@@ -1,0 +1,122 @@
+"""Bitstream + helper unit tests (OStream/IStream/varint/bits).
+
+Mirrors the reference's ostream/istream unit coverage
+(/root/reference/src/dbnode/encoding/{ostream,istream}_test.go) behaviorally.
+"""
+
+import pytest
+
+from m3_tpu.codec.istream import IStream
+from m3_tpu.codec.ostream import OStream
+from m3_tpu.utils import varint
+from m3_tpu.utils.bits import (
+    bits_to_float,
+    float_to_bits,
+    leading_and_trailing_zeros,
+    num_sig,
+    sign_extend,
+)
+
+
+def test_write_bits_msb_first():
+    os = OStream()
+    os.write_bits(0b101, 3)
+    os.write_bits(0b11111, 5)
+    raw, pos = os.raw_bytes()
+    assert raw == bytes([0b10111111])
+    assert pos == 8
+
+
+def test_write_byte_unaligned():
+    os = OStream()
+    os.write_bit(1)
+    os.write_byte(0xFF)
+    raw, pos = os.raw_bytes()
+    assert raw == bytes([0b11111111, 0b10000000])
+    assert pos == 1
+
+
+def test_write_bits_64():
+    os = OStream()
+    v = 0x0123456789ABCDEF
+    os.write_bits(v, 64)
+    raw, pos = os.raw_bytes()
+    assert raw == v.to_bytes(8, "big")
+    assert pos == 8
+
+
+def test_read_back_roundtrip():
+    os = OStream()
+    pieces = [(0b1, 1), (0xAB, 8), (0x3FF, 10), (0, 3), (0x0123456789ABCDEF, 64), (0b101, 3)]
+    for v, n in pieces:
+        os.write_bits(v, n)
+    raw, _ = os.raw_bytes()
+    ist = IStream(raw)
+    for v, n in pieces:
+        assert ist.read_bits(n) == v
+
+
+def test_peek_does_not_consume():
+    os = OStream()
+    os.write_bits(0b110101, 6)
+    os.write_bits(0xDEAD, 16)
+    raw, _ = os.raw_bytes()
+    ist = IStream(raw)
+    assert ist.read_bits(2) == 0b11
+    assert ist.peek_bits(4) == 0b0101
+    assert ist.peek_bits(4) == 0b0101
+    assert ist.read_bits(4) == 0b0101
+    assert ist.read_bits(16) == 0xDEAD
+
+
+def test_read_past_end_raises():
+    ist = IStream(b"\xff")
+    ist.read_bits(8)
+    with pytest.raises(EOFError):
+        ist.read_bits(1)
+    with pytest.raises(EOFError):
+        IStream(b"\x00").peek_bits(9)
+
+
+@pytest.mark.parametrize("x", [0, 1, -1, 63, -64, 64, 1 << 40, -(1 << 40), 2**62, -(2**62)])
+def test_varint_roundtrip(x):
+    data = varint.put_varint(x)
+    it = iter(data)
+    assert varint.read_varint(lambda: next(it)) == x
+
+
+def test_varint_go_vectors():
+    # Go binary.PutVarint: zigzag then LEB128. PutVarint(0)=[0x00], (1)=[0x02],
+    # (-1)=[0x01], (4)=[0x08], (-5)=[0x09].
+    assert varint.put_varint(0) == b"\x00"
+    assert varint.put_varint(1) == b"\x02"
+    assert varint.put_varint(-1) == b"\x01"
+    assert varint.put_varint(4) == b"\x08"
+    assert varint.put_varint(-5) == b"\x09"
+
+
+def test_num_sig():
+    assert num_sig(0) == 0
+    assert num_sig(1) == 1
+    assert num_sig(0xFF) == 8
+    assert num_sig(1 << 63) == 64
+
+
+def test_leading_trailing():
+    assert leading_and_trailing_zeros(0) == (64, 0)
+    assert leading_and_trailing_zeros(1) == (63, 0)
+    assert leading_and_trailing_zeros(1 << 63) == (0, 63)
+    assert leading_and_trailing_zeros(0b1100) == (60, 2)
+
+
+def test_sign_extend():
+    assert sign_extend(0b0111, 4) == 7
+    assert sign_extend(0b1000, 4) == -8
+    assert sign_extend(0b1111, 4) == -1
+    assert sign_extend((1 << 64) - 1, 64) == -1
+
+
+def test_float_bits_roundtrip():
+    for v in [0.0, -0.0, 1.5, -3.25, 1e300, float("inf")]:
+        assert bits_to_float(float_to_bits(v)) == v
+    assert float_to_bits(1.0) == 0x3FF0000000000000

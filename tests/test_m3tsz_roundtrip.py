@@ -1,0 +1,299 @@
+"""M3TSZ codec round-trip + format-invariant tests.
+
+Mirrors the reference's round-trip coverage
+(/root/reference/src/dbnode/encoding/m3tsz/roundtrip_test.go,
+encoder_test.go, iterator_test.go) behaviorally, plus property-style
+randomized series per the test strategy in SURVEY.md §4.
+"""
+
+import math
+import random
+
+import pytest
+
+from m3_tpu.codec import scheme
+from m3_tpu.codec.m3tsz import (
+    Datapoint,
+    Encoder,
+    ReaderIterator,
+    convert_to_int_float,
+    decode,
+    encode_series,
+)
+from m3_tpu.codec.ostream import OStream
+from m3_tpu.utils.xtime import Unit
+
+START = 1_600_000_000 * 10**9  # aligned to seconds
+
+
+def roundtrip(ts, vals, **kw):
+    data = encode_series(ts, vals, start_nanos=START, **kw)
+    dps = decode(data, int_optimized=kw.get("int_optimized", True))
+    assert len(dps) == len(ts)
+    for et, ev, dp in zip(ts, vals, dps):
+        assert dp.timestamp == et
+        if math.isnan(ev):
+            assert math.isnan(dp.value)
+        else:
+            assert dp.value == ev
+    return data
+
+
+def test_simple_gauges():
+    ts = [START + (i + 1) * 10 * 10**9 for i in range(100)]
+    vals = [float(i % 7) for i in range(100)]
+    data = roundtrip(ts, vals)
+    # Regular int data compresses far below 2 bytes/dp.
+    assert len(data) / len(ts) < 2.0
+
+
+def test_random_jitter_series():
+    random.seed(7)
+    t = START
+    ts, vals = [], []
+    for _ in range(1000):
+        t += random.choice([9, 10, 10, 10, 11, 30]) * 10**9
+        ts.append(t)
+        vals.append(round(random.uniform(-500, 500), random.choice([0, 1, 2])))
+    roundtrip(ts, vals)
+
+
+def test_pure_float_series():
+    ts = [START + (i + 1) * 10**9 for i in range(512)]
+    vals = [math.sin(i / 9.0) * math.pi for i in range(512)]
+    roundtrip(ts, vals)
+    roundtrip(ts, vals, int_optimized=False)
+
+
+def test_special_values():
+    ts = [START + (i + 1) * 10**9 for i in range(8)]
+    vals = [0.0, -0.0, float("nan"), float("inf"), float("-inf"), 1e-300, 1e300, 5.0]
+    roundtrip(ts, vals)
+    roundtrip(ts, vals, int_optimized=False)
+
+
+def test_repeated_values_compress_to_bits():
+    n = 720
+    ts = [START + (i + 1) * 10 * 10**9 for i in range(n)]
+    vals = [42.0] * n
+    data = roundtrip(ts, vals)
+    # dod==0 (1 bit) + repeat (2 bits) per point after the first.
+    assert len(data) < n  # well under 1 byte/dp
+
+
+def test_annotations_roundtrip():
+    enc = Encoder(START)
+    enc.encode(START + 10**9, 1.0, annotation=b"schema-v1")
+    enc.encode(START + 2 * 10**9, 2.0, annotation=b"schema-v1")  # unchanged: not rewritten
+    enc.encode(START + 3 * 10**9, 3.0, annotation=b"schema-v2")
+    dps = decode(enc.stream())
+    assert dps[0].annotation == b"schema-v1"
+    assert dps[1].annotation is None  # only carried when it changes
+    assert dps[2].annotation == b"schema-v2"
+
+
+def test_single_byte_annotation_varint_zero():
+    enc = Encoder(START)
+    enc.encode(START + 10**9, 1.0, annotation=b"x")  # len-1 == 0 varint
+    dps = decode(enc.stream())
+    assert dps[0].annotation == b"x"
+
+
+def test_time_unit_change_mid_stream():
+    enc = Encoder(START)
+    enc.encode(START + 10**9, 1.0, unit=Unit.SECOND)
+    enc.encode(START + 10**9 + 250_000_000, 2.0, unit=Unit.MILLISECOND)
+    enc.encode(START + 10**9 + 500_000_000, 3.0, unit=Unit.MILLISECOND)
+    enc.encode(START + 2 * 10**9, 4.0, unit=Unit.SECOND)
+    dps = decode(enc.stream())
+    assert [d.timestamp for d in dps] == [
+        START + 10**9,
+        START + 10**9 + 250_000_000,
+        START + 10**9 + 500_000_000,
+        START + 2 * 10**9,
+    ]
+    assert dps[1].unit == Unit.MILLISECOND
+    assert dps[3].unit == Unit.SECOND
+
+
+def test_unaligned_start_writes_time_unit_marker():
+    # Start not divisible by one second -> initial unit None -> first write
+    # emits a time-unit marker (timestamp_encoder.go:208-219).
+    start = START + 123
+    enc = Encoder(start)
+    enc.encode(start + 10**9, 1.0)
+    enc.encode(start + 2 * 10**9, 2.0)
+    dps = decode(enc.stream())
+    assert [d.timestamp for d in dps] == [start + 10**9, start + 2 * 10**9]
+
+
+def test_nanosecond_unit_64bit_default_bucket():
+    start = START
+    ts = [start + 1, start + 2, start + 3 + 10**15]  # huge dod forces 64-bit bucket
+    vals = [1.0, 2.0, 3.0]
+    enc = Encoder(start)
+    for t, v in zip(ts, vals):
+        enc.encode(t, v, unit=Unit.NANOSECOND)
+    dps = decode(enc.stream())
+    assert [d.timestamp for d in dps] == ts
+
+
+def test_negative_dod_buckets():
+    # Exercise each bucket size: 7/9/12-bit and the 32-bit default (seconds).
+    deltas = [10, 10 - 63, 10 + 200, 10 - 2000, 10 + 100000]  # seconds between points
+    t = START
+    ts = []
+    for i, d in enumerate(deltas):
+        t += abs(d) * 10**9 if False else d * 10**9 if t + d * 10**9 > START else (i + 1) * 10**9
+        ts.append(t)
+    # ensure strictly increasing
+    ts = sorted(set(ts))
+    vals = [float(i) for i in range(len(ts))]
+    roundtrip(ts, vals)
+
+
+def test_known_first_record_bits():
+    """Lock the wire format for one datapoint (int-optimized zero value).
+
+    Stream: 64-bit start nanos, dod bucket 0b10 + 7-bit value 10,
+    then int mode bit 0, sig update path for value 5 -> sig=3,
+    mult no-update, sign bit, 3 diff bits, then EOS tail.
+    """
+    start = START
+    enc = Encoder(start)
+    enc.encode(start + 10 * 10**9, 5.0)
+    data = enc.stream()
+    from m3_tpu.codec.istream import IStream
+
+    ist = IStream(data)
+    assert ist.read_bits(64) == start
+    assert ist.read_bits(2) == 0b10  # first dod bucket opcode
+    assert ist.read_bits(7) == 10  # dod == delta == 10s
+    assert ist.read_bits(1) == 0  # int mode
+    assert ist.read_bits(1) == 1  # update sig
+    assert ist.read_bits(1) == 1  # non-zero sig
+    assert ist.read_bits(6) == 2  # sig-1 == 2 (5 needs 3 bits)
+    assert ist.read_bits(1) == 0  # no mult update
+    assert ist.read_bits(1) == 1  # "negative diff" opcode meaning add (first value >= 0)
+    assert ist.read_bits(3) == 5  # |value|
+    assert ist.read_bits(scheme.NUM_MARKER_OPCODE_BITS) == scheme.MARKER_OPCODE
+    assert ist.read_bits(scheme.NUM_MARKER_VALUE_BITS) == scheme.END_OF_STREAM_MARKER
+
+
+def test_tail_scheme():
+    os = OStream()
+    os.write_bits(0b1011, 4)
+    raw, pos = os.raw_bytes()
+    t = scheme.tail(raw[-1], pos)
+    # 4 bits of data + 11 marker bits = 15 bits -> 2 bytes
+    assert len(t) == 2
+    from m3_tpu.codec.istream import IStream
+
+    ist = IStream(t)
+    assert ist.read_bits(4) == 0b1011
+    assert ist.read_bits(9) == scheme.MARKER_OPCODE
+    assert ist.read_bits(2) == scheme.END_OF_STREAM_MARKER
+
+
+class TestConvertToIntFloat:
+    def test_exact_ints(self):
+        assert convert_to_int_float(46.0, 0) == (46.0, 0, False)
+        assert convert_to_int_float(-3.0, 0) == (-3.0, 0, False)
+        assert convert_to_int_float(0.0, 0) == (0.0, 0, False)
+
+    def test_decimal_scaling(self):
+        val, mult, is_float = convert_to_int_float(1.5, 0)
+        assert (val, mult, is_float) == (15.0, 1, False)
+        val, mult, is_float = convert_to_int_float(0.001, 0)
+        assert (val, mult, is_float) == (1.0, 3, False)
+
+    def test_near_int_rounding(self):
+        # 46.000000000000001 is the same float64 as 46.0
+        val, mult, is_float = convert_to_int_float(46.000000000000001, 0)
+        assert (val, mult, is_float) == (46.0, 0, False)
+
+    def test_true_float(self):
+        val, mult, is_float = convert_to_int_float(math.pi, 0)
+        assert is_float and val == math.pi
+
+    def test_existing_mult_scales_first(self):
+        val, mult, is_float = convert_to_int_float(2.0, 2)
+        assert (val, mult, is_float) == (200.0, 2, False)
+
+    def test_large_value_stays_float(self):
+        # Integral values take the quick path regardless of magnitude…
+        assert convert_to_int_float(1.5e13, 0) == (1.5e13, 0, False)
+        # …but non-integral values past maxOptInt stay float (m3tsz.go:98).
+        val, mult, is_float = convert_to_int_float(1.5e13 + 0.5, 0)
+        assert is_float
+
+
+def test_int_float_mode_transitions():
+    ts = [START + (i + 1) * 10**9 for i in range(6)]
+    vals = [5.0, 6.0, math.pi, math.e, 7.0, 8.5]
+    roundtrip(ts, vals)
+
+
+def test_sig_tracker_hysteresis_roundtrip():
+    # Large diffs then many small diffs: sig should shrink only after the
+    # repeat threshold; round trip must stay exact throughout.
+    random.seed(3)
+    t = START
+    ts, vals = [], []
+    v = 1_000_000.0
+    for i in range(64):
+        t += 10 * 10**9
+        ts.append(t)
+        v += random.choice([1, -1, 100000, -100000]) if i < 10 else random.choice([1, -1])
+        vals.append(float(v))
+    roundtrip(ts, vals)
+
+
+def test_iterator_api():
+    ts = [START + (i + 1) * 10**9 for i in range(10)]
+    vals = [float(i) for i in range(10)]
+    data = encode_series(ts, vals, start_nanos=START)
+    it = ReaderIterator(data)
+    n = 0
+    while it.next():
+        dp = it.current()
+        assert dp.timestamp == ts[n] and dp.value == vals[n]
+        n += 1
+    assert n == 10
+    assert it.err is None
+
+
+def test_empty_encoder_stream():
+    enc = Encoder(START)
+    assert enc.stream() == b""
+    assert len(enc) == 0
+
+
+def test_decode_empty():
+    assert decode(b"") == []
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_property_random_series(seed):
+    """Property-style: random timestamps/values always round trip exactly."""
+    rng = random.Random(seed)
+    t = START + rng.randrange(0, 10**9)  # possibly unaligned start
+    ts, vals = [], []
+    for _ in range(rng.randrange(1, 400)):
+        t += rng.randrange(1, 10**11)
+        ts.append(t)
+        kind = rng.random()
+        if kind < 0.4:
+            vals.append(float(rng.randrange(-(10**6), 10**6)))
+        elif kind < 0.7:
+            vals.append(round(rng.uniform(-1000, 1000), rng.randrange(0, 6)))
+        else:
+            vals.append(rng.uniform(-1e12, 1e12))
+    enc = Encoder(START, default_unit=Unit.NANOSECOND)
+    for tt, vv in zip(ts, vals):
+        enc.encode(tt, vv, unit=Unit.NANOSECOND)
+    dps = decode(enc.stream())
+    assert len(dps) == len(ts)
+    for et, ev, dp in zip(ts, vals, dps):
+        assert dp.timestamp == et
+        assert dp.value == ev
