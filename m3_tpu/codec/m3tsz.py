@@ -696,7 +696,9 @@ def decode(
     out = []
     while it.next():
         out.append(it.current())
-    if it.err is not None:
+    # Parity with Go callers: io.EOF is treated as stream end, anything else
+    # (e.g. an invalid multiplier) is a real decode error.
+    if it.err is not None and not isinstance(it.err, EOFError):
         raise it.err
     return out
 
