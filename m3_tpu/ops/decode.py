@@ -1,0 +1,518 @@
+"""Batched M3TSZ decode as a JAX program (jit/TPU-compatible).
+
+The CPU iterator (/root/reference/src/dbnode/encoding/m3tsz/iterator.go) is a
+sequential bit-stream walk; the TPU design parallelizes ACROSS series and
+scans WITHIN each series (SURVEY.md §2.5, §7): one `lax.scan` step decodes one
+datapoint record for every series simultaneously. All control flow is
+branchless — every possible record interpretation is computed from a fetched
+bit window and the right one selected — because XLA traces a single static
+program.
+
+64-bit quantities (timestamps, float64 bit patterns) are (hi, lo) uint32
+pairs via ops.u64 since TPUs have no native 64-bit integers.
+
+Device-decode contract (vs the CPU reference decoder):
+- bit-exact timestamps and value *state* (float bits / int value + multiplier)
+  surfaced as integer pairs; `finalize_decode` reconstructs bit-exact float64
+  values on host.
+- annotations are not supported on device (streams carrying them set the
+  per-series `err` flag); the host ReaderIterator handles those.
+- time units second/ms/us/ns are supported, including mid-stream time-unit
+  change markers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.xtime import Unit
+from . import u64
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+# Marker scheme constants (encoding/scheme.go:28-38).
+_MARKER_OPCODE = 0x100
+_MARKER_BITS = 11
+_EOS = 0
+_ANNOTATION = 1
+_TIME_UNIT = 2
+
+# Unit code -> nanos multiplier; only s/ms/us/ns decodable on device.
+_UNIT_NANOS = np.zeros(9, dtype=np.uint32)
+_UNIT_NANOS[Unit.SECOND] = 1_000_000_000
+_UNIT_NANOS[Unit.MILLISECOND] = 1_000_000
+_UNIT_NANOS[Unit.MICROSECOND] = 1_000
+_UNIT_NANOS[Unit.NANOSECOND] = 1
+# Default dod bucket width: 32 bits for s/ms, 64 for us/ns (scheme.go:47-52).
+_UNIT_DEFAULT_BITS = np.zeros(9, dtype=np.int32)
+_UNIT_DEFAULT_BITS[Unit.SECOND] = 32
+_UNIT_DEFAULT_BITS[Unit.MILLISECOND] = 32
+_UNIT_DEFAULT_BITS[Unit.MICROSECOND] = 64
+_UNIT_DEFAULT_BITS[Unit.NANOSECOND] = 64
+
+
+class DecodeState(NamedTuple):
+    pos: jnp.ndarray  # int32[S] bit cursor
+    done: jnp.ndarray  # bool[S]
+    err: jnp.ndarray  # bool[S]
+    prev_time: tuple  # u64[S] unix nanos
+    prev_delta: tuple  # u64[S] signed nanos
+    time_unit: jnp.ndarray  # int32[S]
+    prev_float_bits: tuple  # u64[S]
+    prev_xor: tuple  # u64[S]
+    int_val: tuple  # u64[S] signed current int value
+    mult: jnp.ndarray  # int32[S]
+    sig: jnp.ndarray  # int32[S]
+    is_float: jnp.ndarray  # bool[S]
+
+
+class DecodeResult(NamedTuple):
+    """[S, T] outputs; see finalize_decode for host-side value reconstruction."""
+
+    ts_hi: jnp.ndarray
+    ts_lo: jnp.ndarray
+    val_hi: jnp.ndarray  # float64 bits OR signed int64 value, per point_is_float
+    val_lo: jnp.ndarray
+    point_is_float: jnp.ndarray  # bool[S, T]
+    mult: jnp.ndarray  # int32[S, T] decimal multiplier exponent for int points
+    valid: jnp.ndarray  # bool[S, T]
+    err: jnp.ndarray  # bool[S] series hit a decode error / unsupported feature
+    values_f32: jnp.ndarray  # float32[S, T] approximate values for aggregation
+
+
+def _pick4(ws, k):
+    """Select ws[k], ws[k+1], ws[k+2] from a 4-word window, 0 beyond."""
+    zero = jnp.zeros_like(ws[0])
+    opts = list(ws) + [zero, zero, zero]
+
+    def pick(i):
+        # i is a traced int32 vector in 0..5
+        r = zero
+        for j in range(6):
+            r = jnp.where(i == j, opts[j], r)
+        return r
+
+    return pick(k), pick(k + 1), pick(k + 2)
+
+
+def _extract(ws, start, n):
+    """Read ``n`` (<=64) bits at bit offset ``start`` within a 4-word window.
+
+    Valid as long as start + n <= 97 (4 words minus the <=31-bit base shift).
+    Returns a u64 pair holding the bits right-aligned.
+    """
+    start = jnp.asarray(start, I32)
+    k = start >> 5
+    r = (start & 31).astype(U32)
+    w0, w1, w2 = _pick4(ws, k)
+    nz = r != 0
+    hi = (w0 << r) | jnp.where(nz, w1 >> (U32(32) - r), U32(0))
+    lo = (w1 << r) | jnp.where(nz, w2 >> (U32(32) - r), U32(0))
+    return u64.shr((hi, lo), jnp.asarray(64, I32) - jnp.asarray(n, I32))
+
+
+def _extract32(ws, start, n):
+    """As _extract but returns the low word (n <= 32)."""
+    return _extract(ws, start, n)[1]
+
+
+def _fetch4(words, pos):
+    """Gather 4 consecutive words starting at pos//32 for each series."""
+    widx = jnp.clip(pos >> 5, 0, words.shape[1] - 1)
+    base = words.shape[1] - 1
+
+    def take(off):
+        idx = jnp.clip(widx + off, 0, base)
+        return jnp.take_along_axis(words, idx[:, None], axis=1)[:, 0]
+
+    ws = (take(0), take(1), take(2), take(3))
+    # Align to the in-word bit offset so extracts are relative to `pos`.
+    r = (pos & 31).astype(U32)
+    nz = r != 0
+    inv = U32(32) - r
+
+    def sh(a, b):
+        return (a << r) | jnp.where(nz, b >> inv, U32(0))
+
+    return (sh(ws[0], ws[1]), sh(ws[1], ws[2]), sh(ws[2], ws[3]), ws[3] << r)
+
+
+def _decode_timestamp(words, num_bits, state, first):
+    """One timestamp record for all series. Returns (state', became_done)."""
+    pos = state.pos
+    # --- first record: 64-bit unix nanos start time ---
+    ws0 = _fetch4(words, pos)
+    nt = _extract(ws0, jnp.zeros_like(pos), jnp.full_like(pos, 64))
+    pos = jnp.where(first, pos + 64, pos)
+    prev_time = u64.select(first, nt, state.prev_time)
+
+    ws = _fetch4(words, pos)
+    # --- marker peek (11 bits; zero padding can never look like a marker) ---
+    in_range = (pos + _MARKER_BITS) <= num_bits
+    peek = _extract32(ws, jnp.zeros_like(pos), jnp.full_like(pos, _MARKER_BITS))
+    is_marker = in_range & (peek >> 2 == _MARKER_OPCODE)
+    marker_val = (peek & 3).astype(I32)
+    eos = is_marker & (marker_val == _EOS)
+    ann = is_marker & (marker_val == _ANNOTATION)
+    tu_marker = is_marker & (marker_val == _TIME_UNIT)
+
+    # --- time-unit marker: 8-bit unit byte follows ---
+    new_unit = _extract32(ws, jnp.full_like(pos, _MARKER_BITS), jnp.full_like(pos, 8)).astype(I32)
+    unit_nanos_tab = jnp.asarray(_UNIT_NANOS)
+    tu_supported = (new_unit >= 1) & (new_unit <= 4)
+    tu_changed = tu_marker & tu_supported & (new_unit != state.time_unit)
+    time_unit = jnp.where(tu_marker & tu_supported, new_unit, state.time_unit)
+    # offset of the dod record within the window
+    dod_off = jnp.where(tu_marker, _MARKER_BITS + 8, 0)
+
+    # --- dod decode ---
+    # changed path: raw 64-bit nanos (timestamp_iterator.go:228-238)
+    dod_changed = _extract(ws, dod_off, jnp.full_like(pos, 64))
+
+    # bucket path
+    b0 = _extract32(ws, dod_off, jnp.ones_like(pos))
+    b1 = _extract32(ws, dod_off + 1, jnp.ones_like(pos))
+    b2 = _extract32(ws, dod_off + 2, jnp.ones_like(pos))
+    b3 = _extract32(ws, dod_off + 3, jnp.ones_like(pos))
+    zero_dod = b0 == 0
+    sel7 = (b0 == 1) & (b1 == 0)
+    sel9 = (b0 == 1) & (b1 == 1) & (b2 == 0)
+    sel12 = (b0 == 1) & (b1 == 1) & (b2 == 1) & (b3 == 0)
+    default_bits = jnp.take(jnp.asarray(_UNIT_DEFAULT_BITS), jnp.clip(time_unit, 0, 8))
+    nbits = jnp.where(
+        sel7, 7, jnp.where(sel9, 9, jnp.where(sel12, 12, default_bits))
+    ).astype(I32)
+    opbits = jnp.where(sel7, 2, jnp.where(sel9, 3, 4)).astype(I32)
+    raw = _extract(ws, dod_off + opbits, nbits)
+    dod_norm = u64.sign_extend(raw, nbits)
+    unit_nanos = jnp.take(unit_nanos_tab, jnp.clip(time_unit, 0, 8))
+    dod_bucket = u64.mul_u32(dod_norm, unit_nanos)
+    bucket_consumed = jnp.where(zero_dod, 1, opbits + nbits)
+
+    dod = u64.select(tu_changed, u64.sign_extend(dod_changed, jnp.full_like(pos, 64)), dod_bucket)
+    dod = u64.select(zero_dod & ~tu_changed, u64.const(0, dod[0].shape), dod)
+    consumed = dod_off + jnp.where(tu_changed, 64, bucket_consumed)
+
+    unit_ok = (time_unit >= 1) & (time_unit <= 4)
+    err_now = (ann | ~unit_ok | (tu_marker & ~tu_supported)) & ~state.done & ~eos
+
+    prev_delta = u64.add(state.prev_delta, dod)
+    prev_time = u64.add(prev_time, prev_delta)
+    prev_delta = u64.select(tu_changed, u64.const(0, prev_delta[0].shape), prev_delta)
+
+    active = ~state.done & ~state.err & ~eos & ~err_now
+    new_pos = jnp.where(active, pos + consumed, state.pos)
+    state = state._replace(
+        pos=new_pos,
+        done=state.done | eos,
+        err=state.err | err_now,
+        prev_time=u64.select(active, prev_time, state.prev_time),
+        prev_delta=u64.select(active, prev_delta, state.prev_delta),
+        time_unit=jnp.where(active, time_unit, state.time_unit),
+    )
+    return state, eos
+
+
+def _read_int_header(ws, off, sig, mult):
+    """sig/mult update header (iterator.go readIntSigMult). Returns
+    (sig', mult', consumed, mult_invalid)."""
+    one = jnp.ones_like(off)
+    b_sig_upd = _extract32(ws, off, one)
+    b_zero_sig = _extract32(ws, off + 1, one)
+    sig_m1 = _extract32(ws, off + 2, jnp.full_like(off, 6)).astype(I32)
+    upd = b_sig_upd == 1
+    zero_sig = b_zero_sig == 0  # OpcodeZeroSig == 0x0
+    new_sig = jnp.where(upd, jnp.where(zero_sig, 0, sig_m1 + 1), sig)
+    sig_consumed = jnp.where(upd, jnp.where(zero_sig, 2, 8), 1)
+
+    moff = off + sig_consumed
+    b_mult_upd = _extract32(ws, moff, one)
+    mult_v = _extract32(ws, moff + 1, jnp.full_like(off, 3)).astype(I32)
+    mupd = b_mult_upd == 1
+    new_mult = jnp.where(mupd, mult_v, mult)
+    consumed = sig_consumed + jnp.where(mupd, 4, 1)
+    mult_invalid = mupd & (mult_v > 6)
+    return new_sig, new_mult, moff + jnp.where(mupd, 4, 1) - off, mult_invalid
+
+
+def _read_int_diff(ws, off, sig, int_val):
+    """Sign + sig-bit diff (iterator.go readIntValDiff). Returns (int_val', consumed)."""
+    sign_bit = _extract32(ws, off, jnp.ones_like(off))
+    diff = _extract(ws, off + 1, sig)
+    # opcodeNegative(1) means "add |diff|" (see iterator.go:162-169 semantics).
+    delta = u64.select(sign_bit == 1, diff, u64.neg(diff))
+    return u64.add(int_val, delta), 1 + sig
+
+
+def _read_xor(ws, off, prev_float_bits, prev_xor):
+    """XOR float record (float_encoder_iterator.go:117-166).
+
+    Returns (prev_float_bits', prev_xor', consumed)."""
+    one = jnp.ones_like(off)
+    c0 = _extract32(ws, off, one)
+    c1 = _extract32(ws, off + 1, one)
+    zero_path = c0 == 0
+    contained = (c0 == 1) & (c1 == 0)
+
+    # contained: reuse prev leading/trailing window
+    prev_nonzero = ~u64.is_zero(prev_xor)
+    prev_lead = jnp.where(prev_nonzero, u64.clz(prev_xor), 64)
+    prev_trail = jnp.where(prev_nonzero, u64.ctz(prev_xor), 0)
+    nm_c = jnp.clip(64 - prev_lead - prev_trail, 0, 64)
+    bits_c = _extract(ws, off + 2, nm_c)
+    xor_c = u64.shl(bits_c, prev_trail)
+    consumed_c = 2 + nm_c
+
+    # uncontained: 6-bit lead, 6-bit (nm-1), nm bits
+    lead_u = _extract32(ws, off + 2, jnp.full_like(off, 6)).astype(I32)
+    nm_u = _extract32(ws, off + 8, jnp.full_like(off, 6)).astype(I32) + 1
+    bits_u = _extract(ws, off + 14, nm_u)
+    trail_u = jnp.clip(64 - lead_u - nm_u, 0, 64)
+    xor_u = u64.shl(bits_u, trail_u)
+    consumed_u = 14 + nm_u
+
+    xor = u64.select(contained, xor_c, xor_u)
+    xor = u64.select(zero_path, u64.const(0, xor[0].shape), xor)
+    consumed = jnp.where(zero_path, 1, jnp.where(contained, consumed_c, consumed_u))
+    new_bits = u64.bxor(prev_float_bits, xor)
+    return new_bits, xor, consumed
+
+
+def _decode_value(words, state, first, int_optimized: bool):
+    """One value record for all series (iterator.go readFirstValue/readNextValue)."""
+    pos = state.pos
+    ws = _fetch4(words, pos)
+    zero = jnp.zeros_like(pos)
+    one = jnp.ones_like(pos)
+
+    if not int_optimized:
+        full = _extract(ws, zero, jnp.full_like(pos, 64))
+        nb, nx, consumed = _read_xor(ws, zero, state.prev_float_bits, state.prev_xor)
+        new_bits = u64.select(first, full, nb)
+        new_xor = u64.select(first, full, nx)
+        consumed = jnp.where(first, 64, consumed)
+        active = ~state.done & ~state.err
+        return state._replace(
+            pos=jnp.where(active, pos + consumed, state.pos),
+            prev_float_bits=u64.select(active, new_bits, state.prev_float_bits),
+            prev_xor=u64.select(active, new_xor, state.prev_xor),
+            is_float=jnp.ones_like(state.is_float),
+        )
+
+    # ---- int-optimized scheme ----
+    # FIRST record: mode bit, then full float or int header+diff.
+    f_mode = _extract32(ws, zero, one)  # 1 = float (opcodeFloatMode)
+    f_full = _extract(ws, one, jnp.full_like(pos, 64))
+    f_sig, f_mult, f_hdr_consumed, f_mult_bad = _read_int_header(ws, one, state.sig, state.mult)
+    f_int_val, f_diff_consumed = _read_int_diff(
+        ws, one + f_hdr_consumed, f_sig, u64.const(0, pos.shape)
+    )
+    first_is_float = f_mode == 1
+    first_consumed = jnp.where(first_is_float, 65, 1 + f_hdr_consumed + f_diff_consumed)
+
+    # NEXT record.
+    b0 = _extract32(ws, zero, one)  # 0 = update, 1 = no update
+    b1 = _extract32(ws, one, one)  # update: 1 = repeat
+    b2 = _extract32(ws, jnp.full_like(pos, 2), one)  # update+norepeat: 1 = float mode
+    upd = b0 == 0
+    repeat = upd & (b1 == 1)
+    to_float = upd & ~repeat & (b2 == 1)
+    to_int = upd & ~repeat & (b2 == 0)
+    stay = ~upd
+
+    # update -> float: full 64-bit float at offset 3
+    u_full = _extract(ws, jnp.full_like(pos, 3), jnp.full_like(pos, 64))
+    # update -> int: header at offset 3 then diff
+    u_sig, u_mult, u_hdr_consumed, u_mult_bad = _read_int_header(
+        ws, jnp.full_like(pos, 3), state.sig, state.mult
+    )
+    u_int_val, u_diff_consumed = _read_int_diff(
+        ws, jnp.full_like(pos, 3) + u_hdr_consumed, u_sig, state.int_val
+    )
+    # no update: XOR (float mode) or plain diff (int mode)
+    x_bits, x_xor, x_consumed = _read_xor(ws, one, state.prev_float_bits, state.prev_xor)
+    s_int_val, s_diff_consumed = _read_int_diff(ws, one, state.sig, state.int_val)
+
+    next_consumed = jnp.where(
+        repeat,
+        2,
+        jnp.where(
+            to_float,
+            3 + 64,
+            jnp.where(
+                to_int,
+                3 + u_hdr_consumed + u_diff_consumed,
+                jnp.where(state.is_float, 1 + x_consumed, 1 + s_diff_consumed),
+            ),
+        ),
+    )
+
+    # ---- merge first/next ----
+    consumed = jnp.where(first, first_consumed, next_consumed)
+
+    sel_first_float = first & first_is_float
+    sel_first_int = first & ~first_is_float
+    sel_to_float = ~first & to_float
+    sel_to_int = ~first & to_int
+    sel_stay_float = ~first & stay & state.is_float
+    sel_stay_int = ~first & stay & ~state.is_float
+    sel_repeat = ~first & repeat
+
+    new_is_float = jnp.where(
+        sel_first_float | sel_to_float,
+        True,
+        jnp.where(sel_first_int | sel_to_int, False, state.is_float),
+    )
+
+    # float bits: full float on first/to_float; XOR result when staying float.
+    new_float_bits = u64.select(sel_first_float, f_full, state.prev_float_bits)
+    new_float_bits = u64.select(sel_to_float, u_full, new_float_bits)
+    new_float_bits = u64.select(sel_stay_float, x_bits, new_float_bits)
+    new_xor = u64.select(sel_first_float, f_full, state.prev_xor)
+    new_xor = u64.select(sel_to_float, u_full, new_xor)
+    new_xor = u64.select(sel_stay_float, x_xor, new_xor)
+
+    new_int_val = u64.select(sel_first_int, f_int_val, state.int_val)
+    new_int_val = u64.select(sel_to_int, u_int_val, new_int_val)
+    new_int_val = u64.select(sel_stay_int, s_int_val, new_int_val)
+
+    new_sig = jnp.where(sel_first_int, f_sig, jnp.where(sel_to_int, u_sig, state.sig))
+    new_mult = jnp.where(sel_first_int, f_mult, jnp.where(sel_to_int, u_mult, state.mult))
+    err_now = (sel_first_int & f_mult_bad) | (sel_to_int & u_mult_bad)
+
+    active = ~state.done & ~state.err & ~err_now
+    return state._replace(
+        pos=jnp.where(active, pos + consumed, state.pos),
+        err=state.err | (err_now & ~state.done),
+        prev_float_bits=u64.select(active, new_float_bits, state.prev_float_bits),
+        prev_xor=u64.select(active, new_xor, state.prev_xor),
+        int_val=u64.select(active, new_int_val, state.int_val),
+        sig=jnp.where(active, new_sig, state.sig),
+        mult=jnp.where(active, new_mult, state.mult),
+        is_float=jnp.where(active, new_is_float, state.is_float),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_points", "int_optimized"))
+def decode_batched(
+    words,
+    num_bits,
+    initial_unit,
+    max_points: int,
+    int_optimized: bool = True,
+) -> DecodeResult:
+    """Decode up to ``max_points`` datapoints from every series' stream.
+
+    Args:
+      words: uint32[S, W] big-endian-packed streams (BatchedSegments.words).
+      num_bits: int32[S] valid bits per stream.
+      initial_unit: int32[S] initial time unit codes (BatchedSegments helper;
+        mirrors initialTimeUnit nt-divisibility in timestamp_iterator.go:115-134).
+      max_points: static scan length T.
+    """
+    words = jnp.asarray(words, U32)
+    num_bits = jnp.asarray(num_bits, I32)
+    initial_unit = jnp.asarray(initial_unit, I32)
+    s = words.shape[0]
+    zero_pair = u64.const(0, (s,))
+
+    state = DecodeState(
+        pos=jnp.zeros((s,), I32),
+        done=num_bits <= 0,
+        err=jnp.zeros((s,), bool),
+        prev_time=zero_pair,
+        prev_delta=zero_pair,
+        time_unit=initial_unit,
+        prev_float_bits=zero_pair,
+        prev_xor=zero_pair,
+        int_val=zero_pair,
+        mult=jnp.zeros((s,), I32),
+        sig=jnp.zeros((s,), I32),
+        is_float=jnp.zeros((s,), bool),
+    )
+
+    def step(state, idx):
+        first = idx == 0
+        was_active = ~state.done & ~state.err
+        first_vec = jnp.full((s,), False) | first
+        state, _ = _decode_timestamp(words, num_bits, state, first_vec)
+        ts_active = ~state.done & ~state.err
+        state = _decode_value(words, state, first_vec, int_optimized)
+        now_active = ~state.done & ~state.err
+        valid = was_active & ts_active & now_active
+
+        point_is_float = jnp.logical_or(not int_optimized, state.is_float)
+        val = u64.select(point_is_float, state.prev_float_bits, state.int_val)
+        out = (
+            state.prev_time[0],
+            state.prev_time[1],
+            val[0],
+            val[1],
+            point_is_float,
+            state.mult,
+            valid,
+        )
+        return state, out
+
+    final_state, outs = jax.lax.scan(step, state, jnp.arange(max_points))
+    ts_hi, ts_lo, val_hi, val_lo, pif, mult, valid = outs
+    # scan stacks on axis 0 ([T, S]); transpose to [S, T].
+    tr = lambda x: jnp.swapaxes(x, 0, 1)
+    val_pair = (tr(val_hi), tr(val_lo))
+    values_f32 = jnp.where(
+        tr(pif),
+        u64.f64_bits_to_f32(val_pair),
+        _int_val_to_f32(val_pair, tr(mult)),
+    )
+    return DecodeResult(
+        ts_hi=tr(ts_hi),
+        ts_lo=tr(ts_lo),
+        val_hi=val_pair[0],
+        val_lo=val_pair[1],
+        point_is_float=tr(pif),
+        mult=tr(mult),
+        valid=tr(valid),
+        err=final_state.err,
+        values_f32=jnp.where(tr(valid), values_f32, jnp.float32(jnp.nan)),
+    )
+
+
+def _int_val_to_f32(pair, mult):
+    v = u64.to_f32(pair)
+    scale = jnp.take(
+        jnp.asarray([1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6], jnp.float32),
+        jnp.clip(mult, 0, 6),
+    )
+    return v / scale
+
+
+def finalize_decode(res: DecodeResult):
+    """Host-side bit-exact reconstruction: int64 nanos + float64 values.
+
+    Integer-mode points become int_val / 10^mult in float64 — identical
+    arithmetic to the CPU iterator's convertFromIntFloat (m3tsz.go:120-126),
+    so results match the reference decoder bit for bit.
+    """
+    ts_hi = np.asarray(res.ts_hi, np.uint64)
+    ts_lo = np.asarray(res.ts_lo, np.uint64)
+    timestamps = ((ts_hi << np.uint64(32)) | ts_lo).astype(np.int64)
+
+    val_hi = np.asarray(res.val_hi, np.uint64)
+    val_lo = np.asarray(res.val_lo, np.uint64)
+    raw = (val_hi << np.uint64(32)) | val_lo
+    float_vals = raw.view(np.float64)
+
+    int_vals = raw.astype(np.int64).astype(np.float64)
+    scale = np.power(10.0, np.asarray(res.mult, np.int64))
+    int_vals = int_vals / scale
+
+    pif = np.asarray(res.point_is_float, bool)
+    values = np.where(pif, float_vals, int_vals)
+    valid = np.asarray(res.valid, bool)
+    return timestamps, values, valid

@@ -1,0 +1,84 @@
+"""Columnar batched-segment container — the unit shipped to device.
+
+The reference hands query nodes compressed per-series segments
+(ts.Segment via xio.BlockReader, /root/reference/src/dbnode/x/xio/). The TPU
+framework instead batches N series' finalized M3TSZ streams into dense arrays:
+
+- ``words``: uint32[S, W] — each stream's bytes packed big-endian into 32-bit
+  words (bit 0 of the stream is the MSB of word 0), zero-padded to the batch
+  max length. MSB-first packing matches the OStream bit order exactly, so the
+  device bit cursor is just a flat bit index.
+- ``num_bits``: int32[S] — valid bits per series.
+
+This is the array-of-structure-of-arrays equivalent of a []ts.Segment and the
+input to ops.decode.decode_batched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclass
+class BatchedSegments:
+    words: np.ndarray  # uint32[S, W]
+    num_bits: np.ndarray  # int32[S]
+
+    @property
+    def num_series(self) -> int:
+        return self.words.shape[0]
+
+    @property
+    def num_words(self) -> int:
+        return self.words.shape[1]
+
+    @staticmethod
+    def from_streams(streams: Sequence[bytes], pad_words: int | None = None) -> "BatchedSegments":
+        """Pack finalized M3TSZ streams into a dense word matrix."""
+        n = len(streams)
+        max_len = max((len(s) for s in streams), default=0)
+        w = (max_len + 3) // 4
+        if pad_words is not None:
+            w = max(w, pad_words)
+        # Pad W so the decoder's 3-word window fetch never needs bounds checks
+        # beyond index clamping.
+        w += 2
+        words = np.zeros((n, w), dtype=np.uint32)
+        num_bits = np.zeros((n,), dtype=np.int32)
+        for i, s in enumerate(streams):
+            num_bits[i] = len(s) * 8
+            if not s:
+                continue
+            padded = s + b"\x00" * (-len(s) % 4)
+            words[i, : len(padded) // 4] = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+        return BatchedSegments(words=words, num_bits=num_bits)
+
+    def initial_units(self, default_unit=None) -> np.ndarray:
+        """Per-series initial time-unit codes for the device decoder.
+
+        Mirrors initialTimeUnit (m3tsz/timestamp_encoder.go:208-219): the
+        default unit applies only when the stream's first 64-bit timestamp is
+        an exact multiple of it, else the stream starts unitless (None) and
+        carries a time-unit marker.
+        """
+        from ..utils.xtime import Unit
+
+        if default_unit is None:
+            default_unit = Unit.SECOND
+        if self.num_words < 2:
+            return np.zeros((self.num_series,), dtype=np.int32)
+        nt = (self.words[:, 0].astype(np.uint64) << np.uint64(32)) | self.words[:, 1].astype(
+            np.uint64
+        )
+        aligned = (nt % np.uint64(default_unit.nanos())) == 0
+        has_first = self.num_bits >= 64
+        return np.where(aligned & has_first, np.int32(default_unit), np.int32(0))
+
+    def stream(self, i: int) -> bytes:
+        """Recover series i's stream bytes (for tests / host round trips)."""
+        nbytes = int(self.num_bits[i]) // 8
+        raw = self.words[i].astype(">u4").tobytes()
+        return raw[:nbytes]

@@ -1,0 +1,150 @@
+"""Parity tests: batched JAX decoder vs the CPU reference codec.
+
+The device-decode contract (ops/decode.py) is bit-exact timestamps and values
+vs the CPU ReaderIterator, the TPU-side equivalent of the reference's
+"bit-exact parity to the CPU iterator" requirement (BASELINE.md).
+"""
+
+import math
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from m3_tpu.codec.m3tsz import Encoder, decode, encode_series
+from m3_tpu.ops.decode import decode_batched, finalize_decode
+from m3_tpu.segment.batched import BatchedSegments
+from m3_tpu.utils.xtime import Unit
+
+START = 1_600_000_000 * 10**9
+
+
+def assert_parity(streams, expected, int_optimized=True, default_unit=Unit.SECOND, maxp=None):
+    seg = BatchedSegments.from_streams(streams)
+    maxp = maxp or max((len(e) for e in expected), default=1) or 1
+    res = decode_batched(
+        seg.words,
+        seg.num_bits,
+        seg.initial_units(default_unit),
+        max_points=maxp,
+        int_optimized=int_optimized,
+    )
+    ts_out, vals_out, valid = finalize_decode(res)
+    assert not np.asarray(res.err).any()
+    for i, exp in enumerate(expected):
+        assert valid[i].sum() == len(exp)
+        for j, dp in enumerate(exp):
+            assert ts_out[i, j] == dp.timestamp
+            # bit-exact float64 comparison (NaN-safe)
+            assert struct.pack("<d", dp.value) == struct.pack("<d", float(vals_out[i, j]))
+
+
+def test_mixed_random_batch():
+    random.seed(1)
+    streams, expected = [], []
+    for _ in range(40):
+        n = random.randrange(1, 50)
+        t = START + random.randrange(0, 100) * 10**9
+        ts, vals = [], []
+        for _ in range(n):
+            t += random.choice([9, 10, 10, 10, 11, 30]) * 10**9
+            ts.append(t)
+            kind = random.random()
+            if kind < 0.5:
+                vals.append(float(random.randrange(-(10**6), 10**6)))
+            elif kind < 0.8:
+                vals.append(round(random.uniform(-1000, 1000), random.randrange(0, 5)))
+            else:
+                vals.append(random.uniform(-1e9, 1e9))
+        data = encode_series(ts, vals, start_nanos=START)
+        streams.append(data)
+        expected.append(decode(data))
+    assert_parity(streams, expected)
+
+
+def test_time_unit_change():
+    enc = Encoder(START)
+    enc.encode(START + 10**9, 1.0, unit=Unit.SECOND)
+    enc.encode(START + 10**9 + 250_000_000, 2.5, unit=Unit.MILLISECOND)
+    enc.encode(START + 10**9 + 500_000_000, 3.0, unit=Unit.MILLISECOND)
+    enc.encode(START + 3 * 10**9, 4.0, unit=Unit.SECOND)
+    d = enc.stream()
+    assert_parity([d], [decode(d)])
+
+
+def test_unaligned_start_marker():
+    start = START + 123
+    enc = Encoder(start)
+    enc.encode(start + 10**9, 7.0)
+    enc.encode(start + 2 * 10**9, 8.0)
+    d = enc.stream()
+    assert_parity([d], [decode(d)])
+
+
+def test_nanosecond_64bit_bucket():
+    enc = Encoder(START, default_unit=Unit.NANOSECOND)
+    ts = [START + 1, START + 2, START + 3 + 10**15, START + 4 + 10**15]
+    for t, v in zip(ts, [1.0, 2.0, 3.0, 4.5]):
+        enc.encode(t, v, unit=Unit.NANOSECOND)
+    d = enc.stream()
+    assert_parity([d], [decode(d, default_unit=Unit.NANOSECOND)], default_unit=Unit.NANOSECOND)
+
+
+@pytest.mark.parametrize("int_optimized", [True, False])
+def test_special_floats(int_optimized):
+    vals = [0.0, -0.0, float("nan"), float("inf"), float("-inf"), 1e-300, 1e300, math.pi]
+    ts = [START + (i + 1) * 10**9 for i in range(len(vals))]
+    d = encode_series(ts, vals, start_nanos=START, int_optimized=int_optimized)
+    assert_parity(
+        [d], [decode(d, int_optimized=int_optimized)], int_optimized=int_optimized
+    )
+
+
+def test_repeats_and_mode_flips():
+    random.seed(9)
+    vals = (
+        [5.0] * 10
+        + [5.5, 6.5, math.e, 7.0]
+        + [1000000.0 + random.choice([1, -1]) for _ in range(20)]
+        + [42.0] * 5
+    )
+    ts = [START + (i + 1) * 10 * 10**9 for i in range(len(vals))]
+    d = encode_series(ts, vals, start_nanos=START)
+    assert_parity([d], [decode(d)])
+
+
+def test_ragged_batch_with_empty_stream():
+    s0 = encode_series([START + 10**9], [1.5], start_nanos=START)
+    s2 = encode_series(
+        [START + i * 10**9 for i in range(1, 100)],
+        [float(i) for i in range(99)],
+        start_nanos=START,
+    )
+    assert_parity([s0, b"", s2], [decode(s0), [], decode(s2)], maxp=100)
+
+
+def test_annotation_stream_flags_err():
+    enc = Encoder(START)
+    enc.encode(START + 10**9, 1.0, annotation=b"x")
+    seg = BatchedSegments.from_streams([enc.stream()])
+    res = decode_batched(seg.words, seg.num_bits, seg.initial_units(), max_points=4)
+    assert np.asarray(res.err)[0]
+    assert not np.asarray(res.valid)[0].any()
+
+
+def test_values_f32_close():
+    ts = [START + (i + 1) * 10**9 for i in range(20)]
+    vals = [math.sin(i / 3.0) * 100 for i in range(20)]
+    d = encode_series(ts, vals, start_nanos=START)
+    seg = BatchedSegments.from_streams([d])
+    res = decode_batched(seg.words, seg.num_bits, seg.initial_units(), max_points=20)
+    got = np.asarray(res.values_f32)[0]
+    np.testing.assert_allclose(got, np.array(vals, np.float32), rtol=1e-5)
+
+
+def test_segment_roundtrip_container():
+    s = encode_series([START + 10**9, START + 2 * 10**9], [1.0, 2.0], start_nanos=START)
+    seg = BatchedSegments.from_streams([s, b"ab"])
+    assert seg.stream(0) == s
+    assert seg.stream(1) == b"ab"

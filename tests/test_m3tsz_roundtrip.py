@@ -292,7 +292,9 @@ def test_property_random_series(seed):
     enc = Encoder(START, default_unit=Unit.NANOSECOND)
     for tt, vv in zip(ts, vals):
         enc.encode(tt, vv, unit=Unit.NANOSECOND)
-    dps = decode(enc.stream())
+    # Decoder must share the encoder's options default unit (namespace-level
+    # encoding options in the reference).
+    dps = decode(enc.stream(), default_unit=Unit.NANOSECOND)
     assert len(dps) == len(ts)
     for et, ev, dp in zip(ts, vals, dps):
         assert dp.timestamp == et
