@@ -1,0 +1,66 @@
+"""Driver benchmark: batched M3TSZ decode + aggregate throughput on one chip.
+
+Measures datapoints decoded+aggregated per second (BASELINE.md config 2/3
+shape: S series x 720 points, gauge workload, scan decode + sum/count/min/max
+reductions). Baseline for vs_baseline is the north-star target of 10B
+datapoints/sec/chip (BASELINE.json); the reference itself publishes no
+comparable hard number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+NORTH_STAR = 10e9  # datapoints/sec/chip
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.parallel.scan import scan_aggregate
+    from m3_tpu.utils.synthetic import tiled_batch
+
+    n_points = 720
+    n_series = int(os.environ.get("BENCH_SERIES", 65536))
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        n_series = min(n_series, 2048)
+
+    batch = tiled_batch(n_series, n_points, n_unique=64, seed=3)
+    words = jnp.asarray(batch.words)
+    num_bits = jnp.asarray(batch.num_bits)
+    units = jnp.asarray(batch.initial_units(), jnp.int32)
+
+    fn = jax.jit(lambda w, b, u: scan_aggregate(w, b, u, max_points=n_points + 2))
+    out = fn(words, num_bits, units)  # compile + warm
+    jax.block_until_ready(out)
+    total_points = int(out.total_count)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(words, num_bits, units)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    dps = total_points / dt
+    print(
+        json.dumps(
+            {
+                "metric": "m3tsz_decode_aggregate_datapoints_per_sec_per_chip",
+                "value": round(dps, 1),
+                "unit": "datapoints/s",
+                "vs_baseline": round(dps / NORTH_STAR, 6),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Device-mesh helpers for the sharded scan runtime.
+
+The reference distributes series by murmur3(seriesID) mod N virtual shards and
+assigns shards to nodes via placements (/root/reference/src/dbnode/sharding/
+shardset.go:149, src/cluster/placement/). The TPU-native equivalent maps the
+shard axis onto a 1-D `jax.sharding.Mesh` axis named "shard": series batches
+are laid out [series, time] and sharded along axis 0; cross-series aggregation
+rides ICI via psum over the "shard" axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def series_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the series/shard axis.
+
+    Args:
+      n_devices: take the first N available devices (default: all).
+      devices: explicit device list (overrides n_devices).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def series_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [series, ...] arrays: split axis 0 across the mesh."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,120 @@
+"""Sharded scan-and-aggregate: the framework's flagship execution path.
+
+Reference counterpart: the coordinator fan-out query path — index query →
+per-shard ReadEncoded → client-side decode → temporal functions → cross-series
+aggregation (/root/reference/src/query/storage/fanout/storage.go:76,156 and
+src/query/functions/). Here the whole post-index pipeline is one SPMD program:
+each device decodes its slice of the series axis (BatchedSegments sharded on
+axis 0), reduces within series (time axis), and cross-series aggregates ride
+ICI via `jax.lax.psum` over the "shard" mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops.decode import decode_batched
+from .mesh import SHARD_AXIS, series_mesh
+
+
+class ScanAggregates(NamedTuple):
+    """Per-series reductions plus replicated cross-series totals."""
+
+    series_sum: jnp.ndarray  # f32[S] sum_over_time per series
+    series_count: jnp.ndarray  # i32[S] valid datapoints per series
+    series_min: jnp.ndarray  # f32[S]
+    series_max: jnp.ndarray  # f32[S]
+    series_last: jnp.ndarray  # f32[S]
+    total_sum: jnp.ndarray  # f32[] cross-series (psum over shard axis)
+    total_count: jnp.ndarray  # i32[]
+    total_min: jnp.ndarray  # f32[]
+    total_max: jnp.ndarray  # f32[]
+
+
+def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psum):
+    res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
+    vals = res.values_f32  # [s_local, T], NaN where invalid
+    valid = res.valid
+    zero = jnp.where(valid, vals, 0.0)
+    s_sum = jnp.sum(zero, axis=1)
+    s_count = jnp.sum(valid.astype(jnp.int32), axis=1)
+    s_min = jnp.min(jnp.where(valid, vals, jnp.inf), axis=1)
+    s_max = jnp.max(jnp.where(valid, vals, -jnp.inf), axis=1)
+    # last valid value per series
+    t = vals.shape[1]
+    last_idx = jnp.max(jnp.where(valid, jnp.arange(t)[None, :], -1), axis=1)
+    s_last = jnp.take_along_axis(zero, jnp.maximum(last_idx, 0)[:, None], axis=1)[:, 0]
+    s_last = jnp.where(last_idx >= 0, s_last, jnp.nan)
+
+    has = s_count > 0
+    t_sum = jnp.sum(jnp.where(has, s_sum, 0.0))
+    t_count = jnp.sum(s_count)
+    t_min = jnp.min(jnp.where(has, s_min, jnp.inf))
+    t_max = jnp.max(jnp.where(has, s_max, -jnp.inf))
+    if with_psum:
+        t_sum = jax.lax.psum(t_sum, SHARD_AXIS)
+        t_count = jax.lax.psum(t_count, SHARD_AXIS)
+        t_min = jax.lax.pmin(t_min, SHARD_AXIS)
+        t_max = jax.lax.pmax(t_max, SHARD_AXIS)
+    t_min = jnp.where(t_count > 0, t_min, jnp.nan)
+    t_max = jnp.where(t_count > 0, t_max, jnp.nan)
+    return ScanAggregates(
+        series_sum=s_sum,
+        series_count=s_count,
+        series_min=jnp.where(has, s_min, jnp.nan),
+        series_max=jnp.where(has, s_max, jnp.nan),
+        series_last=s_last,
+        total_sum=t_sum,
+        total_count=t_count,
+        total_min=t_min,
+        total_max=t_max,
+    )
+
+
+def scan_aggregate(words, num_bits, initial_unit, max_points: int) -> ScanAggregates:
+    """Single-device decode + aggregate (no collectives)."""
+    return _local_scan_aggregate(
+        words, num_bits, initial_unit, max_points=max_points, with_psum=False
+    )
+
+
+def make_sharded_scan(mesh, max_points: int):
+    """Build a pjit'd scan-and-aggregate over ``mesh``'s shard axis.
+
+    Inputs must have a series count divisible by the mesh size (pad with
+    num_bits==0 series — zero-length streams decode to no valid points and
+    drop out of every reduction).
+    """
+    fn = shard_map(
+        functools.partial(
+            _local_scan_aggregate, max_points=max_points, with_psum=True
+        ),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=ScanAggregates(
+            series_sum=P(SHARD_AXIS),
+            series_count=P(SHARD_AXIS),
+            series_min=P(SHARD_AXIS),
+            series_max=P(SHARD_AXIS),
+            series_last=P(SHARD_AXIS),
+            total_sum=P(),
+            total_count=P(),
+            total_min=P(),
+            total_max=P(),
+        ),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_scan_aggregate(
+    words, num_bits, initial_unit, max_points: int, mesh=None
+) -> ScanAggregates:
+    mesh = mesh if mesh is not None else series_mesh()
+    return make_sharded_scan(mesh, max_points)(words, num_bits, initial_unit)

@@ -1,0 +1,71 @@
+"""Synthetic series generation for benches/tests.
+
+Reference counterpart: the integration data generators and m3nsch load-gen
+datums (/root/reference/src/dbnode/integration/generate/,
+src/m3nsch/datums/). Generates gauge-like series, encodes them with the CPU
+M3TSZ encoder, and tiles them into a BatchedSegments matrix so large series
+counts don't pay per-series Python encode cost.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..codec.m3tsz import encode_series
+from ..segment.batched import BatchedSegments
+from ..utils.xtime import Unit
+
+NANOS = 1_000_000_000
+
+
+def synthetic_streams(
+    n_unique: int,
+    n_points: int,
+    start_nanos: int = 1_600_000_000 * NANOS,
+    step_nanos: int = 10 * NANOS,
+    seed: int = 0,
+    kind: str = "gauge",
+) -> list[bytes]:
+    """Encode ``n_unique`` synthetic series of ``n_points`` datapoints each.
+
+    kind:
+      gauge  — random-walk floats with ~2 decimal places (int-optimizable)
+      counter— monotonically increasing integer-ish values
+      float  — full-precision floats (exercise the XOR path)
+    """
+    rng = np.random.default_rng(seed)
+    ts = start_nanos + step_nanos * np.arange(n_points, dtype=np.int64)
+    unit = Unit.SECOND if step_nanos % NANOS == 0 else Unit.MILLISECOND
+    # Jitter in whole units of the encode unit (sub-unit deltas would be
+    # truncated by timestamp normalization) so non-zero dod buckets are
+    # actually exercised.
+    jitter = rng.integers(-2, 3, size=(n_unique, n_points)) * unit.nanos()
+    jitter[:, 0] = 0
+    streams = []
+    for i in range(n_unique):
+        if kind == "gauge":
+            vals = np.round(50 + np.cumsum(rng.normal(0, 1, n_points)), 2)
+        elif kind == "counter":
+            vals = np.cumsum(rng.integers(0, 100, n_points)).astype(np.float64)
+        else:
+            vals = rng.normal(0, 1, n_points)
+        t = (ts + jitter[i]).tolist()
+        streams.append(encode_series(t, vals.tolist(), unit=unit))
+    return streams
+
+
+def tiled_batch(
+    n_series: int,
+    n_points: int,
+    n_unique: int = 64,
+    seed: int = 0,
+    kind: str = "gauge",
+) -> BatchedSegments:
+    """A BatchedSegments of ``n_series`` rows built by tiling n_unique encoded
+    streams — cheap way to build million-series batches for device benches."""
+    streams = synthetic_streams(n_unique, n_points, seed=seed, kind=kind)
+    base = BatchedSegments.from_streams(streams)
+    reps = (n_series + n_unique - 1) // n_unique
+    words = np.tile(base.words, (reps, 1))[:n_series]
+    num_bits = np.tile(base.num_bits, reps)[:n_series]
+    return BatchedSegments(words=words, num_bits=num_bits)
