@@ -1,0 +1,103 @@
+"""Block model — the framework's north-star query interface.
+
+Reference: `block.Block` (/root/reference/src/query/block/types.go:55-137)
+exposes StepIter/SeriesIter views over a [series, time] result. The TPU-native
+block IS the dense array: ``values`` f32[S, T] on a regular step grid with NaN
+marking missing samples (the reference uses NaN sentinels the same way), plus
+host-side per-series metadata (tags). Step/series views are cheap array
+slices instead of iterators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+import numpy as np
+
+NANOS = 1_000_000_000
+
+# Tags are tuples of (name, value) bytes pairs, sorted by name — the
+# hashable, order-canonical equivalent of models.Tags
+# (/root/reference/src/query/models/tags.go).
+Tags = tuple[tuple[bytes, bytes], ...]
+
+
+def make_tags(d: dict[bytes | str, bytes | str] | Sequence[tuple]) -> Tags:
+    items = d.items() if isinstance(d, dict) else d
+    out = []
+    for k, v in items:
+        k = k.encode() if isinstance(k, str) else bytes(k)
+        v = v.encode() if isinstance(v, str) else bytes(v)
+        out.append((k, v))
+    return tuple(sorted(out))
+
+
+@dataclass(frozen=True)
+class Bounds:
+    """Regular step grid: [start, start + step*steps) — query/block/types.go
+    Bounds{Start, Duration, StepSize}."""
+
+    start_nanos: int
+    step_nanos: int
+    steps: int
+
+    @property
+    def step_seconds(self) -> float:
+        return self.step_nanos / NANOS
+
+    def timestamps(self) -> np.ndarray:
+        return self.start_nanos + self.step_nanos * np.arange(self.steps, dtype=np.int64)
+
+    @property
+    def end_nanos(self) -> int:
+        return self.start_nanos + self.step_nanos * self.steps
+
+
+@dataclass(frozen=True)
+class SeriesMeta:
+    """Per-series metadata (block.SeriesMeta: name + tags)."""
+
+    tags: Tags
+    name: bytes = b""
+
+
+@dataclass
+class BlockMeta:
+    bounds: Bounds
+    series: list[SeriesMeta] = field(default_factory=list)
+
+
+@dataclass
+class ColumnBlock:
+    """values[S, T] on meta.bounds' grid; NaN = missing sample."""
+
+    meta: BlockMeta
+    values: np.ndarray  # or jnp array — functions are backend-agnostic
+
+    @property
+    def num_series(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def num_steps(self) -> int:
+        return self.values.shape[1]
+
+    # --- view parity with block.Block (types.go:55) ---
+    def step_iter(self) -> Iterator[tuple[int, np.ndarray]]:
+        """Yields (unix_nanos, values[S]) per step — StepIter equivalent."""
+        ts = self.meta.bounds.timestamps()
+        vals = np.asarray(self.values)
+        for i in range(self.num_steps):
+            yield int(ts[i]), vals[:, i]
+
+    def series_iter(self) -> Iterator[tuple[SeriesMeta, np.ndarray]]:
+        """Yields (meta, values[T]) per series — SeriesIter equivalent."""
+        vals = np.asarray(self.values)
+        for i in range(self.num_series):
+            meta = self.meta.series[i] if i < len(self.meta.series) else SeriesMeta(())
+            yield meta, vals[i]
+
+    def with_values(self, values, series: list[SeriesMeta] | None = None) -> "ColumnBlock":
+        meta = BlockMeta(bounds=self.meta.bounds, series=self.meta.series if series is None else series)
+        return ColumnBlock(meta=meta, values=values)

@@ -1,0 +1,227 @@
+"""Cross-series (tag-grouped) aggregations as segment reductions.
+
+Reference: /root/reference/src/query/functions/aggregation/function.go
+(sum/min/max/avg/count/stddev/var/quantile/absent over tag buckets),
+take.go (topk/bottomk). Grouping by tags happens host-side once per query
+(group ids are data-independent); the per-step math is `jax.ops.segment_*`
+over the series axis — the TPU-native form of the reference's bucket loops.
+
+NaN semantics (function.go):
+  sum/min/max: NaN iff every value in the bucket is NaN
+  count: number of non-NaN values (0, not NaN, for empty buckets)
+  avg/stddev/var: NaN iff count == 0 (population variance)
+  absent: 1 where the bucket has no non-NaN value, else NaN
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...block.core import SeriesMeta, Tags
+
+__all__ = [
+    "group_by_tags",
+    "GroupLayout",
+    "grouped_sum",
+    "grouped_count",
+    "grouped_avg",
+    "grouped_min",
+    "grouped_max",
+    "grouped_stddev",
+    "grouped_stdvar",
+    "grouped_quantile",
+    "absent",
+    "topk",
+    "bottomk",
+]
+
+
+@dataclass
+class GroupLayout:
+    """Host-computed series→group assignment.
+
+    group_ids: int32[S] group index per series
+    metas: per-group SeriesMeta (the retained tags)
+    pad_index: int32[G, M] series indices per group, -1 padded (for sort-based
+      ops: quantile/topk), M = max group size
+    """
+
+    group_ids: np.ndarray
+    metas: list[SeriesMeta]
+    pad_index: np.ndarray
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.metas)
+
+
+def group_by_tags(
+    series: list[SeriesMeta],
+    matching: list[bytes] | None = None,
+    without: bool = False,
+) -> GroupLayout:
+    """PromQL by/without grouping (aggregation/function.go:180-210 via
+    utils.GroupSeries). matching=None, without=False → one global group."""
+    matching = [m if isinstance(m, bytes) else m.encode() for m in (matching or [])]
+    groups: dict[Tags, int] = {}
+    members: list[list[int]] = []
+    metas: list[SeriesMeta] = []
+    gids = np.zeros(len(series), np.int32)
+    for i, sm in enumerate(series):
+        if without:
+            key = tuple((k, v) for k, v in sm.tags if k not in matching)
+        else:
+            key = tuple((k, v) for k, v in sm.tags if k in matching)
+        gid = groups.get(key)
+        if gid is None:
+            gid = len(metas)
+            groups[key] = gid
+            metas.append(SeriesMeta(tags=key))
+            members.append([])
+        gids[i] = gid
+        members[gid].append(i)
+    m = max((len(x) for x in members), default=1)
+    pad = np.full((len(metas), m), -1, np.int32)
+    for g, idxs in enumerate(members):
+        pad[g, : len(idxs)] = idxs
+    return GroupLayout(group_ids=gids, metas=metas, pad_index=pad)
+
+
+def _seg(values, layout: GroupLayout):
+    gids = jnp.asarray(layout.group_ids)
+    g = layout.num_groups
+    valid = ~jnp.isnan(values)
+    x = jnp.where(valid, values, 0)
+    s = jax.ops.segment_sum(x, gids, num_segments=g)
+    c = jax.ops.segment_sum(valid.astype(values.dtype), gids, num_segments=g)
+    return s, c, gids, g, valid, x
+
+
+def grouped_sum(values, layout: GroupLayout):
+    s, c, *_ = _seg(values, layout)
+    return jnp.where(c > 0, s, jnp.nan)
+
+
+def grouped_count(values, layout: GroupLayout):
+    _, c, *_ = _seg(values, layout)
+    return c
+
+
+def grouped_avg(values, layout: GroupLayout):
+    s, c, *_ = _seg(values, layout)
+    return jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan)
+
+
+def grouped_min(values, layout: GroupLayout):
+    gids = jnp.asarray(layout.group_ids)
+    g = layout.num_groups
+    x = jnp.where(jnp.isnan(values), jnp.inf, values)
+    m = jax.ops.segment_min(x, gids, num_segments=g)
+    c = jax.ops.segment_sum((~jnp.isnan(values)).astype(jnp.float32), gids, num_segments=g)
+    return jnp.where(c > 0, m, jnp.nan)
+
+
+def grouped_max(values, layout: GroupLayout):
+    gids = jnp.asarray(layout.group_ids)
+    g = layout.num_groups
+    x = jnp.where(jnp.isnan(values), -jnp.inf, values)
+    m = jax.ops.segment_max(x, gids, num_segments=g)
+    c = jax.ops.segment_sum((~jnp.isnan(values)).astype(jnp.float32), gids, num_segments=g)
+    return jnp.where(c > 0, m, jnp.nan)
+
+
+def grouped_stdvar(values, layout: GroupLayout):
+    # two-pass population variance exactly as varianceFn (function.go:124-143)
+    s, c, gids, g, valid, x = _seg(values, layout)
+    mean = jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan)
+    diff = values - jnp.take(mean, gids, axis=0)
+    sq = jnp.where(valid, diff * diff, 0)
+    ss = jax.ops.segment_sum(sq, gids, num_segments=g)
+    return jnp.where(c > 0, ss / jnp.maximum(c, 1), jnp.nan)
+
+
+def grouped_stddev(values, layout: GroupLayout):
+    return jnp.sqrt(grouped_stdvar(values, layout))
+
+
+def absent(values, layout: GroupLayout | None = None):
+    """absentFn (function.go:46-55): per step, 1 if no series has a value."""
+    any_present = jnp.any(~jnp.isnan(values), axis=0)
+    return jnp.where(any_present, jnp.nan, 1.0)[None, :]
+
+
+def _padded(values, layout: GroupLayout):
+    """[G, M, T] group-major view, NaN at padding."""
+    idx = jnp.asarray(layout.pad_index)
+    g = jnp.take(values, jnp.clip(idx, 0, values.shape[0] - 1), axis=0)
+    return jnp.where((idx < 0)[:, :, None], jnp.nan, g)
+
+
+def grouped_quantile(values, layout: GroupLayout, q: float):
+    """Same interpolation as quantile_over_time (aggregation.go:265-297)."""
+    p = _padded(values, layout)  # [G, M, T]
+    m = p.shape[1]
+    sw = jnp.sort(p, axis=1)  # NaN to the end of axis 1
+    n = jnp.sum(~jnp.isnan(p), axis=1)  # [G, T]
+    if q < 0:
+        return jnp.where(n > 0, -jnp.inf, jnp.nan)
+    if q > 1:
+        return jnp.where(n > 0, jnp.inf, jnp.nan)
+    dt = values.dtype
+    rank = q * (n - 1).astype(dt)
+    lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, m - 1)
+    hi = jnp.minimum(jnp.clip(lo + 1, 0, m - 1), jnp.maximum(n - 1, 0))
+    frac = rank - lo.astype(dt)
+    vlo = jnp.take_along_axis(sw, lo[:, None, :], axis=1)[:, 0, :]
+    vhi = jnp.take_along_axis(sw, hi[:, None, :], axis=1)[:, 0, :]
+    out = vlo + (vhi - vlo) * frac
+    return jnp.where(n > 0, out, jnp.nan)
+
+
+def _take(values, layout: GroupLayout, k: int, largest: bool):
+    """topk/bottomk (take.go): keep k best per group per step, NaN the rest.
+    Stable rank (ties broken by series order) like the reference heap."""
+    p = _padded(values, layout)  # [G, M, T]
+    key = jnp.where(jnp.isnan(p), -jnp.inf if largest else jnp.inf, p)
+    if largest:
+        key = -key  # argsort ascending == descending on value
+    order = jnp.argsort(key, axis=1, stable=True)  # [G, M, T]
+    ranks = jnp.argsort(order, axis=1, stable=True)  # rank of each slot
+    keep_padded = (ranks < k) & ~jnp.isnan(p)
+    # scatter back to [S, T]
+    s = values.shape[0]
+    idx = jnp.asarray(layout.pad_index)  # [G, M]
+    flat_idx = jnp.clip(idx.reshape(-1), 0, s - 1)
+    keep = jnp.zeros(values.shape, bool)
+    src = keep_padded.reshape(-1, values.shape[1]) & (idx.reshape(-1) >= 0)[:, None]
+    keep = keep.at[flat_idx].max(src)
+    return jnp.where(keep, values, jnp.nan)
+
+
+def topk(values, layout: GroupLayout, k: int):
+    return _take(values, layout, k, largest=True)
+
+
+def bottomk(values, layout: GroupLayout, k: int):
+    return _take(values, layout, k, largest=False)
+
+
+def count_values(values, series: list[SeriesMeta], label: bytes):
+    """count_values (count_values.go): per step, count series sharing each
+    distinct value. Host-side — output cardinality is data-dependent, which is
+    inherently dynamic-shape; this runs on the result block, not the hot path.
+    Returns (values[G, T], metas)."""
+    vals = np.asarray(values)
+    uniq = np.unique(vals[~np.isnan(vals)])
+    out = np.full((len(uniq), vals.shape[1]), np.nan)
+    metas = []
+    for i, u in enumerate(uniq):
+        cnt = np.sum(vals == u, axis=0).astype(np.float64)
+        out[i] = np.where(cnt > 0, cnt, np.nan)
+        metas.append(SeriesMeta(tags=((label, repr(float(u)).encode()),)))
+    return out, metas

@@ -1,0 +1,180 @@
+"""Binary operations with vector matching.
+
+Reference: /root/reference/src/query/functions/binary/ — arithmetic
+(arithmetic.go), comparison with optional BOOL modifier (comparison.go),
+set logic and/or/unless (and.go, or.go, unless.go), all driven by the
+intersect() series matcher (binary.go:233+). Matching is host-side (tag
+hashing, data-independent); the per-step math is elementwise on gathered
+series rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...block.core import SeriesMeta, Tags
+
+__all__ = [
+    "VectorMatching",
+    "intersect",
+    "arithmetic",
+    "comparison",
+    "logical_and",
+    "logical_or",
+    "logical_unless",
+    "ARITH_FNS",
+    "COMP_FNS",
+]
+
+NAME_TAG = b"__name__"
+
+
+@dataclass
+class VectorMatching:
+    """on/ignoring matching (binary/types.go VectorMatching)."""
+
+    on: bool = False  # True: match only on `matching_labels`
+    matching_labels: tuple[bytes, ...] = ()
+    # group_left/group_right many-to-one is intentionally deferred until the
+    # PromQL front end lands; intersect() is one-to-one like processBothSeries.
+
+
+def _match_key(tags: Tags, matching: VectorMatching) -> Tags:
+    labels = matching.matching_labels
+    if matching.on:
+        return tuple((k, v) for k, v in tags if k in labels)
+    return tuple((k, v) for k, v in tags if k not in labels and k != NAME_TAG)
+
+
+def intersect(
+    matching: VectorMatching,
+    l_metas: list[SeriesMeta],
+    r_metas: list[SeriesMeta],
+) -> tuple[np.ndarray, np.ndarray, list[SeriesMeta]]:
+    """(take_left, corresponding_right, out_metas) — binary.go intersect()."""
+    r_index: dict[Tags, int] = {}
+    for i, rm in enumerate(r_metas):
+        r_index.setdefault(_match_key(rm.tags, matching), i)
+    take_left, take_right, metas = [], [], []
+    for i, lm in enumerate(l_metas):
+        key = _match_key(lm.tags, matching)
+        j = r_index.get(key)
+        if j is not None:
+            take_left.append(i)
+            take_right.append(j)
+            metas.append(SeriesMeta(tags=key, name=lm.name))
+    return (
+        np.asarray(take_left, np.int32),
+        np.asarray(take_right, np.int32),
+        metas,
+    )
+
+
+def _go_mod(x, y):
+    # Go math.Mod semantics: result sign follows x (arithmetic.go uses math.Mod)
+    return x - jnp.trunc(x / y) * y
+
+
+ARITH_FNS = {
+    "+": lambda x, y: x + y,
+    "-": lambda x, y: x - y,
+    "*": lambda x, y: x * y,
+    "/": lambda x, y: x / y,
+    "^": lambda x, y: jnp.power(x, y),
+    "%": _go_mod,
+}
+
+
+COMP_FNS = {
+    "==": lambda x, y: x == y,
+    "!=": lambda x, y: x != y,
+    ">": lambda x, y: x > y,
+    "<": lambda x, y: x < y,
+    ">=": lambda x, y: x >= y,
+    "<=": lambda x, y: x <= y,
+}
+
+
+def _gather(values, idx):
+    return jnp.take(jnp.asarray(values), jnp.asarray(idx), axis=0)
+
+
+def arithmetic(op: str, l_values, r_values, take_left, take_right):
+    lv = _gather(l_values, take_left)
+    rv = _gather(r_values, take_right)
+    return ARITH_FNS[op](lv, rv)
+
+
+def comparison(op: str, l_values, r_values, take_left, take_right, return_bool: bool):
+    """comparison.go: filter mode keeps lhs value where true else NaN; BOOL
+    mode returns 1/0 (NaN propagates as NaN-compare = false → 0...  Go
+    toFloat(x==y) with NaN operands is 0, but NaN input rows keep NaN via the
+    arithmetic NaN rule only in filter mode)."""
+    lv = _gather(l_values, take_left)
+    rv = _gather(r_values, take_right)
+    cond = COMP_FNS[op](lv, rv)
+    if return_bool:
+        return jnp.where(jnp.isnan(lv) | jnp.isnan(rv), jnp.nan, cond.astype(lv.dtype))
+    return jnp.where(cond, lv, jnp.nan)
+
+
+def _key_set(metas: list[SeriesMeta], matching: VectorMatching):
+    return {_match_key(m.tags, matching) for m in metas}
+
+
+def logical_and(l_values, r_values, l_metas, r_metas, matching: VectorMatching):
+    """and.go: keep lhs series whose match key exists in rhs AND rhs has a
+    value at that step."""
+    r_keys = {}
+    for j, rm in enumerate(r_metas):
+        r_keys.setdefault(_match_key(rm.tags, matching), j)
+    take_l, take_r = [], []
+    metas = []
+    for i, lm in enumerate(l_metas):
+        j = r_keys.get(_match_key(lm.tags, matching))
+        if j is not None:
+            take_l.append(i)
+            take_r.append(j)
+            metas.append(lm)
+    if not take_l:
+        return jnp.zeros((0, np.asarray(l_values).shape[1]), jnp.asarray(l_values).dtype), []
+    lv = _gather(l_values, np.asarray(take_l, np.int32))
+    rv = _gather(r_values, np.asarray(take_r, np.int32))
+    return jnp.where(jnp.isnan(rv), jnp.nan, lv), metas
+
+
+def logical_or(l_values, r_values, l_metas, r_metas, matching: VectorMatching):
+    """or.go: all lhs series, plus rhs series whose key is absent from lhs."""
+    l_keys = _key_set(l_metas, matching)
+    keep_r = [j for j, rm in enumerate(r_metas) if _match_key(rm.tags, matching) not in l_keys]
+    lv = jnp.asarray(l_values)
+    if keep_r:
+        rv = _gather(r_values, np.asarray(keep_r, np.int32))
+        out = jnp.concatenate([lv, rv], axis=0)
+    else:
+        out = lv
+    metas = list(l_metas) + [r_metas[j] for j in keep_r]
+    return out, metas
+
+
+def logical_unless(l_values, r_values, l_metas, r_metas, matching: VectorMatching):
+    """unless.go: lhs series whose key is NOT in rhs; where key IS in rhs,
+    keep lhs values only at steps where rhs is NaN."""
+    r_keys = {}
+    for j, rm in enumerate(r_metas):
+        r_keys.setdefault(_match_key(rm.tags, matching), j)
+    lv = jnp.asarray(l_values)
+    # rhs row index per lhs series, -1 when unmatched
+    r_idx = np.asarray(
+        [r_keys.get(_match_key(lm.tags, matching), -1) for lm in l_metas], np.int32
+    )
+    if len(r_metas):
+        rvv = _gather(r_values, np.maximum(r_idx, 0))
+        masked = jnp.where(jnp.isnan(rvv), lv, jnp.nan)
+    else:
+        masked = lv
+    unmatched = jnp.asarray(r_idx < 0)[:, None]
+    return jnp.where(unmatched, lv, masked), list(l_metas)

@@ -1,0 +1,217 @@
+"""Linear (per-sample) functions + histogram_quantile + sort.
+
+Reference: /root/reference/src/query/functions/linear/ — clamp.go, math.go,
+round.go, sort.go, datetime.go, histogram_quantile.go. All elementwise ops
+vectorize trivially; histogram_quantile groups series by tags-minus-le on the
+host and interpolates buckets on device.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...block.core import SeriesMeta
+
+__all__ = [
+    "MATH_FNS",
+    "clamp_min",
+    "clamp_max",
+    "round_to",
+    "sort_series",
+    "datetime_fn",
+    "histogram_buckets",
+    "histogram_quantile",
+]
+
+MATH_FNS = {
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "exp": jnp.exp,
+    "sqrt": jnp.sqrt,
+    "ln": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+}
+
+
+def clamp_min(values, scalar: float):
+    return jnp.maximum(values, scalar)
+
+
+def clamp_max(values, scalar: float):
+    return jnp.minimum(values, scalar)
+
+
+def round_to(values, to_nearest: float = 1.0):
+    # round.go: floor(v/to + 0.5) * to
+    return jnp.floor(values / to_nearest + 0.5) * to_nearest
+
+
+def sort_series(values, descending: bool = False):
+    """sort.go: order series by their last-step value (instant queries)."""
+    vals = np.asarray(values)
+    key = vals[:, -1]
+    # NaN series sort last in either direction
+    key = np.where(np.isnan(key), np.inf if not descending else -np.inf, key)
+    order = np.argsort(-key if descending else key, kind="stable")
+    return order
+
+
+_DATETIME_FNS = {
+    "day_of_month": lambda tm: tm.tm_mday,
+    "day_of_week": lambda tm: tm.tm_wday == 6 and 0 or (tm.tm_wday + 1) % 7,
+    "days_in_month": None,  # special-cased below
+    "hour": lambda tm: tm.tm_hour,
+    "minute": lambda tm: tm.tm_min,
+    "month": lambda tm: tm.tm_mon,
+    "year": lambda tm: tm.tm_year,
+}
+
+
+def datetime_fn(name: str, values):
+    """datetime.go: interpret values as unix seconds (UTC)."""
+    import calendar
+    import time as _time
+
+    vals = np.asarray(values, np.float64)
+    out = np.full_like(vals, np.nan)
+    it = np.nditer(vals, flags=["multi_index"])
+    for v in it:
+        fv = float(v)
+        if math.isnan(fv):
+            continue
+        tm = _time.gmtime(fv)
+        if name == "days_in_month":
+            out[it.multi_index] = calendar.monthrange(tm.tm_year, tm.tm_mon)[1]
+        else:
+            out[it.multi_index] = _DATETIME_FNS[name](tm)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# histogram_quantile (histogram_quantile.go:153-384)
+# ---------------------------------------------------------------------------
+
+LE_TAG = b"le"
+
+
+def histogram_buckets(series: list[SeriesMeta]):
+    """Group series into histograms by tags-minus-le; sort buckets by le.
+
+    Returns (index[G, B] int32 with -1 pad, bounds[G, B] f32 (+inf pad),
+    metas[G]) — groups whose max bound isn't +Inf or with <2 buckets are
+    dropped (sanitizeBuckets, :196-214)."""
+    groups: dict = {}
+    for i, sm in enumerate(series):
+        le = None
+        rest = []
+        for k, v in sm.tags:
+            if k == LE_TAG:
+                le = v
+            else:
+                rest.append((k, v))
+        if le is None:
+            continue
+        try:
+            bound = float(le.decode())
+        except ValueError:
+            continue
+        groups.setdefault(tuple(rest), []).append((bound, i))
+    idxs, bounds, metas = [], [], []
+    for key, buckets in groups.items():
+        buckets.sort()
+        bs = [b for b, _ in buckets]
+        if len(buckets) < 2 or not math.isinf(bs[-1]) or bs[-1] < 0:
+            continue
+        idxs.append([i for _, i in buckets])
+        bounds.append(bs)
+        metas.append(SeriesMeta(tags=key))
+    if not idxs:
+        return np.zeros((0, 1), np.int32), np.zeros((0, 1), np.float32), []
+    b = max(len(x) for x in idxs)
+    index = np.full((len(idxs), b), -1, np.int32)
+    bnd = np.full((len(idxs), b), np.inf, np.float32)
+    for g, (ix, bo) in enumerate(zip(idxs, bounds)):
+        index[g, : len(ix)] = ix
+        bnd[g, : len(bo)] = bo
+    return index, bnd, metas
+
+
+def histogram_quantile(q: float, values, index, bounds):
+    """Vectorized bucketQuantile (:216-256) with ensureMonotonic (:321-331).
+
+    values: [S, T]; index: [G, B] series row per bucket (-1 pad);
+    bounds: [G, B] le upper bounds. Returns [G, T]."""
+    values = jnp.asarray(values)
+    s, t = values.shape
+    index = jnp.asarray(index)
+    bounds = jnp.asarray(bounds)
+    g, b = index.shape
+    if g == 0:
+        return jnp.zeros((0, t), values.dtype)
+
+    v = jnp.take(values, jnp.clip(index, 0, s - 1), axis=0)  # [G, B, T]
+    valid = (index >= 0)[:, :, None] & ~jnp.isnan(v)
+    if q < 0 or q > 1:
+        has = jnp.any(valid, axis=1)
+        return jnp.where(has, -jnp.inf if q < 0 else jnp.inf, jnp.nan)
+
+    # ensureMonotonic over valid buckets
+    vm = jnp.where(valid, v, -jnp.inf)
+    vm = jnp.maximum.accumulate(vm, axis=1)
+    v = jnp.where(valid, jnp.maximum(v, vm), v)
+
+    le = jnp.broadcast_to(bounds[:, :, None], (g, b, t))
+    # last valid bucket must be the +Inf one
+    bidx = jnp.broadcast_to(jnp.arange(b)[None, :, None], (g, b, t))
+    last_idx = jnp.max(jnp.where(valid, bidx, -1), axis=1)  # [G, T]
+    n_valid = jnp.sum(valid, axis=1)
+    top_le = jnp.take_along_axis(le, jnp.maximum(last_idx, 0)[:, None, :], axis=1)[:, 0]
+    top_val = jnp.take_along_axis(v, jnp.maximum(last_idx, 0)[:, None, :], axis=1)[:, 0]
+    ok = (n_valid >= 2) & jnp.isinf(top_le) & (last_idx >= 0)
+
+    rank = q * top_val  # [G, T]
+
+    # first valid bucket (other than the last) with value >= rank
+    cand = valid & (v >= rank[:, None, :]) & (bidx < last_idx[:, None, :])
+    any_cand = jnp.any(cand, axis=1)
+    first_cand = jnp.argmax(cand, axis=1)  # [G, T]
+
+    # previous valid bucket before each bucket (for start bound / count)
+    prev_idx = jnp.concatenate(
+        [jnp.full((g, 1, t), -1, jnp.int32), jnp.maximum.accumulate(jnp.where(valid, bidx, -1), axis=1)[:, :-1]],
+        axis=1,
+    )  # [G, B, T] index of last valid bucket strictly before b
+
+    sel = first_cand[:, None, :]
+    cur_le = jnp.take_along_axis(le, sel, axis=1)[:, 0]
+    cur_val = jnp.take_along_axis(v, sel, axis=1)[:, 0]
+    p_idx = jnp.take_along_axis(prev_idx, sel, axis=1)[:, 0]  # [G, T]
+    has_prev = p_idx >= 0
+    p_sel = jnp.maximum(p_idx, 0)[:, None, :]
+    prev_le = jnp.take_along_axis(le, p_sel, axis=1)[:, 0]
+    prev_val = jnp.take_along_axis(v, p_sel, axis=1)[:, 0]
+
+    bucket_start = jnp.where(has_prev, prev_le, 0.0)
+    count = cur_val - jnp.where(has_prev, prev_val, 0.0)
+    rank_adj = rank - jnp.where(has_prev, prev_val, 0.0)
+    interp = bucket_start + (cur_le - bucket_start) * rank_adj / jnp.where(
+        count == 0, 1, count
+    )
+
+    # edge cases
+    first_valid = jnp.argmax(valid, axis=1)  # [G, T]
+    fv_le = jnp.take_along_axis(le, first_valid[:, None, :], axis=1)[:, 0]
+    is_first = (first_cand == first_valid) & (fv_le <= 0)
+    result = jnp.where(is_first, fv_le, interp)
+
+    # no candidate below top: return second-last valid bucket's bound
+    second_last = jnp.take_along_axis(prev_idx, jnp.maximum(last_idx, 0)[:, None, :], axis=1)[:, 0]
+    sl_le = jnp.take_along_axis(le, jnp.maximum(second_last, 0)[:, None, :], axis=1)[:, 0]
+    result = jnp.where(any_cand, result, sl_le)
+
+    return jnp.where(ok, result, jnp.nan)

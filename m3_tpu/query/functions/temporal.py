@@ -1,0 +1,483 @@
+"""Temporal (windowed, per-series) query functions as vectorized array ops.
+
+Reference: /root/reference/src/query/functions/temporal/ — the sliding-window
+controller (base.go:278-404, getIndices window [end-duration, end] inclusive
+both ends) applying per-window scalar processors. Here every output step for
+every series is computed at once: windowed reductions via
+`jax.lax.reduce_window` over the time axis (maps directly onto TPU vector
+units; no per-window Python), NaN marks missing samples exactly like the
+reference's ts.Datapoints.
+
+Conventions shared by all functions:
+  - input `values`: [S, T] float array on a regular step grid; NaN = missing.
+  - `window`: number of grid steps per window, inclusive of both ends —
+    PromQL range `d` at step `s` is window = d/s + 1 steps, and the duration
+    used by rate-style normalization is (window-1)*step_seconds.
+  - output: [S, T], output[t] covers input steps [t-window+1, t] (windows
+    clipped at the left edge see fewer points, matching a reference query
+    with no earlier block available). Callers that carry context from the
+    previous block simply prepend its columns and slice the output.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "sum_over_time",
+    "count_over_time",
+    "avg_over_time",
+    "min_over_time",
+    "max_over_time",
+    "last_over_time",
+    "stddev_over_time",
+    "stdvar_over_time",
+    "quantile_over_time",
+    "rate",
+    "increase",
+    "delta",
+    "irate",
+    "idelta",
+    "deriv",
+    "predict_linear",
+    "resets",
+    "changes",
+    "holt_winters",
+]
+
+
+def _win_sum(x, window):
+    return lax.reduce_window(
+        x, jnp.zeros((), x.dtype), lax.add, (1, window), (1, 1), [(0, 0), (window - 1, 0)]
+    )
+
+
+def _win_max(x, window):
+    return lax.reduce_window(
+        x,
+        jnp.asarray(-jnp.inf, x.dtype),
+        lax.max,
+        (1, window),
+        (1, 1),
+        [(0, 0), (window - 1, 0)],
+    )
+
+
+def _win_min(x, window):
+    return lax.reduce_window(
+        x,
+        jnp.asarray(jnp.inf, x.dtype),
+        lax.min,
+        (1, window),
+        (1, 1),
+        [(0, 0), (window - 1, 0)],
+    )
+
+
+def _win_imax(x, window):
+    """reduce_window max for int32 index arrays (init -1)."""
+    return lax.reduce_window(
+        x, jnp.asarray(-1, x.dtype), lax.max, (1, window), (1, 1), [(0, 0), (window - 1, 0)]
+    )
+
+
+def _win_imin(x, window, big):
+    return lax.reduce_window(
+        x, jnp.asarray(big, x.dtype), lax.min, (1, window), (1, 1), [(0, 0), (window - 1, 0)]
+    )
+
+
+def _valid(values):
+    return ~jnp.isnan(values)
+
+
+def _masked(values, fill=0.0):
+    return jnp.where(_valid(values), values, jnp.asarray(fill, values.dtype))
+
+
+# ---------------------------------------------------------------------------
+# *_over_time aggregations (temporal/aggregation.go:144-236 NaN semantics)
+# ---------------------------------------------------------------------------
+
+
+def _sum_count(values, window):
+    valid = _valid(values)
+    s = _win_sum(_masked(values), window)
+    c = _win_sum(valid.astype(values.dtype), window)
+    return s, c
+
+
+def sum_over_time(values, window):
+    s, c = _sum_count(values, window)
+    return jnp.where(c > 0, s, jnp.nan)
+
+
+def count_over_time(values, window):
+    _, c = _sum_count(values, window)
+    return jnp.where(c > 0, c, jnp.nan)
+
+
+def avg_over_time(values, window):
+    s, c = _sum_count(values, window)
+    return jnp.where(c > 0, s / c, jnp.nan)
+
+
+def min_over_time(values, window):
+    c = _win_sum(_valid(values).astype(values.dtype), window)
+    m = _win_min(_masked(values, jnp.inf), window)
+    return jnp.where(c > 0, m, jnp.nan)
+
+
+def max_over_time(values, window):
+    c = _win_sum(_valid(values).astype(values.dtype), window)
+    m = _win_max(_masked(values, -jnp.inf), window)
+    return jnp.where(c > 0, m, jnp.nan)
+
+
+def last_over_time(values, window):
+    last_idx, _, _ = _window_valid_indices(values, window)
+    v = jnp.take_along_axis(values, jnp.maximum(last_idx, 0), axis=1)
+    return jnp.where(last_idx >= 0, v, jnp.nan)
+
+
+def stdvar_over_time(values, window):
+    # aux/count Welford result == E[x^2] - mean^2 over window, population var
+    # (aggregation.go:207-222; NaN unless >= 2 points).
+    valid = _valid(values)
+    x = _masked(values)
+    s = _win_sum(x, window)
+    ss = _win_sum(x * x, window)
+    c = _win_sum(valid.astype(values.dtype), window)
+    mean = s / jnp.maximum(c, 1)
+    var = ss / jnp.maximum(c, 1) - mean * mean
+    return jnp.where(c >= 2, jnp.maximum(var, 0), jnp.nan)
+
+
+def stddev_over_time(values, window):
+    return jnp.sqrt(stdvar_over_time(values, window))
+
+
+# ---------------------------------------------------------------------------
+# window index machinery
+# ---------------------------------------------------------------------------
+
+
+def _window_valid_indices(values, window):
+    """(last_idx, first_idx, count) of valid samples per window, -1/T when none."""
+    s, t = values.shape
+    valid = _valid(values)
+    idx = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (s, t))
+    last_idx = _win_imax(jnp.where(valid, idx, -1), window)
+    first_idx = _win_imin(jnp.where(valid, idx, t), window, t)
+    count = _win_sum(valid.astype(jnp.float32), window)
+    return last_idx, first_idx, count
+
+
+def _prev_valid(values):
+    """Per index t: (prev_idx, prev_val) of the last valid sample at index < t."""
+    s, t = values.shape
+    valid = _valid(values)
+    idx = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (s, t))
+    ffill = lax.associative_scan(jnp.maximum, jnp.where(valid, idx, -1), axis=1)
+    prev_idx = jnp.concatenate([jnp.full((s, 1), -1, jnp.int32), ffill[:, :-1]], axis=1)
+    prev_val = jnp.take_along_axis(values, jnp.maximum(prev_idx, 0), axis=1)
+    prev_val = jnp.where(prev_idx >= 0, prev_val, jnp.nan)
+    return prev_idx, prev_val
+
+
+def _pair_event_window_sum(values, event_amount, window):
+    """Windowed sum of per-sample pair events, excluding the event attached to
+    the window's FIRST valid sample (its pair partner lies before the window
+    — mirrors the reference loops starting with zero state, e.g.
+    rate.go:170-188, functions.go:89-117)."""
+    wsum = _win_sum(event_amount, window)
+    last_idx, first_idx, _ = _window_valid_indices(values, window)
+    t = values.shape[1]
+    first_event = jnp.take_along_axis(event_amount, jnp.clip(first_idx, 0, t - 1), axis=1)
+    first_event = jnp.where(first_idx < t, first_event, 0.0)
+    return wsum - first_event, last_idx, first_idx
+
+
+# ---------------------------------------------------------------------------
+# rate family (temporal/rate.go:150-239)
+# ---------------------------------------------------------------------------
+
+
+def _rate_impl(values, window, step_seconds, is_rate, is_counter):
+    dt = values.dtype
+    s, t = values.shape
+    duration = (window - 1) * step_seconds
+
+    _, prev_val = _prev_valid(values)
+    valid = _valid(values)
+    reset = valid & ~jnp.isnan(prev_val) & (values < prev_val)
+    corr_amount = jnp.where(reset & is_counter, _masked(prev_val), 0.0).astype(dt)
+    corr, last_idx, first_idx = _pair_event_window_sum(values, corr_amount, window)
+
+    has_two = (last_idx >= 0) & (first_idx < t) & (last_idx != first_idx)
+    li = jnp.maximum(last_idx, 0)
+    fi = jnp.clip(first_idx, 0, t - 1)
+    last_val = jnp.take_along_axis(values, li, axis=1)
+    first_val = jnp.take_along_axis(values, fi, axis=1)
+
+    # grid timestamps relative to each output step's rangeEnd, in seconds
+    out_idx = jnp.arange(t, dtype=jnp.float32)[None, :]
+    t_last = (li.astype(jnp.float32) - out_idx) * step_seconds  # <= 0
+    t_first = (fi.astype(jnp.float32) - out_idx) * step_seconds
+    range_start = -duration
+
+    duration_to_start = t_first - range_start
+    duration_to_end = -t_last
+    sampled_interval = t_last - t_first
+    avg_between = sampled_interval / jnp.maximum((li - fi).astype(jnp.float32), 1)
+
+    result = last_val - first_val + corr
+    if is_counter:
+        # zero-point extrapolation clamp (rate.go:200-211)
+        dur_to_zero = sampled_interval * (first_val / jnp.where(result > 0, result, 1.0))
+        clamp = (result > 0) & (first_val >= 0)
+        duration_to_start = jnp.where(
+            clamp & (dur_to_zero < duration_to_start), dur_to_zero, duration_to_start
+        )
+
+    threshold = avg_between * 1.1
+    extrap = sampled_interval
+    extrap = extrap + jnp.where(duration_to_start < threshold, duration_to_start, avg_between / 2)
+    extrap = extrap + jnp.where(duration_to_end < threshold, duration_to_end, avg_between / 2)
+
+    result = result * (extrap / jnp.maximum(sampled_interval, 1e-30))
+    if is_rate:
+        result = result / duration
+    return jnp.where(has_two, result, jnp.nan).astype(dt)
+
+
+def rate(values, window, step_seconds):
+    return _rate_impl(values, window, step_seconds, is_rate=True, is_counter=True)
+
+
+def increase(values, window, step_seconds):
+    return _rate_impl(values, window, step_seconds, is_rate=False, is_counter=True)
+
+
+def delta(values, window, step_seconds):
+    return _rate_impl(values, window, step_seconds, is_rate=False, is_counter=False)
+
+
+def _irate_impl(values, window, step_seconds, is_rate):
+    """Last two valid samples in window (rate.go irateFunc:240-282)."""
+    s, t = values.shape
+    prev_idx, prev_val = _prev_valid(values)
+    last_idx, first_idx, _ = _window_valid_indices(values, window)
+    li = jnp.maximum(last_idx, 0)
+    last_val = jnp.take_along_axis(values, li, axis=1)
+    # second-to-last valid = prev_valid at the last sample's index
+    second_idx = jnp.take_along_axis(prev_idx, li, axis=1)
+    second_val = jnp.take_along_axis(prev_val, li, axis=1)
+    window_start = jnp.arange(t, dtype=jnp.int32)[None, :] - (window - 1)
+    ok = (last_idx >= 0) & (second_idx >= 0) & (second_idx >= window_start)
+    res = last_val - second_val
+    if is_rate:
+        dt_s = (li - second_idx).astype(values.dtype) * step_seconds
+        res = res / jnp.maximum(dt_s, 1e-30)
+    return jnp.where(ok, res, jnp.nan)
+
+
+def irate(values, window, step_seconds):
+    return _irate_impl(values, window, step_seconds, is_rate=True)
+
+
+def idelta(values, window, step_seconds):
+    return _irate_impl(values, window, step_seconds, is_rate=False)
+
+
+# ---------------------------------------------------------------------------
+# linear regression (temporal/linear_regression.go:145-190)
+# ---------------------------------------------------------------------------
+
+
+def _linreg_sums(values, window, step_seconds, chunk: int = 128):
+    """Windowed least squares with timeDiff relative to the window end — the
+    reference's interceptTime == evaluationTime (linear_regression.go:136).
+    Uses exact per-window recentering on gathered windows (chunked) to avoid
+    the f32 cancellation a shift-invariant cumulative formulation would hit.
+    Slope is recenter-invariant, so deriv shares this."""
+    dt = values.dtype
+    s, t = values.shape
+    nchunks = -(-t // chunk)
+    # time diff of window slot j (0..W-1) from the window end, in seconds
+    d = (jnp.arange(window, dtype=dt) - (window - 1)) * jnp.asarray(step_seconds, dt)
+
+    def one_chunk(t0):
+        w = _gather_windows(values, window, t0, chunk)  # [S, chunk, W]
+        ok = ~jnp.isnan(w)
+        x = jnp.where(ok, w, 0)
+        vi = ok.astype(dt)
+        n = jnp.sum(vi, axis=-1)
+        sv = jnp.sum(x, axis=-1)
+        sd = jnp.sum(d * vi, axis=-1)
+        sdd = jnp.sum(d * d * vi, axis=-1)
+        sdv = jnp.sum(d * x, axis=-1)
+        nn = jnp.maximum(n, 1)
+        cov = sdv - sd * sv / nn
+        var = sdd - sd * sd / nn
+        slope = cov / jnp.where(var == 0, 1, var)
+        intercept = sv / nn - slope * sd / nn
+        good = n >= 2
+        return jnp.where(good, slope, jnp.nan), jnp.where(good, intercept, jnp.nan)
+
+    slopes, intercepts = lax.map(one_chunk, jnp.arange(nchunks) * chunk)
+    fix = lambda a: jnp.moveaxis(a, 0, 1).reshape(s, nchunks * chunk)[:, :t]
+    return fix(slopes), fix(intercepts)
+
+
+def deriv(values, window, step_seconds):
+    slope, _ = _linreg_sums(values, window, step_seconds)
+    return slope
+
+
+def predict_linear(values, window, step_seconds, predict_seconds):
+    slope, intercept = _linreg_sums(values, window, step_seconds)
+    return slope * predict_seconds + intercept
+
+
+# ---------------------------------------------------------------------------
+# resets / changes (temporal/functions.go:89-117)
+# ---------------------------------------------------------------------------
+
+
+def _count_pairs(values, window, cmp):
+    _, prev_val = _prev_valid(values)
+    valid = _valid(values)
+    event = valid & ~jnp.isnan(prev_val) & cmp(values, prev_val)
+    count, last_idx, first_idx = _pair_event_window_sum(
+        values, event.astype(values.dtype), window
+    )
+    # NaN iff no valid sample after the window's first slot (functions.go:93-116:
+    # `prev` seeds from dps[0], loop over dps[1:]).
+    t = values.shape[1]
+    win_first_slot = jnp.clip(
+        jnp.arange(t, dtype=jnp.int32)[None, :] - (window - 1), 0, t - 1
+    )
+    valid_after_first = _win_sum(valid.astype(values.dtype), window) - jnp.take_along_axis(
+        valid.astype(values.dtype), win_first_slot, axis=1
+    )
+    return jnp.where(valid_after_first > 0, count, jnp.nan)
+
+
+def resets(values, window):
+    return _count_pairs(values, window, lambda c, p: c < p)
+
+
+def changes(values, window):
+    return _count_pairs(values, window, lambda c, p: c != p)
+
+
+# ---------------------------------------------------------------------------
+# holt_winters (temporal/holt_winters.go:77-141) — sequential smoothing within
+# the window: lax.scan over the window axis on gathered windows, chunked over
+# time to bound the [S, chunk, W] gather.
+# ---------------------------------------------------------------------------
+
+
+def _gather_windows(values, window, t0, chunk):
+    """[S, chunk, W] windows ending at steps t0..t0+chunk-1 (NaN left-pad)."""
+    values = jnp.asarray(values)
+    s, t = values.shape
+    ends = t0 + jnp.arange(chunk)
+    offs = jnp.arange(window) - (window - 1)
+    idx = ends[:, None] + offs[None, :]  # [chunk, W]
+    oob = idx < 0
+    g = jnp.take(values, jnp.clip(idx, 0, t - 1), axis=1)  # [S, chunk, W]
+    return jnp.where(oob[None, :, :], jnp.nan, g)
+
+
+def holt_winters(values, window, sf: float, tf: float, chunk: int = 128):
+    s, t = values.shape
+    dt = values.dtype
+    nchunks = -(-t // chunk)
+
+    def one_chunk(t0):
+        w = _gather_windows(values, window, t0, chunk)  # [S, chunk, W]
+        flat = w.reshape(s * chunk, window)
+
+        def step(carry, v):
+            found1, found2, prev, curr, trend, idx = carry
+            nan = jnp.isnan(v)
+            # first valid
+            take1 = ~nan & ~found1
+            # second valid: initialize trend
+            take2 = ~nan & found1 & ~found2
+            trend0 = jnp.where(take2, v - curr, trend)
+            # smoothing update for 2nd+ valid samples
+            upd = ~nan & found1
+            trend_new = jnp.where(
+                idx - 1 == 0, trend0, tf * (curr - prev) + (1 - tf) * trend0
+            )
+            new_curr = sf * v + (1 - sf) * (curr + trend_new)
+            curr_out = jnp.where(take1, v, jnp.where(upd, new_curr, curr))
+            prev_out = jnp.where(upd, curr, prev)
+            trend_out = jnp.where(upd, trend_new, trend0)
+            idx_out = jnp.where(~nan, idx + 1, idx)
+            return (
+                found1 | ~nan,
+                found2 | take2,
+                prev_out,
+                curr_out,
+                trend_out,
+                idx_out,
+            ), None
+
+        z = jnp.zeros((flat.shape[0],), dt)
+        init = (
+            jnp.zeros_like(z, bool),
+            jnp.zeros_like(z, bool),
+            z,
+            z,
+            z,
+            jnp.zeros_like(z, jnp.int32),
+        )
+        (f1, f2, _, curr, _, _), _ = lax.scan(step, init, flat.T)
+        out = jnp.where(f2, curr, jnp.nan)
+        return out.reshape(s, chunk)
+
+    outs = lax.map(one_chunk, jnp.arange(nchunks) * chunk)  # [nchunks, S, chunk]
+    out = jnp.moveaxis(outs, 0, 1).reshape(s, nchunks * chunk)
+    return out[:, :t]
+
+
+def quantile_over_time(values, window, q: float, chunk: int = 128):
+    """quantile over valid samples in window (aggregation.go:239-280): sort the
+    gathered window (NaNs sort to the end under jnp.sort), linear interpolate."""
+    s, t = values.shape
+    dt = values.dtype
+    if q < 0:
+        base = jnp.full((s, t), -jnp.inf, dt)
+        c = _win_sum(_valid(values).astype(dt), window)
+        return jnp.where(c > 0, base, jnp.nan)
+    if q > 1:
+        base = jnp.full((s, t), jnp.inf, dt)
+        c = _win_sum(_valid(values).astype(dt), window)
+        return jnp.where(c > 0, base, jnp.nan)
+    nchunks = -(-t // chunk)
+
+    def one_chunk(t0):
+        w = _gather_windows(values, window, t0, chunk)  # [S, chunk, W]
+        sw = jnp.sort(w, axis=-1)  # NaNs to the end
+        n = jnp.sum(~jnp.isnan(w), axis=-1)  # [S, chunk]
+        rank = q * (n - 1).astype(dt)
+        lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, window - 1)
+        hi = jnp.clip(lo + 1, 0, window - 1)
+        hi = jnp.minimum(hi, jnp.maximum(n - 1, 0))
+        frac = rank - lo.astype(dt)
+        vlo = jnp.take_along_axis(sw, lo[..., None], axis=-1)[..., 0]
+        vhi = jnp.take_along_axis(sw, hi[..., None], axis=-1)[..., 0]
+        out = vlo + (vhi - vlo) * frac
+        return jnp.where(n > 0, out, jnp.nan)
+
+    outs = lax.map(one_chunk, jnp.arange(nchunks) * chunk)
+    out = jnp.moveaxis(outs, 0, 1).reshape(s, nchunks * chunk)
+    return out[:, :t]

@@ -1,0 +1,166 @@
+"""Cross-series aggregation parity vs scalar oracles of
+/root/reference/src/query/functions/aggregation/function.go and take.go."""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.block.core import SeriesMeta, make_tags
+from m3_tpu.query.functions import aggregation as A
+
+
+@pytest.fixture(scope="module")
+def block():
+    rng = np.random.default_rng(7)
+    metas = []
+    for i in range(12):
+        metas.append(
+            SeriesMeta(
+                tags=make_tags(
+                    {
+                        "job": f"job{i % 3}",
+                        "instance": f"inst{i % 4}",
+                        "unique": f"u{i}",
+                    }
+                )
+            )
+        )
+    vals = rng.normal(10, 5, (12, 20)).astype(np.float32)
+    vals[rng.random((12, 20)) < 0.3] = np.nan
+    vals[5, :] = np.nan
+    return metas, vals
+
+
+def buckets_of(layout):
+    out = [[] for _ in range(layout.num_groups)]
+    for i, g in enumerate(layout.group_ids):
+        out[g].append(i)
+    return out
+
+
+def oracle_per_step(vals, buckets, fn):
+    g = len(buckets)
+    t = vals.shape[1]
+    out = np.full((g, t), np.nan)
+    for gi, b in enumerate(buckets):
+        for ti in range(t):
+            out[gi, ti] = fn([vals[i, ti] for i in b])
+    return out
+
+
+def o_sum(xs):
+    ys = [x for x in xs if not math.isnan(x)]
+    return sum(ys) if ys else math.nan
+
+
+def o_count(xs):
+    return float(len([x for x in xs if not math.isnan(x)]))
+
+
+def o_avg(xs):
+    ys = [x for x in xs if not math.isnan(x)]
+    return sum(ys) / len(ys) if ys else math.nan
+
+
+def o_min(xs):
+    ys = [x for x in xs if not math.isnan(x)]
+    return min(ys) if ys else math.nan
+
+
+def o_max(xs):
+    ys = [x for x in xs if not math.isnan(x)]
+    return max(ys) if ys else math.nan
+
+
+def o_var(xs):
+    ys = [x for x in xs if not math.isnan(x)]
+    if not ys:
+        return math.nan
+    m = sum(ys) / len(ys)
+    return sum((y - m) ** 2 for y in ys) / len(ys)
+
+
+def assert_close(got, want, rtol=1e-4, atol=1e-3):
+    got = np.asarray(got)
+    nan_g, nan_w = np.isnan(got), np.isnan(want)
+    assert (nan_g == nan_w).all(), np.argwhere(nan_g != nan_w)[:5]
+    np.testing.assert_allclose(got[~nan_g], want[~nan_w], rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("by,without", [(["job"], False), (["unique"], True), (None, False)])
+def test_grouped_aggs(block, by, without):
+    metas, vals = block
+    layout = A.group_by_tags(metas, by, without)
+    buckets = buckets_of(layout)
+    assert_close(A.grouped_sum(vals, layout), oracle_per_step(vals, buckets, o_sum))
+    assert_close(A.grouped_count(vals, layout), oracle_per_step(vals, buckets, o_count))
+    assert_close(A.grouped_avg(vals, layout), oracle_per_step(vals, buckets, o_avg))
+    assert_close(A.grouped_min(vals, layout), oracle_per_step(vals, buckets, o_min))
+    assert_close(A.grouped_max(vals, layout), oracle_per_step(vals, buckets, o_max))
+    assert_close(
+        A.grouped_stdvar(vals, layout), oracle_per_step(vals, buckets, o_var), rtol=1e-3
+    )
+
+
+def test_grouped_quantile(block):
+    metas, vals = block
+    layout = A.group_by_tags(metas, ["job"], False)
+    buckets = buckets_of(layout)
+
+    def o_q(xs, q=0.75):
+        ys = sorted(x for x in xs if not math.isnan(x))
+        if not ys:
+            return math.nan
+        rank = q * (len(ys) - 1)
+        lo = int(math.floor(rank))
+        hi = min(lo + 1, len(ys) - 1)
+        return ys[lo] + (ys[hi] - ys[lo]) * (rank - lo)
+
+    assert_close(A.grouped_quantile(vals, layout, 0.75), oracle_per_step(vals, buckets, o_q))
+
+
+def test_topk(block):
+    metas, vals = block
+    layout = A.group_by_tags(metas, ["job"], False)
+    k = 2
+    got = np.asarray(A.topk(vals, layout, k))
+    assert got.shape == vals.shape
+    for gi, b in enumerate(buckets_of(layout)):
+        for ti in range(vals.shape[1]):
+            col = [(vals[i, ti], i) for i in b if not math.isnan(vals[i, ti])]
+            kept = {i for i in b if not math.isnan(got[i, ti])}
+            want = {i for _, i in sorted(col, key=lambda p: (-p[0], p[1]))[:k]}
+            assert kept == want, (gi, ti, kept, want)
+    # non-kept entries are NaN, kept entries keep original values
+    mask = ~np.isnan(got)
+    np.testing.assert_array_equal(got[mask], vals[mask])
+
+
+def test_bottomk(block):
+    metas, vals = block
+    layout = A.group_by_tags(metas, [], False)  # single global group
+    got = np.asarray(A.bottomk(vals, layout, 3))
+    for ti in range(vals.shape[1]):
+        col = [(vals[i, ti], i) for i in range(vals.shape[0]) if not math.isnan(vals[i, ti])]
+        kept = {i for i in range(vals.shape[0]) if not math.isnan(got[i, ti])}
+        want = {i for _, i in sorted(col, key=lambda p: (p[0], p[1]))[:3]}
+        assert kept == want
+
+
+def test_absent(block):
+    metas, vals = block
+    got = np.asarray(A.absent(vals))
+    want = np.where(np.any(~np.isnan(vals), axis=0), np.nan, 1.0)[None, :]
+    assert ((np.isnan(got)) == (np.isnan(want))).all()
+    assert (got[~np.isnan(got)] == 1.0).all()
+
+
+def test_count_values(block):
+    metas, vals = block
+    v = np.round(vals)
+    out, out_metas = A.count_values(v, metas, b"value")
+    assert len(out_metas) == out.shape[0]
+    total = np.nansum(out, axis=0)
+    want = np.sum(~np.isnan(v), axis=0)
+    np.testing.assert_allclose(total[want > 0], want[want > 0])

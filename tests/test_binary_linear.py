@@ -1,0 +1,233 @@
+"""Binary op / linear function parity tests (reference: src/query/functions/
+{binary,linear}/)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.block.core import SeriesMeta, make_tags
+from m3_tpu.query.functions import binary as B
+from m3_tpu.query.functions import linear as L
+
+
+def metas_from(dicts):
+    return [SeriesMeta(tags=make_tags(d)) for d in dicts]
+
+
+@pytest.fixture
+def sides():
+    rng = np.random.default_rng(3)
+    l_metas = metas_from(
+        [
+            {"job": "a", "instance": "1", "__name__": "m1"},
+            {"job": "a", "instance": "2", "__name__": "m1"},
+            {"job": "b", "instance": "1", "__name__": "m1"},
+        ]
+    )
+    r_metas = metas_from(
+        [
+            {"job": "a", "instance": "2", "__name__": "m2"},
+            {"job": "b", "instance": "1", "__name__": "m2"},
+            {"job": "c", "instance": "9", "__name__": "m2"},
+        ]
+    )
+    lv = rng.normal(0, 10, (3, 8)).astype(np.float32)
+    rv = rng.normal(0, 10, (3, 8)).astype(np.float32)
+    lv[0, 2] = np.nan
+    rv[1, 3] = np.nan
+    return l_metas, r_metas, lv, rv
+
+
+def test_intersect_ignoring_name(sides):
+    l_metas, r_metas, lv, rv = sides
+    tl, tr, metas = B.intersect(B.VectorMatching(), l_metas, r_metas)
+    # matches: (a,2)<->(a,2), (b,1)<->(b,1)
+    assert list(tl) == [1, 2]
+    assert list(tr) == [0, 1]
+    assert len(metas) == 2
+
+
+def test_intersect_on(sides):
+    l_metas, r_metas, lv, rv = sides
+    m = B.VectorMatching(on=True, matching_labels=(b"job",))
+    tl, tr, _ = B.intersect(m, l_metas, r_metas)
+    # first-write-wins on rhs key: job=a -> r0, job=b -> r1
+    assert list(tl) == [0, 1, 2]
+    assert list(tr) == [0, 0, 1]
+
+
+def test_arithmetic_ops(sides):
+    l_metas, r_metas, lv, rv = sides
+    tl, tr, _ = B.intersect(B.VectorMatching(), l_metas, r_metas)
+    for op, fn in [
+        ("+", lambda x, y: x + y),
+        ("-", lambda x, y: x - y),
+        ("*", lambda x, y: x * y),
+        ("/", lambda x, y: x / y),
+        ("%", math.fmod),
+    ]:
+        got = np.asarray(B.arithmetic(op, lv, rv, tl, tr))
+        for k in range(len(tl)):
+            for t in range(lv.shape[1]):
+                x, y = float(lv[tl[k], t]), float(rv[tr[k], t])
+                want = fn(x, y) if not (math.isnan(x) or math.isnan(y)) else math.nan
+                g = got[k, t]
+                if math.isnan(want):
+                    assert math.isnan(g)
+                else:
+                    assert g == pytest.approx(want, rel=1e-5, abs=1e-5), (op, k, t)
+
+
+def test_comparison_filter_and_bool(sides):
+    l_metas, r_metas, lv, rv = sides
+    tl, tr, _ = B.intersect(B.VectorMatching(), l_metas, r_metas)
+    got = np.asarray(B.comparison(">", lv, rv, tl, tr, return_bool=False))
+    gotb = np.asarray(B.comparison(">", lv, rv, tl, tr, return_bool=True))
+    for k in range(len(tl)):
+        for t in range(lv.shape[1]):
+            x, y = float(lv[tl[k], t]), float(rv[tr[k], t])
+            if math.isnan(x) or math.isnan(y):
+                assert math.isnan(got[k, t]) and math.isnan(gotb[k, t])
+            elif x > y:
+                assert got[k, t] == pytest.approx(x)
+                assert gotb[k, t] == 1.0
+            else:
+                assert math.isnan(got[k, t])
+                assert gotb[k, t] == 0.0
+
+
+def test_logical_ops(sides):
+    l_metas, r_metas, lv, rv = sides
+    m = B.VectorMatching()
+    andv, and_m = B.logical_and(lv, rv, l_metas, r_metas, m)
+    assert len(and_m) == 2  # (a,2), (b,1)
+    andv = np.asarray(andv)
+    assert math.isnan(andv[1, 3])  # rhs NaN blanks lhs
+    assert andv[0, 0] == pytest.approx(lv[1, 0])
+
+    orv, or_m = B.logical_or(lv, rv, l_metas, r_metas, m)
+    assert len(or_m) == 4  # 3 lhs + rhs (c,9)
+    np.testing.assert_array_equal(np.asarray(orv)[:3], lv)
+
+    unv, un_m = B.logical_unless(lv, rv, l_metas, r_metas, m)
+    unv = np.asarray(unv)
+    assert len(un_m) == 3
+    # lhs[0] has no rhs match -> kept fully
+    np.testing.assert_array_equal(unv[0][~np.isnan(lv[0])], lv[0][~np.isnan(lv[0])])
+    # lhs[1] matched (a,2): kept only where rhs NaN
+    assert math.isnan(unv[1, 0])
+    # lhs[2] matched (b,1): rv[1,3] is NaN -> kept there
+    assert unv[2, 3] == pytest.approx(lv[2, 3])
+
+
+def test_math_round_clamp():
+    v = np.array([[-1.5, 2.3, np.nan, 100.0]], np.float32)
+    np.testing.assert_allclose(np.asarray(L.MATH_FNS["abs"](v))[0, :2], [1.5, 2.3])
+    assert math.isnan(float(np.asarray(L.MATH_FNS["sqrt"](v))[0, 0]))  # sqrt(-) = NaN
+    np.testing.assert_allclose(np.asarray(L.clamp_min(v, 0.0))[0, 0], 0.0)
+    np.testing.assert_allclose(np.asarray(L.clamp_max(v, 50.0))[0, 3], 50.0)
+    np.testing.assert_allclose(np.asarray(L.round_to(v, 1.0))[0, :2], [-1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(L.round_to(v, 0.5))[0, :2], [-1.5, 2.5])
+
+
+def test_sort_series():
+    v = np.array([[1, 5.0], [2, 1.0], [3, np.nan], [4, 9.0]], np.float32)
+    # NaN series sort last in both directions (Prometheus behavior; the
+    # reference's sort.go is a no-op because M3 lacks instant queries)
+    assert list(L.sort_series(v)) == [1, 0, 3, 2]
+    assert list(L.sort_series(v, descending=True)) == [3, 0, 1, 2]
+
+
+def o_bucket_quantile(q, buckets):
+    """Literal bucketQuantile (histogram_quantile.go:216-256) after
+    ensureMonotonic (:321-331)."""
+    if len(buckets) < 2:
+        return math.nan
+    if not math.isinf(buckets[-1][0]):
+        return math.nan
+    mx = -math.inf
+    mono = []
+    for ub, v in buckets:
+        mx = max(mx, v)
+        mono.append((ub, mx))
+    buckets = mono
+    rank = q * buckets[-1][1]
+    n = len(buckets)
+    bi = n - 1
+    for i in range(n - 1):
+        if buckets[i][1] >= rank:
+            bi = i
+            break
+    if bi == n - 1:
+        return buckets[n - 2][0]
+    if bi == 0 and buckets[0][0] <= 0:
+        return buckets[0][0]
+    start, end = 0.0, buckets[bi][0]
+    count = buckets[bi][1]
+    if bi > 0:
+        start = buckets[bi - 1][0]
+        count -= buckets[bi - 1][1]
+        rank -= buckets[bi - 1][1]
+    return start + (end - start) * rank / count
+
+
+def test_histogram_quantile():
+    rng = np.random.default_rng(11)
+    les = [0.1, 0.5, 1.0, 5.0, math.inf]
+    metas = []
+    for job in ("a", "b"):
+        for le in les:
+            metas.append(
+                SeriesMeta(tags=make_tags({"job": job, "le": repr(le).replace("inf", "+Inf")}))
+            )
+    # cumulative counts increasing across buckets
+    t = 6
+    vals = np.zeros((len(metas), t), np.float32)
+    for g in range(2):
+        base = np.cumsum(rng.integers(0, 50, (len(les), t)), axis=0).astype(np.float32)
+        vals[g * len(les) : (g + 1) * len(les)] = base
+    # NaN a bucket at one step; NaN whole top bucket at another step
+    vals[1, 2] = np.nan
+    vals[4, 4] = np.nan
+
+    index, bounds, out_metas = L.histogram_buckets(metas)
+    assert len(out_metas) == 2
+    got = np.asarray(L.histogram_quantile(0.9, vals, index, bounds))
+    for g in range(2):
+        rows = index[g]
+        for ti in range(t):
+            buckets = [
+                (float(bounds[g][k]), float(vals[rows[k], ti]))
+                for k in range(len(rows))
+                if rows[k] >= 0 and not math.isnan(vals[rows[k], ti])
+            ]
+            want = o_bucket_quantile(0.9, buckets)
+            if math.isnan(want):
+                assert math.isnan(got[g, ti]), (g, ti, got[g, ti])
+            else:
+                assert got[g, ti] == pytest.approx(want, rel=1e-4), (g, ti)
+
+
+def test_histogram_quantile_edge_q():
+    metas = [
+        SeriesMeta(tags=make_tags({"le": "1"})),
+        SeriesMeta(tags=make_tags({"le": "+Inf"})),
+    ]
+    vals = np.array([[5.0], [10.0]], np.float32)
+    index, bounds, _ = L.histogram_buckets(metas)
+    assert np.asarray(L.histogram_quantile(-0.1, vals, index, bounds))[0, 0] == -math.inf
+    assert np.asarray(L.histogram_quantile(1.1, vals, index, bounds))[0, 0] == math.inf
+
+
+def test_datetime_fns():
+    # 2021-03-14 15:09:26 UTC, a Sunday
+    ts = np.array([[1615734566.0, np.nan]])
+    assert L.datetime_fn("day_of_month", ts)[0, 0] == 14
+    assert L.datetime_fn("month", ts)[0, 0] == 3
+    assert L.datetime_fn("year", ts)[0, 0] == 2021
+    assert L.datetime_fn("hour", ts)[0, 0] == 15
+    assert L.datetime_fn("minute", ts)[0, 0] == 9
+    assert L.datetime_fn("day_of_week", ts)[0, 0] == 0  # Sunday = 0
+    assert L.datetime_fn("days_in_month", ts)[0, 0] == 31
+    assert math.isnan(L.datetime_fn("year", ts)[0, 1])

@@ -1,0 +1,334 @@
+"""Temporal function parity vs a scalar oracle of the reference semantics.
+
+The oracle mirrors the Go per-window loops literally
+(/root/reference/src/query/functions/temporal/{aggregation,rate,functions,
+linear_regression,holt_winters}.go); the vectorized versions must match on
+random NaN-gapped data for every output step.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.query.functions import temporal as T
+
+STEP = 10.0  # seconds
+
+
+def windows(vals, w):
+    """Yield (end_idx, window_list) covering [end-w+1, end] clipped at 0."""
+    t = vals.shape[0]
+    for end in range(t):
+        lo = max(0, end - w + 1)
+        yield end, list(vals[lo : end + 1])
+
+
+# ---- oracles (literal transcriptions of the Go loops) ----
+
+
+def o_sum(vs):
+    xs = [v for v in vs if not math.isnan(v)]
+    return sum(xs) if xs else math.nan
+
+
+def o_count(vs):
+    c = len([v for v in vs if not math.isnan(v)])
+    return float(c) if c else math.nan
+
+
+def o_avg(vs):
+    xs = [v for v in vs if not math.isnan(v)]
+    return sum(xs) / len(xs) if xs else math.nan
+
+
+def o_min(vs):
+    xs = [v for v in vs if not math.isnan(v)]
+    return min(xs) if xs else math.nan
+
+
+def o_max(vs):
+    xs = [v for v in vs if not math.isnan(v)]
+    return max(xs) if xs else math.nan
+
+
+def o_stdvar(vs):
+    xs = [v for v in vs if not math.isnan(v)]
+    if len(xs) < 2:
+        return math.nan
+    m = sum(xs) / len(xs)
+    return sum((x - m) ** 2 for x in xs) / len(xs)
+
+
+def o_rate(vs, w, is_rate=True, is_counter=True):
+    # rate.go:150-239 with grid timestamps
+    n = len(vs)
+    if n < 2:
+        return math.nan
+    duration = (w - 1) * STEP
+    range_end = 0.0  # relative; samples at -(n-1)*STEP .. 0
+    ts = [range_end - (n - 1 - i) * STEP for i in range(n)]
+    range_start = range_end - duration
+    corr = 0.0
+    first_val = last_val = 0.0
+    first_idx = last_idx = -1
+    first_ts = last_ts = 0.0
+    found = False
+    for i, v in enumerate(vs):
+        if math.isnan(v):
+            continue
+        if not found:
+            first_val, first_ts, first_idx, found = v, ts[i], i, True
+        if is_counter and v < last_val:
+            corr += last_val
+        last_val, last_ts, last_idx = v, ts[i], i
+    if first_idx == last_idx:
+        return math.nan
+    dur_start = first_ts - range_start
+    dur_end = range_end - last_ts
+    sampled = last_ts - first_ts
+    avg_between = sampled / (last_idx - first_idx)
+    result = last_val - first_val + corr
+    if is_counter and result > 0 and first_val >= 0:
+        dz = sampled * (first_val / result)
+        if dz < dur_start:
+            dur_start = dz
+    thresh = avg_between * 1.1
+    extrap = sampled
+    extrap += dur_start if dur_start < thresh else avg_between / 2
+    extrap += dur_end if dur_end < thresh else avg_between / 2
+    result *= extrap / sampled
+    if is_rate:
+        result /= duration
+    return result
+
+
+def o_irate(vs, is_rate):
+    idxs = [i for i, v in enumerate(vs) if not math.isnan(v)]
+    if len(idxs) < 2:
+        return math.nan
+    i2, i1 = idxs[-1], idxs[-2]
+    res = vs[i2] - vs[i1]
+    if is_rate:
+        res /= (i2 - i1) * STEP
+    return res
+
+
+def o_linreg(vs, w):
+    n = len(vs)
+    # interceptTime = rangeEnd; ts relative as in o_rate
+    ts = [-(n - 1 - i) * STEP for i in range(n)]
+    cnt = 0
+    sn = sv = sd = sdd = sdv = 0.0
+    for i, v in enumerate(vs):
+        if math.isnan(v):
+            continue
+        cnt += 1
+        d = ts[i]
+        sn += 1
+        sv += v
+        sd += d
+        sdd += d * d
+        sdv += d * v
+    if cnt < 2:
+        return math.nan, math.nan
+    cov = sdv - sd * sv / sn
+    var = sdd - sd * sd / sn
+    slope = cov / var
+    intercept = sv / sn - slope * sd / sn
+    return slope, intercept
+
+
+def o_resets_changes(vs, cmp):
+    if not vs:
+        return math.nan
+    all_nan = True
+    result = 0.0
+    prev = vs[0]
+    for curr in vs[1:]:
+        if math.isnan(curr):
+            continue
+        all_nan = False
+        if not math.isnan(prev) and cmp(curr, prev):
+            result += 1
+        prev = curr
+    return math.nan if all_nan else result
+
+
+def o_holt_winters(vs, sf, tf):
+    found1 = found2 = False
+    prev = curr = trend = 0.0
+    idx = 0
+    for v in vs:
+        if math.isnan(v):
+            continue
+        if not found1:
+            found1, curr = True, v
+            idx += 1
+            continue
+        if not found2:
+            found2, trend = True, v - curr
+        if idx - 1 == 0:
+            tv = trend
+        else:
+            tv = tf * (curr - prev) + (1 - tf) * trend
+        prev, curr, trend = curr, sf * v + (1 - sf) * (curr + tv), tv
+        idx += 1
+    return curr if found2 else math.nan
+
+
+def o_quantile(vs, q):
+    xs = sorted(v for v in vs if not math.isnan(v))
+    if not xs:
+        return math.nan
+    if q < 0:
+        return -math.inf
+    if q > 1:
+        return math.inf
+    rank = q * (len(xs) - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (rank - lo)
+
+
+# ---- fixtures ----
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(42)
+    s, t = 7, 60
+    vals = np.cumsum(rng.normal(1.0, 5.0, (s, t)), axis=1).astype(np.float32)
+    # counter-ish rows: make some rows monotonic with resets
+    vals[0] = np.abs(vals[0])
+    # NaN gaps
+    mask = rng.random((s, t)) < 0.25
+    vals[mask] = np.nan
+    vals[2, :] = np.nan  # fully-empty series
+    vals[3, ::2] = np.nan
+    return vals
+
+
+def check(fn_out, oracle, vals, w, rtol=2e-4, atol=2e-4):
+    got = np.asarray(fn_out)
+    for si in range(vals.shape[0]):
+        for end, win in windows(vals[si], w):
+            want = oracle(win)
+            g = got[si, end]
+            if math.isnan(want):
+                assert math.isnan(g), (si, end, g, "want NaN")
+            else:
+                assert g == pytest.approx(want, rel=rtol, abs=atol), (si, end, g, want)
+
+
+@pytest.mark.parametrize("w", [1, 5, 16])
+def test_over_time_aggs(data, w):
+    check(T.sum_over_time(data, w), o_sum, data, w)
+    check(T.count_over_time(data, w), o_count, data, w)
+    check(T.avg_over_time(data, w), o_avg, data, w)
+    check(T.min_over_time(data, w), o_min, data, w)
+    check(T.max_over_time(data, w), o_max, data, w)
+    check(T.stdvar_over_time(data, w), o_stdvar, data, w, rtol=2e-3, atol=2e-2)
+
+
+@pytest.mark.parametrize("w", [5, 16])
+def test_rate_family(data, w):
+    check(
+        T.rate(data, w, STEP), lambda vs: o_rate(vs, w, True, True), data, w, rtol=1e-3
+    )
+    check(
+        T.increase(data, w, STEP),
+        lambda vs: o_rate(vs, w, False, True),
+        data,
+        w,
+        rtol=1e-3,
+    )
+    check(
+        T.delta(data, w, STEP),
+        lambda vs: o_rate(vs, w, False, False),
+        data,
+        w,
+        rtol=1e-3,
+        atol=1e-2,
+    )
+    check(T.irate(data, w, STEP), lambda vs: o_irate(vs, True), data, w, rtol=1e-3)
+    check(T.idelta(data, w, STEP), lambda vs: o_irate(vs, False), data, w, rtol=1e-3)
+
+
+@pytest.mark.parametrize("w", [5, 16])
+def test_linreg(data, w):
+    check(
+        T.deriv(data, w, STEP),
+        lambda vs: o_linreg(vs, w)[0],
+        data,
+        w,
+        rtol=5e-3,
+        atol=5e-3,
+    )
+    check(
+        T.predict_linear(data, w, STEP, 600.0),
+        lambda vs: (
+            o_linreg(vs, w)[0] * 600.0 + o_linreg(vs, w)[1]
+            if not math.isnan(o_linreg(vs, w)[0])
+            else math.nan
+        ),
+        data,
+        w,
+        rtol=5e-3,
+        atol=5e-1,
+    )
+
+
+@pytest.mark.parametrize("w", [5, 16])
+def test_resets_changes(data, w):
+    check(
+        T.resets(data, w),
+        lambda vs: o_resets_changes(vs, lambda c, p: c < p),
+        data,
+        w,
+    )
+    check(
+        T.changes(data, w),
+        lambda vs: o_resets_changes(vs, lambda c, p: c != p),
+        data,
+        w,
+    )
+
+
+@pytest.mark.parametrize("w", [5, 16])
+def test_holt_winters(data, w):
+    check(
+        T.holt_winters(data, w, 0.3, 0.6, chunk=16),
+        lambda vs: o_holt_winters(vs, 0.3, 0.6),
+        data,
+        w,
+        rtol=1e-3,
+        atol=1e-2,
+    )
+
+
+@pytest.mark.parametrize("q", [-0.5, 0.0, 0.5, 0.9, 1.0, 1.5])
+def test_quantile_over_time(data, q):
+    w = 9
+    got = np.asarray(T.quantile_over_time(data, w, q, chunk=16))
+    for si in range(data.shape[0]):
+        for end, win in windows(data[si], w):
+            want = o_quantile(win, q)
+            g = got[si, end]
+            if math.isnan(want):
+                assert math.isnan(g)
+            elif math.isinf(want):
+                assert g == want
+            else:
+                assert g == pytest.approx(want, rel=2e-4, abs=2e-4), (si, end, g, want)
+
+
+def test_last_over_time(data):
+    w = 7
+    got = np.asarray(T.last_over_time(data, w))
+
+    def o_last(vs):
+        xs = [v for v in vs if not math.isnan(v)]
+        return xs[-1] if xs else math.nan
+
+    check(got, o_last, data, w)
