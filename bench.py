@@ -20,32 +20,41 @@ NORTH_STAR = 10e9  # datapoints/sec/chip
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
+    import functools
 
-    from m3_tpu.parallel.scan import scan_aggregate
-    from m3_tpu.utils.synthetic import tiled_batch
+    import jax
+
+    from m3_tpu.ops.chunked import build_chunked, tile_chunked
+    from m3_tpu.parallel.scan import chunked_device_args, chunked_scan_aggregate
+    from m3_tpu.utils.synthetic import synthetic_streams
 
     n_points = 720
+    k = 16
     n_series = int(os.environ.get("BENCH_SERIES", 65536))
     platform = jax.devices()[0].platform
     if platform == "cpu":
-        n_series = min(n_series, 2048)
+        n_series = min(n_series, 4096)
 
-    batch = tiled_batch(n_series, n_points, n_unique=64, seed=3)
-    words = jnp.asarray(batch.words)
-    num_bits = jnp.asarray(batch.num_bits)
-    units = jnp.asarray(batch.initial_units(), jnp.int32)
+    streams = synthetic_streams(64, n_points, seed=3)
+    batch = tile_chunked(build_chunked(streams, k=k), n_series)
+    args = chunked_device_args(batch)
 
-    fn = jax.jit(lambda w, b, u: scan_aggregate(w, b, u, max_points=n_points + 2))
-    out = fn(words, num_bits, units)  # compile + warm
+    fn = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate,
+            s=batch.num_series,
+            c=batch.num_chunks,
+            k=batch.k,
+        )
+    )
+    out = fn(args)  # compile + warm
     jax.block_until_ready(out)
     total_points = int(out.total_count)
 
-    iters = 5
+    iters = 10
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(words, num_bits, units)
+        out = fn(args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
 

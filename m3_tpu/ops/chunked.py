@@ -1,0 +1,395 @@
+"""Chunked M3TSZ decode: side-table-indexed, gather-free device scan.
+
+The TPU redesign of the reference's sequential iterator
+(/root/reference/src/dbnode/encoding/m3tsz/iterator.go): streams are split
+into chunks of K records, each chunk carrying a ~40-byte snapshot of the
+decoder state at its start (SURVEY.md §7 hard part #1 — "host prescan index
+of record offsets stored alongside segments at encode time"). Decode then
+runs as a K-step `lax.scan` over S×C chunk-lanes:
+
+  - sequential dependence is confined WITHIN a chunk (K steps instead of T);
+  - every chunk reads bits from its own small word window, so the per-step
+    bit fetch is a narrow [N, CW] take instead of a strided HBM gather over
+    the full [S, W] stream matrix;
+  - lane parallelism multiplies by C = ceil(T/K), which keeps the VPU busy
+    even for few-series batches.
+
+Side tables come from the encoder (it walks the stream anyway) or from a
+one-time host prescan for foreign streams; on-device results are bit-identical
+to the CPU iterator either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codec.m3tsz import DEFAULT_INT_OPTIMIZATION, ReaderIterator, initial_time_unit
+from ..utils.xtime import Unit
+from . import u64
+from .decode import DecodeResult, DecodeState, _decode_timestamp, _decode_value, _int_val_to_f32
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# Decoder-state fields stored as (hi, lo) uint32 pairs.
+STATE_PAIR_FIELDS = ("prev_time", "prev_delta", "prev_float_bits", "prev_xor", "int_val")
+# Every per-lane field of ChunkedBatch, in decode_chunked_lanes order.
+LANE_FIELDS = (
+    "windows",
+    "rel_pos",
+    "num_bits",
+    "first",
+    *STATE_PAIR_FIELDS,
+    "time_unit",
+    "sig",
+    "mult",
+    "is_float",
+)
+
+
+def lane_kwargs(batch: "ChunkedBatch", transform=None) -> dict:
+    """ChunkedBatch → decode_chunked_lanes kwargs; ``transform`` maps each
+    array (applied to both halves of pair fields)."""
+    t = transform or (lambda x: x)
+    out = {}
+    for f in LANE_FIELDS:
+        v = getattr(batch, f)
+        out[f] = (t(v[0]), t(v[1])) if f in STATE_PAIR_FIELDS else t(v)
+    return out
+
+
+def _split64(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    x = x.astype(np.uint64)
+    return (x >> np.uint64(32)).astype(np.uint32), (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+@dataclass
+class ChunkedBatch:
+    """Flattened [S*C] chunk lanes + per-chunk decoder-state side table."""
+
+    windows: np.ndarray  # uint32[N, CW]
+    rel_pos: np.ndarray  # int32[N] bit offset of chunk start within window
+    num_bits: np.ndarray  # int32[N] window-relative valid bit bound
+    first: np.ndarray  # bool[N] first chunk of its series
+    prev_time: tuple  # (hi, lo) uint32[N]
+    prev_delta: tuple
+    prev_float_bits: tuple
+    prev_xor: tuple
+    int_val: tuple
+    time_unit: np.ndarray  # int32[N]
+    sig: np.ndarray
+    mult: np.ndarray
+    is_float: np.ndarray  # bool[N]
+    k: int
+    num_series: int
+    num_chunks: int  # C per series (uniform, zero-padded)
+
+    @property
+    def num_lanes(self) -> int:
+        return self.windows.shape[0]
+
+
+def build_chunked(
+    streams: list[bytes],
+    k: int = 32,
+    int_optimized: bool = DEFAULT_INT_OPTIMIZATION,
+    default_unit: Unit = Unit.SECOND,
+    min_window_words: int = 0,
+) -> ChunkedBatch:
+    """Host prescan: walk each stream with the CPU iterator, snapshotting
+    decoder state every ``k`` records. The encoder path calls this on its own
+    in-memory streams at flush time (the side table is part of our fileset
+    format, not the reference's)."""
+    snaps = []  # list of per-series list of snapshot dicts
+    spans = []  # bit spans per chunk
+    for data in streams:
+        it = ReaderIterator(data, int_optimized=int_optimized, default_unit=default_unit)
+        per = []
+        nrec = 0
+        total_bits = len(data) * 8
+
+        def snap():
+            st = it.stream
+            ts = it.ts_iterator
+            unit = ts.time_unit
+            if nrec == 0 and len(data) >= 8:
+                nt = int.from_bytes(data[:8], "big")
+                unit = initial_time_unit(nt, default_unit)
+            return dict(
+                off=st.byte_pos * 8 + st.bit_pos,
+                prev_time=ts.prev_time & 0xFFFFFFFFFFFFFFFF,
+                prev_delta=ts.prev_time_delta & 0xFFFFFFFFFFFFFFFF,
+                time_unit=int(unit),
+                prev_float_bits=it.float_iter.prev_float_bits,
+                prev_xor=it.float_iter.prev_xor,
+                int_val=int(it.int_val) & 0xFFFFFFFFFFFFFFFF,
+                sig=it.sig,
+                mult=it.mult,
+                is_float=it.is_float,
+                nrec_before=nrec,
+            )
+
+        while True:
+            pending = snap() if nrec % k == 0 else None
+            if not it.next():
+                # no record followed: don't emit an empty trailing chunk
+                break
+            if pending is not None:
+                per.append(pending)
+            nrec += 1
+            if it.ts_iterator.done or it.err is not None:
+                break
+        # chunk spans: start offsets + stream end
+        offs = [p["off"] for p in per] + [total_bits]
+        spans.append([offs[i + 1] - offs[i] for i in range(len(per))])
+        for p, spn in zip(per, spans[-1]):
+            p["span"] = spn
+            p["total_bits"] = total_bits
+        snaps.append(per)
+
+    s = len(streams)
+    c = max((len(p) for p in snaps), default=1)
+    c = max(c, 1)
+    n = s * c
+    # window size: cover max span + 4 lookahead words + up to 31 bits of
+    # alignment slack
+    max_span = max((p["span"] for per in snaps for p in per), default=0)
+    cw = (31 + max_span + 31) // 32 + 4
+    cw = max(cw, min_window_words, 6)
+
+    windows = np.zeros((n, cw), np.uint32)
+    rel = np.zeros(n, np.int32)
+    nbits = np.zeros(n, np.int32)
+    first = np.zeros(n, bool)
+    pt = np.zeros(n, np.uint64)
+    pd = np.zeros(n, np.uint64)
+    pfb = np.zeros(n, np.uint64)
+    pxr = np.zeros(n, np.uint64)
+    iv = np.zeros(n, np.uint64)
+    tu = np.zeros(n, np.int32)
+    sig = np.zeros(n, np.int32)
+    mult = np.zeros(n, np.int32)
+    isf = np.zeros(n, bool)
+
+    for si, (data, per) in enumerate(zip(streams, snaps)):
+        padded = np.frombuffer(
+            data + b"\x00" * (-len(data) % 4), dtype=">u4"
+        ).astype(np.uint32) if data else np.zeros(0, np.uint32)
+        for ci, p in enumerate(per):
+            i = si * c + ci
+            w0 = p["off"] >> 5
+            rel[i] = p["off"] & 31
+            seg = padded[w0 : w0 + cw]
+            windows[i, : len(seg)] = seg
+            nbits[i] = max(0, min(p["total_bits"] - (w0 << 5), cw * 32))
+            first[i] = ci == 0
+            pt[i] = p["prev_time"]
+            pd[i] = p["prev_delta"]
+            pfb[i] = p["prev_float_bits"]
+            pxr[i] = p["prev_xor"]
+            iv[i] = p["int_val"]
+            tu[i] = p["time_unit"]
+            sig[i] = p["sig"]
+            mult[i] = p["mult"]
+            isf[i] = p["is_float"]
+
+    return ChunkedBatch(
+        windows=windows,
+        rel_pos=rel,
+        num_bits=nbits,
+        first=first,
+        prev_time=_split64(pt),
+        prev_delta=_split64(pd),
+        prev_float_bits=_split64(pfb),
+        prev_xor=_split64(pxr),
+        int_val=_split64(iv),
+        time_unit=tu,
+        sig=sig,
+        mult=mult,
+        is_float=isf,
+        k=k,
+        num_series=s,
+        num_chunks=c,
+    )
+
+
+def tile_chunked(batch: ChunkedBatch, n_series: int) -> ChunkedBatch:
+    """Tile a small unique batch up to n_series (bench helper)."""
+    reps = -(-n_series // batch.num_series)
+    cut = n_series * batch.num_chunks
+
+    def t(x):
+        return np.tile(np.asarray(x), (reps,) + (1,) * (np.asarray(x).ndim - 1))[:cut]
+
+    return ChunkedBatch(
+        **lane_kwargs(batch, transform=t),
+        k=batch.k,
+        num_series=n_series,
+        num_chunks=batch.num_chunks,
+    )
+
+
+def _window_columns(windows):
+    """Pre-split the [N, CW] window into CW+3 column vectors (zero-padded).
+
+    Device gathers are catastrophically slow on TPU (XLA lowers them to
+    scalar dynamic-slices), so the per-step fetch is a pure vector select
+    chain over these columns instead."""
+    n, cw = windows.shape
+    zero = jnp.zeros((n,), U32)
+    cols = [windows[:, j] for j in range(cw)] + [zero, zero, zero]
+    return cols
+
+
+def _fetch4_select(cols, cw, base_rel, pos):
+    """Aligned 4-word fetch via a select tree over the lane-private window
+    columns — O(CW) VPU selects, no gather."""
+    p = base_rel + pos
+    widx = p >> 5
+
+    # binary select tree over starting index: pick cols[widx + off] for
+    # off in 0..3 by reducing groups of candidates level by level.
+    def pick(off):
+        cand = cols[off : off + cw]  # candidates for widx in [0, cw)
+        # pad to a power of two so the binary tree indexes cleanly
+        size = 1
+        while size < len(cand):
+            size *= 2
+        cand = cand + [cols[-1]] * (size - len(cand))
+        idx = widx
+        while len(cand) > 1:
+            cand = [
+                jnp.where((idx & 1) == 0, cand[j], cand[j + 1])
+                for j in range(0, len(cand), 2)
+            ]
+            idx = idx >> 1
+        return cand[0]
+
+    ws = (pick(0), pick(1), pick(2), pick(3))
+    r = (p & 31).astype(U32)
+    nz = r != 0
+    inv = U32(32) - r
+
+    def sh(a, b):
+        return (a << r) | jnp.where(nz, b >> inv, U32(0))
+
+    return (sh(ws[0], ws[1]), sh(ws[1], ws[2]), sh(ws[2], ws[3]), ws[3] << r)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "int_optimized"))
+def decode_chunked_lanes(
+    windows,
+    rel_pos,
+    num_bits,
+    first,
+    prev_time,
+    prev_delta,
+    prev_float_bits,
+    prev_xor,
+    int_val,
+    time_unit,
+    sig,
+    mult,
+    is_float,
+    k: int,
+    int_optimized: bool = True,
+) -> DecodeResult:
+    """K-step scan over chunk lanes. Same record semantics as
+    decode.decode_batched; only the fetch and initial state differ."""
+    windows = jnp.asarray(windows, U32)
+    rel_pos = jnp.asarray(rel_pos, I32)
+    n = windows.shape[0]
+    cols = _window_columns(windows)
+    fetch4 = functools.partial(_fetch4_select, cols, windows.shape[1], rel_pos)
+    as_pair = lambda p: (jnp.asarray(p[0], U32), jnp.asarray(p[1], U32))
+
+    state = DecodeState(
+        pos=jnp.zeros((n,), I32),
+        done=jnp.asarray(num_bits, I32) <= jnp.asarray(rel_pos, I32),
+        err=jnp.zeros((n,), bool),
+        prev_time=as_pair(prev_time),
+        prev_delta=as_pair(prev_delta),
+        time_unit=jnp.asarray(time_unit, I32),
+        prev_float_bits=as_pair(prev_float_bits),
+        prev_xor=as_pair(prev_xor),
+        int_val=as_pair(int_val),
+        mult=jnp.asarray(mult, I32),
+        sig=jnp.asarray(sig, I32),
+        is_float=jnp.asarray(is_float, bool),
+    )
+    first_chunk = jnp.asarray(first, bool)
+    nb = jnp.asarray(num_bits, I32) - rel_pos  # bits available from chunk start
+    from .decode import _extract
+
+    zero_pos = jnp.zeros((n,), I32)
+    nt0 = _extract(fetch4(zero_pos), zero_pos, jnp.full_like(zero_pos, 64))
+
+    def step(state, idx):
+        first_vec = first_chunk & (idx == 0)
+        was_active = ~state.done & ~state.err
+        state, _ = _decode_timestamp(fetch4, nb, state, first_vec, nt=nt0)
+        ts_active = ~state.done & ~state.err
+        state = _decode_value(fetch4, state, first_vec, int_optimized)
+        now_active = ~state.done & ~state.err
+        valid = was_active & ts_active & now_active
+        point_is_float = jnp.logical_or(not int_optimized, state.is_float)
+        val = u64.select(point_is_float, state.prev_float_bits, state.int_val)
+        out = (
+            state.prev_time[0],
+            state.prev_time[1],
+            val[0],
+            val[1],
+            point_is_float,
+            state.mult,
+            valid,
+        )
+        return state, out
+
+    final_state, outs = jax.lax.scan(step, state, jnp.arange(k))
+    ts_hi, ts_lo, val_hi, val_lo, pif, mlt, valid = outs
+    tr = lambda x: jnp.swapaxes(x, 0, 1)
+    val_pair = (tr(val_hi), tr(val_lo))
+    values_f32 = jnp.where(
+        tr(pif),
+        u64.f64_bits_to_f32(val_pair),
+        _int_val_to_f32(val_pair, tr(mlt)),
+    )
+    return DecodeResult(
+        ts_hi=tr(ts_hi),
+        ts_lo=tr(ts_lo),
+        val_hi=val_pair[0],
+        val_lo=val_pair[1],
+        point_is_float=tr(pif),
+        mult=tr(mlt),
+        valid=tr(valid),
+        err=final_state.err,
+        values_f32=jnp.where(tr(valid), values_f32, jnp.float32(jnp.nan)),
+    )
+
+
+def decode_chunked(batch: ChunkedBatch, int_optimized: bool = True) -> DecodeResult:
+    """Decode a ChunkedBatch; outputs reshaped to [S, C*K] per-series rows."""
+    res = decode_chunked_lanes(
+        **lane_kwargs(batch), k=batch.k, int_optimized=int_optimized
+    )
+    s, c, k = batch.num_series, batch.num_chunks, batch.k
+
+    def rs(x):
+        return x.reshape(s, c * k)
+
+    return DecodeResult(
+        ts_hi=rs(res.ts_hi),
+        ts_lo=rs(res.ts_lo),
+        val_hi=rs(res.val_hi),
+        val_lo=rs(res.val_lo),
+        point_is_float=rs(res.point_is_float),
+        mult=rs(res.mult),
+        valid=rs(res.valid),
+        err=jnp.any(res.err.reshape(s, c), axis=1),
+        values_f32=rs(res.values_f32),
+    )

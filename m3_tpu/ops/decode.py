@@ -143,16 +143,19 @@ def _fetch4(words, pos):
     return (sh(ws[0], ws[1]), sh(ws[1], ws[2]), sh(ws[2], ws[3]), ws[3] << r)
 
 
-def _decode_timestamp(words, num_bits, state, first):
-    """One timestamp record for all series. Returns (state', became_done)."""
+def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
+    """One timestamp record for all series. Returns (state', became_done).
+
+    ``nt`` is the (hi, lo) 64-bit first timestamp; callers hoist its fetch
+    out of the scan (it is only consumed on the first record, at pos 0)."""
     pos = state.pos
-    # --- first record: 64-bit unix nanos start time ---
-    ws0 = _fetch4(words, pos)
-    nt = _extract(ws0, jnp.zeros_like(pos), jnp.full_like(pos, 64))
+    if nt is None:
+        ws0 = fetch4(pos)
+        nt = _extract(ws0, jnp.zeros_like(pos), jnp.full_like(pos, 64))
     pos = jnp.where(first, pos + 64, pos)
     prev_time = u64.select(first, nt, state.prev_time)
 
-    ws = _fetch4(words, pos)
+    ws = fetch4(pos)
     # --- marker peek (11 bits; zero padding can never look like a marker) ---
     in_range = (pos + _MARKER_BITS) <= num_bits
     peek = _extract32(ws, jnp.zeros_like(pos), jnp.full_like(pos, _MARKER_BITS))
@@ -284,10 +287,10 @@ def _read_xor(ws, off, prev_float_bits, prev_xor):
     return new_bits, xor, consumed
 
 
-def _decode_value(words, state, first, int_optimized: bool):
+def _decode_value(fetch4, state, first, int_optimized: bool):
     """One value record for all series (iterator.go readFirstValue/readNextValue)."""
     pos = state.pos
-    ws = _fetch4(words, pos)
+    ws = fetch4(pos)
     zero = jnp.zeros_like(pos)
     one = jnp.ones_like(pos)
 
@@ -420,10 +423,13 @@ def decode_batched(
     num_bits = jnp.asarray(num_bits, I32)
     initial_unit = jnp.asarray(initial_unit, I32)
     s = words.shape[0]
+    fetch4 = functools.partial(_fetch4, words)
     zero_pair = u64.const(0, (s,))
 
+    zero_pos = jnp.zeros((s,), I32)
+    nt0 = _extract(fetch4(zero_pos), zero_pos, jnp.full_like(zero_pos, 64))
     state = DecodeState(
-        pos=jnp.zeros((s,), I32),
+        pos=zero_pos,
         done=num_bits <= 0,
         err=jnp.zeros((s,), bool),
         prev_time=zero_pair,
@@ -441,9 +447,9 @@ def decode_batched(
         first = idx == 0
         was_active = ~state.done & ~state.err
         first_vec = jnp.full((s,), False) | first
-        state, _ = _decode_timestamp(words, num_bits, state, first_vec)
+        state, _ = _decode_timestamp(fetch4, num_bits, state, first_vec, nt=nt0)
         ts_active = ~state.done & ~state.err
-        state = _decode_value(words, state, first_vec, int_optimized)
+        state = _decode_value(fetch4, state, first_vec, int_optimized)
         now_active = ~state.done & ~state.err
         valid = was_active & ts_active & now_active
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..ops.chunked import ChunkedBatch, decode_chunked_lanes
 from ..ops.decode import decode_batched
 from .mesh import SHARD_AXIS, series_mesh
 
@@ -37,10 +38,8 @@ class ScanAggregates(NamedTuple):
     total_max: jnp.ndarray  # f32[]
 
 
-def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psum):
-    res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
-    vals = res.values_f32  # [s_local, T], NaN where invalid
-    valid = res.valid
+def _aggregate_decoded(vals, valid, with_psum):
+    """Per-series + cross-series reductions over decoded [S, T] values."""
     zero = jnp.where(valid, vals, 0.0)
     s_sum = jnp.sum(zero, axis=1)
     s_count = jnp.sum(valid.astype(jnp.int32), axis=1)
@@ -77,11 +76,35 @@ def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psu
     )
 
 
+def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psum):
+    res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
+    return _aggregate_decoded(res.values_f32, res.valid, with_psum)
+
+
 def scan_aggregate(words, num_bits, initial_unit, max_points: int) -> ScanAggregates:
     """Single-device decode + aggregate (no collectives)."""
     return _local_scan_aggregate(
         words, num_bits, initial_unit, max_points=max_points, with_psum=False
     )
+
+
+def chunked_scan_aggregate(lane_args: dict, s: int, c: int, k: int, with_psum=False):
+    """Flagship fast path: side-table chunked decode (ops/chunked.py) +
+    aggregation. ``lane_args`` are ChunkedBatch fields as (device) arrays."""
+    res = decode_chunked_lanes(**lane_args, k=k)
+    vals = res.values_f32.reshape(s, c * k)
+    valid = res.valid.reshape(s, c * k)
+    return _aggregate_decoded(vals, valid, with_psum)
+
+
+def chunked_device_args(batch: ChunkedBatch, device_put=True) -> dict:
+    """ChunkedBatch → kwargs for decode_chunked_lanes, device-resident."""
+    import jax as _jax
+
+    from ..ops.chunked import lane_kwargs
+
+    put = (lambda x: _jax.device_put(jnp.asarray(x))) if device_put else jnp.asarray
+    return lane_kwargs(batch, transform=put)
 
 
 def make_sharded_scan(mesh, max_points: int):

@@ -110,14 +110,13 @@ def arithmetic(op: str, l_values, r_values, take_left, take_right):
 
 def comparison(op: str, l_values, r_values, take_left, take_right, return_bool: bool):
     """comparison.go: filter mode keeps lhs value where true else NaN; BOOL
-    mode returns 1/0 (NaN propagates as NaN-compare = false → 0...  Go
-    toFloat(x==y) with NaN operands is 0, but NaN input rows keep NaN via the
-    arithmetic NaN rule only in filter mode)."""
+    mode is toFloat(cmp) with plain IEEE NaN comparisons — NaN > y is 0,
+    NaN != y is 1, exactly like the reference's Go float comparisons."""
     lv = _gather(l_values, take_left)
     rv = _gather(r_values, take_right)
     cond = COMP_FNS[op](lv, rv)
     if return_bool:
-        return jnp.where(jnp.isnan(lv) | jnp.isnan(rv), jnp.nan, cond.astype(lv.dtype))
+        return cond.astype(lv.dtype)
     return jnp.where(cond, lv, jnp.nan)
 
 
@@ -147,13 +146,23 @@ def logical_and(l_values, r_values, l_metas, r_metas, matching: VectorMatching):
 
 
 def logical_or(l_values, r_values, l_metas, r_metas, matching: VectorMatching):
-    """or.go: all lhs series, plus rhs series whose key is absent from lhs."""
+    """or.go: all lhs series (with NaN steps filled from a matching rhs
+    series, or.go:88-95), plus rhs series whose key is absent from lhs."""
+    r_keys: dict[Tags, int] = {}
+    for j, rm in enumerate(r_metas):
+        r_keys.setdefault(_match_key(rm.tags, matching), j)
+    lv = jnp.asarray(l_values)
+    r_idx = np.asarray(
+        [r_keys.get(_match_key(lm.tags, matching), -1) for lm in l_metas], np.int32
+    )
+    if len(r_metas) and (r_idx >= 0).any():
+        rvv = _gather(r_values, np.maximum(r_idx, 0))
+        matched = jnp.asarray(r_idx >= 0)[:, None]
+        lv = jnp.where(matched & jnp.isnan(lv), rvv, lv)
     l_keys = _key_set(l_metas, matching)
     keep_r = [j for j, rm in enumerate(r_metas) if _match_key(rm.tags, matching) not in l_keys]
-    lv = jnp.asarray(l_values)
     if keep_r:
-        rv = _gather(r_values, np.asarray(keep_r, np.int32))
-        out = jnp.concatenate([lv, rv], axis=0)
+        out = jnp.concatenate([lv, _gather(r_values, np.asarray(keep_r, np.int32))], axis=0)
     else:
         out = lv
     metas = list(l_metas) + [r_metas[j] for j in keep_r]

@@ -63,7 +63,7 @@ def sort_series(values, descending: bool = False):
 
 _DATETIME_FNS = {
     "day_of_month": lambda tm: tm.tm_mday,
-    "day_of_week": lambda tm: tm.tm_wday == 6 and 0 or (tm.tm_wday + 1) % 7,
+    "day_of_week": lambda tm: (tm.tm_wday + 1) % 7,  # Go: Sunday = 0
     "days_in_month": None,  # special-cased below
     "hour": lambda tm: tm.tm_hour,
     "minute": lambda tm: tm.tm_min,

@@ -145,10 +145,14 @@ def last_over_time(values, window):
 
 
 def stdvar_over_time(values, window):
-    # aux/count Welford result == E[x^2] - mean^2 over window, population var
-    # (aggregation.go:207-222; NaN unless >= 2 points).
+    # Population variance over the window (aggregation.go:207-222; NaN unless
+    # >= 2 points). Variance is shift-invariant, so subtract a per-series
+    # baseline before the E[x^2]-mean^2 sums — without it the f32 sums
+    # catastrophically cancel for large-mean series.
     valid = _valid(values)
-    x = _masked(values)
+    baseline = jnp.nanmean(jnp.where(valid, values, jnp.nan), axis=1, keepdims=True)
+    baseline = jnp.where(jnp.isnan(baseline), 0.0, baseline)
+    x = jnp.where(valid, values - baseline, 0.0)
     s = _win_sum(x, window)
     ss = _win_sum(x * x, window)
     c = _win_sum(valid.astype(values.dtype), window)

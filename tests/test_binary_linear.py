@@ -84,17 +84,18 @@ def test_comparison_filter_and_bool(sides):
     tl, tr, _ = B.intersect(B.VectorMatching(), l_metas, r_metas)
     got = np.asarray(B.comparison(">", lv, rv, tl, tr, return_bool=False))
     gotb = np.asarray(B.comparison(">", lv, rv, tl, tr, return_bool=True))
+    gotne = np.asarray(B.comparison("!=", lv, rv, tl, tr, return_bool=True))
     for k in range(len(tl)):
         for t in range(lv.shape[1]):
             x, y = float(lv[tl[k], t]), float(rv[tr[k], t])
-            if math.isnan(x) or math.isnan(y):
-                assert math.isnan(got[k, t]) and math.isnan(gotb[k, t])
-            elif x > y:
+            # BOOL mode uses plain IEEE comparisons like the Go reference:
+            # NaN > y is 0, NaN != y is 1
+            assert gotb[k, t] == (1.0 if x > y else 0.0)
+            assert gotne[k, t] == (1.0 if x != y else 0.0)
+            if x > y:
                 assert got[k, t] == pytest.approx(x)
-                assert gotb[k, t] == 1.0
             else:
                 assert math.isnan(got[k, t])
-                assert gotb[k, t] == 0.0
 
 
 def test_logical_ops(sides):
@@ -106,9 +107,15 @@ def test_logical_ops(sides):
     assert math.isnan(andv[1, 3])  # rhs NaN blanks lhs
     assert andv[0, 0] == pytest.approx(lv[1, 0])
 
-    orv, or_m = B.logical_or(lv, rv, l_metas, r_metas, m)
+    lv_gap = lv.copy()
+    lv_gap[1, 5] = np.nan  # (a,2) matched by rhs[0]: or fills the gap
+    orv, or_m = B.logical_or(lv_gap, rv, l_metas, r_metas, m)
     assert len(or_m) == 4  # 3 lhs + rhs (c,9)
-    np.testing.assert_array_equal(np.asarray(orv)[:3], lv)
+    orv = np.asarray(orv)
+    assert orv[1, 5] == pytest.approx(rv[0, 5])  # or.go:88-95 gap fill
+    assert math.isnan(orv[0, 2])  # unmatched lhs gap stays NaN
+    mask = ~np.isnan(lv_gap)
+    np.testing.assert_array_equal(orv[:3][mask], lv_gap[mask])
 
     unv, un_m = B.logical_unless(lv, rv, l_metas, r_metas, m)
     unv = np.asarray(unv)

@@ -1,0 +1,95 @@
+"""Chunked (side-table) device decode parity vs the CPU ReaderIterator."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.codec.m3tsz import Encoder, decode, encode_series
+from m3_tpu.ops.chunked import build_chunked, decode_chunked
+from m3_tpu.ops.decode import finalize_decode
+from m3_tpu.utils.xtime import Unit
+
+START = 1_600_000_000 * 10**9
+
+
+def check_parity(streams, k, int_optimized=True):
+    batch = build_chunked(streams, k=k, int_optimized=int_optimized)
+    res = decode_chunked(batch, int_optimized=int_optimized)
+    ts, vals, valid = finalize_decode(res)
+    for i, s in enumerate(streams):
+        want = decode(s, int_optimized=int_optimized)
+        got_ts = ts[i][valid[i]]
+        got_vals = vals[i][valid[i]]
+        assert len(got_ts) == len(want), (i, len(got_ts), len(want))
+        for j, dp in enumerate(want):
+            assert got_ts[j] == dp.timestamp, (i, j)
+            assert got_vals[j] == dp.value or (
+                np.isnan(got_vals[j]) and np.isnan(dp.value)
+            ), (i, j, got_vals[j], dp.value)
+    return res
+
+
+@pytest.mark.parametrize("k", [4, 8, 32])
+def test_gauge_roundtrip(k):
+    rng = np.random.default_rng(0)
+    streams = []
+    for i in range(5):
+        n = int(rng.integers(1, 100))
+        ts = START + np.cumsum(rng.integers(1, 20, n)) * 10**9
+        vals = np.round(rng.normal(50, 10, n), 2)
+        streams.append(encode_series(ts.tolist(), vals.tolist()))
+    check_parity(streams, k)
+
+
+def test_float_mode_and_unit_changes():
+    rng = np.random.default_rng(1)
+    streams = []
+    # full-precision floats (XOR path)
+    n = 70
+    ts = START + np.cumsum(rng.integers(1, 5, n)) * 10**9
+    streams.append(encode_series(ts.tolist(), rng.normal(0, 1, n).tolist()))
+    # mid-stream time unit changes
+    enc = Encoder(START)
+    t = START
+    for j in range(50):
+        unit = Unit.SECOND if (j // 7) % 2 == 0 else Unit.MILLISECOND
+        step = 10**9 if unit == Unit.SECOND else 250_000_000
+        t += step
+        enc.encode(t, float(j % 13), unit=unit)
+    streams.append(enc.stream())
+    # mixed int->float->int transitions
+    enc = Encoder(START)
+    t = START
+    vals = [1.0, 2.0, 2.0, 0.1234567890123, 4.0, 5.5, 5.5, 1e300, 7.0]
+    for j, v in enumerate(vals * 6):
+        t += 10**9
+        enc.encode(t, v)
+    streams.append(enc.stream())
+    check_parity(streams, 8)
+
+
+def test_non_int_optimized():
+    rng = np.random.default_rng(2)
+    n = 40
+    ts = START + np.cumsum(rng.integers(1, 5, n)) * 10**9
+    streams = [
+        encode_series(ts.tolist(), rng.normal(0, 1, n).tolist(), int_optimized=False)
+    ]
+    check_parity(streams, 8, int_optimized=False)
+
+
+def test_empty_and_short_streams():
+    streams = [
+        b"",
+        encode_series([START], [42.0]),
+        encode_series([START, START + 10**9], [1.5, 1.5]),
+    ]
+    check_parity(streams, 8)
+
+
+def test_ragged_lengths():
+    rng = np.random.default_rng(3)
+    streams = []
+    for n in [1, 7, 33, 64, 65, 127]:
+        ts = START + np.cumsum(rng.integers(1, 9, n)) * 10**9
+        streams.append(encode_series(ts.tolist(), np.round(rng.normal(0, 5, n), 1).tolist()))
+    check_parity(streams, 32)
