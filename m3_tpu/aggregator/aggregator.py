@@ -1,0 +1,249 @@
+"""Streaming aggregator: sharded ingest → windowed batch rollups → flush.
+
+Reference: /root/reference/src/aggregator/aggregator/ — `aggregator.Aggregator`
+(aggregator.go:66+ AddUntimed/AddTimed/AddForwarded), murmur3 shard routing
+(:354 shardFor), per-(metric, policy) timed windows (generic_elem.go), and the
+leader flush manager draining windows on resolution boundaries
+(leader_flush_mgr.go:70).
+
+TPU-native inversion: instead of per-metric accumulator objects updated one
+value at a time, each shard buffers (id, time, value) columns per storage
+policy and a flush drains whole windows through the segment kernels
+(kernels.py) in one device call. Entry bookkeeping (id interning) is host-side
+dict work, exactly the role the reference's entry.go hashmap plays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..metrics.policy import StoragePolicy
+from ..metrics.types import AggregationType, MetricType, Untimed
+from ..utils.hash import shard_for
+from .kernels import aggregate_segments, segment_quantiles, window_keys
+
+
+@dataclass
+class AggregatedMetric:
+    """One flushed datapoint (metric/aggregated/types.go Metric)."""
+
+    id: bytes
+    time_nanos: int  # window END, like elems flush (generic_elem.go timestamps)
+    value: float
+    policy: StoragePolicy
+    agg_type: AggregationType
+
+    @property
+    def suffixed_id(self) -> bytes:
+        """id + '.' + type string (types_options.go suffix scheme)."""
+        return self.id + b"." + self.agg_type.type_string.encode()
+
+
+@dataclass
+class _PolicyBuffer:
+    """Pending raw values for one storage policy within one shard."""
+
+    ids: list[int] = field(default_factory=list)
+    times: list[int] = field(default_factory=list)
+    values: list[float] = field(default_factory=list)
+    types: list[int] = field(default_factory=list)
+
+
+class _Shard:
+    """aggregatorShard (shard.go): owns interned metric entries + buffers."""
+
+    def __init__(self) -> None:
+        self.id_index: dict[bytes, int] = {}
+        self.ids: list[bytes] = []
+        self.metric_types: list[MetricType] = []
+        self.agg_overrides: dict[int, tuple[AggregationType, ...]] = {}
+        self.buffers: dict[StoragePolicy, _PolicyBuffer] = {}
+
+    def intern(self, mid: bytes, mtype: MetricType) -> int:
+        idx = self.id_index.get(mid)
+        if idx is None:
+            idx = len(self.ids)
+            self.id_index[mid] = idx
+            self.ids.append(mid)
+            self.metric_types.append(mtype)
+        return idx
+
+    def add(
+        self,
+        mid: bytes,
+        mtype: MetricType,
+        time_nanos: int,
+        values,
+        policies,
+        aggregations: tuple[AggregationType, ...] | None = None,
+    ) -> None:
+        idx = self.intern(mid, mtype)
+        if aggregations:
+            self.agg_overrides[idx] = aggregations
+        if not isinstance(values, (list, tuple)):
+            values = [values]
+        for policy in policies:
+            buf = self.buffers.setdefault(policy, _PolicyBuffer())
+            for v in values:
+                buf.ids.append(idx)
+                buf.times.append(time_nanos)
+                buf.values.append(float(v))
+                buf.types.append(int(mtype))
+
+
+class Aggregator:
+    """AddUntimed/AddTimed + flush (aggregator.go:181-267).
+
+    ``flush_handler`` receives list[AggregatedMetric] — the seam where the
+    reference hands results to m3msg producers (aggregator/handler/)."""
+
+    def __init__(
+        self,
+        num_shards: int = 16,
+        default_policies: tuple[StoragePolicy, ...] = (),
+        flush_handler: Callable[[list[AggregatedMetric]], None] | None = None,
+    ) -> None:
+        self.num_shards = num_shards
+        self.shards = [_Shard() for _ in range(num_shards)]
+        self.default_policies = default_policies or (StoragePolicy.parse("10s:2d"),)
+        self.flush_handler = flush_handler
+        # warm standby: follower shards mirror adds but skip flush output
+        self.is_leader = True
+
+    def shard_for(self, mid: bytes) -> int:
+        return shard_for(mid, self.num_shards)
+
+    # --- ingest (AddUntimed aggregator.go:181, AddTimed :219) ---
+
+    def add_untimed(
+        self,
+        metric: Untimed,
+        time_nanos: int,
+        policies: tuple[StoragePolicy, ...] | None = None,
+        aggregations: tuple[AggregationType, ...] | None = None,
+    ) -> None:
+        shard = self.shards[self.shard_for(metric.id)]
+        if metric.type == MetricType.COUNTER:
+            values = [float(metric.counter_value)]
+        elif metric.type == MetricType.TIMER:
+            values = list(metric.batch_timer_values)
+        else:
+            values = [metric.gauge_value]
+        shard.add(
+            metric.id,
+            metric.type,
+            time_nanos,
+            values,
+            policies or self.default_policies,
+            aggregations,
+        )
+
+    def add_timed(
+        self,
+        mid: bytes,
+        mtype: MetricType,
+        time_nanos: int,
+        value: float,
+        policies: tuple[StoragePolicy, ...] | None = None,
+        aggregations: tuple[AggregationType, ...] | None = None,
+    ) -> None:
+        self.shards[self.shard_for(mid)].add(
+            mid, mtype, time_nanos, [value], policies or self.default_policies, aggregations
+        )
+
+    # AddForwarded: multi-stage rollup input — same buffer path, the pipeline
+    # stage lives in rules (forwarded_writer.go equivalence).
+    add_forwarded = add_timed
+
+    # --- flush (leader_flush_mgr.go drains windows per resolution) ---
+
+    def flush(self, up_to_nanos: int) -> list[AggregatedMetric]:
+        out: list[AggregatedMetric] = []
+        for shard in self.shards:
+            for policy, buf in shard.buffers.items():
+                if not buf.ids:
+                    continue
+                res = policy.resolution.window_nanos
+                boundary = (up_to_nanos // res) * res
+                times = np.asarray(buf.times, np.int64)
+                flushable = times < boundary
+                if not flushable.any():
+                    continue
+                keep = ~flushable
+                ids = np.asarray(buf.ids, np.int32)[flushable]
+                vals = np.asarray(buf.values, np.float32)[flushable]
+                ts = times[flushable]
+                types = np.asarray(buf.types, np.int32)[flushable]
+                # retain unflushed tail
+                buf.ids = list(np.asarray(buf.ids, np.int32)[keep])
+                buf.times = list(times[keep])
+                buf.values = list(np.asarray(buf.values, np.float32)[keep])
+                buf.types = list(np.asarray(buf.types, np.int32)[keep])
+                if self.is_leader:
+                    out.extend(
+                        self._flush_policy(shard, policy, ids, ts, vals, types, res)
+                    )
+        if self.flush_handler and out:
+            self.flush_handler(out)
+        return out
+
+    def _flush_policy(self, shard, policy, ids, ts, vals, types, res) -> list[AggregatedMetric]:
+        w0 = int(ts.min() // res) * res
+        n_windows = int(ts.max() // res) - int(w0 // res) + 1
+        n_metrics = len(shard.ids)
+        keys, widx, torder = window_keys(ids, ts, w0, res, n_windows)
+        n_groups = n_metrics * n_windows
+        agg = aggregate_segments(keys, vals, torder, n_groups)
+
+        # quantiles only for groups containing timer values
+        need_q = sorted(
+            {
+                q
+                for i in range(n_metrics)
+                for t in (
+                    shard.agg_overrides.get(i) or shard.metric_types[i].default_aggregations()
+                )
+                for q in [t.quantile()]
+                if q is not None
+            }
+        )
+        quantiles = {}
+        if need_q:
+            qvals = np.asarray(segment_quantiles(keys, vals, n_groups, tuple(need_q)))
+            quantiles = {q: qvals[i] for i, q in enumerate(need_q)}
+
+        count = np.asarray(agg.count)
+        host = {
+            AggregationType.LAST: np.asarray(agg.last),
+            AggregationType.MIN: np.asarray(agg.min),
+            AggregationType.MAX: np.asarray(agg.max),
+            AggregationType.MEAN: np.asarray(agg.mean),
+            AggregationType.COUNT: count,
+            AggregationType.SUM: np.asarray(agg.sum),
+            AggregationType.SUMSQ: np.asarray(agg.sum_sq),
+            AggregationType.STDEV: np.asarray(agg.stdev),
+        }
+        out = []
+        present = np.unique(keys)
+        for g in present:
+            midx, wi = divmod(int(g), n_windows)
+            window_end = w0 + (wi + 1) * res
+            aggs = shard.agg_overrides.get(midx) or shard.metric_types[
+                midx
+            ].default_aggregations()
+            for atype in aggs:
+                q = atype.quantile()
+                v = quantiles[q][g] if q is not None else host[atype][g]
+                out.append(
+                    AggregatedMetric(
+                        id=shard.ids[midx],
+                        time_nanos=window_end,
+                        value=float(v),
+                        policy=policy,
+                        agg_type=atype,
+                    )
+                )
+        return out

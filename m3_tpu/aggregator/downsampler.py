@@ -1,0 +1,109 @@
+"""In-process downsampler: rule match → aggregation → pipeline → flush.
+
+Reference: /root/reference/src/cmd/services/m3coordinator/downsample/ — the
+coordinator embeds an aggregator (`NewDownsampler` options.go:547); incoming
+writes pass through metrics_appender.go (rule match, rollup id construction)
+into aggregation elems, and flushed values go to storage via flush_handler.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..block.core import Tags
+from ..metrics.policy import StoragePolicy
+from ..metrics.transformation import APPLY
+from ..metrics.types import AggregationType, MetricType
+from ..rules.rules import ActiveRuleSet, RuleSet, encode_tags_id
+from .aggregator import AggregatedMetric, Aggregator
+
+
+@dataclass
+class Downsampler:
+    """downsamplerAndWriter's downsample half (ingest/write.go:138)."""
+
+    ruleset: RuleSet
+    aggregator: Aggregator = field(default_factory=Aggregator)
+    # storage sink for flushed aggregated metrics (flush_handler.go)
+    sink: Callable[[list[AggregatedMetric]], None] | None = None
+    auto_mapping_policies: tuple[StoragePolicy, ...] = ()
+    # rollup pipelines keyed by flushed metric identity
+    _pipelines: dict[bytes, tuple] = field(default_factory=dict)
+    _carry: dict[tuple, tuple] = field(default_factory=dict)
+
+    def write(
+        self,
+        tags: Tags,
+        time_nanos: int,
+        value: float,
+        mtype: MetricType = MetricType.GAUGE,
+    ) -> bool:
+        """Returns False when a drop policy matched (metric not persisted
+        unaggregated — ingest/write.go shouldWrite)."""
+        active: ActiveRuleSet = self.ruleset.active_at(time_nanos)
+        m = active.forward_match(tags)
+        mid = encode_tags_id(tags)
+
+        policies = m.policies or self.auto_mapping_policies
+        if policies:
+            self.aggregator.add_timed(
+                mid, mtype, time_nanos, value, policies=policies, aggregations=m.aggregations or None
+            )
+        for rtags, target in m.rollups:
+            rid = encode_tags_id(rtags)
+            self._pipelines[rid] = target.pipeline
+            self.aggregator.add_timed(
+                rid,
+                MetricType.GAUGE if mtype == MetricType.GAUGE else MetricType.COUNTER,
+                time_nanos,
+                value,
+                policies=target.policies or policies or self.aggregator.default_policies,
+                aggregations=target.aggregations or None,
+            )
+        return not m.drop
+
+    def flush(self, up_to_nanos: int) -> list[AggregatedMetric]:
+        flushed = self.aggregator.flush(up_to_nanos)
+        out = []
+        # apply rollup pipelines across consecutive flush windows, carrying
+        # the previous datapoint across flush() calls (forwarded_writer.go
+        # keeps equivalent per-elem state)
+        by_key: dict[tuple, list[AggregatedMetric]] = {}
+        for m in flushed:
+            pipeline = self._pipelines.get(m.id, ())
+            if not pipeline:
+                out.append(m)
+            else:
+                by_key.setdefault((m.id, m.policy, m.agg_type), []).append(m)
+        for key, ms in by_key.items():
+            ms.sort(key=lambda m: m.time_nanos)
+            pipeline = self._pipelines[key[0]]
+            times = np.asarray([m.time_nanos for m in ms], np.int64)
+            values = np.asarray([m.value for m in ms], np.float64)
+            carry = self._carry.get(key)
+            if carry is not None:
+                times = np.concatenate([[carry[0]], times])
+                values = np.concatenate([[carry[1]], values])
+            t, v = times, values
+            for op in pipeline:
+                t, v = APPLY[int(op)](t, v)
+            self._carry[key] = (int(times[-1]), float(values[-1]))
+            start = 1 if carry is not None else 0
+            for i in range(start, len(ms) + start):
+                if not np.isnan(v[i]):
+                    m = ms[i - start]
+                    out.append(
+                        AggregatedMetric(
+                            id=m.id,
+                            time_nanos=int(t[i]),
+                            value=float(v[i]),
+                            policy=m.policy,
+                            agg_type=m.agg_type,
+                        )
+                    )
+        if self.sink and out:
+            self.sink(out)
+        return out

