@@ -94,64 +94,63 @@ class ChunkedBatch:
         return self.windows.shape[0]
 
 
-def build_chunked(
-    streams: list[bytes],
-    k: int = 32,
+def snapshot_stream(
+    data: bytes,
+    k: int,
     int_optimized: bool = DEFAULT_INT_OPTIMIZATION,
     default_unit: Unit = Unit.SECOND,
-    min_window_words: int = 0,
+) -> list[dict]:
+    """Host prescan of one stream: decoder-state snapshot every ``k`` records.
+
+    This is the side table our fileset format persists next to each stream
+    (persisted by storage/fs.py); the encoder path can also emit it directly
+    at flush time since it walks the stream anyway."""
+    it = ReaderIterator(data, int_optimized=int_optimized, default_unit=default_unit)
+    per: list[dict] = []
+    nrec = 0
+    total_bits = len(data) * 8
+
+    def snap():
+        st = it.stream
+        ts = it.ts_iterator
+        unit = ts.time_unit
+        if nrec == 0 and len(data) >= 8:
+            nt = int.from_bytes(data[:8], "big")
+            unit = initial_time_unit(nt, default_unit)
+        return dict(
+            off=st.byte_pos * 8 + st.bit_pos,
+            prev_time=ts.prev_time & 0xFFFFFFFFFFFFFFFF,
+            prev_delta=ts.prev_time_delta & 0xFFFFFFFFFFFFFFFF,
+            time_unit=int(unit),
+            prev_float_bits=it.float_iter.prev_float_bits,
+            prev_xor=it.float_iter.prev_xor,
+            int_val=int(it.int_val) & 0xFFFFFFFFFFFFFFFF,
+            sig=it.sig,
+            mult=it.mult,
+            is_float=it.is_float,
+        )
+
+    while True:
+        pending = snap() if nrec % k == 0 else None
+        if not it.next():
+            # no record followed: don't emit an empty trailing chunk
+            break
+        if pending is not None:
+            per.append(pending)
+        nrec += 1
+        if it.ts_iterator.done or it.err is not None:
+            break
+    offs = [p["off"] for p in per] + [total_bits]
+    for i, p in enumerate(per):
+        p["span"] = offs[i + 1] - offs[i]
+        p["total_bits"] = total_bits
+    return per
+
+
+def assemble_chunked(
+    streams: list[bytes], snaps: list[list[dict]], k: int, min_window_words: int = 0
 ) -> ChunkedBatch:
-    """Host prescan: walk each stream with the CPU iterator, snapshotting
-    decoder state every ``k`` records. The encoder path calls this on its own
-    in-memory streams at flush time (the side table is part of our fileset
-    format, not the reference's)."""
-    snaps = []  # list of per-series list of snapshot dicts
-    spans = []  # bit spans per chunk
-    for data in streams:
-        it = ReaderIterator(data, int_optimized=int_optimized, default_unit=default_unit)
-        per = []
-        nrec = 0
-        total_bits = len(data) * 8
-
-        def snap():
-            st = it.stream
-            ts = it.ts_iterator
-            unit = ts.time_unit
-            if nrec == 0 and len(data) >= 8:
-                nt = int.from_bytes(data[:8], "big")
-                unit = initial_time_unit(nt, default_unit)
-            return dict(
-                off=st.byte_pos * 8 + st.bit_pos,
-                prev_time=ts.prev_time & 0xFFFFFFFFFFFFFFFF,
-                prev_delta=ts.prev_time_delta & 0xFFFFFFFFFFFFFFFF,
-                time_unit=int(unit),
-                prev_float_bits=it.float_iter.prev_float_bits,
-                prev_xor=it.float_iter.prev_xor,
-                int_val=int(it.int_val) & 0xFFFFFFFFFFFFFFFF,
-                sig=it.sig,
-                mult=it.mult,
-                is_float=it.is_float,
-                nrec_before=nrec,
-            )
-
-        while True:
-            pending = snap() if nrec % k == 0 else None
-            if not it.next():
-                # no record followed: don't emit an empty trailing chunk
-                break
-            if pending is not None:
-                per.append(pending)
-            nrec += 1
-            if it.ts_iterator.done or it.err is not None:
-                break
-        # chunk spans: start offsets + stream end
-        offs = [p["off"] for p in per] + [total_bits]
-        spans.append([offs[i + 1] - offs[i] for i in range(len(per))])
-        for p, spn in zip(per, spans[-1]):
-            p["span"] = spn
-            p["total_bits"] = total_bits
-        snaps.append(per)
-
+    """Pack streams + per-chunk snapshots into the dense lane arrays."""
     s = len(streams)
     c = max((len(p) for p in snaps), default=1)
     c = max(c, 1)
@@ -177,9 +176,11 @@ def build_chunked(
     isf = np.zeros(n, bool)
 
     for si, (data, per) in enumerate(zip(streams, snaps)):
-        padded = np.frombuffer(
-            data + b"\x00" * (-len(data) % 4), dtype=">u4"
-        ).astype(np.uint32) if data else np.zeros(0, np.uint32)
+        padded = (
+            np.frombuffer(data + b"\x00" * (-len(data) % 4), dtype=">u4").astype(np.uint32)
+            if data
+            else np.zeros(0, np.uint32)
+        )
         for ci, p in enumerate(per):
             i = si * c + ci
             w0 = p["off"] >> 5
@@ -216,6 +217,21 @@ def build_chunked(
         num_series=s,
         num_chunks=c,
     )
+
+
+def build_chunked(
+    streams: list[bytes],
+    k: int = 32,
+    int_optimized: bool = DEFAULT_INT_OPTIMIZATION,
+    default_unit: Unit = Unit.SECOND,
+    min_window_words: int = 0,
+) -> ChunkedBatch:
+    """Prescan + assemble (see snapshot_stream / assemble_chunked)."""
+    snaps = [
+        snapshot_stream(d, k, int_optimized=int_optimized, default_unit=default_unit)
+        for d in streams
+    ]
+    return assemble_chunked(streams, snaps, k, min_window_words=min_window_words)
 
 
 def tile_chunked(batch: ChunkedBatch, n_series: int) -> ChunkedBatch:

@@ -1,0 +1,236 @@
+"""Database → namespaces → shards → series: write/read routing + lifecycle.
+
+Reference: /root/reference/src/dbnode/storage/ — storage.Database
+(database.go: Write :573, ReadEncoded :842, Bootstrap :925, AssignShardSet
+:386), dbNamespace (namespace.go, per-namespace retention/blockSize), dbShard
+(shard.go: writeAndIndex :869, ReadEncoded :1060, Tick :663, WarmFlush :2146),
+bootstrap chain (bootstrap/process.go:147: filesystem → commitlog → peers →
+uninitialized).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..codec.m3tsz import Datapoint, decode
+from ..utils.hash import shard_for
+from ..utils.xtime import Unit
+from .commitlog import CommitLog, CommitLogEntry
+from .fs import CHUNK_K, FilesetID, FilesetReader, list_filesets, write_fileset
+from .series import NANOS, SeriesBuffer
+
+
+@dataclass
+class NamespaceOptions:
+    """namespace metadata (src/dbnode/namespace/options.go)."""
+
+    retention_nanos: int = 2 * 24 * 3600 * NANOS
+    block_size_nanos: int = 2 * 3600 * NANOS
+    index_enabled: bool = True
+    cold_writes_enabled: bool = True
+
+
+class Shard:
+    """dbShard: series map for one virtual shard."""
+
+    def __init__(self, shard_id: int, ns: str, opts: NamespaceOptions, base: str) -> None:
+        self.id = shard_id
+        self.namespace = ns
+        self.opts = opts
+        self.base = base
+        self.series: dict[bytes, SeriesBuffer] = {}
+        self._flushed_blocks: set[int] = set()
+
+    def write(self, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> None:
+        buf = self.series.get(sid)
+        if buf is None:
+            buf = SeriesBuffer(sid, self.opts.block_size_nanos)
+            self.series[sid] = buf
+        buf.write(t_nanos, value, unit)
+
+    def read(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
+        out: list[Datapoint] = []
+        # flushed filesets first (older), then buffer (newer wins on dupes)
+        for fid in list_filesets(self.base, self.namespace, self.id):
+            if fid.block_start + self.opts.block_size_nanos <= start or fid.block_start >= end:
+                continue
+            reader = FilesetReader(self.base, fid)
+            stream = reader.stream(sid)
+            if stream:
+                out.extend(dp for dp in decode(stream) if start <= dp.timestamp < end)
+        buf = self.series.get(sid)
+        if buf is not None:
+            out.extend(buf.read(start, end))
+        dedup: dict[int, Datapoint] = {}
+        for dp in out:
+            dedup[dp.timestamp] = dp
+        return [dedup[t] for t in sorted(dedup)]
+
+    def warm_flush(self, flush_before_nanos: int) -> list[FilesetID]:
+        """shard.go:2146 — write filesets for complete blocks, then evict."""
+        blocks: dict[int, dict[bytes, bytes]] = {}
+        for sid, buf in self.series.items():
+            for bs, stream in buf.streams_before(flush_before_nanos).items():
+                if stream and bs not in self._flushed_blocks:
+                    blocks.setdefault(bs, {})[sid] = stream
+        flushed = []
+        for bs, series in sorted(blocks.items()):
+            fid = FilesetID(self.namespace, self.id, bs, volume=0)
+            write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
+            self._flushed_blocks.add(bs)
+            flushed.append(fid)
+        # evict only what this flush made durable — cold writes into
+        # previously-flushed blocks stay buffered for cold_flush
+        for buf in self.series.values():
+            for fid in flushed:
+                buf.evict_block(fid.block_start)
+        return flushed
+
+    def cold_flush(self, flush_before_nanos: int) -> list[FilesetID]:
+        """shard.go:2212 — out-of-order writes into already-flushed blocks go
+        out as a new volume merged with the existing fileset."""
+        flushed = []
+        for sid, buf in list(self.series.items()):
+            for bs, stream in buf.streams_before(flush_before_nanos).items():
+                if bs not in self._flushed_blocks or not stream:
+                    continue
+                existing = list_filesets(self.base, self.namespace, self.id)
+                prev = next((f for f in existing if f.block_start == bs), None)
+                series: dict[bytes, bytes] = {}
+                if prev is not None:
+                    reader = FilesetReader(self.base, prev)
+                    for other in reader.series_ids:
+                        series[other] = reader.stream(other) or b""
+                # merge this series' new points with any flushed ones
+                merged: dict[int, Datapoint] = {}
+                if sid in series:
+                    for dp in decode(series[sid]):
+                        merged[dp.timestamp] = dp
+                for dp in decode(stream):
+                    merged[dp.timestamp] = dp
+                from ..codec.m3tsz import Encoder
+
+                enc = Encoder(min(merged))
+                for t in sorted(merged):
+                    dp = merged[t]
+                    enc.encode(dp.timestamp, dp.value, unit=dp.unit)
+                series[sid] = enc.stream()
+                vol = (prev.volume + 1) if prev is not None else 0
+                fid = FilesetID(self.namespace, self.id, bs, volume=vol)
+                write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
+                flushed.append(fid)
+                buf.evict_block(bs)
+        return flushed
+
+    def tick(self, now_nanos: int) -> None:
+        """shard.go:663 tickAndExpire: drop series/blocks past retention."""
+        expire_before = now_nanos - self.opts.retention_nanos
+        for sid in list(self.series):
+            buf = self.series[sid]
+            buf.evict_before(expire_before)
+            if not buf.buckets:
+                del self.series[sid]
+
+
+class Namespace:
+    def __init__(self, name: str, opts: NamespaceOptions, num_shards: int, base: str) -> None:
+        self.name = name
+        self.opts = opts
+        self.num_shards = num_shards
+        self.shards = [Shard(i, name, opts, base) for i in range(num_shards)]
+
+    def shard_for(self, sid: bytes) -> Shard:
+        return self.shards[shard_for(sid, self.num_shards)]
+
+
+class Database:
+    """Top-level storage node object (database.go)."""
+
+    def __init__(self, base_dir: str, num_shards: int = 8, commitlog_enabled: bool = True) -> None:
+        self.base = base_dir
+        self.num_shards = num_shards
+        self.namespaces: dict[str, Namespace] = {}
+        self.commitlog_enabled = commitlog_enabled
+        self._commitlogs: dict[str, CommitLog] = {}
+        self.bootstrapped = False
+
+    def create_namespace(self, name: str, opts: NamespaceOptions | None = None) -> Namespace:
+        ns = Namespace(name, opts or NamespaceOptions(), self.num_shards, self.base)
+        self.namespaces[name] = ns
+        if self.commitlog_enabled:
+            self._commitlogs[name] = CommitLog(self._commitlog_path(name))
+        return ns
+
+    def _commitlog_path(self, ns: str) -> str:
+        return os.path.join(self.base, "commitlogs", f"{ns}.wal")
+
+    def write(
+        self, ns: str, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND
+    ) -> None:
+        namespace = self.namespaces[ns]
+        cl = self._commitlogs.get(ns)
+        if cl is not None:
+            cl.write(CommitLogEntry(sid, t_nanos, value, unit))
+        namespace.shard_for(sid).write(sid, t_nanos, value, unit)
+
+    def write_batch(self, ns: str, entries: list[tuple[bytes, int, float]]) -> None:
+        namespace = self.namespaces[ns]
+        cl = self._commitlogs.get(ns)
+        if cl is not None:
+            cl.write_batch(
+                [CommitLogEntry(sid, t, v) for sid, t, v in entries]
+            )
+        for sid, t, v in entries:
+            namespace.shard_for(sid).write(sid, t, v)
+
+    def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
+        return self.namespaces[ns].shard_for(sid).read(sid, start, end)
+
+    def flush(self, ns: str, flush_before_nanos: int) -> list[FilesetID]:
+        out = []
+        for shard in self.namespaces[ns].shards:
+            out.extend(shard.warm_flush(flush_before_nanos))
+            if self.namespaces[ns].opts.cold_writes_enabled:
+                out.extend(shard.cold_flush(flush_before_nanos))
+        # flushed data is durable: rotate the WAL (snapshot+truncate role)
+        cl = self._commitlogs.get(ns)
+        if cl is not None:
+            old = cl.rotate(self._commitlog_path(ns) + ".new")
+            os.replace(cl.path, old)
+            cl.path = old
+        return out
+
+    def tick(self, now_nanos: int) -> None:
+        for ns in self.namespaces.values():
+            for shard in ns.shards:
+                shard.tick(now_nanos)
+
+    # --- bootstrap chain (bootstrap/process.go:147) ---
+
+    def bootstrap(self) -> dict:
+        """filesystem → commitlog → (peers, uninitialized) — the fs source is
+        implicit (filesets are read lazily at query time once complete); the
+        commitlog source replays WAL entries into buffers."""
+        result = {"commitlog_entries": 0, "filesets": 0}
+        for name, ns in self.namespaces.items():
+            for shard in ns.shards:
+                fids = list_filesets(self.base, name, shard.id)
+                result["filesets"] += len(fids)
+                for fid in fids:
+                    shard._flushed_blocks.add(fid.block_start)
+            entries = CommitLog.replay(self._commitlog_path(name))
+            for e in entries:
+                sh = ns.shard_for(e.series_id)
+                # skip points already covered by a complete flushed block
+                bs = (e.time_nanos // ns.opts.block_size_nanos) * ns.opts.block_size_nanos
+                if bs in sh._flushed_blocks:
+                    continue
+                sh.write(e.series_id, e.time_nanos, e.value, e.unit)
+            result["commitlog_entries"] += len(entries)
+        self.bootstrapped = True
+        return result
+
+    def close(self) -> None:
+        for cl in self._commitlogs.values():
+            cl.close()

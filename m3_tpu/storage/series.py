@@ -1,0 +1,109 @@
+"""Per-series in-memory buffer: block-windowed encoders with warm/cold writes.
+
+Reference: /root/reference/src/dbnode/storage/series/ — dbSeries.Write
+(series.go:289) routes datapoints into dbBuffer buckets per block window
+(buffer.go:250); the warm/cold decision (:268-313) classifies writes inside
+the buffer-past/buffer-future window as warm, everything else as cold
+(out-of-order, flushed separately). Tick merges bucket encoders
+(buffer.go:413-478).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..codec.m3tsz import Datapoint, Encoder, decode
+from ..utils.xtime import Unit
+
+NANOS = 1_000_000_000
+
+
+@dataclass
+class BufferBucket:
+    """One encoder per (block window, warm/cold version) — buffer.go buckets."""
+
+    block_start: int
+    encoder: Encoder | None = None
+    # raw out-of-order points kept aside until merge (cold writes land here)
+    pending: list[tuple[int, float, Unit]] = field(default_factory=list)
+    last_write_nanos: int = -1
+    num_writes: int = 0
+
+    def write(self, t_nanos: int, value: float, unit: Unit) -> None:
+        if self.encoder is not None and t_nanos > self.last_write_nanos:
+            self.encoder.encode(t_nanos, value, unit=unit)
+        else:
+            if self.encoder is None and t_nanos > self.last_write_nanos:
+                self.encoder = Encoder(t_nanos)
+                self.encoder.encode(t_nanos, value, unit=unit)
+            else:
+                self.pending.append((t_nanos, value, unit))
+        self.last_write_nanos = max(self.last_write_nanos, t_nanos)
+        self.num_writes += 1
+
+    def merged_stream(self) -> bytes:
+        """Merge in-order encoder + pending out-of-order points into one
+        canonical stream (the reference's bucket merge, buffer.go:413-478)."""
+        points: list[Datapoint] = []
+        if self.encoder is not None:
+            points.extend(decode(self.encoder.stream()))
+        for t, v, u in self.pending:
+            points.append(Datapoint(timestamp=t, value=v, unit=u))
+        if not points:
+            return b""
+        # sort by time; later write wins on duplicate timestamps
+        dedup: dict[int, Datapoint] = {}
+        for dp in points:
+            dedup[dp.timestamp] = dp
+        enc = Encoder(min(dedup))
+        for t in sorted(dedup):
+            dp = dedup[t]
+            enc.encode(dp.timestamp, dp.value, unit=dp.unit)
+        return enc.stream()
+
+
+class SeriesBuffer:
+    """dbSeries + dbBuffer: buckets keyed by block start."""
+
+    def __init__(self, series_id: bytes, block_size_nanos: int) -> None:
+        self.id = series_id
+        self.block_size = block_size_nanos
+        self.buckets: dict[int, BufferBucket] = {}
+
+    def block_start(self, t_nanos: int) -> int:
+        return (t_nanos // self.block_size) * self.block_size
+
+    def write(self, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> None:
+        bs = self.block_start(t_nanos)
+        bucket = self.buckets.get(bs)
+        if bucket is None:
+            bucket = BufferBucket(block_start=bs)
+            self.buckets[bs] = bucket
+        bucket.write(t_nanos, value, unit)
+
+    def read(self, start_nanos: int, end_nanos: int) -> list[Datapoint]:
+        out: list[Datapoint] = []
+        for bs in sorted(self.buckets):
+            if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                continue
+            stream = self.buckets[bs].merged_stream()
+            for dp in decode(stream):
+                if start_nanos <= dp.timestamp < end_nanos:
+                    out.append(dp)
+        return out
+
+    def streams_before(self, flush_before_nanos: int) -> dict[int, bytes]:
+        """Canonical merged streams for blocks entirely before the cutoff
+        (WarmFlush input, shard.go:2146)."""
+        return {
+            bs: b.merged_stream()
+            for bs, b in self.buckets.items()
+            if bs + self.block_size <= flush_before_nanos
+        }
+
+    def evict_before(self, t_nanos: int) -> None:
+        for bs in [b for b in self.buckets if b + self.block_size <= t_nanos]:
+            del self.buckets[bs]
+
+    def evict_block(self, block_start: int) -> None:
+        self.buckets.pop(block_start, None)

@@ -1,0 +1,184 @@
+"""Storage node tests: buffer semantics, fileset checkpoint commit, WAL crash
+replay, cold-flush volumes, bootstrap, device decode from filesets.
+(Reference: src/dbnode/storage/, src/dbnode/persist/fs/.)"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from m3_tpu.codec.m3tsz import decode
+from m3_tpu.ops.chunked import decode_chunked
+from m3_tpu.ops.decode import finalize_decode
+from m3_tpu.storage.commitlog import CommitLog, CommitLogEntry
+from m3_tpu.storage.database import Database, NamespaceOptions
+from m3_tpu.storage.fs import FilesetID, FilesetReader, fileset_complete, list_filesets, write_fileset
+from m3_tpu.storage.series import SeriesBuffer
+from m3_tpu.utils.xtime import Unit
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+HOUR = 3600 * NANOS
+
+
+def test_series_buffer_in_order_and_cold():
+    buf = SeriesBuffer(b"s", 2 * HOUR)
+    buf.write(T0 + 10 * NANOS, 1.0)
+    buf.write(T0 + 20 * NANOS, 2.0)
+    buf.write(T0 + 5 * NANOS, 0.5)  # out of order -> pending
+    buf.write(T0 + 20 * NANOS, 3.0)  # duplicate ts -> last wins
+    got = buf.read(T0, T0 + HOUR)
+    assert [(dp.timestamp, dp.value) for dp in got] == [
+        (T0 + 5 * NANOS, 0.5),
+        (T0 + 10 * NANOS, 1.0),
+        (T0 + 20 * NANOS, 3.0),
+    ]
+
+
+def test_fileset_checkpoint_commit(tmp_path):
+    base = str(tmp_path)
+    fid = FilesetID("ns", 0, T0)
+    from m3_tpu.codec.m3tsz import encode_series
+
+    series = {
+        b"a": encode_series([T0 + i * NANOS for i in range(10)], [float(i) for i in range(10)]),
+        b"b": encode_series([T0 + i * NANOS for i in range(5)], [2.0 * i for i in range(5)]),
+    }
+    write_fileset(base, fid, series, 2 * HOUR)
+    assert fileset_complete(base, fid)
+    r = FilesetReader(base, fid)
+    assert sorted(r.series_ids) == [b"a", b"b"]
+    assert decode(r.stream(b"a"))[3].value == 3.0
+    assert r.stream(b"missing") is None
+
+    # corrupt the digest -> checkpoint no longer validates
+    digest_path = os.path.join(base, "data", "ns", "0", f"fileset-{T0}-0-digest.db")
+    with open(digest_path, "ab") as f:
+        f.write(b"x")
+    assert not fileset_complete(base, fid)
+    assert list_filesets(base, "ns", 0) == []
+
+
+def test_fileset_missing_checkpoint_invisible(tmp_path):
+    base = str(tmp_path)
+    fid = FilesetID("ns", 1, T0)
+    from m3_tpu.codec.m3tsz import encode_series
+
+    write_fileset(base, fid, {b"a": encode_series([T0], [1.0])}, 2 * HOUR)
+    os.remove(os.path.join(base, "data", "ns", "1", f"fileset-{T0}-0-checkpoint.db"))
+    assert list_filesets(base, "ns", 1) == []
+
+
+def test_fileset_device_decode(tmp_path):
+    """Side tables in the fileset let the device decode without prescan."""
+    base = str(tmp_path)
+    fid = FilesetID("ns", 0, T0)
+    from m3_tpu.codec.m3tsz import encode_series
+
+    rng = np.random.default_rng(4)
+    series = {}
+    for i in range(7):
+        n = int(rng.integers(3, 90))
+        ts = [T0 + int(t) * NANOS for t in np.cumsum(rng.integers(1, 9, n))]
+        series[f"s{i}".encode()] = encode_series(ts, np.round(rng.normal(0, 9, n), 2).tolist())
+    write_fileset(base, fid, series, 2 * HOUR)
+
+    r = FilesetReader(base, fid)
+    sids = r.series_ids
+    batch = r.chunked_batch(sids)
+    ts, vals, valid = finalize_decode(decode_chunked(batch))
+    for i, sid in enumerate(sids):
+        want = decode(series[sid])
+        got_t = ts[i][valid[i]]
+        got_v = vals[i][valid[i]]
+        assert len(got_t) == len(want)
+        assert all(got_t[j] == want[j].timestamp for j in range(len(want)))
+        assert all(got_v[j] == want[j].value for j in range(len(want)))
+
+
+def test_commitlog_replay_and_torn_tail(tmp_path):
+    path = str(tmp_path / "wal")
+    cl = CommitLog(path, flush_every=1)
+    entries = [
+        CommitLogEntry(b"a", T0 + i * NANOS, float(i), Unit.SECOND, b"" if i else b"ann")
+        for i in range(5)
+    ]
+    for e in entries:
+        cl.write(e)
+    cl.close()
+
+    got = CommitLog.replay(path)
+    assert len(got) == 5
+    assert got[0].annotation == b"ann"
+    assert got[4].value == 4.0
+
+    # torn tail: truncate mid-record
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 7)
+    got = CommitLog.replay(path)
+    assert len(got) == 4  # last record dropped cleanly
+
+
+def test_database_write_flush_read_bootstrap(tmp_path):
+    base = str(tmp_path)
+    opts = NamespaceOptions(block_size_nanos=2 * HOUR, retention_nanos=48 * HOUR)
+    db = Database(base, num_shards=4)
+    db.create_namespace("metrics", opts)
+
+    for i in range(100):
+        db.write("metrics", f"series.{i % 10}".encode(), T0 + i * 60 * NANOS, float(i))
+
+    # read from buffer
+    dps = db.read("metrics", b"series.3", T0, T0 + 3 * HOUR)
+    assert [dp.value for dp in dps] == [3.0, 13.0, 23.0, 33.0, 43.0, 53.0, 63.0, 73.0, 83.0, 93.0]
+
+    # flush the first complete block
+    flushed = db.flush("metrics", T0 + 2 * HOUR)
+    assert flushed
+    # reads merge fileset + buffer identically
+    dps2 = db.read("metrics", b"series.3", T0, T0 + 3 * HOUR)
+    assert [dp.value for dp in dps2] == [dp.value for dp in dps]
+
+    # crash: new Database over same dir, bootstrap replays WAL + sees filesets
+    db.close()
+    db2 = Database(base, num_shards=4)
+    db2.create_namespace("metrics", opts)
+    stats = db2.bootstrap()
+    assert stats["filesets"] >= 1
+    dps3 = db2.read("metrics", b"series.3", T0, T0 + 3 * HOUR)
+    assert [dp.value for dp in dps3] == [dp.value for dp in dps]
+    db2.close()
+
+
+def test_cold_writes_new_volume(tmp_path):
+    base = str(tmp_path)
+    opts = NamespaceOptions(block_size_nanos=2 * HOUR)
+    db = Database(base, num_shards=1, commitlog_enabled=False)
+    db.create_namespace("ns", opts)
+
+    db.write("ns", b"s", T0 + 10 * NANOS, 1.0)
+    db.write("ns", b"s", T0 + 20 * NANOS, 2.0)
+    db.flush("ns", T0 + 2 * HOUR)
+
+    # cold write into the already-flushed block
+    db.write("ns", b"s", T0 + 15 * NANOS, 1.5)
+    db.flush("ns", T0 + 2 * HOUR)
+
+    fids = list_filesets(base, "ns", 0)
+    assert len(fids) == 1 and fids[0].volume == 1  # new volume wins
+    dps = db.read("ns", b"s", T0, T0 + HOUR)
+    assert [dp.value for dp in dps] == [1.0, 1.5, 2.0]
+
+
+def test_tick_expires_retention(tmp_path):
+    opts = NamespaceOptions(block_size_nanos=HOUR, retention_nanos=2 * HOUR)
+    db = Database(str(tmp_path), num_shards=1, commitlog_enabled=False)
+    db.create_namespace("ns", opts)
+    db.write("ns", b"old", T0, 1.0)
+    db.write("ns", b"new", T0 + 5 * HOUR, 2.0)
+    db.tick(T0 + 5 * HOUR)
+    shard = db.namespaces["ns"].shards[0]
+    assert b"old" not in shard.series
+    assert b"new" in shard.series
