@@ -1,0 +1,114 @@
+"""Namespace reverse index: per-block-start index blocks over segments.
+
+Reference: /root/reference/src/dbnode/storage/index.go — nsIndex.WriteBatch
+(:531) inserts into the active mutable segment of the write-time block,
+Query (:1182) unions matches across blocks overlapping the query range,
+AggregateQuery (:1218) returns tag names/values, WarmFlush (:868) seals
+mutable segments into immutable ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..block.core import Tags
+from .query import Query, execute
+from .segment import Document, MutableSegment, SealedSegment
+
+
+class IndexBlock:
+    def __init__(self, block_start: int) -> None:
+        self.block_start = block_start
+        self.mutable = MutableSegment()
+        self.sealed: list[SealedSegment] = []
+
+    @property
+    def segments(self):
+        return ([self.mutable] if len(self.mutable) else []) + self.sealed
+
+    def seal(self) -> None:
+        """WarmFlush: mutable → immutable segment (storage/index.go:868)."""
+        if len(self.mutable):
+            self.sealed.append(self.mutable.seal())
+            self.mutable = MutableSegment()
+
+
+@dataclass
+class QueryResult:
+    docs: list[Document]
+    exhaustive: bool = True
+
+
+class NamespaceIndex:
+    """nsIndex: block-partitioned reverse index."""
+
+    def __init__(self, block_size_nanos: int, retention_nanos: int | None = None) -> None:
+        self.block_size = block_size_nanos
+        self.retention = retention_nanos
+        self.blocks: dict[int, IndexBlock] = {}
+
+    def _block_for(self, t_nanos: int) -> IndexBlock:
+        bs = (t_nanos // self.block_size) * self.block_size
+        blk = self.blocks.get(bs)
+        if blk is None:
+            blk = IndexBlock(bs)
+            self.blocks[bs] = blk
+        return blk
+
+    def write(self, series_id: bytes, tags: Tags, t_nanos: int) -> None:
+        self._block_for(t_nanos).mutable.insert(Document(series_id, tags))
+
+    def write_batch(self, entries: list[tuple[bytes, Tags, int]]) -> None:
+        for sid, tags, t in entries:
+            self.write(sid, tags, t)
+
+    def query(
+        self, q: Query, start_nanos: int, end_nanos: int, limit: int | None = None
+    ) -> QueryResult:
+        """storage/index.go:1182 — union across overlapping blocks, dedupe."""
+        segs = []
+        for bs in sorted(self.blocks):
+            if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                continue
+            segs.extend(self.blocks[bs].segments)
+        docs = execute(segs, q, limit=limit)
+        exhaustive = limit is None or len(docs) < limit
+        return QueryResult(docs=docs, exhaustive=exhaustive)
+
+    def aggregate_query(
+        self,
+        q: Query | None,
+        start_nanos: int,
+        end_nanos: int,
+        field_filter: list[bytes] | None = None,
+    ) -> dict[bytes, set[bytes]]:
+        """AggregateQuery (:1218): tag names → value sets, optionally only for
+        docs matching q."""
+        out: dict[bytes, set[bytes]] = {}
+        if q is None:
+            for bs, blk in self.blocks.items():
+                if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                    continue
+                for seg in blk.segments:
+                    for name in seg.fields():
+                        if field_filter and name not in field_filter:
+                            continue
+                        out.setdefault(name, set()).update(seg.terms(name))
+            return out
+        for doc in self.query(q, start_nanos, end_nanos).docs:
+            for name, value in doc.fields:
+                if field_filter and name not in field_filter:
+                    continue
+                out.setdefault(name, set()).add(value)
+        return out
+
+    def seal_before(self, t_nanos: int) -> None:
+        for bs, blk in self.blocks.items():
+            if bs + self.block_size <= t_nanos:
+                blk.seal()
+
+    def evict_before(self, t_nanos: int) -> None:
+        for bs in [b for b in self.blocks if b + self.block_size <= t_nanos]:
+            del self.blocks[bs]

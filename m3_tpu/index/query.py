@@ -1,0 +1,146 @@
+"""Index query AST + executor.
+
+Reference: /root/reference/src/m3ninx/ — idx.Query builders (idx/), searchers
+(search/searcher/: term, regexp, conjunction, disjunction, negation, all,
+empty, field) and executor (search/executor/) iterating matches across
+segments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .segment import Document
+
+
+@dataclass(frozen=True)
+class Query:
+    pass
+
+
+@dataclass(frozen=True)
+class TermQuery(Query):
+    field: bytes
+    value: bytes
+
+
+@dataclass(frozen=True)
+class RegexpQuery(Query):
+    field: bytes
+    pattern: bytes
+
+
+@dataclass(frozen=True)
+class FieldQuery(Query):
+    """Matches docs that have the field at all (searcher/field.go)."""
+
+    field: bytes
+
+
+@dataclass(frozen=True)
+class AllQuery(Query):
+    pass
+
+
+@dataclass(frozen=True)
+class ConjunctionQuery(Query):
+    queries: tuple[Query, ...]
+
+
+@dataclass(frozen=True)
+class DisjunctionQuery(Query):
+    queries: tuple[Query, ...]
+
+
+@dataclass(frozen=True)
+class NegationQuery(Query):
+    query: Query
+
+
+def term(field: bytes, value: bytes) -> TermQuery:
+    return TermQuery(field, value)
+
+
+def regexp(field: bytes, pattern: bytes) -> RegexpQuery:
+    return RegexpQuery(field, pattern)
+
+
+def conj(*qs: Query) -> ConjunctionQuery:
+    return ConjunctionQuery(tuple(qs))
+
+
+def disj(*qs: Query) -> DisjunctionQuery:
+    return DisjunctionQuery(tuple(qs))
+
+
+def neg(q: Query) -> NegationQuery:
+    return NegationQuery(q)
+
+
+def search_segment(seg, query: Query) -> np.ndarray:
+    """Postings for one segment (search/searcher dispatch); sorted unique."""
+    if isinstance(query, TermQuery):
+        return np.asarray(seg.postings(query.field, query.value), np.int32)
+    if isinstance(query, RegexpQuery):
+        if hasattr(seg, "postings_regexp"):
+            return seg.postings_regexp(query.field, query.pattern)
+        import re
+
+        rx = re.compile(b"^(?:" + query.pattern + b")$")
+        out = [
+            np.asarray(seg.postings(query.field, t), np.int32)
+            for t in seg.terms(query.field)
+            if rx.match(t)
+        ]
+        return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int32)
+    if isinstance(query, FieldQuery):
+        out = [
+            np.asarray(seg.postings(query.field, t), np.int32)
+            for t in seg.terms(query.field)
+        ]
+        return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int32)
+    if isinstance(query, AllQuery):
+        return np.arange(len(seg), dtype=np.int32)
+    if isinstance(query, ConjunctionQuery):
+        if not query.queries:
+            return np.zeros(0, np.int32)
+        # negations subtract from the positive intersection (idx/query.go)
+        pos = [q for q in query.queries if not isinstance(q, NegationQuery)]
+        negs = [q for q in query.queries if isinstance(q, NegationQuery)]
+        if pos:
+            acc = search_segment(seg, pos[0])
+            for q in pos[1:]:
+                acc = np.intersect1d(acc, search_segment(seg, q), assume_unique=False)
+        else:
+            acc = np.arange(len(seg), dtype=np.int32)
+        for q in negs:
+            acc = np.setdiff1d(acc, search_segment(seg, q.query), assume_unique=False)
+        return acc.astype(np.int32)
+    if isinstance(query, DisjunctionQuery):
+        out = [search_segment(seg, q) for q in query.queries]
+        out = [o for o in out if len(o)]
+        return np.unique(np.concatenate(out)).astype(np.int32) if out else np.zeros(0, np.int32)
+    if isinstance(query, NegationQuery):
+        return np.setdiff1d(
+            np.arange(len(seg), dtype=np.int32), search_segment(seg, query.query)
+        ).astype(np.int32)
+    raise TypeError(f"unknown query {query!r}")
+
+
+def execute(segments, query: Query, limit: int | None = None) -> list[Document]:
+    """search/executor: iterate matched docs across segments (docs dedupe by
+    id — later segments don't re-emit ids already seen)."""
+    out: list[Document] = []
+    seen: set[bytes] = set()
+    for seg in segments:
+        for i in search_segment(seg, query):
+            doc = seg.docs[int(i)]
+            if doc.id in seen:
+                continue
+            seen.add(doc.id)
+            out.append(doc)
+            if limit is not None and len(out) >= limit:
+                return out
+    return out

@@ -1,0 +1,198 @@
+"""Inverted index segments: mutable ingest segment + sealed immutable segment.
+
+Reference: /root/reference/src/m3ninx/ — doc model (doc/), mutable segment
+(index/segment/mem: concurrent postings map field→term→roaring bitmap),
+immutable FST segment (index/segment/fst: fields FST → terms FST → postings
+bitsets, mmap'd), segment builder (index/segment/builder merges segments).
+
+TPU-native stance: postings are sorted int32 numpy arrays (the role roaring
+bitmaps play), term dictionaries are sorted arrays searched by np.searchsorted
+(the role the FST plays), and set algebra is vectorized numpy — all host-side,
+feeding series batches to the device scan.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..block.core import Tags
+
+
+@dataclass(frozen=True)
+class Document:
+    """doc.Document{ID, Fields} (m3ninx/doc/document.go)."""
+
+    id: bytes
+    fields: Tags
+
+
+class MutableSegment:
+    """segment/mem: built live on ingest."""
+
+    def __init__(self) -> None:
+        self.docs: list[Document] = []
+        self._ids: dict[bytes, int] = {}
+        self._postings: dict[tuple[bytes, bytes], list[int]] = {}
+        self._fields: dict[bytes, set[bytes]] = {}
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+    def insert(self, doc: Document) -> int:
+        existing = self._ids.get(doc.id)
+        if existing is not None:
+            return existing
+        idx = len(self.docs)
+        self.docs.append(doc)
+        self._ids[doc.id] = idx
+        for name, value in doc.fields:
+            self._postings.setdefault((name, value), []).append(idx)
+            self._fields.setdefault(name, set()).add(value)
+        return idx
+
+    def postings(self, name: bytes, value: bytes) -> np.ndarray:
+        return np.asarray(self._postings.get((name, value), []), np.int32)
+
+    def terms(self, name: bytes) -> list[bytes]:
+        return sorted(self._fields.get(name, ()))
+
+    def fields(self) -> list[bytes]:
+        return sorted(self._fields)
+
+    def seal(self) -> "SealedSegment":
+        return SealedSegment.from_mutable(self)
+
+
+class SealedSegment:
+    """Immutable segment: sorted term dict per field + packed postings —
+    the fst segment's role (segment/fst/segment.go) in array form."""
+
+    def __init__(self, docs, field_terms, postings_index, postings_data) -> None:
+        self.docs: list[Document] = docs
+        # field -> (sorted term list, [start, end) into postings_data per term)
+        self._field_terms: dict[bytes, list[bytes]] = field_terms
+        self._postings_index: dict[bytes, np.ndarray] = postings_index  # [n_terms, 2]
+        self._postings_data: np.ndarray = postings_data  # int32 concatenated
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+    @staticmethod
+    def from_mutable(seg: MutableSegment) -> "SealedSegment":
+        field_terms: dict[bytes, list[bytes]] = {}
+        postings_index: dict[bytes, np.ndarray] = {}
+        chunks: list[np.ndarray] = []
+        offset = 0
+        for name in seg.fields():
+            terms = seg.terms(name)
+            field_terms[name] = terms
+            idx = np.zeros((len(terms), 2), np.int64)
+            for i, t in enumerate(terms):
+                p = seg.postings(name, t)
+                chunks.append(p)
+                idx[i] = (offset, offset + len(p))
+                offset += len(p)
+            postings_index[name] = idx
+        data = np.concatenate(chunks) if chunks else np.zeros(0, np.int32)
+        return SealedSegment(list(seg.docs), field_terms, postings_index, data)
+
+    def fields(self) -> list[bytes]:
+        return sorted(self._field_terms)
+
+    def terms(self, name: bytes) -> list[bytes]:
+        return self._field_terms.get(name, [])
+
+    def postings(self, name: bytes, value: bytes) -> np.ndarray:
+        terms = self._field_terms.get(name)
+        if not terms:
+            return np.zeros(0, np.int32)
+        i = np.searchsorted(np.asarray(terms, object), value)
+        if i >= len(terms) or terms[i] != value:
+            return np.zeros(0, np.int32)
+        s, e = self._postings_index[name][i]
+        return self._postings_data[s:e]
+
+    def postings_regexp(self, name: bytes, pattern: bytes) -> np.ndarray:
+        """segment/fst/regexp: regex → automaton over the term FST; here a
+        compiled re over the sorted term dict."""
+        terms = self._field_terms.get(name)
+        if not terms:
+            return np.zeros(0, np.int32)
+        rx = re.compile(b"^(?:" + pattern + b")$")
+        out = []
+        idx = self._postings_index[name]
+        for i, t in enumerate(terms):
+            if rx.match(t):
+                s, e = idx[i]
+                out.append(self._postings_data[s:e])
+        if not out:
+            return np.zeros(0, np.int32)
+        return np.unique(np.concatenate(out))
+
+    # --- persistence (m3ninx/persist segment file sets) ---
+
+    def serialize(self) -> bytes:
+        parts = [struct.pack("<I", len(self.docs))]
+        for d in self.docs:
+            enc_fields = b"\x00".join(k + b"\x01" + v for k, v in d.fields)
+            parts.append(struct.pack("<II", len(d.id), len(enc_fields)))
+            parts.append(d.id)
+            parts.append(enc_fields)
+        parts.append(struct.pack("<I", len(self._field_terms)))
+        for name in self.fields():
+            terms = self._field_terms[name]
+            idx = self._postings_index[name]
+            parts.append(struct.pack("<II", len(name), len(terms)))
+            parts.append(name)
+            for i, t in enumerate(terms):
+                parts.append(struct.pack("<IQQ", len(t), idx[i][0], idx[i][1]))
+                parts.append(t)
+        raw = self._postings_data.astype("<i4").tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+        return b"".join(parts)
+
+    @staticmethod
+    def deserialize(buf: bytes) -> "SealedSegment":
+        pos = 0
+        (n_docs,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        docs = []
+        for _ in range(n_docs):
+            id_len, f_len = struct.unpack_from("<II", buf, pos)
+            pos += 8
+            did = buf[pos : pos + id_len]
+            pos += id_len
+            enc = buf[pos : pos + f_len]
+            pos += f_len
+            fields_ = tuple(
+                tuple(p.split(b"\x01", 1)) for p in enc.split(b"\x00") if p
+            )
+            docs.append(Document(did, fields_))
+        (n_fields,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        field_terms: dict[bytes, list[bytes]] = {}
+        postings_index: dict[bytes, np.ndarray] = {}
+        for _ in range(n_fields):
+            name_len, n_terms = struct.unpack_from("<II", buf, pos)
+            pos += 8
+            name = buf[pos : pos + name_len]
+            pos += name_len
+            terms = []
+            idx = np.zeros((n_terms, 2), np.int64)
+            for i in range(n_terms):
+                t_len, s, e = struct.unpack_from("<IQQ", buf, pos)
+                pos += 20
+                terms.append(buf[pos : pos + t_len])
+                pos += t_len
+                idx[i] = (s, e)
+            field_terms[name] = terms
+            postings_index[name] = idx
+        (raw_len,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        data = np.frombuffer(buf, "<i4", count=raw_len // 4, offset=pos).copy()
+        return SealedSegment(docs, field_terms, postings_index, data)

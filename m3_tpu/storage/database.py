@@ -139,6 +139,11 @@ class Namespace:
         self.opts = opts
         self.num_shards = num_shards
         self.shards = [Shard(i, name, opts, base) for i in range(num_shards)]
+        self.index = None
+        if opts.index_enabled:
+            from ..index.ns_index import NamespaceIndex
+
+            self.index = NamespaceIndex(opts.block_size_nanos, opts.retention_nanos)
 
     def shard_for(self, sid: bytes) -> Shard:
         return self.shards[shard_for(sid, self.num_shards)]
@@ -186,6 +191,38 @@ class Database:
 
     def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
         return self.namespaces[ns].shard_for(sid).read(sid, start, end)
+
+    # --- tagged write / index query path (database.go:606 WriteTagged,
+    # :785 QueryIDs; network FetchTagged mirrors this) ---
+
+    def write_tagged(
+        self, ns: str, tags, t_nanos: int, value: float, unit: Unit = Unit.SECOND
+    ) -> bytes:
+        from ..rules.rules import encode_tags_id
+
+        sid = encode_tags_id(tags)
+        namespace = self.namespaces[ns]
+        if namespace.index is not None:
+            namespace.index.write(sid, tags, t_nanos)
+        self.write(ns, sid, t_nanos, value, unit)
+        return sid
+
+    def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None):
+        namespace = self.namespaces[ns]
+        if namespace.index is None:
+            raise RuntimeError(f"namespace {ns} has no index")
+        return namespace.index.query(query, start, end, limit=limit)
+
+    def fetch_tagged(
+        self, ns: str, query, start: int, end: int, limit: int | None = None
+    ) -> list[tuple[bytes, tuple, list[Datapoint]]]:
+        """Index query + per-series read (the FetchTagged server path,
+        tchannelthrift/node/service.go:626)."""
+        result = self.query_ids(ns, query, start, end, limit=limit)
+        out = []
+        for doc in result.docs:
+            out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
+        return out
 
     def flush(self, ns: str, flush_before_nanos: int) -> list[FilesetID]:
         out = []

@@ -1,0 +1,126 @@
+"""Inverted index tests (reference: src/m3ninx/, src/dbnode/storage/index.go)."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.block.core import make_tags
+from m3_tpu.index.ns_index import NamespaceIndex
+from m3_tpu.index.query import (
+    AllQuery,
+    FieldQuery,
+    conj,
+    disj,
+    execute,
+    neg,
+    regexp,
+    search_segment,
+    term,
+)
+from m3_tpu.index.segment import Document, MutableSegment, SealedSegment
+from m3_tpu.storage.database import Database, NamespaceOptions
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+HOUR = 3600 * NANOS
+
+
+def seg_with_docs():
+    seg = MutableSegment()
+    docs = [
+        Document(b"cpu;host=a", make_tags({"name": "cpu", "host": "a", "dc": "sjc"})),
+        Document(b"cpu;host=b", make_tags({"name": "cpu", "host": "b", "dc": "dca"})),
+        Document(b"mem;host=a", make_tags({"name": "mem", "host": "a", "dc": "sjc"})),
+        Document(b"disk;host=c", make_tags({"name": "disk", "host": "c"})),
+    ]
+    for d in docs:
+        seg.insert(d)
+    return seg, docs
+
+
+@pytest.mark.parametrize("sealed", [False, True])
+def test_search_queries(sealed):
+    seg, docs = seg_with_docs()
+    if sealed:
+        seg = seg.seal()
+
+    def ids(q):
+        return {seg.docs[int(i)].id for i in search_segment(seg, q)}
+
+    assert ids(term(b"name", b"cpu")) == {b"cpu;host=a", b"cpu;host=b"}
+    assert ids(term(b"name", b"nope")) == set()
+    assert ids(regexp(b"name", b"c.*|mem")) == {b"cpu;host=a", b"cpu;host=b", b"mem;host=a"}
+    assert ids(conj(term(b"name", b"cpu"), term(b"dc", b"sjc"))) == {b"cpu;host=a"}
+    assert ids(disj(term(b"name", b"mem"), term(b"name", b"disk"))) == {
+        b"mem;host=a",
+        b"disk;host=c",
+    }
+    assert ids(conj(term(b"host", b"a"), neg(term(b"name", b"mem")))) == {b"cpu;host=a"}
+    assert ids(neg(FieldQuery(b"dc"))) == {b"disk;host=c"}
+    assert ids(AllQuery()) == {d.id for d in docs}
+
+
+def test_insert_dedupe_and_executor_across_segments():
+    seg1, _ = seg_with_docs()
+    idx1 = seg1.insert(Document(b"cpu;host=a", make_tags({"name": "cpu"})))
+    assert idx1 == 0  # same id -> same doc
+    sealed = seg1.seal()
+    seg2 = MutableSegment()
+    seg2.insert(Document(b"cpu;host=a", make_tags({"name": "cpu", "host": "a", "dc": "sjc"})))
+    seg2.insert(Document(b"new;host=z", make_tags({"name": "new", "host": "z"})))
+    docs = execute([sealed, seg2], FieldQuery(b"name"))
+    assert len({d.id for d in docs}) == len(docs)  # cross-segment dedupe
+    assert {d.id for d in docs} >= {b"cpu;host=a", b"new;host=z"}
+
+
+def test_sealed_serialize_roundtrip():
+    seg, _ = seg_with_docs()
+    sealed = seg.seal()
+    buf = sealed.serialize()
+    back = SealedSegment.deserialize(buf)
+    assert [d.id for d in back.docs] == [d.id for d in sealed.docs]
+    q = conj(term(b"name", b"cpu"), term(b"dc", b"sjc"))
+    assert {back.docs[int(i)].id for i in search_segment(back, q)} == {b"cpu;host=a"}
+    assert back.terms(b"dc") == sealed.terms(b"dc")
+
+
+def test_ns_index_blocks_and_aggregate():
+    idx = NamespaceIndex(block_size_nanos=2 * HOUR)
+    idx.write(b"s1", make_tags({"name": "cpu", "host": "a"}), T0)
+    idx.write(b"s2", make_tags({"name": "cpu", "host": "b"}), T0 + 3 * HOUR)
+    idx.write(b"s3", make_tags({"name": "mem", "host": "a"}), T0 + 3 * HOUR)
+
+    r = idx.query(term(b"name", b"cpu"), T0, T0 + HOUR)
+    assert {d.id for d in r.docs} == {b"s1"}
+    r = idx.query(term(b"name", b"cpu"), T0, T0 + 6 * HOUR)
+    assert {d.id for d in r.docs} == {b"s1", b"s2"}
+
+    # limit -> not exhaustive
+    r = idx.query(AllQuery(), T0, T0 + 6 * HOUR, limit=2)
+    assert len(r.docs) == 2 and not r.exhaustive
+
+    agg = idx.aggregate_query(None, T0, T0 + 6 * HOUR)
+    assert agg[b"name"] == {b"cpu", b"mem"}
+    agg = idx.aggregate_query(term(b"host", b"a"), T0, T0 + 6 * HOUR, field_filter=[b"name"])
+    assert agg == {b"name": {b"cpu", b"mem"}}
+
+    # sealing preserves queries
+    idx.seal_before(T0 + 2 * HOUR)
+    r = idx.query(term(b"name", b"cpu"), T0, T0 + HOUR)
+    assert {d.id for d in r.docs} == {b"s1"}
+
+
+def test_database_write_tagged_fetch_tagged(tmp_path):
+    db = Database(str(tmp_path), num_shards=4, commitlog_enabled=False)
+    db.create_namespace("ns", NamespaceOptions(block_size_nanos=2 * HOUR))
+    for i in range(8):
+        tags = make_tags({"__name__": "req", "host": f"h{i % 2}", "idx": str(i)})
+        db.write_tagged("ns", tags, T0 + i * NANOS, float(i))
+
+    res = db.fetch_tagged("ns", term(b"host", b"h1"), T0, T0 + HOUR)
+    assert len(res) == 4
+    for sid, tags, dps in res:
+        assert dict(tags)[b"host"] == b"h1"
+        assert len(dps) == 1
+
+    res = db.fetch_tagged("ns", regexp(b"idx", b"[0-3]"), T0, T0 + HOUR)
+    assert len(res) == 4
