@@ -1,0 +1,333 @@
+"""Query engine: PromQL AST evaluation over storage blocks.
+
+Reference: /root/reference/src/query/executor/ — Engine.ExecuteExpr
+(engine.go:116) builds the transform DAG and pushes blocks through it
+(state.go:183). Here evaluation is direct recursion over the AST: every node
+produces a dense [S, T] block (or a [T] scalar row), so each transform is one
+vectorized call into m3_tpu.query.functions — the DAG collapses into array
+ops the device can fuse.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol
+
+import numpy as np
+
+from ..block.core import Bounds, SeriesMeta, Tags, make_tags
+from .functions import aggregation as A
+from .functions import binary as B
+from .functions import linear as L
+from .functions import temporal as T
+from .promql import (
+    Aggregation,
+    BinaryOp,
+    Call,
+    Expr,
+    Matcher,
+    NumberLiteral,
+    RangeSelector,
+    StringLiteral,
+    Unary,
+    VectorSelector,
+    parse,
+)
+
+NANOS = 1_000_000_000
+DEFAULT_LOOKBACK = 5 * 60 * NANOS
+
+
+@dataclass
+class Result:
+    """A evaluated vector: values [S, T] + per-series metas (scalar results
+    have one row and scalar=True)."""
+
+    values: np.ndarray
+    metas: list[SeriesMeta]
+    scalar: bool = False
+
+
+class Storage(Protocol):
+    """storage.Storage seam (src/query/storage/types.go): raw series fetch."""
+
+    def fetch(
+        self, matchers: list[Matcher], start_nanos: int, end_nanos: int
+    ) -> list[tuple[Tags, np.ndarray, np.ndarray]]:
+        """→ [(tags, times i64[n], values f64[n])] raw samples, times sorted."""
+        ...
+
+
+def consolidate(
+    series: list[tuple[Tags, np.ndarray, np.ndarray]],
+    bounds: Bounds,
+    lookback_nanos: int,
+) -> Result:
+    """Samples → step grid: value at step = last sample in (t-lookback, t]
+    (storage/m3/consolidators/ 'last' consolidation)."""
+    s = len(series)
+    grid = bounds.timestamps()
+    out = np.full((s, bounds.steps), np.nan)
+    metas = []
+    for i, (tags, times, vals) in enumerate(series):
+        metas.append(SeriesMeta(tags=tags))
+        if len(times) == 0:
+            continue
+        idx = np.searchsorted(times, grid, side="right") - 1
+        ok = idx >= 0
+        sample_t = times[np.maximum(idx, 0)]
+        ok &= grid - sample_t < lookback_nanos
+        out[i] = np.where(ok, vals[np.maximum(idx, 0)], np.nan)
+    return Result(values=out, metas=metas)
+
+
+class Engine:
+    """executor.Engine equivalent."""
+
+    def __init__(self, storage: Storage, lookback_nanos: int = DEFAULT_LOOKBACK) -> None:
+        self.storage = storage
+        self.lookback = lookback_nanos
+
+    def query_range(
+        self, query: str, start_nanos: int, end_nanos: int, step_nanos: int
+    ) -> Result:
+        ast = parse(query)
+        steps = int((end_nanos - start_nanos) // step_nanos) + 1
+        bounds = Bounds(start_nanos, step_nanos, steps)
+        return self._eval(ast, bounds)
+
+    def query_instant(self, query: str, time_nanos: int) -> Result:
+        return self.query_range(query, time_nanos, time_nanos, NANOS)
+
+    # --- evaluation ---
+
+    def _fetch(self, sel: VectorSelector, bounds: Bounds, extra_steps: int = 0) -> Result:
+        start = bounds.start_nanos - sel.offset_nanos - extra_steps * bounds.step_nanos
+        end = bounds.start_nanos - sel.offset_nanos + bounds.step_nanos * bounds.steps
+        matchers = list(sel.matchers)
+        if sel.name:
+            matchers.append(Matcher("__name__", "=", sel.name))
+        raw = self.storage.fetch(matchers, start - self.lookback, end)
+        b = Bounds(start, bounds.step_nanos, bounds.steps + extra_steps)
+        return consolidate(raw, b, self.lookback)
+
+    def _eval(self, e: Expr, bounds: Bounds) -> Result:
+        if isinstance(e, NumberLiteral):
+            return Result(
+                np.full((1, bounds.steps), e.value), [SeriesMeta(())], scalar=True
+            )
+        if isinstance(e, VectorSelector):
+            return self._fetch(e, bounds)
+        if isinstance(e, Unary):
+            r = self._eval(e.expr, bounds)
+            vals = -r.values if e.op == "-" else r.values
+            return Result(vals, r.metas, r.scalar)
+        if isinstance(e, Call):
+            return self._call(e, bounds)
+        if isinstance(e, Aggregation):
+            return self._aggregate(e, bounds)
+        if isinstance(e, BinaryOp):
+            return self._binary(e, bounds)
+        if isinstance(e, RangeSelector):
+            raise ValueError("promql: range selector outside function call")
+        if isinstance(e, StringLiteral):
+            raise ValueError("promql: string literal in value position")
+        raise TypeError(f"unhandled node {e!r}")
+
+    # temporal functions taking a range argument
+    _TEMPORAL = {
+        "rate": lambda v, w, s: T.rate(v, w, s),
+        "irate": lambda v, w, s: T.irate(v, w, s),
+        "increase": lambda v, w, s: T.increase(v, w, s),
+        "delta": lambda v, w, s: T.delta(v, w, s),
+        "idelta": lambda v, w, s: T.idelta(v, w, s),
+        "deriv": lambda v, w, s: T.deriv(v, w, s),
+        "resets": lambda v, w, s: T.resets(v, w),
+        "changes": lambda v, w, s: T.changes(v, w),
+        "sum_over_time": lambda v, w, s: T.sum_over_time(v, w),
+        "count_over_time": lambda v, w, s: T.count_over_time(v, w),
+        "avg_over_time": lambda v, w, s: T.avg_over_time(v, w),
+        "min_over_time": lambda v, w, s: T.min_over_time(v, w),
+        "max_over_time": lambda v, w, s: T.max_over_time(v, w),
+        "last_over_time": lambda v, w, s: T.last_over_time(v, w),
+        "stddev_over_time": lambda v, w, s: T.stddev_over_time(v, w),
+        "stdvar_over_time": lambda v, w, s: T.stdvar_over_time(v, w),
+        "present_over_time": lambda v, w, s: np.where(
+            np.asarray(T.count_over_time(v, w)) > 0, 1.0, np.nan
+        ),
+    }
+
+    def _range_arg(self, arg: Expr, bounds: Bounds) -> tuple[np.ndarray, list, int]:
+        if not isinstance(arg, RangeSelector):
+            raise ValueError("promql: function requires a range vector")
+        window = int(arg.range_nanos // bounds.step_nanos) + 1
+        extra = window - 1
+        r = self._fetch(arg.vector, bounds, extra_steps=extra)
+        return np.asarray(r.values), r.metas, window
+
+    def _call(self, e: Call, bounds: Bounds) -> Result:
+        name = e.func
+        step_s = bounds.step_nanos / NANOS
+        if name in self._TEMPORAL:
+            vals, metas, w = self._range_arg(e.args[0], bounds)
+            out = np.asarray(self._TEMPORAL[name](vals, w, step_s))
+            return Result(out[:, w - 1 :], metas)
+        if name == "quantile_over_time":
+            q = _number(e.args[0])
+            vals, metas, w = self._range_arg(e.args[1], bounds)
+            out = np.asarray(T.quantile_over_time(vals, w, q))
+            return Result(out[:, w - 1 :], metas)
+        if name == "predict_linear":
+            vals, metas, w = self._range_arg(e.args[0], bounds)
+            t = _number(e.args[1])
+            out = np.asarray(T.predict_linear(vals, w, step_s, t))
+            return Result(out[:, w - 1 :], metas)
+        if name == "holt_winters":
+            vals, metas, w = self._range_arg(e.args[0], bounds)
+            sf, tf = _number(e.args[1]), _number(e.args[2])
+            out = np.asarray(T.holt_winters(vals, w, sf, tf))
+            return Result(out[:, w - 1 :], metas)
+        if name in L.MATH_FNS:
+            r = self._eval(e.args[0], bounds)
+            return Result(np.asarray(L.MATH_FNS[name](r.values)), r.metas, r.scalar)
+        if name == "round":
+            r = self._eval(e.args[0], bounds)
+            to = _number(e.args[1]) if len(e.args) > 1 else 1.0
+            return Result(np.asarray(L.round_to(r.values, to)), r.metas, r.scalar)
+        if name == "clamp_min":
+            r = self._eval(e.args[0], bounds)
+            return Result(np.asarray(L.clamp_min(r.values, _number(e.args[1]))), r.metas)
+        if name == "clamp_max":
+            r = self._eval(e.args[0], bounds)
+            return Result(np.asarray(L.clamp_max(r.values, _number(e.args[1]))), r.metas)
+        if name == "clamp":
+            r = self._eval(e.args[0], bounds)
+            lo, hi = _number(e.args[1]), _number(e.args[2])
+            return Result(np.clip(r.values, lo, hi), r.metas)
+        if name == "histogram_quantile":
+            q = _number(e.args[0])
+            r = self._eval(e.args[1], bounds)
+            index, bnds, metas = L.histogram_buckets(r.metas)
+            out = np.asarray(L.histogram_quantile(q, r.values, index, bnds))
+            return Result(out, metas)
+        if name in ("sort", "sort_desc"):
+            r = self._eval(e.args[0], bounds)
+            order = L.sort_series(r.values, descending=name == "sort_desc")
+            return Result(r.values[order], [r.metas[i] for i in order])
+        if name == "absent":
+            r = self._eval(e.args[0], bounds)
+            vals = np.asarray(A.absent(r.values))
+            return Result(vals, [SeriesMeta(())])
+        if name == "scalar":
+            r = self._eval(e.args[0], bounds)
+            if len(r.metas) == 1:
+                return Result(r.values[:1], [SeriesMeta(())], scalar=True)
+            return Result(np.full((1, bounds.steps), np.nan), [SeriesMeta(())], scalar=True)
+        if name == "vector":
+            r = self._eval(e.args[0], bounds)
+            return Result(r.values, [SeriesMeta(())])
+        if name == "time":
+            t = bounds.timestamps() / NANOS
+            return Result(t[None, :].astype(np.float64), [SeriesMeta(())], scalar=True)
+        if name == "timestamp":
+            r = self._eval(e.args[0], bounds)
+            t = (bounds.timestamps() / NANOS)[None, :]
+            out = np.where(np.isnan(np.asarray(r.values)), np.nan, t)
+            return Result(out, r.metas)
+        if name in ("day_of_month", "day_of_week", "days_in_month", "hour", "minute", "month", "year"):
+            if e.args:
+                r = self._eval(e.args[0], bounds)
+                vals, metas = r.values, r.metas
+            else:
+                vals = (bounds.timestamps() / NANOS)[None, :].astype(np.float64)
+                metas = [SeriesMeta(())]
+            return Result(L.datetime_fn(name, vals), metas)
+        raise ValueError(f"promql: unsupported function {name}")
+
+    def _aggregate(self, e: Aggregation, bounds: Bounds) -> Result:
+        r = self._eval(e.expr, bounds)
+        matching = [g.encode() for g in e.grouping]
+        layout = A.group_by_tags(r.metas, matching or None, e.without)
+        vals = np.asarray(r.values)
+        if e.op in ("topk", "bottomk"):
+            k = int(_number(e.param))
+            fn = A.topk if e.op == "topk" else A.bottomk
+            out = np.asarray(fn(vals, layout, k))
+            keep = ~np.all(np.isnan(out), axis=1)
+            return Result(out[keep], [r.metas[i] for i in np.nonzero(keep)[0]])
+        if e.op == "quantile":
+            out = np.asarray(A.grouped_quantile(vals, layout, _number(e.param)))
+            return Result(out, layout.metas)
+        if e.op == "count_values":
+            label = e.param.value if isinstance(e.param, StringLiteral) else "value"
+            out, metas = A.count_values(vals, r.metas, label.encode())
+            return Result(out, metas)
+        fn = {
+            "sum": A.grouped_sum,
+            "min": A.grouped_min,
+            "max": A.grouped_max,
+            "avg": A.grouped_avg,
+            "count": A.grouped_count,
+            "stddev": A.grouped_stddev,
+            "stdvar": A.grouped_stdvar,
+        }[e.op]
+        return Result(np.asarray(fn(vals, layout)), layout.metas)
+
+    def _binary(self, e: BinaryOp, bounds: Bounds) -> Result:
+        lhs = self._eval(e.lhs, bounds)
+        rhs = self._eval(e.rhs, bounds)
+        lv, rv = np.asarray(lhs.values), np.asarray(rhs.values)
+
+        if e.op in ("and", "or", "unless"):
+            m = B.VectorMatching(on=e.on, matching_labels=tuple(x.encode() for x in e.matching_labels))
+            fn = {"and": B.logical_and, "or": B.logical_or, "unless": B.logical_unless}[e.op]
+            vals, metas = fn(lv, rv, lhs.metas, rhs.metas, m)
+            return Result(np.asarray(vals), metas)
+
+        is_comp = e.op in B.COMP_FNS
+        # scalar op scalar / vector op scalar / scalar op vector
+        if lhs.scalar and rhs.scalar:
+            out = self._apply_scalar(e, lv, rv)
+            return Result(out, lhs.metas, scalar=True)
+        if rhs.scalar:
+            out = self._apply_scalar(e, lv, rv)  # broadcast [1,T]
+            return Result(out, _drop_names(lhs.metas) if not is_comp else lhs.metas)
+        if lhs.scalar:
+            if is_comp and not e.return_bool:
+                cond = B.COMP_FNS[e.op](lv, rv)
+                return Result(np.where(cond, rv, np.nan), rhs.metas)
+            out = self._apply_scalar(e, lv, rv)
+            return Result(out, _drop_names(rhs.metas) if not is_comp else rhs.metas)
+
+        # vector op vector
+        m = B.VectorMatching(on=e.on, matching_labels=tuple(x.encode() for x in e.matching_labels))
+        tl, tr, metas = B.intersect(m, lhs.metas, rhs.metas)
+        if is_comp:
+            out = np.asarray(B.comparison(e.op, lv, rv, tl, tr, e.return_bool))
+            metas = [lhs.metas[i] for i in tl] if not e.return_bool else metas
+            return Result(out, metas)
+        out = np.asarray(B.arithmetic(e.op, lv, rv, tl, tr))
+        return Result(out, metas)
+
+    def _apply_scalar(self, e: BinaryOp, lv, rv):
+        if e.op in B.COMP_FNS:
+            cond = B.COMP_FNS[e.op](lv, rv)
+            if e.return_bool:
+                return cond.astype(np.float64)
+            return np.where(cond, lv, np.nan)
+        return np.asarray(B.ARITH_FNS[e.op](np.asarray(lv), np.asarray(rv)))
+
+
+def _drop_names(metas: list[SeriesMeta]) -> list[SeriesMeta]:
+    return [
+        SeriesMeta(tags=tuple((k, v) for k, v in m.tags if k != b"__name__"), name=m.name)
+        for m in metas
+    ]
+
+
+def _number(e: Expr | None) -> float:
+    if isinstance(e, NumberLiteral):
+        return e.value
+    if isinstance(e, Unary) and isinstance(e.expr, NumberLiteral):
+        return -e.expr.value if e.op == "-" else e.expr.value
+    raise ValueError("promql: expected a number literal")

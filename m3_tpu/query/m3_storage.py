@@ -1,0 +1,76 @@
+"""Storage adapters: matchers → index query → decoded series; fanout.
+
+Reference: /root/reference/src/query/storage/m3/storage.go:182
+(FetchCompressed: resolve namespaces, FetchTagged, wrap into blocks) and
+src/query/storage/fanout/storage.go:48-156 (merge across clusters by
+retention/resolution attributes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index.query import AllQuery, conj, neg, regexp, term
+from ..storage.database import Database
+from .promql import Matcher
+
+
+def matchers_to_index_query(matchers: list[Matcher]):
+    """models.Matchers → idx.Query (storage/index/convert)."""
+    qs = []
+    for m in matchers:
+        name = m.name.encode()
+        value = m.value.encode()
+        if m.op == "=":
+            qs.append(term(name, value))
+        elif m.op == "!=":
+            qs.append(neg(term(name, value)))
+        elif m.op == "=~":
+            qs.append(regexp(name, value))
+        elif m.op == "!~":
+            qs.append(neg(regexp(name, value)))
+        else:
+            raise ValueError(f"bad matcher op {m.op}")
+    if not qs:
+        return AllQuery()
+    if len(qs) == 1:
+        return qs[0]
+    return conj(*qs)
+
+
+@dataclass
+class M3Storage:
+    """Engine Storage over one Database namespace."""
+
+    db: Database
+    namespace: str
+
+    def fetch(self, matchers, start_nanos, end_nanos):
+        q = matchers_to_index_query(matchers)
+        out = []
+        for sid, tags, dps in self.db.fetch_tagged(self.namespace, q, start_nanos, end_nanos):
+            times = np.asarray([dp.timestamp for dp in dps], np.int64)
+            vals = np.asarray([dp.value for dp in dps], np.float64)
+            out.append((tags, times, vals))
+        return out
+
+
+@dataclass
+class FanoutStorage:
+    """Merge series from multiple storages (fanout/storage.go): exact-id
+    duplicates resolved by preferring the higher-resolution (first) source."""
+
+    storages: list
+
+    def fetch(self, matchers, start_nanos, end_nanos):
+        seen: dict = {}
+        order = []
+        for st in self.storages:
+            for tags, times, vals in st.fetch(matchers, start_nanos, end_nanos):
+                if tags in seen:
+                    continue
+                seen[tags] = (tags, times, vals)
+                order.append(tags)
+        return [seen[t] for t in order]
