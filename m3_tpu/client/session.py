@@ -1,0 +1,128 @@
+"""Cluster-aware client session: replicated quorum writes/reads.
+
+Reference: /root/reference/src/dbnode/client/ — session.Open
+(session.go:505), Write fan-out to every replica of the shard
+(writeAttemptWithRLock :1068), consistency-level result gating (:1789-1815),
+FetchTagged across replicas with series merge/dedupe
+(encoding/series_iterator.go), and peer streaming for bootstrap/repair
+(FetchBootstrapBlocksFromPeers :2033).
+
+Nodes are in-process storage nodes (testing/cluster.py) or any object with
+the same surface — the transport seam where the reference speaks
+TChannel/Thrift.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..cluster.topology import ConsistencyLevel, TopologyMap
+from ..utils.hash import shard_for
+from ..utils.xtime import Unit
+
+
+class ConsistencyError(Exception):
+    def __init__(self, op: str, achieved: int, required: int, errors: list) -> None:
+        super().__init__(
+            f"{op}: consistency not achieved ({achieved}/{required}): {errors}"
+        )
+        self.achieved = achieved
+        self.required = required
+
+
+@dataclass
+class Session:
+    topology: TopologyMap
+    nodes: dict  # instance id -> node (testing/cluster.Node or RPC stub)
+    namespace: str = "default"
+    write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
+    read_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
+
+    @property
+    def num_shards(self) -> int:
+        return self.topology.placement.num_shards
+
+    def _shard(self, sid: bytes) -> int:
+        return shard_for(sid, self.num_shards)
+
+    # --- writes (session.go:977-1100) ---
+
+    def write_tagged(self, tags, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> bytes:
+        from ..rules.rules import encode_tags_id
+
+        sid = encode_tags_id(tags)
+        shard = self._shard(sid)
+        hosts = self.topology.hosts_for_shard(shard)
+        required = self.write_consistency.required(self.topology.replicas)
+        success, errors = 0, []
+        for host in hosts:
+            node = self.nodes.get(host)
+            if node is None or not node.is_up:
+                errors.append(f"{host}: down")
+                continue
+            try:
+                node.write_tagged(self.namespace, tags, t_nanos, value, unit)
+                success += 1
+            except Exception as exc:  # pragma: no cover - defensive
+                errors.append(f"{host}: {exc}")
+        if success < required:
+            raise ConsistencyError("write", success, required, errors)
+        return sid
+
+    def write(self, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> None:
+        shard = self._shard(sid)
+        hosts = self.topology.hosts_for_shard(shard)
+        required = self.write_consistency.required(self.topology.replicas)
+        success, errors = 0, []
+        for host in hosts:
+            node = self.nodes.get(host)
+            if node is None or not node.is_up:
+                errors.append(f"{host}: down")
+                continue
+            node.write(self.namespace, sid, t_nanos, value, unit)
+            success += 1
+        if success < required:
+            raise ConsistencyError("write", success, required, errors)
+
+    # --- reads (session.go:1269-1530 + series_iterator replica merge) ---
+
+    def fetch_tagged(self, query, start_nanos: int, end_nanos: int):
+        """Fan out to replicas of every shard; merge + dedupe series across
+        replicas (last-written value wins on equal timestamps, the
+        SeriesIterator default)."""
+        required = self.read_consistency.required(self.topology.replicas)
+        by_series: dict[bytes, tuple] = {}
+        responded_by_shard: dict[int, int] = {}
+        for host, node in self.nodes.items():
+            if not node.is_up:
+                continue
+            try:
+                res = node.fetch_tagged(self.namespace, query, start_nanos, end_nanos)
+            except Exception:
+                continue
+            for shard in node.owned_shards():
+                responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
+            for sid, tags, dps in res:
+                cur = by_series.get(sid)
+                if cur is None:
+                    by_series[sid] = (tags, {dp.timestamp: dp for dp in dps})
+                else:
+                    merged = cur[1]
+                    for dp in dps:
+                        merged.setdefault(dp.timestamp, dp)
+        # consistency check per shard that has any owner
+        for shard, count in responded_by_shard.items():
+            if count < required:
+                raise ConsistencyError("read", count, required, [f"shard {shard}"])
+        out = []
+        for sid in sorted(by_series):
+            tags, merged = by_series[sid]
+            out.append((sid, tags, [merged[t] for t in sorted(merged)]))
+        return out
+
+    # --- peer streaming (peers bootstrapper / repair seam) ---
+
+    def stream_shard_from_peer(self, peer_id: str, shard: int):
+        """FetchBootstrapBlocksFromPeers: raw series streams for one shard."""
+        node = self.nodes[peer_id]
+        return node.stream_shard(self.namespace, shard)

@@ -1,0 +1,104 @@
+"""Versioned KV store with watches — the control-plane foundation.
+
+Reference: /root/reference/src/cluster/kv/ — kv.Store/TxnStore
+(kv/types.go), etcd implementation with watches + caching overlays
+(kv/etcd/store.go). This is the in-process equivalent the reference's
+integration tests use (fake cluster services); an optional JSON file backing
+makes values durable across restarts (the role of etcd persistence for a
+single-node deployment).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class VersionedValue:
+    version: int
+    value: Any
+
+
+class KVStore:
+    """kv.Store: Get/Set/SetIfNotExists/CheckAndSet + watches."""
+
+    def __init__(self, backing_path: str | None = None) -> None:
+        self._lock = threading.RLock()
+        self._data: dict[str, VersionedValue] = {}
+        self._watchers: dict[str, list[Callable[[VersionedValue], None]]] = {}
+        self._path = backing_path
+        if backing_path and os.path.exists(backing_path):
+            with open(backing_path) as f:
+                raw = json.load(f)
+            self._data = {k: VersionedValue(v["version"], v["value"]) for k, v in raw.items()}
+
+    def _persist(self) -> None:
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {k: {"version": v.version, "value": v.value} for k, v in self._data.items()},
+                f,
+            )
+        os.replace(tmp, self._path)
+
+    def get(self, key: str) -> VersionedValue | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: str, value: Any) -> int:
+        with self._lock:
+            cur = self._data.get(key)
+            version = (cur.version + 1) if cur else 1
+            vv = VersionedValue(version, value)
+            self._data[key] = vv
+            self._persist()
+            watchers = list(self._watchers.get(key, ()))
+        for w in watchers:
+            w(vv)
+        return version
+
+    def set_if_not_exists(self, key: str, value: Any) -> int:
+        with self._lock:
+            if key in self._data:
+                raise KeyError(f"key {key} already exists")
+        return self.set(key, value)
+
+    def check_and_set(self, key: str, expect_version: int, value: Any) -> int:
+        """CAS (kv/types.go CheckAndSet): version 0 = must not exist."""
+        with self._lock:
+            cur = self._data.get(key)
+            cur_version = cur.version if cur else 0
+            if cur_version != expect_version:
+                raise ValueError(
+                    f"version mismatch for {key}: have {cur_version}, want {expect_version}"
+                )
+        return self.set(key, value)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+            self._persist()
+
+    def watch(self, key: str, fn: Callable[[VersionedValue], None]) -> Callable[[], None]:
+        """Register a watcher; returns an unsubscribe fn. Fires immediately
+        with the current value if one exists (etcd watch + get semantics)."""
+        with self._lock:
+            self._watchers.setdefault(key, []).append(fn)
+            cur = self._data.get(key)
+        if cur is not None:
+            fn(cur)
+
+        def unsub() -> None:
+            with self._lock:
+                try:
+                    self._watchers[key].remove(fn)
+                except (KeyError, ValueError):
+                    pass
+
+        return unsub

@@ -1,0 +1,175 @@
+"""Placements: instance/shard assignment with shard states.
+
+Reference: /root/reference/src/cluster/placement/ — placement.Placement model
+(types.go), sharded placement algorithm (algo/sharded.go: balanced initial
+assignment, add/remove instance moves the minimum number of shards), shard
+states Initializing/Available/Leaving (src/cluster/shard/) gating reads, and
+placement storage in KV (placement/storage).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from .kv import KVStore
+
+
+class ShardState(enum.IntEnum):
+    INITIALIZING = 0
+    AVAILABLE = 1
+    LEAVING = 2
+
+
+@dataclass
+class ShardAssignment:
+    shard: int
+    state: ShardState = ShardState.INITIALIZING
+    source_instance: str | None = None  # where to stream from while initializing
+
+
+@dataclass
+class Instance:
+    id: str
+    endpoint: str = ""
+    isolation_group: str = ""
+    weight: int = 1
+    shards: dict[int, ShardAssignment] = field(default_factory=dict)
+
+
+@dataclass
+class Placement:
+    instances: dict[str, Instance] = field(default_factory=dict)
+    num_shards: int = 0
+    replica_factor: int = 1
+    version: int = 0
+
+    def instances_for_shard(self, shard: int, readable_only: bool = False) -> list[Instance]:
+        out = []
+        for inst in self.instances.values():
+            a = inst.shards.get(shard)
+            if a is None:
+                continue
+            if readable_only and a.state == ShardState.INITIALIZING:
+                continue
+            out.append(inst)
+        return out
+
+    def mark_all_available(self) -> None:
+        for inst in self.instances.values():
+            for a in inst.shards.values():
+                a.state = ShardState.AVAILABLE
+
+    def to_dict(self) -> dict:
+        return {
+            "numShards": self.num_shards,
+            "replicaFactor": self.replica_factor,
+            "instances": {
+                iid: {
+                    "endpoint": inst.endpoint,
+                    "isolationGroup": inst.isolation_group,
+                    "weight": inst.weight,
+                    "shards": {
+                        str(s): {"state": int(a.state), "source": a.source_instance}
+                        for s, a in inst.shards.items()
+                    },
+                }
+                for iid, inst in self.instances.items()
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Placement":
+        p = Placement(num_shards=d["numShards"], replica_factor=d["replicaFactor"])
+        for iid, v in d["instances"].items():
+            inst = Instance(iid, v["endpoint"], v["isolationGroup"], v["weight"])
+            for s, a in v["shards"].items():
+                inst.shards[int(s)] = ShardAssignment(
+                    int(s), ShardState(a["state"]), a.get("source")
+                )
+            p.instances[iid] = inst
+        return p
+
+
+def build_initial_placement(
+    instance_ids: list[str], num_shards: int, replica_factor: int
+) -> Placement:
+    """algo/sharded.go initial placement: round-robin replicas across
+    instances, no two replicas of a shard on the same instance."""
+    if replica_factor > len(instance_ids):
+        raise ValueError("replica factor exceeds instance count")
+    p = Placement(num_shards=num_shards, replica_factor=replica_factor)
+    for iid in instance_ids:
+        p.instances[iid] = Instance(iid)
+    n = len(instance_ids)
+    for s in range(num_shards):
+        for r in range(replica_factor):
+            iid = instance_ids[(s + r) % n]
+            p.instances[iid].shards[s] = ShardAssignment(s, ShardState.AVAILABLE)
+    return p
+
+
+def add_instance(p: Placement, new_id: str) -> Placement:
+    """algo/sharded.go AddInstance: steal shards from the most-loaded
+    instances; stolen shards start INITIALIZING with a source to stream from."""
+    if new_id in p.instances:
+        raise ValueError(f"instance {new_id} already in placement")
+    target = p.num_shards * p.replica_factor // (len(p.instances) + 1)
+    new_inst = Instance(new_id)
+    p.instances[new_id] = new_inst
+    while len(new_inst.shards) < target:
+        donor = max(
+            (i for i in p.instances.values() if i.id != new_id),
+            key=lambda i: len(i.shards),
+        )
+        movable = [
+            s
+            for s, a in donor.shards.items()
+            if a.state == ShardState.AVAILABLE and s not in new_inst.shards
+        ]
+        if not movable:
+            break
+        s = movable[0]
+        del donor.shards[s]
+        new_inst.shards[s] = ShardAssignment(
+            s, ShardState.INITIALIZING, source_instance=donor.id
+        )
+    p.version += 1
+    return p
+
+
+def remove_instance(p: Placement, iid: str) -> Placement:
+    """algo/sharded.go RemoveInstance: redistribute its shards to the
+    least-loaded remaining instances."""
+    gone = p.instances.pop(iid)
+    for s, a in gone.shards.items():
+        candidates = sorted(
+            (i for i in p.instances.values() if s not in i.shards),
+            key=lambda i: len(i.shards),
+        )
+        if not candidates:
+            continue
+        dst = candidates[0]
+        dst.shards[s] = ShardAssignment(s, ShardState.INITIALIZING, source_instance=None)
+    p.version += 1
+    return p
+
+
+class PlacementService:
+    """placement.Service: placements stored + versioned in KV."""
+
+    KEY = "_placement/{name}"
+
+    def __init__(self, kv: KVStore, name: str = "default") -> None:
+        self.kv = kv
+        self.key = self.KEY.format(name=name)
+
+    def get(self) -> Placement | None:
+        vv = self.kv.get(self.key)
+        return Placement.from_dict(vv.value) if vv else None
+
+    def set(self, p: Placement) -> int:
+        return self.kv.set(self.key, p.to_dict())
+
+    def watch(self, fn) -> callable:
+        return self.kv.watch(self.key, lambda vv: fn(Placement.from_dict(vv.value)))

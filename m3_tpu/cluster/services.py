@@ -1,0 +1,98 @@
+"""Service discovery, heartbeats, and leader election over the KV store.
+
+Reference: /root/reference/src/cluster/services/ — advertise+watch instances
+(services.Services), heartbeat (services/heartbeat/etcd), leader election
+(services/leader wrapping etcd concurrency primitives; the aggregator's
+election_mgr.go campaigns through it, and the coordinator's in-process
+downsampler uses a local stub leader_local.go — which this also covers).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .kv import KVStore
+
+
+@dataclass
+class ServiceInstance:
+    id: str
+    endpoint: str
+    zone: str = "embedded"
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+class Services:
+    """Advertise + watch + heartbeat liveness."""
+
+    def __init__(self, kv: KVStore, heartbeat_timeout: float = 10.0) -> None:
+        self.kv = kv
+        self.heartbeat_timeout = heartbeat_timeout
+        self._lock = threading.RLock()
+        self._instances: dict[str, dict[str, ServiceInstance]] = {}
+
+    def advertise(self, service: str, instance: ServiceInstance) -> None:
+        with self._lock:
+            self._instances.setdefault(service, {})[instance.id] = instance
+        self.kv.set(f"_services/{service}/{instance.id}", instance.endpoint)
+
+    def heartbeat(self, service: str, instance_id: str) -> None:
+        with self._lock:
+            inst = self._instances.get(service, {}).get(instance_id)
+            if inst:
+                inst.last_heartbeat = time.monotonic()
+
+    def unadvertise(self, service: str, instance_id: str) -> None:
+        with self._lock:
+            self._instances.get(service, {}).pop(instance_id, None)
+        self.kv.delete(f"_services/{service}/{instance_id}")
+
+    def instances(self, service: str, live_only: bool = True) -> list[ServiceInstance]:
+        now = time.monotonic()
+        with self._lock:
+            out = list(self._instances.get(service, {}).values())
+        if live_only:
+            out = [i for i in out if now - i.last_heartbeat < self.heartbeat_timeout]
+        return sorted(out, key=lambda i: i.id)
+
+
+class LeaderElection:
+    """Per-electionID campaign/resign/leader (services/leader/election).
+
+    CAS on a KV key; leadership is lost when the leader resigns or its
+    session is explicitly expired (the fake-clusterservices pattern the
+    reference's integration tests rely on)."""
+
+    def __init__(self, kv: KVStore, election_id: str) -> None:
+        self.kv = kv
+        self.key = f"_election/{election_id}"
+
+    def campaign(self, candidate: str) -> bool:
+        vv = self.kv.get(self.key)
+        if vv is None or vv.value is None:
+            try:
+                self.kv.check_and_set(self.key, vv.version if vv else 0, candidate)
+                return True
+            except (ValueError, KeyError):
+                return self.leader() == candidate
+        return vv.value == candidate
+
+    def leader(self) -> str | None:
+        vv = self.kv.get(self.key)
+        return vv.value if vv else None
+
+    def resign(self, candidate: str) -> None:
+        vv = self.kv.get(self.key)
+        if vv and vv.value == candidate:
+            self.kv.check_and_set(self.key, vv.version, None)
+
+    def expire(self) -> None:
+        """Simulate session expiry (leader process died)."""
+        vv = self.kv.get(self.key)
+        if vv:
+            self.kv.check_and_set(self.key, vv.version, None)
+
+    def watch(self, fn) -> callable:
+        return self.kv.watch(self.key, lambda vv: fn(vv.value))

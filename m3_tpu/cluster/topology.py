@@ -1,0 +1,69 @@
+"""Topology: shard→hosts map derived from placements + consistency levels.
+
+Reference: /root/reference/src/dbnode/topology/ — dynamic topology watching
+the placement (dynamic.go), shard→hosts map (map.go), consistency levels
+(consistency_level.go: One/Majority/All + unstrict variants).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .placement import Placement, PlacementService, ShardState
+
+
+class ConsistencyLevel(enum.Enum):
+    ONE = "one"
+    MAJORITY = "majority"
+    ALL = "all"
+
+    def required(self, replicas: int) -> int:
+        if self is ConsistencyLevel.ONE:
+            return 1
+        if self is ConsistencyLevel.MAJORITY:
+            return replicas // 2 + 1
+        return replicas
+
+
+class TopologyMap:
+    """topology/map.go: route shard → host list."""
+
+    def __init__(self, placement: Placement) -> None:
+        self.placement = placement
+
+    @property
+    def replicas(self) -> int:
+        return self.placement.replica_factor
+
+    def hosts_for_shard(self, shard: int, readable_only: bool = False) -> list[str]:
+        return [
+            i.id
+            for i in self.placement.instances_for_shard(shard, readable_only=readable_only)
+        ]
+
+    def shard_state(self, instance_id: str, shard: int) -> ShardState | None:
+        inst = self.placement.instances.get(instance_id)
+        if inst is None:
+            return None
+        a = inst.shards.get(shard)
+        return a.state if a else None
+
+
+class DynamicTopology:
+    """topology/dynamic.go: re-derive the map on placement changes."""
+
+    def __init__(self, svc: PlacementService) -> None:
+        self.svc = svc
+        self.map: TopologyMap | None = None
+        self._listeners = []
+        svc.watch(self._on_placement)
+
+    def _on_placement(self, p: Placement) -> None:
+        self.map = TopologyMap(p)
+        for fn in list(self._listeners):
+            fn(self.map)
+
+    def listen(self, fn) -> None:
+        self._listeners.append(fn)
+        if self.map is not None:
+            fn(self.map)
