@@ -1,0 +1,150 @@
+"""Message bus: topics, shard-routed producers, acked at-least-once delivery.
+
+Reference: /root/reference/src/msg/ — topic.Service (topics + consumer
+services in KV, topic/), producer.Producer/Writer (producer/types.go:65,121;
+per-consumer-service writers, shard→consumer routing, ref-counted messages,
+ack tracking with retry in producer/writer/), consumer with ack flush
+(consumer/consumer.go). The wire protocol (size-prefixed protobuf over TCP,
+protocol/proto) is replaced by in-process queues behind the same seams; a
+network transport can slot in at Consumer.deliver.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..cluster.kv import KVStore
+
+
+@dataclass
+class ConsumerService:
+    name: str
+    consumption_type: str = "shared"  # shared | replicated (topic/types.go)
+
+
+@dataclass
+class Topic:
+    name: str
+    num_shards: int = 64
+    consumer_services: list[ConsumerService] = field(default_factory=list)
+
+
+class TopicService:
+    """topic.Service: topics stored in KV (topic/service.go)."""
+
+    def __init__(self, kv: KVStore) -> None:
+        self.kv = kv
+
+    def add(self, topic: Topic) -> None:
+        self.kv.set(
+            f"_topics/{topic.name}",
+            {
+                "numShards": topic.num_shards,
+                "consumers": [
+                    {"name": c.name, "type": c.consumption_type}
+                    for c in topic.consumer_services
+                ],
+            },
+        )
+
+    def get(self, name: str) -> Topic | None:
+        vv = self.kv.get(f"_topics/{name}")
+        if vv is None:
+            return None
+        return Topic(
+            name,
+            vv.value["numShards"],
+            [ConsumerService(c["name"], c["type"]) for c in vv.value["consumers"]],
+        )
+
+
+@dataclass
+class Message:
+    shard: int
+    payload: bytes
+    id: int = 0
+    acked: bool = False
+
+
+class Consumer:
+    """A consumer instance of one consumer service; processes + acks."""
+
+    def __init__(self, service: str, instance_id: str, handler: Callable[[Message], bool]) -> None:
+        self.service = service
+        self.id = instance_id
+        self.handler = handler  # returns True to ack
+        self.is_up = True
+
+    def deliver(self, msg: Message) -> bool:
+        if not self.is_up:
+            return False
+        return bool(self.handler(msg))
+
+
+class Producer:
+    """producer.Producer: route by shard to each consumer service, track
+    unacked messages, retry on a deadline (producer/writer/message_writer.go)."""
+
+    def __init__(self, topic: Topic, retry_interval: float = 0.05, max_retries: int = 8) -> None:
+        self.topic = topic
+        self.retry_interval = retry_interval
+        self.max_retries = max_retries
+        self._consumers: dict[str, list[Consumer]] = {}
+        self._next_id = 0
+        self._unacked: list[tuple[Message, str, int]] = []  # (msg, service, attempts)
+        self._lock = threading.RLock()
+
+    def register(self, consumer: Consumer) -> None:
+        with self._lock:
+            self._consumers.setdefault(consumer.service, []).append(consumer)
+
+    def _route(self, service: str, shard: int) -> list[Consumer]:
+        cs = self._consumers.get(service, [])
+        if not cs:
+            return []
+        svc = next((c for c in self.topic.consumer_services if c.name == service), None)
+        if svc and svc.consumption_type == "replicated":
+            return cs  # every instance gets every shard (replicated topic)
+        return [cs[shard % len(cs)]]  # shared: shard-owned instance
+
+    def produce(self, shard: int, payload: bytes) -> int:
+        """At-least-once: deliver to each consumer service; queue failures."""
+        with self._lock:
+            self._next_id += 1
+            mid = self._next_id
+        for svc in self.topic.consumer_services:
+            msg = Message(shard=shard % self.topic.num_shards, payload=payload, id=mid)
+            delivered = False
+            for c in self._route(svc.name, msg.shard):
+                if c.deliver(msg):
+                    delivered = True
+            if not delivered:
+                with self._lock:
+                    self._unacked.append((msg, svc.name, 0))
+        return mid
+
+    def retry_unacked(self) -> int:
+        """One retry sweep; returns messages still unacked. The reference
+        runs this on a timer (message_writer retryBatch)."""
+        with self._lock:
+            pending = self._unacked
+            self._unacked = []
+        still = []
+        for msg, service, attempts in pending:
+            delivered = False
+            for c in self._route(service, msg.shard):
+                if c.deliver(msg):
+                    delivered = True
+            if not delivered and attempts + 1 < self.max_retries:
+                still.append((msg, service, attempts + 1))
+        with self._lock:
+            self._unacked.extend(still)
+        return len(self._unacked)
+
+    @property
+    def num_unacked(self) -> int:
+        with self._lock:
+            return len(self._unacked)

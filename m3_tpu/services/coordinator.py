@@ -1,0 +1,277 @@
+"""Coordinator service: HTTP API front end over storage + engine + downsampler.
+
+Reference: /root/reference/src/query/server/query.go:177 (Run: storage,
+downsampler, engine, HTTP router) and src/query/api/v1/handler/ — Prometheus
+remote write (prometheus/remote/write.go:257, snappy+protobuf), remote read,
+PromQL native range/instant (native/read.go:120), label endpoints
+(native/complete_tags.go), admin namespace/placement/topic handlers, health.
+
+Served with the stdlib threading HTTP server — the process seam where the
+reference uses its router; handlers match the reference's routes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..aggregator.downsampler import Downsampler
+from ..block.core import make_tags
+from ..cluster.kv import KVStore
+from ..cluster.placement import PlacementService
+from ..gen import prompb_pb2 as prompb
+from ..metrics.types import MetricType
+from ..msg.bus import ConsumerService, Topic, TopicService
+from ..query.engine import Engine, Result
+from ..query.m3_storage import M3Storage
+from ..query.promql import Matcher
+from ..storage.database import Database, NamespaceOptions
+from ..utils.snappy import compress, decompress
+
+NANOS = 1_000_000_000
+MS = 1_000_000
+
+
+class Coordinator:
+    """The single-process coordinator: DB + engine + optional downsampler."""
+
+    def __init__(
+        self,
+        db: Database | None = None,
+        namespace: str = "default",
+        downsampler: Downsampler | None = None,
+        kv: KVStore | None = None,
+        base_dir: str | None = None,
+    ) -> None:
+        import tempfile
+
+        if db is None:
+            db = Database(base_dir or tempfile.mkdtemp(prefix="m3tpu-"), num_shards=4)
+            db.create_namespace(namespace, NamespaceOptions())
+        self.db = db
+        self.namespace = namespace
+        self.engine = Engine(M3Storage(db, namespace))
+        self.downsampler = downsampler
+        self.kv = kv or KVStore()
+        self.placement_svc = PlacementService(self.kv)
+        self.topic_svc = TopicService(self.kv)
+
+    # --- ingest (downsamplerAndWriter ingest/write.go:138) ---
+
+    def write_prom(self, req: prompb.WriteRequest) -> int:
+        count = 0
+        for ts in req.timeseries:
+            tags = make_tags([(l.name, l.value) for l in ts.labels])
+            for s in ts.samples:
+                t_nanos = s.timestamp * MS
+                keep = True
+                if self.downsampler is not None:
+                    keep = self.downsampler.write(tags, t_nanos, s.value, MetricType.GAUGE)
+                if keep:
+                    self.db.write_tagged(self.namespace, tags, t_nanos, s.value)
+                count += 1
+        return count
+
+    def read_prom(self, req: prompb.ReadRequest) -> prompb.ReadResponse:
+        resp = prompb.ReadResponse()
+        for q in req.queries:
+            matchers = []
+            for m in q.matchers:
+                op = {0: "=", 1: "!=", 2: "=~", 3: "!~"}[m.type]
+                matchers.append(Matcher(m.name, op, m.value))
+            result = resp.results.add()
+            raw = self.engine.storage.fetch(
+                matchers, q.start_timestamp_ms * MS, (q.end_timestamp_ms + 1) * MS
+            )
+            for tags, times, vals in raw:
+                ts = result.timeseries.add()
+                for k, v in tags:
+                    ts.labels.add(name=k.decode(), value=v.decode())
+                for t, v in zip(times, vals):
+                    ts.samples.add(value=float(v), timestamp=int(t) // MS)
+        return resp
+
+    def query_range(self, query: str, start_s: float, end_s: float, step_s: float) -> dict:
+        r = self.engine.query_range(
+            query, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
+        )
+        return _prom_matrix(r, int(start_s * NANOS), int(step_s * NANOS))
+
+    def query_instant(self, query: str, time_s: float) -> dict:
+        r = self.engine.query_instant(query, int(time_s * NANOS))
+        return _prom_vector(r, time_s)
+
+    def labels(self) -> list[str]:
+        ns = self.db.namespaces[self.namespace]
+        agg = ns.index.aggregate_query(None, 0, 2**62)
+        return sorted(k.decode() for k in agg)
+
+    def label_values(self, name: str) -> list[str]:
+        ns = self.db.namespaces[self.namespace]
+        agg = ns.index.aggregate_query(None, 0, 2**62, field_filter=[name.encode()])
+        return sorted(v.decode() for v in agg.get(name.encode(), ()))
+
+
+def _prom_matrix(r: Result, start_nanos: int, step_nanos: int) -> dict:
+    out = []
+    vals = np.asarray(r.values)
+    for i, meta in enumerate(r.metas):
+        metric = {k.decode(): v.decode() for k, v in meta.tags}
+        values = []
+        for t in range(vals.shape[1]):
+            v = vals[i, t]
+            if np.isnan(v):
+                continue
+            values.append([(start_nanos + t * step_nanos) / NANOS, _fmt(v)])
+        if values:
+            out.append({"metric": metric, "values": values})
+    return {"status": "success", "data": {"resultType": "matrix", "result": out}}
+
+
+def _prom_vector(r: Result, time_s: float) -> dict:
+    out = []
+    vals = np.asarray(r.values)
+    for i, meta in enumerate(r.metas):
+        v = vals[i, -1]
+        if np.isnan(v):
+            continue
+        metric = {k.decode(): v2.decode() for k, v2 in meta.tags}
+        out.append({"metric": metric, "value": [time_s, _fmt(v)]})
+    return {"status": "success", "data": {"resultType": "vector", "result": out}}
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(float(v))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    coordinator: Coordinator = None  # injected by serve()
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._send(code, json.dumps(obj).encode())
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+
+    def do_GET(self) -> None:
+        c = self.coordinator
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            if url.path == "/health":
+                self._json({"ok": True})
+            elif url.path == "/api/v1/query_range":
+                self._json(
+                    c.query_range(
+                        q["query"][0],
+                        float(q["start"][0]),
+                        float(q["end"][0]),
+                        _parse_step(q.get("step", ["15"])[0]),
+                    )
+                )
+            elif url.path == "/api/v1/query":
+                self._json(c.query_instant(q["query"][0], float(q["time"][0])))
+            elif url.path == "/api/v1/labels":
+                self._json({"status": "success", "data": c.labels()})
+            elif (m := re.match(r"^/api/v1/label/([^/]+)/values$", url.path)) is not None:
+                self._json({"status": "success", "data": c.label_values(m.group(1))})
+            elif url.path == "/api/v1/services/m3db/placement":
+                p = c.placement_svc.get()
+                self._json(p.to_dict() if p else {}, 200 if p else 404)
+            else:
+                self._json({"error": "not found"}, 404)
+        except Exception as exc:  # surface handler errors as 400s
+            self._json({"status": "error", "error": str(exc)}, 400)
+
+    def do_POST(self) -> None:
+        c = self.coordinator
+        url = urlparse(self.path)
+        try:
+            if url.path == "/api/v1/prom/remote/write":
+                raw = decompress(self._body())
+                req = prompb.WriteRequest()
+                req.ParseFromString(raw)
+                n = c.write_prom(req)
+                self._send(200, b"")
+            elif url.path == "/api/v1/prom/remote/read":
+                raw = decompress(self._body())
+                req = prompb.ReadRequest()
+                req.ParseFromString(raw)
+                resp = c.read_prom(req)
+                self._send(
+                    200,
+                    compress(resp.SerializeToString()),
+                    ctype="application/x-protobuf",
+                )
+            elif url.path == "/api/v1/json/write":
+                body = json.loads(self._body())
+                tags = make_tags(body["tags"])
+                c.db.write_tagged(
+                    c.namespace, tags, int(body["timestamp"] * NANOS), float(body["value"])
+                )
+                self._json({"ok": True})
+            elif url.path == "/api/v1/services/m3db/database/create":
+                body = json.loads(self._body())
+                name = body["namespaceName"]
+                opts = NamespaceOptions(
+                    retention_nanos=int(
+                        _parse_step(body.get("retentionTime", "48h")) * NANOS
+                    )
+                )
+                if name not in c.db.namespaces:
+                    c.db.create_namespace(name, opts)
+                self._json({"namespace": name}, 201)
+            elif url.path == "/api/v1/topic":
+                body = json.loads(self._body())
+                c.topic_svc.add(
+                    Topic(
+                        body["name"],
+                        body.get("numberOfShards", 64),
+                        [
+                            ConsumerService(s["serviceName"], s.get("consumptionType", "shared"))
+                            for s in body.get("consumerServices", [])
+                        ],
+                    )
+                )
+                self._json({"ok": True}, 201)
+            else:
+                self._json({"error": "not found"}, 404)
+        except Exception as exc:
+            self._json({"status": "error", "error": str(exc)}, 400)
+
+
+def _parse_step(s: str) -> float:
+    m = re.match(r"^(\d+(?:\.\d+)?)([smhd]?)$", s)
+    if not m:
+        raise ValueError(f"bad duration {s!r}")
+    mult = {"": 1, "s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
+    return float(m.group(1)) * mult
+
+
+def serve(coordinator: Coordinator, port: int = 0) -> tuple[ThreadingHTTPServer, int]:
+    """Start the HTTP server on a background thread; returns (server, port)."""
+    handler = type("BoundHandler", (_Handler,), {"coordinator": coordinator})
+    srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]

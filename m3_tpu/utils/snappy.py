@@ -1,0 +1,99 @@
+"""Pure-Python snappy block format codec.
+
+Prometheus remote read/write bodies are snappy-compressed protobuf
+(/root/reference/src/query/api/v1/handler/prometheus/remote/write.go:257).
+No snappy wheel ships in this environment, so: full-spec decompression, and
+spec-valid literal-only compression (a legal snappy stream — every
+decompressor accepts it; ratio 1.0 plus small framing overhead).
+"""
+
+from __future__ import annotations
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    if not data:
+        return b""
+    total, pos = _read_uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                length = int.from_bytes(data[pos : pos + extra], "little") + 1
+                pos += extra
+            out += data[pos : pos + length]
+            pos += length
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            length = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+        if offset == 0:
+            raise ValueError("snappy: zero copy offset")
+        start = len(out) - offset
+        if start < 0:
+            raise ValueError("snappy: offset before start")
+        # overlapping copies are byte-at-a-time semantics
+        for _ in range(length):
+            out.append(out[start])
+            start += 1
+    if len(out) != total:
+        raise ValueError(f"snappy: size mismatch {len(out)} != {total}")
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """Literal-only encoding: header + literal chunks (max 2^32-1 each)."""
+    out = bytearray(_write_uvarint(len(data)))
+    pos = 0
+    n = len(data)
+    if n == 0:
+        return bytes(out)
+    while pos < n:
+        chunk = data[pos : pos + 65536]
+        length = len(chunk)
+        if length <= 60:
+            out.append((length - 1) << 2)
+        else:
+            out.append(61 << 2)  # 2-byte length literal
+            out += (length - 1).to_bytes(2, "little")
+        out += chunk
+        pos += length
+    return bytes(out)
