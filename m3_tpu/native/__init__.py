@@ -1,0 +1,222 @@
+"""ctypes bindings for the native C++ codec (native/m3tsz.cc).
+
+Builds lazily with g++ if the shared library is missing; every entry point
+has a pure-Python fallback so the framework degrades gracefully on hosts
+without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_DIR, "libm3tsz.so"))
+_SRC_PATH = os.path.abspath(os.path.join(_DIR, "m3tsz.cc"))
+
+_lib = None
+
+
+class _SnapRec(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("off", ctypes.c_uint32),
+        ("prev_time", ctypes.c_uint64),
+        ("prev_delta", ctypes.c_uint64),
+        ("prev_float_bits", ctypes.c_uint64),
+        ("prev_xor", ctypes.c_uint64),
+        ("int_val", ctypes.c_uint64),
+        ("time_unit", ctypes.c_uint8),
+        ("sig", ctypes.c_uint8),
+        ("mult", ctypes.c_uint8),
+        ("is_float", ctypes.c_uint8),
+    ]
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC_PATH):
+        return False
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O3",
+                "-shared",
+                "-fPIC",
+                "-std=c++17",
+                "-o",
+                _LIB_PATH,
+                _SRC_PATH,
+                "-lpthread",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.m3tsz_encode_batch.restype = ctypes.c_int64
+    lib.m3tsz_encode_series.restype = ctypes.c_int64
+    lib.m3tsz_prescan.restype = ctypes.c_int32
+    lib.m3tsz_prescan_batch.restype = ctypes.c_int32
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _encode_batch_native(lib, times, values, lengths, default_unit, int_optimized, n_threads, cap):
+    out_buf = np.zeros(cap, np.uint8)
+    offsets = np.zeros(len(lengths) + 1, np.int64)
+    total = lib.m3tsz_encode_batch(
+        times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(len(lengths)),
+        ctypes.c_int(default_unit),
+        ctypes.c_int(1 if int_optimized else 0),
+        out_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(cap),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int32(n_threads),
+    )
+    return total, out_buf, offsets
+
+
+def encode_batch(
+    times: np.ndarray,
+    values: np.ndarray,
+    lengths: np.ndarray,
+    default_unit: int = 1,
+    int_optimized: bool = True,
+    n_threads: int = 0,
+) -> list[bytes]:
+    """Encode N series (concatenated columns) → list of finalized streams.
+
+    Falls back to the Python encoder when the native lib is unavailable."""
+    lib = load()
+    times = np.ascontiguousarray(times, np.int64)
+    values = np.ascontiguousarray(values, np.float64)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    n = len(lengths)
+    if lib is None:
+        from ..codec.m3tsz import encode_series
+        from ..utils.xtime import Unit
+
+        out = []
+        pos = 0
+        for ln in lengths:
+            out.append(
+                encode_series(
+                    times[pos : pos + ln].tolist(),
+                    values[pos : pos + ln].tolist(),
+                    int_optimized=int_optimized,
+                    unit=Unit(default_unit),
+                )
+            )
+            pos += ln
+        return out
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 1, 16)
+    cap = max(int(times.size * 16 + n * 16 + 1024), 4096)
+    total, out_buf, offsets = _encode_batch_native(
+        lib, times, values, lengths, default_unit, int_optimized, n_threads, cap
+    )
+    if total < 0:  # grow to the exact required size and retry once
+        total, out_buf, offsets = _encode_batch_native(
+            lib, times, values, lengths, default_unit, int_optimized, n_threads, -total
+        )
+    raw = out_buf.tobytes()
+    return [raw[offsets[i] : offsets[i + 1]] for i in range(n)]
+
+
+def prescan_batch(
+    streams: list[bytes],
+    k: int = 32,
+    default_unit: int = 1,
+    int_optimized: bool = True,
+    n_threads: int = 0,
+) -> list[list[dict]]:
+    """Side-table prescan for N streams → per-series snapshot dict lists
+    (same shape as ops.chunked.snapshot_stream)."""
+    lib = load()
+    if lib is None:
+        from ..ops.chunked import snapshot_stream
+        from ..utils.xtime import Unit
+
+        return [
+            snapshot_stream(s, k, int_optimized=int_optimized, default_unit=Unit(default_unit))
+            for s in streams
+        ]
+    n = len(streams)
+    if n == 0:
+        return []
+    data = b"".join(streams)
+    offsets = np.zeros(n + 1, np.int64)
+    for i, s in enumerate(streams):
+        offsets[i + 1] = offsets[i] + len(s)
+    max_len = max((len(s) for s in streams), default=0)
+    # record lower bound ~3 bits, so snapshots per stream are bounded by this
+    max_snaps = max((max_len * 8) // max(3 * k, 1) + 2, 2)
+    buf = (_SnapRec * (n * max_snaps))()
+    counts = np.zeros(n, np.int32)
+    arr = np.frombuffer(data, np.uint8) if data else np.zeros(1, np.uint8)
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 1, 16)
+    lib.m3tsz_prescan_batch(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int32(n),
+        ctypes.c_int32(k),
+        ctypes.c_int(default_unit),
+        ctypes.c_int(1 if int_optimized else 0),
+        buf,
+        ctypes.c_int32(max_snaps),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n_threads),
+    )
+    out: list[list[dict]] = []
+    for i in range(n):
+        total_bits = len(streams[i]) * 8
+        per = []
+        c = max(int(counts[i]), 0)
+        for j in range(c):
+            r = buf[i * max_snaps + j]
+            per.append(
+                dict(
+                    off=r.off,
+                    prev_time=r.prev_time,
+                    prev_delta=r.prev_delta,
+                    prev_float_bits=r.prev_float_bits,
+                    prev_xor=r.prev_xor,
+                    int_val=r.int_val,
+                    time_unit=r.time_unit,
+                    sig=r.sig,
+                    mult=r.mult,
+                    is_float=bool(r.is_float),
+                    total_bits=total_bits,
+                )
+            )
+        offs = [p["off"] for p in per] + [total_bits]
+        for j, p in enumerate(per):
+            p["span"] = offs[j + 1] - p["off"]
+        out.append(per)
+    return out

@@ -98,6 +98,8 @@ def write_fileset(
     chunk_k: int = CHUNK_K,
 ) -> None:
     """Write all fileset files, checkpoint LAST (write.go ordering)."""
+    from .. import native
+
     os.makedirs(_dir(base, fid), exist_ok=True)
     ids = sorted(series)
     data_parts: list[bytes] = []
@@ -106,9 +108,13 @@ def write_fileset(
     bloom = BloomFilter(_bloom_bits(max(len(ids), 1)))
     offset = 0
     summaries: list[bytes] = []
+    if native.available():
+        all_snaps = native.prescan_batch([series[sid] for sid in ids], k=chunk_k)
+    else:
+        all_snaps = [snapshot_stream(series[sid], chunk_k) for sid in ids]
     for i, sid in enumerate(ids):
         stream = series[sid]
-        snaps = snapshot_stream(stream, chunk_k)
+        snaps = all_snaps[i]
         side = np.zeros(len(snaps), SIDE_DTYPE)
         for j, p in enumerate(snaps):
             side[j] = (

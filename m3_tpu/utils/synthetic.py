@@ -41,17 +41,27 @@ def synthetic_streams(
     # actually exercised.
     jitter = rng.integers(-2, 3, size=(n_unique, n_points)) * unit.nanos()
     jitter[:, 0] = 0
-    streams = []
-    for i in range(n_unique):
-        if kind == "gauge":
-            vals = np.round(50 + np.cumsum(rng.normal(0, 1, n_points)), 2)
-        elif kind == "counter":
-            vals = np.cumsum(rng.integers(0, 100, n_points)).astype(np.float64)
-        else:
-            vals = rng.normal(0, 1, n_points)
-        t = (ts + jitter[i]).tolist()
-        streams.append(encode_series(t, vals.tolist(), unit=unit))
-    return streams
+    all_t = ts[None, :] + jitter
+    if kind == "gauge":
+        all_v = np.round(50 + np.cumsum(rng.normal(0, 1, (n_unique, n_points)), axis=1), 2)
+    elif kind == "counter":
+        all_v = np.cumsum(rng.integers(0, 100, (n_unique, n_points)), axis=1).astype(np.float64)
+    else:
+        all_v = rng.normal(0, 1, (n_unique, n_points))
+
+    from .. import native
+
+    if native.available():
+        return native.encode_batch(
+            all_t.ravel(),
+            all_v.ravel(),
+            np.full(n_unique, n_points, np.int32),
+            default_unit=int(unit),
+        )
+    return [
+        encode_series(all_t[i].tolist(), all_v[i].tolist(), unit=unit)
+        for i in range(n_unique)
+    ]
 
 
 def tiled_batch(
