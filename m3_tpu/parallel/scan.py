@@ -107,6 +107,40 @@ def chunked_device_args(batch: ChunkedBatch, device_put=True) -> dict:
     return lane_kwargs(batch, transform=put)
 
 
+def make_sharded_chunked_scan(mesh, s: int, c: int, k: int):
+    """Sharded flagship path: chunked decode + aggregate over the mesh.
+
+    Lane arrays are [S*C] series-major, so sharding axis 0 across N devices
+    keeps whole series on one device as long as S % N == 0 (pad with empty
+    series otherwise). Cross-series totals psum over the shard axis.
+    """
+    n_dev = mesh.devices.size
+    if s % n_dev != 0:
+        raise ValueError(f"series count {s} not divisible by mesh size {n_dev}")
+
+    def local(lane_args):
+        return chunked_scan_aggregate(lane_args, s // n_dev, c, k, with_psum=True)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS),),
+        out_specs=ScanAggregates(
+            series_sum=P(SHARD_AXIS),
+            series_count=P(SHARD_AXIS),
+            series_min=P(SHARD_AXIS),
+            series_max=P(SHARD_AXIS),
+            series_last=P(SHARD_AXIS),
+            total_sum=P(),
+            total_count=P(),
+            total_min=P(),
+            total_max=P(),
+        ),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
 def make_sharded_scan(mesh, max_points: int):
     """Build a pjit'd scan-and-aggregate over ``mesh``'s shard axis.
 
