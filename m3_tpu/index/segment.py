@@ -136,9 +136,11 @@ class SealedSegment:
     # --- persistence (m3ninx/persist segment file sets) ---
 
     def serialize(self) -> bytes:
+        from ..utils.serialize import encode_tags
+
         parts = [struct.pack("<I", len(self.docs))]
         for d in self.docs:
-            enc_fields = b"\x00".join(k + b"\x01" + v for k, v in d.fields)
+            enc_fields = encode_tags(d.fields)
             parts.append(struct.pack("<II", len(d.id), len(enc_fields)))
             parts.append(d.id)
             parts.append(enc_fields)
@@ -158,6 +160,8 @@ class SealedSegment:
 
     @staticmethod
     def deserialize(buf: bytes) -> "SealedSegment":
+        from ..utils.serialize import decode_tags
+
         pos = 0
         (n_docs,) = struct.unpack_from("<I", buf, pos)
         pos += 4
@@ -169,10 +173,7 @@ class SealedSegment:
             pos += id_len
             enc = buf[pos : pos + f_len]
             pos += f_len
-            fields_ = tuple(
-                tuple(p.split(b"\x01", 1)) for p in enc.split(b"\x00") if p
-            )
-            docs.append(Document(did, fields_))
+            docs.append(Document(did, decode_tags(enc)))
         (n_fields,) = struct.unpack_from("<I", buf, pos)
         pos += 4
         field_terms: dict[bytes, list[bytes]] = {}

@@ -212,9 +212,17 @@ def f64_bits_to_f32(a):
         jnp.float32
     )
     frac = mant * jnp.float32(2.0**-52)
-    e = jnp.clip(exp - 1023, -149, 128).astype(jnp.float32)
-    magnitude = (jnp.float32(1.0) + frac) * jnp.exp2(e)
-    magnitude = jnp.where(exp == 0, frac * jnp.exp2(jnp.float32(-126.0)), magnitude)
+    # Exact power-of-two scaling: bitcast (e+127)<<23 rather than jnp.exp2,
+    # which is a polynomial approximation on some backends (CPU) and loses
+    # ~2^-18 relative accuracy at large exponents.
+    def pow2(e_int):
+        bits = ((e_int + 127).astype(jnp.uint32)) << U32(23)
+        return lax.bitcast_convert_type(bits, jnp.float32)
+
+    e = jnp.clip(exp - 1023, -149, 128)
+    e1 = jnp.clip(e, -126, 127)
+    magnitude = (jnp.float32(1.0) + frac) * pow2(e1) * pow2(e - e1)
+    magnitude = jnp.where(exp == 0, frac * pow2(jnp.int32(-126)), magnitude)
     special = exp == 0x7FF
     inf = jnp.float32(jnp.inf)
     nan = jnp.float32(jnp.nan)

@@ -16,7 +16,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..ops.chunked import ChunkedBatch, decode_chunked_lanes
@@ -136,7 +136,7 @@ def make_sharded_chunked_scan(mesh, s: int, c: int, k: int):
             total_min=P(),
             total_max=P(),
         ),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -165,7 +165,7 @@ def make_sharded_scan(mesh, max_points: int):
             total_min=P(),
             total_max=P(),
         ),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(fn)
 

@@ -135,15 +135,19 @@ class ActiveRuleSet:
 
 
 def encode_tags_id(tags: Tags) -> bytes:
-    """Canonical tag-encoded metric ID (the role of metric/id/m3 ids)."""
-    return b",".join(k + b"=" + v for k, v in tags)
+    """Canonical tag-encoded metric ID (the role of metric/id/m3 ids).
+
+    Length-prefixed wire format (x/serialize/encoder.go:55-191 semantics) so
+    tag bytes containing ','/'=' can never produce colliding IDs.
+    """
+    from ..utils.serialize import encode_tags
+
+    return encode_tags(tags)
 
 
 def decode_tags_id(mid: bytes) -> Tags:
-    out = []
+    from ..utils.serialize import decode_tags
+
     if not mid:
         return ()
-    for part in mid.split(b","):
-        k, _, v = part.partition(b"=")
-        out.append((k, v))
-    return tuple(sorted(out))
+    return tuple(sorted(decode_tags(mid)))

@@ -1,0 +1,60 @@
+"""Force JAX onto a virtual N-device CPU host mesh.
+
+Single source of truth for the env hygiene needed in this image: a
+sitecustomize may pre-register a TPU PJRT plugin (gated on
+PALLAS_AXON_POOL_IPS) and force ``jax_platforms`` to it, so both an env-var
+scrub (for child processes, before interpreter start) and a post-import
+``jax.config.update`` (for an already-running interpreter) are required.
+Used by tests/conftest.py and __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Env vars that can override or re-route the platform choice.
+_PLATFORM_SELECTORS = (
+    "PJRT_DEVICE",
+    "JAX_PLATFORM_NAME",
+    "TPU_LIBRARY_PATH",
+    "PALLAS_AXON_POOL_IPS",
+)
+
+
+def cpu_mesh_env(n_devices: int, base: dict | None = None) -> dict:
+    """A copy of ``base`` (default os.environ) forcing an n-device CPU mesh.
+
+    For spawning child processes: takes effect before any jax import there.
+    """
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    for k in _PLATFORM_SELECTORS:
+        env.pop(k, None)
+    return env
+
+
+def force_cpu_mesh(n_devices: int) -> None:
+    """Force the CURRENT process onto an n-device CPU mesh.
+
+    Must run before jax creates any backend. Applies both the env scrub and
+    the config override (the latter wins over a plugin's sitecustomize-time
+    platform selection).
+    """
+    os.environ.update(
+        {k: v for k, v in cpu_mesh_env(n_devices).items() if k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    )
+    for k in _PLATFORM_SELECTORS:
+        os.environ.pop(k, None)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) >= n_devices, devs

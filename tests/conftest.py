@@ -2,12 +2,10 @@
 
 Multi-chip hardware is not available in CI; sharding tests run against
 ``--xla_force_host_platform_device_count=8`` per the build-plan test strategy
-(SURVEY.md §7). This must run before jax is imported anywhere.
+(SURVEY.md §7). All platform-forcing logic lives in
+m3_tpu.testing.cpu_mesh (shared with __graft_entry__.dryrun_multichip).
 """
 
-import os
+from m3_tpu.testing.cpu_mesh import force_cpu_mesh
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+force_cpu_mesh(8)

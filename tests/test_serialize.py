@@ -1,0 +1,87 @@
+"""Tag wire codec property tests.
+
+Reference counterpart: /root/reference/src/x/serialize/encode_decode_prop_test.go
+— arbitrary byte tags must round-trip uniquely; separator bytes (','/'=')
+inside names/values must never collide (the round-1 ad-hoc 'k=v,' join did).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from m3_tpu.utils.serialize import decode_tags, encode_tags, is_tag_id
+
+
+def test_roundtrip_basic():
+    tags = ((b"__name__", b"http_requests"), (b"job", b"api"))
+    assert decode_tags(encode_tags(tags)) == tags
+
+
+def test_sorted_canonical():
+    a = encode_tags([(b"b", b"2"), (b"a", b"1")])
+    b = encode_tags([(b"a", b"1"), (b"b", b"2")])
+    assert a == b
+
+
+def test_separator_bytes_do_not_collide():
+    # the classic ambiguity cases for 'k=v,' style joins
+    t1 = ((b"a", b"1,b=2"),)
+    t2 = ((b"a", b"1"), (b"b", b"2"))
+    assert encode_tags(t1) != encode_tags(t2)
+    t3 = ((b"a=1", b"x"),)
+    t4 = ((b"a", b"1=x"),)
+    assert encode_tags(t3) != encode_tags(t4)
+    for t in (t1, t2, t3, t4):
+        assert decode_tags(encode_tags(t)) == t
+
+
+def test_property_random_bytes_roundtrip_uniquely():
+    rng = random.Random(1234)
+
+    def rand_bytes():
+        n = rng.randrange(0, 24)
+        return bytes(rng.randrange(256) for _ in range(n))
+
+    seen = {}
+    for _ in range(500):
+        n_tags = rng.randrange(0, 6)
+        # unique names (tag sets are maps in the reference model)
+        names = set()
+        tags = []
+        for _ in range(n_tags):
+            k = rand_bytes()
+            if k in names:
+                continue
+            names.add(k)
+            tags.append((k, rand_bytes()))
+        tags = tuple(sorted(tags))
+        enc = encode_tags(tags)
+        assert decode_tags(enc) == tags
+        if enc in seen:
+            assert seen[enc] == tags  # same encoding => same tag set
+        seen[enc] = tags
+
+
+def test_empty_and_empty_values():
+    assert decode_tags(encode_tags(())) == ()
+    tags = ((b"", b""), (b"k", b""))
+    assert decode_tags(encode_tags(tags)) == tags
+
+
+def test_limits():
+    with pytest.raises(ValueError):
+        encode_tags(((b"k", b"x" * 70000),))
+
+
+def test_malformed_rejected():
+    enc = encode_tags(((b"a", b"b"),))
+    with pytest.raises(ValueError):
+        decode_tags(enc[:-1])  # truncated
+    with pytest.raises(ValueError):
+        decode_tags(enc + b"\x00")  # trailing garbage
+    with pytest.raises(ValueError):
+        decode_tags(b"\x00\x00\x00\x00")  # bad magic
+    assert is_tag_id(enc)
+    assert not is_tag_id(b"plain-series-id")
