@@ -79,8 +79,13 @@ class Session:
             if node is None or not node.is_up:
                 errors.append(f"{host}: down")
                 continue
-            node.write(self.namespace, sid, t_nanos, value, unit)
-            success += 1
+            try:
+                node.write(self.namespace, sid, t_nanos, value, unit)
+                success += 1
+            except Exception as exc:
+                # a raising replica must not abort the fan-out — remaining
+                # replicas can still reach quorum (session.go:1068)
+                errors.append(f"{host}: {exc}")
         if success < required:
             raise ConsistencyError("write", success, required, errors)
 
@@ -110,8 +115,11 @@ class Session:
                     merged = cur[1]
                     for dp in dps:
                         merged.setdefault(dp.timestamp, dp)
-        # consistency check per shard that has any owner
-        for shard, count in responded_by_shard.items():
+        # consistency check over EVERY shard in the placement — a shard whose
+        # replicas are all down has zero responders and must fail the read,
+        # not silently return partial results (session.go:1789-1815)
+        for shard in range(self.num_shards):
+            count = responded_by_shard.get(shard, 0)
             if count < required:
                 raise ConsistencyError("read", count, required, [f"shard {shard}"])
         out = []

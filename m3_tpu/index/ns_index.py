@@ -9,13 +9,20 @@ mutable segments into immutable ones.
 
 from __future__ import annotations
 
+import os
+import re as _re
+import struct
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..block.core import Tags
+from ..utils.blob import read_checked_blob, write_atomic_checked_blob
 from .query import Query, execute
 from .segment import Document, MutableSegment, SealedSegment
+
+_SEG_MAGIC = 0x6D334958  # "m3IX"
+_SEG_FILE_RE = _re.compile(r"^segments-(-?\d+)\.db$")
 
 
 class IndexBlock:
@@ -23,6 +30,9 @@ class IndexBlock:
         self.block_start = block_start
         self.mutable = MutableSegment()
         self.sealed: list[SealedSegment] = []
+        # set on insert/seal, cleared once persisted — so flush only rewrites
+        # blocks that actually changed
+        self.dirty = False
 
     @property
     def segments(self):
@@ -33,6 +43,7 @@ class IndexBlock:
         if len(self.mutable):
             self.sealed.append(self.mutable.seal())
             self.mutable = MutableSegment()
+            self.dirty = True
 
 
 @dataclass
@@ -58,7 +69,9 @@ class NamespaceIndex:
         return blk
 
     def write(self, series_id: bytes, tags: Tags, t_nanos: int) -> None:
-        self._block_for(t_nanos).mutable.insert(Document(series_id, tags))
+        blk = self._block_for(t_nanos)
+        blk.mutable.insert(Document(series_id, tags))
+        blk.dirty = True
 
     def write_batch(self, entries: list[tuple[bytes, Tags, int]]) -> None:
         for sid, tags, t in entries:
@@ -112,3 +125,68 @@ class NamespaceIndex:
     def evict_before(self, t_nanos: int) -> None:
         for bs in [b for b in self.blocks if b + self.block_size <= t_nanos]:
             del self.blocks[bs]
+
+    # --- persistence (storage/index.go:868 WarmFlush of index blocks +
+    # m3ninx/persist segment file sets) ---
+
+    @staticmethod
+    def _seg_dir(base: str, ns_name: str) -> str:
+        return os.path.join(base, "index", ns_name)
+
+    def persist_before(self, base: str, ns_name: str, t_nanos: int) -> list[str]:
+        """Seal blocks entirely before the cutoff and write each DIRTY
+        block's sealed segments to one atomically-replaced file
+        (utils/blob.py framing). Unchanged blocks are skipped so flush cost
+        does not grow with retention. Returns paths written."""
+        self.seal_before(t_nanos)
+        out = []
+        d = self._seg_dir(base, ns_name)
+        for bs, blk in sorted(self.blocks.items()):
+            if bs + self.block_size > t_nanos or not blk.sealed:
+                continue
+            path = os.path.join(d, f"segments-{bs}.db")
+            if not blk.dirty and os.path.exists(path):
+                continue
+            payloads = [seg.serialize() for seg in blk.sealed]
+            body = struct.pack("<I", len(payloads)) + b"".join(
+                struct.pack("<Q", len(p)) + p for p in payloads
+            )
+            write_atomic_checked_blob(path, _SEG_MAGIC, body)
+            blk.dirty = False
+            out.append(path)
+        return out
+
+    def load_persisted(self, base: str, ns_name: str) -> set[int]:
+        """Load persisted index blocks; returns the block starts restored.
+        Corrupt files read as absent (the block is then rebuilt from fileset
+        IDs by bootstrap)."""
+        d = self._seg_dir(base, ns_name)
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return set()
+        loaded: set[int] = set()
+        for n in sorted(names):
+            m = _SEG_FILE_RE.match(n)
+            if not m:
+                continue
+            bs = int(m.group(1))
+            body = read_checked_blob(os.path.join(d, n), _SEG_MAGIC)
+            if body is None:
+                continue
+            try:
+                (count,) = struct.unpack_from("<I", body, 0)
+                pos = 4
+                segs = []
+                for _ in range(count):
+                    (ln,) = struct.unpack_from("<Q", body, pos)
+                    pos += 8
+                    segs.append(SealedSegment.deserialize(body[pos : pos + ln]))
+                    pos += ln
+            except (struct.error, ValueError):
+                continue
+            blk = self._block_for(bs)
+            blk.sealed = segs
+            blk.dirty = False
+            loaded.add(bs)
+        return loaded

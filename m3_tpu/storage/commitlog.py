@@ -1,15 +1,24 @@
-"""Commit log: uncompressed append-only WAL with rotation and replay.
+"""Commit log: segmented append-only WAL with rotation, replay and cleanup.
 
 Reference: /root/reference/src/dbnode/persist/fs/commitlog/ — NewCommitLog
 (commit_log.go:249), batched async writes behind a single writer
 (writeBehind :804), flush interval/fsync policy, RotateLogs (:370), chunked
-reader (reader.go). Entries here are length-prefixed binary records; replay
-tolerates a torn final record (crash mid-append).
+reader (reader.go).
+
+The log is a directory of numbered segment files (``commitlog-<seq>.wal``).
+Rotation seals the active segment and opens the next; sealed segments are
+only DELETED once their entries are durable elsewhere (flushed filesets
+and/or snapshot files — the reference removes commit logs only when covered
+by snapshots, commit_log cleanup in storage/cleanup.go). Replay walks all
+segments in sequence order and tolerates a torn final record. Record CRCs
+cover series_id AND payload so a corrupted id cannot replay datapoints into
+the wrong series.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import struct
 import zlib
 from dataclasses import dataclass
@@ -17,7 +26,8 @@ from dataclasses import dataclass
 from ..utils.xtime import Unit
 
 _MAGIC = 0x6D33574C  # "m3WL"
-_HDR = struct.Struct("<IHI")  # crc32 of payload, id length, payload length
+_HDR = struct.Struct("<IHI")  # crc32 of (series_id + payload), id len, payload len
+_SEG_RE = re.compile(r"^commitlog-(\d+)\.wal$")
 
 
 @dataclass
@@ -29,19 +39,44 @@ class CommitLogEntry:
     annotation: bytes = b""
 
 
+def _seg_path(dir_path: str, seq: int) -> str:
+    return os.path.join(dir_path, f"commitlog-{seq}.wal")
+
+
+def _list_segments(dir_path: str) -> list[tuple[int, str]]:
+    try:
+        names = os.listdir(dir_path)
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in names:
+        m = _SEG_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dir_path, n)))
+    return sorted(out)
+
+
 class CommitLog:
-    """Single-writer WAL. fsync policy: "always" or batched every N writes
+    """Single-writer segmented WAL. fsync policy: batched every N writes
     (the reference's flush interval maps to flush_every here)."""
 
-    def __init__(self, path: str, flush_every: int = 64) -> None:
-        self.path = path
+    def __init__(self, dir_path: str, flush_every: int = 64) -> None:
+        self.dir = dir_path
         self.flush_every = flush_every
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "ab")
-        if self._f.tell() == 0:
-            self._f.write(struct.pack("<I", _MAGIC))
-            self._f.flush()
+        os.makedirs(dir_path, exist_ok=True)
+        segs = _list_segments(dir_path)
+        # a fresh segment per open — the previous process's tail stays sealed
+        self.active_seq = (segs[-1][0] + 1) if segs else 0
+        self._f = self._open_segment(self.active_seq)
         self._pending = 0
+
+    def _open_segment(self, seq: int):
+        f = open(_seg_path(self.dir, seq), "ab")
+        if f.tell() == 0:
+            f.write(struct.pack("<I", _MAGIC))
+            f.flush()
+            os.fsync(f.fileno())
+        return f
 
     def write(self, entry: CommitLogEntry) -> None:
         payload = (
@@ -54,11 +89,8 @@ class CommitLog:
             )
             + entry.annotation
         )
-        rec = (
-            _HDR.pack(zlib.crc32(payload), len(entry.series_id), len(payload))
-            + entry.series_id
-            + payload
-        )
+        crc = zlib.crc32(entry.series_id + payload)
+        rec = _HDR.pack(crc, len(entry.series_id), len(payload)) + entry.series_id + payload
         self._f.write(rec)
         self._pending += 1
         if self._pending >= self.flush_every:
@@ -78,20 +110,46 @@ class CommitLog:
         self.flush()
         self._f.close()
 
-    def rotate(self, new_path: str) -> str:
-        """RotateLogs (:370): seal current file, open a fresh one."""
+    def rotate(self) -> int:
+        """RotateLogs (:370): seal the active segment, open the next.
+        Returns the sealed segment's sequence number."""
+        sealed = self.active_seq
         self.close()
-        old = self.path
-        self.path = new_path
-        self._f = open(new_path, "ab")
-        if self._f.tell() == 0:
-            self._f.write(struct.pack("<I", _MAGIC))
-            self._f.flush()
-        return old
+        self.active_seq += 1
+        self._f = self._open_segment(self.active_seq)
+        self._pending = 0
+        return sealed
+
+    # --- cleanup (storage/cleanup.go commit-log removal semantics) ---
+
+    def inactive_segments(self) -> list[tuple[int, str]]:
+        return [(s, p) for s, p in _list_segments(self.dir) if s < self.active_seq]
+
+    def cleanup(self, covered) -> int:
+        """Delete sealed segments in which EVERY entry satisfies ``covered``
+        (a predicate CommitLogEntry -> bool, i.e. durable elsewhere).
+        Returns the number of segments removed."""
+        removed = 0
+        for _, path in self.inactive_segments():
+            if all(covered(e) for e in self.replay_segment(path)):
+                os.remove(path)
+                removed += 1
+        return removed
+
+    def remove_inactive(self) -> int:
+        """Delete ALL sealed segments (caller guarantees coverage, e.g. a
+        just-written snapshot of every buffer)."""
+        removed = 0
+        for _, path in self.inactive_segments():
+            os.remove(path)
+            removed += 1
+        return removed
+
+    # --- replay (reader.go) ---
 
     @staticmethod
-    def replay(path: str) -> list[CommitLogEntry]:
-        """reader.go: stream records; stop cleanly at a torn tail."""
+    def replay_segment(path: str) -> list[CommitLogEntry]:
+        """Stream records from one segment; stop cleanly at a torn tail."""
         out: list[CommitLogEntry] = []
         try:
             with open(path, "rb") as f:
@@ -109,10 +167,18 @@ class CommitLog:
                 break  # torn tail
             sid = buf[start : start + id_len]
             payload = buf[start + id_len : end]
-            if zlib.crc32(payload) != crc:
+            if zlib.crc32(sid + payload) != crc:
                 break  # corruption: stop replay (reference surfaces an error)
             t, v, unit, ann_len = struct.unpack_from("<qdBH", payload, 0)
             ann = payload[19 : 19 + ann_len]
             out.append(CommitLogEntry(sid, t, v, Unit(unit), ann))
             pos = end
+        return out
+
+    @staticmethod
+    def replay(dir_path: str) -> list[CommitLogEntry]:
+        """All entries across all segments, in write order."""
+        out: list[CommitLogEntry] = []
+        for _, path in _list_segments(dir_path):
+            out.extend(CommitLog.replay_segment(path))
         return out

@@ -11,14 +11,24 @@ uninitialized).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 
 from ..codec.m3tsz import Datapoint, decode
 from ..utils.hash import shard_for
+from ..utils.serialize import decode_tags, is_tag_id
 from ..utils.xtime import Unit
 from .commitlog import CommitLog, CommitLogEntry
-from .fs import CHUNK_K, FilesetID, FilesetReader, list_filesets, write_fileset
+from .fs import (
+    CHUNK_K,
+    FilesetID,
+    FilesetReader,
+    list_filesets,
+    read_index_ids,
+    write_fileset,
+)
 from .series import NANOS, SeriesBuffer
+from .snapshot import read_latest_snapshot, write_snapshot
 
 
 @dataclass
@@ -159,38 +169,46 @@ class Database:
         self.commitlog_enabled = commitlog_enabled
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
+        # Serializes write/read/flush across request threads — the reference
+        # guards these paths with per-shard locks (shard.go RLock/Lock); a
+        # single re-entrant lock is the current granularity.
+        self.lock = threading.RLock()
 
     def create_namespace(self, name: str, opts: NamespaceOptions | None = None) -> Namespace:
-        ns = Namespace(name, opts or NamespaceOptions(), self.num_shards, self.base)
-        self.namespaces[name] = ns
-        if self.commitlog_enabled:
-            self._commitlogs[name] = CommitLog(self._commitlog_path(name))
-        return ns
+        with self.lock:
+            ns = Namespace(name, opts or NamespaceOptions(), self.num_shards, self.base)
+            self.namespaces[name] = ns
+            if self.commitlog_enabled:
+                self._commitlogs[name] = CommitLog(self._commitlog_dir(name))
+            return ns
 
-    def _commitlog_path(self, ns: str) -> str:
-        return os.path.join(self.base, "commitlogs", f"{ns}.wal")
+    def _commitlog_dir(self, ns: str) -> str:
+        return os.path.join(self.base, "commitlogs", ns)
 
     def write(
         self, ns: str, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND
     ) -> None:
-        namespace = self.namespaces[ns]
-        cl = self._commitlogs.get(ns)
-        if cl is not None:
-            cl.write(CommitLogEntry(sid, t_nanos, value, unit))
-        namespace.shard_for(sid).write(sid, t_nanos, value, unit)
+        with self.lock:
+            namespace = self.namespaces[ns]
+            cl = self._commitlogs.get(ns)
+            if cl is not None:
+                cl.write(CommitLogEntry(sid, t_nanos, value, unit))
+            namespace.shard_for(sid).write(sid, t_nanos, value, unit)
 
     def write_batch(self, ns: str, entries: list[tuple[bytes, int, float]]) -> None:
-        namespace = self.namespaces[ns]
-        cl = self._commitlogs.get(ns)
-        if cl is not None:
-            cl.write_batch(
-                [CommitLogEntry(sid, t, v) for sid, t, v in entries]
-            )
-        for sid, t, v in entries:
-            namespace.shard_for(sid).write(sid, t, v)
+        with self.lock:
+            namespace = self.namespaces[ns]
+            cl = self._commitlogs.get(ns)
+            if cl is not None:
+                cl.write_batch(
+                    [CommitLogEntry(sid, t, v) for sid, t, v in entries]
+                )
+            for sid, t, v in entries:
+                namespace.shard_for(sid).write(sid, t, v)
 
     def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
-        return self.namespaces[ns].shard_for(sid).read(sid, start, end)
+        with self.lock:
+            return self.namespaces[ns].shard_for(sid).read(sid, start, end)
 
     # --- tagged write / index query path (database.go:606 WriteTagged,
     # :785 QueryIDs; network FetchTagged mirrors this) ---
@@ -201,73 +219,189 @@ class Database:
         from ..rules.rules import encode_tags_id
 
         sid = encode_tags_id(tags)
-        namespace = self.namespaces[ns]
-        if namespace.index is not None:
-            namespace.index.write(sid, tags, t_nanos)
-        self.write(ns, sid, t_nanos, value, unit)
+        with self.lock:
+            namespace = self.namespaces[ns]
+            if namespace.index is not None:
+                namespace.index.write(sid, tags, t_nanos)
+            self.write(ns, sid, t_nanos, value, unit)
         return sid
 
     def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None):
-        namespace = self.namespaces[ns]
-        if namespace.index is None:
-            raise RuntimeError(f"namespace {ns} has no index")
-        return namespace.index.query(query, start, end, limit=limit)
+        with self.lock:
+            namespace = self.namespaces[ns]
+            if namespace.index is None:
+                raise RuntimeError(f"namespace {ns} has no index")
+            return namespace.index.query(query, start, end, limit=limit)
 
     def fetch_tagged(
         self, ns: str, query, start: int, end: int, limit: int | None = None
     ) -> list[tuple[bytes, tuple, list[Datapoint]]]:
         """Index query + per-series read (the FetchTagged server path,
         tchannelthrift/node/service.go:626)."""
-        result = self.query_ids(ns, query, start, end, limit=limit)
-        out = []
-        for doc in result.docs:
-            out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
-        return out
+        with self.lock:
+            result = self.query_ids(ns, query, start, end, limit=limit)
+            out = []
+            for doc in result.docs:
+                out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
+            return out
 
     def flush(self, ns: str, flush_before_nanos: int) -> list[FilesetID]:
-        out = []
-        for shard in self.namespaces[ns].shards:
-            out.extend(shard.warm_flush(flush_before_nanos))
-            if self.namespaces[ns].opts.cold_writes_enabled:
-                out.extend(shard.cold_flush(flush_before_nanos))
-        # flushed data is durable: rotate the WAL (snapshot+truncate role)
-        cl = self._commitlogs.get(ns)
-        if cl is not None:
-            old = cl.rotate(self._commitlog_path(ns) + ".new")
-            os.replace(cl.path, old)
-            cl.path = old
-        return out
+        with self.lock:
+            namespace = self.namespaces[ns]
+            out = []
+            for shard in namespace.shards:
+                out.extend(shard.warm_flush(flush_before_nanos))
+                if namespace.opts.cold_writes_enabled:
+                    out.extend(shard.cold_flush(flush_before_nanos))
+            # Rotate the WAL, then drop only sealed segments whose every entry
+            # is now durable in a flushed fileset. Coverage is BLOCK-aligned:
+            # only entries whose whole block is before the cutoff were
+            # flushed (streams_before), so an entry in a partial block at the
+            # cutoff edge keeps its segment alive. With cold writes enabled,
+            # warm+cold flush together make every such point durable; with
+            # cold writes disabled, late points in already-flushed blocks are
+            # never durable, so segments are kept (the reference removes
+            # commit logs only once covered by snapshot/fileset data —
+            # storage/cleanup.go).
+            cl = self._commitlogs.get(ns)
+            if cl is not None:
+                cl.rotate()
+                if namespace.opts.cold_writes_enabled:
+                    bsz = namespace.opts.block_size_nanos
+                    cl.cleanup(
+                        lambda e: (e.time_nanos // bsz) * bsz + bsz
+                        <= flush_before_nanos
+                    )
+            # WarmFlush of index blocks (storage/index.go:868): seal + persist
+            if namespace.index is not None:
+                namespace.index.persist_before(self.base, ns, flush_before_nanos)
+            return out
+
+    def snapshot(self, ns: str) -> int:
+        """shard.go:2335 Snapshot: capture every un-flushed buffer stream so
+        commit-log replay is bounded. Returns the number of records written.
+        All sealed WAL segments become removable afterwards: their entries are
+        either in flushed filesets or in this snapshot."""
+        with self.lock:
+            namespace = self.namespaces[ns]
+            total = 0
+            for shard in namespace.shards:
+                records = []
+                for sid, buf in shard.series.items():
+                    for bs, bucket in buf.buckets.items():
+                        stream = bucket.merged_stream()
+                        if stream:
+                            records.append((sid, bs, stream))
+                write_snapshot(self.base, ns, shard.id, records)
+                total += len(records)
+            cl = self._commitlogs.get(ns)
+            if cl is not None:
+                cl.rotate()
+                cl.remove_inactive()
+            return total
 
     def tick(self, now_nanos: int) -> None:
-        for ns in self.namespaces.values():
-            for shard in ns.shards:
-                shard.tick(now_nanos)
+        with self.lock:
+            for ns in self.namespaces.values():
+                for shard in ns.shards:
+                    shard.tick(now_nanos)
 
     # --- bootstrap chain (bootstrap/process.go:147) ---
 
+    def _reindex(self, namespace: Namespace, sid: bytes, t_nanos: int) -> None:
+        """Rebuild reverse-index state for a recovered series. Series IDs are
+        the canonical tag wire format (utils/serialize.py), so tags are
+        recoverable from the ID alone."""
+        if namespace.index is not None and is_tag_id(sid):
+            try:
+                tags = tuple(sorted(decode_tags(sid)))
+            except ValueError:
+                return
+            namespace.index.write(sid, tags, t_nanos)
+
     def bootstrap(self) -> dict:
-        """filesystem → commitlog → (peers, uninitialized) — the fs source is
-        implicit (filesets are read lazily at query time once complete); the
-        commitlog source replays WAL entries into buffers."""
-        result = {"commitlog_entries": 0, "filesets": 0}
-        for name, ns in self.namespaces.items():
-            for shard in ns.shards:
-                fids = list_filesets(self.base, name, shard.id)
-                result["filesets"] += len(fids)
-                for fid in fids:
-                    shard._flushed_blocks.add(fid.block_start)
-            entries = CommitLog.replay(self._commitlog_path(name))
-            for e in entries:
-                sh = ns.shard_for(e.series_id)
-                # skip points already covered by a complete flushed block
-                bs = (e.time_nanos // ns.opts.block_size_nanos) * ns.opts.block_size_nanos
-                if bs in sh._flushed_blocks:
-                    continue
-                sh.write(e.series_id, e.time_nanos, e.value, e.unit)
-            result["commitlog_entries"] += len(entries)
-        self.bootstrapped = True
-        return result
+        """filesystem → snapshot → commitlog — the fs source marks flushed
+        blocks (fileset data is read lazily at query time) and re-indexes
+        flushed series; the snapshot source restores buffered streams; the
+        commitlog source replays remaining WAL segments into buffers.
+
+        Replay never skips entries: a replayed point that also exists in a
+        flushed fileset dedupes at read/merge time, whereas skipping loses
+        cold writes that were logged but not yet cold-flushed."""
+        with self.lock:
+            result = {"commitlog_entries": 0, "filesets": 0, "snapshot_records": 0}
+            for name, ns in self.namespaces.items():
+                # persisted index blocks load wholesale; blocks without one
+                # are rebuilt below from fileset IDs (tag wire format)
+                persisted: set[int] = set()
+                if ns.index is not None:
+                    persisted = ns.index.load_persisted(self.base, name)
+                for shard in ns.shards:
+                    fids = list_filesets(self.base, name, shard.id)
+                    result["filesets"] += len(fids)
+                    for fid in fids:
+                        shard._flushed_blocks.add(fid.block_start)
+                        if fid.block_start in persisted:
+                            continue
+                        for sid in read_index_ids(self.base, fid):
+                            self._reindex(ns, sid, fid.block_start)
+                    snap = read_latest_snapshot(self.base, name, shard.id)
+                    if snap:
+                        for sid, bs, stream in snap:
+                            for dp in decode(stream):
+                                shard.write(sid, dp.timestamp, dp.value, dp.unit)
+                            self._reindex(ns, sid, bs)
+                        result["snapshot_records"] += len(snap)
+                entries = CommitLog.replay(self._commitlog_dir(name))
+                # Re-buffering a point that already sits in a flushed fileset
+                # would make the next cold_flush rewrite an identical volume,
+                # so entries for flushed blocks are checked against the
+                # fileset first (decoded lazily, cached per (shard, block,
+                # series)). Points NOT in the fileset are genuine un-flushed
+                # cold writes and must replay.
+                cover: dict[tuple[int, int], FilesetReader | None] = {}
+                pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
+                bsz = ns.opts.block_size_nanos
+
+                def _covered(sh: Shard, e: CommitLogEntry) -> bool:
+                    bs = (e.time_nanos // bsz) * bsz
+                    if bs not in sh._flushed_blocks:
+                        return False
+                    rk = (sh.id, bs)
+                    if rk not in cover:
+                        fid = next(
+                            (
+                                f
+                                for f in list_filesets(self.base, name, sh.id)
+                                if f.block_start == bs
+                            ),
+                            None,
+                        )
+                        cover[rk] = FilesetReader(self.base, fid) if fid else None
+                    reader = cover[rk]
+                    if reader is None:
+                        return False
+                    pk = (sh.id, bs, e.series_id)
+                    if pk not in pts:
+                        stream = reader.stream(e.series_id)
+                        pts[pk] = (
+                            {dp.timestamp: dp.value for dp in decode(stream)}
+                            if stream
+                            else {}
+                        )
+                    return pts[pk].get(e.time_nanos) == e.value
+
+                for e in entries:
+                    sh = ns.shard_for(e.series_id)
+                    if _covered(sh, e):
+                        continue
+                    sh.write(e.series_id, e.time_nanos, e.value, e.unit)
+                    self._reindex(ns, e.series_id, e.time_nanos)
+                result["commitlog_entries"] += len(entries)
+            self.bootstrapped = True
+            return result
 
     def close(self) -> None:
-        for cl in self._commitlogs.values():
-            cl.close()
+        with self.lock:
+            for cl in self._commitlogs.values():
+                cl.close()

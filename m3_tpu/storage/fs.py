@@ -209,6 +209,23 @@ def list_filesets(base: str, namespace: str, shard: int) -> list[FilesetID]:
     return sorted(best.values(), key=lambda f: f.block_start)
 
 
+def read_index_ids(base: str, fid: FilesetID) -> list[bytes]:
+    """Series IDs of a complete fileset, reading ONLY the index file (used by
+    bootstrap to re-index flushed series without touching data/side files)."""
+    if not fileset_complete(base, fid):
+        raise FileNotFoundError(f"incomplete fileset {fid}")
+    with open(_path(base, fid, "index"), "rb") as f:
+        buf = f.read()
+    out = []
+    pos = 0
+    while pos < len(buf):
+        id_len, _, _, _ = struct.unpack_from("<IIQI", buf, pos)
+        pos += 20
+        out.append(buf[pos : pos + id_len])
+        pos += id_len
+    return out
+
+
 class FilesetReader:
     """read.go + seek.go: id lookup via bloom → index search → data slice."""
 

@@ -1,0 +1,95 @@
+"""Snapshot files: periodic capture of un-flushed series buffers.
+
+Reference: /root/reference/src/dbnode/storage/shard.go:2335 (Snapshot) +
+persist/fs/snapshot_metadata_{read,write}.go — snapshots bound commit-log
+replay: once a snapshot of every buffer is durable, all earlier WAL segments
+can be removed, and bootstrap = filesets + latest snapshot + WAL tail.
+
+One snapshot file per (namespace, shard), atomically replaced
+(utils/blob.py); records are (series_id, block_start, m3tsz stream). Only the
+newest sequence is kept.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+
+from ..utils.blob import read_checked_blob, write_atomic_checked_blob
+
+_MAGIC = 0x6D33534E  # "m3SN"
+_REC = struct.Struct("<IqI")  # id len, block_start, stream len
+_SNAP_RE = re.compile(r"^snapshot-(\d+)\.db$")
+
+
+def _dir(base: str, ns: str, shard: int) -> str:
+    return os.path.join(base, "snapshots", ns, str(shard))
+
+
+def _list(base: str, ns: str, shard: int) -> list[tuple[int, str]]:
+    d = _dir(base, ns, shard)
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in names:
+        m = _SNAP_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(d, n)))
+    return sorted(out)
+
+
+def write_snapshot(
+    base: str, ns: str, shard: int, records: list[tuple[bytes, int, bytes]]
+) -> int:
+    """Write records [(series_id, block_start, stream)]; returns the new
+    sequence number. Older snapshots are removed after the new one commits."""
+    existing = _list(base, ns, shard)
+    seq = (existing[-1][0] + 1) if existing else 0
+    parts = [struct.pack("<I", len(records))]
+    for sid, bs, stream in records:
+        parts.append(_REC.pack(len(sid), bs, len(stream)))
+        parts.append(sid)
+        parts.append(stream)
+    write_atomic_checked_blob(
+        os.path.join(_dir(base, ns, shard), f"snapshot-{seq}.db"),
+        _MAGIC,
+        b"".join(parts),
+    )
+    for _, path in existing:
+        os.remove(path)
+    return seq
+
+
+def read_latest_snapshot(
+    base: str, ns: str, shard: int
+) -> list[tuple[bytes, int, bytes]] | None:
+    """Records of the newest valid snapshot, or None. A corrupt newest file
+    falls back to the next-newest (the atomic replace makes this rare)."""
+    for _, path in reversed(_list(base, ns, shard)):
+        body = read_checked_blob(path, _MAGIC)
+        if body is None:
+            continue
+        (count,) = struct.unpack_from("<I", body, 0)
+        pos = 4
+        out = []
+        ok = True
+        for _ in range(count):
+            if pos + _REC.size > len(body):
+                ok = False
+                break
+            id_len, bs, s_len = _REC.unpack_from(body, pos)
+            pos += _REC.size
+            sid = body[pos : pos + id_len]
+            pos += id_len
+            stream = body[pos : pos + s_len]
+            pos += s_len
+            if len(sid) != id_len or len(stream) != s_len:
+                ok = False
+                break
+            out.append((sid, bs, stream))
+        if ok:
+            return out
+    return None

@@ -1,0 +1,49 @@
+"""Atomic checksummed blob files.
+
+Shared framing for small durable state files (buffer snapshots, persisted
+index segments): ``<u32 magic><body><u32 crc32(magic+body)>`` written to a
+temp file, fsync'd, then atomically os.replace'd into place. Readers get the
+body back only if magic and CRC check out — a torn or corrupt file reads as
+absent, which is the recovery semantic every caller wants (the reference's
+digest/checkpoint pairing plays this role for filesets, persist/fs/fs.go).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+_U32 = struct.Struct("<I")
+
+
+def write_atomic_checked_blob(path: str, magic: int, body: bytes) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    head = _U32.pack(magic)
+    blob = head + body + _U32.pack(zlib.crc32(head + body))
+    tmp = os.path.join(
+        os.path.dirname(path), f".{os.path.basename(path)}.tmp"
+    )
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_checked_blob(path: str, magic: int) -> bytes | None:
+    """Body bytes, or None when missing/torn/corrupt/wrong-magic."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    if len(blob) < 2 * _U32.size:
+        return None
+    (got_magic,) = _U32.unpack_from(blob, 0)
+    if got_magic != magic:
+        return None
+    body, (crc,) = blob[_U32.size : -_U32.size], _U32.unpack(blob[-_U32.size :])
+    if zlib.crc32(blob[: -_U32.size]) != crc:
+        return None
+    return body
