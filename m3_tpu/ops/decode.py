@@ -44,17 +44,26 @@ _ANNOTATION = 1
 _TIME_UNIT = 2
 
 # Unit code -> nanos multiplier; only s/ms/us/ns decodable on device.
+# Selected with where-chains (not jnp.take): per-lane gathers from tiny
+# tables don't lower well on TPU/Pallas, selects do.
 _UNIT_NANOS = np.zeros(9, dtype=np.uint32)
 _UNIT_NANOS[Unit.SECOND] = 1_000_000_000
 _UNIT_NANOS[Unit.MILLISECOND] = 1_000_000
 _UNIT_NANOS[Unit.MICROSECOND] = 1_000
 _UNIT_NANOS[Unit.NANOSECOND] = 1
-# Default dod bucket width: 32 bits for s/ms, 64 for us/ns (scheme.go:47-52).
-_UNIT_DEFAULT_BITS = np.zeros(9, dtype=np.int32)
-_UNIT_DEFAULT_BITS[Unit.SECOND] = 32
-_UNIT_DEFAULT_BITS[Unit.MILLISECOND] = 32
-_UNIT_DEFAULT_BITS[Unit.MICROSECOND] = 64
-_UNIT_DEFAULT_BITS[Unit.NANOSECOND] = 64
+
+
+def _unit_nanos(unit):
+    out = jnp.zeros_like(unit).astype(U32)
+    for code in (Unit.SECOND, Unit.MILLISECOND, Unit.MICROSECOND, Unit.NANOSECOND):
+        out = jnp.where(unit == int(code), U32(_UNIT_NANOS[code]), out)
+    return out
+
+
+def _unit_default_bits(unit):
+    # Default dod bucket width: 32 bits for s/ms, 64 for us/ns (scheme.go:47-52).
+    is32 = (unit == int(Unit.SECOND)) | (unit == int(Unit.MILLISECOND))
+    return jnp.where(is32, I32(32), I32(64))
 
 
 class DecodeState(NamedTuple):
@@ -167,7 +176,6 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
 
     # --- time-unit marker: 8-bit unit byte follows ---
     new_unit = _extract32(ws, jnp.full_like(pos, _MARKER_BITS), jnp.full_like(pos, 8)).astype(I32)
-    unit_nanos_tab = jnp.asarray(_UNIT_NANOS)
     tu_supported = (new_unit >= 1) & (new_unit <= 4)
     tu_changed = tu_marker & tu_supported & (new_unit != state.time_unit)
     time_unit = jnp.where(tu_marker & tu_supported, new_unit, state.time_unit)
@@ -187,14 +195,14 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
     sel7 = (b0 == 1) & (b1 == 0)
     sel9 = (b0 == 1) & (b1 == 1) & (b2 == 0)
     sel12 = (b0 == 1) & (b1 == 1) & (b2 == 1) & (b3 == 0)
-    default_bits = jnp.take(jnp.asarray(_UNIT_DEFAULT_BITS), jnp.clip(time_unit, 0, 8))
+    default_bits = _unit_default_bits(time_unit)
     nbits = jnp.where(
         sel7, 7, jnp.where(sel9, 9, jnp.where(sel12, 12, default_bits))
     ).astype(I32)
     opbits = jnp.where(sel7, 2, jnp.where(sel9, 3, 4)).astype(I32)
     raw = _extract(ws, dod_off + opbits, nbits)
     dod_norm = u64.sign_extend(raw, nbits)
-    unit_nanos = jnp.take(unit_nanos_tab, jnp.clip(time_unit, 0, 8))
+    unit_nanos = _unit_nanos(time_unit)
     dod_bucket = u64.mul_u32(dod_norm, unit_nanos)
     bucket_consumed = jnp.where(zero_dod, 1, opbits + nbits)
 
@@ -367,10 +375,10 @@ def _decode_value(fetch4, state, first, int_optimized: bool):
     sel_stay_int = ~first & stay & ~state.is_float
     sel_repeat = ~first & repeat
 
-    new_is_float = jnp.where(
-        sel_first_float | sel_to_float,
-        True,
-        jnp.where(sel_first_int | sel_to_int, False, state.is_float),
+    # Boolean algebra, not jnp.where(pred, True/False, ...): bool splat
+    # constants lower to i8 vectors Mosaic can't truncate back to i1.
+    new_is_float = (sel_first_float | sel_to_float) | (
+        ~(sel_first_int | sel_to_int) & state.is_float
     )
 
     # float bits: full float on first/to_float; XOR result when staying float.
@@ -491,10 +499,10 @@ def decode_batched(
 
 def _int_val_to_f32(pair, mult):
     v = u64.to_f32(pair)
-    scale = jnp.take(
-        jnp.asarray([1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6], jnp.float32),
-        jnp.clip(mult, 0, 6),
-    )
+    scale = jnp.full_like(v, 1.0)
+    for m, s in enumerate((1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6)):
+        if m:
+            scale = jnp.where(mult == m, jnp.float32(s), scale)
     return v / scale
 
 

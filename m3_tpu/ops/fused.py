@@ -1,0 +1,307 @@
+"""Fused chunked decode + aggregation: the flagship TPU kernel.
+
+Round-1 decode materialized 7 u64 [S, T] outputs from the scan and aggregated
+afterwards — every step streamed a multi-hundred-MB carry plus outputs through
+HBM. Here the whole K-step decode loop runs with its state resident on-chip
+and only per-LANE aggregates (sum/count/min/max/last) leave the kernel:
+
+  - Pallas path (TPU): grid over lane tiles of 8x128; each program loads its
+    tile's window columns into VMEM once and runs the K-record loop as a
+    fori_loop, state in vector registers/VMEM. HBM traffic = windows once +
+    [N] accumulators once.
+  - jnp path (CPU fallback + oracle): identical math as a lax.scan with
+    accumulators in the carry and NO per-step outputs.
+
+Record semantics are decode.py's branchless M3TSZ step (reference hot loop:
+/root/reference/src/dbnode/encoding/m3tsz/iterator.go:64, istream.go:97);
+aggregation matches parallel/scan._aggregate_decoded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import u64
+from .chunked import _fetch4_select, _window_columns
+from .decode import (
+    DecodeState,
+    _decode_timestamp,
+    _decode_value,
+    _extract,
+    _int_val_to_f32,
+)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+
+LANE_TILE = (8, 128)  # native f32/i32 VPU tile
+TILE_LANES = LANE_TILE[0] * LANE_TILE[1]
+
+
+class LaneAggregates(NamedTuple):
+    """Per-lane (= per chunk) reductions emitted by the fused kernel."""
+
+    sum: jnp.ndarray  # f32[N]
+    count: jnp.ndarray  # i32[N]
+    min: jnp.ndarray  # f32[N] (+inf where empty)
+    max: jnp.ndarray  # f32[N] (-inf where empty)
+    last: jnp.ndarray  # f32[N] (value of last valid record in the lane)
+    err: jnp.ndarray  # bool/i32[N]
+
+
+def _init_state(rel_pos, num_bits, prev_time, prev_delta, prev_float_bits,
+                prev_xor, int_val, time_unit, sig, mult, is_float):
+    as_pair = lambda p: (jnp.asarray(p[0], U32), jnp.asarray(p[1], U32))
+    shape = rel_pos.shape
+    return DecodeState(
+        pos=jnp.zeros(shape, I32),
+        done=jnp.asarray(num_bits, I32) <= jnp.asarray(rel_pos, I32),
+        err=jnp.zeros(shape, bool),
+        prev_time=as_pair(prev_time),
+        prev_delta=as_pair(prev_delta),
+        time_unit=jnp.asarray(time_unit, I32),
+        prev_float_bits=as_pair(prev_float_bits),
+        prev_xor=as_pair(prev_xor),
+        int_val=as_pair(int_val),
+        mult=jnp.asarray(mult, I32),
+        sig=jnp.asarray(sig, I32),
+        is_float=jnp.asarray(is_float, bool),
+    )
+
+
+def _fused_step(fetch4, nb, nt0, first_chunk_i32, int_optimized, carry, idx):
+    """Decode ONE record for every lane and fold it into the accumulators.
+
+    ``first_chunk_i32`` is int32, not bool: every value closed over by the
+    loop body is threaded through the while-op carry, and Mosaic cannot
+    round-trip i1 vector carries (it stores them as i8 and the trunc back is
+    unsupported). Mask math stays in int32 until the final compare.
+    """
+    state, acc = carry
+    s_sum, s_cnt, s_min, s_max, s_last = acc
+    first_vec = (first_chunk_i32 * jnp.where(idx == 0, I32(1), I32(0))) != 0
+    was_active = ~state.done & ~state.err
+    state, _ = _decode_timestamp(fetch4, nb, state, first_vec, nt=nt0)
+    ts_active = ~state.done & ~state.err
+    state = _decode_value(fetch4, state, first_vec, int_optimized)
+    now_active = ~state.done & ~state.err
+    valid = was_active & ts_active & now_active
+
+    if int_optimized:
+        point_is_float = state.is_float
+        val = u64.select(point_is_float, state.prev_float_bits, state.int_val)
+        v = jnp.where(
+            point_is_float,
+            u64.f64_bits_to_f32(val),
+            _int_val_to_f32(val, state.mult),
+        )
+    else:
+        v = u64.f64_bits_to_f32(state.prev_float_bits)
+    s_sum = s_sum + jnp.where(valid, v, F32(0))
+    s_cnt = s_cnt + valid.astype(I32)
+    s_min = jnp.minimum(s_min, jnp.where(valid, v, F32(jnp.inf)))
+    s_max = jnp.maximum(s_max, jnp.where(valid, v, F32(-jnp.inf)))
+    s_last = jnp.where(valid, v, s_last)
+    return state, (s_sum, s_cnt, s_min, s_max, s_last)
+
+
+def _run_lane_tile(windows_cols, rel_pos, num_bits, first, prev_time, prev_delta,
+                   prev_float_bits, prev_xor, int_val, time_unit, sig, mult,
+                   is_float, k: int, cw: int, int_optimized: bool,
+                   use_scan: bool) -> LaneAggregates:
+    """Shared body: decode K records over one set of lanes (any shape) with
+    window columns already materialized, accumulating aggregates."""
+    rel_pos = jnp.asarray(rel_pos, I32)
+    fetch4 = functools.partial(_fetch4_select, windows_cols, cw, rel_pos)
+    state = _init_state(rel_pos, num_bits, prev_time, prev_delta,
+                        prev_float_bits, prev_xor, int_val, time_unit, sig,
+                        mult, is_float)
+    first_chunk_i32 = jnp.asarray(first).astype(I32)
+    nb = jnp.asarray(num_bits, I32) - rel_pos
+    zero_pos = jnp.zeros_like(rel_pos)
+    nt0 = _extract(fetch4(zero_pos), zero_pos, jnp.full_like(zero_pos, 64))
+
+    shape = rel_pos.shape
+    acc0 = (
+        jnp.zeros(shape, F32),
+        jnp.zeros(shape, I32),
+        jnp.full(shape, jnp.inf, F32),
+        jnp.full(shape, -jnp.inf, F32),
+        jnp.full(shape, jnp.nan, F32),
+    )
+    step = functools.partial(
+        _fused_step, fetch4, nb, nt0, first_chunk_i32, int_optimized
+    )
+    if use_scan:
+        (state, acc), _ = jax.lax.scan(
+            lambda c, i: (step(c, i), None), (state, acc0), jnp.arange(k)
+        )
+    else:
+        # Mosaic can't round-trip i1 vectors through a fori_loop carry, so
+        # bool state fields travel as int32 and are re-compared each step.
+        def pack(st):
+            return st._replace(
+                done=st.done.astype(I32), err=st.err.astype(I32),
+                is_float=st.is_float.astype(I32),
+            )
+
+        def unpack(st):
+            return st._replace(
+                done=st.done != 0, err=st.err != 0, is_float=st.is_float != 0
+            )
+
+        def body(i, c):
+            st, ac = c
+            st, ac = step((unpack(st), ac), i)
+            return pack(st), ac
+
+        state, acc = jax.lax.fori_loop(0, k, body, (pack(state), acc0))
+        state = unpack(state)
+    s_sum, s_cnt, s_min, s_max, s_last = acc
+    return LaneAggregates(
+        sum=s_sum, count=s_cnt, min=s_min, max=s_max, last=s_last, err=state.err
+    )
+
+
+# ---------------------------------------------------------------------------
+# jnp fallback path (CPU tests, oracle, non-TPU backends)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k", "int_optimized"))
+def lane_aggregates_jnp(
+    windows, rel_pos, num_bits, first, prev_time, prev_delta, prev_float_bits,
+    prev_xor, int_val, time_unit, sig, mult, is_float, k: int,
+    int_optimized: bool = True,
+) -> LaneAggregates:
+    windows = jnp.asarray(windows, U32)
+    cols = _window_columns(windows)
+    return _run_lane_tile(
+        cols, rel_pos, num_bits, first, prev_time, prev_delta, prev_float_bits,
+        prev_xor, int_val, time_unit, sig, mult, is_float, k,
+        windows.shape[1], int_optimized, use_scan=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _pallas_kernel(k, cw, int_optimized, win_ref, rel_ref, nbits_ref, first_ref,
+                   pt_hi, pt_lo, pd_hi, pd_lo, pfb_hi, pfb_lo, pxr_hi, pxr_lo,
+                   iv_hi, iv_lo, tu_ref, sig_ref, mult_ref, isf_ref,
+                   sum_ref, cnt_ref, min_ref, max_ref, last_ref, err_ref):
+    cols = [win_ref[j, 0] for j in range(cw)]
+    zero = jnp.zeros(LANE_TILE, U32)
+    cols = cols + [zero, zero, zero]
+    agg = _run_lane_tile(
+        cols,
+        rel_ref[0],
+        nbits_ref[0],
+        first_ref[0] != 0,
+        (pt_hi[0], pt_lo[0]),
+        (pd_hi[0], pd_lo[0]),
+        (pfb_hi[0], pfb_lo[0]),
+        (pxr_hi[0], pxr_lo[0]),
+        (iv_hi[0], iv_lo[0]),
+        tu_ref[0],
+        sig_ref[0],
+        mult_ref[0],
+        isf_ref[0] != 0,
+        k,
+        cw,
+        int_optimized,
+        use_scan=False,
+    )
+    sum_ref[0] = agg.sum
+    cnt_ref[0] = agg.count
+    min_ref[0] = agg.min
+    max_ref[0] = agg.max
+    last_ref[0] = agg.last
+    err_ref[0] = agg.err.astype(I32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "int_optimized", "interpret")
+)
+def lane_aggregates_pallas(
+    windows, rel_pos, num_bits, first, prev_time, prev_delta, prev_float_bits,
+    prev_xor, int_val, time_unit, sig, mult, is_float, k: int,
+    int_optimized: bool = True, interpret: bool = False,
+) -> LaneAggregates:
+    """Tiled Pallas execution over [N] lanes (N padded to 1024 multiples).
+
+    Host-side callers should pass numpy/jnp arrays; padding lanes decode
+    zero bits and contribute identity values to every aggregate.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    windows = jnp.asarray(windows, U32)
+    n, cw = windows.shape
+    tiles = -(-n // TILE_LANES)
+    npad = tiles * TILE_LANES
+
+    def pad_to(x, fill=0):
+        x = jnp.asarray(x)
+        if x.shape[0] == npad:
+            return x
+        return jnp.concatenate(
+            [x, jnp.full((npad - x.shape[0],) + x.shape[1:], fill, x.dtype)]
+        )
+
+    # windows transposed to [CW, tiles, 8, 128] so each column is a clean tile
+    w = pad_to(windows).T.reshape(cw, tiles, *LANE_TILE)
+
+    def lanes(x, fill=0, dtype=None):
+        x = pad_to(jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype), fill)
+        return x.reshape(tiles, *LANE_TILE)
+
+    args = [
+        w,
+        lanes(rel_pos),
+        lanes(num_bits),
+        lanes(jnp.asarray(first).astype(I32)),
+        lanes(prev_time[0]), lanes(prev_time[1]),
+        lanes(prev_delta[0]), lanes(prev_delta[1]),
+        lanes(prev_float_bits[0]), lanes(prev_float_bits[1]),
+        lanes(prev_xor[0]), lanes(prev_xor[1]),
+        lanes(int_val[0]), lanes(int_val[1]),
+        lanes(time_unit),
+        lanes(sig),
+        lanes(mult),
+        lanes(jnp.asarray(is_float).astype(I32)),
+    ]
+
+    lane_spec = pl.BlockSpec((1, *LANE_TILE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    win_spec = pl.BlockSpec((cw, 1, *LANE_TILE), lambda i: (0, i, 0, 0), memory_space=pltpu.VMEM)
+    out_shape = [
+        jax.ShapeDtypeStruct((tiles, *LANE_TILE), F32),
+        jax.ShapeDtypeStruct((tiles, *LANE_TILE), I32),
+        jax.ShapeDtypeStruct((tiles, *LANE_TILE), F32),
+        jax.ShapeDtypeStruct((tiles, *LANE_TILE), F32),
+        jax.ShapeDtypeStruct((tiles, *LANE_TILE), F32),
+        jax.ShapeDtypeStruct((tiles, *LANE_TILE), I32),
+    ]
+    outs = pl.pallas_call(
+        functools.partial(_pallas_kernel, k, cw, int_optimized),
+        grid=(tiles,),
+        in_specs=[win_spec] + [lane_spec] * (len(args) - 1),
+        out_specs=[lane_spec] * 6,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(*args)
+    s_sum, s_cnt, s_min, s_max, s_last, s_err = (o.reshape(npad)[:n] for o in outs)
+    return LaneAggregates(
+        sum=s_sum, count=s_cnt, min=s_min, max=s_max, last=s_last, err=s_err != 0
+    )

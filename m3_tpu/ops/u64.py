@@ -95,7 +95,9 @@ def shl(a, s):
     """Logical shift left by vector amounts s in [0, 64]."""
     hi, lo = a
     s = jnp.asarray(s, U32)
-    s1 = jnp.minimum(s, U32(31))
+    # NOT jnp.minimum: unsigned vector min lowers to an i8->i1 trunc that
+    # Mosaic rejects inside fori_loop bodies (Pallas kernel path).
+    s1 = jnp.where(s < U32(31), s, U32(31))
     hi_a = (hi << s1) | jnp.where(s1 == 0, U32(0), lo >> (U32(32) - s1))
     lo_a = lo << s1
     s2 = jnp.clip(s.astype(jnp.int32) - 32, 0, 31).astype(U32)
@@ -111,7 +113,9 @@ def shr(a, s):
     """Logical shift right by vector amounts s in [0, 64]."""
     hi, lo = a
     s = jnp.asarray(s, U32)
-    s1 = jnp.minimum(s, U32(31))
+    # NOT jnp.minimum: unsigned vector min lowers to an i8->i1 trunc that
+    # Mosaic rejects inside fori_loop bodies (Pallas kernel path).
+    s1 = jnp.where(s < U32(31), s, U32(31))
     lo_a = (lo >> s1) | jnp.where(s1 == 0, U32(0), hi << (U32(32) - s1))
     hi_a = hi >> s1
     s2 = jnp.clip(s.astype(jnp.int32) - 32, 0, 31).astype(U32)
@@ -191,11 +195,23 @@ def umul32_wide(a, b):
     return hi, lo
 
 
+def u32_to_f32(x):
+    """uint32 -> float32 value conversion via int32 halves.
+
+    Mosaic (Pallas TPU) has no uint32->float32 convert; 16-bit halves cast
+    exactly through int32 and recombine without precision loss beyond f32's
+    own 24-bit mantissa."""
+    x = jnp.asarray(x, U32)
+    hi = (x >> U32(16)).astype(jnp.int32).astype(jnp.float32)
+    lo = (x & U32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
+    return hi * jnp.float32(65536.0) + lo
+
+
 def to_f32(a):
     """Approximate signed 64-bit pair -> float32 (for on-device aggregation)."""
     hi, lo = a
     hi_signed = hi.astype(jnp.int32).astype(jnp.float32)
-    return hi_signed * jnp.float32(4294967296.0) + lo.astype(jnp.float32)
+    return hi_signed * jnp.float32(4294967296.0) + u32_to_f32(lo)
 
 
 def f64_bits_to_f32(a):
@@ -208,9 +224,9 @@ def f64_bits_to_f32(a):
     hi, lo = a
     sign = jnp.where((hi >> 31) != 0, jnp.float32(-1.0), jnp.float32(1.0))
     exp = ((hi >> 20) & U32(0x7FF)).astype(jnp.int32)
-    mant = (hi & U32(0xFFFFF)).astype(jnp.float32) * jnp.float32(2.0**32) + lo.astype(
-        jnp.float32
-    )
+    mant = (hi & U32(0xFFFFF)).astype(jnp.int32).astype(jnp.float32) * jnp.float32(
+        2.0**32
+    ) + u32_to_f32(lo)
     frac = mant * jnp.float32(2.0**-52)
     # Exact power-of-two scaling: bitcast (e+127)<<23 rather than jnp.exp2,
     # which is a polynomial approximation on some backends (CPU) and loses
@@ -222,7 +238,7 @@ def f64_bits_to_f32(a):
     e = jnp.clip(exp - 1023, -149, 128)
     e1 = jnp.clip(e, -126, 127)
     magnitude = (jnp.float32(1.0) + frac) * pow2(e1) * pow2(e - e1)
-    magnitude = jnp.where(exp == 0, frac * pow2(jnp.int32(-126)), magnitude)
+    magnitude = jnp.where(exp == 0, frac * pow2(jnp.full_like(exp, -126)), magnitude)
     special = exp == 0x7FF
     inf = jnp.float32(jnp.inf)
     nan = jnp.float32(jnp.nan)

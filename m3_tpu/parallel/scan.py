@@ -97,6 +97,61 @@ def chunked_scan_aggregate(lane_args: dict, s: int, c: int, k: int, with_psum=Fa
     return _aggregate_decoded(vals, valid, with_psum)
 
 
+def _aggregates_from_lanes(lane_agg, s: int, c: int, with_psum: bool) -> ScanAggregates:
+    """Reduce per-lane (per-chunk) aggregates [S*C] to ScanAggregates."""
+    rs = lambda x: x.reshape(s, c)
+    l_sum, l_cnt = rs(lane_agg.sum), rs(lane_agg.count)
+    l_min, l_max, l_last = rs(lane_agg.min), rs(lane_agg.max), rs(lane_agg.last)
+    s_sum = jnp.sum(l_sum, axis=1)
+    s_count = jnp.sum(l_cnt, axis=1)
+    s_min = jnp.min(l_min, axis=1)
+    s_max = jnp.max(l_max, axis=1)
+    # last = value of the last chunk that saw any valid record
+    cidx = jnp.arange(c)[None, :]
+    last_c = jnp.max(jnp.where(l_cnt > 0, cidx, -1), axis=1)
+    s_last = jnp.take_along_axis(l_last, jnp.maximum(last_c, 0)[:, None], axis=1)[:, 0]
+    s_last = jnp.where(last_c >= 0, s_last, jnp.nan)
+
+    has = s_count > 0
+    t_sum = jnp.sum(jnp.where(has, s_sum, 0.0))
+    t_count = jnp.sum(s_count)
+    t_min = jnp.min(jnp.where(has, s_min, jnp.inf))
+    t_max = jnp.max(jnp.where(has, s_max, -jnp.inf))
+    if with_psum:
+        t_sum = jax.lax.psum(t_sum, SHARD_AXIS)
+        t_count = jax.lax.psum(t_count, SHARD_AXIS)
+        t_min = jax.lax.pmin(t_min, SHARD_AXIS)
+        t_max = jax.lax.pmax(t_max, SHARD_AXIS)
+    t_min = jnp.where(t_count > 0, t_min, jnp.nan)
+    t_max = jnp.where(t_count > 0, t_max, jnp.nan)
+    return ScanAggregates(
+        series_sum=s_sum,
+        series_count=s_count,
+        series_min=jnp.where(has, s_min, jnp.nan),
+        series_max=jnp.where(has, s_max, jnp.nan),
+        series_last=s_last,
+        total_sum=t_sum,
+        total_count=t_count,
+        total_min=t_min,
+        total_max=t_max,
+    )
+
+
+def chunked_scan_aggregate_fused(
+    lane_args: dict, s: int, c: int, k: int, with_psum=False, backend: str = "auto"
+):
+    """Fused flagship path (ops/fused.py): the K-step decode runs with state
+    on-chip and only per-lane aggregates leave the kernel. ``backend``:
+    "pallas" (TPU kernel), "jnp" (lax.scan fallback), or "auto"."""
+    from ..ops import fused
+
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() not in ("cpu",) else "jnp"
+    fn = fused.lane_aggregates_pallas if backend == "pallas" else fused.lane_aggregates_jnp
+    lane_agg = fn(**lane_args, k=k)
+    return _aggregates_from_lanes(lane_agg, s, c, with_psum)
+
+
 def chunked_device_args(batch: ChunkedBatch, device_put=True) -> dict:
     """ChunkedBatch → kwargs for decode_chunked_lanes, device-resident."""
     import jax as _jax
