@@ -25,11 +25,14 @@ def main() -> None:
     import jax
 
     from m3_tpu.ops.chunked import build_chunked, tile_chunked
-    from m3_tpu.parallel.scan import chunked_device_args, chunked_scan_aggregate
+    from m3_tpu.parallel.scan import (
+        chunked_device_args,
+        chunked_scan_aggregate_fused,
+    )
     from m3_tpu.utils.synthetic import synthetic_streams
 
     n_points = 720
-    k = 16
+    k = 24
     n_series = int(os.environ.get("BENCH_SERIES", 65536))
     platform = jax.devices()[0].platform
     if platform == "cpu":
@@ -41,7 +44,7 @@ def main() -> None:
 
     fn = jax.jit(
         functools.partial(
-            chunked_scan_aggregate,
+            chunked_scan_aggregate_fused,
             s=batch.num_series,
             c=batch.num_chunks,
             k=batch.k,

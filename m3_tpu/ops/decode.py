@@ -406,7 +406,9 @@ def _decode_value(fetch4, state, first, int_optimized: bool):
         int_val=u64.select(active, new_int_val, state.int_val),
         sig=jnp.where(active, new_sig, state.sig),
         mult=jnp.where(active, new_mult, state.mult),
-        is_float=jnp.where(active, new_is_float, state.is_float),
+        # Boolean algebra, not jnp.where: select_n with i1 *operands* lowers
+        # through an i8 vector Mosaic cannot truncate back to i1.
+        is_float=(active & new_is_float) | (~active & state.is_float),
     )
 
 

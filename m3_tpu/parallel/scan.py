@@ -146,7 +146,9 @@ def chunked_scan_aggregate_fused(
     from ..ops import fused
 
     if backend == "auto":
-        backend = "pallas" if jax.default_backend() not in ("cpu",) else "jnp"
+        # Mosaic kernels are TPU-only; every other backend (cpu, gpu) takes
+        # the lax.scan fallback rather than attempting a pltpu lowering.
+        backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
     fn = fused.lane_aggregates_pallas if backend == "pallas" else fused.lane_aggregates_jnp
     lane_agg = fn(**lane_args, k=k)
     return _aggregates_from_lanes(lane_agg, s, c, with_psum)

@@ -40,6 +40,25 @@ def cpu_mesh_env(n_devices: int, base: dict | None = None) -> dict:
     return env
 
 
+# Snapshot of the platform-selecting env vars as they were before
+# force_cpu_mesh scrubbed them; lets child processes (e.g. the real-TPU smoke
+# test) restore the original accelerator environment.
+_SAVED_ENV: dict[str, str | None] = {}
+
+
+def original_env(base: dict | None = None) -> dict:
+    """A copy of ``base`` (default os.environ) with any force_cpu_mesh
+    scrubbing undone — suitable for spawning a child that should see the
+    machine's real accelerator."""
+    env = dict(os.environ if base is None else base)
+    for k, v in _SAVED_ENV.items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
+    return env
+
+
 def force_cpu_mesh(n_devices: int) -> None:
     """Force the CURRENT process onto an n-device CPU mesh.
 
@@ -47,6 +66,8 @@ def force_cpu_mesh(n_devices: int) -> None:
     the config override (the latter wins over a plugin's sitecustomize-time
     platform selection).
     """
+    for k in ("JAX_PLATFORMS", "XLA_FLAGS", *_PLATFORM_SELECTORS):
+        _SAVED_ENV.setdefault(k, os.environ.get(k))
     os.environ.update(
         {k: v for k, v in cpu_mesh_env(n_devices).items() if k in ("JAX_PLATFORMS", "XLA_FLAGS")}
     )

@@ -1,0 +1,148 @@
+"""Parity tests for the fused decode+aggregate kernel (ops/fused.py).
+
+The fused path is the flagship TPU kernel; these tests pin it to the chunked
+oracle (ops/chunked.py + parallel/scan.chunked_scan_aggregate) in three tiers:
+
+  1. jnp fallback vs oracle (always, CPU mesh)
+  2. Pallas interpret-mode vs oracle (always, CPU mesh) — exercises the exact
+     kernel body Mosaic compiles, catching i1-vector hazards before hardware
+  3. real-TPU compile+run vs oracle — opt-in via M3_TPU_SMOKE=1 since the CI
+     conftest forces a CPU mesh (run: M3_TPU_SMOKE=1 pytest tests/test_fused.py)
+"""
+
+import functools
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from m3_tpu.ops.chunked import build_chunked, tile_chunked
+from m3_tpu.parallel.scan import (
+    chunked_device_args,
+    chunked_scan_aggregate,
+    chunked_scan_aggregate_fused,
+)
+from m3_tpu.utils.synthetic import synthetic_streams
+
+
+def _batch(k=16, n_series=96, n_points=97, seed=7):
+    streams = synthetic_streams(32, n_points, seed=seed)
+    return tile_chunked(build_chunked(streams, k=k), n_series)
+
+
+def _oracle(batch, args):
+    fn = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate,
+            s=batch.num_series,
+            c=batch.num_chunks,
+            k=batch.k,
+        )
+    )
+    return fn(args)
+
+
+def _fused(batch, args, backend):
+    fn = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate_fused,
+            s=batch.num_series,
+            c=batch.num_chunks,
+            k=batch.k,
+            backend=backend,
+        )
+    )
+    return fn(args)
+
+
+def _assert_matches(got, want):
+    np.testing.assert_array_equal(np.asarray(got.series_count), np.asarray(want.series_count))
+    np.testing.assert_allclose(np.asarray(got.series_sum), np.asarray(want.series_sum), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.series_min), np.asarray(want.series_min), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.series_max), np.asarray(want.series_max), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.series_last), np.asarray(want.series_last), rtol=1e-6)
+    assert int(got.total_count) == int(want.total_count)
+    np.testing.assert_allclose(float(got.total_sum), float(want.total_sum), rtol=1e-6)
+
+
+@pytest.mark.parametrize("k", [8, 16, 24])
+def test_fused_jnp_matches_oracle(k):
+    batch = _batch(k=k)
+    args = chunked_device_args(batch, device_put=False)
+    _assert_matches(_fused(batch, args, "jnp"), _oracle(batch, args))
+
+
+@pytest.mark.parametrize("k", [16, 24])
+def test_fused_pallas_interpret_matches_oracle(k):
+    """Runs the actual Pallas kernel body in interpret mode on CPU."""
+    from m3_tpu.ops import fused
+
+    batch = _batch(k=k)
+    args = chunked_device_args(batch, device_put=False)
+    from m3_tpu.ops.chunked import lane_kwargs
+
+    lane_agg = fused.lane_aggregates_pallas(
+        **lane_kwargs(batch), k=batch.k, interpret=True
+    )
+    want = _oracle(batch, args)
+    s, c = batch.num_series, batch.num_chunks
+    got_count = np.asarray(lane_agg.count).reshape(s, c).sum(axis=1)
+    got_sum = np.asarray(lane_agg.sum).reshape(s, c).sum(axis=1)
+    np.testing.assert_array_equal(got_count, np.asarray(want.series_count))
+    np.testing.assert_allclose(got_sum, np.asarray(want.series_sum), rtol=1e-6)
+
+
+def test_fused_auto_backend_on_cpu_is_jnp():
+    """ADVICE r2: backend='auto' must not pick the Mosaic kernel off-TPU."""
+    batch = _batch()
+    args = chunked_device_args(batch, device_put=False)
+    # On the CI CPU mesh this would raise in lowering if 'pallas' were chosen.
+    out = _fused(batch, args, "auto")
+    _assert_matches(out, _oracle(batch, args))
+
+
+@pytest.mark.skipif(
+    os.environ.get("M3_TPU_SMOKE") != "1",
+    reason="real-TPU smoke test; set M3_TPU_SMOKE=1 (requires a TPU)",
+)
+def test_fused_pallas_real_tpu_smoke():
+    """Compile + run the Mosaic kernel on real hardware, outside the forced
+    CPU mesh, by shelling out to a clean interpreter."""
+    code = r"""
+import functools, json
+import jax, numpy as np
+from m3_tpu.ops.chunked import build_chunked, tile_chunked
+from m3_tpu.parallel.scan import (
+    chunked_device_args, chunked_scan_aggregate, chunked_scan_aggregate_fused)
+from m3_tpu.utils.synthetic import synthetic_streams
+
+assert jax.default_backend() == "tpu", jax.default_backend()
+streams = synthetic_streams(32, 180, seed=11)
+batch = tile_chunked(build_chunked(streams, k=16), 1024)
+args = chunked_device_args(batch)
+p = functools.partial(
+    chunked_scan_aggregate, s=batch.num_series, c=batch.num_chunks, k=batch.k)
+want = jax.jit(p)(args)
+pf = functools.partial(
+    chunked_scan_aggregate_fused, s=batch.num_series, c=batch.num_chunks,
+    k=batch.k, backend="pallas")
+got = jax.jit(pf)(args)
+assert int(got.total_count) == int(want.total_count)
+np.testing.assert_allclose(
+    float(got.total_sum), float(want.total_sum), rtol=1e-6)
+print("TPU_SMOKE_OK")
+"""
+    from m3_tpu.testing.cpu_mesh import original_env
+
+    env = original_env()
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    assert "TPU_SMOKE_OK" in res.stdout, res.stdout + res.stderr
