@@ -24,32 +24,51 @@ def main() -> None:
 
     import jax
 
+    from m3_tpu.ops import fused
     from m3_tpu.ops.chunked import build_chunked, tile_chunked
     from m3_tpu.parallel.scan import (
         chunked_device_args,
         chunked_scan_aggregate_fused,
+        chunked_scan_aggregate_packed,
     )
     from m3_tpu.utils.synthetic import synthetic_streams
 
     n_points = 720
     k = 24
-    n_series = int(os.environ.get("BENCH_SERIES", 65536))
+    n_series = int(os.environ.get("BENCH_SERIES", 262144))
     platform = jax.devices()[0].platform
     if platform == "cpu":
         n_series = min(n_series, 4096)
 
     streams = synthetic_streams(64, n_points, seed=3)
     batch = tile_chunked(build_chunked(streams, k=k), n_series)
-    args = chunked_device_args(batch)
 
-    fn = jax.jit(
-        functools.partial(
-            chunked_scan_aggregate_fused,
-            s=batch.num_series,
-            c=batch.num_chunks,
-            k=batch.k,
+    if platform == "tpu":
+        # packed-layout Pallas kernel: 3 contiguous DMAs per grid program
+        packed = fused.pack_lane_inputs(batch)
+        w4 = jax.device_put(packed.windows4)
+        l4 = jax.device_put(packed.lanes4)
+        fn0 = jax.jit(
+            functools.partial(
+                chunked_scan_aggregate_packed,
+                n=packed.n,
+                s=batch.num_series,
+                c=batch.num_chunks,
+                k=batch.k,
+            )
         )
-    )
+        fn = lambda _args: fn0(w4, l4)
+        args = None
+    else:
+        args = chunked_device_args(batch)
+        fn = jax.jit(
+            functools.partial(
+                chunked_scan_aggregate_fused,
+                s=batch.num_series,
+                c=batch.num_chunks,
+                k=batch.k,
+            )
+        )
     out = fn(args)  # compile + warm
     jax.block_until_ready(out)
     total_points = int(out.total_count)

@@ -271,30 +271,28 @@ def _window_columns(windows):
 
 
 def _fetch4_select(cols, cw, base_rel, pos):
-    """Aligned 4-word fetch via a select tree over the lane-private window
-    columns — O(CW) VPU selects, no gather."""
+    """Aligned 4-word fetch via a barrel shift over the lane-private window
+    columns — O(CW + 4 log CW) VPU selects, no gather.
+
+    One shared barrel shifter (high bit first, narrowing the live candidate
+    list to 4 + remaining-shift entries each stage) replaces four independent
+    select trees: ~46 selects vs ~124 at CW=24."""
     p = base_rel + pos
     widx = p >> 5
-
-    # binary select tree over starting index: pick cols[widx + off] for
-    # off in 0..3 by reducing groups of candidates level by level.
-    def pick(off):
-        cand = cols[off : off + cw]  # candidates for widx in [0, cw)
-        # pad to a power of two so the binary tree indexes cleanly
-        size = 1
-        while size < len(cand):
-            size *= 2
-        cand = cand + [cols[-1]] * (size - len(cand))
-        idx = widx
-        while len(cand) > 1:
-            cand = [
-                jnp.where((idx & 1) == 0, cand[j], cand[j + 1])
-                for j in range(0, len(cand), 2)
-            ]
-            idx = idx >> 1
-        return cand[0]
-
-    ws = (pick(0), pick(1), pick(2), pick(3))
+    zero = jnp.zeros_like(cols[0])
+    cand = list(cols[: cw + 3])
+    s = 1
+    while s * 2 <= cw - 1:
+        s *= 2
+    while s >= 1:
+        flag = (widx & s) != 0
+        width = min(4 + s - 1, len(cand))
+        cand = [
+            jnp.where(flag, cand[i + s] if i + s < len(cand) else zero, cand[i])
+            for i in range(width)
+        ]
+        s //= 2
+    ws = (cand[0], cand[1], cand[2], cand[3])
     r = (p & 31).astype(U32)
     nz = r != 0
     inv = U32(32) - r
@@ -351,7 +349,7 @@ def decode_chunked_lanes(
     from .decode import _extract
 
     zero_pos = jnp.zeros((n,), I32)
-    nt0 = _extract(fetch4(zero_pos), zero_pos, jnp.full_like(zero_pos, 64))
+    nt0 = _extract(fetch4(zero_pos), 0, 64)
 
     def step(state, idx):
         first_vec = first_chunk & (idx == 0)

@@ -115,14 +115,32 @@ def _extract(ws, start, n):
 
     Valid as long as start + n <= 97 (4 words minus the <=31-bit base shift).
     Returns a u64 pair holding the bits right-aligned.
+
+    ``start``/``n`` may be Python ints: record formats put most fields at
+    compile-time-constant offsets, and a static start turns the word pick +
+    alignment into plain shifts (the dynamic path costs ~20 vector selects).
     """
-    start = jnp.asarray(start, I32)
-    k = start >> 5
-    r = (start & 31).astype(U32)
-    w0, w1, w2 = _pick4(ws, k)
-    nz = r != 0
-    hi = (w0 << r) | jnp.where(nz, w1 >> (U32(32) - r), U32(0))
-    lo = (w1 << r) | jnp.where(nz, w2 >> (U32(32) - r), U32(0))
+    if isinstance(start, (int, np.integer)):
+        start = int(start)
+        k, r = start >> 5, start & 31
+        zero = jnp.zeros_like(ws[0])
+        opts = list(ws) + [zero, zero, zero]
+        w0, w1, w2 = opts[k], opts[k + 1], opts[k + 2]
+        if r == 0:
+            hi, lo = w0, w1
+        else:
+            hi = (w0 << U32(r)) | (w1 >> U32(32 - r))
+            lo = (w1 << U32(r)) | (w2 >> U32(32 - r))
+    else:
+        start = jnp.asarray(start, I32)
+        k = start >> 5
+        r = (start & 31).astype(U32)
+        w0, w1, w2 = _pick4(ws, k)
+        nz = r != 0
+        hi = (w0 << r) | jnp.where(nz, w1 >> (U32(32) - r), U32(0))
+        lo = (w1 << r) | jnp.where(nz, w2 >> (U32(32) - r), U32(0))
+    if isinstance(n, (int, np.integer)):
+        return u64.shr((hi, lo), 64 - int(n))
     return u64.shr((hi, lo), jnp.asarray(64, I32) - jnp.asarray(n, I32))
 
 
@@ -160,14 +178,14 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
     pos = state.pos
     if nt is None:
         ws0 = fetch4(pos)
-        nt = _extract(ws0, jnp.zeros_like(pos), jnp.full_like(pos, 64))
+        nt = _extract(ws0, 0, 64)
     pos = jnp.where(first, pos + 64, pos)
     prev_time = u64.select(first, nt, state.prev_time)
 
     ws = fetch4(pos)
     # --- marker peek (11 bits; zero padding can never look like a marker) ---
     in_range = (pos + _MARKER_BITS) <= num_bits
-    peek = _extract32(ws, jnp.zeros_like(pos), jnp.full_like(pos, _MARKER_BITS))
+    peek = _extract32(ws, 0, _MARKER_BITS)
     is_marker = in_range & (peek >> 2 == _MARKER_OPCODE)
     marker_val = (peek & 3).astype(I32)
     eos = is_marker & (marker_val == _EOS)
@@ -175,22 +193,30 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
     tu_marker = is_marker & (marker_val == _TIME_UNIT)
 
     # --- time-unit marker: 8-bit unit byte follows ---
-    new_unit = _extract32(ws, jnp.full_like(pos, _MARKER_BITS), jnp.full_like(pos, 8)).astype(I32)
+    new_unit = _extract32(ws, _MARKER_BITS, 8).astype(I32)
     tu_supported = (new_unit >= 1) & (new_unit <= 4)
     tu_changed = tu_marker & tu_supported & (new_unit != state.time_unit)
     time_unit = jnp.where(tu_marker & tu_supported, new_unit, state.time_unit)
-    # offset of the dod record within the window
-    dod_off = jnp.where(tu_marker, _MARKER_BITS + 8, 0)
+    # offset of the dod record within the window: 0, or 19 after a marker
+    _TU_DOD_OFF = _MARKER_BITS + 8
+    dod_off = jnp.where(tu_marker, _TU_DOD_OFF, 0)
 
-    # --- dod decode ---
-    # changed path: raw 64-bit nanos (timestamp_iterator.go:228-238)
-    dod_changed = _extract(ws, dod_off, jnp.full_like(pos, 64))
+    # --- dod decode (fully static extracts; offsets are 0 or 19) ---
+    # changed path: raw 64-bit nanos (timestamp_iterator.go:228-238); only
+    # consumed when tu_changed, i.e. when the dod sits at the static offset 19
+    dod_changed = _extract(ws, _TU_DOD_OFF, 64)
 
-    # bucket path
-    b0 = _extract32(ws, dod_off, jnp.ones_like(pos))
-    b1 = _extract32(ws, dod_off + 1, jnp.ones_like(pos))
-    b2 = _extract32(ws, dod_off + 2, jnp.ones_like(pos))
-    b3 = _extract32(ws, dod_off + 3, jnp.ones_like(pos))
+    # bucket path: the 16 head bits cover opcode + the 7/9/12-bit payloads,
+    # so the small buckets are static shifts of one selected head word
+    head16 = jnp.where(
+        tu_marker,
+        _extract32(ws, _TU_DOD_OFF, 16),
+        _extract32(ws, 0, 16),
+    )
+    b0 = (head16 >> 15) & 1
+    b1 = (head16 >> 14) & 1
+    b2 = (head16 >> 13) & 1
+    b3 = (head16 >> 12) & 1
     zero_dod = b0 == 0
     sel7 = (b0 == 1) & (b1 == 0)
     sel9 = (b0 == 1) & (b1 == 1) & (b2 == 0)
@@ -200,8 +226,19 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
         sel7, 7, jnp.where(sel9, 9, jnp.where(sel12, 12, default_bits))
     ).astype(I32)
     opbits = jnp.where(sel7, 2, jnp.where(sel9, 3, 4)).astype(I32)
-    raw = _extract(ws, dod_off + opbits, nbits)
-    dod_norm = u64.sign_extend(raw, nbits)
+    d7 = (((head16 >> 7) & U32(0x7F)).astype(I32) ^ 0x40) - 0x40
+    d9 = (((head16 >> 4) & U32(0x1FF)).astype(I32) ^ 0x100) - 0x100
+    d12 = ((head16 & U32(0xFFF)).astype(I32) ^ 0x800) - 0x800
+    d_small = jnp.where(sel7, d7, jnp.where(sel9, d9, d12))
+    # default bucket: 32-bit (s/ms) or 64-bit (us/ns) payload at dod_off + 4
+    raw32 = jnp.where(
+        tu_marker,
+        _extract32(ws, _TU_DOD_OFF + 4, 32),
+        _extract32(ws, 4, 32),
+    ).astype(I32)
+    raw64 = u64.select(tu_marker, _extract(ws, _TU_DOD_OFF + 4, 64), _extract(ws, 4, 64))
+    dod_def = u64.select(default_bits == 32, u64.from_i32(raw32), raw64)
+    dod_norm = u64.select(sel7 | sel9 | sel12, u64.from_i32(d_small), dod_def)
     unit_nanos = _unit_nanos(time_unit)
     dod_bucket = u64.mul_u32(dod_norm, unit_nanos)
     bucket_consumed = jnp.where(zero_dod, 1, opbits + nbits)
@@ -230,44 +267,50 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
     return state, eos
 
 
-def _read_int_header(ws, off, sig, mult):
-    """sig/mult update header (iterator.go readIntSigMult). Returns
-    (sig', mult', consumed, mult_invalid)."""
-    one = jnp.ones_like(off)
-    b_sig_upd = _extract32(ws, off, one)
-    b_zero_sig = _extract32(ws, off + 1, one)
-    sig_m1 = _extract32(ws, off + 2, jnp.full_like(off, 6)).astype(I32)
-    upd = b_sig_upd == 1
-    zero_sig = b_zero_sig == 0  # OpcodeZeroSig == 0x0
+def _read_int_header12(hb, sig, mult):
+    """sig/mult update header (iterator.go readIntSigMult) decoded from its
+    12 head bits ``hb`` (the header never exceeds 12 bits: sig part <= 8,
+    mult part <= 4), so every field is a static shift of one word. Bit 11 of
+    ``hb`` is the first header bit. Returns (sig', mult', consumed, invalid)."""
+    upd = ((hb >> 11) & 1) == 1
+    zero_sig = ((hb >> 10) & 1) == 0  # OpcodeZeroSig == 0x0
+    sig_m1 = ((hb >> 4) & U32(0x3F)).astype(I32)
     new_sig = jnp.where(upd, jnp.where(zero_sig, 0, sig_m1 + 1), sig)
     sig_consumed = jnp.where(upd, jnp.where(zero_sig, 2, 8), 1)
 
-    moff = off + sig_consumed
-    b_mult_upd = _extract32(ws, moff, one)
-    mult_v = _extract32(ws, moff + 1, jnp.full_like(off, 3)).astype(I32)
+    # mult header at sig_consumed in {1, 2, 8}: static shifts, value select
+    is1 = ~upd
+    is2 = upd & zero_sig
+    b_mult_upd = jnp.where(is1, (hb >> 10) & 1, jnp.where(is2, (hb >> 9) & 1, (hb >> 3) & 1))
+    mult_v = jnp.where(
+        is1,
+        ((hb >> 7) & U32(7)).astype(I32),
+        jnp.where(is2, ((hb >> 6) & U32(7)).astype(I32), (hb & U32(7)).astype(I32)),
+    )
     mupd = b_mult_upd == 1
     new_mult = jnp.where(mupd, mult_v, mult)
     consumed = sig_consumed + jnp.where(mupd, 4, 1)
     mult_invalid = mupd & (mult_v > 6)
-    return new_sig, new_mult, moff + jnp.where(mupd, 4, 1) - off, mult_invalid
+    return new_sig, new_mult, consumed, mult_invalid
 
 
 def _read_int_diff(ws, off, sig, int_val):
-    """Sign + sig-bit diff (iterator.go readIntValDiff). Returns (int_val', consumed)."""
-    sign_bit = _extract32(ws, off, jnp.ones_like(off))
+    """Sign + sig-bit diff (iterator.go readIntValDiff). ``off`` may be a
+    Python int (static extracts) or traced. Returns (int_val', consumed)."""
+    sign_bit = _extract32(ws, off, 1)
     diff = _extract(ws, off + 1, sig)
     # opcodeNegative(1) means "add |diff|" (see iterator.go:162-169 semantics).
     delta = u64.select(sign_bit == 1, diff, u64.neg(diff))
     return u64.add(int_val, delta), 1 + sig
 
 
-def _read_xor(ws, off, prev_float_bits, prev_xor):
-    """XOR float record (float_encoder_iterator.go:117-166).
+def _read_xor(ws, off: int, prev_float_bits, prev_xor):
+    """XOR float record (float_encoder_iterator.go:117-166). ``off`` is the
+    record-format constant (Python int) so all starts are static.
 
     Returns (prev_float_bits', prev_xor', consumed)."""
-    one = jnp.ones_like(off)
-    c0 = _extract32(ws, off, one)
-    c1 = _extract32(ws, off + 1, one)
+    c0 = _extract32(ws, off, 1)
+    c1 = _extract32(ws, off + 1, 1)
     zero_path = c0 == 0
     contained = (c0 == 1) & (c1 == 0)
 
@@ -281,8 +324,8 @@ def _read_xor(ws, off, prev_float_bits, prev_xor):
     consumed_c = 2 + nm_c
 
     # uncontained: 6-bit lead, 6-bit (nm-1), nm bits
-    lead_u = _extract32(ws, off + 2, jnp.full_like(off, 6)).astype(I32)
-    nm_u = _extract32(ws, off + 8, jnp.full_like(off, 6)).astype(I32) + 1
+    lead_u = _extract32(ws, off + 2, 6).astype(I32)
+    nm_u = _extract32(ws, off + 8, 6).astype(I32) + 1
     bits_u = _extract(ws, off + 14, nm_u)
     trail_u = jnp.clip(64 - lead_u - nm_u, 0, 64)
     xor_u = u64.shl(bits_u, trail_u)
@@ -299,12 +342,10 @@ def _decode_value(fetch4, state, first, int_optimized: bool):
     """One value record for all series (iterator.go readFirstValue/readNextValue)."""
     pos = state.pos
     ws = fetch4(pos)
-    zero = jnp.zeros_like(pos)
-    one = jnp.ones_like(pos)
 
     if not int_optimized:
-        full = _extract(ws, zero, jnp.full_like(pos, 64))
-        nb, nx, consumed = _read_xor(ws, zero, state.prev_float_bits, state.prev_xor)
+        full = _extract(ws, 0, 64)
+        nb, nx, consumed = _read_xor(ws, 0, state.prev_float_bits, state.prev_xor)
         new_bits = u64.select(first, full, nb)
         new_xor = u64.select(first, full, nx)
         consumed = jnp.where(first, 64, consumed)
@@ -318,54 +359,19 @@ def _decode_value(fetch4, state, first, int_optimized: bool):
 
     # ---- int-optimized scheme ----
     # FIRST record: mode bit, then full float or int header+diff.
-    f_mode = _extract32(ws, zero, one)  # 1 = float (opcodeFloatMode)
-    f_full = _extract(ws, one, jnp.full_like(pos, 64))
-    f_sig, f_mult, f_hdr_consumed, f_mult_bad = _read_int_header(ws, one, state.sig, state.mult)
-    f_int_val, f_diff_consumed = _read_int_diff(
-        ws, one + f_hdr_consumed, f_sig, u64.const(0, pos.shape)
-    )
+    head3 = _extract32(ws, 0, 3)  # first 3 bits cover every mode peek below
+    f_mode = (head3 >> 2) & 1  # 1 = float (opcodeFloatMode)
     first_is_float = f_mode == 1
-    first_consumed = jnp.where(first_is_float, 65, 1 + f_hdr_consumed + f_diff_consumed)
 
-    # NEXT record.
-    b0 = _extract32(ws, zero, one)  # 0 = update, 1 = no update
-    b1 = _extract32(ws, one, one)  # update: 1 = repeat
-    b2 = _extract32(ws, jnp.full_like(pos, 2), one)  # update+norepeat: 1 = float mode
+    # NEXT record opcodes.
+    b0 = (head3 >> 2) & 1  # 0 = update, 1 = no update
+    b1 = (head3 >> 1) & 1  # update: 1 = repeat
+    b2 = head3 & 1  # update+norepeat: 1 = float mode
     upd = b0 == 0
     repeat = upd & (b1 == 1)
     to_float = upd & ~repeat & (b2 == 1)
     to_int = upd & ~repeat & (b2 == 0)
     stay = ~upd
-
-    # update -> float: full 64-bit float at offset 3
-    u_full = _extract(ws, jnp.full_like(pos, 3), jnp.full_like(pos, 64))
-    # update -> int: header at offset 3 then diff
-    u_sig, u_mult, u_hdr_consumed, u_mult_bad = _read_int_header(
-        ws, jnp.full_like(pos, 3), state.sig, state.mult
-    )
-    u_int_val, u_diff_consumed = _read_int_diff(
-        ws, jnp.full_like(pos, 3) + u_hdr_consumed, u_sig, state.int_val
-    )
-    # no update: XOR (float mode) or plain diff (int mode)
-    x_bits, x_xor, x_consumed = _read_xor(ws, one, state.prev_float_bits, state.prev_xor)
-    s_int_val, s_diff_consumed = _read_int_diff(ws, one, state.sig, state.int_val)
-
-    next_consumed = jnp.where(
-        repeat,
-        2,
-        jnp.where(
-            to_float,
-            3 + 64,
-            jnp.where(
-                to_int,
-                3 + u_hdr_consumed + u_diff_consumed,
-                jnp.where(state.is_float, 1 + x_consumed, 1 + s_diff_consumed),
-            ),
-        ),
-    )
-
-    # ---- merge first/next ----
-    consumed = jnp.where(first, first_consumed, next_consumed)
 
     sel_first_float = first & first_is_float
     sel_first_int = first & ~first_is_float
@@ -375,6 +381,43 @@ def _decode_value(fetch4, state, first, int_optimized: bool):
     sel_stay_int = ~first & stay & ~state.is_float
     sel_repeat = ~first & repeat
 
+    # A record consumes AT MOST ONE of each sub-record kind, at an offset
+    # determined by its selector — so each kind is read once at a selected
+    # offset instead of once per path:
+    #   full float: at 1 (first) or 3 (update->float)
+    #   int header: at 1 (first) or 3 (update->int); <=12 bits, static shifts
+    #   int diff:   after the header (first/update->int) or at 1 (stay-int)
+    #   xor:        at 1 (stay-float)
+    full = u64.select(first, _extract(ws, 1, 64), _extract(ws, 3, 64))
+    takes_header = sel_first_int | sel_to_int
+    hdr12 = jnp.where(first, _extract32(ws, 1, 12), _extract32(ws, 3, 12))
+    h_sig, h_mult, h_consumed, h_mult_bad = _read_int_header12(hdr12, state.sig, state.mult)
+    diff_off = jnp.where(
+        first, 1 + h_consumed, jnp.where(to_int, 3 + h_consumed, 1)
+    )
+    diff_sig = jnp.where(takes_header, h_sig, state.sig)
+    diff_base = u64.select(first, u64.const(0, pos.shape), state.int_val)
+    d_int_val, d_consumed = _read_int_diff(ws, diff_off, diff_sig, diff_base)
+    x_bits, x_xor, x_consumed = _read_xor(ws, 1, state.prev_float_bits, state.prev_xor)
+
+    first_consumed = jnp.where(first_is_float, 65, 1 + h_consumed + d_consumed)
+    next_consumed = jnp.where(
+        repeat,
+        2,
+        jnp.where(
+            to_float,
+            3 + 64,
+            jnp.where(
+                to_int,
+                3 + h_consumed + d_consumed,
+                jnp.where(state.is_float, 1 + x_consumed, 1 + d_consumed),
+            ),
+        ),
+    )
+
+    # ---- merge first/next ----
+    consumed = jnp.where(first, first_consumed, next_consumed)
+
     # Boolean algebra, not jnp.where(pred, True/False, ...): bool splat
     # constants lower to i8 vectors Mosaic can't truncate back to i1.
     new_is_float = (sel_first_float | sel_to_float) | (
@@ -382,20 +425,18 @@ def _decode_value(fetch4, state, first, int_optimized: bool):
     )
 
     # float bits: full float on first/to_float; XOR result when staying float.
-    new_float_bits = u64.select(sel_first_float, f_full, state.prev_float_bits)
-    new_float_bits = u64.select(sel_to_float, u_full, new_float_bits)
+    takes_full = sel_first_float | sel_to_float
+    new_float_bits = u64.select(takes_full, full, state.prev_float_bits)
     new_float_bits = u64.select(sel_stay_float, x_bits, new_float_bits)
-    new_xor = u64.select(sel_first_float, f_full, state.prev_xor)
-    new_xor = u64.select(sel_to_float, u_full, new_xor)
+    new_xor = u64.select(takes_full, full, state.prev_xor)
     new_xor = u64.select(sel_stay_float, x_xor, new_xor)
 
-    new_int_val = u64.select(sel_first_int, f_int_val, state.int_val)
-    new_int_val = u64.select(sel_to_int, u_int_val, new_int_val)
-    new_int_val = u64.select(sel_stay_int, s_int_val, new_int_val)
+    takes_diff = sel_first_int | sel_to_int | sel_stay_int
+    new_int_val = u64.select(takes_diff, d_int_val, state.int_val)
 
-    new_sig = jnp.where(sel_first_int, f_sig, jnp.where(sel_to_int, u_sig, state.sig))
-    new_mult = jnp.where(sel_first_int, f_mult, jnp.where(sel_to_int, u_mult, state.mult))
-    err_now = (sel_first_int & f_mult_bad) | (sel_to_int & u_mult_bad)
+    new_sig = jnp.where(takes_header, h_sig, state.sig)
+    new_mult = jnp.where(takes_header, h_mult, state.mult)
+    err_now = takes_header & h_mult_bad
 
     active = ~state.done & ~state.err & ~err_now
     return state._replace(
@@ -437,7 +478,7 @@ def decode_batched(
     zero_pair = u64.const(0, (s,))
 
     zero_pos = jnp.zeros((s,), I32)
-    nt0 = _extract(fetch4(zero_pos), zero_pos, jnp.full_like(zero_pos, 64))
+    nt0 = _extract(fetch4(zero_pos), 0, 64)
     state = DecodeState(
         pos=zero_pos,
         done=num_bits <= 0,

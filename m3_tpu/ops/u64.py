@@ -13,10 +13,15 @@ undefined, so every variable shift here is clamped and masked explicitly.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
+_np_int = _np.integer
+
 U32 = jnp.uint32
-MASK32 = jnp.uint32(0xFFFFFFFF)
+# np scalar, NOT jnp: a module-level jnp scalar is a concrete device array
+# that Pallas kernels would capture as an illegal closed-over constant.
+MASK32 = _np.uint32(0xFFFFFFFF)
 
 
 def u64(hi, lo):
@@ -31,7 +36,7 @@ def from_u32(x):
 def from_i32(x):
     """Sign-extend an int32 vector into a 64-bit pair (two's complement)."""
     x32 = jnp.asarray(x, jnp.int32)
-    hi = jnp.where(x32 < 0, MASK32, jnp.uint32(0))
+    hi = jnp.where(x32 < 0, MASK32, _np.uint32(0))
     return hi, x32.astype(U32)
 
 
@@ -92,8 +97,19 @@ def is_neg(a):
 
 
 def shl(a, s):
-    """Logical shift left by vector amounts s in [0, 64]."""
+    """Logical shift left by amounts s in [0, 64] (vector or Python int)."""
     hi, lo = a
+    if isinstance(s, (int, _np_int)):
+        s = int(s)
+        if s == 0:
+            return hi, lo
+        if s < 32:
+            return (hi << U32(s)) | (lo >> U32(32 - s)), lo << U32(s)
+        if s == 32:
+            return lo, jnp.zeros_like(lo)
+        if s < 64:
+            return lo << U32(s - 32), jnp.zeros_like(lo)
+        return jnp.zeros_like(hi), jnp.zeros_like(lo)
     s = jnp.asarray(s, U32)
     # NOT jnp.minimum: unsigned vector min lowers to an i8->i1 trunc that
     # Mosaic rejects inside fori_loop bodies (Pallas kernel path).
@@ -110,8 +126,19 @@ def shl(a, s):
 
 
 def shr(a, s):
-    """Logical shift right by vector amounts s in [0, 64]."""
+    """Logical shift right by amounts s in [0, 64] (vector or Python int)."""
     hi, lo = a
+    if isinstance(s, (int, _np_int)):
+        s = int(s)
+        if s == 0:
+            return hi, lo
+        if s < 32:
+            return hi >> U32(s), (lo >> U32(s)) | (hi << U32(32 - s))
+        if s == 32:
+            return jnp.zeros_like(hi), hi
+        if s < 64:
+            return jnp.zeros_like(hi), hi >> U32(s - 32)
+        return jnp.zeros_like(hi), jnp.zeros_like(lo)
     s = jnp.asarray(s, U32)
     # NOT jnp.minimum: unsigned vector min lowers to an i8->i1 trunc that
     # Mosaic rejects inside fori_loop bodies (Pallas kernel path).

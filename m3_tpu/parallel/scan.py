@@ -154,6 +154,20 @@ def chunked_scan_aggregate_fused(
     return _aggregates_from_lanes(lane_agg, s, c, with_psum)
 
 
+def chunked_scan_aggregate_packed(
+    windows4, lanes4, n: int, s: int, c: int, k: int, with_psum=False,
+    interpret: bool = False,
+):
+    """Packed-layout flagship path: 3 contiguous DMAs per Pallas grid program
+    (ops/fused.py packed kernel). Inputs come from fused.pack_lane_inputs."""
+    from ..ops import fused
+
+    lane_agg = fused.lane_aggregates_packed(
+        windows4, lanes4, n=n, k=k, interpret=interpret
+    )
+    return _aggregates_from_lanes(lane_agg, s, c, with_psum)
+
+
 def chunked_device_args(batch: ChunkedBatch, device_put=True) -> dict:
     """ChunkedBatch → kwargs for decode_chunked_lanes, device-resident."""
     import jax as _jax

@@ -95,6 +95,22 @@ def test_fused_pallas_interpret_matches_oracle(k):
     np.testing.assert_allclose(got_sum, np.asarray(want.series_sum), rtol=1e-6)
 
 
+@pytest.mark.parametrize("k", [16, 24])
+def test_packed_pallas_interpret_matches_oracle(k):
+    """Packed-layout kernel (3-DMA fast path) in interpret mode vs oracle."""
+    from m3_tpu.ops import fused
+    from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+
+    batch = _batch(k=k)
+    args = chunked_device_args(batch, device_put=False)
+    packed = fused.pack_lane_inputs(batch)
+    got = chunked_scan_aggregate_packed(
+        packed.windows4, packed.lanes4, n=packed.n,
+        s=batch.num_series, c=batch.num_chunks, k=batch.k, interpret=True,
+    )
+    _assert_matches(got, _oracle(batch, args))
+
+
 def test_fused_auto_backend_on_cpu_is_jnp():
     """ADVICE r2: backend='auto' must not pick the Mosaic kernel off-TPU."""
     batch = _batch()
@@ -133,6 +149,17 @@ got = jax.jit(pf)(args)
 assert int(got.total_count) == int(want.total_count)
 np.testing.assert_allclose(
     float(got.total_sum), float(want.total_sum), rtol=1e-6)
+
+from m3_tpu.ops import fused
+from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+packed = fused.pack_lane_inputs(batch)
+pp = functools.partial(
+    chunked_scan_aggregate_packed, n=packed.n, s=batch.num_series,
+    c=batch.num_chunks, k=batch.k)
+got2 = jax.jit(pp)(packed.windows4, packed.lanes4)
+assert int(got2.total_count) == int(want.total_count)
+np.testing.assert_allclose(
+    float(got2.total_sum), float(want.total_sum), rtol=1e-6)
 print("TPU_SMOKE_OK")
 """
     from m3_tpu.testing.cpu_mesh import original_env
