@@ -122,9 +122,29 @@ class NamespaceIndex:
             if bs + self.block_size <= t_nanos:
                 blk.seal()
 
-    def evict_before(self, t_nanos: int) -> None:
+    def evict_before(
+        self, t_nanos: int, base: str | None = None, ns_name: str | None = None
+    ) -> None:
+        """Drop index blocks entirely before ``t_nanos``; when a segment
+        directory is given, also unlink their persisted segment files so
+        expired blocks neither survive on disk nor resurrect at bootstrap
+        (storage/index.go block expiry + its file cleanup)."""
         for bs in [b for b in self.blocks if b + self.block_size <= t_nanos]:
             del self.blocks[bs]
+        if base is None or ns_name is None:
+            return
+        d = self._seg_dir(base, ns_name)
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return
+        for n in names:
+            m = _SEG_FILE_RE.match(n)
+            if m and int(m.group(1)) + self.block_size <= t_nanos:
+                try:
+                    os.remove(os.path.join(d, n))
+                except FileNotFoundError:
+                    pass
 
     # --- persistence (storage/index.go:868 WarmFlush of index blocks +
     # m3ninx/persist segment file sets) ---

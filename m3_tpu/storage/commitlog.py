@@ -69,6 +69,7 @@ class CommitLog:
         self.active_seq = (segs[-1][0] + 1) if segs else 0
         self._f = self._open_segment(self.active_seq)
         self._pending = 0
+        self._active_entries = 0
 
     def _open_segment(self, seq: int):
         f = open(_seg_path(self.dir, seq), "ab")
@@ -93,6 +94,7 @@ class CommitLog:
         rec = _HDR.pack(crc, len(entry.series_id), len(payload)) + entry.series_id + payload
         self._f.write(rec)
         self._pending += 1
+        self._active_entries += 1
         if self._pending >= self.flush_every:
             self.flush()
 
@@ -112,12 +114,17 @@ class CommitLog:
 
     def rotate(self) -> int:
         """RotateLogs (:370): seal the active segment, open the next.
-        Returns the sealed segment's sequence number."""
+        Returns the sealed segment's sequence number. Rotating an EMPTY
+        active segment is a no-op (a periodic mediator would otherwise
+        mint one segment file per pass)."""
         sealed = self.active_seq
+        if self._active_entries == 0:
+            return sealed
         self.close()
         self.active_seq += 1
         self._f = self._open_segment(self.active_seq)
         self._pending = 0
+        self._active_entries = 0
         return sealed
 
     # --- cleanup (storage/cleanup.go commit-log removal semantics) ---

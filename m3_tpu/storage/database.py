@@ -23,12 +23,19 @@ from .fs import (
     CHUNK_K,
     FilesetID,
     FilesetReader,
+    delete_fileset,
+    list_fileset_volumes,
     list_filesets,
     read_index_ids,
     write_fileset,
 )
 from .series import NANOS, SeriesBuffer
-from .snapshot import read_latest_snapshot, write_snapshot
+from .snapshot import read_latest_snapshot, remove_snapshots, write_snapshot
+
+
+class ColdWriteError(ValueError):
+    """Write into a flushed block while cold writes are disabled
+    (dbnode m3dberrors.ErrColdWritesNotEnabled)."""
 
 
 @dataclass
@@ -42,7 +49,12 @@ class NamespaceOptions:
 
 
 class Shard:
-    """dbShard: series map for one virtual shard."""
+    """dbShard: series map for one virtual shard.
+
+    Reads go through a per-(block) FilesetReader cache (the role of
+    persist/fs/seek_manager.go seeker cache + the wired list): a fileset is
+    materialized once and reused until a newer volume replaces it or the
+    block expires, instead of re-reading data+index+side files per read."""
 
     def __init__(self, shard_id: int, ns: str, opts: NamespaceOptions, base: str) -> None:
         self.id = shard_id
@@ -51,8 +63,39 @@ class Shard:
         self.base = base
         self.series: dict[bytes, SeriesBuffer] = {}
         self._flushed_blocks: set[int] = set()
+        self._filesets: list[FilesetID] | None = None  # listdir cache
+        self._readers: dict[int, FilesetReader] = {}  # block_start -> reader
+        self.reader_materializations = 0  # observability: fileset loads
+
+    def filesets(self) -> list[FilesetID]:
+        if self._filesets is None:
+            self._filesets = list_filesets(self.base, self.namespace, self.id)
+        return self._filesets
+
+    def _invalidate_filesets(self) -> None:
+        self._filesets = None
+
+    def reader(self, fid: FilesetID) -> FilesetReader:
+        cached = self._readers.get(fid.block_start)
+        if cached is not None and cached.fid.volume == fid.volume:
+            return cached
+        reader = FilesetReader(self.base, fid)
+        self.reader_materializations += 1
+        self._readers[fid.block_start] = reader
+        return reader
+
+    def check_write(self, t_nanos: int) -> None:
+        """Raise if a write at ``t_nanos`` would be rejected (shard.go:
+        writes into flushed blocks need cold writes enabled)."""
+        bs = (t_nanos // self.opts.block_size_nanos) * self.opts.block_size_nanos
+        if bs in self._flushed_blocks and not self.opts.cold_writes_enabled:
+            raise ColdWriteError(
+                f"write at {t_nanos} targets flushed block {bs} and namespace "
+                f"{self.namespace} has cold writes disabled"
+            )
 
     def write(self, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> None:
+        self.check_write(t_nanos)
         buf = self.series.get(sid)
         if buf is None:
             buf = SeriesBuffer(sid, self.opts.block_size_nanos)
@@ -62,11 +105,10 @@ class Shard:
     def read(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
         out: list[Datapoint] = []
         # flushed filesets first (older), then buffer (newer wins on dupes)
-        for fid in list_filesets(self.base, self.namespace, self.id):
+        for fid in self.filesets():
             if fid.block_start + self.opts.block_size_nanos <= start or fid.block_start >= end:
                 continue
-            reader = FilesetReader(self.base, fid)
-            stream = reader.stream(sid)
+            stream = self.reader(fid).stream(sid)
             if stream:
                 out.extend(dp for dp in decode(stream) if start <= dp.timestamp < end)
         buf = self.series.get(sid)
@@ -90,6 +132,8 @@ class Shard:
             write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
             self._flushed_blocks.add(bs)
             flushed.append(fid)
+        if flushed:
+            self._invalidate_filesets()
         # evict only what this flush made durable — cold writes into
         # previously-flushed blocks stay buffered for cold_flush
         for buf in self.series.values():
@@ -98,49 +142,68 @@ class Shard:
         return flushed
 
     def cold_flush(self, flush_before_nanos: int) -> list[FilesetID]:
-        """shard.go:2212 — out-of-order writes into already-flushed blocks go
-        out as a new volume merged with the existing fileset."""
-        flushed = []
+        """shard.go:2212 + persist/fs/merger.go — out-of-order writes into
+        already-flushed blocks merge with the existing fileset ONCE PER BLOCK
+        (all cold series together) and go out as one new volume."""
+        # gather every cold stream per block first, so each block merges once
+        cold: dict[int, dict[bytes, bytes]] = {}
         for sid, buf in list(self.series.items()):
             for bs, stream in buf.streams_before(flush_before_nanos).items():
-                if bs not in self._flushed_blocks or not stream:
-                    continue
-                existing = list_filesets(self.base, self.namespace, self.id)
-                prev = next((f for f in existing if f.block_start == bs), None)
-                series: dict[bytes, bytes] = {}
-                if prev is not None:
-                    reader = FilesetReader(self.base, prev)
-                    for other in reader.series_ids:
-                        series[other] = reader.stream(other) or b""
-                # merge this series' new points with any flushed ones
+                if bs in self._flushed_blocks and stream:
+                    cold.setdefault(bs, {})[sid] = stream
+        flushed = []
+        for bs, updates in sorted(cold.items()):
+            prev = next((f for f in self.filesets() if f.block_start == bs), None)
+            series: dict[bytes, bytes] = {}
+            if prev is not None:
+                reader = self.reader(prev)
+                for other in reader.series_ids:
+                    series[other] = reader.stream(other) or b""
+            from ..codec.m3tsz import Encoder
+
+            for sid, stream in updates.items():
                 merged: dict[int, Datapoint] = {}
                 if sid in series:
                     for dp in decode(series[sid]):
                         merged[dp.timestamp] = dp
                 for dp in decode(stream):
                     merged[dp.timestamp] = dp
-                from ..codec.m3tsz import Encoder
-
                 enc = Encoder(min(merged))
                 for t in sorted(merged):
                     dp = merged[t]
                     enc.encode(dp.timestamp, dp.value, unit=dp.unit)
                 series[sid] = enc.stream()
-                vol = (prev.volume + 1) if prev is not None else 0
-                fid = FilesetID(self.namespace, self.id, bs, volume=vol)
-                write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
-                flushed.append(fid)
-                buf.evict_block(bs)
+            vol = (prev.volume + 1) if prev is not None else 0
+            fid = FilesetID(self.namespace, self.id, bs, volume=vol)
+            write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
+            flushed.append(fid)
+            for sid in updates:
+                self.series[sid].evict_block(bs)
+        if flushed:
+            self._invalidate_filesets()
         return flushed
 
     def tick(self, now_nanos: int) -> None:
-        """shard.go:663 tickAndExpire: drop series/blocks past retention."""
+        """shard.go:663 tickAndExpire: drop series/blocks past retention,
+        expired filesets off disk, and stale cached readers."""
         expire_before = now_nanos - self.opts.retention_nanos
         for sid in list(self.series):
             buf = self.series[sid]
             buf.evict_before(expire_before)
             if not buf.buckets:
                 del self.series[sid]
+        bsz = self.opts.block_size_nanos
+        expired = [
+            fid
+            for fid in list_fileset_volumes(self.base, self.namespace, self.id)
+            if fid.block_start + bsz <= expire_before
+        ]
+        for fid in expired:
+            delete_fileset(self.base, fid)
+            self._flushed_blocks.discard(fid.block_start)
+            self._readers.pop(fid.block_start, None)
+        if expired:
+            self._invalidate_filesets()
 
 
 class Namespace:
@@ -190,21 +253,27 @@ class Database:
     ) -> None:
         with self.lock:
             namespace = self.namespaces[ns]
+            # buffer first so rejected writes (ColdWriteError) never reach the
+            # WAL — a logged-but-unacceptable entry would poison replay
+            namespace.shard_for(sid).write(sid, t_nanos, value, unit)
             cl = self._commitlogs.get(ns)
             if cl is not None:
                 cl.write(CommitLogEntry(sid, t_nanos, value, unit))
-            namespace.shard_for(sid).write(sid, t_nanos, value, unit)
 
     def write_batch(self, ns: str, entries: list[tuple[bytes, int, float]]) -> None:
         with self.lock:
             namespace = self.namespaces[ns]
+            # validate the whole batch before applying any entry, so a
+            # rejected write can't leave a partially-applied unlogged batch
+            for sid, t, v in entries:
+                namespace.shard_for(sid).check_write(t)
+            for sid, t, v in entries:
+                namespace.shard_for(sid).write(sid, t, v)
             cl = self._commitlogs.get(ns)
             if cl is not None:
                 cl.write_batch(
                     [CommitLogEntry(sid, t, v) for sid, t, v in entries]
                 )
-            for sid, t, v in entries:
-                namespace.shard_for(sid).write(sid, t, v)
 
     def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
         with self.lock:
@@ -221,9 +290,11 @@ class Database:
         sid = encode_tags_id(tags)
         with self.lock:
             namespace = self.namespaces[ns]
+            # data first: a rejected write (ColdWriteError) must not leave a
+            # phantom entry in the reverse index
+            self.write(ns, sid, t_nanos, value, unit)
             if namespace.index is not None:
                 namespace.index.write(sid, tags, t_nanos)
-            self.write(ns, sid, t_nanos, value, unit)
         return sid
 
     def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None):
@@ -259,19 +330,28 @@ class Database:
             # flushed (streams_before), so an entry in a partial block at the
             # cutoff edge keeps its segment alive. With cold writes enabled,
             # warm+cold flush together make every such point durable; with
-            # cold writes disabled, late points in already-flushed blocks are
-            # never durable, so segments are kept (the reference removes
-            # commit logs only once covered by snapshot/fileset data —
-            # storage/cleanup.go).
+            # cold writes disabled, writes into flushed blocks are rejected
+            # at write time (never logged), so the same coverage rule holds
+            # (the reference removes commit logs only once covered by
+            # snapshot/fileset data — storage/cleanup.go).
             cl = self._commitlogs.get(ns)
+            bsz = namespace.opts.block_size_nanos
             if cl is not None:
                 cl.rotate()
-                if namespace.opts.cold_writes_enabled:
-                    bsz = namespace.opts.block_size_nanos
-                    cl.cleanup(
-                        lambda e: (e.time_nanos // bsz) * bsz + bsz
-                        <= flush_before_nanos
-                    )
+                cl.cleanup(
+                    lambda e: (e.time_nanos // bsz) * bsz + bsz
+                    <= flush_before_nanos
+                )
+            # Snapshots whose every record now lives in a flushed block are
+            # covered by filesets; drop them so bootstrap doesn't re-buffer
+            # flushed points (storage/cleanup.go snapshot cleanup).
+            for shard in namespace.shards:
+                snap = read_latest_snapshot(self.base, ns, shard.id)
+                if snap and all(
+                    bs + bsz <= flush_before_nanos and bs in shard._flushed_blocks
+                    for _, bs, _ in snap
+                ):
+                    remove_snapshots(self.base, ns, shard.id)
             # WarmFlush of index blocks (storage/index.go:868): seal + persist
             if namespace.index is not None:
                 namespace.index.persist_before(self.base, ns, flush_before_nanos)
@@ -292,7 +372,12 @@ class Database:
                         stream = bucket.merged_stream()
                         if stream:
                             records.append((sid, bs, stream))
-                write_snapshot(self.base, ns, shard.id, records)
+                if records:
+                    write_snapshot(self.base, ns, shard.id, records)
+                else:
+                    # nothing buffered: an absent snapshot says the same
+                    # thing as an empty one without the file churn
+                    remove_snapshots(self.base, ns, shard.id)
                 total += len(records)
             cl = self._commitlogs.get(ns)
             if cl is not None:
@@ -301,10 +386,16 @@ class Database:
             return total
 
     def tick(self, now_nanos: int) -> None:
+        """storage/mediator.go tick: expire buffers, filesets, and index
+        blocks past retention (including their persisted segment files)."""
         with self.lock:
-            for ns in self.namespaces.values():
+            for name, ns in self.namespaces.items():
                 for shard in ns.shards:
                     shard.tick(now_nanos)
+                if ns.index is not None:
+                    ns.index.evict_before(
+                        now_nanos - ns.opts.retention_nanos, self.base, name
+                    )
 
     # --- bootstrap chain (bootstrap/process.go:147) ---
 
@@ -331,13 +422,52 @@ class Database:
         with self.lock:
             result = {"commitlog_entries": 0, "filesets": 0, "snapshot_records": 0}
             for name, ns in self.namespaces.items():
+                # Re-buffering a point that already sits in a flushed fileset
+                # would make the next cold_flush rewrite an identical volume,
+                # so snapshot records and commitlog entries for flushed blocks
+                # are checked against the fileset first (decoded lazily,
+                # cached per (shard, block, series)). Points NOT in the
+                # fileset are genuine un-flushed cold writes and must replay.
+                pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
+                bsz = ns.opts.block_size_nanos
+
+                def _covered(sh: Shard, sid: bytes, t_nanos: int, value: float) -> bool:
+                    bs = (t_nanos // bsz) * bsz
+                    if bs not in sh._flushed_blocks:
+                        return False
+                    fid = next(
+                        (f for f in sh.filesets() if f.block_start == bs), None
+                    )
+                    if fid is None:
+                        return False
+                    pk = (sh.id, bs, sid)
+                    if pk not in pts:
+                        stream = sh.reader(fid).stream(sid)
+                        pts[pk] = (
+                            {dp.timestamp: dp.value for dp in decode(stream)}
+                            if stream
+                            else {}
+                        )
+                    return pts[pk].get(t_nanos) == value
+
+                def _restore(sh: Shard, sid: bytes, t: int, v: float, unit) -> bool:
+                    if _covered(sh, sid, t, v):
+                        return False
+                    try:
+                        sh.write(sid, t, v, unit)
+                    except ColdWriteError:
+                        # pre-crash WAL/snapshot entry in a flushed block of a
+                        # cold-disabled namespace whose value changed: drop it
+                        return False
+                    return True
+
                 # persisted index blocks load wholesale; blocks without one
                 # are rebuilt below from fileset IDs (tag wire format)
                 persisted: set[int] = set()
                 if ns.index is not None:
                     persisted = ns.index.load_persisted(self.base, name)
                 for shard in ns.shards:
-                    fids = list_filesets(self.base, name, shard.id)
+                    fids = shard.filesets()
                     result["filesets"] += len(fids)
                     for fid in fids:
                         shard._flushed_blocks.add(fid.block_start)
@@ -349,54 +479,14 @@ class Database:
                     if snap:
                         for sid, bs, stream in snap:
                             for dp in decode(stream):
-                                shard.write(sid, dp.timestamp, dp.value, dp.unit)
+                                _restore(shard, sid, dp.timestamp, dp.value, dp.unit)
                             self._reindex(ns, sid, bs)
                         result["snapshot_records"] += len(snap)
                 entries = CommitLog.replay(self._commitlog_dir(name))
-                # Re-buffering a point that already sits in a flushed fileset
-                # would make the next cold_flush rewrite an identical volume,
-                # so entries for flushed blocks are checked against the
-                # fileset first (decoded lazily, cached per (shard, block,
-                # series)). Points NOT in the fileset are genuine un-flushed
-                # cold writes and must replay.
-                cover: dict[tuple[int, int], FilesetReader | None] = {}
-                pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
-                bsz = ns.opts.block_size_nanos
-
-                def _covered(sh: Shard, e: CommitLogEntry) -> bool:
-                    bs = (e.time_nanos // bsz) * bsz
-                    if bs not in sh._flushed_blocks:
-                        return False
-                    rk = (sh.id, bs)
-                    if rk not in cover:
-                        fid = next(
-                            (
-                                f
-                                for f in list_filesets(self.base, name, sh.id)
-                                if f.block_start == bs
-                            ),
-                            None,
-                        )
-                        cover[rk] = FilesetReader(self.base, fid) if fid else None
-                    reader = cover[rk]
-                    if reader is None:
-                        return False
-                    pk = (sh.id, bs, e.series_id)
-                    if pk not in pts:
-                        stream = reader.stream(e.series_id)
-                        pts[pk] = (
-                            {dp.timestamp: dp.value for dp in decode(stream)}
-                            if stream
-                            else {}
-                        )
-                    return pts[pk].get(e.time_nanos) == e.value
-
                 for e in entries:
                     sh = ns.shard_for(e.series_id)
-                    if _covered(sh, e):
-                        continue
-                    sh.write(e.series_id, e.time_nanos, e.value, e.unit)
-                    self._reindex(ns, e.series_id, e.time_nanos)
+                    if _restore(sh, e.series_id, e.time_nanos, e.value, e.unit):
+                        self._reindex(ns, e.series_id, e.time_nanos)
                 result["commitlog_entries"] += len(entries)
             self.bootstrapped = True
             return result

@@ -188,7 +188,18 @@ def fileset_complete(base: str, fid: FilesetID) -> bool:
         return False
 
 
-def list_filesets(base: str, namespace: str, shard: int) -> list[FilesetID]:
+def delete_fileset(base: str, fid: FilesetID) -> None:
+    """Remove every file of a fileset, checkpoint FIRST so a crash mid-delete
+    leaves an incomplete (ignored) fileset rather than a corrupt-looking one."""
+    for suffix in ("checkpoint", "digest") + SUFFIXES[:-2]:
+        try:
+            os.remove(_path(base, fid, suffix))
+        except FileNotFoundError:
+            pass
+
+
+def list_fileset_volumes(base: str, namespace: str, shard: int) -> list[FilesetID]:
+    """ALL complete volumes (not just the winning one per block)."""
     d = os.path.join(base, "data", namespace, str(shard))
     out = []
     try:
@@ -202,9 +213,13 @@ def list_filesets(base: str, namespace: str, shard: int) -> list[FilesetID]:
         fid = FilesetID(namespace, shard, int(bs), int(vol))
         if fileset_complete(base, fid):
             out.append(fid)
-    # latest volume per block start wins (cold flush volumes)
+    return sorted(out, key=lambda f: (f.block_start, f.volume))
+
+
+def list_filesets(base: str, namespace: str, shard: int) -> list[FilesetID]:
+    """Latest complete volume per block start (cold flush volumes win)."""
     best: dict[int, FilesetID] = {}
-    for fid in sorted(out, key=lambda f: f.volume):
+    for fid in list_fileset_volumes(base, namespace, shard):
         best[fid.block_start] = fid
     return sorted(best.values(), key=lambda f: f.block_start)
 

@@ -1,0 +1,106 @@
+"""Background mediator: the clock-driven lifecycle loop of a storage node.
+
+Reference: /root/reference/src/dbnode/storage/mediator.go:78 — a running node
+ticks, warm/cold-flushes, snapshots, and cleans up continuously; nothing in
+the durability machinery waits for an operator call. Here one daemon thread
+per Database drives `run_once` on an interval; tests drive `run_once(now)`
+directly with a fake clock, so every transition is deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from .series import NANOS
+
+
+@dataclass
+class MediatorOptions:
+    """Cadence knobs (flush manager / tick defaults in the reference)."""
+
+    tick_interval_nanos: int = 10 * NANOS
+    # wall-clock pause between run_once calls of the background thread
+    loop_interval_secs: float = 1.0
+    # a block flushes once now >= block_end + buffer_past (flush_mgr.go)
+    buffer_past_nanos: int = 10 * 60 * NANOS
+    snapshot_interval_nanos: int = 60 * NANOS
+    # floor between flush passes when the cutoff block hasn't advanced —
+    # flush also runs WAL/snapshot cleanup (O(sealed bytes) disk reads), so
+    # it must not run every loop pass
+    flush_interval_nanos: int = 60 * NANOS
+
+
+class Mediator:
+    """Drives tick → flush → snapshot for one Database."""
+
+    def __init__(self, db, opts: MediatorOptions | None = None, clock=time.time_ns):
+        self.db = db
+        self.opts = opts or MediatorOptions()
+        self.clock = clock
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._last_tick = 0
+        self._last_snapshot = 0
+        self._last_flush = 0
+        self._last_cutoff: dict[str, int] = {}
+        self.runs = 0
+        self.errors = 0
+        self.last_error: BaseException | None = None
+
+    # -- one deterministic pass (tests call this with a fake now) --
+
+    def run_once(self, now_nanos: int | None = None) -> dict:
+        now = self.clock() if now_nanos is None else now_nanos
+        did: dict = {"tick": False, "flushed": [], "snapshots": 0}
+        if now - self._last_tick >= self.opts.tick_interval_nanos:
+            self.db.tick(now)
+            self._last_tick = now
+            did["tick"] = True
+        flush_due = now - self._last_flush >= self.opts.flush_interval_nanos
+        for name, ns in list(self.db.namespaces.items()):
+            bsz = ns.opts.block_size_nanos
+            cutoff = ((now - self.opts.buffer_past_nanos) // bsz) * bsz
+            # flush when the cutoff reaches a new block (warm flush due) or
+            # on the periodic interval (drains cold writes + cleanup)
+            if not flush_due and cutoff <= self._last_cutoff.get(name, -1):
+                continue
+            flushed = self.db.flush(name, cutoff)
+            self._last_cutoff[name] = cutoff
+            self._last_flush = now
+            if flushed:
+                did["flushed"].extend(flushed)
+        if now - self._last_snapshot >= self.opts.snapshot_interval_nanos:
+            for name in list(self.db.namespaces):
+                did["snapshots"] += self.db.snapshot(name)
+            self._last_snapshot = now
+        self.runs += 1
+        return did
+
+    # -- background thread --
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.opts.loop_interval_secs):
+                try:
+                    self.run_once()
+                except Exception as exc:  # noqa: BLE001 — the lifecycle loop
+                    # must survive transient errors (disk full, races); a
+                    # dead mediator silently stops all durability work
+                    self.errors += 1
+                    self.last_error = exc
+
+        self._thread = threading.Thread(target=loop, name="m3tpu-mediator", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._thread = None

@@ -63,6 +63,20 @@ def write_snapshot(
     return seq
 
 
+def remove_snapshots(base: str, ns: str, shard: int) -> int:
+    """Delete all snapshot files for a shard (flush covered their records);
+    returns how many files were removed. Reference: storage/cleanup.go removes
+    snapshots once their data is in flushed filesets."""
+    removed = 0
+    for _, path in _list(base, ns, shard):
+        try:
+            os.remove(path)
+            removed += 1
+        except FileNotFoundError:
+            pass
+    return removed
+
+
 def read_latest_snapshot(
     base: str, ns: str, shard: int
 ) -> list[tuple[bytes, int, bytes]] | None:

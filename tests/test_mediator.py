@@ -1,0 +1,191 @@
+"""Clock-driven lifecycle tests: the mediator runs the durability machinery
+with no manual flush/snapshot/tick calls (mediator.go:78 semantics), reads
+hit a cached fileset reader (seek_manager.go role), and retention eviction
+covers buffers, filesets, index blocks, and their persisted files."""
+
+import os
+
+from m3_tpu.storage.database import ColdWriteError, Database, NamespaceOptions
+from m3_tpu.storage.mediator import Mediator, MediatorOptions
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+HOUR = 3600 * NANOS
+B0 = (T0 // HOUR) * HOUR  # block start containing T0
+
+
+def _opts(**kw):
+    return NamespaceOptions(
+        retention_nanos=kw.pop("retention", 8 * HOUR),
+        block_size_nanos=kw.pop("block", HOUR),
+        **kw,
+    )
+
+
+def _mediator(db, now):
+    return Mediator(
+        db,
+        MediatorOptions(
+            tick_interval_nanos=0,
+            buffer_past_nanos=10 * 60 * NANOS,
+            snapshot_interval_nanos=0,
+        ),
+        clock=lambda: now,
+    )
+
+
+def test_mediator_drives_flush_snapshot_wal_and_expiry(tmp_path):
+    db = Database(str(tmp_path), num_shards=2)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    med = _mediator(db, T0)
+
+    # write into block T0; nothing is flushable yet (cutoff < block end)
+    for i in range(50):
+        db.write("ns", b"cpu", T0 + i * NANOS, float(i))
+    out = med.run_once(T0 + 30 * 60 * NANOS)
+    assert out["tick"] and not out["flushed"]
+    # un-flushed data got snapshotted
+    assert out["snapshots"] > 0
+
+    # advance past block end + buffer_past: the mediator warm-flushes,
+    # persists the index, bounds the WAL, and drops the covered snapshot
+    now = T0 + HOUR + 20 * 60 * NANOS
+    out = med.run_once(now)
+    assert out["flushed"], "mediator should flush the completed block"
+    sh = db.namespaces["ns"].shard_for(b"cpu")
+    assert B0 in sh._flushed_blocks
+    # nothing left buffered for that block -> next snapshot pass clears files
+    out = med.run_once(now + NANOS)
+    snap_dir = os.path.join(str(tmp_path), "snapshots", "ns")
+    leftover = [
+        f
+        for root, _, files in os.walk(snap_dir)
+        for f in files
+        if f.startswith("snapshot")
+    ]
+    assert leftover == [], f"covered snapshots must be removed: {leftover}"
+    # reads still serve the flushed data
+    assert len(db.read("ns", b"cpu", T0, T0 + HOUR)) == 50
+
+    # advance past retention: tick expires the fileset from disk
+    late = T0 + 10 * HOUR
+    med.run_once(late)
+    assert db.read("ns", b"cpu", T0, T0 + HOUR) == []
+    data_dir = os.path.join(str(tmp_path), "data", "ns")
+    files = [f for root, _, fs in os.walk(data_dir) for f in fs]
+    assert files == [], f"expired fileset files must be deleted: {files}"
+
+
+def test_mediator_index_eviction_includes_persisted_segments(tmp_path):
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts(retention=4 * HOUR))
+    db.bootstrap()
+    tags = ((b"host", b"a"), (b"name", b"cpu"))
+    db.write_tagged("ns", tags, T0 + NANOS, 1.0)
+    med = _mediator(db, T0)
+    med.run_once(T0 + HOUR + 20 * 60 * NANOS)  # flush + persist index
+    seg_dir = os.path.join(str(tmp_path), "index", "ns")
+    assert os.listdir(seg_dir), "index segments should persist at flush"
+    ns = db.namespaces["ns"]
+    assert B0 in ns.index.blocks
+
+    med.run_once(T0 + 6 * HOUR)  # past retention
+    assert B0 not in ns.index.blocks, "index block must evict past retention"
+    assert os.listdir(seg_dir) == [], "persisted index segment files must go too"
+
+
+def test_reader_cache_materializes_fileset_once(tmp_path):
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    for i in range(20):
+        db.write("ns", b"cpu", T0 + i * NANOS, float(i))
+    db.flush("ns", T0 + HOUR)
+    sh = db.namespaces["ns"].shards[0]
+    before = sh.reader_materializations
+    for _ in range(25):
+        assert len(db.read("ns", b"cpu", T0, T0 + HOUR)) == 20
+    assert sh.reader_materializations == before + 1, (
+        "25 reads of one flushed block must materialize the fileset once"
+    )
+    # a cold write creating a new volume invalidates the cached reader
+    db.write("ns", b"cpu", T0 + 30 * NANOS, 99.0)
+    db.flush("ns", T0 + HOUR)
+    assert len(db.read("ns", b"cpu", T0, T0 + HOUR)) == 21
+    assert sh.reader_materializations == before + 2
+
+
+def test_cold_writes_disabled_rejects_and_bounds_wal(tmp_path):
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts(cold_writes_enabled=False))
+    db.bootstrap()
+    db.write("ns", b"cpu", T0 + NANOS, 1.0)
+    db.flush("ns", T0 + HOUR)
+    try:
+        db.write("ns", b"cpu", T0 + 2 * NANOS, 2.0)
+        raised = False
+    except ColdWriteError:
+        raised = True
+    assert raised, "cold write into a flushed block must be rejected"
+    # WAL is bounded without snapshots even with cold writes disabled
+    wal_dir = os.path.join(str(tmp_path), "commitlogs", "ns")
+    segs = [f for f in os.listdir(wal_dir) if f.endswith(".wal")]
+    assert len(segs) <= 2, f"flush should clean covered WAL segments: {segs}"
+    # restart replays nothing stale: flushed point readable, no duplicates
+    db.close()
+    db2 = Database(str(tmp_path), num_shards=1)
+    db2.create_namespace("ns", _opts(cold_writes_enabled=False))
+    db2.bootstrap()
+    assert [dp.value for dp in db2.read("ns", b"cpu", T0, T0 + HOUR)] == [1.0]
+
+
+def test_snapshot_flush_restart_does_not_duplicate_volumes(tmp_path):
+    """ADVICE r2 repro: snapshot -> flush -> crash -> bootstrap must not
+    re-buffer flushed points (which made the next flush write a spurious
+    duplicate volume)."""
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    for i in range(10):
+        db.write("ns", b"cpu", T0 + i * NANOS, float(i))
+    db.snapshot("ns")
+    db.flush("ns", T0 + HOUR)
+    db.close()  # "crash" after flush; snapshot cleanup already ran in flush
+
+    db2 = Database(str(tmp_path), num_shards=1)
+    db2.create_namespace("ns", _opts())
+    db2.bootstrap()
+    sh = db2.namespaces["ns"].shards[0]
+    assert not any(
+        buf.buckets for buf in sh.series.values()
+    ), "bootstrap must not re-buffer flushed points"
+    fs_before = {
+        f
+        for root, _, fs in os.walk(os.path.join(str(tmp_path), "data"))
+        for f in fs
+    }
+    db2.flush("ns", T0 + HOUR)
+    fs_after = {
+        f
+        for root, _, fs in os.walk(os.path.join(str(tmp_path), "data"))
+        for f in fs
+    }
+    assert fs_before == fs_after, "restart+flush must not write new volumes"
+
+
+def test_mediator_background_thread_runs(tmp_path):
+    import time
+
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    med = Mediator(db, MediatorOptions(loop_interval_secs=0.02))
+    med.start()
+    try:
+        deadline = time.time() + 5
+        while med.runs < 3 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        med.stop()
+    assert med.runs >= 3
