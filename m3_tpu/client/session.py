@@ -45,51 +45,65 @@ class Session:
     def _shard(self, sid: bytes) -> int:
         return shard_for(sid, self.num_shards)
 
+    def _fanout(self, op_name: str, shard: int, required: int, call):
+        """Try ``call(node)`` on every replica of ``shard``; a raising
+        replica must not abort the fan-out — remaining replicas can still
+        reach quorum (session.go:1068). Returns the per-replica results;
+        raises ConsistencyError when fewer than ``required`` succeed."""
+        success, errors, results = 0, [], []
+        for host in self.topology.hosts_for_shard(shard):
+            node = self.nodes.get(host)
+            if node is None or not node.is_up:
+                errors.append(f"{host}: down")
+                continue
+            try:
+                results.append(call(node))
+                success += 1
+            except Exception as exc:
+                errors.append(f"{host}: {exc}")
+        if success < required:
+            raise ConsistencyError(op_name, success, required, errors)
+        return results
+
     # --- writes (session.go:977-1100) ---
 
     def write_tagged(self, tags, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> bytes:
         from ..rules.rules import encode_tags_id
 
         sid = encode_tags_id(tags)
-        shard = self._shard(sid)
-        hosts = self.topology.hosts_for_shard(shard)
-        required = self.write_consistency.required(self.topology.replicas)
-        success, errors = 0, []
-        for host in hosts:
-            node = self.nodes.get(host)
-            if node is None or not node.is_up:
-                errors.append(f"{host}: down")
-                continue
-            try:
-                node.write_tagged(self.namespace, tags, t_nanos, value, unit)
-                success += 1
-            except Exception as exc:  # pragma: no cover - defensive
-                errors.append(f"{host}: {exc}")
-        if success < required:
-            raise ConsistencyError("write", success, required, errors)
+        self._fanout(
+            "write",
+            self._shard(sid),
+            self.write_consistency.required(self.topology.replicas),
+            lambda node: node.write_tagged(self.namespace, tags, t_nanos, value, unit),
+        )
         return sid
 
     def write(self, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> None:
-        shard = self._shard(sid)
-        hosts = self.topology.hosts_for_shard(shard)
-        required = self.write_consistency.required(self.topology.replicas)
-        success, errors = 0, []
-        for host in hosts:
-            node = self.nodes.get(host)
-            if node is None or not node.is_up:
-                errors.append(f"{host}: down")
-                continue
-            try:
-                node.write(self.namespace, sid, t_nanos, value, unit)
-                success += 1
-            except Exception as exc:
-                # a raising replica must not abort the fan-out — remaining
-                # replicas can still reach quorum (session.go:1068)
-                errors.append(f"{host}: {exc}")
-        if success < required:
-            raise ConsistencyError("write", success, required, errors)
+        self._fanout(
+            "write",
+            self._shard(sid),
+            self.write_consistency.required(self.topology.replicas),
+            lambda node: node.write(self.namespace, sid, t_nanos, value, unit),
+        )
 
     # --- reads (session.go:1269-1530 + series_iterator replica merge) ---
+
+    def fetch(self, sid: bytes, start_nanos: int, end_nanos: int):
+        """Fetch one series by ID. Consistency gates ONLY on the shard this
+        ID lives in (session.go:1789-1815 readConsistencyAchieved over the
+        attempted shard) — other shards being down cannot fail this read."""
+        replies = self._fanout(
+            "fetch",
+            self._shard(sid),
+            self.read_consistency.required(self.topology.replicas),
+            lambda node: node.read(self.namespace, sid, start_nanos, end_nanos),
+        )
+        merged: dict[int, object] = {}
+        for dps in replies:
+            for dp in dps:
+                merged.setdefault(dp.timestamp, dp)
+        return [merged[t] for t in sorted(merged)]
 
     def fetch_tagged(self, query, start_nanos: int, end_nanos: int):
         """Fan out to replicas of every shard; merge + dedupe series across

@@ -1,0 +1,159 @@
+"""RemoteNode: socket-backed node stub with a connection pool + retries.
+
+Reference: /root/reference/src/dbnode/client/ — host queues and connection
+pools (session.go:505 Open, host_queue.go); here each RemoteNode keeps a
+small pool of persistent connections, retries once on a broken connection
+(idempotent ops), and surfaces remote errors as exceptions so the Session's
+consistency accounting treats them like any replica failure.
+
+RemoteNode implements the same surface as testing/cluster.Node, so a Session
+works identically over in-process nodes and sockets.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..utils.xtime import Unit
+from . import wire
+
+
+class RemoteError(RuntimeError):
+    def __init__(self, etype: str, message: str) -> None:
+        super().__init__(message)
+        self.etype = etype
+
+
+class RemoteNode:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        node_id: str | None = None,
+        pool_size: int = 4,
+        timeout: float = 10.0,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.id = node_id or f"{host}:{port}"
+        self.timeout = timeout
+        self._pool: list[socket.socket] = []
+        self._pool_lock = threading.Lock()
+        self._pool_size = pool_size
+        self._shards_cache: tuple[float, set[int]] | None = None
+
+    # -- connection pool --
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _acquire(self) -> socket.socket:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._connect()
+
+    def _release(self, sock: socket.socket) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(sock)
+                return
+        sock.close()
+
+    def close(self) -> None:
+        with self._pool_lock:
+            for sock in self._pool:
+                sock.close()
+            self._pool.clear()
+
+    def _call(self, op: str, _retry: bool = True, **args):
+        req = {"op": op, **args}
+        sock = self._acquire()
+        try:
+            wire.send_frame(sock, req)
+            resp = wire.recv_frame(sock)
+        except (ConnectionError, OSError, ValueError):
+            sock.close()
+            if _retry:
+                # one retry on a fresh connection (stale pooled socket)
+                return self._call(op, _retry=False, **args)
+            raise
+        self._release(sock)
+        if not resp.get("ok"):
+            raise RemoteError(resp.get("etype", ""), resp.get("error", "remote error"))
+        return resp.get("result")
+
+    # -- node surface (mirrors testing/cluster.Node) --
+
+    @property
+    def is_up(self) -> bool:
+        # optimistic: failures surface as exceptions the session counts
+        return True
+
+    def health(self) -> dict:
+        return self._call("health")
+
+    def write(self, ns, sid, t, v, unit=Unit.SECOND):
+        return self._call("write", ns=ns, sid=sid, t=t, v=v, unit=int(unit))
+
+    def write_batch(self, ns, entries):
+        return self._call(
+            "write_batch", ns=ns, entries=[list(e) for e in entries]
+        )
+
+    def write_tagged(self, ns, tags, t, v, unit=Unit.SECOND):
+        return self._call(
+            "write_tagged",
+            ns=ns,
+            tags=[[n, v2] for n, v2 in tags],
+            t=t,
+            v=v,
+            unit=int(unit),
+        )
+
+    def read(self, ns, sid, start, end):
+        return wire.dps_from_wire(
+            self._call("fetch", ns=ns, sid=sid, start=start, end=end)
+        )
+
+    def fetch_tagged(self, ns, query, start, end, limit=None):
+        return wire.series_from_wire(
+            self._call(
+                "fetch_tagged",
+                ns=ns,
+                query=wire.query_to_wire(query),
+                start=start,
+                end=end,
+                limit=limit,
+            )
+        )
+
+    def query_ids(self, ns, query, start, end, limit=None):
+        return self._call(
+            "query_ids",
+            ns=ns,
+            query=wire.query_to_wire(query),
+            start=start,
+            end=end,
+            limit=limit,
+        )
+
+    def stream_shard(self, ns, shard):
+        return wire.series_from_wire(self._call("stream_shard", ns=ns, shard=shard))
+
+    def owned_shards(self, cache_secs: float = 1.0) -> set[int]:
+        cached = self._shards_cache
+        now = time.monotonic()
+        if cached is not None and now - cached[0] < cache_secs:
+            return cached[1]
+        shards = set(self._call("owned_shards"))
+        self._shards_cache = (now, shards)
+        return shards
+
+    def assign_shards(self, shards) -> None:
+        self._shards_cache = None
+        self._call("assign_shards", shards=sorted(shards))

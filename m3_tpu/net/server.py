@@ -1,0 +1,143 @@
+"""Node RPC server: the network data plane of a storage node.
+
+Reference: /root/reference/src/dbnode/network/server/tchannelthrift/node/
+service.go — write (:449), writeTagged, fetch, fetchTagged (:626), query,
+aggregate, plus the peer-streaming endpoints the bootstrapper/repair use.
+Here: a threaded TCP server speaking the net.wire framing; each connection
+is a sequential request/response loop (clients pool connections for
+concurrency); per-request errors return {"ok": False} without killing the
+connection.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+from ..utils.xtime import Unit
+from . import wire
+
+
+class NodeService:
+    """Dispatch table over a storage Database + shard assignment state."""
+
+    def __init__(self, db, node_id: str = "", assigned_shards=None) -> None:
+        self.db = db
+        self.node_id = node_id
+        self.assigned_shards: set[int] = set(assigned_shards or ())
+
+    def handle(self, req: dict):
+        op = req.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown op {op!r}")
+        return fn(req)
+
+    # -- rpc.thrift surface --
+
+    def op_health(self, req):
+        return {"id": self.node_id, "bootstrapped": self.db.bootstrapped}
+
+    def op_write(self, req):
+        self.db.write(
+            req["ns"], req["sid"], req["t"], req["v"], Unit(req.get("unit", 1))
+        )
+        return True
+
+    def op_write_batch(self, req):
+        self.db.write_batch(req["ns"], [tuple(e) for e in req["entries"]])
+        return True
+
+    def op_write_tagged(self, req):
+        tags = tuple((n, v) for n, v in req["tags"])
+        return self.db.write_tagged(
+            req["ns"], tags, req["t"], req["v"], Unit(req.get("unit", 1))
+        )
+
+    def op_fetch(self, req):
+        dps = self.db.read(req["ns"], req["sid"], req["start"], req["end"])
+        return wire.dps_to_wire(dps)
+
+    def op_fetch_tagged(self, req):
+        q = wire.query_from_wire(req["query"])
+        res = self.db.fetch_tagged(
+            req["ns"], q, req["start"], req["end"], limit=req.get("limit")
+        )
+        return wire.series_to_wire(res)
+
+    def op_query_ids(self, req):
+        q = wire.query_from_wire(req["query"])
+        result = self.db.query_ids(
+            req["ns"], q, req["start"], req["end"], limit=req.get("limit")
+        )
+        return {
+            "ids": [d.id for d in result.docs],
+            "exhaustive": result.exhaustive,
+        }
+
+    def op_stream_shard(self, req):
+        return wire.series_to_wire(self.db.stream_shard(req["ns"], req["shard"]))
+
+    def op_owned_shards(self, req):
+        return sorted(self.assigned_shards)
+
+    def op_assign_shards(self, req):
+        """AssignShardSet (database.go:386): the control plane pushes shard
+        ownership; peers bootstrap is driven by the caller via stream_shard."""
+        self.assigned_shards = set(req["shards"])
+        return True
+
+
+class NodeServer:
+    """TCP front end for a NodeService."""
+
+    def __init__(self, service: NodeService, host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        svc = service
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        req = wire.recv_frame(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        result = svc.handle(req)
+                        resp = {"ok": True, "result": result}
+                    except Exception as exc:  # per-request isolation
+                        resp = {
+                            "ok": False,
+                            "error": f"{type(exc).__name__}: {exc}",
+                            "etype": type(exc).__name__,
+                        }
+                    try:
+                        wire.send_frame(self.request, resp)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="m3tpu-node-server", daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

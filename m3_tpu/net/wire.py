@@ -1,0 +1,228 @@
+"""Node RPC wire format: length-prefixed frames of a compact binary value
+codec, plus (de)serialization of the index query AST and datapoints.
+
+Reference surface: /root/reference/src/dbnode/generated/thrift/rpc.thrift:44-87
+(write / writeTagged / fetch / fetchTagged / query plus batch variants) —
+the reference speaks TChannel+Thrift; this framework defines its own framing:
+
+    frame   := u32 little-endian payload length | payload
+    payload := value
+    value   := 'N' | 'T' | 'F'
+             | 'i' i64 | 'd' f64
+             | 'b' u32 len bytes | 's' u32 len utf8
+             | 'l' u32 count value* | 'm' u32 count (value value)*
+
+Every RPC request is a map {"op": str, ...args}; every response is a map
+{"ok": bool, "result": ... | "error": str}.
+"""
+
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+
+from ..codec.m3tsz import Datapoint
+from ..index.query import (
+    AllQuery,
+    ConjunctionQuery,
+    DisjunctionQuery,
+    FieldQuery,
+    NegationQuery,
+    Query,
+    RegexpQuery,
+    TermQuery,
+)
+from ..utils.xtime import Unit
+
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def encode_value(v, out: BytesIO) -> None:
+    if v is None:
+        out.write(b"N")
+    elif v is True:
+        out.write(b"T")
+    elif v is False:
+        out.write(b"F")
+    elif isinstance(v, int):
+        out.write(b"i")
+        out.write(_I64.pack(v))
+    elif isinstance(v, float):
+        out.write(b"d")
+        out.write(_F64.pack(v))
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        out.write(b"b")
+        out.write(_U32.pack(len(b)))
+        out.write(b)
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out.write(b"s")
+        out.write(_U32.pack(len(b)))
+        out.write(b)
+    elif isinstance(v, (list, tuple)):
+        out.write(b"l")
+        out.write(_U32.pack(len(v)))
+        for item in v:
+            encode_value(item, out)
+    elif isinstance(v, dict):
+        out.write(b"m")
+        out.write(_U32.pack(len(v)))
+        for k, val in v.items():
+            encode_value(k, out)
+            encode_value(val, out)
+    else:
+        raise TypeError(f"unencodable type {type(v)!r}")
+
+
+def decode_value(buf: bytes, pos: int = 0):
+    tag = buf[pos : pos + 1]
+    pos += 1
+    if tag == b"N":
+        return None, pos
+    if tag == b"T":
+        return True, pos
+    if tag == b"F":
+        return False, pos
+    if tag == b"i":
+        return _I64.unpack_from(buf, pos)[0], pos + 8
+    if tag == b"d":
+        return _F64.unpack_from(buf, pos)[0], pos + 8
+    if tag in (b"b", b"s"):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        raw = buf[pos : pos + n]
+        if len(raw) != n:
+            raise ValueError("truncated value")
+        return (raw if tag == b"b" else raw.decode("utf-8")), pos + n
+    if tag == b"l":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = decode_value(buf, pos)
+            items.append(item)
+        return items, pos
+    if tag == b"m":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = decode_value(buf, pos)
+            v, pos = decode_value(buf, pos)
+            d[k] = v
+        return d, pos
+    raise ValueError(f"bad value tag {tag!r} at {pos - 1}")
+
+
+def dumps(v) -> bytes:
+    out = BytesIO()
+    encode_value(v, out)
+    return out.getvalue()
+
+
+def loads(b: bytes):
+    v, pos = decode_value(b, 0)
+    if pos != len(b):
+        raise ValueError(f"trailing bytes after value ({pos} != {len(b)})")
+    return v
+
+
+# --- framing over a socket/file-like ---
+
+
+def send_frame(sock, v) -> None:
+    payload = dumps(v)
+    sock.sendall(_U32.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock):
+    (n,) = _U32.unpack(_recv_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return loads(_recv_exact(sock, n))
+
+
+# --- query AST <-> wire values ---
+
+
+def query_to_wire(q: Query):
+    if isinstance(q, TermQuery):
+        return {"t": "term", "f": q.field, "v": q.value}
+    if isinstance(q, RegexpQuery):
+        return {"t": "regexp", "f": q.field, "p": q.pattern}
+    if isinstance(q, FieldQuery):
+        return {"t": "field", "f": q.field}
+    if isinstance(q, AllQuery):
+        return {"t": "all"}
+    if isinstance(q, ConjunctionQuery):
+        return {"t": "conj", "q": [query_to_wire(s) for s in q.queries]}
+    if isinstance(q, DisjunctionQuery):
+        return {"t": "disj", "q": [query_to_wire(s) for s in q.queries]}
+    if isinstance(q, NegationQuery):
+        return {"t": "neg", "q": query_to_wire(q.query)}
+    raise TypeError(f"unknown query type {type(q)!r}")
+
+
+def query_from_wire(w) -> Query:
+    t = w["t"]
+    if t == "term":
+        return TermQuery(w["f"], w["v"])
+    if t == "regexp":
+        return RegexpQuery(w["f"], w["p"])
+    if t == "field":
+        return FieldQuery(w["f"])
+    if t == "all":
+        return AllQuery()
+    if t == "conj":
+        return ConjunctionQuery(tuple(query_from_wire(s) for s in w["q"]))
+    if t == "disj":
+        return DisjunctionQuery(tuple(query_from_wire(s) for s in w["q"]))
+    if t == "neg":
+        return NegationQuery(query_from_wire(w["q"]))
+    raise ValueError(f"unknown query tag {t!r}")
+
+
+# --- datapoints / series results ---
+
+
+def dps_to_wire(dps) -> list:
+    return [
+        [dp.timestamp, dp.value, int(dp.unit), dp.annotation or b""] for dp in dps
+    ]
+
+
+def dps_from_wire(w) -> list[Datapoint]:
+    return [
+        Datapoint(t, v, Unit(u), bytes(a) if a else None) for t, v, u, a in w
+    ]
+
+
+def series_to_wire(result) -> list:
+    """[(sid, tags, dps)] -> wire (tags as [[name, value], ...])."""
+    return [
+        [sid, [[n, v] for n, v in tags], dps_to_wire(dps)]
+        for sid, tags, dps in result
+    ]
+
+
+def series_from_wire(w) -> list:
+    return [
+        (sid, tuple((n, v) for n, v in tags), dps_from_wire(dps))
+        for sid, tags, dps in w
+    ]

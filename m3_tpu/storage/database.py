@@ -316,6 +316,30 @@ class Database:
                 out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
             return out
 
+    def stream_shard(self, ns: str, shard_id: int) -> list:
+        """Peer streaming (FetchBootstrapBlocksFromPeers / repair source):
+        every (sid, tags, datapoints) owned by one shard; tags come from the
+        reverse index when available."""
+        with self.lock:
+            namespace = self.namespaces[ns]
+            sh = namespace.shards[shard_id]
+            sids = set(sh.series)
+            for fid in sh.filesets():
+                sids.update(sh.reader(fid).series_ids)
+            docs: dict[bytes, tuple] = {}
+            if namespace.index is not None and sids:
+                for blk in namespace.index.blocks.values():
+                    for seg in blk.segments:
+                        for d in seg.docs:
+                            if d.id in sids:
+                                docs.setdefault(d.id, d.fields)
+            out = []
+            for sid in sorted(sids):
+                dps = sh.read(sid, 0, 2**62)
+                if dps:
+                    out.append((sid, docs.get(sid, ()), dps))
+            return out
+
     def flush(self, ns: str, flush_before_nanos: int) -> list[FilesetID]:
         with self.lock:
             namespace = self.namespaces[ns]

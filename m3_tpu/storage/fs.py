@@ -23,7 +23,10 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..ops.chunked import ChunkedBatch, assemble_chunked, snapshot_stream
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # ops.chunked pulls in jax; storage nodes import lazily
+    from ..ops.chunked import ChunkedBatch
 
 CHUNK_K = 32
 
@@ -111,6 +114,8 @@ def write_fileset(
     if native.available():
         all_snaps = native.prescan_batch([series[sid] for sid in ids], k=chunk_k)
     else:
+        from ..ops.chunked import snapshot_stream
+
         all_snaps = [snapshot_stream(series[sid], chunk_k) for sid in ids]
     for i, sid in enumerate(ids):
         stream = series[sid]
@@ -314,9 +319,11 @@ class FilesetReader:
             )
         return snaps
 
-    def chunked_batch(self, sids: list[bytes] | None = None) -> ChunkedBatch:
+    def chunked_batch(self, sids: list[bytes] | None = None) -> "ChunkedBatch":
         """Assemble a device-decodable batch straight from the fileset —
         no CPU prescan (the side file already holds the snapshots)."""
+        from ..ops.chunked import assemble_chunked
+
         sids = sids if sids is not None else self.series_ids
         streams = []
         snaps = []

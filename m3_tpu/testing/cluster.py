@@ -58,29 +58,18 @@ class Node:
         return self.db.fetch_tagged(ns, query, start, end)
 
     def read(self, ns, sid, start, end):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
         return self.db.read(ns, sid, start, end)
 
     def owned_shards(self) -> set[int]:
         return self.assigned_shards
 
     def stream_shard(self, ns, shard):
-        """Peer streaming: all (sid, tags, datapoints) owned by one shard.
-        Tags come from the reverse index when available."""
-        namespace = self.db.namespaces[ns]
-        docs = {}
-        if namespace.index is not None:
-            from ..index.query import AllQuery
-
-            for blk in namespace.index.blocks.values():
-                for seg in blk.segments:
-                    for d in seg.docs:
-                        docs.setdefault(d.id, d.fields)
-        out = []
-        sh = namespace.shards[shard]
-        for sid, buf in sh.series.items():
-            dps = sh.read(sid, 0, 2**62)
-            out.append((sid, docs.get(sid, ()), dps))
-        return out
+        """Peer streaming: all (sid, tags, datapoints) owned by one shard."""
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        return self.db.stream_shard(ns, shard)
 
 
 @dataclass
@@ -178,6 +167,8 @@ class LocalCluster:
                         series.setdefault(dp.timestamp, (dp.value, dp.unit))
                         have[sid].add(dp.timestamp)
                 per_node[node.id] = have
+            from ..storage.database import ColdWriteError
+
             for node in owners:
                 have = per_node[node.id]
                 for sid, points in union.items():
@@ -185,9 +176,14 @@ class LocalCluster:
                     for t in sorted(missing):
                         v, unit = points[t]
                         tags = tag_map.get(sid)
-                        if tags:
-                            node.write_tagged(ns, tags, t, v, unit)
-                        else:
-                            node.write(ns, sid, t, v, unit)
+                        try:
+                            if tags:
+                                node.write_tagged(ns, tags, t, v, unit)
+                            else:
+                                node.write(ns, sid, t, v, unit)
+                        except ColdWriteError:
+                            # cold writes disabled: a flushed-block diff can't
+                            # be backfilled through the write path; skip it
+                            continue
                         repaired += 1
         return repaired
