@@ -15,6 +15,7 @@ dict work, exactly the role the reference's entry.go hashmap plays.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -105,13 +106,27 @@ class Aggregator:
         num_shards: int = 16,
         default_policies: tuple[StoragePolicy, ...] = (),
         flush_handler: Callable[[list[AggregatedMetric]], None] | None = None,
+        election=None,
+        flush_times=None,
     ) -> None:
         self.num_shards = num_shards
         self.shards = [_Shard() for _ in range(num_shards)]
         self.default_policies = default_policies or (StoragePolicy.parse("10s:2d"),)
         self.flush_handler = flush_handler
-        # warm standby: follower shards mirror adds but skip flush output
-        self.is_leader = True
+        # Replicated deployment: an election.ElectionManager decides which
+        # replica emits at each flush pass, and a FlushTimesStore shares the
+        # leader's progress so followers prune instead of emit and a
+        # takeover resumes exactly where the old leader stopped
+        # (election_mgr.go:43, follower_flush_mgr.go:70). Standalone
+        # (election=None) is always leader.
+        self.election = election
+        self.flush_times = flush_times
+        # late datapoints a replicated leader dropped because their window
+        # was already flushed (observability for the replication caveat)
+        self.dropped_late = 0
+        # ingest servers call add_* from handler threads while a flush loop
+        # drains; one lock guards the column buffers (entry.go lock role)
+        self._lock = threading.Lock()
 
     def shard_for(self, mid: bytes) -> int:
         return shard_for(mid, self.num_shards)
@@ -132,14 +147,15 @@ class Aggregator:
             values = list(metric.batch_timer_values)
         else:
             values = [metric.gauge_value]
-        shard.add(
-            metric.id,
-            metric.type,
-            time_nanos,
-            values,
-            policies or self.default_policies,
-            aggregations,
-        )
+        with self._lock:
+            shard.add(
+                metric.id,
+                metric.type,
+                time_nanos,
+                values,
+                policies or self.default_policies,
+                aggregations,
+            )
 
     def add_timed(
         self,
@@ -150,24 +166,59 @@ class Aggregator:
         policies: tuple[StoragePolicy, ...] | None = None,
         aggregations: tuple[AggregationType, ...] | None = None,
     ) -> None:
-        self.shards[self.shard_for(mid)].add(
-            mid, mtype, time_nanos, [value], policies or self.default_policies, aggregations
-        )
+        with self._lock:
+            self.shards[self.shard_for(mid)].add(
+                mid, mtype, time_nanos, [value],
+                policies or self.default_policies, aggregations,
+            )
 
     # AddForwarded: multi-stage rollup input — same buffer path, the pipeline
     # stage lives in rules (forwarded_writer.go equivalence).
     add_forwarded = add_timed
 
-    # --- flush (leader_flush_mgr.go drains windows per resolution) ---
+    @property
+    def is_leader(self) -> bool:
+        return self.election is None or self.election.is_leader
+
+    # --- flush (leader_flush_mgr.go drains windows per resolution;
+    # follower_flush_mgr.go prunes up to the leader's flush times) ---
 
     def flush(self, up_to_nanos: int) -> list[AggregatedMetric]:
+        # campaigning at flush time means takeover is observed within one
+        # flush interval of the old leader's session expiring
+        leader = self.election.elect() if self.election is not None else True
+        leader_times = self.flush_times.get() if self.flush_times is not None else {}
+        flushed_boundaries: dict[str, int] = {}
         out: list[AggregatedMetric] = []
+        with self._lock:
+            self._drain(
+                leader, up_to_nanos, leader_times, flushed_boundaries, out
+            )
+        # delivery BEFORE recording progress: if the handler raises (or the
+        # process dies here), the shared flush times don't advance, so
+        # followers keep their mirror of these windows and a takeover
+        # re-emits them instead of losing them
+        if self.flush_handler and out:
+            self.flush_handler(out)
+        if leader and self.flush_times is not None and flushed_boundaries:
+            self.flush_times.update(flushed_boundaries)
+        return out
+
+    def _drain(self, leader, up_to_nanos, leader_times, flushed_boundaries, out):
         for shard in self.shards:
             for policy, buf in shard.buffers.items():
                 if not buf.ids:
                     continue
                 res = policy.resolution.window_nanos
-                boundary = (up_to_nanos // res) * res
+                pkey = str(policy)
+                prev_bound = leader_times.get(pkey, 0)
+                if leader:
+                    boundary = (up_to_nanos // res) * res
+                else:
+                    # follower warm standby: drop ONLY what the leader has
+                    # durably flushed; everything else stays buffered so a
+                    # takeover can flush it
+                    boundary = prev_bound
                 times = np.asarray(buf.times, np.int64)
                 flushable = times < boundary
                 if not flushable.any():
@@ -182,13 +233,21 @@ class Aggregator:
                 buf.times = list(times[keep])
                 buf.values = list(np.asarray(buf.values, np.float32)[keep])
                 buf.types = list(np.asarray(buf.types, np.int32)[keep])
-                if self.is_leader:
-                    out.extend(
-                        self._flush_policy(shard, policy, ids, ts, vals, types, res)
+                if leader:
+                    # windows the previous leader already emitted (per the
+                    # shared flush times) are discarded, not re-emitted
+                    emit = ts >= prev_bound
+                    if emit.any():
+                        out.extend(
+                            self._flush_policy(
+                                shard, policy, ids[emit], ts[emit],
+                                vals[emit], types[emit], res,
+                            )
+                        )
+                    self.dropped_late += int((~emit).sum())
+                    flushed_boundaries[pkey] = max(
+                        boundary, flushed_boundaries.get(pkey, 0)
                     )
-        if self.flush_handler and out:
-            self.flush_handler(out)
-        return out
 
     def _flush_policy(self, shard, policy, ids, ts, vals, types, res) -> list[AggregatedMetric]:
         w0 = int(ts.min() // res) * res

@@ -1,0 +1,68 @@
+"""Aggregator leader election + shared flush-time bookkeeping.
+
+Reference: /root/reference/src/aggregator/aggregator/election_mgr.go:43 —
+each aggregator replica campaigns on a per-shard-set election; exactly one
+leader flushes, followers run warm standby. follower_flush_mgr.go:70 — the
+leader persists per-window flush times to KV; followers prune their mirrored
+buffers up to the leader's flush times instead of emitting, so a takeover
+flushes every window exactly once (nothing lost, nothing doubled).
+"""
+
+from __future__ import annotations
+
+from ..cluster.services import LeaderElection
+
+
+class FlushTimesStore:
+    """KV-backed map of policy key -> last flushed window boundary (nanos).
+
+    The role of flushTimesManager persisting flush times to the cluster KV
+    (flush_times_mgr.go): followers read it to know what the leader already
+    emitted; a new leader resumes from it."""
+
+    def __init__(self, kv, scope: str) -> None:
+        self.kv = kv
+        self.key = f"_flush_times/{scope}"
+
+    def get(self) -> dict[str, int]:
+        vv = self.kv.get(self.key)
+        return dict(vv.value) if vv and vv.value else {}
+
+    def update(self, updates: dict[str, int]) -> None:
+        """Merge updates, keeping the max boundary per policy (CAS loop)."""
+        for _ in range(16):
+            vv = self.kv.get(self.key)
+            cur = dict(vv.value) if vv and vv.value else {}
+            for k, boundary in updates.items():
+                cur[k] = max(boundary, cur.get(k, 0))
+            try:
+                if vv is None:
+                    self.kv.set_if_not_exists(self.key, cur)
+                else:
+                    self.kv.check_and_set(self.key, vv.version, cur)
+                return
+            except (ValueError, KeyError):
+                continue  # raced another writer; re-read and retry
+        raise RuntimeError("flush times CAS contention")
+
+
+class ElectionManager:
+    """Campaign/observe leadership for one aggregator replica."""
+
+    def __init__(self, kv, scope: str, instance_id: str) -> None:
+        self.election = LeaderElection(kv, f"aggregator/{scope}")
+        self.instance_id = instance_id
+
+    def elect(self) -> bool:
+        """Campaign; returns whether this instance is now the leader.
+        Aggregators call this at each flush pass, so leadership loss or
+        takeover is observed within one flush interval (election_mgr.go
+        checkCampaignState)."""
+        return self.election.campaign(self.instance_id)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.election.leader() == self.instance_id
+
+    def resign(self) -> None:
+        self.election.resign(self.instance_id)

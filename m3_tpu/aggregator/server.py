@@ -1,0 +1,139 @@
+"""Aggregator ingest server + client: the tier's socket boundary.
+
+Reference: /root/reference/src/aggregator/server/rawtcp/server.go — a raw TCP
+listener decoding the unaggregated metrics stream into AddUntimed/AddTimed —
+and src/aggregator/client/client.go — the shard-routing writer the
+coordinator's downsampler uses. Framing is metrics/encoding's length-prefixed
+messages, streamed one-way per connection (fire-and-forget, like rawtcp).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+from ..metrics.encoding import UnaggregatedMessage, decode_message, encode_message
+from ..metrics.types import MetricType
+from ..net.wire import FrameDecoder, pack_frame
+from ..utils.hash import shard_for
+
+MAX_MSG = 64 * 1024 * 1024
+
+
+class AggregatorIngestServer:
+    """rawtcp server: stream of length-prefixed unaggregated messages."""
+
+    def __init__(self, aggregator, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.aggregator = aggregator
+        self.received = 0
+        self.decode_errors = 0
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                frames = FrameDecoder(max_frame=MAX_MSG)
+                while True:
+                    try:
+                        chunk = self.request.recv(1 << 20)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    try:
+                        payloads = frames.feed(chunk)
+                    except ValueError:
+                        return  # poisoned stream; drop connection
+                    for payload in payloads:
+                        try:
+                            msg, _ = decode_message(payload)
+                            outer._apply(msg)
+                            outer.received += 1
+                        except Exception:
+                            outer.decode_errors += 1
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def _apply(self, msg: UnaggregatedMessage) -> None:
+        policies = msg.policies or None
+        aggs = msg.aggregations or None
+        if msg.timed:
+            m = msg.metric
+            if m.type == MetricType.COUNTER:
+                values = [float(m.counter_value)]
+            elif m.type == MetricType.TIMER:
+                values = list(m.batch_timer_values)
+            else:
+                values = [m.gauge_value]
+            for v in values:
+                self.aggregator.add_timed(
+                    m.id, m.type, msg.time_nanos, v, policies, aggs
+                )
+        else:
+            self.aggregator.add_untimed(msg.metric, msg.time_nanos, policies, aggs)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="m3tpu-agg-ingest", daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class AggregatorClient:
+    """Shard-routing writer over persistent sockets (client/client.go).
+
+    Instances own disjoint shard ranges of a ``num_shards`` space; a metric
+    routes by murmur3 shard of its id. With one instance, everything goes
+    there (the common single-aggregator deployment)."""
+
+    def __init__(self, endpoints: list[tuple[str, int]], num_shards: int = 16) -> None:
+        self.endpoints = endpoints
+        self.num_shards = num_shards
+        self._socks: dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+
+    def _sock(self, idx: int) -> socket.socket:
+        sock = self._socks.get(idx)
+        if sock is None:
+            host, port = self.endpoints[idx]
+            sock = socket.create_connection((host, port), timeout=10)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[idx] = sock
+        return sock
+
+    def _instance_for(self, mid: bytes) -> int:
+        return shard_for(mid, self.num_shards) % len(self.endpoints)
+
+    def send(self, msg: UnaggregatedMessage) -> None:
+        frame = pack_frame(encode_message(msg))
+        idx = self._instance_for(msg.metric.id)
+        with self._lock:
+            try:
+                self._sock(idx).sendall(frame)
+            except OSError:
+                # one reconnect attempt (stale connection)
+                self._socks.pop(idx, None)
+                self._sock(idx).sendall(frame)
+
+    def close(self) -> None:
+        with self._lock:
+            for sock in self._socks.values():
+                sock.close()
+            self._socks.clear()

@@ -1,0 +1,185 @@
+"""Unaggregated metrics wire encoding: the aggregation tier's ingress format.
+
+Reference: /root/reference/src/metrics/encoding/protobuf/ —
+unaggregated_encoder.go / unaggregated_iterator.go encode a stream of
+length-prefixed MetricWithMetadatas messages (counter/timer/gauge union +
+staged metadatas carrying storage policies and aggregation types). This
+framework defines its own compact layout with the same information content:
+
+    message := u8 kind | payload
+    untimed := u8 mtype | u32 id_len | id | i64 time_nanos
+             | union (i64 counter / u32 n f64* timers / f64 gauge)
+             | u32 ann_len | ann
+             | u8 n_policies (u32 res_nanos_s? -> i64 window, i64 retention)*
+             | u8 n_aggs (u8 agg_type)*
+    timed   := like untimed with a single f64 value
+
+Policies/aggregations empty means "use the receiver's defaults", matching
+the DefaultStagedMetadatas fast path the reference optimizes for.
+"""
+
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+
+from .policy import Resolution, Retention, StoragePolicy
+from .types import AggregationType, MetricType, Untimed
+
+KIND_UNTIMED = 1
+KIND_TIMED = 2
+
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+
+class UnaggregatedMessage:
+    """One ingress message: an untimed/timed metric + routing metadata."""
+
+    def __init__(
+        self,
+        metric: Untimed,
+        time_nanos: int,
+        policies: tuple[StoragePolicy, ...] = (),
+        aggregations: tuple[AggregationType, ...] = (),
+        timed: bool = False,
+    ) -> None:
+        self.metric = metric
+        self.time_nanos = time_nanos
+        self.policies = tuple(policies)
+        self.aggregations = tuple(aggregations)
+        self.timed = timed
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, UnaggregatedMessage)
+            and self.metric == other.metric
+            and self.time_nanos == other.time_nanos
+            and self.policies == other.policies
+            and self.aggregations == other.aggregations
+            and self.timed == other.timed
+        )
+
+    def __repr__(self) -> str:  # debugging aid
+        return (
+            f"UnaggregatedMessage({self.metric!r}, t={self.time_nanos}, "
+            f"policies={self.policies}, aggs={self.aggregations})"
+        )
+
+
+def encode_message(msg: UnaggregatedMessage) -> bytes:
+    out = BytesIO()
+    out.write(_U8.pack(KIND_TIMED if msg.timed else KIND_UNTIMED))
+    m = msg.metric
+    out.write(_U8.pack(int(m.type)))
+    out.write(_U32.pack(len(m.id)))
+    out.write(m.id)
+    out.write(_I64.pack(msg.time_nanos))
+    if m.type == MetricType.COUNTER:
+        out.write(_I64.pack(int(m.counter_value)))
+    elif m.type == MetricType.TIMER:
+        out.write(_U32.pack(len(m.batch_timer_values)))
+        for v in m.batch_timer_values:
+            out.write(_F64.pack(v))
+    else:
+        out.write(_F64.pack(m.gauge_value))
+    ann = m.annotation or b""
+    out.write(_U32.pack(len(ann)))
+    out.write(ann)
+    out.write(_U8.pack(len(msg.policies)))
+    for p in msg.policies:
+        out.write(_I64.pack(p.resolution.window_nanos))
+        out.write(_I64.pack(p.retention.period_nanos))
+    out.write(_U8.pack(len(msg.aggregations)))
+    for a in msg.aggregations:
+        out.write(_U8.pack(int(a)))
+    return out.getvalue()
+
+
+def decode_message(buf: bytes, pos: int = 0) -> tuple[UnaggregatedMessage, int]:
+    kind = buf[pos]
+    pos += 1
+    if kind not in (KIND_UNTIMED, KIND_TIMED):
+        raise ValueError(f"bad message kind {kind}")
+    mtype = MetricType(buf[pos])
+    pos += 1
+    (id_len,) = _U32.unpack_from(buf, pos)
+    pos += 4
+    mid = bytes(buf[pos : pos + id_len])
+    pos += id_len
+    (t,) = _I64.unpack_from(buf, pos)
+    pos += 8
+    counter, timers, gauge = 0, [], 0.0
+    if mtype == MetricType.COUNTER:
+        (counter,) = _I64.unpack_from(buf, pos)
+        pos += 8
+    elif mtype == MetricType.TIMER:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        timers = [
+            _F64.unpack_from(buf, pos + 8 * i)[0] for i in range(n)
+        ]
+        pos += 8 * n
+    else:
+        (gauge,) = _F64.unpack_from(buf, pos)
+        pos += 8
+    (ann_len,) = _U32.unpack_from(buf, pos)
+    pos += 4
+    ann = bytes(buf[pos : pos + ann_len])
+    pos += ann_len
+    n_pol = buf[pos]
+    pos += 1
+    policies = []
+    for _ in range(n_pol):
+        (window,) = _I64.unpack_from(buf, pos)
+        (period,) = _I64.unpack_from(buf, pos + 8)
+        pos += 16
+        policies.append(StoragePolicy(Resolution(window), Retention(period)))
+    n_agg = buf[pos]
+    pos += 1
+    aggs = tuple(AggregationType(buf[pos + i]) for i in range(n_agg))
+    pos += n_agg
+    metric = Untimed(
+        type=mtype,
+        id=mid,
+        counter_value=counter,
+        batch_timer_values=timers,
+        gauge_value=gauge,
+        annotation=ann,
+    )
+    return (
+        UnaggregatedMessage(
+            metric,
+            t,
+            tuple(policies),
+            aggs,
+            timed=kind == KIND_TIMED,
+        ),
+        pos,
+    )
+
+
+def encode_batch(msgs) -> bytes:
+    """Length-prefixed concatenation (the unaggregated_iterator framing)."""
+    out = BytesIO()
+    for m in msgs:
+        payload = encode_message(m)
+        out.write(_U32.pack(len(payload)))
+        out.write(payload)
+    return out.getvalue()
+
+
+def decode_batch(buf: bytes) -> list[UnaggregatedMessage]:
+    msgs = []
+    pos = 0
+    while pos < len(buf):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        msg, end = decode_message(buf, pos)
+        if end - pos != n:
+            raise ValueError(f"message length mismatch ({end - pos} != {n})")
+        msgs.append(msg)
+        pos += n
+    return msgs

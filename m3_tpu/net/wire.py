@@ -135,9 +135,35 @@ def loads(b: bytes):
 # --- framing over a socket/file-like ---
 
 
+def pack_frame(payload: bytes) -> bytes:
+    return _U32.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental length-prefixed frame parser for streaming receivers
+    (the one framing implementation; request/response paths use
+    send_frame/recv_frame below)."""
+
+    def __init__(self, max_frame: int = MAX_FRAME) -> None:
+        self.max_frame = max_frame
+        self._buf = bytearray()
+
+    def feed(self, chunk: bytes) -> list[bytes]:
+        self._buf.extend(chunk)
+        out = []
+        while len(self._buf) >= 4:
+            (n,) = _U32.unpack_from(self._buf, 0)
+            if n > self.max_frame:
+                raise ValueError(f"frame too large: {n}")
+            if len(self._buf) < 4 + n:
+                break
+            out.append(bytes(self._buf[4 : 4 + n]))
+            del self._buf[: 4 + n]
+        return out
+
+
 def send_frame(sock, v) -> None:
-    payload = dumps(v)
-    sock.sendall(_U32.pack(len(payload)) + payload)
+    sock.sendall(pack_frame(dumps(v)))
 
 
 def _recv_exact(sock, n: int) -> bytes:

@@ -1,0 +1,98 @@
+"""m3aggregator-equivalent service binary.
+
+Reference: /root/reference/src/cmd/services/m3aggregator/main/main.go — the
+aggregator process wires config → rawtcp ingest server → flush manager →
+downstream handler. Run:
+
+    python -m m3_tpu.services.aggregator --port 6000 \
+        --forward 127.0.0.1:9000 --forward-namespace default
+
+Flushed aggregates forward to a dbnode's RPC write_batch (suffixed IDs), or
+count locally when no --forward is given. Prints ``LISTENING <host> <port>``
+once serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+import time
+
+from ..aggregator.aggregator import Aggregator
+from ..aggregator.server import AggregatorIngestServer
+from ..metrics.policy import StoragePolicy
+from ..storage.series import NANOS
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="m3tpu-aggregator", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-shards", type=int, default=16)
+    p.add_argument("--policy", action="append", default=[], help="e.g. 10s:2d")
+    p.add_argument("--flush-interval-secs", type=float, default=1.0)
+    p.add_argument("--forward", default="", help="dbnode host:port for output")
+    p.add_argument("--forward-namespace", default="default")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    forward_node = None
+    if args.forward:
+        from ..net.client import RemoteNode
+
+        host, port = args.forward.rsplit(":", 1)
+        forward_node = RemoteNode(host, int(port))
+
+    flushed_count = [0]
+
+    def handler(metrics):
+        flushed_count[0] += len(metrics)
+        if forward_node is not None:
+            forward_node.write_batch(
+                args.forward_namespace,
+                [(m.suffixed_id, m.time_nanos, m.value) for m in metrics],
+            )
+
+    policies = tuple(StoragePolicy.parse(s) for s in args.policy) or ()
+    agg = Aggregator(
+        num_shards=args.num_shards,
+        default_policies=policies,
+        flush_handler=handler,
+    )
+    server = AggregatorIngestServer(agg, host=args.host, port=args.port)
+
+    stop = threading.Event()
+
+    def flush_loop():
+        while not stop.wait(args.flush_interval_secs):
+            try:
+                agg.flush(time.time_ns())
+            except Exception:
+                pass  # keep the flush loop alive (mediator-style resilience)
+
+    flusher = threading.Thread(target=flush_loop, name="m3tpu-agg-flush", daemon=True)
+    flusher.start()
+
+    def shutdown(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    print(f"LISTENING {server.host} {server.port}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        stop.set()
+        agg.flush(time.time_ns() + 10**12)  # drain on shutdown
+        if forward_node is not None:
+            forward_node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,17 +96,23 @@ class ProcCluster:
             env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
         )
+        import select
+
         deadline = time.time() + 60
         line = ""
-        while time.time() < deadline:
-            line = proc.stdout.readline()
-            if line.startswith("LISTENING"):
-                break
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                proc.kill()
+                raise TimeoutError(f"{node_id} did not start: {line!r}")
+            # select so a child that hangs before printing can't block forever
+            ready, _, _ = select.select([proc.stdout], [], [], min(remaining, 1.0))
+            if ready:
+                line = proc.stdout.readline()
+                if line.startswith("LISTENING"):
+                    break
             if proc.poll() is not None:
                 raise RuntimeError(f"{node_id} died at startup")
-        else:
-            proc.kill()
-            raise TimeoutError(f"{node_id} did not start: {line!r}")
         _, host, port_s = line.split()
         client = RemoteNode(host, int(port_s), node_id=node_id)
         return ProcNode(node_id, proc, client)

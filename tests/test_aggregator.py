@@ -160,8 +160,18 @@ def test_aggregator_end_to_end():
 
 
 def test_follower_does_not_emit():
+    from m3_tpu.aggregator.election import ElectionManager, FlushTimesStore
+    from m3_tpu.cluster.kv import KVStore
+
     t0 = 1_600_000_000 * NANOS
-    agg = Aggregator(num_shards=2)
-    agg.is_leader = False
+    kv = KVStore()
+    # another instance holds the election -> this aggregator is a follower
+    ElectionManager(kv, "ss", "other").elect()
+    agg = Aggregator(
+        num_shards=2,
+        election=ElectionManager(kv, "ss", "me"),
+        flush_times=FlushTimesStore(kv, "ss"),
+    )
+    assert not agg.is_leader
     agg.add_timed(b"m", MetricType.COUNTER, t0, 1.0)
     assert agg.flush(t0 + 60 * NANOS) == []

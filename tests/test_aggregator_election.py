@@ -1,0 +1,121 @@
+"""Leader/follower aggregator semantics (election_mgr.go:43 +
+follower_flush_mgr.go:70): replicated aggregators mirror ingest, exactly one
+emits per window, and a leader death mid-stream hands over without losing or
+double-emitting any window."""
+
+from m3_tpu.aggregator.aggregator import Aggregator
+from m3_tpu.aggregator.election import ElectionManager, FlushTimesStore
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.types import MetricType, Untimed
+
+NANOS = 1_000_000_000
+W = 10 * NANOS  # 10s windows
+T0 = 1_600_000_000 * NANOS // W * W
+POLICY = (StoragePolicy.parse("10s:2d"),)
+
+
+def _pair():
+    kv = KVStore()
+    out_a, out_b = [], []
+    a = Aggregator(
+        num_shards=4,
+        default_policies=POLICY,
+        flush_handler=out_a.extend,
+        election=ElectionManager(kv, "ss0", "agg-a"),
+        flush_times=FlushTimesStore(kv, "ss0"),
+    )
+    b = Aggregator(
+        num_shards=4,
+        default_policies=POLICY,
+        flush_handler=out_b.extend,
+        election=ElectionManager(kv, "ss0", "agg-b"),
+        flush_times=FlushTimesStore(kv, "ss0"),
+    )
+    return kv, a, b, out_a, out_b
+
+
+def _gauge(mid, value):
+    return Untimed(id=mid, type=MetricType.GAUGE, gauge_value=value)
+
+
+def _add_both(a, b, mid, t, v):
+    a.add_untimed(_gauge(mid, v), t)
+    b.add_untimed(_gauge(mid, v), t)
+
+
+def _windows(metrics):
+    return sorted({(m.id, m.time_nanos) for m in metrics})
+
+
+def test_leader_emits_follower_mirrors():
+    kv, a, b, out_a, out_b = _pair()
+    _add_both(a, b, b"cpu", T0 + NANOS, 1.0)
+    _add_both(a, b, b"cpu", T0 + 2 * NANOS, 3.0)
+    a.flush(T0 + W)  # a campaigns first -> leader
+    b.flush(T0 + W)  # b follows: prunes, emits nothing
+    assert a.is_leader and not b.is_leader
+    assert len(out_a) > 0 and out_b == []
+    # follower buffers for the flushed window were pruned
+    assert all(not buf.ids for sh in b.shards for buf in sh.buffers.values())
+
+
+def test_leader_death_follower_takeover_exactly_once():
+    kv, a, b, out_a, out_b = _pair()
+    # window 1 flushed by the leader
+    _add_both(a, b, b"cpu", T0 + NANOS, 1.0)
+    a.flush(T0 + W)
+    b.flush(T0 + W)
+    # window 2 ingested on both, then the leader dies mid-window
+    _add_both(a, b, b"cpu", T0 + W + NANOS, 5.0)
+    a.election.election.expire()  # leader session expiry (process death)
+    # follower campaigns at its next flush pass and takes over
+    out = b.flush(T0 + 2 * W)
+    assert b.is_leader
+    assert out, "new leader must flush the window the old leader never did"
+    both = out_a + out_b
+    windows = [w for _, w in _windows(both)]
+    assert windows == sorted(set(windows)), f"double-emitted windows: {windows}"
+    assert {w for _, w in _windows(both)} == {T0 + W, T0 + 2 * W}
+
+
+def test_takeover_does_not_reemit_windows_follower_never_pruned():
+    """Leader flushes w1 and dies BEFORE the follower runs any follower
+    flush: the follower still has w1 buffered, but the shared flush times
+    say w1 was emitted — takeover must emit only w2."""
+    kv, a, b, out_a, out_b = _pair()
+    _add_both(a, b, b"cpu", T0 + NANOS, 1.0)
+    a.flush(T0 + W)  # leader emits w1; follower never flushes
+    _add_both(a, b, b"cpu", T0 + W + NANOS, 5.0)
+    a.election.election.expire()
+    b.flush(T0 + 2 * W)
+    both = out_a + out_b
+    per_window = {}
+    for m in both:
+        per_window.setdefault(m.time_nanos, []).append(m)
+    assert set(per_window) == {T0 + W, T0 + 2 * W}
+    counts = {w: len({m.suffixed_id for m in ms}) for w, ms in per_window.items()}
+    # each window emitted once per (id, agg type)
+    for w, ms in per_window.items():
+        assert len(ms) == counts[w], f"window {w} double-emitted: {ms}"
+
+
+def test_dead_leader_never_loses_unflushed_window():
+    """Leader dies before flushing anything: the follower flushes ALL
+    windows on takeover."""
+    kv, a, b, out_a, out_b = _pair()
+    _add_both(a, b, b"cpu", T0 + NANOS, 1.0)
+    a.flush(T0)  # leader campaigns but nothing flushable yet
+    a.election.election.expire()
+    b.flush(T0 + W)
+    assert out_a == []
+    assert {w for _, w in _windows(out_b)} == {T0 + W}
+
+
+def test_standalone_aggregator_still_always_leader():
+    out = []
+    agg = Aggregator(num_shards=2, default_policies=POLICY, flush_handler=out.extend)
+    assert agg.is_leader
+    agg.add_untimed(_gauge(b"cpu", 2.0), T0 + NANOS)
+    agg.flush(T0 + W)
+    assert out
