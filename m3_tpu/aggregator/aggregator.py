@@ -124,6 +124,10 @@ class Aggregator:
         # late datapoints a replicated leader dropped because their window
         # was already flushed (observability for the replication caveat)
         self.dropped_late = 0
+        # aggregates drained but not yet delivered (flush_handler raised);
+        # retried at the next flush so a transient downstream outage doesn't
+        # lose windows in standalone mode
+        self._pending_emit: list[AggregatedMetric] = []
         # ingest servers call add_* from handler threads while a flush loop
         # drains; one lock guards the column buffers (entry.go lock role)
         self._lock = threading.Lock()
@@ -197,9 +201,16 @@ class Aggregator:
         # delivery BEFORE recording progress: if the handler raises (or the
         # process dies here), the shared flush times don't advance, so
         # followers keep their mirror of these windows and a takeover
-        # re-emits them instead of losing them
-        if self.flush_handler and out:
-            self.flush_handler(out)
+        # re-emits them instead of losing them. Standalone (no followers),
+        # undelivered aggregates stay in _pending_emit and retry next flush.
+        if self.flush_handler and (out or self._pending_emit):
+            to_send = self._pending_emit + out
+            try:
+                self.flush_handler(to_send)
+                self._pending_emit = []
+            except Exception:
+                self._pending_emit = to_send
+                raise
         if leader and self.flush_times is not None and flushed_boundaries:
             self.flush_times.update(flushed_boundaries)
         return out

@@ -106,11 +106,13 @@ class AggregatorClient:
     def __init__(self, endpoints: list[tuple[str, int]], num_shards: int = 16) -> None:
         self.endpoints = endpoints
         self.num_shards = num_shards
-        self._socks: dict[int, socket.socket] = {}
-        self._lock = threading.Lock()
+        self._socks: list[socket.socket | None] = [None] * len(endpoints)
+        # per-endpoint locks: a down instance (blocking in connect) must not
+        # stall sends routed to healthy instances
+        self._locks = [threading.Lock() for _ in endpoints]
 
     def _sock(self, idx: int) -> socket.socket:
-        sock = self._socks.get(idx)
+        sock = self._socks[idx]
         if sock is None:
             host, port = self.endpoints[idx]
             sock = socket.create_connection((host, port), timeout=10)
@@ -124,16 +126,17 @@ class AggregatorClient:
     def send(self, msg: UnaggregatedMessage) -> None:
         frame = pack_frame(encode_message(msg))
         idx = self._instance_for(msg.metric.id)
-        with self._lock:
+        with self._locks[idx]:
             try:
                 self._sock(idx).sendall(frame)
             except OSError:
                 # one reconnect attempt (stale connection)
-                self._socks.pop(idx, None)
+                self._socks[idx] = None
                 self._sock(idx).sendall(frame)
 
     def close(self) -> None:
-        with self._lock:
-            for sock in self._socks.values():
-                sock.close()
-            self._socks.clear()
+        for idx, lock in enumerate(self._locks):
+            with lock:
+                if self._socks[idx] is not None:
+                    self._socks[idx].close()
+                    self._socks[idx] = None

@@ -10,7 +10,8 @@ ops the device can fuse.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import re as _re
+from dataclasses import dataclass, replace
 from typing import Protocol
 
 import numpy as np
@@ -29,6 +30,7 @@ from .promql import (
     NumberLiteral,
     RangeSelector,
     StringLiteral,
+    Subquery,
     Unary,
     VectorSelector,
     parse,
@@ -117,6 +119,15 @@ class Engine:
                 np.full((1, bounds.steps), e.value), [SeriesMeta(())], scalar=True
             )
         if isinstance(e, VectorSelector):
+            if e.at_nanos is not None:
+                # @ pins evaluation: one instant, broadcast across steps
+                at = _resolve_at(e.at_nanos, bounds)
+                r = self._fetch(
+                    replace(e, at_nanos=None), Bounds(at, bounds.step_nanos, 1)
+                )
+                return Result(
+                    np.tile(np.asarray(r.values), (1, bounds.steps)), r.metas
+                )
             return self._fetch(e, bounds)
         if isinstance(e, Unary):
             r = self._eval(e.expr, bounds)
@@ -157,36 +168,93 @@ class Engine:
         ),
     }
 
-    def _range_arg(self, arg: Expr, bounds: Bounds) -> tuple[np.ndarray, list, int]:
-        if not isinstance(arg, RangeSelector):
-            raise ValueError("promql: function requires a range vector")
-        window = int(arg.range_nanos // bounds.step_nanos) + 1
-        extra = window - 1
-        r = self._fetch(arg.vector, bounds, extra_steps=extra)
-        return np.asarray(r.values), r.metas, window
+    def _range_arg(self, arg: Expr, bounds: Bounds):
+        """Range-vector argument → (values, metas, window, step_secs, post).
+
+        ``values`` is a [S, N] sample matrix whose trailing axis a temporal
+        function slides its ``window`` over; ``post`` maps the function's
+        [S, N - window + 1] output onto the query's [S, steps] grid (identity
+        for plain ranges; column re-selection for subqueries, whose samples
+        are at the subquery step; broadcast for @-pinned ranges).
+        """
+        if isinstance(arg, RangeSelector):
+            sel = arg.vector
+            window = int(arg.range_nanos // bounds.step_nanos) + 1
+            extra = window - 1
+            step_s = bounds.step_nanos / NANOS
+            if sel.at_nanos is not None:
+                at = _resolve_at(sel.at_nanos, bounds)
+                b_at = Bounds(
+                    at - extra * bounds.step_nanos, bounds.step_nanos, window
+                )
+                r = self._fetch(replace(sel, at_nanos=None), b_at)
+
+                def post(out, _steps=bounds.steps):
+                    return np.tile(out[:, -1:], (1, _steps))
+
+                return np.asarray(r.values), r.metas, window, step_s, post
+            r = self._fetch(sel, bounds, extra_steps=extra)
+            return np.asarray(r.values), r.metas, window, step_s, lambda out: out
+        if isinstance(arg, Subquery):
+            return self._subquery_arg(arg, bounds)
+        raise ValueError("promql: function requires a range vector")
+
+    def _subquery_arg(self, sq: Subquery, bounds: Bounds):
+        sub_step = sq.step_nanos or bounds.step_nanos
+        if sq.at_nanos is not None:
+            at = _resolve_at(sq.at_nanos, bounds)
+            outer_ts = np.asarray([at - sq.offset_nanos], np.int64)
+        else:
+            outer_ts = bounds.timestamps() - sq.offset_nanos
+        window = int(sq.range_nanos // sub_step) + 1
+        g_start = int(outer_ts.min()) - sq.range_nanos
+        n_sub = int((int(outer_ts.max()) - g_start) // sub_step) + 1
+        sub_bounds = Bounds(g_start, sub_step, n_sub)
+        inner = self._eval(sq.expr, sub_bounds)
+        vals = np.asarray(inner.values)
+        grid = sub_bounds.timestamps()
+        # output column j of a sliced temporal result ends at grid[j + w - 1];
+        # each outer step wants the window ending at the last grid point <= t
+        idx = np.searchsorted(grid, outer_ts, side="right") - 1
+        cols = np.clip(idx - (window - 1), 0, max(n_sub - window, 0))
+
+        if sq.at_nanos is not None:
+
+            def post(out, _steps=bounds.steps, _cols=cols):
+                return np.tile(out[:, _cols[:1]], (1, _steps))
+
+        else:
+
+            def post(out, _cols=cols):
+                return out[:, _cols]
+
+        return vals, inner.metas, window, sub_step / NANOS, post
 
     def _call(self, e: Call, bounds: Bounds) -> Result:
         name = e.func
-        step_s = bounds.step_nanos / NANOS
         if name in self._TEMPORAL:
-            vals, metas, w = self._range_arg(e.args[0], bounds)
+            vals, metas, w, step_s, post = self._range_arg(e.args[0], bounds)
             out = np.asarray(self._TEMPORAL[name](vals, w, step_s))
-            return Result(out[:, w - 1 :], metas)
+            return Result(post(out[:, w - 1 :]), metas)
         if name == "quantile_over_time":
             q = _number(e.args[0])
-            vals, metas, w = self._range_arg(e.args[1], bounds)
+            vals, metas, w, step_s, post = self._range_arg(e.args[1], bounds)
             out = np.asarray(T.quantile_over_time(vals, w, q))
-            return Result(out[:, w - 1 :], metas)
+            return Result(post(out[:, w - 1 :]), metas)
         if name == "predict_linear":
-            vals, metas, w = self._range_arg(e.args[0], bounds)
+            vals, metas, w, step_s, post = self._range_arg(e.args[0], bounds)
             t = _number(e.args[1])
             out = np.asarray(T.predict_linear(vals, w, step_s, t))
-            return Result(out[:, w - 1 :], metas)
+            return Result(post(out[:, w - 1 :]), metas)
         if name == "holt_winters":
-            vals, metas, w = self._range_arg(e.args[0], bounds)
+            vals, metas, w, step_s, post = self._range_arg(e.args[0], bounds)
             sf, tf = _number(e.args[1]), _number(e.args[2])
             out = np.asarray(T.holt_winters(vals, w, sf, tf))
-            return Result(out[:, w - 1 :], metas)
+            return Result(post(out[:, w - 1 :]), metas)
+        if name == "label_replace":
+            return self._label_replace(e, bounds)
+        if name == "label_join":
+            return self._label_join(e, bounds)
         if name in L.MATH_FNS:
             r = self._eval(e.args[0], bounds)
             return Result(np.asarray(L.MATH_FNS[name](r.values)), r.metas, r.scalar)
@@ -243,6 +311,47 @@ class Engine:
                 metas = [SeriesMeta(())]
             return Result(L.datetime_fn(name, vals), metas)
         raise ValueError(f"promql: unsupported function {name}")
+
+    # --- label manipulation (functions/label_replace, label_join —
+    # src/query/functions/tag/ in the reference) ---
+
+    def _label_replace(self, e: Call, bounds: Bounds) -> Result:
+        r = self._eval(e.args[0], bounds)
+        dst, repl, src, regex_s = (_string(a) for a in e.args[1:5])
+        pattern = _re.compile(regex_s)
+        metas = []
+        for m in r.metas:
+            tags = dict(m.tags)
+            val = tags.get(src.encode(), b"").decode()
+            mm = pattern.fullmatch(val)
+            if mm is not None:
+                new = mm.expand(_promql_template(repl))
+                if new:
+                    tags[dst.encode()] = new.encode()
+                else:
+                    tags.pop(dst.encode(), None)
+            metas.append(
+                SeriesMeta(tags=tuple(sorted(tags.items())), name=m.name)
+            )
+        return Result(r.values, metas, r.scalar)
+
+    def _label_join(self, e: Call, bounds: Bounds) -> Result:
+        r = self._eval(e.args[0], bounds)
+        dst = _string(e.args[1])
+        sep = _string(e.args[2])
+        srcs = [_string(a).encode() for a in e.args[3:]]
+        metas = []
+        for m in r.metas:
+            tags = dict(m.tags)
+            joined = sep.encode().join(tags.get(sl, b"") for sl in srcs)
+            if joined:
+                tags[dst.encode()] = joined
+            else:
+                tags.pop(dst.encode(), None)
+            metas.append(
+                SeriesMeta(tags=tuple(sorted(tags.items())), name=m.name)
+            )
+        return Result(r.values, metas, r.scalar)
 
     def _aggregate(self, e: Aggregation, bounds: Bounds) -> Result:
         r = self._eval(e.expr, bounds)
@@ -301,10 +410,57 @@ class Engine:
 
         # vector op vector
         m = B.VectorMatching(on=e.on, matching_labels=tuple(x.encode() for x in e.matching_labels))
+        if e.group_left or e.group_right:
+            return self._binary_grouped(e, m, lhs, rhs, lv, rv, is_comp)
         tl, tr, metas = B.intersect(m, lhs.metas, rhs.metas)
         if is_comp:
             out = np.asarray(B.comparison(e.op, lv, rv, tl, tr, e.return_bool))
             metas = [lhs.metas[i] for i in tl] if not e.return_bool else metas
+            return Result(out, metas)
+        out = np.asarray(B.arithmetic(e.op, lv, rv, tl, tr))
+        return Result(out, metas)
+
+    def _binary_grouped(self, e: BinaryOp, m, lhs, rhs, lv, rv, is_comp) -> Result:
+        """Many-to-one vector matching (binary.go group_left/group_right):
+        each series on the MANY side joins at most one series on the ONE
+        side; result keeps the many side's labels, plus any carried labels
+        named in group_left(...)/group_right(...)."""
+        many, one = (lhs, rhs) if e.group_left else (rhs, lhs)
+        one_index: dict = {}
+        for j, om in enumerate(one.metas):
+            key = B._match_key(om.tags, m)
+            if key in one_index:
+                raise ValueError(
+                    "promql: many-to-many matching: multiple series on the "
+                    f"'one' side share match key {key!r}"
+                )
+            one_index[key] = j
+        take_many, take_one, metas = [], [], []
+        include = [x.encode() for x in e.include_labels]
+        for i, mm in enumerate(many.metas):
+            j = one_index.get(B._match_key(mm.tags, m))
+            if j is None:
+                continue
+            take_many.append(i)
+            take_one.append(j)
+            tags = dict(mm.tags)
+            if not is_comp:
+                # arithmetic drops the metric name, as in the 1:1 path
+                tags.pop(b"__name__", None)
+            if include:
+                one_tags = dict(one.metas[j].tags)
+                for lbl in include:
+                    if lbl in one_tags:
+                        tags[lbl] = one_tags[lbl]
+                    else:
+                        tags.pop(lbl, None)
+            metas.append(SeriesMeta(tags=tuple(sorted(tags.items())), name=mm.name))
+        tm = np.asarray(take_many, np.int32)
+        to = np.asarray(take_one, np.int32)
+        # orient back to lhs/rhs for the (non-commutative) operator
+        tl, tr = (tm, to) if e.group_left else (to, tm)
+        if is_comp:
+            out = np.asarray(B.comparison(e.op, lv, rv, tl, tr, e.return_bool))
             return Result(out, metas)
         out = np.asarray(B.arithmetic(e.op, lv, rv, tl, tr))
         return Result(out, metas)
@@ -331,3 +487,24 @@ def _number(e: Expr | None) -> float:
     if isinstance(e, Unary) and isinstance(e.expr, NumberLiteral):
         return -e.expr.value if e.op == "-" else e.expr.value
     raise ValueError("promql: expected a number literal")
+
+
+def _string(e: Expr) -> str:
+    if isinstance(e, StringLiteral):
+        return e.value
+    raise ValueError("promql: expected a string literal")
+
+
+def _resolve_at(at, bounds: Bounds) -> int:
+    """@ modifier value → absolute nanos (start()/end() use the bounds)."""
+    if at == "start":
+        return bounds.start_nanos
+    if at == "end":
+        return bounds.start_nanos + bounds.step_nanos * (bounds.steps - 1)
+    return int(at)
+
+
+def _promql_template(repl: str) -> str:
+    """label_replace templates use $1/${name}; re.Match.expand wants \\1."""
+    out = _re.sub(r"\$\{(\w+)\}", r"\\g<\1>", repl)
+    return _re.sub(r"\$(\d+)", r"\\\1", out)

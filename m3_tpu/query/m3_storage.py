@@ -58,17 +58,67 @@ class M3Storage:
 
 
 @dataclass
-class FanoutStorage:
-    """Merge series from multiple storages (fanout/storage.go): exact-id
-    duplicates resolved by preferring the higher-resolution (first) source."""
+class ClusterNamespace:
+    """One queryable namespace + its retention/resolution attributes
+    (storage/m3/types.go ClusterNamespace + Attributes)."""
 
-    storages: list
+    storage: object  # Engine Storage (e.g. M3Storage)
+    retention_nanos: int
+    resolution_nanos: int = 0  # 0 = raw samples
+    aggregated: bool = False  # False = the unaggregated namespace
+
+
+def resolve_cluster_namespaces(
+    namespaces: list[ClusterNamespace], now_nanos: int, start_nanos: int
+) -> list[ClusterNamespace]:
+    """storage/m3/cluster_resolver.go resolveClusterNamespacesForQuery:
+
+    1. the unaggregated namespace wins if its retention covers the query
+       start;
+    2. otherwise the FINEST-resolution aggregated namespace that covers it;
+    3. otherwise nothing covers — fall back to the longest-retention
+       namespace (partial data beats none).
+    """
+    if not namespaces:
+        return []
+    covers = lambda ns: now_nanos - ns.retention_nanos <= start_nanos
+    unagg = [ns for ns in namespaces if not ns.aggregated]
+    if unagg and covers(unagg[0]):
+        return [unagg[0]]
+    covering = sorted(
+        (ns for ns in namespaces if ns.aggregated and covers(ns)),
+        key=lambda ns: ns.resolution_nanos,
+    )
+    if covering:
+        return [covering[0]]
+    return [max(namespaces, key=lambda ns: ns.retention_nanos)]
+
+
+@dataclass
+class FanoutStorage:
+    """Retention/resolution-aware fanout (fanout/storage.go:48 +
+    cluster_resolver): pick the namespace(s) whose attributes fit the query
+    range, fetch, and dedupe exact-id overlaps preferring the
+    finer-resolution source."""
+
+    namespaces: list  # list[ClusterNamespace]
+    clock: object = None  # () -> nanos; injectable for tests
+
+    def _now(self) -> int:
+        if self.clock is not None:
+            return self.clock()
+        import time
+
+        return time.time_ns()
+
+    def resolve(self, start_nanos: int) -> list[ClusterNamespace]:
+        return resolve_cluster_namespaces(self.namespaces, self._now(), start_nanos)
 
     def fetch(self, matchers, start_nanos, end_nanos):
         seen: dict = {}
         order = []
-        for st in self.storages:
-            for tags, times, vals in st.fetch(matchers, start_nanos, end_nanos):
+        for ns in self.resolve(start_nanos):
+            for tags, times, vals in ns.storage.fetch(matchers, start_nanos, end_nanos):
                 if tags in seen:
                     continue
                 seen[tags] = (tags, times, vals)

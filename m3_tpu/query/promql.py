@@ -45,6 +45,9 @@ class VectorSelector(Expr):
     name: str | None
     matchers: list[Matcher] = field(default_factory=list)
     offset_nanos: int = 0
+    # @ modifier: absolute nanos, or "start"/"end" (resolved against the
+    # query bounds at eval time)
+    at_nanos: int | str | None = None
 
 
 @dataclass
@@ -57,6 +60,18 @@ class RangeSelector(Expr):
 class Call(Expr):
     func: str
     args: list[Expr]
+
+
+@dataclass
+class Subquery(Expr):
+    """expr[range:step] — inner expr evaluated at step resolution, exposed
+    to its enclosing function as a range vector (prometheus subqueries)."""
+
+    expr: Expr
+    range_nanos: int
+    step_nanos: int = 0  # 0 = default (the outer query step)
+    offset_nanos: int = 0
+    at_nanos: int | str | None = None
 
 
 @dataclass
@@ -79,6 +94,8 @@ class BinaryOp(Expr):
     matching_labels: list[str] = field(default_factory=list)
     group_left: bool = False
     group_right: bool = False
+    # carried labels of group_left(...)/group_right(...)
+    include_labels: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -110,6 +127,7 @@ FUNCTIONS = {
     "abs", "ceil", "floor", "exp", "sqrt", "ln", "log2", "log10", "round",
     "clamp_min", "clamp_max", "clamp",
     "histogram_quantile", "sort", "sort_desc", "absent", "scalar", "vector",
+    "label_replace", "label_join",
     "time", "timestamp",
     "day_of_month", "day_of_week", "days_in_month", "hour", "minute", "month",
     "year",
@@ -121,8 +139,9 @@ _TOKEN_RE = re.compile(
   | (?P<duration>\d+(?:\.\d+)?(?:ns|us|ms|s|m|h|d|w|y)(?:\d+(?:\.\d+)?(?:ns|us|ms|s|m|h|d|w|y))*)
   | (?P<number>\d+\.\d+|\d+|\.\d+)
   | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
-  | (?P<ident>[a-zA-Z_:][a-zA-Z0-9_:.]*)
-  | (?P<op>=~|!~|==|!=|<=|>=|<|>|=|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,)
+  | (?P<ident>[a-zA-Z_][a-zA-Z0-9_:.]*)
+  | (?P<colonident>:[a-zA-Z_:][a-zA-Z0-9_:.]*)
+  | (?P<op>=~|!~|==|!=|<=|>=|<|>|=|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|:|@)
     """,
     re.VERBOSE,
 )
@@ -147,6 +166,10 @@ def lex(s: str) -> list[Token]:
         kind = m.lastgroup
         if kind == "space":
             continue
+        if kind == "colonident":
+            # leading-colon recording-rule names are idents; a bare ':'
+            # (subquery step separator) stays an operator
+            kind = "ident"
         text = m.group()
         if kind == "ident" and text in _KEYWORDS:
             kind = text
@@ -223,7 +246,7 @@ class Parser:
                     node.group_left = which == "group_left"
                     node.group_right = which == "group_right"
                     if self.cur.text == "(":
-                        self._label_list()  # carried labels (accepted, 1:1 only)
+                        node.include_labels = self._label_list()
             # ^ is right-associative
             next_min = prec if op == "^" else prec + 1
             node.rhs = self.parse_expr(next_min)
@@ -242,20 +265,53 @@ class Parser:
             if t.kind == "op" and t.text == "[":
                 self.eat(text="[")
                 dur = self.eat("duration").text
-                self.eat(text="]")
-                if not isinstance(e, VectorSelector):
-                    raise ValueError("promql: range on non-selector")
-                e = RangeSelector(e, _duration_nanos(dur))
+                if self.cur.text == ":":
+                    # subquery: expr[range:step?]
+                    self.eat(text=":")
+                    step = 0
+                    if self.cur.kind == "duration":
+                        step = _duration_nanos(self.eat("duration").text)
+                    self.eat(text="]")
+                    e = Subquery(e, _duration_nanos(dur), step)
+                else:
+                    self.eat(text="]")
+                    if not isinstance(e, VectorSelector):
+                        raise ValueError("promql: range on non-selector")
+                    e = RangeSelector(e, _duration_nanos(dur))
             elif t.kind == "offset":
                 self.eat("offset")
+                neg = False
+                if self.cur.text == "-":
+                    self.eat(text="-")
+                    neg = True
                 dur = self.eat("duration").text
-                off = _duration_nanos(dur)
+                off = _duration_nanos(dur) * (-1 if neg else 1)
                 if isinstance(e, VectorSelector):
                     e.offset_nanos = off
                 elif isinstance(e, RangeSelector):
                     e.vector.offset_nanos = off
+                elif isinstance(e, Subquery):
+                    e.offset_nanos = off
                 else:
                     raise ValueError("promql: offset on non-selector")
+            elif t.kind == "op" and t.text == "@":
+                self.eat(text="@")
+                if self.cur.kind == "number":
+                    at = int(float(self.eat().text) * 1e9)
+                elif self.cur.kind == "ident" and self.cur.text in ("start", "end"):
+                    at = self.eat("ident").text
+                    self.eat(text="(")
+                    self.eat(text=")")
+                else:
+                    raise ValueError("promql: bad @ modifier")
+                if isinstance(e, VectorSelector):
+                    e.at_nanos = at
+                elif isinstance(e, RangeSelector):
+                    e.vector.at_nanos = at
+                elif isinstance(e, Subquery):
+                    e.at_nanos = at
+                else:
+                    raise ValueError("promql: @ on non-selector")
             else:
                 return e
 

@@ -66,13 +66,17 @@ def main(argv=None) -> int:
     server = AggregatorIngestServer(agg, host=args.host, port=args.port)
 
     stop = threading.Event()
+    flush_errors = [0]
 
     def flush_loop():
         while not stop.wait(args.flush_interval_secs):
             try:
                 agg.flush(time.time_ns())
-            except Exception:
-                pass  # keep the flush loop alive (mediator-style resilience)
+            except Exception as exc:
+                # keep the loop alive (mediator-style resilience); drained
+                # aggregates stay in agg._pending_emit and retry next pass
+                flush_errors[0] += 1
+                print(f"flush error ({flush_errors[0]}): {exc}", file=sys.stderr)
 
     flusher = threading.Thread(target=flush_loop, name="m3tpu-agg-flush", daemon=True)
     flusher.start()

@@ -96,8 +96,21 @@ class ProcCluster:
             env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
         )
-        import select
+        # a reader thread owns the (buffered) pipe; the main thread waits on
+        # a queue with a deadline, so a child hanging before LISTENING (or a
+        # line already sitting in the TextIOWrapper buffer, which select(2)
+        # on the raw fd cannot see) can neither block nor be missed
+        import queue as _queue
+        import threading
 
+        lines: _queue.Queue = _queue.Queue()
+
+        def _pump():
+            for ln in proc.stdout:
+                lines.put(ln)
+            lines.put(None)
+
+        threading.Thread(target=_pump, daemon=True).start()
         deadline = time.time() + 60
         line = ""
         while True:
@@ -105,14 +118,17 @@ class ProcCluster:
             if remaining <= 0:
                 proc.kill()
                 raise TimeoutError(f"{node_id} did not start: {line!r}")
-            # select so a child that hangs before printing can't block forever
-            ready, _, _ = select.select([proc.stdout], [], [], min(remaining, 1.0))
-            if ready:
-                line = proc.stdout.readline()
-                if line.startswith("LISTENING"):
-                    break
-            if proc.poll() is not None:
+            try:
+                item = lines.get(timeout=min(remaining, 1.0))
+            except _queue.Empty:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"{node_id} died at startup")
+                continue
+            if item is None:
                 raise RuntimeError(f"{node_id} died at startup")
+            line = item
+            if line.startswith("LISTENING"):
+                break
         _, host, port_s = line.split()
         client = RemoteNode(host, int(port_s), node_id=node_id)
         return ProcNode(node_id, proc, client)

@@ -1,0 +1,251 @@
+"""PromQL completeness: subqueries, @ modifier, label_replace/label_join,
+group_left/group_right enrichment, and retention/resolution-aware fanout
+namespace resolution (VERDICT r2 item 7; reference: prometheus subquery
+semantics, src/query/functions/tag/, storage/m3/cluster_resolver.go)."""
+
+import math
+import tempfile
+
+import numpy as np
+import pytest
+
+from m3_tpu.block.core import make_tags
+from m3_tpu.query.engine import Engine
+from m3_tpu.query.m3_storage import (
+    ClusterNamespace,
+    FanoutStorage,
+    M3Storage,
+    resolve_cluster_namespaces,
+)
+from m3_tpu.query.promql import Subquery, VectorSelector, parse
+from m3_tpu.storage.database import Database, NamespaceOptions
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+HOUR = 3600 * NANOS
+STEP = 10 * NANOS
+
+
+# --- parser ---
+
+
+def test_parse_subquery():
+    e = parse("rate(req[1m])[30m:5m]")
+    assert isinstance(e, Subquery)
+    assert e.range_nanos == 30 * 60 * NANOS
+    assert e.step_nanos == 5 * 60 * NANOS
+    e = parse("max_over_time(rate(req[1m])[30m:])")
+    sq = e.args[0]
+    assert isinstance(sq, Subquery) and sq.step_nanos == 0
+
+
+def test_parse_at_modifier():
+    e = parse("req @ 1600000000")
+    assert isinstance(e, VectorSelector) and e.at_nanos == 1600000000 * NANOS
+    e = parse("rate(req[5m] @ start())")
+    assert e.args[0].vector.at_nanos == "start"
+    e = parse("req @ end() offset 1m")
+    assert e.at_nanos == "end" and e.offset_nanos == 60 * NANOS
+
+
+def test_parse_recording_rule_name_with_colon():
+    e = parse("job:req:rate5m")
+    assert isinstance(e, VectorSelector) and e.name == "job:req:rate5m"
+
+
+def test_parse_group_left_carried_labels():
+    e = parse("a * on (job) group_left (env, dc) b")
+    assert e.group_left and e.include_labels == ["env", "dc"]
+
+
+# --- engine fixtures ---
+
+
+@pytest.fixture(scope="module")
+def engine():
+    tmp = tempfile.mkdtemp()
+    db = Database(tmp, num_shards=2, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions(block_size_nanos=2 * HOUR))
+    for job, host, slope in [("api", "a", 10.0), ("api", "b", 20.0)]:
+        tags = make_tags({"__name__": "req", "job": job, "host": host})
+        for i in range(120):
+            db.write_tagged("default", tags, T0 + i * STEP, slope * i)
+    # one "info" series per job for group_left enrichment
+    for job, env in [("api", "prod")]:
+        tags = make_tags({"__name__": "job_info", "job": job, "env": env})
+        for i in range(120):
+            db.write_tagged("default", tags, T0 + i * STEP, 1.0)
+    return Engine(M3Storage(db, "default"))
+
+
+def run(engine, q, start=None, end=None, step=STEP):
+    start = T0 + 60 * STEP if start is None else start
+    end = T0 + 80 * STEP if end is None else end
+    return engine.query_range(q, start, end, step)
+
+
+# --- @ modifier ---
+
+
+def test_at_modifier_pins_instant(engine):
+    at_secs = (T0 + 70 * STEP) // NANOS
+    r = run(engine, f'req{{job="api", host="a"}} @ {at_secs}')
+    vals = np.asarray(r.values)
+    # every step shows the value at the pinned instant: 10 * 70
+    assert np.allclose(vals, 700.0)
+
+
+def test_at_start_end(engine):
+    r = run(engine, 'req{host="a"} @ start()')
+    assert np.allclose(np.asarray(r.values), 600.0)  # 10 * 60
+    r = run(engine, 'req{host="a"} @ end()')
+    assert np.allclose(np.asarray(r.values), 800.0)  # 10 * 80
+
+
+def test_at_range_function(engine):
+    # rate over a window pinned at end(): constant across all steps
+    r = run(engine, 'rate(req{host="a"}[5m] @ end())')
+    vals = np.asarray(r.values)
+    assert np.allclose(vals, 1.0)  # slope 10 per 10s step
+    assert vals.shape[1] == 21
+
+
+# --- subqueries ---
+
+
+def test_subquery_max_over_time(engine):
+    # rate is constant 1.0 for host=a; max over the subquery window is 1.0
+    r = run(engine, 'max_over_time(rate(req{host="a"}[1m])[5m:1m])')
+    assert np.allclose(np.asarray(r.values), 1.0)
+
+
+def test_subquery_default_step(engine):
+    r = run(engine, 'avg_over_time(req{host="a"}[2m:])')
+    vals = np.asarray(r.values)
+    # avg of a linear series over a trailing 2m window at each step ~
+    # value at (t - 1m) midpoint; check center step value loosely
+    assert vals.shape == (1, 21)
+    mid = 10 * (70 - 6)  # value 1m (6 steps) back from step 70
+    assert abs(vals[0, 10] - mid) <= 10.0
+
+
+def test_subquery_of_subquery_like_nesting(engine):
+    # subquery over a plain selector: last_over_time picks the newest sample
+    r = run(engine, 'last_over_time(req{host="a"}[3m:1m])')
+    vals = np.asarray(r.values)
+    # inner samples lie on the 1m subquery grid, so the newest one at outer
+    # step i is the value at the last 1m boundary <= i (plateaus of 6 steps)
+    expect = np.asarray([10.0 * (i - i % 6) for i in range(60, 81)])
+    assert np.allclose(vals[0], expect)
+
+
+# --- label manipulation ---
+
+
+def test_label_replace(engine):
+    r = run(engine, 'label_replace(req{host="a"}, "shard", "$1", "job", "(ap)i")')
+    tags = [dict(m.tags) for m in r.metas]
+    assert all(t.get(b"shard") == b"ap" for t in tags)
+    # non-matching regex leaves series untouched
+    r = run(engine, 'label_replace(req{host="a"}, "shard", "$1", "job", "(zz)x")')
+    assert all(b"shard" not in dict(m.tags) for m in r.metas)
+
+
+def test_label_join(engine):
+    r = run(engine, 'label_join(req{host="a"}, "jh", "-", "job", "host")')
+    assert all(dict(m.tags)[b"jh"] == b"api-a" for m in r.metas)
+
+
+# --- group_left enrichment ---
+
+
+def test_group_left_carries_labels(engine):
+    r = run(engine, 'req * on (job) group_left (env) job_info')
+    assert len(r.metas) == 2  # both req hosts match the one job_info
+    for m in r.metas:
+        tags = dict(m.tags)
+        assert tags[b"env"] == b"prod"
+        assert b"host" in tags  # many-side labels preserved
+    by_host = {dict(m.tags)[b"host"]: i for i, m in enumerate(r.metas)}
+    vals = np.asarray(r.values)
+    assert np.allclose(vals[by_host[b"a"], 0], 600.0)
+    assert np.allclose(vals[by_host[b"b"], 0], 1200.0)
+
+
+def test_group_right_mirrors(engine):
+    r = run(engine, 'job_info * on (job) group_right () req')
+    assert len(r.metas) == 2
+    assert all(b"host" in dict(m.tags) for m in r.metas)
+
+
+def test_many_to_many_rejected(engine):
+    with pytest.raises(ValueError):
+        run(engine, 'req * on (job) group_left () req')
+
+
+# --- fanout resolution ---
+
+
+class _FakeStorage:
+    def __init__(self, label):
+        self.label = label
+        self.calls = 0
+
+    def fetch(self, matchers, start, end):
+        self.calls += 1
+        return [(((b"src", self.label),), np.asarray([start]), np.asarray([1.0]))]
+
+
+def _namespaces():
+    unagg = ClusterNamespace(_FakeStorage(b"unagg"), retention_nanos=48 * HOUR)
+    agg_fine = ClusterNamespace(
+        _FakeStorage(b"agg5m"),
+        retention_nanos=120 * 24 * HOUR,
+        resolution_nanos=5 * 60 * NANOS,
+        aggregated=True,
+    )
+    agg_coarse = ClusterNamespace(
+        _FakeStorage(b"agg1h"),
+        retention_nanos=2 * 365 * 24 * HOUR,
+        resolution_nanos=HOUR,
+        aggregated=True,
+    )
+    return unagg, agg_fine, agg_coarse
+
+
+def test_resolver_prefers_unaggregated_when_covering():
+    unagg, agg_fine, agg_coarse = _namespaces()
+    now = T0
+    got = resolve_cluster_namespaces([unagg, agg_fine, agg_coarse], now, now - HOUR)
+    assert got == [unagg]
+
+
+def test_resolver_picks_finest_covering_aggregated():
+    unagg, agg_fine, agg_coarse = _namespaces()
+    now = T0
+    # 30 days back: beyond unagg's 48h, within both aggregated retentions
+    got = resolve_cluster_namespaces(
+        [unagg, agg_fine, agg_coarse], now, now - 30 * 24 * HOUR
+    )
+    assert got == [agg_fine]
+    # 1 year back: only the coarse namespace covers
+    got = resolve_cluster_namespaces(
+        [unagg, agg_fine, agg_coarse], now, now - 365 * 24 * HOUR
+    )
+    assert got == [agg_coarse]
+
+
+def test_resolver_falls_back_to_longest_retention():
+    unagg, agg_fine, agg_coarse = _namespaces()
+    got = resolve_cluster_namespaces(
+        [unagg, agg_fine, agg_coarse], T0, T0 - 10 * 365 * 24 * HOUR
+    )
+    assert got == [agg_coarse]
+
+
+def test_fanout_routes_to_resolved_namespace():
+    unagg, agg_fine, agg_coarse = _namespaces()
+    fan = FanoutStorage([unagg, agg_fine, agg_coarse], clock=lambda: T0)
+    out = fan.fetch([], T0 - 30 * 24 * HOUR, T0)
+    assert out[0][0] == ((b"src", b"agg5m"),)
+    assert agg_fine.storage.calls == 1 and unagg.storage.calls == 0
