@@ -128,6 +128,9 @@ class Aggregator:
         # retried at the next flush so a transient downstream outage doesn't
         # lose windows in standalone mode
         self._pending_emit: list[AggregatedMetric] = []
+        # pending output dropped on leadership loss (the takeover leader
+        # re-emits those windows from its own mirror)
+        self.dropped_pending = 0
         # ingest servers call add_* from handler threads while a flush loop
         # drains; one lock guards the column buffers (entry.go lock role)
         self._lock = threading.Lock()
@@ -203,6 +206,12 @@ class Aggregator:
         # followers keep their mirror of these windows and a takeover
         # re-emits them instead of losing them. Standalone (no followers),
         # undelivered aggregates stay in _pending_emit and retry next flush.
+        if not leader and self._pending_emit:
+            # leadership lost with undelivered output: the flush times for
+            # those windows never advanced, so the NEW leader re-emits them
+            # from its mirror — retrying here would double-deliver
+            self.dropped_pending += len(self._pending_emit)
+            self._pending_emit = []
         if self.flush_handler and (out or self._pending_emit):
             to_send = self._pending_emit + out
             try:

@@ -145,6 +145,18 @@ class RemoteNode:
     def stream_shard(self, ns, shard):
         return wire.series_from_wire(self._call("stream_shard", ns=ns, shard=shard))
 
+    def block_metadata(self, ns, shard):
+        return self._call("block_metadata", ns=ns, shard=shard)
+
+    def stream_series_blocks(self, ns, shard, items):
+        out = self._call(
+            "stream_series_blocks",
+            ns=ns,
+            shard=shard,
+            items=[[sid, bs] for sid, bs in items],
+        )
+        return [(sid, bs, wire.dps_from_wire(dps)) for sid, bs, dps in out]
+
     def owned_shards(self, cache_secs: float = 1.0) -> set[int]:
         cached = self._shards_cache
         now = time.monotonic()

@@ -79,6 +79,20 @@ class NodeService:
     def op_stream_shard(self, req):
         return wire.series_to_wire(self.db.stream_shard(req["ns"], req["shard"]))
 
+    # -- repair endpoints (storage/repair.go metadata + block fetch) --
+
+    def op_block_metadata(self, req):
+        from ..storage.repair import block_metadata
+
+        return block_metadata(self.db, req["ns"], req["shard"])
+
+    def op_stream_series_blocks(self, req):
+        from ..storage.repair import stream_series_blocks
+
+        items = [(sid, bs) for sid, bs in req["items"]]
+        out = stream_series_blocks(self.db, req["ns"], items)
+        return [[sid, bs, wire.dps_to_wire(dps)] for sid, bs, dps in out]
+
     def op_owned_shards(self, req):
         return sorted(self.assigned_shards)
 

@@ -96,6 +96,9 @@ class Engine:
         ast = parse(query)
         steps = int((end_nanos - start_nanos) // step_nanos) + 1
         bounds = Bounds(start_nanos, step_nanos, steps)
+        # @ start()/end() bind to the TOP-LEVEL query range, even inside
+        # subqueries (prometheus PreprocessExpr)
+        _bind_at(ast, bounds)
         return self._eval(ast, bounds)
 
     def query_instant(self, query: str, time_nanos: int) -> Result:
@@ -207,7 +210,12 @@ class Engine:
         else:
             outer_ts = bounds.timestamps() - sq.offset_nanos
         window = int(sq.range_nanos // sub_step) + 1
-        g_start = int(outer_ts.min()) - sq.range_nanos
+        # inner evaluation instants align to ABSOLUTE multiples of the
+        # subquery step (prometheus subquery semantics), so results don't
+        # shift with the outer query's start; the grid extends DOWN past
+        # (outer_min - range) so the earliest outer step has a full window
+        lo = int(outer_ts.min()) - sq.range_nanos
+        g_start = (lo // sub_step) * sub_step
         n_sub = int((int(outer_ts.max()) - g_start) // sub_step) + 1
         sub_bounds = Bounds(g_start, sub_step, n_sub)
         inner = self._eval(sq.expr, sub_bounds)
@@ -493,6 +501,33 @@ def _string(e: Expr) -> str:
     if isinstance(e, StringLiteral):
         return e.value
     raise ValueError("promql: expected a string literal")
+
+
+def _bind_at(e, bounds: Bounds) -> None:
+    """Resolve @ start()/end() sentinels against the top-level query bounds
+    (must run before evaluation: subqueries evaluate their inner expression
+    under DIFFERENT bounds, which must not re-bind start/end)."""
+    if isinstance(e, VectorSelector):
+        if isinstance(e.at_nanos, str):
+            e.at_nanos = _resolve_at(e.at_nanos, bounds)
+    elif isinstance(e, RangeSelector):
+        _bind_at(e.vector, bounds)
+    elif isinstance(e, Subquery):
+        if isinstance(e.at_nanos, str):
+            e.at_nanos = _resolve_at(e.at_nanos, bounds)
+        _bind_at(e.expr, bounds)
+    elif isinstance(e, Call):
+        for a in e.args:
+            _bind_at(a, bounds)
+    elif isinstance(e, Aggregation):
+        _bind_at(e.expr, bounds)
+        if e.param is not None:
+            _bind_at(e.param, bounds)
+    elif isinstance(e, BinaryOp):
+        _bind_at(e.lhs, bounds)
+        _bind_at(e.rhs, bounds)
+    elif isinstance(e, Unary):
+        _bind_at(e.expr, bounds)
 
 
 def _resolve_at(at, bounds: Bounds) -> int:

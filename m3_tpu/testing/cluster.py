@@ -71,6 +71,20 @@ class Node:
             raise ConnectionError(f"{self.id} down")
         return self.db.stream_shard(ns, shard)
 
+    def block_metadata(self, ns, shard):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        from ..storage.repair import block_metadata
+
+        return block_metadata(self.db, ns, shard)
+
+    def stream_series_blocks(self, ns, shard, items):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        from ..storage.repair import stream_series_blocks
+
+        return stream_series_blocks(self.db, ns, items)
+
 
 @dataclass
 class LocalCluster:
@@ -139,51 +153,37 @@ class LocalCluster:
         self.placement_svc.set(placement)
         return node
 
-    # --- repair (storage/repair.go: compare replicas, stream diffs) ---
+    # --- repair (storage/repair.py: checksum-diff replicas, stream diffs) ---
 
     def repair(self, ns: str = "default") -> int:
-        """Active anti-entropy: for each shard, union replica series points
-        and backfill any replica missing some. Returns points repaired."""
-        repaired = 0
-        placement = self.placement_svc.get()
-        for shard_id in range(self.num_shards):
-            owners = [
-                self.nodes[i.id]
-                for i in placement.instances_for_shard(shard_id)
-                if self.nodes[i.id].is_up
-            ]
-            if len(owners) < 2:
-                continue
-            union: dict[bytes, dict[int, tuple]] = {}
-            tag_map: dict[bytes, tuple] = {}
-            per_node: dict[str, dict[bytes, set[int]]] = {}
-            for node in owners:
-                have: dict[bytes, set[int]] = {}
-                for sid, tags, dps in node.stream_shard(ns, shard_id):
-                    tag_map.setdefault(sid, tags)
-                    series = union.setdefault(sid, {})
-                    have[sid] = set()
-                    for dp in dps:
-                        series.setdefault(dp.timestamp, (dp.value, dp.unit))
-                        have[sid].add(dp.timestamp)
-                per_node[node.id] = have
-            from ..storage.database import ColdWriteError
+        """Active anti-entropy over all live replicas: each node repairs its
+        owned shards against its peers via the storage-layer checksum diff
+        (storage/repair.go semantics). Returns points merged."""
+        from ..storage.repair import repair_database
 
-            for node in owners:
-                have = per_node[node.id]
-                for sid, points in union.items():
-                    missing = set(points) - have.get(sid, set())
-                    for t in sorted(missing):
-                        v, unit = points[t]
-                        tags = tag_map.get(sid)
-                        try:
-                            if tags:
-                                node.write_tagged(ns, tags, t, v, unit)
-                            else:
-                                node.write(ns, sid, t, v, unit)
-                        except ColdWriteError:
-                            # cold writes disabled: a flushed-block diff can't
-                            # be backfilled through the write path; skip it
-                            continue
-                        repaired += 1
-        return repaired
+        merged = 0
+        placement = self.placement_svc.get()
+        for nid, node in self.nodes.items():
+            if not node.is_up:
+                continue
+            inst = placement.instances.get(nid)
+            if inst is None:
+                continue
+            for shard_id in sorted(inst.shards):
+                peers = [
+                    self.nodes[i.id]
+                    for i in placement.instances_for_shard(shard_id)
+                    if i.id != nid and self.nodes[i.id].is_up
+                ]
+                if not peers:
+                    continue
+                r = repair_database(
+                    node.db, ns, peers, shard_ids=[shard_id]
+                )
+                if r.peer_errors:
+                    # a failed repair must not read as "converged"
+                    raise RuntimeError(
+                        f"repair errors on {nid} shard {shard_id}: {r.peer_errors}"
+                    )
+                merged += r.points_merged
+        return merged

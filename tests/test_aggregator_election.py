@@ -112,6 +112,46 @@ def test_dead_leader_never_loses_unflushed_window():
     assert {w for _, w in _windows(out_b)} == {T0 + W}
 
 
+def test_failed_delivery_then_leadership_loss_does_not_double_emit():
+    """Leader drains windows, delivery fails, leadership moves: the OLD node
+    must drop its pending output (the new leader re-emits those windows from
+    its mirror) — exactly one delivery total."""
+    kv = KVStore()
+    out_a, out_b = [], []
+    fail = [True]
+
+    def flaky_handler(ms):
+        if fail[0]:
+            raise ConnectionError("downstream away")
+        out_a.extend(ms)
+
+    a = Aggregator(
+        num_shards=4, default_policies=POLICY, flush_handler=flaky_handler,
+        election=ElectionManager(kv, "ss0", "agg-a"),
+        flush_times=FlushTimesStore(kv, "ss0"),
+    )
+    b = Aggregator(
+        num_shards=4, default_policies=POLICY, flush_handler=out_b.extend,
+        election=ElectionManager(kv, "ss0", "agg-b"),
+        flush_times=FlushTimesStore(kv, "ss0"),
+    )
+    _add_both(a, b, b"cpu", T0 + NANOS, 1.0)
+    try:
+        a.flush(T0 + W)  # drains, delivery raises, flush times NOT advanced
+    except ConnectionError:
+        pass
+    assert a._pending_emit and out_a == []
+    # leadership moves to b; b emits w1 from its mirror
+    a.election.election.expire()
+    b.flush(T0 + W)
+    assert {w for _, w in _windows(out_b)} == {T0 + W}
+    # a (now follower, delivery healthy again) must NOT re-deliver
+    fail[0] = False
+    a.flush(T0 + W)
+    assert out_a == [] and a._pending_emit == []
+    assert a.dropped_pending > 0
+
+
 def test_standalone_aggregator_still_always_leader():
     out = []
     agg = Aggregator(num_shards=2, default_policies=POLICY, flush_handler=out.extend)

@@ -133,10 +133,35 @@ def test_subquery_of_subquery_like_nesting(engine):
     # subquery over a plain selector: last_over_time picks the newest sample
     r = run(engine, 'last_over_time(req{host="a"}[3m:1m])')
     vals = np.asarray(r.values)
-    # inner samples lie on the 1m subquery grid, so the newest one at outer
-    # step i is the value at the last 1m boundary <= i (plateaus of 6 steps)
-    expect = np.asarray([10.0 * (i - i % 6) for i in range(60, 81)])
+    # inner samples lie on the ABSOLUTE 1m grid (T0 is 40s past a minute,
+    # so aligned instants sit at offsets ≡ 20s mod 60s); the newest sample
+    # at outer offset o is the last aligned instant <= o
+    def expect_at(o_secs):
+        aligned = o_secs - ((o_secs + 40) % 60)
+        return float(aligned)  # series value at offset x is x
+
+    expect = np.asarray([expect_at(i * 10) for i in range(60, 81)])
     assert np.allclose(vals[0], expect)
+
+
+def test_at_start_inside_subquery_binds_to_outer_range(engine):
+    """@ start() inside a subquery resolves against the TOP-LEVEL query
+    bounds, not the subquery's shifted evaluation bounds (PreprocessExpr)."""
+    r = run(engine, 'max_over_time((req{host="a"} @ start())[5m:1m])')
+    # req @ start() is 600 everywhere, so the max over any window is 600
+    assert np.allclose(np.asarray(r.values), 600.0)
+
+
+def test_subquery_grid_is_absolutely_aligned(engine):
+    """Two queries with different starts sample the inner expr at the SAME
+    absolute instants (grid aligned to multiples of the subquery step)."""
+    q = 'last_over_time(req{host="a"}[3m:1m])'
+    r1 = run(engine, q, start=T0 + 66 * STEP, end=T0 + 72 * STEP)
+    r2 = run(engine, q, start=T0 + 69 * STEP, end=T0 + 72 * STEP)
+    v1 = np.asarray(r1.values)[0]
+    v2 = np.asarray(r2.values)[0]
+    # overlapping instants T0+69..72 must agree exactly
+    assert np.allclose(v1[3:], v2)
 
 
 # --- label manipulation ---

@@ -1,0 +1,220 @@
+"""Property tests: hypothesis-driven random streams through every codec tier
+and random crash-point WAL/snapshot recovery (VERDICT r2 item 10; reference
+pattern: persist/fs/commitlog/read_write_prop_test.go and the m3tsz
+prop tests under src/dbnode/encoding/m3tsz).
+
+Seeds: hypothesis derandomizes in CI by default only with profiles; here we
+print the falsifying example on failure (hypothesis reports the seed) and
+pin `derandomize=False` so runs explore fresh cases while staying
+reproducible via the printed blob.
+"""
+
+import math
+import os
+import struct
+
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, note, settings
+from hypothesis import strategies as st
+
+from m3_tpu import native
+from m3_tpu.codec.m3tsz import ReaderIterator, decode, encode_series
+from m3_tpu.storage.commitlog import CommitLog, CommitLogEntry
+from m3_tpu.utils.xtime import Unit
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+_SETTINGS = dict(
+    max_examples=40,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+
+# --- strategies ---
+
+# values that stress the int-optimization state machine: ints, decimals with
+# few significant digits, floats, specials
+_values = st.one_of(
+    st.integers(min_value=-(2**40), max_value=2**40).map(float),
+    st.decimals(
+        min_value=-1e6, max_value=1e6, places=3, allow_nan=False, allow_infinity=False
+    ).map(float),
+    st.floats(
+        min_value=-1e12, max_value=1e12, allow_nan=False, allow_infinity=False
+    ),
+)
+
+_deltas = st.one_of(
+    st.integers(min_value=1, max_value=60),  # seconds-scale strides
+    st.integers(min_value=1, max_value=10**6),  # wild jumps
+)
+
+
+@st.composite
+def _series(draw, min_size=1, max_size=120):
+    n = draw(st.integers(min_value=min_size, max_value=max_size))
+    deltas = draw(st.lists(_deltas, min_size=n, max_size=n))
+    vals = draw(st.lists(_values, min_size=n, max_size=n))
+    ts = []
+    t = T0
+    for d in deltas:
+        t += d * NANOS
+        ts.append(t)
+    return ts, vals
+
+
+# --- codec round-trips ---
+
+
+def _value_matches(got: float, want: float) -> bool:
+    """The int-optimized scheme intentionally rounds values whose scaled
+    form is within 1 ULP of an integer (reference m3tsz.go convertToIntFloat
+    doc: '46.000...001 would be returned as 46'; denormals collapse to 0 via
+    the Nextafter(val, 0) round-down rule). The induced error is bounded by
+    a few ULP of the original value; everything else round-trips exactly."""
+    if got == want or (math.isnan(got) and math.isnan(want)):
+        return True
+    ulp = abs(math.nextafter(want, math.inf) - want)
+    return abs(got - want) <= 4 * max(ulp, 5e-324)
+
+
+@settings(**_SETTINGS)
+@given(_series())
+def test_python_codec_roundtrip_random(series):
+    ts, vals = series
+    note(f"n={len(ts)}")
+    stream = encode_series(ts, vals)
+    got = decode(stream)
+    assert [dp.timestamp for dp in got] == ts
+    for dp, v in zip(got, vals):
+        assert _value_matches(dp.value, v), (dp.value, v)
+    # decode -> encode -> decode is a fixpoint (the rounding is idempotent)
+    stream2 = encode_series(ts, [dp.value for dp in got])
+    got2 = decode(stream2)
+    assert [dp.value for dp in got2] == [dp.value for dp in got]
+
+
+@settings(**_SETTINGS)
+@given(_series(max_size=80))
+def test_native_codec_matches_python_random(series):
+    if not native.available():
+        pytest.skip("native codec not built")
+    ts, vals = series
+    py_stream = encode_series(ts, vals)
+    nat_streams = native.encode_batch(
+        np.asarray(ts, np.int64),
+        np.asarray(vals, np.float64),
+        np.asarray([len(ts)], np.int32),
+    )
+    assert nat_streams[0] == py_stream, "native encoder must be bit-exact"
+    # native prescanner state snapshots must replay to the same decode
+    snaps = native.prescan_batch([py_stream], k=8)
+    assert sum(1 for _ in decode(py_stream)) == len(ts)
+    assert snaps[0][0]["off"] == 0
+
+
+@settings(**_SETTINGS)
+@given(_series(max_size=60))
+def test_device_decoder_matches_cpu_random(series):
+    """Random streams through the batched JAX decoder (bit-exact contract)."""
+    from m3_tpu.ops.chunked import build_chunked, decode_chunked
+    from m3_tpu.ops.decode import finalize_decode
+
+    ts, vals = series
+    stream = encode_series(ts, vals)
+    cpu = decode(stream)  # the bit-exact oracle is the CPU decoder
+    batch = build_chunked([stream], k=8)
+    res = decode_chunked(batch)
+    times, values, valid = finalize_decode(res)
+    got_t = times[0][valid[0]]
+    got_v = values[0][valid[0]]
+    assert list(got_t) == [dp.timestamp for dp in cpu]
+    for g, w in zip(got_v, (dp.value for dp in cpu)):
+        assert g == w or (math.isnan(g) and math.isnan(w))
+
+
+@settings(**_SETTINGS)
+@given(_series(max_size=60), st.sampled_from([Unit.MILLISECOND, Unit.MICROSECOND]))
+def test_codec_roundtrip_subsecond_units(series, unit):
+    ts, vals = series
+    stream = encode_series(ts, vals, unit=unit)
+    got = decode(stream)
+    assert [dp.timestamp for dp in got] == ts
+
+
+# --- WAL crash-point recovery ---
+
+
+@settings(**_SETTINGS)
+@given(
+    _series(min_size=2, max_size=40),
+    st.integers(min_value=0, max_value=10**6),
+)
+def test_wal_random_crash_point_replays_prefix(tmp_path_factory, series, cut):
+    """Truncate the WAL at an arbitrary byte: replay must yield an exact
+    prefix of the written entries, never garbage, never an exception
+    (read_write_prop_test.go torn-write semantics)."""
+    ts, vals = series
+    d = tmp_path_factory.mktemp("wal")
+    cl = CommitLog(str(d), flush_every=1)
+    entries = [
+        CommitLogEntry(f"s{i % 3}".encode(), t, v)
+        for i, (t, v) in enumerate(zip(ts, vals))
+    ]
+    for e in entries:
+        cl.write(e)
+    cl.close()
+    seg = os.path.join(str(d), f"commitlog-{cl.active_seq}.wal")
+    size = os.path.getsize(seg)
+    cut_at = 4 + (cut % max(size - 4, 1))  # keep the magic, cut anywhere after
+    with open(seg, "r+b") as f:
+        f.truncate(cut_at)
+    got = CommitLog.replay(str(d))
+    assert len(got) <= len(entries)
+    for g, w in zip(got, entries):
+        assert (g.series_id, g.time_nanos) == (w.series_id, w.time_nanos)
+        assert g.value == w.value or (math.isnan(g.value) and math.isnan(w.value))
+
+
+@settings(max_examples=15, deadline=None, suppress_health_check=[HealthCheck.too_slow])
+@given(
+    st.lists(st.integers(min_value=0, max_value=59), min_size=1, max_size=30),
+    st.integers(min_value=0, max_value=2),
+    st.randoms(use_true_random=False),
+)
+def test_storage_crash_recovery_random_schedule(tmp_path_factory, offsets, n_ops, rng):
+    """Random write/flush/snapshot schedule, then 'crash' (drop the object)
+    and bootstrap a fresh Database: every acknowledged write must be
+    readable, with no duplicates."""
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    HOUR = 3600 * NANOS
+    base = str(tmp_path_factory.mktemp("dbprop"))
+    db = Database(base, num_shards=2)
+    opts = NamespaceOptions(block_size_nanos=HOUR)
+    db.create_namespace("ns", opts)
+    db.bootstrap()
+    expected = {}
+    for i, off in enumerate(offsets):
+        t = T0 + off * 60 * NANOS
+        db.write("ns", b"cpu", t, float(i))
+        expected[t] = float(i)
+        op = rng.randint(0, 5)
+        if op == 0:
+            db.flush("ns", ((t // HOUR) + 1) * HOUR)
+        elif op == 1:
+            db.snapshot("ns")
+    # crash: no close/flush — tail lives only in the WAL
+    del db
+
+    db2 = Database(base, num_shards=2)
+    db2.create_namespace("ns", opts)
+    db2.bootstrap()
+    got = db2.read("ns", b"cpu", 0, 2**62)
+    assert {dp.timestamp: dp.value for dp in got} == expected
+    ts_list = [dp.timestamp for dp in got]
+    assert ts_list == sorted(set(ts_list)), "duplicates after recovery"
+    db2.close()

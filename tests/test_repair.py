@@ -1,0 +1,155 @@
+"""Storage-layer repair: checksum metadata diff + diff-only streaming
+(storage/repair.go:67 semantics, VERDICT r2 item 9)."""
+
+import pytest
+
+from m3_tpu.cluster.topology import ConsistencyLevel
+from m3_tpu.storage.repair import block_metadata, repair_database, repair_shard
+from m3_tpu.testing.cluster import LocalCluster
+from m3_tpu.utils.serialize import encode_tags
+
+NANOS = 1_000_000_000
+HOUR = 3600 * NANOS
+T0 = 1_600_000_000 * NANOS
+
+
+def test_repair_streams_only_differing_blocks():
+    cluster = LocalCluster(num_nodes=2, num_shards=4, replica_factor=2)
+    a, b = cluster.nodes["node0"], cluster.nodes["node1"]
+    session = cluster.session(write_cl=ConsistencyLevel.ALL)
+
+    # 3 series fully replicated; many blocks of data
+    sids = []
+    for name in (b"alpha", b"beta", b"gamma"):
+        for i in range(10):
+            session.write(name, T0 + i * NANOS, float(i))
+        sids.append(name)
+    # one series diverges: b missed two points (written while b was down)
+    b.is_up = False
+    session_one = cluster.session(write_cl=ConsistencyLevel.ONE)
+    session_one.write(b"alpha", T0 + 100 * NANOS, 42.0)
+    session_one.write(b"beta", T0 + 2 * HOUR + NANOS, 7.0)  # different block
+    b.is_up = True
+
+    # b repairs against a: only the two differing (series, block) pairs move
+    res = repair_database(b.db, "default", [a])
+    assert res.blocks_streamed == 2, res
+    assert res.points_merged == 2, res
+    assert res.blocks_compared >= 4  # all replicated blocks were compared
+
+    # convergence: a second pass finds zero diffs
+    res2 = repair_database(b.db, "default", [a])
+    assert res2.blocks_streamed == 0 and res2.points_merged == 0
+    # both replicas now serve the repaired points
+    assert any(
+        dp.value == 42.0 for dp in b.db.read("default", b"alpha", T0, T0 + HOUR)
+    )
+
+
+def test_repair_covers_flushed_filesets():
+    """Diffs hidden in flushed blocks (not buffers) are still detected:
+    metadata draws from filesets too."""
+    cluster = LocalCluster(num_nodes=2, num_shards=2, replica_factor=2)
+    a, b = cluster.nodes["node0"], cluster.nodes["node1"]
+    session = cluster.session(write_cl=ConsistencyLevel.ALL)
+    for i in range(5):
+        session.write(b"flushed", T0 + i * NANOS, float(i))
+    b.is_up = False
+    cluster.session(write_cl=ConsistencyLevel.ONE).write(
+        b"flushed", T0 + 50 * NANOS, 9.0
+    )
+    b.is_up = True
+    # a flushes the block to disk; its buffer is evicted
+    bsz = a.db.namespaces["default"].opts.block_size_nanos
+    a.db.flush("default", ((T0 // bsz) + 1) * bsz)
+    res = repair_database(b.db, "default", [a])
+    assert res.points_merged == 1
+    assert any(
+        dp.value == 9.0 for dp in b.db.read("default", b"flushed", T0, T0 + HOUR)
+    )
+
+
+def test_identical_data_across_flush_states_compares_equal():
+    """A flushed+cold-write replica and an all-buffered replica holding the
+    same points must digest identically (canonical decoded-point digests) —
+    otherwise every repair pass re-streams the block forever."""
+    cluster = LocalCluster(num_nodes=2, num_shards=2, replica_factor=2)
+    a, b = cluster.nodes["node0"], cluster.nodes["node1"]
+    session = cluster.session(write_cl=ConsistencyLevel.ALL)
+    for i in range(5):
+        session.write(b"s", T0 + i * NANOS, float(i))
+    # a flushes, then BOTH take the same cold write; b stays buffered
+    bsz = a.db.namespaces["default"].opts.block_size_nanos
+    a.db.flush("default", ((T0 // bsz) + 1) * bsz)
+    session.write(b"s", T0 + 50 * NANOS, 5.0)
+    res = repair_database(b.db, "default", [a])
+    assert res.blocks_streamed == 0, (
+        f"identical data must not stream: {res}"
+    )
+    res2 = repair_database(a.db, "default", [b])
+    assert res2.blocks_streamed == 0
+
+
+def test_repair_maintains_index_for_tag_ids():
+    """Merged points for tag-format IDs re-index via write_tagged."""
+    from m3_tpu.index.query import term
+
+    cluster = LocalCluster(num_nodes=2, num_shards=2, replica_factor=2)
+    a, b = cluster.nodes["node0"], cluster.nodes["node1"]
+    b.is_up = False
+    session = cluster.session(write_cl=ConsistencyLevel.ONE)
+    tags = ((b"host", b"x"), (b"name", b"cpu"))
+    session.write_tagged(tags, T0 + NANOS, 1.0)
+    b.is_up = True
+    repair_database(b.db, "default", [a])
+    got = b.db.fetch_tagged("default", term(b"name", b"cpu"), T0, T0 + HOUR)
+    assert len(got) == 1 and [dp.value for dp in got[0][2]] == [1.0]
+
+
+def test_repair_over_sockets(tmp_path):
+    """The repair exchange crosses the RPC boundary (RemoteNode peers)."""
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.net.server import NodeServer, NodeService
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    dbs, servers, clients = [], [], []
+    for name in ("a", "b"):
+        db = Database(str(tmp_path / name), num_shards=2)
+        db.create_namespace("default", NamespaceOptions(block_size_nanos=HOUR))
+        db.bootstrap()
+        server = NodeServer(NodeService(db, node_id=name))
+        server.start()
+        dbs.append(db)
+        servers.append(server)
+        clients.append(RemoteNode("127.0.0.1", server.port, node_id=name))
+    try:
+        for i in range(4):
+            dbs[0].write("default", b"s", T0 + i * NANOS, float(i))
+            if i < 2:  # b diverges
+                dbs[1].write("default", b"s", T0 + i * NANOS, float(i))
+        res = repair_shard(dbs[1], "default",
+                           dbs[1].namespaces["default"].shard_for(b"s").id,
+                           [clients[0]])
+        assert res.blocks_streamed == 1 and res.points_merged == 2
+        assert len(dbs[1].read("default", b"s", T0, T0 + HOUR)) == 4
+    finally:
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.stop()
+        for db in dbs:
+            db.close()
+
+
+def test_cluster_fixture_repair_still_converges():
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3)
+    b = cluster.nodes["node1"]
+    b.is_up = False
+    session = cluster.session(write_cl=ConsistencyLevel.MAJORITY)
+    for i in range(6):
+        session.write(b"m", T0 + i * NANOS, float(i))
+    b.is_up = True
+    merged = cluster.repair()
+    assert merged == 6
+    assert len(b.db.read("default", b"m", T0, T0 + HOUR)) == 6
+    assert cluster.repair() == 0
