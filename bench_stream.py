@@ -1,0 +1,87 @@
+"""Sustained streaming benchmark: host→device upload + fused decode at 1M
+series (BASELINE config-5 direction: working set larger than one transfer).
+
+Unlike bench.py (device-resident arrays, pure kernel throughput), every
+timed iteration re-uploads each packed batch from host memory, so the
+number includes the host→device pipeline (parallel/stream.py double
+buffering).
+
+CAVEAT for this environment: host→device rides a shared network tunnel
+whose effective bandwidth swings ~100x between runs (measured 0.07s to
+>10s draining identical 47M-point batches). Treat the figure as a lower
+bound; on a real TPU host the pipeline is bounded by PCIe/host DMA
+(tens of GB/s) and the same code measures accordingly.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+NORTH_STAR = 10e9  # datapoints/sec/chip, same scale as bench.py
+
+
+def main() -> None:
+    import jax
+
+    # the Mosaic compile of the packed kernel is ~2min through the remote
+    # compile tunnel; cache it across runs
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_CACHE_DIR", os.path.expanduser("~/.cache/jax_comp_cache")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    from m3_tpu.ops.chunked import build_chunked, tile_chunked
+    from m3_tpu.parallel.stream import packed_batches, stream_aggregate
+    from m3_tpu.utils.synthetic import synthetic_streams
+
+    n_points = 720
+    k = 24
+    # NOTE: in this environment host->device rides an axon tunnel measured
+    # at ~1.4 GB/s, so the sustained number is transfer-bound; real PCIe /
+    # host DMA is ~30x that. 1M series (BENCH_SERIES=1048576) works but
+    # takes ~10 GB of host batches and minutes of tunnel time.
+    n_series = int(os.environ.get("BENCH_SERIES", 262144))
+    batch_series = int(os.environ.get("BENCH_BATCH", 65536))
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        n_series = min(n_series, 8192)
+        batch_series = min(batch_series, 4096)
+
+    base = build_chunked(synthetic_streams(64, n_points, seed=3), k=k)
+    n_batches = -(-n_series // batch_series)
+    host = list(
+        packed_batches(tile_chunked(base, batch_series) for _ in range(n_batches))
+    )
+
+    # Steady-state measurement within ONE pass: the first drain absorbs
+    # compile + pipeline fill; the window from first to last drain covers
+    # n_batches - 1 batches of sustained upload+decode. (Repeat whole-pass
+    # timing is unusable in this environment: device buffer churn through
+    # the axon tunnel stalls later passes in ways real hosts don't.)
+    marks = stream_aggregate(host, prefetch=2, drain_times=(times := []))
+    total_points = marks.total_count
+    per_batch = total_points // n_batches
+    dt = (times[-1] - times[0]) / max(n_batches - 1, 1)
+
+    dps = per_batch / dt
+    print(
+        json.dumps(
+            {
+                "metric": "m3tsz_streamed_decode_aggregate_datapoints_per_sec",
+                "value": round(dps, 1),
+                "unit": "datapoints/s",
+                "vs_baseline": round(dps / NORTH_STAR, 6),
+                "series": n_series,
+                "batches": n_batches,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -90,7 +90,7 @@ class NodeService:
         from ..storage.repair import stream_series_blocks
 
         items = [(sid, bs) for sid, bs in req["items"]]
-        out = stream_series_blocks(self.db, req["ns"], items)
+        out = stream_series_blocks(self.db, req["ns"], items, shard_id=req["shard"])
         return [[sid, bs, wire.dps_to_wire(dps)] for sid, bs, dps in out]
 
     def op_owned_shards(self, req):

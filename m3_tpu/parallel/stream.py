@@ -1,0 +1,117 @@
+"""Host→device streaming pipeline: double-buffered upload + fused decode.
+
+Reference mapping: SURVEY §2.7's "batched segment-upload RPC into device
+HBM" / §7.5 fetch→pin→upload→kernel. At BASELINE config-5 scale (tens of
+millions of series) the working set exceeds HBM, so scans stream: while the
+device decodes batch N, batch N+1's packed arrays are already in flight
+(`jax.device_put` is asynchronous), and batch N-P's results are drained to
+bound in-flight memory at P batches.
+
+Batches are the packed kernel layout (ops/fused.pack_lane_inputs) — the
+same bytes filesets hold, so production reads go disk → packed host arrays
+→ HBM without per-point host work.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+import jax
+import numpy as np
+
+from ..ops import fused
+from .scan import chunked_scan_aggregate_packed
+
+
+@dataclass
+class StreamTotals:
+    """Cross-batch aggregate of the per-batch ScanAggregates totals."""
+
+    total_sum: float = 0.0
+    total_count: int = 0
+    total_min: float = float("inf")
+    total_max: float = float("-inf")
+    batches: int = 0
+
+    def fold(self, agg) -> None:
+        self.total_sum += float(agg.total_sum)
+        self.total_count += int(agg.total_count)
+        cnt = int(agg.total_count)
+        if cnt:
+            self.total_min = min(self.total_min, float(agg.total_min))
+            self.total_max = max(self.total_max, float(agg.total_max))
+        self.batches += 1
+
+
+def packed_batches(batches: Iterable) -> Iterator[tuple]:
+    """ChunkedBatch iterable → (windows4, lanes4, n, s, c, k) host tuples."""
+    for batch in batches:
+        packed = fused.pack_lane_inputs(batch)
+        yield (
+            packed.windows4,
+            packed.lanes4,
+            packed.n,
+            batch.num_series,
+            batch.num_chunks,
+            batch.k,
+        )
+
+
+def stream_aggregate(
+    host_batches: Iterable[tuple], prefetch: int = 2, drain_times: list | None = None
+) -> StreamTotals:
+    """Stream (windows4, lanes4, n, s, c, k) host batches through the packed
+    kernel with ``prefetch`` batches in flight.
+
+    Upload of batch N+1 overlaps compute of batch N (async dispatch); the
+    oldest result is drained once the window exceeds ``prefetch``, bounding
+    device memory to ~prefetch batches. ``drain_times`` (optional list)
+    receives a perf_counter stamp per drained batch for steady-state timing.
+    """
+    import time as _time
+
+    totals = StreamTotals()
+    inflight: deque = deque()
+
+    def drain_one():
+        agg = inflight.popleft()
+        jax.block_until_ready(agg)
+        totals.fold(agg)
+        if drain_times is not None:
+            drain_times.append(_time.perf_counter())
+
+    for w4, l4, n, s, c, k in host_batches:
+        dev_w = jax.device_put(w4)
+        dev_l = jax.device_put(l4)
+        fn = _jitted(n, s, c, k)
+        inflight.append(fn(dev_w, dev_l))
+        if len(inflight) > prefetch:
+            drain_one()
+    while inflight:
+        drain_one()
+    return totals
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted(n: int, s: int, c: int, k: int):
+    # Mosaic kernels are TPU-only; other backends run the kernel body in
+    # Pallas interpret mode (same code path, no Mosaic lowering)
+    interpret = jax.default_backend() != "tpu"
+    return jax.jit(
+        functools.partial(
+            chunked_scan_aggregate_packed, n=n, s=s, c=c, k=k, interpret=interpret
+        )
+    )
+
+
+def fileset_packed_batches(readers: Iterable, batch_series: int = 65536):
+    """FilesetReader iterable → packed host batches straight off the side
+    tables (no CPU prescan): the production fetch→upload path."""
+    for reader in readers:
+        sids = reader.series_ids
+        for i in range(0, len(sids), batch_series):
+            chunk = reader.chunked_batch(sids[i : i + batch_series])
+            yield from packed_batches([chunk])

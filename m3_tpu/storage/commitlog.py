@@ -134,13 +134,19 @@ class CommitLog:
 
     def cleanup(self, covered) -> int:
         """Delete sealed segments in which EVERY entry satisfies ``covered``
-        (a predicate CommitLogEntry -> bool, i.e. durable elsewhere).
+        (a predicate CommitLogEntry -> bool, i.e. durable elsewhere),
+        OLDEST-FIRST and stopping at the first retained segment — the
+        surviving WAL must stay a contiguous SUFFIX of write history.
+        Deleting a newer segment around an older survivor would let the
+        survivor's stale same-timestamp entries win replay's last-wins
+        ordering over values that now live only in filesets.
         Returns the number of segments removed."""
         removed = 0
         for _, path in self.inactive_segments():
-            if all(covered(e) for e in self.replay_segment(path)):
-                os.remove(path)
-                removed += 1
+            if not all(covered(e) for e in self.replay_segment(path)):
+                break
+            os.remove(path)
+            removed += 1
         return removed
 
     def remove_inactive(self) -> int:

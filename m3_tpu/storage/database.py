@@ -373,7 +373,7 @@ class Database:
                 snap = read_latest_snapshot(self.base, ns, shard.id)
                 if snap and all(
                     bs + bsz <= flush_before_nanos and bs in shard._flushed_blocks
-                    for _, bs, _ in snap
+                    for _, bs, _, _ in snap
                 ):
                     remove_snapshots(self.base, ns, shard.id)
             # WarmFlush of index blocks (storage/index.go:868): seal + persist
@@ -390,12 +390,15 @@ class Database:
             namespace = self.namespaces[ns]
             total = 0
             for shard in namespace.shards:
+                vol_now = {f.block_start: f.volume for f in shard.filesets()}
                 records = []
                 for sid, buf in shard.series.items():
                     for bs, bucket in buf.buckets.items():
                         stream = bucket.merged_stream()
                         if stream:
-                            records.append((sid, bs, stream))
+                            records.append(
+                                (sid, bs, stream, vol_now.get(bs, -1))
+                            )
                 if records:
                     write_snapshot(self.base, ns, shard.id, records)
                 else:
@@ -485,6 +488,23 @@ class Database:
                         return False
                     return True
 
+                def _has_fileset_point(sh: Shard, sid: bytes, t: int) -> bool:
+                    bs = (t // bsz) * bsz
+                    fid = next(
+                        (f for f in sh.filesets() if f.block_start == bs), None
+                    )
+                    if fid is None:
+                        return False
+                    pk = (sh.id, bs, sid)
+                    if pk not in pts:
+                        stream = sh.reader(fid).stream(sid)
+                        pts[pk] = (
+                            {dp.timestamp: dp.value for dp in decode(stream)}
+                            if stream
+                            else {}
+                        )
+                    return t in pts[pk]
+
                 # persisted index blocks load wholesale; blocks without one
                 # are rebuilt below from fileset IDs (tag wire format)
                 persisted: set[int] = set()
@@ -501,14 +521,43 @@ class Database:
                             self._reindex(ns, sid, fid.block_start)
                     snap = read_latest_snapshot(self.base, name, shard.id)
                     if snap:
-                        for sid, bs, stream in snap:
+                        vol_now = {
+                            f.block_start: f.volume for f in shard.filesets()
+                        }
+                        for sid, bs, stream, rec_vol in snap:
+                            # Ordering vs filesets (the recorded volume is
+                            # the arbiter): every warm/cold flush bumps the
+                            # block's fileset volume, so a volume that has
+                            # advanced since the snapshot means the fileset
+                            # superseded this record — restoring it would
+                            # shadow newer flushed values (buffer wins on
+                            # read dedupe). An unchanged volume means the
+                            # record is a cold-write overlay NEWER than the
+                            # fileset.
+                            if vol_now.get(bs, -1) > rec_vol:
+                                continue
                             for dp in decode(stream):
                                 _restore(shard, sid, dp.timestamp, dp.value, dp.unit)
                             self._reindex(ns, sid, bs)
                         result["snapshot_records"] += len(snap)
                 entries = CommitLog.replay(self._commitlog_dir(name))
+                # The WAL is totally ordered, so for duplicate (sid, t) the
+                # LAST entry is the live value (an earlier entry may be a
+                # stale overwrite whose newer value now lives only in a
+                # fileset — replaying it would shadow the fileset).
+                final: dict[tuple[bytes, int], CommitLogEntry] = {}
                 for e in entries:
+                    final[(e.series_id, e.time_nanos)] = e
+                for e in final.values():
                     sh = ns.shard_for(e.series_id)
+                    if _covered(sh, e.series_id, e.time_nanos, e.value):
+                        continue
+                    # value differs from (or is absent in) the fileset: the
+                    # last-ordered WAL write is newer than the flush unless
+                    # the point exists there with another value AND this
+                    # entry predates the flush — with last-wins dedupe the
+                    # only such survivors are post-flush cold writes, so
+                    # replay them
                     if _restore(sh, e.series_id, e.time_nanos, e.value, e.unit):
                         self._reindex(ns, e.series_id, e.time_nanos)
                 result["commitlog_entries"] += len(entries)

@@ -54,7 +54,10 @@ def _canonical_digest(sh, sid: bytes, bs: int, bsz: int):
 def block_metadata(db, ns: str, shard_id: int) -> list[list]:
     """[[block_start, sid, n_points, checksum], ...] for one shard — the
     repair metadata exchange (repair.go Metadata step). Digests are over
-    decoded points, so replicas at different flush stages compare equal."""
+    decoded points, so replicas at different flush stages compare equal.
+
+    The global lock is held only to snapshot the key set and per digest —
+    not across the whole scan — so serving traffic interleaves."""
     with db.lock:
         namespace = db.namespaces[ns]
         bsz = namespace.opts.block_size_nanos
@@ -66,23 +69,32 @@ def block_metadata(db, ns: str, shard_id: int) -> list[list]:
         for sid, buf in sh.series.items():
             for bs in buf.buckets:
                 keys.add((bs, sid))
-        out = []
-        for bs, sid in sorted(keys):
+    out = []
+    for bs, sid in sorted(keys):
+        with db.lock:
             digest = _canonical_digest(sh, sid, bs, bsz)
-            if digest is not None:
-                out.append([bs, sid, digest[0], digest[1]])
-        return out
+        if digest is not None:
+            out.append([bs, sid, digest[0], digest[1]])
+    return out
 
 
-def stream_series_blocks(db, ns: str, items: list[tuple[bytes, int]]) -> list:
+def stream_series_blocks(
+    db, ns: str, items: list[tuple[bytes, int]], shard_id: int | None = None
+) -> list:
     """[(sid, block_start, datapoints)] for the requested series-blocks —
-    the repair data fetch (only differing blocks are requested)."""
+    the repair data fetch (only differing blocks are requested). When
+    ``shard_id`` is given, requests for series outside that shard are
+    rejected (the RPC is scoped per shard)."""
     with db.lock:
         namespace = db.namespaces[ns]
         bsz = namespace.opts.block_size_nanos
         out = []
         for sid, bs in items:
             sh = namespace.shard_for(sid)
+            if shard_id is not None and sh.id != shard_id:
+                raise ValueError(
+                    f"series {sid!r} belongs to shard {sh.id}, not {shard_id}"
+                )
             dps = sh.read(sid, bs, bs + bsz)
             out.append((sid, bs, dps))
         return out
@@ -96,6 +108,10 @@ class RepairResult:
     blocks_compared: int = 0
     blocks_streamed: int = 0
     points_merged: int = 0
+    # diffs in flushed blocks of cold-disabled namespaces can't backfill
+    # through the write path; counted, not errors (repair still converges
+    # everything repairable)
+    points_skipped_cold: int = 0
     peer_errors: list = field(default_factory=list)
 
 
@@ -149,8 +165,8 @@ def repair_shard(db, ns: str, shard_id: int, peers: list, tags_for=None) -> Repa
                     else:
                         db.write(ns, sid, dp.timestamp, dp.value, unit)
                     res.points_merged += 1
-                except ColdWriteError as exc:
-                    res.peer_errors.append(f"merge {sid!r}@{dp.timestamp}: {exc}")
+                except ColdWriteError:
+                    res.points_skipped_cold += 1
             # refresh the local digest so later peers don't re-stream what
             # this peer just repaired
             local[(bs, sid)] = _canonical_digest(sh, sid, bs, bsz)
@@ -169,5 +185,6 @@ def repair_database(db, ns: str, peers: list, shard_ids=None, tags_for=None) -> 
         total.blocks_compared += r.blocks_compared
         total.blocks_streamed += r.blocks_streamed
         total.points_merged += r.points_merged
+        total.points_skipped_cold += r.points_skipped_cold
         total.peer_errors.extend(r.peer_errors)
     return total

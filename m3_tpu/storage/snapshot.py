@@ -18,8 +18,8 @@ import struct
 
 from ..utils.blob import read_checked_blob, write_atomic_checked_blob
 
-_MAGIC = 0x6D33534E  # "m3SN"
-_REC = struct.Struct("<IqI")  # id len, block_start, stream len
+_MAGIC = 0x6D335350  # "m3SP" (v3: records the fileset volume at snapshot)
+_REC = struct.Struct("<IqIi")  # id len, block_start, stream len, volume
 _SNAP_RE = re.compile(r"^snapshot-(\d+)\.db$")
 
 
@@ -42,15 +42,20 @@ def _list(base: str, ns: str, shard: int) -> list[tuple[int, str]]:
 
 
 def write_snapshot(
-    base: str, ns: str, shard: int, records: list[tuple[bytes, int, bytes]]
+    base: str, ns: str, shard: int, records: list[tuple[bytes, int, bytes, int]]
 ) -> int:
-    """Write records [(series_id, block_start, stream)]; returns the new
-    sequence number. Older snapshots are removed after the new one commits."""
+    """Write records [(series_id, block_start, stream, volume)]; ``volume``
+    is the block's fileset volume when the snapshot was taken (-1 = none) —
+    bootstrap orders snapshot data against filesets with it: a fileset whose
+    volume has since advanced supersedes the record (any warm or cold flush
+    bumps the volume), while an unchanged volume means the record is a
+    cold-write overlay NEWER than the fileset. Returns the new sequence
+    number. Older snapshots are removed after the new one commits."""
     existing = _list(base, ns, shard)
     seq = (existing[-1][0] + 1) if existing else 0
     parts = [struct.pack("<I", len(records))]
-    for sid, bs, stream in records:
-        parts.append(_REC.pack(len(sid), bs, len(stream)))
+    for sid, bs, stream, volume in records:
+        parts.append(_REC.pack(len(sid), bs, len(stream), volume))
         parts.append(sid)
         parts.append(stream)
     write_atomic_checked_blob(
@@ -94,7 +99,7 @@ def read_latest_snapshot(
             if pos + _REC.size > len(body):
                 ok = False
                 break
-            id_len, bs, s_len = _REC.unpack_from(body, pos)
+            id_len, bs, s_len, volume = _REC.unpack_from(body, pos)
             pos += _REC.size
             sid = body[pos : pos + id_len]
             pos += id_len
@@ -103,7 +108,7 @@ def read_latest_snapshot(
             if len(sid) != id_len or len(stream) != s_len:
                 ok = False
                 break
-            out.append((sid, bs, stream))
+            out.append((sid, bs, stream, volume))
         if ok:
             return out
     return None

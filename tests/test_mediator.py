@@ -174,6 +174,75 @@ def test_snapshot_flush_restart_does_not_duplicate_volumes(tmp_path):
     assert fs_before == fs_after, "restart+flush must not write new volumes"
 
 
+def test_overwrite_after_snapshot_not_resurrected(tmp_path):
+    """snapshot captures v_old; the point is overwritten and flushed; crash:
+    bootstrap must NOT restore the stale snapshot value over the fileset
+    (the snapshot record predates the flush — its flushed flag arbitrates)."""
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    t = B0 + NANOS
+    db.write("ns", b"cpu", t, 2.0)
+    db.write("ns", b"cpu", t + NANOS, 7.0)  # second point keeps snapshot alive
+    db.snapshot("ns")
+    db.write("ns", b"cpu", t, 4.0)  # overwrite after the snapshot
+    # also buffer something in ANOTHER block so flush's all-covered snapshot
+    # cleanup does not fire and the stale snapshot survives the crash
+    db.write("ns", b"cpu", B0 + HOUR + NANOS, 1.0)
+    db.flush("ns", B0 + HOUR)
+    live = {dp.timestamp: dp.value for dp in db.read("ns", b"cpu", 0, 2**62)}
+    db.close()
+
+    db2 = Database(str(tmp_path), num_shards=1)
+    db2.create_namespace("ns", _opts())
+    db2.bootstrap()
+    got = {dp.timestamp: dp.value for dp in db2.read("ns", b"cpu", 0, 2**62)}
+    assert got == live, f"recovered {got} != pre-crash {live}"
+    assert got[t] == 4.0
+
+
+def test_wal_overwrite_replay_is_last_wins(tmp_path):
+    """Two WAL entries for the same (sid, t): replay must keep the LAST
+    value, even when the newer value also lives in a fileset and the stale
+    entry's value does not."""
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    t = B0 + NANOS
+    db.write("ns", b"cpu", t, 2.0)
+    db.write("ns", b"cpu", t, 4.0)
+    # entry in an unflushed block keeps the WAL segment alive post-flush
+    db.write("ns", b"cpu", B0 + HOUR + NANOS, 1.0)
+    db.flush("ns", B0 + HOUR)
+    db.close()
+
+    db2 = Database(str(tmp_path), num_shards=1)
+    db2.create_namespace("ns", _opts())
+    db2.bootstrap()
+    got = {dp.timestamp: dp.value for dp in db2.read("ns", b"cpu", 0, 2**62)}
+    assert got[t] == 4.0, got
+
+
+def test_cold_overlay_snapshot_is_restored(tmp_path):
+    """The inverse ordering: a snapshot taken AFTER the flush holds cold
+    writes newer than the fileset — those must restore."""
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", _opts())
+    db.bootstrap()
+    t = B0 + NANOS
+    db.write("ns", b"cpu", t, 2.0)
+    db.flush("ns", B0 + HOUR)
+    db.write("ns", b"cpu", t, 9.0)  # cold overwrite atop the flushed block
+    db.snapshot("ns")  # snapshot AFTER flush: flushed flag is set
+    db.close()
+
+    db2 = Database(str(tmp_path), num_shards=1)
+    db2.create_namespace("ns", _opts())
+    db2.bootstrap()
+    got = {dp.timestamp: dp.value for dp in db2.read("ns", b"cpu", 0, 2**62)}
+    assert got[t] == 9.0, got
+
+
 def test_mediator_background_thread_runs(tmp_path):
     import time
 

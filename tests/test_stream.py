@@ -1,0 +1,80 @@
+"""Streaming pipeline tests: batched upload totals match the chunked oracle
+and the fileset-fed path decodes straight off side tables (SURVEY §7.5
+fetch→upload→kernel)."""
+
+import functools
+
+import jax
+import numpy as np
+
+from m3_tpu.codec.m3tsz import encode_series
+from m3_tpu.ops.chunked import build_chunked, tile_chunked
+from m3_tpu.parallel.scan import chunked_device_args, chunked_scan_aggregate
+from m3_tpu.parallel.stream import (
+    fileset_packed_batches,
+    packed_batches,
+    stream_aggregate,
+)
+from m3_tpu.utils.synthetic import synthetic_streams
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+
+def _oracle_totals(batches):
+    total_sum, total_count = 0.0, 0
+    for batch in batches:
+        fn = jax.jit(
+            functools.partial(
+                chunked_scan_aggregate,
+                s=batch.num_series,
+                c=batch.num_chunks,
+                k=batch.k,
+            )
+        )
+        out = fn(chunked_device_args(batch, device_put=False))
+        total_sum += float(out.total_sum)
+        total_count += int(out.total_count)
+    return total_sum, total_count
+
+
+def test_stream_totals_match_oracle():
+    base = build_chunked(synthetic_streams(16, 60, seed=5), k=8)
+    batches = [tile_chunked(base, 64) for _ in range(3)]
+    want_sum, want_count = _oracle_totals(batches)
+    totals = stream_aggregate(packed_batches(batches), prefetch=2)
+    assert totals.batches == 3
+    assert totals.total_count == want_count
+    np.testing.assert_allclose(totals.total_sum, want_sum, rtol=1e-6)
+
+
+def test_stream_prefetch_zero_still_correct():
+    base = build_chunked(synthetic_streams(8, 30, seed=6), k=8)
+    batches = [tile_chunked(base, 16) for _ in range(2)]
+    want_sum, want_count = _oracle_totals(batches)
+    totals = stream_aggregate(packed_batches(batches), prefetch=0)
+    assert totals.total_count == want_count
+    np.testing.assert_allclose(totals.total_sum, want_sum, rtol=1e-6)
+
+
+def test_fileset_to_stream_path(tmp_path):
+    """Disk → side tables → packed batches → kernel without a host prescan."""
+    from m3_tpu.storage.fs import CHUNK_K, FilesetID, FilesetReader, write_fileset
+
+    series = {
+        f"s{i}".encode(): encode_series(
+            [T0 + j * NANOS for j in range(40)],
+            [float(i + j) for j in range(40)],
+        )
+        for i in range(20)
+    }
+    fid = FilesetID("ns", 0, T0, 0)
+    write_fileset(str(tmp_path), fid, series, 2 * 3600 * NANOS, CHUNK_K)
+    reader = FilesetReader(str(tmp_path), fid)
+
+    totals = stream_aggregate(
+        fileset_packed_batches([reader], batch_series=7), prefetch=1
+    )
+    assert totals.total_count == 20 * 40
+    want = sum(float(i + j) for i in range(20) for j in range(40))
+    np.testing.assert_allclose(totals.total_sum, want, rtol=1e-6)
