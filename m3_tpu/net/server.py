@@ -15,6 +15,7 @@ import socket
 import socketserver
 import threading
 
+from ..utils.instrument import DEFAULT as METRICS
 from ..utils.xtime import Unit
 from . import wire
 
@@ -32,7 +33,12 @@ class NodeService:
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             raise ValueError(f"unknown op {op!r}")
-        return fn(req)
+        METRICS.counter("rpc_requests_total", labels={"op": str(op)}).inc()
+        try:
+            return fn(req)
+        except Exception:
+            METRICS.counter("rpc_errors_total", labels={"op": str(op)}).inc()
+            raise
 
     # -- rpc.thrift surface --
 
@@ -92,6 +98,10 @@ class NodeService:
         items = [(sid, bs) for sid, bs in req["items"]]
         out = stream_series_blocks(self.db, req["ns"], items, shard_id=req["shard"])
         return [[sid, bs, wire.dps_to_wire(dps)] for sid, bs, dps in out]
+
+    def op_metrics(self, req):
+        """Self-observability exposition (x/instrument); Prometheus text."""
+        return METRICS.expose()
 
     def op_owned_shards(self, req):
         return sorted(self.assigned_shards)

@@ -1,0 +1,90 @@
+"""Per-query cost limits: bound series / datapoints a single query touches.
+
+Reference: /root/reference/src/query/cost/ + src/x/cost/ — a per-query
+ChainedEnforcer charges each fetched block against query- and global-scope
+limits and aborts the query when exceeded (the coordinator returns 4xx
+instead of OOMing the node). Here an Enforcer accumulates charges from the
+engine's fetch path; the global scope is a shared parent enforcer.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+class QueryLimitError(Exception):
+    """Cost limit exceeded — maps to HTTP 422 at the coordinator."""
+
+    def __init__(self, what: str, used: int, limit: int) -> None:
+        super().__init__(
+            f"query limit exceeded: {what} used {used} > limit {limit}"
+        )
+        self.what = what
+        self.used = used
+        self.limit = limit
+
+
+@dataclass
+class QueryLimits:
+    """0 = unlimited (cost/config defaults)."""
+
+    max_series: int = 0
+    max_datapoints: int = 0
+
+
+class Enforcer:
+    """Accumulates charges for ONE query (cost.ChainedEnforcer child)."""
+
+    def __init__(self, limits: QueryLimits, parent: "GlobalEnforcer | None" = None):
+        self.limits = limits
+        self.parent = parent
+        self.series = 0
+        self.datapoints = 0
+
+    def charge(self, series: int, datapoints: int) -> None:
+        # record + propagate BEFORE checking own limits, so release() always
+        # returns exactly what the parent received
+        self.series += series
+        self.datapoints += datapoints
+        if self.parent is not None:
+            self.parent.charge(series, datapoints)
+        if 0 < self.limits.max_series < self.series:
+            raise QueryLimitError("series", self.series, self.limits.max_series)
+        if 0 < self.limits.max_datapoints < self.datapoints:
+            raise QueryLimitError(
+                "datapoints", self.datapoints, self.limits.max_datapoints
+            )
+
+    def release(self) -> None:
+        if self.parent is not None:
+            self.parent.release(self.series, self.datapoints)
+
+
+class GlobalEnforcer:
+    """Process-wide concurrent-cost ceiling (the global scope of the
+    chained enforcer): the sum over in-flight queries."""
+
+    def __init__(self, limits: QueryLimits) -> None:
+        self.limits = limits
+        self._lock = threading.Lock()
+        self.series = 0
+        self.datapoints = 0
+
+    def charge(self, series: int, datapoints: int) -> None:
+        with self._lock:
+            self.series += series
+            self.datapoints += datapoints
+            if 0 < self.limits.max_series < self.series:
+                raise QueryLimitError(
+                    "global series", self.series, self.limits.max_series
+                )
+            if 0 < self.limits.max_datapoints < self.datapoints:
+                raise QueryLimitError(
+                    "global datapoints", self.datapoints, self.limits.max_datapoints
+                )
+
+    def release(self, series: int, datapoints: int) -> None:
+        with self._lock:
+            self.series -= series
+            self.datapoints -= datapoints

@@ -11,6 +11,7 @@ ops the device can fuse.
 from __future__ import annotations
 
 import re as _re
+import threading
 from dataclasses import dataclass, replace
 from typing import Protocol
 
@@ -86,9 +87,19 @@ def consolidate(
 class Engine:
     """executor.Engine equivalent."""
 
-    def __init__(self, storage: Storage, lookback_nanos: int = DEFAULT_LOOKBACK) -> None:
+    def __init__(
+        self,
+        storage: Storage,
+        lookback_nanos: int = DEFAULT_LOOKBACK,
+        limits=None,
+        global_enforcer=None,
+    ) -> None:
         self.storage = storage
         self.lookback = lookback_nanos
+        # per-query cost limits (query/cost.py); None = unlimited
+        self.limits = limits
+        self.global_enforcer = global_enforcer
+        self._enforcer = threading.local()
 
     def query_range(
         self, query: str, start_nanos: int, end_nanos: int, step_nanos: int
@@ -99,7 +110,17 @@ class Engine:
         # @ start()/end() bind to the TOP-LEVEL query range, even inside
         # subqueries (prometheus PreprocessExpr)
         _bind_at(ast, bounds)
-        return self._eval(ast, bounds)
+        if self.limits is None:
+            return self._eval(ast, bounds)
+        from .cost import Enforcer
+
+        enforcer = Enforcer(self.limits, self.global_enforcer)
+        self._enforcer.current = enforcer
+        try:
+            return self._eval(ast, bounds)
+        finally:
+            self._enforcer.current = None
+            enforcer.release()
 
     def query_instant(self, query: str, time_nanos: int) -> Result:
         return self.query_range(query, time_nanos, time_nanos, NANOS)
@@ -113,6 +134,11 @@ class Engine:
         if sel.name:
             matchers.append(Matcher("__name__", "=", sel.name))
         raw = self.storage.fetch(matchers, start - self.lookback, end)
+        enforcer = getattr(self._enforcer, "current", None)
+        if enforcer is not None:
+            # charge fetched series + raw datapoints against the query's
+            # cost limits (query/cost.go block accounting)
+            enforcer.charge(len(raw), sum(len(t) for _, t, _ in raw))
         b = Bounds(start, bounds.step_nanos, bounds.steps + extra_steps)
         return consolidate(raw, b, self.lookback)
 

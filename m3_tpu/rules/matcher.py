@@ -1,0 +1,154 @@
+"""Rule matcher service: KV-watched rulesets with per-ID match caching.
+
+Reference: /root/reference/src/metrics/matcher/match.go (+ matcher/cache/) —
+the coordinator's downsampler doesn't call rulesets directly: a Matcher
+watches the rules namespaces key in KV, keeps per-namespace active rulesets
+hot, serves ForwardMatch from an LRU cache, and invalidates when a ruleset's
+version changes, so rule updates propagate without restarts.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..block.core import Tags
+from .rules import ActiveRuleSet, MatchResult, RuleSet
+
+NAMESPACES_KEY = "_rules/namespaces"
+
+
+def ruleset_key(namespace: str) -> str:
+    return f"_rules/ruleset/{namespace}"
+
+
+@dataclass
+class MatcherOptions:
+    cache_capacity: int = 100_000
+    namespaces_key: str = NAMESPACES_KEY
+
+
+class Matcher:
+    """matcher.Matcher: resolve (namespace, id tags, time) → MatchResult."""
+
+    def __init__(self, kv, opts: MatcherOptions | None = None) -> None:
+        self.kv = kv
+        self.opts = opts or MatcherOptions()
+        # RLock: a namespaces update subscribes rulesets (and replays their
+        # current values) while already holding the lock
+        self._lock = threading.RLock()
+        # namespace -> (ruleset version, RuleSet)
+        self._rulesets: dict[str, tuple[int, RuleSet]] = {}
+        self._active: dict[tuple, ActiveRuleSet] = {}
+        # (namespace, tags) -> MatchResult, LRU-bounded (matcher/cache)
+        self._cache: OrderedDict = OrderedDict()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.invalidations = 0
+        self._unsubs = []
+        self._watch_namespaces()
+
+    # -- KV wiring (matcher.go namespaces watch + per-namespace ruleset
+    # watches) --
+
+    def _watch_namespaces(self) -> None:
+        def on_namespaces(vv) -> None:
+            names = list(vv.value or [])
+            with self._lock:
+                for name in names:
+                    if name not in self._rulesets:
+                        self._rulesets[name] = (-1, RuleSet())
+                        self._subscribe_ruleset(name)
+                for gone in set(self._rulesets) - set(names):
+                    del self._rulesets[gone]
+                self._active.clear()
+                self._invalidate_locked()
+
+        self._unsubs.append(self.kv.watch(self.opts.namespaces_key, on_namespaces))
+        vv = self.kv.get(self.opts.namespaces_key)
+        if vv is not None:
+            on_namespaces(vv)
+
+    def _subscribe_ruleset(self, namespace: str) -> None:
+        key = ruleset_key(namespace)
+
+        def on_ruleset(vv) -> None:
+            rs = vv.value
+            if not isinstance(rs, RuleSet):
+                return
+            with self._lock:
+                cur = self._rulesets.get(namespace)
+                if cur is not None and cur[0] == vv.version:
+                    return
+                self._rulesets[namespace] = (vv.version, rs)
+                self._active.clear()
+                self._invalidate_locked()
+
+        self._unsubs.append(self.kv.watch(key, on_ruleset))
+        vv = self.kv.get(key)
+        if vv is not None:
+            on_ruleset(vv)
+
+    def _invalidate_locked(self) -> None:
+        self._cache.clear()
+        self.invalidations += 1
+
+    # -- matching --
+
+    def namespaces(self) -> list[str]:
+        with self._lock:
+            return sorted(self._rulesets)
+
+    def _cutover_epoch(self, rs: RuleSet, time_nanos: int) -> int:
+        """Number of rule cutovers at or before ``time_nanos`` — the active
+        set (and thus match results) only changes when this does, so caches
+        key on it instead of being time-blind (a rule with a future cutover
+        must activate once time passes it)."""
+        cutovers = sorted(
+            {r.cutover_nanos for r in rs.mapping_rules}
+            | {r.cutover_nanos for r in rs.rollup_rules}
+        )
+        epoch = 0
+        for c in cutovers:
+            if c <= time_nanos:
+                epoch += 1
+        return epoch
+
+    def match(self, namespace: str, tags: Tags, time_nanos: int) -> MatchResult:
+        with self._lock:
+            entry = self._rulesets.get(namespace)
+            rs = entry[1] if entry else RuleSet()
+            epoch = self._cutover_epoch(rs, time_nanos)
+            key = (namespace, epoch, tags)
+            cached = self._cache.get(key)
+            if cached is not None:
+                self._cache.move_to_end(key)
+                self.cache_hits += 1
+                return cached
+            self.cache_misses += 1
+            active = self._active.get((namespace, epoch))
+            if active is None:
+                active = rs.active_at(time_nanos)
+                self._active[(namespace, epoch)] = active
+            result = active.forward_match(tags)
+            self._cache[key] = result
+            while len(self._cache) > self.opts.cache_capacity:
+                self._cache.popitem(last=False)
+            return result
+
+    def close(self) -> None:
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs = []
+
+
+def set_namespaces(kv, names: list[str]) -> None:
+    """Admin helper: publish the rules namespaces list."""
+    kv.set(NAMESPACES_KEY, list(names))
+
+
+def set_ruleset(kv, namespace: str, ruleset: RuleSet) -> None:
+    """Admin helper: publish a namespace's ruleset (bumps the KV version,
+    which invalidates every matcher's cache)."""
+    kv.set(ruleset_key(namespace), ruleset)

@@ -47,6 +47,7 @@ class Coordinator:
         downsampler: Downsampler | None = None,
         kv: KVStore | None = None,
         base_dir: str | None = None,
+        query_limits=None,
     ) -> None:
         import tempfile
 
@@ -55,7 +56,22 @@ class Coordinator:
             db.create_namespace(namespace, NamespaceOptions())
         self.db = db
         self.namespace = namespace
-        self.engine = Engine(M3Storage(db, namespace))
+        global_enforcer = None
+        if query_limits is not None:
+            from ..query.cost import GlobalEnforcer, QueryLimits
+
+            # global ceiling defaults to 10x the per-query scope (x/cost)
+            global_enforcer = GlobalEnforcer(
+                QueryLimits(
+                    max_series=query_limits.max_series * 10,
+                    max_datapoints=query_limits.max_datapoints * 10,
+                )
+            )
+        self.engine = Engine(
+            M3Storage(db, namespace),
+            limits=query_limits,
+            global_enforcer=global_enforcer,
+        )
         self.downsampler = downsampler
         self.kv = kv or KVStore()
         self.placement_svc = PlacementService(self.kv)
@@ -180,6 +196,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/health":
                 self._json({"ok": True})
+            elif url.path == "/metrics":
+                from ..utils.instrument import DEFAULT as METRICS
+
+                self._send(
+                    200, METRICS.expose().encode(), ctype="text/plain; version=0.0.4"
+                )
             elif url.path == "/api/v1/query_range":
                 self._json(
                     c.query_range(
@@ -200,8 +222,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(p.to_dict() if p else {}, 200 if p else 404)
             else:
                 self._json({"error": "not found"}, 404)
-        except Exception as exc:  # surface handler errors as 400s
-            self._json({"status": "error", "error": str(exc)}, 400)
+        except Exception as exc:  # surface handler errors as 4xx
+            from ..query.cost import QueryLimitError
+
+            code = 422 if isinstance(exc, QueryLimitError) else 400
+            self._json({"status": "error", "error": str(exc)}, code)
 
     def do_POST(self) -> None:
         c = self.coordinator
@@ -268,10 +293,102 @@ def _parse_step(s: str) -> float:
     return float(m.group(1)) * mult
 
 
-def serve(coordinator: Coordinator, port: int = 0) -> tuple[ThreadingHTTPServer, int]:
+# --- service binary (cmd/services/m3coordinator/main) ---
+
+from dataclasses import dataclass as _dataclass, field as _dc_field
+
+
+@_dataclass
+class LimitsConfig:
+    max_series: int = 0
+    max_datapoints: int = 0
+
+
+@_dataclass
+class CoordinatorConfig:
+    """YAML schema for the coordinator binary (utils/config.py loader)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    namespace: str = "default"
+    base_dir: str = ""
+    num_shards: int = 4
+    limits: LimitsConfig = _dc_field(default_factory=LimitsConfig)
+
+
+def main(argv=None) -> int:
+    """Runnable coordinator process:
+
+        python -m m3_tpu.services.coordinator --port 7201 --base-dir /data
+
+    or with a YAML config (utils/config.py schema = CoordinatorConfig):
+
+        python -m m3_tpu.services.coordinator --config coordinator.yml
+
+    Prints ``LISTENING <host> <port>`` once serving.
+    """
+    import argparse
+    import signal
+
+    from ..query.cost import QueryLimits
+    from ..utils.config import load_config
+
+    p = argparse.ArgumentParser(prog="m3tpu-coordinator")
+    p.add_argument("--config", default="")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--base-dir", default=None)
+    p.add_argument("--namespace", default=None)
+    args = p.parse_args(argv)
+
+    cfg = load_config(CoordinatorConfig, args.config) if args.config else CoordinatorConfig()
+    host = args.host if args.host is not None else cfg.host
+    port = args.port if args.port is not None else cfg.port
+    base_dir = args.base_dir if args.base_dir is not None else (cfg.base_dir or None)
+    namespace = args.namespace if args.namespace is not None else cfg.namespace
+
+    db = None
+    if base_dir:
+        db = Database(base_dir, num_shards=cfg.num_shards)
+        db.create_namespace(namespace, NamespaceOptions())
+        db.bootstrap()
+    limits = None
+    if cfg.limits.max_series or cfg.limits.max_datapoints:
+        limits = QueryLimits(
+            max_series=cfg.limits.max_series,
+            max_datapoints=cfg.limits.max_datapoints,
+        )
+    coord = Coordinator(db=db, namespace=namespace, query_limits=limits)
+    server, bound = serve(coord, port, host=host)
+
+    def shutdown(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    print(f"LISTENING {host} {bound}", flush=True)
+    try:
+        # serve() already runs the accept loop on a daemon thread; a second
+        # serve_forever() here would race it on the same socket. Park until
+        # a signal raises SystemExit.
+        threading.Event().wait()
+    finally:
+        server.shutdown()
+        coord.db.close()
+    return 0
+
+
+
+def serve(
+    coordinator: Coordinator, port: int = 0, host: str = "127.0.0.1"
+) -> tuple[ThreadingHTTPServer, int]:
     """Start the HTTP server on a background thread; returns (server, port)."""
     handler = type("BoundHandler", (_Handler,), {"coordinator": coordinator})
-    srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    srv = ThreadingHTTPServer((host, port), handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

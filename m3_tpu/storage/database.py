@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 from ..codec.m3tsz import Datapoint, decode
 from ..utils.hash import shard_for
+from ..utils.instrument import DEFAULT as METRICS
 from ..utils.serialize import decode_tags, is_tag_id
 from ..utils.xtime import Unit
 from .commitlog import CommitLog, CommitLogEntry
@@ -36,6 +37,10 @@ from .snapshot import read_latest_snapshot, remove_snapshots, write_snapshot
 class ColdWriteError(ValueError):
     """Write into a flushed block while cold writes are disabled
     (dbnode m3dberrors.ErrColdWritesNotEnabled)."""
+
+
+class NewSeriesLimitError(RuntimeError):
+    """New-series insert rate limit hit (kvconfig insert limit)."""
 
 
 @dataclass
@@ -232,6 +237,13 @@ class Database:
         self.commitlog_enabled = commitlog_enabled
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
+        # self-observability (x/instrument role)
+        self._m_writes = METRICS.counter("db_writes_total", "datapoint writes")
+        self._m_reads = METRICS.counter("db_reads_total", "series reads")
+        self._m_write_errors = METRICS.counter("db_write_errors_total")
+        # new-series insert rate limit (runtime options; 0 = unlimited)
+        self._new_series_limit = 0
+        self._new_series_window = (0, 0)  # (second, count)
         # Serializes write/read/flush across request threads — the reference
         # guards these paths with per-shard locks (shard.go RLock/Lock); a
         # single re-entrant lock is the current granularity.
@@ -253,9 +265,18 @@ class Database:
     ) -> None:
         with self.lock:
             namespace = self.namespaces[ns]
+            shard = namespace.shard_for(sid)
+            is_new = self._check_new_series(shard, sid)
             # buffer first so rejected writes (ColdWriteError) never reach the
             # WAL — a logged-but-unacceptable entry would poison replay
-            namespace.shard_for(sid).write(sid, t_nanos, value, unit)
+            try:
+                shard.write(sid, t_nanos, value, unit)
+            except Exception:
+                self._m_write_errors.inc()
+                raise
+            if is_new and self._new_series_limit > 0:
+                self._consume_new_series()
+            self._m_writes.inc()
             cl = self._commitlogs.get(ns)
             if cl is not None:
                 cl.write(CommitLogEntry(sid, t_nanos, value, unit))
@@ -268,15 +289,52 @@ class Database:
             for sid, t, v in entries:
                 namespace.shard_for(sid).check_write(t)
             for sid, t, v in entries:
-                namespace.shard_for(sid).write(sid, t, v)
+                shard = namespace.shard_for(sid)
+                is_new = self._check_new_series(shard, sid)
+                shard.write(sid, t, v)
+                if is_new and self._new_series_limit > 0:
+                    self._consume_new_series()
+                self._m_writes.inc()
             cl = self._commitlogs.get(ns)
             if cl is not None:
                 cl.write_batch(
                     [CommitLogEntry(sid, t, v) for sid, t, v in entries]
                 )
 
+    def apply_runtime_options(self, ro) -> None:
+        """storage/runtime.py listener target: live-tunable node knobs."""
+        with self.lock:
+            self._new_series_limit = int(ro.write_new_series_limit_per_sec)
+
+    def _check_new_series(self, shard: Shard, sid: bytes) -> bool:
+        """ClusterNewSeriesInsertLimit (kvconfig): cap NEW series creations
+        per second across the node; existing-series writes are unaffected.
+        Returns whether the write WOULD create a series; the token is only
+        consumed after the write succeeds (_consume_new_series), so rejected
+        writes don't burn quota."""
+        is_new = sid not in shard.series
+        if self._new_series_limit <= 0 or not is_new:
+            return is_new
+        import time as _time
+
+        now_s = int(_time.monotonic())
+        sec, count = self._new_series_window
+        if sec != now_s:
+            sec, count = now_s, 0
+            self._new_series_window = (sec, count)
+        if count >= self._new_series_limit:
+            raise NewSeriesLimitError(
+                f"new series insert limit {self._new_series_limit}/s exceeded"
+            )
+        return True
+
+    def _consume_new_series(self) -> None:
+        sec, count = self._new_series_window
+        self._new_series_window = (sec, count + 1)
+
     def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
         with self.lock:
+            self._m_reads.inc()
             return self.namespaces[ns].shard_for(sid).read(sid, start, end)
 
     # --- tagged write / index query path (database.go:606 WriteTagged,

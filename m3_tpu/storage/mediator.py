@@ -35,10 +35,20 @@ class MediatorOptions:
 class Mediator:
     """Drives tick → flush → snapshot for one Database."""
 
-    def __init__(self, db, opts: MediatorOptions | None = None, clock=time.time_ns):
+    def __init__(
+        self,
+        db,
+        opts: MediatorOptions | None = None,
+        clock=time.time_ns,
+        runtime=None,
+    ):
         self.db = db
         self.opts = opts or MediatorOptions()
         self.clock = clock
+        if runtime is not None:
+            # live reconfig (storage/runtime.py): cadence updates apply on
+            # the next pass
+            runtime.watch(self._apply_runtime)
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._last_tick = 0
@@ -48,6 +58,12 @@ class Mediator:
         self.runs = 0
         self.errors = 0
         self.last_error: BaseException | None = None
+
+    def _apply_runtime(self, ro) -> None:
+        self.opts.tick_interval_nanos = int(ro.tick_interval_secs * NANOS)
+        self.opts.flush_interval_nanos = int(ro.flush_interval_secs * NANOS)
+        self.opts.snapshot_interval_nanos = int(ro.snapshot_interval_secs * NANOS)
+        self.opts.buffer_past_nanos = int(ro.buffer_past_secs * NANOS)
 
     # -- one deterministic pass (tests call this with a fake now) --
 

@@ -1,0 +1,82 @@
+"""Runtime options: KV-watched live reconfiguration of a running node.
+
+Reference: /root/reference/src/dbnode/runtime/runtime_options_manager.go +
+src/dbnode/kvconfig/keys.go — operators flip node behavior (tick/flush
+cadence, write limits) through the cluster KV without restarts; components
+register listeners and apply changes on the next pass.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, replace
+
+RUNTIME_KEY = "_runtime/options"
+
+
+@dataclass(frozen=True)
+class RuntimeOptions:
+    """The live-tunable subset (runtime/types.go Options)."""
+
+    tick_interval_secs: float = 10.0
+    flush_interval_secs: float = 60.0
+    snapshot_interval_secs: float = 60.0
+    buffer_past_secs: float = 600.0
+    # max NEW series insertions per second, 0 = unlimited
+    # (kvconfig ClusterNewSeriesInsertLimit)
+    write_new_series_limit_per_sec: int = 0
+
+
+class RuntimeOptionsManager:
+    """options manager + kvconfig watch: get() is always current; listeners
+    fire on every KV update."""
+
+    def __init__(self, kv, defaults: RuntimeOptions | None = None) -> None:
+        self.kv = kv
+        self._lock = threading.Lock()
+        self._current = defaults or RuntimeOptions()
+        self._from_kv = False  # becomes True after a real KV update
+        self._listeners: list = []
+        self._unsub = kv.watch(RUNTIME_KEY, self._on_update)
+        vv = kv.get(RUNTIME_KEY)
+        if vv is not None:
+            self._on_update(vv)
+
+    def _on_update(self, vv) -> None:
+        data = vv.value
+        if not isinstance(data, dict):
+            return
+        with self._lock:
+            known = {
+                k: v for k, v in data.items() if hasattr(self._current, k)
+            }
+            self._current = replace(self._current, **known)
+            self._from_kv = True
+            listeners = list(self._listeners)
+            current = self._current
+        for fn in listeners:
+            fn(current)
+
+    def get(self) -> RuntimeOptions:
+        with self._lock:
+            return self._current
+
+    def watch(self, fn) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+            replay = self._from_kv
+        if replay:
+            # replay only options that actually came from KV — firing the
+            # built-in defaults would clobber a caller's explicit config
+            fn(self.get())
+
+    def close(self) -> None:
+        self._unsub()
+
+
+def set_runtime_options(kv, **updates) -> None:
+    """Admin helper: merge updates into the runtime options KV key."""
+    vv = kv.get(RUNTIME_KEY)
+    cur = dict(vv.value) if vv and isinstance(vv.value, dict) else {}
+    cur.update(updates)
+    kv.set(RUNTIME_KEY, cur)

@@ -1,0 +1,126 @@
+"""Config system: YAML → typed dataclass configs with env interpolation.
+
+Reference: /root/reference/src/x/config/config.go — services load YAML with
+``${ENV_VAR:default}`` expansion, strict unknown-key detection, and
+validation, into per-service config structs (cmd/services/*/config). Here
+the schema IS a dataclass tree: nested dataclasses map to nested mappings,
+unknown keys raise, missing keys use dataclass defaults (required fields
+without defaults raise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any, get_args, get_origin, get_type_hints
+
+import yaml
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::([^}]*))?\}")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _interpolate(text: str) -> str:
+    def repl(m: re.Match) -> str:
+        name, default = m.group(1), m.group(2)
+        val = os.environ.get(name)
+        if val is None:
+            if default is None:
+                raise ConfigError(f"environment variable {name} is not set")
+            return default
+        return val
+
+    return _ENV_RE.sub(repl, text)
+
+
+def _coerce(value: Any, typ: Any, path: str) -> Any:
+    if dataclasses.is_dataclass(typ):
+        if value is None:
+            value = {}
+        if not isinstance(value, dict):
+            raise ConfigError(f"{path}: expected a mapping, got {type(value).__name__}")
+        return _build(typ, value, path)
+    origin = get_origin(typ)
+    if origin in (list, tuple):
+        if value is None:
+            return origin()
+        if not isinstance(value, (list, tuple)):
+            raise ConfigError(f"{path}: expected a list")
+        (item_t, *_rest) = get_args(typ) or (Any,)
+        out = [
+            _coerce(v, item_t, f"{path}[{i}]") for i, v in enumerate(value)
+        ]
+        return tuple(out) if origin is tuple else out
+    if origin is dict:
+        return dict(value or {})
+    # Optional[X] / unions: try each member
+    if origin is not None and str(origin) in ("typing.Union", "<class 'types.UnionType'>"):
+        last_err = None
+        for member in get_args(typ):
+            if member is type(None):
+                if value is None:
+                    return None
+                continue
+            try:
+                return _coerce(value, member, path)
+            except (ConfigError, TypeError, ValueError) as exc:
+                last_err = exc
+        raise ConfigError(f"{path}: no union member matched ({last_err})")
+    if typ is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        raise ConfigError(f"{path}: expected a bool")
+    if typ in (int, float, str):
+        try:
+            return typ(value)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                f"{path}: expected {typ.__name__}, got {value!r}"
+            ) from None
+    return value
+
+
+def _build(cls, data: dict, path: str = ""):
+    hints = get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ConfigError(
+            f"{path or cls.__name__}: unknown keys {sorted(unknown)} "
+            f"(known: {sorted(fields)})"
+        )
+    kwargs = {}
+    for name, f in fields.items():
+        sub_path = f"{path}.{name}" if path else name
+        if name in data:
+            kwargs[name] = _coerce(data[name], hints[name], sub_path)
+        elif (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+        ):
+            raise ConfigError(f"{sub_path}: required key missing")
+    obj = cls(**kwargs)
+    validate = getattr(obj, "validate", None)
+    if callable(validate):
+        validate()
+    return obj
+
+
+def load_config(cls, path: str):
+    """Read a YAML file into the dataclass ``cls`` with env interpolation."""
+    with open(path) as f:
+        text = f.read()
+    return loads_config(cls, text)
+
+
+def loads_config(cls, text: str):
+    data = yaml.safe_load(_interpolate(text)) or {}
+    if not isinstance(data, dict):
+        raise ConfigError("top-level config must be a mapping")
+    return _build(cls, data)

@@ -1,0 +1,150 @@
+"""Instrumentation: process metrics registry with Prometheus exposition.
+
+Reference: /root/reference/src/x/instrument/ — every service carries an
+instrument.Options scope emitting counters/gauges/timers about itself
+(tally → Prometheus). Here: a Registry of Counter/Gauge/Histogram handles
+with label sets, rendered in the Prometheus text format by services'
+/metrics endpoints (coordinator HTTP route, dbnode RPC op).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10
+)
+
+
+class Histogram:
+    def __init__(self, buckets=DEFAULT_BUCKETS) -> None:
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self.counts[i] += 1
+            self.sum += v
+            self.total += 1
+
+
+@dataclass
+class _Family:
+    kind: str  # counter | gauge | histogram
+    help: str
+    children: dict = field(default_factory=dict)  # labels tuple -> metric
+
+
+class Registry:
+    """tally.Scope-equivalent: named metric families with label children."""
+
+    def __init__(self, prefix: str = "") -> None:
+        self.prefix = prefix
+        self._fams: dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def _family(self, name: str, kind: str, help_: str) -> _Family:
+        with self._lock:
+            fam = self._fams.get(name)
+            if fam is None:
+                fam = _Family(kind, help_)
+                self._fams[name] = fam
+            elif fam.kind != kind:
+                raise ValueError(f"metric {name} already registered as {fam.kind}")
+            return fam
+
+    def _child(self, name: str, kind: str, help_: str, labels: dict | None, ctor):
+        fam = self._family(name, kind, help_)
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            child = fam.children.get(key)
+            if child is None:
+                child = ctor()
+                fam.children[key] = child
+            return child
+
+    def counter(self, name: str, help: str = "", labels: dict | None = None) -> Counter:
+        return self._child(name, "counter", help, labels, Counter)
+
+    def gauge(self, name: str, help: str = "", labels: dict | None = None) -> Gauge:
+        return self._child(name, "gauge", help, labels, Gauge)
+
+    def histogram(
+        self, name: str, help: str = "", labels: dict | None = None, buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._child(
+            name, "histogram", help, labels, lambda: Histogram(buckets)
+        )
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            fams = {
+                n: (f.kind, f.help, dict(f.children))
+                for n, f in sorted(self._fams.items())
+            }
+        for name, (kind, help_, children) in fams.items():
+            full = f"{self.prefix}{name}"
+            if help_:
+                lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} {kind}")
+            for labels, m in sorted(children.items()):
+                ls = _fmt_labels(labels)
+                if kind in ("counter", "gauge"):
+                    lines.append(f"{full}{ls} {m.value}")
+                else:
+                    acc = 0
+                    for b, c in zip(m.buckets, m.counts):
+                        acc += c
+                        lb = tuple(list(labels) + [("le", repr(float(b)))])
+                        lines.append(f"{full}_bucket{_fmt_labels(lb)} {acc}")
+                    lb = tuple(list(labels) + [("le", "+Inf")])
+                    lines.append(f"{full}_bucket{_fmt_labels(lb)} {m.total}")
+                    lines.append(f"{full}_sum{ls} {m.sum}")
+                    lines.append(f"{full}_count{ls} {m.total}")
+        return "\n".join(lines) + "\n"
+
+
+# the process-default registry (instrument.NewOptions default scope)
+DEFAULT = Registry(prefix="m3tpu_")

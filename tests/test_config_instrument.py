@@ -1,0 +1,207 @@
+"""Config system (x/config), instrumentation (x/instrument), and runtime
+reconfiguration (dbnode/runtime + kvconfig) tests."""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.utils.config import ConfigError, loads_config
+from m3_tpu.utils.instrument import Registry
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+
+# --- config ---
+
+
+@dataclasses.dataclass
+class _Inner:
+    port: int = 7201
+    hosts: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _Cfg:
+    name: str
+    inner: _Inner = dataclasses.field(default_factory=_Inner)
+    ratio: float = 0.5
+    debug: bool = False
+
+    def validate(self):
+        if not (0 <= self.ratio <= 1):
+            raise ConfigError("ratio must be within [0, 1]")
+
+
+def test_config_nested_and_defaults():
+    cfg = loads_config(_Cfg, "name: svc\ninner:\n  port: 9000\n  hosts: [a, b]\n")
+    assert cfg.name == "svc" and cfg.inner.port == 9000
+    assert cfg.inner.hosts == ["a", "b"] and cfg.ratio == 0.5
+
+
+def test_config_env_interpolation(monkeypatch):
+    monkeypatch.setenv("M3_PORT", "1234")
+    cfg = loads_config(_Cfg, "name: svc\ninner: {port: '${M3_PORT}'}\n")
+    assert cfg.inner.port == 1234
+    cfg = loads_config(_Cfg, "name: '${MISSING_VAR:fallback}'\n")
+    assert cfg.name == "fallback"
+    with pytest.raises(ConfigError):
+        loads_config(_Cfg, "name: '${MISSING_VAR_NO_DEFAULT}'\n")
+
+
+def test_config_unknown_key_and_required_and_validate():
+    with pytest.raises(ConfigError, match="unknown keys"):
+        loads_config(_Cfg, "name: x\nbogus: 1\n")
+    with pytest.raises(ConfigError, match="required"):
+        loads_config(_Cfg, "ratio: 0.2\n")
+    with pytest.raises(ConfigError, match="ratio"):
+        loads_config(_Cfg, "name: x\nratio: 2.0\n")
+    with pytest.raises(ConfigError, match="expected bool|expected a bool"):
+        loads_config(_Cfg, "name: x\ndebug: [1]\n")
+    assert loads_config(_Cfg, "name: x\ndebug: 'true'\n").debug is True
+
+
+# --- instrument ---
+
+
+def test_registry_counters_gauges_histograms():
+    reg = Registry(prefix="t_")
+    reg.counter("reqs_total", "requests", {"op": "write"}).inc(3)
+    reg.counter("reqs_total", labels={"op": "read"}).inc()
+    reg.gauge("temp").set(21.5)
+    h = reg.histogram("latency_secs", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 't_reqs_total{op="write"} 3.0' in text
+    assert 't_reqs_total{op="read"} 1.0' in text
+    assert "t_temp 21.5" in text
+    assert 't_latency_secs_bucket{le="0.1"} 1' in text
+    assert 't_latency_secs_bucket{le="1.0"} 2' in text
+    assert 't_latency_secs_bucket{le="+Inf"} 3' in text
+    assert "t_latency_secs_count 3" in text
+
+
+def test_metrics_flow_to_coordinator_endpoint(tmp_path):
+    from m3_tpu.block.core import make_tags
+    from m3_tpu.services.coordinator import Coordinator, serve
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions())
+    db.write_tagged("default", make_tags({"__name__": "x"}), T0, 1.0)
+    coord = Coordinator(db=db)
+    server, port = serve(coord, 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ).read().decode()
+        assert "m3tpu_db_writes_total" in text
+    finally:
+        server.shutdown()
+
+
+def test_node_rpc_metrics_op(tmp_path):
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.net.server import NodeServer, NodeService
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("default", NamespaceOptions())
+    db.bootstrap()
+    server = NodeServer(NodeService(db, node_id="n"))
+    server.start()
+    client = RemoteNode("127.0.0.1", server.port)
+    try:
+        client.write("default", b"s", T0, 1.0)
+        text = client._call("metrics")
+        assert "m3tpu_rpc_requests_total" in text
+        assert 'op="write"' in text
+    finally:
+        client.close()
+        server.stop()
+        db.close()
+
+
+# --- runtime reconfig ---
+
+
+def test_runtime_options_manager_watch_and_apply(tmp_path):
+    from m3_tpu.storage.database import Database, NamespaceOptions, NewSeriesLimitError
+    from m3_tpu.storage.mediator import Mediator, MediatorOptions
+    from m3_tpu.storage.runtime import (
+        RuntimeOptions,
+        RuntimeOptionsManager,
+        set_runtime_options,
+    )
+
+    kv = KVStore()
+    mgr = RuntimeOptionsManager(kv, RuntimeOptions())
+    assert mgr.get().flush_interval_secs == 60.0
+
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", NamespaceOptions())
+    db.bootstrap()
+    med = Mediator(db, MediatorOptions(), runtime=mgr)
+    mgr.watch(db.apply_runtime_options)
+
+    # flip cadence + new-series limit through KV: applied live, no restart
+    set_runtime_options(
+        kv, flush_interval_secs=5.0, write_new_series_limit_per_sec=2
+    )
+    assert med.opts.flush_interval_nanos == 5 * NANOS
+    db.write("ns", b"a", T0, 1.0)
+    db.write("ns", b"b", T0, 1.0)
+    with pytest.raises(NewSeriesLimitError):
+        db.write("ns", b"c", T0, 1.0)
+    # existing series still writable under the limit
+    db.write("ns", b"a", T0 + NANOS, 2.0)
+    # lift the limit
+    set_runtime_options(kv, write_new_series_limit_per_sec=0)
+    db.write("ns", b"c", T0, 1.0)
+    db.close()
+
+
+def test_coordinator_binary_with_yaml_config(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    cfg = tmp_path / "coordinator.yml"
+    cfg.write_text(
+        "port: 0\nnamespace: default\n"
+        f"base_dir: {tmp_path / 'data'}\n"
+        "limits:\n  max_series: 100\n"
+    )
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "m3_tpu.services.coordinator", "--config", str(cfg)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=repo,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("LISTENING"), line
+        _, host, port = line.split()
+        health = json.load(
+            urllib.request.urlopen(f"http://{host}:{port}/health")
+        )
+        assert health["ok"] is True
+        text = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics"
+        ).read().decode()
+        assert "m3tpu_" in text
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

@@ -1,0 +1,175 @@
+"""Rule matcher service (metrics/matcher/match.go semantics) and per-query
+cost limits (query/cost + x/cost semantics)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from m3_tpu.block.core import make_tags
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.rules.filters import TagsFilter
+from m3_tpu.rules.matcher import Matcher, set_namespaces, set_ruleset
+from m3_tpu.rules.rules import MappingRule, RuleSet
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+
+def _ruleset(policy="10s:2d", pattern="name:cpu*"):
+    return RuleSet(
+        mapping_rules=[
+            MappingRule(
+                name="cpu",
+                filter=TagsFilter.parse(pattern),
+                policies=(StoragePolicy.parse(policy),),
+            )
+        ]
+    )
+
+
+def test_matcher_watches_namespaces_and_rulesets():
+    kv = KVStore()
+    set_namespaces(kv, ["agg_ns"])
+    set_ruleset(kv, "agg_ns", _ruleset())
+    m = Matcher(kv)
+    assert m.namespaces() == ["agg_ns"]
+    tags = make_tags({"name": "cpu.user", "host": "a"})
+    res = m.match("agg_ns", tags, T0)
+    assert [str(p) for p in res.policies] == ["10s:2d"]
+    # unmatched tags produce an empty result
+    other = make_tags({"name": "mem", "host": "a"})
+    assert m.match("agg_ns", other, T0).policies == ()
+
+
+def test_matcher_cache_hit_and_invalidation_on_rule_update():
+    kv = KVStore()
+    set_namespaces(kv, ["ns"])
+    set_ruleset(kv, "ns", _ruleset("10s:2d"))
+    m = Matcher(kv)
+    tags = make_tags({"name": "cpu.sys"})
+    r1 = m.match("ns", tags, T0)
+    r2 = m.match("ns", tags, T0)
+    assert r1 is r2 and m.cache_hits == 1
+    # publishing a new ruleset version invalidates the cache and the new
+    # rules take effect without any matcher restart
+    set_ruleset(kv, "ns", _ruleset("1m0s:40d"))
+    r3 = m.match("ns", tags, T0)
+    assert [str(p) for p in r3.policies] == ["1m:40d"]
+    assert m.invalidations >= 2
+
+
+def test_matcher_lru_capacity():
+    from m3_tpu.rules.matcher import MatcherOptions
+
+    kv = KVStore()
+    set_namespaces(kv, ["ns"])
+    set_ruleset(kv, "ns", _ruleset())
+    m = Matcher(kv, MatcherOptions(cache_capacity=4))
+    for i in range(10):
+        m.match("ns", make_tags({"name": f"cpu{i}"}), T0)
+    assert len(m._cache) == 4
+
+
+def test_matcher_namespace_removal():
+    kv = KVStore()
+    set_namespaces(kv, ["a", "b"])
+    set_ruleset(kv, "a", _ruleset())
+    m = Matcher(kv)
+    assert m.namespaces() == ["a", "b"]
+    set_namespaces(kv, ["b"])
+    assert m.namespaces() == ["b"]
+    # removed namespace matches as empty
+    assert m.match("a", make_tags({"name": "cpu"}), T0).policies == ()
+
+
+def test_matcher_future_cutover_activates_with_time():
+    """A rule with a future cutover must start matching once time passes it,
+    despite the per-ID cache (active sets key on the cutover epoch)."""
+    rule = MappingRule(
+        name="cpu",
+        filter=TagsFilter.parse("name:cpu*"),
+        policies=(StoragePolicy.parse("10s:2d"),),
+        cutover_nanos=T0 + 100 * NANOS,
+    )
+    kv = KVStore()
+    set_namespaces(kv, ["ns"])
+    set_ruleset(kv, "ns", RuleSet(mapping_rules=[rule]))
+    m = Matcher(kv)
+    tags = make_tags({"name": "cpu.user"})
+    assert m.match("ns", tags, T0).policies == ()
+    assert m.match("ns", tags, T0).policies == ()  # cached pre-cutover
+    after = m.match("ns", tags, T0 + 200 * NANOS)
+    assert [str(p) for p in after.policies] == ["10s:2d"]
+    # both epochs stay independently cached
+    assert m.match("ns", tags, T0 + 50 * NANOS).policies == ()
+
+
+# --- cost limits ---
+
+
+def test_enforcer_limits_and_global_release():
+    from m3_tpu.query.cost import Enforcer, GlobalEnforcer, QueryLimitError, QueryLimits
+
+    glob = GlobalEnforcer(QueryLimits(max_series=100))
+    e = Enforcer(QueryLimits(max_series=10), glob)
+    e.charge(8, 100)
+    with pytest.raises(QueryLimitError):
+        e.charge(5, 0)
+    e.release()
+    assert glob.series == 0  # released even after the failure
+
+
+def test_engine_enforces_series_limit(tmp_path):
+    from m3_tpu.query.cost import QueryLimitError, QueryLimits
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions())
+    for i in range(8):
+        tags = make_tags({"__name__": "req", "host": f"h{i}"})
+        db.write_tagged("default", tags, T0 + NANOS, float(i))
+    storage = M3Storage(db, "default")
+    limited = Engine(storage, limits=QueryLimits(max_series=4))
+    with pytest.raises(QueryLimitError):
+        limited.query_range("req", T0, T0 + 60 * NANOS, 10 * NANOS)
+    # under the limit passes, and limits reset per query
+    ok = Engine(storage, limits=QueryLimits(max_series=16))
+    for _ in range(3):
+        r = ok.query_range("req", T0, T0 + 60 * NANOS, 10 * NANOS)
+        assert len(r.metas) == 8
+
+
+def test_coordinator_returns_422_on_limit(tmp_path):
+    import threading
+
+    from m3_tpu.query.cost import QueryLimits
+    from m3_tpu.services.coordinator import Coordinator, serve
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions())
+    for i in range(6):
+        tags = make_tags({"__name__": "req", "host": f"h{i}"})
+        db.write_tagged("default", tags, T0 + NANOS, float(i))
+    coord = Coordinator(db=db, query_limits=QueryLimits(max_series=2))
+    server, port = serve(coord, 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = (
+            f"http://127.0.0.1:{port}/api/v1/query_range?query=req"
+            f"&start={T0 // NANOS}&end={T0 // NANOS + 60}&step=10"
+        )
+        try:
+            urllib.request.urlopen(url)
+            code = 200
+        except urllib.error.HTTPError as err:
+            code = err.code
+            body = json.load(err)
+        assert code == 422
+        assert "limit" in body["error"]
+    finally:
+        server.shutdown()
