@@ -1,0 +1,97 @@
+"""Carbon plaintext ingest: ``metric.path value timestamp\\n`` over TCP.
+
+Reference: /root/reference/src/cmd/services/m3coordinator/ingest/carbon/
+ingest.go — lines parse into (path, value, unix seconds); paths store as
+per-node tagged series (paths.py) so the graphite engine and PromQL can
+both query them. Malformed lines are counted and skipped, never fatal.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+from ..utils.instrument import DEFAULT as METRICS
+from .paths import path_to_tags
+
+NANOS = 1_000_000_000
+
+
+def parse_line(line: bytes):
+    """→ (path, value, time_nanos) or None for blank/comment lines."""
+    line = line.strip()
+    if not line or line.startswith(b"#"):
+        return None
+    parts = line.split()
+    if len(parts) != 3:
+        raise ValueError(f"carbon: expected 3 fields, got {len(parts)}")
+    path = parts[0].decode()
+    value = float(parts[1])
+    ts = float(parts[2])
+    return path, value, int(ts * NANOS)
+
+
+class CarbonIngestServer:
+    """Line-oriented TCP listener feeding Database.write_tagged."""
+
+    def __init__(
+        self, db, namespace: str = "graphite", host: str = "127.0.0.1", port: int = 0
+    ) -> None:
+        self.db = db
+        self.namespace = namespace
+        self.received = 0
+        self.malformed = 0
+        outer = self
+        m_recv = METRICS.counter("carbon_lines_total", "carbon lines ingested")
+        m_bad = METRICS.counter("carbon_malformed_total")
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    try:
+                        parsed = parse_line(raw)
+                    except ValueError:
+                        outer.malformed += 1
+                        m_bad.inc()
+                        continue
+                    if parsed is None:
+                        continue
+                    path, value, t_nanos = parsed
+                    try:
+                        outer.db.write_tagged(
+                            outer.namespace, path_to_tags(path), t_nanos, value
+                        )
+                        outer.received += 1
+                        m_recv.inc()
+                    except Exception:
+                        outer.malformed += 1
+                        m_bad.inc()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="m3tpu-carbon", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def send_lines(host: str, port: int, lines: list[str]) -> None:
+    """Test/client helper: push plaintext lines at a carbon listener."""
+    with socket.create_connection((host, port), timeout=10) as sock:
+        payload = "".join(l if l.endswith("\n") else l + "\n" for l in lines)
+        sock.sendall(payload.encode())

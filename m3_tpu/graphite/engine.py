@@ -1,0 +1,132 @@
+"""Graphite render engine: targets → evaluated series over storage.
+
+Reference: /root/reference/src/query/graphite/native/ — compile the target
+expression, fetch path-matched series from tagged storage (per-node
+``__gN__`` tags, storage/converter.go), consolidate onto the step grid,
+and apply the function pipeline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..block.core import Bounds
+from ..query.engine import consolidate
+from .functions import FUNCS, Context, GSeries, parse_interval
+from .parser import Bool, Call, Number, PathExpr, String, parse
+from .paths import pattern_to_query, tags_to_path
+
+NANOS = 1_000_000_000
+DEFAULT_LOOKBACK = 5 * 60 * NANOS
+
+
+@dataclass
+class GraphiteEngine:
+    db: object
+    namespace: str = "graphite"
+    lookback_nanos: int = DEFAULT_LOOKBACK
+
+    def render(
+        self, target: str, start_nanos: int, end_nanos: int, step_nanos: int
+    ) -> list[GSeries]:
+        steps = max(int((end_nanos - start_nanos) // step_nanos), 1)
+        ctx = Context(start_nanos, step_nanos, steps)
+        ast = parse(target)
+        return self._eval(ast, ctx, shift_nanos=0)
+
+    def find(self, pattern: str) -> list[dict]:
+        """metrics/find: path completion at the next node level
+        (graphite/storage find semantics)."""
+        nodes = pattern.split(".")
+        depth = len(nodes)
+        from .paths import glob_node_to_regex, is_pattern, node_tag
+
+        from ..index.query import FieldQuery, conj, regexp, term
+
+        qs = [FieldQuery(node_tag(depth - 1))]
+        for i, node in enumerate(nodes):
+            if node == "*":
+                continue
+            if is_pattern(node):
+                qs.append(regexp(node_tag(i), glob_node_to_regex(node).encode()))
+            else:
+                qs.append(term(node_tag(i), node.encode()))
+        q = qs[0] if len(qs) == 1 else conj(*qs)
+        result = self.db.query_ids(self.namespace, q, 0, 2**62)
+        out: dict[str, bool] = {}
+        for doc in result.docs:
+            tags = dict(doc.fields)
+            path_nodes = []
+            i = 0
+            while node_tag(i) in tags:
+                path_nodes.append(tags[node_tag(i)].decode())
+                i += 1
+            prefix = ".".join(path_nodes[:depth])
+            is_leaf = len(path_nodes) == depth
+            out[prefix] = out.get(prefix, True) and is_leaf
+        return [
+            {"id": p, "text": p.rsplit(".", 1)[-1], "leaf": leaf}
+            for p, leaf in sorted(out.items())
+        ]
+
+    # -- evaluation --
+
+    def _eval(self, node, ctx: Context, shift_nanos: int) -> list[GSeries]:
+        if isinstance(node, PathExpr):
+            return self._fetch(node.pattern, ctx, shift_nanos)
+        if isinstance(node, Call):
+            return self._call(node, ctx, shift_nanos)
+        raise ValueError(f"graphite: target must be a path or call, got {node!r}")
+
+    def _call(self, call: Call, ctx: Context, shift_nanos: int) -> list[GSeries]:
+        fn = FUNCS.get(call.func)
+        if fn is None:
+            raise ValueError(f"graphite: unsupported function {call.func!r}")
+        inner_shift = shift_nanos
+        if call.func == "timeShift":
+            # timeShift('-1d') re-fetches the inner series shifted in time;
+            # the function itself only renames (functions.py)
+            interval = (
+                self._scalar(call.args[1]) if len(call.args) > 1 else "-1d"
+            )
+            inner_shift = shift_nanos + parse_interval(interval)
+            series = self._eval(call.args[0], ctx, inner_shift)
+            return fn(ctx, series, interval)
+        args = []
+        for a in call.args:
+            if isinstance(a, (PathExpr, Call)):
+                args.append(self._eval(a, ctx, inner_shift))
+            else:
+                args.append(self._scalar(a))
+        kwargs = {k: self._scalar(v) for k, v in call.kwargs.items()}
+        return fn(ctx, *args, **kwargs)
+
+    def _scalar(self, node):
+        if isinstance(node, Number):
+            return node.value
+        if isinstance(node, String):
+            return node.value
+        if isinstance(node, Bool):
+            return node.value
+        raise ValueError(f"graphite: expected a literal, got {node!r}")
+
+    def _fetch(self, pattern: str, ctx: Context, shift_nanos: int) -> list[GSeries]:
+        q = pattern_to_query(pattern)
+        start = ctx.start_nanos + shift_nanos
+        end = start + ctx.step_nanos * ctx.steps
+        fetched = self.db.fetch_tagged(
+            self.namespace, q, start - self.lookback_nanos, end
+        )
+        series = []
+        for sid, tags, dps in fetched:
+            times = np.asarray([dp.timestamp for dp in dps], np.int64)
+            vals = np.asarray([dp.value for dp in dps], np.float64)
+            series.append((tags, times, vals))
+        bounds = Bounds(start, ctx.step_nanos, ctx.steps)
+        result = consolidate(series, bounds, self.lookback_nanos)
+        out = []
+        for i, meta in enumerate(result.metas):
+            out.append(GSeries(tags_to_path(meta.tags), np.asarray(result.values[i])))
+        return sorted(out, key=lambda s: s.name)

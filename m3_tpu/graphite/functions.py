@@ -1,0 +1,573 @@
+"""Graphite function library over consolidated series.
+
+Reference: /root/reference/src/query/graphite/native/builtin_functions.go
+(~100 functions). This library implements the widely-used core as
+vectorized numpy transforms over [T] rows; every function takes an eval
+context (bounds/step) and returns a new series list. Names and semantics
+follow graphite-web.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class GSeries:
+    name: str
+    values: np.ndarray  # float64[T], NaN = no data
+
+    def with_values(self, vals, name: str | None = None) -> "GSeries":
+        return GSeries(name if name is not None else self.name, np.asarray(vals, float))
+
+
+@dataclass
+class Context:
+    start_nanos: int
+    step_nanos: int
+    steps: int
+
+
+NANOS = 1_000_000_000
+
+_INTERVAL_RE = re.compile(r"^(-?\d+)(s|sec|secs|second|seconds|min|mins|minute|minutes|h|hour|hours|d|day|days|w|week|weeks|mon|month|months|y|year|years)$")
+_UNIT_SECS = {
+    "s": 1, "sec": 1, "secs": 1, "second": 1, "seconds": 1,
+    "min": 60, "mins": 60, "minute": 60, "minutes": 60,
+    "h": 3600, "hour": 3600, "hours": 3600,
+    "d": 86400, "day": 86400, "days": 86400,
+    "w": 604800, "week": 604800, "weeks": 604800,
+    "mon": 2592000, "month": 2592000, "months": 2592000,
+    "y": 31536000, "year": 31536000, "years": 31536000,
+}
+
+
+def parse_interval(s) -> int:
+    """'5min' → nanos (common/time.go ParseInterval)."""
+    if isinstance(s, (int, float)):
+        return int(s * NANOS)
+    m = _INTERVAL_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"graphite: bad interval {s!r}")
+    return int(m.group(1)) * _UNIT_SECS[m.group(2)] * NANOS
+
+
+def _stack(series: list[GSeries]) -> np.ndarray:
+    return np.vstack([s.values for s in series]) if series else np.zeros((0, 0))
+
+
+def _nan_fn(fn, arr, axis=0):
+    with np.errstate(all="ignore"):
+        out = fn(arr, axis=axis)
+    return out
+
+
+def _combine(name: str, series, reducer) -> list[GSeries]:
+    if not series:
+        return []
+    arr = _stack(series)
+    all_nan = np.all(np.isnan(arr), axis=0)
+    out = reducer(arr)
+    out = np.where(all_nan, np.nan, out)
+    inner = ",".join(s.name for s in series)
+    return [GSeries(f"{name}({inner})", out)]
+
+
+FUNCS: dict = {}
+
+
+def func(*names):
+    def deco(fn):
+        for n in names:
+            FUNCS[n] = fn
+        return fn
+
+    return deco
+
+
+# --- combining ---
+
+
+@func("sumSeries", "sum")
+def sum_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("sumSeries", series, lambda a: _nan_fn(np.nansum, a))
+
+
+@func("averageSeries", "avg")
+def average_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("averageSeries", series, lambda a: _nan_fn(np.nanmean, a))
+
+
+@func("maxSeries")
+def max_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("maxSeries", series, lambda a: _nan_fn(np.nanmax, a))
+
+
+@func("minSeries")
+def min_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("minSeries", series, lambda a: _nan_fn(np.nanmin, a))
+
+
+@func("medianSeries")
+def median_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("medianSeries", series, lambda a: _nan_fn(np.nanmedian, a))
+
+
+@func("stddevSeries")
+def stddev_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("stddevSeries", series, lambda a: _nan_fn(np.nanstd, a))
+
+
+@func("countSeries")
+def count_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    if not series:
+        return []
+    arr = _stack(series)
+    out = np.sum(~np.isnan(arr), axis=0).astype(float)
+    return [GSeries(f"countSeries({','.join(s.name for s in series)})", out)]
+
+
+@func("diffSeries")
+def diff_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    if not series:
+        return []
+    head = np.nan_to_num(series[0].values, nan=np.nan)
+    rest = _stack(series[1:]) if len(series) > 1 else np.zeros((0, len(head)))
+    sub = _nan_fn(np.nansum, rest) if len(series) > 1 else 0.0
+    out = series[0].values - sub
+    return [GSeries(f"diffSeries({','.join(s.name for s in series)})", out)]
+
+
+@func("multiplySeries")
+def multiply_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("multiplySeries", series, lambda a: _nan_fn(np.nanprod, a))
+
+
+@func("divideSeries")
+def divide_series(ctx, dividends, divisors):
+    if len(divisors) != 1:
+        raise ValueError("divideSeries: divisor must be exactly one series")
+    d = divisors[0].values
+    out = []
+    with np.errstate(all="ignore"):
+        for s in dividends:
+            vals = s.values / np.where(d == 0, np.nan, d)
+            out.append(GSeries(f"divideSeries({s.name},{divisors[0].name})", vals))
+    return out
+
+
+@func("asPercent")
+def as_percent(ctx, series, total=None):
+    if total is None:
+        tot = _nan_fn(np.nansum, _stack(series))
+    elif isinstance(total, list):
+        tot = _nan_fn(np.nansum, _stack(total))
+    else:
+        tot = float(total)
+    out = []
+    with np.errstate(all="ignore"):
+        for s in series:
+            out.append(s.with_values(100.0 * s.values / tot, f"asPercent({s.name})"))
+    return out
+
+
+# --- transform ---
+
+
+@func("absolute")
+def absolute(ctx, series):
+    return [s.with_values(np.abs(s.values), f"absolute({s.name})") for s in series]
+
+
+@func("scale")
+def scale(ctx, series, factor):
+    return [s.with_values(s.values * factor, f"scale({s.name},{factor:g})") for s in series]
+
+
+@func("scaleToSeconds")
+def scale_to_seconds(ctx, series, seconds):
+    factor = seconds / (ctx.step_nanos / NANOS)
+    return [
+        s.with_values(s.values * factor, f"scaleToSeconds({s.name},{int(seconds)})")
+        for s in series
+    ]
+
+
+@func("offset")
+def offset(ctx, series, amount):
+    return [s.with_values(s.values + amount, f"offset({s.name},{amount:g})") for s in series]
+
+
+@func("invert")
+def invert(ctx, series):
+    with np.errstate(all="ignore"):
+        return [
+            s.with_values(
+                np.where(s.values == 0, np.nan, 1.0 / s.values), f"invert({s.name})"
+            )
+            for s in series
+        ]
+
+
+@func("logarithm", "log")
+def logarithm(ctx, series, base=10.0):
+    with np.errstate(all="ignore"):
+        return [
+            s.with_values(
+                np.where(s.values > 0, np.log(s.values) / math.log(base), np.nan),
+                f"log({s.name},{base:g})",
+            )
+            for s in series
+        ]
+
+
+@func("pow")
+def pow_(ctx, series, factor):
+    with np.errstate(all="ignore"):
+        return [s.with_values(np.power(s.values, factor), f"pow({s.name},{factor:g})") for s in series]
+
+
+@func("derivative")
+def derivative(ctx, series):
+    out = []
+    for s in series:
+        d = np.diff(s.values, prepend=np.nan)
+        out.append(s.with_values(d, f"derivative({s.name})"))
+    return out
+
+
+@func("nonNegativeDerivative")
+def non_negative_derivative(ctx, series):
+    out = []
+    for s in series:
+        d = np.diff(s.values, prepend=np.nan)
+        d = np.where(d < 0, np.nan, d)
+        out.append(s.with_values(d, f"nonNegativeDerivative({s.name})"))
+    return out
+
+
+@func("perSecond")
+def per_second(ctx, series):
+    step_s = ctx.step_nanos / NANOS
+    out = []
+    for s in series:
+        d = np.diff(s.values, prepend=np.nan) / step_s
+        d = np.where(d < 0, np.nan, d)
+        out.append(s.with_values(d, f"perSecond({s.name})"))
+    return out
+
+
+@func("integral")
+def integral(ctx, series):
+    out = []
+    for s in series:
+        vals = np.nancumsum(s.values)
+        vals = np.where(np.isnan(s.values) & (np.arange(len(vals)) == 0), np.nan, vals)
+        out.append(s.with_values(vals, f"integral({s.name})"))
+    return out
+
+
+@func("keepLastValue")
+def keep_last_value(ctx, series, limit=math.inf):
+    out = []
+    for s in series:
+        vals = s.values.copy()
+        last = np.nan
+        gap = 0
+        for i in range(len(vals)):
+            if np.isnan(vals[i]):
+                gap += 1
+                if not math.isnan(last) and gap <= limit:
+                    vals[i] = last
+            else:
+                last = vals[i]
+                gap = 0
+        out.append(s.with_values(vals, f"keepLastValue({s.name})"))
+    return out
+
+
+@func("transformNull")
+def transform_null(ctx, series, default=0.0):
+    return [
+        s.with_values(
+            np.where(np.isnan(s.values), default, s.values),
+            f"transformNull({s.name},{default:g})",
+        )
+        for s in series
+    ]
+
+
+@func("timeShift")
+def time_shift(ctx, series, interval):
+    # engine pre-fetches with the shift applied; this renames only
+    return [s.with_values(s.values, f"timeShift({s.name},{interval})") for s in series]
+
+
+def _moving(name, reducer):
+    def fn(ctx, series, window):
+        n = max(int(parse_interval(window) // ctx.step_nanos), 1)
+        out = []
+        for s in series:
+            vals = s.values
+            padded = np.concatenate([np.full(n - 1, np.nan), vals])
+            windows = np.lib.stride_tricks.sliding_window_view(padded, n)
+            with np.errstate(all="ignore"):
+                mv = reducer(windows, axis=1)
+            all_nan = np.all(np.isnan(windows), axis=1)
+            mv = np.where(all_nan, np.nan, mv)
+            out.append(s.with_values(mv, f"{name}({s.name},{window!r})"))
+        return out
+
+    return fn
+
+
+FUNCS["movingAverage"] = _moving("movingAverage", np.nanmean)
+FUNCS["movingSum"] = _moving("movingSum", np.nansum)
+FUNCS["movingMax"] = _moving("movingMax", np.nanmax)
+FUNCS["movingMin"] = _moving("movingMin", np.nanmin)
+FUNCS["movingMedian"] = _moving("movingMedian", np.nanmedian)
+
+
+@func("summarize")
+def summarize(ctx, series, interval, fn="sum"):
+    n = max(int(parse_interval(interval) // ctx.step_nanos), 1)
+    red = {
+        "sum": np.nansum, "avg": np.nanmean, "average": np.nanmean,
+        "max": np.nanmax, "min": np.nanmin, "last": lambda a, axis: a[:, -1],
+    }[fn]
+    out = []
+    for s in series:
+        t = len(s.values)
+        pad = (-t) % n
+        vals = np.concatenate([s.values, np.full(pad, np.nan)]).reshape(-1, n)
+        with np.errstate(all="ignore"):
+            summed = red(vals, axis=1)
+        summed = np.where(np.all(np.isnan(vals), axis=1), np.nan, summed)
+        # expand back to step grid (each bucket repeated)
+        expanded = np.repeat(summed, n)[:t]
+        out.append(s.with_values(expanded, f"summarize({s.name},{interval!r},{fn!r})"))
+    return out
+
+
+# --- filtering / sorting ---
+
+
+def _series_agg(s: GSeries, how: str) -> float:
+    with np.errstate(all="ignore"):
+        if how == "max":
+            return float(np.nanmax(s.values)) if not np.all(np.isnan(s.values)) else -math.inf
+        if how == "min":
+            return float(np.nanmin(s.values)) if not np.all(np.isnan(s.values)) else math.inf
+        if how == "avg":
+            return float(np.nanmean(s.values)) if not np.all(np.isnan(s.values)) else -math.inf
+        if how == "total":
+            return float(np.nansum(s.values))
+        if how == "current":
+            valid = s.values[~np.isnan(s.values)]
+            return float(valid[-1]) if len(valid) else -math.inf
+    raise ValueError(how)
+
+
+@func("highestMax")
+def highest_max(ctx, series, n=1):
+    return sorted(series, key=lambda s: _series_agg(s, "max"), reverse=True)[: int(n)]
+
+
+@func("highestAverage")
+def highest_average(ctx, series, n=1):
+    return sorted(series, key=lambda s: _series_agg(s, "avg"), reverse=True)[: int(n)]
+
+
+@func("highestCurrent")
+def highest_current(ctx, series, n=1):
+    return sorted(series, key=lambda s: _series_agg(s, "current"), reverse=True)[: int(n)]
+
+
+@func("lowestAverage")
+def lowest_average(ctx, series, n=1):
+    return sorted(series, key=lambda s: _series_agg(s, "avg"))[: int(n)]
+
+
+@func("lowestCurrent")
+def lowest_current(ctx, series, n=1):
+    return sorted(series, key=lambda s: _series_agg(s, "current"))[: int(n)]
+
+
+@func("sortByMaxima")
+def sort_by_maxima(ctx, series):
+    return sorted(series, key=lambda s: _series_agg(s, "max"), reverse=True)
+
+
+@func("sortByMinima")
+def sort_by_minima(ctx, series):
+    return sorted(series, key=lambda s: _series_agg(s, "min"))
+
+
+@func("sortByTotal")
+def sort_by_total(ctx, series):
+    return sorted(series, key=lambda s: _series_agg(s, "total"), reverse=True)
+
+
+@func("sortByName")
+def sort_by_name(ctx, series):
+    return sorted(series, key=lambda s: s.name)
+
+
+@func("limit")
+def limit(ctx, series, n):
+    return series[: int(n)]
+
+
+@func("exclude")
+def exclude(ctx, series, pattern):
+    rx = re.compile(pattern)
+    return [s for s in series if not rx.search(s.name)]
+
+
+@func("grep")
+def grep(ctx, series, pattern):
+    rx = re.compile(pattern)
+    return [s for s in series if rx.search(s.name)]
+
+
+@func("maximumAbove")
+def maximum_above(ctx, series, n):
+    return [s for s in series if _series_agg(s, "max") > n]
+
+
+@func("maximumBelow")
+def maximum_below(ctx, series, n):
+    return [s for s in series if _series_agg(s, "max") < n]
+
+
+@func("minimumAbove")
+def minimum_above(ctx, series, n):
+    return [s for s in series if _series_agg(s, "min") > n]
+
+
+@func("averageAbove")
+def average_above(ctx, series, n):
+    return [s for s in series if _series_agg(s, "avg") > n]
+
+
+@func("currentAbove")
+def current_above(ctx, series, n):
+    return [s for s in series if _series_agg(s, "current") > n]
+
+
+@func("removeAboveValue")
+def remove_above_value(ctx, series, n):
+    return [
+        s.with_values(np.where(s.values > n, np.nan, s.values),
+                      f"removeAboveValue({s.name},{n:g})")
+        for s in series
+    ]
+
+
+@func("removeBelowValue")
+def remove_below_value(ctx, series, n):
+    return [
+        s.with_values(np.where(s.values < n, np.nan, s.values),
+                      f"removeBelowValue({s.name},{n:g})")
+        for s in series
+    ]
+
+
+@func("nPercentile")
+def n_percentile(ctx, series, n):
+    out = []
+    for s in series:
+        with np.errstate(all="ignore"):
+            p = np.nanpercentile(s.values, n) if not np.all(np.isnan(s.values)) else np.nan
+        out.append(s.with_values(np.full_like(s.values, p), f"nPercentile({s.name},{n:g})"))
+    return out
+
+
+# --- naming / grouping ---
+
+
+@func("alias")
+def alias(ctx, series, name):
+    return [GSeries(name, s.values) for s in series]
+
+
+@func("aliasByNode")
+def alias_by_node(ctx, series, *nodes):
+    out = []
+    for s in series:
+        parts = _base_path(s.name).split(".")
+        picked = [parts[int(n)] for n in nodes if -len(parts) <= int(n) < len(parts)]
+        out.append(GSeries(".".join(picked), s.values))
+    return out
+
+
+@func("aliasSub")
+def alias_sub(ctx, series, pattern, replacement):
+    rx = re.compile(pattern)
+    return [GSeries(rx.sub(replacement, s.name), s.values) for s in series]
+
+
+def _base_path(name: str) -> str:
+    """Strip function wrappers: f(g(a.b.c,...)) → a.b.c (node addressing
+    works on the underlying path, like graphite's pathExpression)."""
+    m = re.search(r"[A-Za-z_0-9\-.${}*?\[\]]+(?=[,)]|$)", name)
+    inner = name
+    while True:
+        m2 = re.match(r"^[A-Za-z_][A-Za-z_0-9]*\((.*)\)$", inner)
+        if not m2:
+            break
+        inner = m2.group(1).split(",")[0]
+    return inner
+
+
+@func("groupByNode")
+def group_by_node(ctx, series, node, callback="sum"):
+    return group_by_nodes(ctx, series, callback, node)
+
+
+@func("groupByNodes")
+def group_by_nodes(ctx, series, callback, *nodes):
+    groups: dict[str, list[GSeries]] = {}
+    for s in series:
+        parts = _base_path(s.name).split(".")
+        key = ".".join(
+            parts[int(n)] if -len(parts) <= int(n) < len(parts) else ""
+            for n in nodes
+        )
+        groups.setdefault(key, []).append(s)
+    out = []
+    fn = FUNCS[
+        {"sum": "sumSeries", "avg": "averageSeries", "average": "averageSeries",
+         "max": "maxSeries", "min": "minSeries"}.get(callback, callback)
+    ]
+    for key in sorted(groups):
+        combined = fn(ctx, groups[key])
+        for s in combined:
+            out.append(GSeries(key, s.values))
+    return out
+
+
+@func("constantLine")
+def constant_line(ctx, value):
+    return [GSeries(f"{value:g}", np.full(ctx.steps, float(value)))]
+
+
+@func("randomWalkFunction", "randomWalk")
+def random_walk(ctx, name="randomWalk"):
+    # deterministic "random" walk (tests need reproducibility; the reference
+    # uses it for demos only)
+    t = np.arange(ctx.steps, dtype=float)
+    return [GSeries(str(name), np.sin(t / 3.0))]

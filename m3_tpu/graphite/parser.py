@@ -1,0 +1,142 @@
+"""Graphite target expression parser.
+
+Reference: /root/reference/src/query/graphite/lexer/ + native/expression.go —
+targets are function calls over series paths:
+
+    sumSeries(servers.web*.cpu.{user,system})
+    movingAverage(scale(app.reqs, 0.1), '5min')
+
+Grammar: expr := call | path | number | string | bool;
+call := ident '(' expr (',' expr)* ')'. Paths may contain glob characters;
+an ident followed by '(' is a function name, otherwise it's (part of) a
+path. Keyword args (``alignToFrom=true``) parse as named arguments.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PathExpr:
+    pattern: str
+
+
+@dataclass
+class Number:
+    value: float
+
+
+@dataclass
+class String:
+    value: str
+
+
+@dataclass
+class Bool:
+    value: bool
+
+
+@dataclass
+class Call:
+    func: str
+    args: list = field(default_factory=list)
+    kwargs: dict = field(default_factory=dict)
+
+
+# one token of a target: strings, numbers, identifiers/paths, punctuation
+_TOKEN = re.compile(
+    r"""\s*(?:
+      (?P<string>'[^']*'|"[^"]*")
+    | (?P<number>-?\d+\.\d+|-?\.\d+|-?\d+(?![\w.{[*]))
+    | (?P<path>(?:[A-Za-z_0-9\-.*?$%:]|\{[^}]*\}|\[[^\]]*\])+)
+    | (?P<punct>[(),=])
+    )""",
+    re.VERBOSE,
+)
+
+
+def _lex(s: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if not m or m.end() == pos:
+            if s[pos:].strip() == "":
+                break
+            raise ValueError(f"graphite: bad character at {pos}: {s[pos:]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        out.append((kind, m.group(kind)))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens) -> None:
+        self.toks = tokens
+        self.i = 0
+
+    @property
+    def cur(self):
+        return self.toks[self.i]
+
+    def eat(self, kind=None, text=None):
+        k, t = self.cur
+        if kind is not None and k != kind:
+            raise ValueError(f"graphite: expected {kind}, got {k} {t!r}")
+        if text is not None and t != text:
+            raise ValueError(f"graphite: expected {text!r}, got {t!r}")
+        self.i += 1
+        return t
+
+    def parse(self):
+        e = self.expr()
+        if self.cur[0] != "eof":
+            raise ValueError(f"graphite: trailing input {self.cur[1]!r}")
+        return e
+
+    def expr(self):
+        k, t = self.cur
+        if k == "string":
+            self.eat()
+            return String(t[1:-1])
+        if k == "number":
+            self.eat()
+            return Number(float(t))
+        if k == "path":
+            self.eat()
+            nxt_k, nxt_t = self.cur
+            if nxt_k == "punct" and nxt_t == "(":
+                return self.call(t)
+            # paths with commas inside braces lex as one path token already;
+            # plain identifiers true/false are booleans
+            if t in ("true", "false"):
+                return Bool(t == "true")
+            return PathExpr(t)
+        raise ValueError(f"graphite: unexpected token {t!r}")
+
+    def call(self, name: str) -> Call:
+        self.eat(text="(")
+        node = Call(name)
+        while self.cur[1] != ")":
+            # keyword argument?
+            if (
+                self.cur[0] == "path"
+                and self.toks[self.i + 1][1] == "="
+                and re.fullmatch(r"[A-Za-z_][A-Za-z_0-9]*", self.cur[1])
+            ):
+                key = self.eat("path")
+                self.eat(text="=")
+                node.kwargs[key] = self.expr()
+            else:
+                node.args.append(self.expr())
+            if self.cur[1] == ",":
+                self.eat(text=",")
+        self.eat(text=")")
+        return node
+
+
+def parse(target: str):
+    return _Parser(_lex(target)).parse()

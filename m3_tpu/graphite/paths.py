@@ -1,0 +1,89 @@
+"""Graphite path ↔ tags mapping and glob matching.
+
+Reference: /root/reference/src/query/graphite/graphite/ — carbon metrics
+like ``servers.web01.cpu.user`` store as tagged series with one tag per
+path node (``__g0__=servers, __g1__=web01, ...``), so the reverse index
+serves graphite queries; glob patterns (``*``, ``{a,b}``, ``[0-9]``, ``?``)
+compile to per-node regexes (graphite/glob.go).
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..index.query import AllQuery, Query, conj, regexp, term
+
+# per-node tag names (graphite/tags.go TagName)
+def node_tag(i: int) -> bytes:
+    return f"__g{i}__".encode()
+
+
+_COUNT_TAG = b"__gcount__"  # number of nodes, so a.b never matches a.b.c
+
+
+def path_to_tags(path: str) -> tuple:
+    nodes = path.split(".")
+    tags = [(node_tag(i), n.encode()) for i, n in enumerate(nodes)]
+    tags.append((_COUNT_TAG, str(len(nodes)).encode()))
+    return tuple(sorted(tags))
+
+
+def tags_to_path(tags) -> str:
+    nodes = {}
+    for k, v in tags:
+        m = re.fullmatch(rb"__g(\d+)__", bytes(k))
+        if m:
+            nodes[int(m.group(1))] = bytes(v).decode()
+    return ".".join(nodes[i] for i in sorted(nodes))
+
+
+_GLOB_CHARS = set("*?{[")
+
+
+def is_pattern(node: str) -> bool:
+    return any(c in _GLOB_CHARS for c in node)
+
+
+def glob_node_to_regex(node: str) -> str:
+    """One path node's glob → regex source (graphite/glob.go semantics)."""
+    out = []
+    i = 0
+    while i < len(node):
+        c = node[i]
+        if c == "*":
+            out.append("[^.]*")
+        elif c == "?":
+            out.append("[^.]")
+        elif c == "{":
+            j = node.find("}", i)
+            if j < 0:
+                raise ValueError(f"unbalanced {{ in {node!r}")
+            alts = node[i + 1 : j].split(",")
+            out.append("(" + "|".join(re.escape(a) for a in alts) + ")")
+            i = j
+        elif c == "[":
+            j = node.find("]", i)
+            if j < 0:
+                raise ValueError(f"unbalanced [ in {node!r}")
+            out.append(node[i : j + 1])
+            i = j
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "".join(out)
+
+
+def pattern_to_query(pattern: str) -> Query:
+    """Glob path pattern → index query over the per-node tags."""
+    nodes = pattern.split(".")
+    qs: list[Query] = [term(_COUNT_TAG, str(len(nodes)).encode())]
+    for i, node in enumerate(nodes):
+        if node == "*":
+            continue  # the count term already pins node presence
+        if is_pattern(node):
+            qs.append(regexp(node_tag(i), glob_node_to_regex(node).encode()))
+        else:
+            qs.append(term(node_tag(i), node.encode()))
+    if len(qs) == 1:
+        return qs[0]
+    return conj(*qs)

@@ -122,6 +122,40 @@ class Coordinator:
         r = self.engine.query_instant(query, int(time_s * NANOS))
         return _prom_vector(r, time_s)
 
+    # --- graphite (src/query/api/v1/handler/graphite/render.go + find.go) ---
+
+    def _graphite_engine(self):
+        from ..graphite.engine import GraphiteEngine
+
+        ns = "graphite" if "graphite" in self.db.namespaces else self.namespace
+        return GraphiteEngine(self.db, namespace=ns)
+
+    def graphite_render(self, q: dict) -> list[dict]:
+        import time as _time
+
+        now_s = _time.time()
+        start_s = _graphite_time(q.get("from", ["-1h"])[0], now_s)
+        end_s = _graphite_time(q.get("until", ["now"])[0], now_s)
+        step_s = _parse_step(q.get("step", ["10"])[0])
+        if step_s <= 0:
+            raise ValueError("step must be positive")
+        engine = self._graphite_engine()
+        out = []
+        for target in q.get("target", []):
+            series = engine.render(
+                target, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
+            )
+            for s in series:
+                pts = [
+                    [None if np.isnan(v) else float(v), int(start_s + i * step_s)]
+                    for i, v in enumerate(s.values)
+                ]
+                out.append({"target": s.name, "datapoints": pts})
+        return out
+
+    def graphite_find(self, pattern: str) -> list[dict]:
+        return self._graphite_engine().find(pattern)
+
     def labels(self) -> list[str]:
         ns = self.db.namespaces[self.namespace]
         agg = ns.index.aggregate_query(None, 0, 2**62)
@@ -220,6 +254,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/api/v1/services/m3db/placement":
                 p = c.placement_svc.get()
                 self._json(p.to_dict() if p else {}, 200 if p else 404)
+            elif url.path in ("/api/v1/graphite/render", "/render"):
+                self._json(c.graphite_render(q))
+            elif url.path in ("/api/v1/graphite/metrics/find", "/metrics/find"):
+                self._json(c.graphite_find(q.get("query", ["*"])[0]))
             else:
                 self._json({"error": "not found"}, 404)
         except Exception as exc:  # surface handler errors as 4xx
@@ -232,7 +270,20 @@ class _Handler(BaseHTTPRequestHandler):
         c = self.coordinator
         url = urlparse(self.path)
         try:
-            if url.path == "/api/v1/prom/remote/write":
+            if url.path in (
+                "/api/v1/graphite/render",
+                "/render",
+                "/api/v1/graphite/metrics/find",
+                "/metrics/find",
+            ):
+                # Grafana's graphite datasource POSTs form-encoded bodies
+                form = parse_qs(self._body().decode())
+                form.update(parse_qs(url.query))
+                if url.path.endswith("find"):
+                    self._json(c.graphite_find(form.get("query", ["*"])[0]))
+                else:
+                    self._json(c.graphite_render(form))
+            elif url.path == "/api/v1/prom/remote/write":
                 raw = decompress(self._body())
                 req = prompb.WriteRequest()
                 req.ParseFromString(raw)
@@ -283,6 +334,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": "not found"}, 404)
         except Exception as exc:
             self._json({"status": "error", "error": str(exc)}, 400)
+
+
+def _graphite_time(s: str, now_s: float) -> float:
+    """Graphite time spec: epoch seconds, 'now', or relative '-1h'/'-30min'
+    (render.go / graphite-web from/until parsing)."""
+    s = str(s).strip()
+    if s in ("now", ""):
+        return now_s
+    if s.startswith("-") or s.startswith("+"):
+        from ..graphite.functions import parse_interval
+
+        return now_s + parse_interval(s.lstrip("+")) / NANOS
+    return float(s)
 
 
 def _parse_step(s: str) -> float:

@@ -1,0 +1,257 @@
+"""Graphite subsystem: path globbing, target parsing, function library,
+carbon ingest → render end-to-end (reference: src/query/graphite/ +
+carbon ingest + graphite API handlers)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.graphite.carbon import CarbonIngestServer, parse_line, send_lines
+from m3_tpu.graphite.engine import GraphiteEngine
+from m3_tpu.graphite.functions import GSeries, parse_interval
+from m3_tpu.graphite.parser import Call, Number, PathExpr, String, parse
+from m3_tpu.graphite.paths import (
+    glob_node_to_regex,
+    path_to_tags,
+    pattern_to_query,
+    tags_to_path,
+)
+from m3_tpu.storage.database import Database, NamespaceOptions
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+STEP = 10 * NANOS
+
+
+# --- paths ---
+
+
+def test_path_tags_roundtrip():
+    tags = path_to_tags("servers.web01.cpu.user")
+    assert tags_to_path(tags) == "servers.web01.cpu.user"
+
+
+def test_glob_node_regex():
+    import re
+
+    assert re.fullmatch(glob_node_to_regex("web*"), "web01")
+    assert not re.fullmatch(glob_node_to_regex("web*"), "db01")
+    assert re.fullmatch(glob_node_to_regex("{web,db}01"), "db01")
+    assert re.fullmatch(glob_node_to_regex("web[0-9]"), "web7")
+    assert not re.fullmatch(glob_node_to_regex("web?"), "web12")
+
+
+# --- parser ---
+
+
+def test_parse_nested_call():
+    e = parse("movingAverage(scale(app.reqs.count, 0.1), '5min')")
+    assert isinstance(e, Call) and e.func == "movingAverage"
+    inner = e.args[0]
+    assert isinstance(inner, Call) and inner.func == "scale"
+    assert isinstance(inner.args[0], PathExpr)
+    assert inner.args[0].pattern == "app.reqs.count"
+    assert inner.args[1].value == 0.1
+    assert e.args[1].value == "5min"
+
+
+def test_parse_globs_and_kwargs():
+    e = parse("summarize(servers.web*.cpu.{user,system}, '1h', fn='avg')")
+    assert e.args[0].pattern == "servers.web*.cpu.{user,system}"
+    assert e.kwargs["fn"].value == "avg"
+
+
+def test_parse_interval():
+    assert parse_interval("5min") == 300 * NANOS
+    assert parse_interval("-1d") == -86400 * NANOS
+    assert parse_interval("2hours") == 7200 * NANOS
+
+
+# --- engine over a real database ---
+
+
+@pytest.fixture(scope="module")
+def gdb():
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    db = Database(tmp, num_shards=2, commitlog_enabled=False)
+    db.create_namespace("graphite", NamespaceOptions(block_size_nanos=2 * 3600 * NANOS))
+    for host, slope in (("web01", 1.0), ("web02", 2.0), ("db01", 10.0)):
+        for i in range(60):
+            db.write_tagged(
+                "graphite",
+                path_to_tags(f"servers.{host}.cpu.user"),
+                T0 + i * STEP,
+                slope * i,
+            )
+    return db
+
+
+def _render(db, target, steps=20):
+    eng = GraphiteEngine(db)
+    return eng.render(target, T0 + 30 * STEP, T0 + (30 + steps) * STEP, STEP)
+
+
+def test_glob_fetch(gdb):
+    out = _render(gdb, "servers.web*.cpu.user")
+    assert [s.name for s in out] == [
+        "servers.web01.cpu.user",
+        "servers.web02.cpu.user",
+    ]
+    assert np.allclose(out[0].values[0], 30.0)
+    assert np.allclose(out[1].values[0], 60.0)
+
+
+def test_sum_and_alias(gdb):
+    out = _render(gdb, "aliasByNode(sumSeries(servers.web*.cpu.user), 0)")
+    assert len(out) == 1
+    # sum of slopes 1+2 = 3 per step index
+    assert np.allclose(out[0].values[0], 90.0)
+
+
+def test_group_by_node(gdb):
+    out = _render(gdb, "groupByNode(servers.*.cpu.user, 1, 'sum')")
+    names = [s.name for s in out]
+    assert names == ["db01", "web01", "web02"]
+
+
+def test_moving_average_and_scale(gdb):
+    out = _render(gdb, "movingAverage(scale(servers.web01.cpu.user, 10), '30s')")
+    vals = out[0].values
+    # window of 3 samples of 10*(i-1,i,i+1) centered trailing: avg = 10*(i-1)
+    assert np.allclose(vals[5], 10.0 * (35 - 1))
+
+
+def test_derivative_and_per_second(gdb):
+    out = _render(gdb, "nonNegativeDerivative(servers.web02.cpu.user)")
+    assert np.allclose(out[0].values[1:], 2.0)
+    out = _render(gdb, "perSecond(servers.web02.cpu.user)")
+    assert np.allclose(out[0].values[1:], 0.2)
+
+
+def test_filters_and_sort(gdb):
+    out = _render(gdb, "highestAverage(servers.*.cpu.user, 1)")
+    assert [s.name for s in out] == ["servers.db01.cpu.user"]
+    out = _render(gdb, "exclude(servers.*.cpu.user, 'db')")
+    assert all("db" not in s.name for s in out)
+    out = _render(gdb, "maximumAbove(servers.*.cpu.user, 300)")
+    assert [s.name for s in out] == ["servers.db01.cpu.user"]
+
+
+def test_as_percent_and_divide(gdb):
+    out = _render(gdb, "asPercent(servers.web01.cpu.user)")
+    assert np.allclose(out[0].values, 100.0)
+    out = _render(gdb, "divideSeries(servers.web02.cpu.user, servers.web01.cpu.user)")
+    assert np.allclose(out[0].values, 2.0)
+
+
+def test_transform_null_and_keep_last(gdb):
+    out = _render(gdb, "transformNull(servers.nothere.cpu.user, -1)")
+    assert out == []  # no series matched at all
+    out = _render(gdb, "keepLastValue(servers.web01.cpu.user)")
+    assert not np.any(np.isnan(out[0].values))
+
+
+def test_time_shift(gdb):
+    out = _render(gdb, "timeShift(servers.web01.cpu.user, '-1min')")
+    # shifted 6 steps back: value at outer step 30 is the value at 24
+    assert np.allclose(out[0].values[0], 24.0)
+
+
+def test_find(gdb):
+    eng = GraphiteEngine(gdb)
+    top = eng.find("*")
+    assert [n["id"] for n in top] == ["servers"]
+    assert top[0]["leaf"] is False
+    hosts = eng.find("servers.*")
+    assert [n["id"] for n in hosts] == [
+        "servers.db01",
+        "servers.web01",
+        "servers.web02",
+    ]
+    leaves = eng.find("servers.web01.cpu.*")
+    assert leaves == [
+        {"id": "servers.web01.cpu.user", "text": "user", "leaf": True}
+    ]
+
+
+# --- carbon ingest end-to-end ---
+
+
+def test_carbon_line_parse():
+    assert parse_line(b"a.b.c 1.5 1600000000\n") == ("a.b.c", 1.5, T0)
+    assert parse_line(b"# comment") is None
+    with pytest.raises(ValueError):
+        parse_line(b"too few")
+
+
+def test_carbon_to_render_end_to_end(tmp_path):
+    import time
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("graphite", NamespaceOptions())
+    server = CarbonIngestServer(db)
+    server.start()
+    try:
+        lines = [
+            f"site.api.requests {10 * i} {1600000000 + 10 * i}" for i in range(12)
+        ] + ["bogus line", "site.api.errors 1 1600000050"]
+        send_lines(server.host, server.port, lines)
+        deadline = time.time() + 10
+        while server.received < 13 and time.time() < deadline:
+            time.sleep(0.01)
+        assert server.received == 13 and server.malformed == 1
+
+        eng = GraphiteEngine(db)
+        out = eng.render("site.api.*", T0, T0 + 120 * NANOS, 10 * NANOS)
+        assert [s.name for s in out] == ["site.api.errors", "site.api.requests"]
+    finally:
+        server.stop()
+        db.close()
+
+
+def test_coordinator_graphite_routes(tmp_path):
+    from m3_tpu.services.coordinator import Coordinator, serve
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("graphite", NamespaceOptions())
+    for i in range(12):
+        db.write_tagged("graphite", path_to_tags("app.reqs"), T0 + i * STEP, float(i))
+    coord = Coordinator(db=db)
+    server, port = serve(coord, 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = (
+            f"http://127.0.0.1:{port}/api/v1/graphite/render?"
+            f"target=scale(app.reqs,2)&from={T0 // NANOS}&until={T0 // NANOS + 110}&step=10"
+        )
+        out = json.load(urllib.request.urlopen(url))
+        assert out[0]["target"] == "scale(app.reqs,2)"
+        vals = [p[0] for p in out[0]["datapoints"]]
+        assert vals[1] == 2.0
+        found = json.load(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/graphite/metrics/find?query=*"
+            )
+        )
+        assert [n["id"] for n in found] == ["app"]
+        # grafana-style POST /render with form body + relative from/until
+        body = (
+            f"target=app.reqs&from={T0 // NANOS}&until={T0 // NANOS + 110}&step=10"
+        ).encode()
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/render", data=body)
+        out = json.load(urllib.request.urlopen(req))
+        assert out[0]["target"] == "app.reqs"
+        # relative time specs must parse ('-1h'/'now' defaults)
+        rel = json.load(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/render?target=app.reqs&from=-1h&until=now"
+            )
+        )
+        assert isinstance(rel, list)  # data is old, empty result is fine
+    finally:
+        server.shutdown()
