@@ -1,0 +1,302 @@
+"""Protobuf-value codec: compressed multi-field (message) time series.
+
+Reference: /root/reference/src/dbnode/encoding/proto/ — encoder.go /
+iterator.go compress protobuf-message values per timestamp with per-field
+strategies: m3tsz timestamps, XOR for double fields, zigzag-varint deltas
+for integer fields, an LRU dictionary + literals for bytes/string fields,
+single bits for bools, and a per-record changed-field bitset so unchanged
+fields cost one bit. This module is the same design over this framework's
+bitstream primitives, with a self-describing schema header.
+
+Wire layout:
+
+    header := u8 version | varint n_fields
+            | (u8 type | varint name_len | name)*
+    record := m3tsz timestamp
+            | changed bitset (1 bit per field)
+            | changed field payloads in schema order
+    stream := header | record* | m3tsz EOS tail
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from ..utils.xtime import Unit
+from . import scheme
+from .istream import IStream
+from .m3tsz import MASK64, FloatXOR, TimestampEncoder, TimestampIterator
+from .ostream import OStream
+
+_VERSION = 1
+_DICT_SIZE = 8  # LRU slots per bytes field (encoder.go byteFieldDictSize)
+_DICT_IDX_BITS = 3
+
+
+class FieldType(enum.IntEnum):
+    DOUBLE = 1
+    INT64 = 2
+    BYTES = 3
+    BOOL = 4
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    type: FieldType
+
+
+Schema = tuple  # tuple[Field, ...]
+
+
+def _zigzag(v: int) -> int:
+    return ((v << 1) ^ (v >> 63)) & MASK64
+
+
+def _unzigzag(u: int) -> int:
+    v = (u >> 1) ^ -(u & 1)
+    return v
+
+
+def _write_varint_bits(os: OStream, value: int) -> None:
+    """Unsigned LEB128 (Go PutUvarint; utils.varint.put_varint is the
+    SIGNED/zigzag variant, so spell the unsigned form out here)."""
+    if value < 0:
+        raise ValueError("uvarint requires a non-negative value")
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            os.write_bits(b | 0x80, 8)
+        else:
+            os.write_bits(b, 8)
+            return
+
+
+def _read_varint_bits(stream: IStream) -> int:
+    out = 0
+    shift = 0
+    while True:
+        b = stream.read_bits(8)
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out
+        shift += 7
+
+
+class _DoubleField:
+    def __init__(self) -> None:
+        self.xor = FloatXOR()
+        self.first = True
+        self.value = 0.0
+
+    def write(self, os: OStream, v: float) -> None:
+        import struct
+
+        bits = struct.unpack("<Q", struct.pack("<d", v))[0]
+        if self.first:
+            self.xor.write_full_float(os, bits)
+            self.first = False
+        else:
+            self.xor.write_next_float(os, bits)
+        self.value = v
+
+    def read(self, stream: IStream) -> float:
+        import struct
+
+        if self.first:
+            self.xor.read_full_float(stream)
+            self.first = False
+        else:
+            self.xor.read_next_float(stream)
+        self.value = struct.unpack(
+            "<d", struct.pack("<Q", self.xor.prev_float_bits)
+        )[0]
+        return self.value
+
+
+class _IntField:
+    def __init__(self) -> None:
+        self.value = 0
+
+    def write(self, os: OStream, v: int) -> None:
+        _write_varint_bits(os, _zigzag(v - self.value))
+        self.value = v
+
+    def read(self, stream: IStream) -> int:
+        self.value += _unzigzag(_read_varint_bits(stream))
+        return self.value
+
+
+class _BytesField:
+    """LRU dictionary of recent values; refs cost 1+3 bits, literals are
+    length-prefixed (encoder.go bytes field strategy)."""
+
+    def __init__(self) -> None:
+        self.lru: list[bytes] = []
+        self.value = b""
+
+    def _touch(self, v: bytes) -> None:
+        if v in self.lru:
+            self.lru.remove(v)
+        self.lru.append(v)
+        if len(self.lru) > _DICT_SIZE:
+            self.lru.pop(0)
+
+    def write(self, os: OStream, v: bytes) -> None:
+        v = bytes(v)
+        if v in self.lru:
+            os.write_bits(0, 1)  # dict ref
+            os.write_bits(self.lru.index(v), _DICT_IDX_BITS)
+        else:
+            os.write_bits(1, 1)  # literal
+            _write_varint_bits(os, len(v))
+            for b in v:
+                os.write_bits(b, 8)
+        self._touch(v)
+        self.value = v
+
+    def read(self, stream: IStream) -> bytes:
+        if stream.read_bits(1) == 0:
+            v = self.lru[stream.read_bits(_DICT_IDX_BITS)]
+        else:
+            n = _read_varint_bits(stream)
+            v = bytes(stream.read_bits(8) for _ in range(n))
+        self._touch(v)
+        self.value = v
+        return v
+
+
+class _BoolField:
+    def __init__(self) -> None:
+        self.value = False
+
+    def write(self, os: OStream, v: bool) -> None:
+        os.write_bits(1 if v else 0, 1)
+        self.value = bool(v)
+
+    def read(self, stream: IStream) -> bool:
+        self.value = stream.read_bits(1) == 1
+        return self.value
+
+
+_FIELD_STATES = {
+    FieldType.DOUBLE: _DoubleField,
+    FieldType.INT64: _IntField,
+    FieldType.BYTES: _BytesField,
+    FieldType.BOOL: _BoolField,
+}
+
+_DEFAULTS = {
+    FieldType.DOUBLE: 0.0,
+    FieldType.INT64: 0,
+    FieldType.BYTES: b"",
+    FieldType.BOOL: False,
+}
+
+
+class ProtoEncoder:
+    def __init__(self, start_nanos: int, schema: Schema, unit: Unit = Unit.SECOND) -> None:
+        self.schema = tuple(schema)
+        self.os = OStream()
+        self.ts = TimestampEncoder(start_nanos, unit)
+        self.unit = unit
+        self._states = [_FIELD_STATES[f.type]() for f in self.schema]
+        self._write_header()
+
+    def _write_header(self) -> None:
+        self.os.write_bits(_VERSION, 8)
+        _write_varint_bits(self.os, len(self.schema))
+        for f in self.schema:
+            self.os.write_bits(int(f.type), 8)
+            name = f.name.encode()
+            _write_varint_bits(self.os, len(name))
+            for b in name:
+                self.os.write_bits(b, 8)
+
+    def encode(self, t_nanos: int, values: dict) -> None:
+        self.ts.write_time(self.os, t_nanos, None, self.unit)
+        changed = []
+        for f, st in zip(self.schema, self._states):
+            v = values.get(f.name, st.value)
+            changed.append(v != st.value or isinstance(st, _DoubleField) and st.first)
+        for c in changed:
+            self.os.write_bits(1 if c else 0, 1)
+        for f, st, c in zip(self.schema, self._states, changed):
+            if c:
+                st.write(self.os, values.get(f.name, st.value))
+
+    def stream(self) -> bytes:
+        raw, pos = self.os.raw_bytes()
+        if not raw:
+            return b""
+        return raw[:-1] + scheme.tail(raw[-1], pos)
+
+
+@dataclass
+class ProtoPoint:
+    timestamp: int
+    values: dict
+
+
+class ProtoReaderIterator:
+    def __init__(self, data: bytes, default_unit: Unit = Unit.SECOND) -> None:
+        self.stream = IStream(data)
+        self.ts = TimestampIterator(default_unit=default_unit)
+        self.schema = self._read_header()
+        self._states = [_FIELD_STATES[f.type]() for f in self.schema]
+        self.current: ProtoPoint | None = None
+
+    def _read_header(self) -> Schema:
+        version = self.stream.read_bits(8)
+        if version != _VERSION:
+            raise ValueError(f"proto codec: unsupported version {version}")
+        n = _read_varint_bits(self.stream)
+        fields = []
+        for _ in range(n):
+            ftype = FieldType(self.stream.read_bits(8))
+            name_len = _read_varint_bits(self.stream)
+            name = bytes(
+                self.stream.read_bits(8) for _ in range(name_len)
+            ).decode()
+            fields.append(Field(name, ftype))
+        return tuple(fields)
+
+    def next(self) -> bool:
+        try:
+            self.ts.read_timestamp(self.stream)
+        except EOFError:
+            return False
+        if self.ts.done:
+            return False
+        changed = [self.stream.read_bits(1) == 1 for _ in self.schema]
+        values = {}
+        for f, st, c in zip(self.schema, self._states, changed):
+            if c:
+                values[f.name] = st.read(self.stream)
+            else:
+                values[f.name] = st.value
+        self.current = ProtoPoint(self.ts.prev_time, values)
+        return True
+
+
+def encode_proto_series(
+    schema: Schema, points: list[tuple[int, dict]], unit: Unit = Unit.SECOND
+) -> bytes:
+    if not points:
+        return b""
+    enc = ProtoEncoder(points[0][0], schema, unit)
+    for t, values in points:
+        enc.encode(t, values)
+    return enc.stream()
+
+
+def decode_proto(data: bytes, default_unit: Unit = Unit.SECOND) -> list[ProtoPoint]:
+    if not data:
+        return []
+    it = ProtoReaderIterator(data, default_unit)
+    out = []
+    while it.next():
+        out.append(it.current)
+    return out

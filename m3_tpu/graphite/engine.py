@@ -41,18 +41,11 @@ class GraphiteEngine:
         (graphite/storage find semantics)."""
         nodes = pattern.split(".")
         depth = len(nodes)
-        from .paths import glob_node_to_regex, is_pattern, node_tag
+        from .paths import node_queries, node_tag
 
-        from ..index.query import FieldQuery, conj, regexp, term
+        from ..index.query import FieldQuery, conj
 
-        qs = [FieldQuery(node_tag(depth - 1))]
-        for i, node in enumerate(nodes):
-            if node == "*":
-                continue
-            if is_pattern(node):
-                qs.append(regexp(node_tag(i), glob_node_to_regex(node).encode()))
-            else:
-                qs.append(term(node_tag(i), node.encode()))
+        qs = [FieldQuery(node_tag(depth - 1))] + node_queries(nodes)
         q = qs[0] if len(qs) == 1 else conj(*qs)
         result = self.db.query_ids(self.namespace, q, 0, 2**62)
         out: dict[str, bool] = {}
@@ -91,7 +84,11 @@ class GraphiteEngine:
             interval = (
                 self._scalar(call.args[1]) if len(call.args) > 1 else "-1d"
             )
-            inner_shift = shift_nanos + parse_interval(interval)
+            delta = parse_interval(interval)
+            if isinstance(interval, str) and not interval.lstrip().startswith(("-", "+")):
+                # graphite-web implies a minus: timeShift(s, '1d') = 1d AGO
+                delta = -delta
+            inner_shift = shift_nanos + delta
             series = self._eval(call.args[0], ctx, inner_shift)
             return fn(ctx, series, interval)
         args = []

@@ -143,8 +143,10 @@ def diff_series(ctx, *lists):
     series = [s for lst in lists for s in lst]
     if not series:
         return []
-    head = np.nan_to_num(series[0].values, nan=np.nan)
-    rest = _stack(series[1:]) if len(series) > 1 else np.zeros((0, len(head)))
+    rest = (
+        _stack(series[1:]) if len(series) > 1
+        else np.zeros((0, len(series[0].values)))
+    )
     sub = _nan_fn(np.nansum, rest) if len(series) > 1 else 0.0
     out = series[0].values - sub
     return [GSeries(f"diffSeries({','.join(s.name for s in series)})", out)]
@@ -318,7 +320,11 @@ def time_shift(ctx, series, interval):
 
 def _moving(name, reducer):
     def fn(ctx, series, window):
-        n = max(int(parse_interval(window) // ctx.step_nanos), 1)
+        # graphite-web: a bare number is a POINT count; strings are intervals
+        if isinstance(window, (int, float)):
+            n = max(int(window), 1)
+        else:
+            n = max(int(parse_interval(window) // ctx.step_nanos), 1)
         out = []
         for s in series:
             vals = s.values
@@ -344,9 +350,14 @@ FUNCS["movingMedian"] = _moving("movingMedian", np.nanmedian)
 @func("summarize")
 def summarize(ctx, series, interval, fn="sum"):
     n = max(int(parse_interval(interval) // ctx.step_nanos), 1)
+    def _last_valid(a, axis):
+        idx = np.where(~np.isnan(a), np.arange(a.shape[1])[None, :], -1).max(axis=1)
+        vals = a[np.arange(a.shape[0]), np.maximum(idx, 0)]
+        return np.where(idx >= 0, vals, np.nan)
+
     red = {
         "sum": np.nansum, "avg": np.nanmean, "average": np.nanmean,
-        "max": np.nanmax, "min": np.nanmin, "last": lambda a, axis: a[:, -1],
+        "max": np.nanmax, "min": np.nanmin, "last": _last_valid,
     }[fn]
     out = []
     for s in series:
@@ -534,7 +545,7 @@ def _base_path(name: str) -> str:
 
 
 @func("groupByNode")
-def group_by_node(ctx, series, node, callback="sum"):
+def group_by_node(ctx, series, node, callback="average"):
     return group_by_nodes(ctx, series, callback, node)
 
 

@@ -49,7 +49,7 @@ class Call:
 _TOKEN = re.compile(
     r"""\s*(?:
       (?P<string>'[^']*'|"[^"]*")
-    | (?P<number>-?\d+\.\d+|-?\.\d+|-?\d+(?![\w.{[*]))
+    | (?P<number>-?\d+\.\d+|-?\.\d+|-?\d+(?![\w.{\[*?]))
     | (?P<path>(?:[A-Za-z_0-9\-.*?$%:]|\{[^}]*\}|\[[^\]]*\])+)
     | (?P<punct>[(),=])
     )""",

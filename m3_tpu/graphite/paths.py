@@ -73,17 +73,23 @@ def glob_node_to_regex(node: str) -> str:
     return "".join(out)
 
 
-def pattern_to_query(pattern: str) -> Query:
-    """Glob path pattern → index query over the per-node tags."""
-    nodes = pattern.split(".")
-    qs: list[Query] = [term(_COUNT_TAG, str(len(nodes)).encode())]
+def node_queries(nodes: list[str]) -> list[Query]:
+    """Per-node term/regexp queries for the non-wildcard path nodes."""
+    qs: list[Query] = []
     for i, node in enumerate(nodes):
         if node == "*":
-            continue  # the count term already pins node presence
+            continue  # wildcard constrains nothing beyond node presence
         if is_pattern(node):
             qs.append(regexp(node_tag(i), glob_node_to_regex(node).encode()))
         else:
             qs.append(term(node_tag(i), node.encode()))
+    return qs
+
+
+def pattern_to_query(pattern: str) -> Query:
+    """Glob path pattern → index query over the per-node tags."""
+    nodes = pattern.split(".")
+    qs = [term(_COUNT_TAG, str(len(nodes)).encode())] + node_queries(nodes)
     if len(qs) == 1:
         return qs[0]
     return conj(*qs)

@@ -139,18 +139,35 @@ class Coordinator:
         step_s = _parse_step(q.get("step", ["10"])[0])
         if step_s <= 0:
             raise ValueError("step must be positive")
+        steps = max(int((end_s - start_s) // step_s), 1)
+        # the graphite path honors the same cost limits as PromQL: bound the
+        # step grid up front, charge fetched output per target
+        limits = self.engine.limits
+        enforcer = None
+        if limits is not None:
+            from ..query.cost import Enforcer, QueryLimitError
+
+            if 0 < limits.max_datapoints < steps:
+                raise QueryLimitError("datapoints", steps, limits.max_datapoints)
+            enforcer = Enforcer(limits, self.engine.global_enforcer)
         engine = self._graphite_engine()
         out = []
-        for target in q.get("target", []):
-            series = engine.render(
-                target, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
-            )
-            for s in series:
-                pts = [
-                    [None if np.isnan(v) else float(v), int(start_s + i * step_s)]
-                    for i, v in enumerate(s.values)
-                ]
-                out.append({"target": s.name, "datapoints": pts})
+        try:
+            for target in q.get("target", []):
+                series = engine.render(
+                    target, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
+                )
+                if enforcer is not None:
+                    enforcer.charge(len(series), len(series) * steps)
+                for s in series:
+                    pts = [
+                        [None if np.isnan(v) else float(v), int(start_s + i * step_s)]
+                        for i, v in enumerate(s.values)
+                    ]
+                    out.append({"target": s.name, "datapoints": pts})
+        finally:
+            if enforcer is not None:
+                enforcer.release()
         return out
 
     def graphite_find(self, pattern: str) -> list[dict]:
