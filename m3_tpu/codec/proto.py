@@ -21,6 +21,7 @@ Wire layout:
 from __future__ import annotations
 
 import enum
+import struct
 from dataclasses import dataclass
 
 from ..utils.xtime import Unit
@@ -92,8 +93,6 @@ class _DoubleField:
         self.value = 0.0
 
     def write(self, os: OStream, v: float) -> None:
-        import struct
-
         bits = struct.unpack("<Q", struct.pack("<d", v))[0]
         if self.first:
             self.xor.write_full_float(os, bits)
@@ -103,8 +102,6 @@ class _DoubleField:
         self.value = v
 
     def read(self, stream: IStream) -> float:
-        import struct
-
         if self.first:
             self.xor.read_full_float(stream)
             self.first = False
@@ -125,7 +122,10 @@ class _IntField:
         self.value = v
 
     def read(self, stream: IStream) -> int:
-        self.value += _unzigzag(_read_varint_bits(stream))
+        # wrap into int64 (the encoder masks deltas to 64 bits, so the
+        # accumulated value must wrap identically at the range boundary)
+        raw = self.value + _unzigzag(_read_varint_bits(stream))
+        self.value = ((raw + 2**63) % 2**64) - 2**63
         return self.value
 
 

@@ -27,6 +27,9 @@ class GraphiteEngine:
     db: object
     namespace: str = "graphite"
     lookback_nanos: int = DEFAULT_LOOKBACK
+    # optional query/cost.py Enforcer: charged at fetch depth, so an
+    # oversized glob aborts before consolidation work happens
+    enforcer: object = None
 
     def render(
         self, target: str, start_nanos: int, end_nanos: int, step_nanos: int
@@ -116,6 +119,10 @@ class GraphiteEngine:
         fetched = self.db.fetch_tagged(
             self.namespace, q, start - self.lookback_nanos, end
         )
+        if self.enforcer is not None:
+            self.enforcer.charge(
+                len(fetched), sum(len(dps) for _, _, dps in fetched)
+            )
         series = []
         for sid, tags, dps in fetched:
             times = np.asarray([dp.timestamp for dp in dps], np.int64)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import re as _re
 import struct
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -59,6 +60,9 @@ class NamespaceIndex:
         self.block_size = block_size_nanos
         self.retention = retention_nanos
         self.blocks: dict[int, IndexBlock] = {}
+        # the index has its own lock (storage/index.go insert queue +
+        # RWMutex role); hot write/query paths no longer ride the db lock
+        self.lock = threading.RLock()
 
     def _block_for(self, t_nanos: int) -> IndexBlock:
         bs = (t_nanos // self.block_size) * self.block_size
@@ -69,9 +73,10 @@ class NamespaceIndex:
         return blk
 
     def write(self, series_id: bytes, tags: Tags, t_nanos: int) -> None:
-        blk = self._block_for(t_nanos)
-        blk.mutable.insert(Document(series_id, tags))
-        blk.dirty = True
+        with self.lock:
+            blk = self._block_for(t_nanos)
+            blk.mutable.insert(Document(series_id, tags))
+            blk.dirty = True
 
     def write_batch(self, entries: list[tuple[bytes, Tags, int]]) -> None:
         for sid, tags, t in entries:
@@ -81,11 +86,12 @@ class NamespaceIndex:
         self, q: Query, start_nanos: int, end_nanos: int, limit: int | None = None
     ) -> QueryResult:
         """storage/index.go:1182 — union across overlapping blocks, dedupe."""
-        segs = []
-        for bs in sorted(self.blocks):
-            if bs + self.block_size <= start_nanos or bs >= end_nanos:
-                continue
-            segs.extend(self.blocks[bs].segments)
+        with self.lock:
+            segs = []
+            for bs in sorted(self.blocks):
+                if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                    continue
+                segs.extend(self.blocks[bs].segments)
         docs = execute(segs, q, limit=limit)
         exhaustive = limit is None or len(docs) < limit
         return QueryResult(docs=docs, exhaustive=exhaustive)
@@ -101,7 +107,9 @@ class NamespaceIndex:
         docs matching q."""
         out: dict[bytes, set[bytes]] = {}
         if q is None:
-            for bs, blk in self.blocks.items():
+            with self.lock:
+                blocks = list(self.blocks.items())
+            for bs, blk in blocks:
                 if bs + self.block_size <= start_nanos or bs >= end_nanos:
                     continue
                 for seg in blk.segments:
@@ -118,9 +126,10 @@ class NamespaceIndex:
         return out
 
     def seal_before(self, t_nanos: int) -> None:
-        for bs, blk in self.blocks.items():
-            if bs + self.block_size <= t_nanos:
-                blk.seal()
+        with self.lock:
+            for bs, blk in list(self.blocks.items()):
+                if bs + self.block_size <= t_nanos:
+                    blk.seal()
 
     def evict_before(
         self, t_nanos: int, base: str | None = None, ns_name: str | None = None
@@ -129,8 +138,9 @@ class NamespaceIndex:
         directory is given, also unlink their persisted segment files so
         expired blocks neither survive on disk nor resurrect at bootstrap
         (storage/index.go block expiry + its file cleanup)."""
-        for bs in [b for b in self.blocks if b + self.block_size <= t_nanos]:
-            del self.blocks[bs]
+        with self.lock:
+            for bs in [b for b in self.blocks if b + self.block_size <= t_nanos]:
+                del self.blocks[bs]
         if base is None or ns_name is None:
             return
         d = self._seg_dir(base, ns_name)
@@ -161,7 +171,9 @@ class NamespaceIndex:
         self.seal_before(t_nanos)
         out = []
         d = self._seg_dir(base, ns_name)
-        for bs, blk in sorted(self.blocks.items()):
+        with self.lock:
+            blocks = sorted(self.blocks.items())
+        for bs, blk in blocks:
             if bs + self.block_size > t_nanos or not blk.sealed:
                 continue
             path = os.path.join(d, f"segments-{bs}.db")

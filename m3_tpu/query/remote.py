@@ -1,0 +1,67 @@
+"""Remote coordinator federation: query another coordinator as a Storage.
+
+Reference: /root/reference/src/query/remote/ — coordinators federate reads
+across clusters/regions by speaking a compressed series protocol to each
+other (compressed_codecs.go over gRPC). Here the transport is the Prometheus
+remote-read endpoint every coordinator already serves
+(/api/v1/prom/remote/read, snappy + prompb): RemoteCoordinatorStorage
+implements the engine's Storage seam, so a FanoutStorage can mix local
+namespaces and remote coordinators in one query.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..gen import prompb_pb2 as prompb
+from ..utils.snappy import compress, decompress
+from .promql import Matcher
+
+MS = 1_000_000
+
+_OP_TO_TYPE = {"=": 0, "!=": 1, "=~": 2, "!~": 3}
+
+
+@dataclass
+class RemoteCoordinatorStorage:
+    """Engine Storage backed by a peer coordinator's remote-read API."""
+
+    base_url: str  # e.g. "http://coordinator-west:7201"
+    timeout: float = 30.0
+
+    def fetch(self, matchers: list[Matcher], start_nanos: int, end_nanos: int):
+        req = prompb.ReadRequest()
+        q = req.queries.add()
+        q.start_timestamp_ms = start_nanos // MS
+        q.end_timestamp_ms = max((end_nanos - 1) // MS, q.start_timestamp_ms)
+        for m in matchers:
+            q.matchers.add(
+                type=_OP_TO_TYPE[m.op], name=m.name, value=m.value
+            )
+        body = compress(req.SerializeToString())
+        http_req = urllib.request.Request(
+            f"{self.base_url}/api/v1/prom/remote/read",
+            data=body,
+            headers={"Content-Type": "application/x-protobuf"},
+        )
+        with urllib.request.urlopen(http_req, timeout=self.timeout) as resp:
+            raw = decompress(resp.read())
+        read_resp = prompb.ReadResponse()
+        read_resp.ParseFromString(raw)
+        out = []
+        for result in read_resp.results:
+            for ts in result.timeseries:
+                tags = tuple(
+                    sorted(
+                        (l.name.encode(), l.value.encode()) for l in ts.labels
+                    )
+                )
+                times = np.asarray(
+                    [s.timestamp * MS for s in ts.samples], np.int64
+                )
+                vals = np.asarray([s.value for s in ts.samples], np.float64)
+                out.append((tags, times, vals))
+        return out

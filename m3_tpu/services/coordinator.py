@@ -124,11 +124,11 @@ class Coordinator:
 
     # --- graphite (src/query/api/v1/handler/graphite/render.go + find.go) ---
 
-    def _graphite_engine(self):
+    def _graphite_engine(self, enforcer=None):
         from ..graphite.engine import GraphiteEngine
 
         ns = "graphite" if "graphite" in self.db.namespaces else self.namespace
-        return GraphiteEngine(self.db, namespace=ns)
+        return GraphiteEngine(self.db, namespace=ns, enforcer=enforcer)
 
     def graphite_render(self, q: dict) -> list[dict]:
         import time as _time
@@ -150,15 +150,15 @@ class Coordinator:
             if 0 < limits.max_datapoints < steps:
                 raise QueryLimitError("datapoints", steps, limits.max_datapoints)
             enforcer = Enforcer(limits, self.engine.global_enforcer)
-        engine = self._graphite_engine()
+        # the enforcer rides inside the engine's fetch, so oversized globs
+        # abort at fetch depth (like the PromQL path), not after rendering
+        engine = self._graphite_engine(enforcer=enforcer)
         out = []
         try:
             for target in q.get("target", []):
                 series = engine.render(
                     target, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
                 )
-                if enforcer is not None:
-                    enforcer.charge(len(series), len(series) * steps)
                 for s in series:
                     pts = [
                         [None if np.isnan(v) else float(v), int(start_s + i * step_s)]

@@ -1,0 +1,151 @@
+"""Load generator: sustained synthetic write/query load against a node or
+coordinator.
+
+Reference: /root/reference/src/m3nsch/ (+ m3comparator) — the load tier
+drives configurable concurrent write workloads with unique series cardinality
+and reports achieved rates. Run:
+
+    python -m m3_tpu.services.loadgen --node 127.0.0.1:9000 \
+        --series 10000 --rate 5000 --duration 10
+
+or against a coordinator's JSON write API with --coordinator host:port.
+Prints one JSON line of achieved stats at the end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+NANOS = 1_000_000_000
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="m3tpu-loadgen", description=__doc__)
+    p.add_argument("--node", default="", help="dbnode RPC host:port")
+    p.add_argument("--coordinator", default="", help="coordinator HTTP host:port")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--series", type=int, default=1000, help="unique series")
+    p.add_argument("--rate", type=float, default=1000.0, help="target writes/sec")
+    p.add_argument("--duration", type=float, default=10.0, help="seconds")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--batch", type=int, default=100, help="writes per RPC batch")
+    p.add_argument("--read-fraction", type=float, default=0.0,
+                   help="fraction of ops that are reads of a random series")
+    return p
+
+
+class Stats:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.writes = 0
+        self.reads = 0
+        self.errors = 0
+
+    def add(self, writes=0, reads=0, errors=0) -> None:
+        with self.lock:
+            self.writes += writes
+            self.reads += reads
+            self.errors += errors
+
+
+def run(args, make_client) -> dict:
+    stats = Stats()
+    stop = time.monotonic() + args.duration
+    per_worker_rate = args.rate / max(args.workers, 1)
+
+    def worker(widx: int) -> None:
+        client = make_client()
+        rnd = widx * 2654435761 % args.series
+        next_send = time.monotonic()
+        while time.monotonic() < stop:
+            batch = []
+            now_nanos = time.time_ns()
+            for i in range(args.batch):
+                sid = f"load.series.{(rnd + i) % args.series}".encode()
+                batch.append((sid, now_nanos + i, float(i)))
+            rnd = (rnd + args.batch) % args.series
+            try:
+                if args.read_fraction and (rnd % 100) < args.read_fraction * 100:
+                    client.read(args.namespace, batch[0][0], 0, 2**62)
+                    stats.add(reads=1)
+                client.write_batch(args.namespace, batch)
+                stats.add(writes=len(batch))
+            except Exception:
+                stats.add(errors=1)
+            next_send += args.batch / per_worker_rate
+            delay = next_send - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(args.workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.duration + 30)
+    elapsed = time.monotonic() - t0
+    return {
+        "writes": stats.writes,
+        "reads": stats.reads,
+        "errors": stats.errors,
+        "elapsed_secs": round(elapsed, 3),
+        "achieved_writes_per_sec": round(stats.writes / elapsed, 1),
+        "target_writes_per_sec": args.rate,
+        "series": args.series,
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.node:
+        from ..net.client import RemoteNode
+
+        host, port = args.node.rsplit(":", 1)
+
+        def make_client():
+            return RemoteNode(host, int(port))
+
+    elif args.coordinator:
+        import urllib.request
+
+        base = f"http://{args.coordinator}"
+
+        class HttpClient:
+            def write_batch(self, ns, batch):
+                for sid, t, v in batch:
+                    body = json.dumps(
+                        {
+                            "tags": {"__name__": sid.decode()},
+                            "timestamp": t / NANOS,
+                            "value": v,
+                        }
+                    ).encode()
+                    urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"{base}/api/v1/json/write", data=body
+                        ),
+                        timeout=10,
+                    )
+
+            def read(self, ns, sid, start, end):
+                return []
+
+        def make_client():
+            return HttpClient()
+
+    else:
+        print("loadgen: need --node or --coordinator", file=sys.stderr)
+        return 2
+    print(json.dumps(run(args, make_client)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

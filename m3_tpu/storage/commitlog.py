@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import re
 import struct
+import threading
 import zlib
 from dataclasses import dataclass
 
@@ -63,6 +64,9 @@ class CommitLog:
     def __init__(self, dir_path: str, flush_every: int = 64) -> None:
         self.dir = dir_path
         self.flush_every = flush_every
+        # single-writer lock: appends from per-shard write paths serialize
+        # here (the reference's commit log has its own writer queue)
+        self._wlock = threading.RLock()
         os.makedirs(dir_path, exist_ok=True)
         segs = _list_segments(dir_path)
         # a fresh segment per open — the previous process's tail stays sealed
@@ -80,6 +84,10 @@ class CommitLog:
         return f
 
     def write(self, entry: CommitLogEntry) -> None:
+        with self._wlock:
+            self._write_locked(entry)
+
+    def _write_locked(self, entry: CommitLogEntry) -> None:
         payload = (
             struct.pack(
                 "<qdBH",
@@ -99,20 +107,27 @@ class CommitLog:
             self.flush()
 
     def write_batch(self, entries: list[CommitLogEntry]) -> None:
-        for e in entries:
-            self.write(e)
-        self.flush()
+        with self._wlock:
+            for e in entries:
+                self._write_locked(e)
+            self.flush()
 
     def flush(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._pending = 0
+        with self._wlock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._pending = 0
 
     def close(self) -> None:
-        self.flush()
-        self._f.close()
+        with self._wlock:
+            self.flush()
+            self._f.close()
 
     def rotate(self) -> int:
+        with self._wlock:
+            return self._rotate_locked()
+
+    def _rotate_locked(self) -> int:
         """RotateLogs (:370): seal the active segment, open the next.
         Returns the sealed segment's sequence number. Rotating an EMPTY
         active segment is a no-op (a periodic mediator would otherwise

@@ -66,6 +66,11 @@ class Shard:
         self.namespace = ns
         self.opts = opts
         self.base = base
+        # per-shard lock (shard.go RWMutex role): hot-path reads/writes
+        # contend only within a shard; lifecycle ops (flush/tick) take the
+        # database lock FIRST then shard locks, writers take only this one,
+        # so the lock order is always db -> shard
+        self.lock = threading.RLock()
         self.series: dict[bytes, SeriesBuffer] = {}
         self._flushed_blocks: set[int] = set()
         self._filesets: list[FilesetID] | None = None  # listdir cache
@@ -73,14 +78,19 @@ class Shard:
         self.reader_materializations = 0  # observability: fileset loads
 
     def filesets(self) -> list[FilesetID]:
-        if self._filesets is None:
-            self._filesets = list_filesets(self.base, self.namespace, self.id)
-        return self._filesets
+        with self.lock:
+            if self._filesets is None:
+                self._filesets = list_filesets(self.base, self.namespace, self.id)
+            return self._filesets
 
     def _invalidate_filesets(self) -> None:
         self._filesets = None
 
     def reader(self, fid: FilesetID) -> FilesetReader:
+        with self.lock:
+            return self._reader_locked(fid)
+
+    def _reader_locked(self, fid: FilesetID) -> FilesetReader:
         cached = self._readers.get(fid.block_start)
         if cached is not None and cached.fid.volume == fid.volume:
             return cached
@@ -100,14 +110,19 @@ class Shard:
             )
 
     def write(self, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND) -> None:
-        self.check_write(t_nanos)
-        buf = self.series.get(sid)
-        if buf is None:
-            buf = SeriesBuffer(sid, self.opts.block_size_nanos)
-            self.series[sid] = buf
-        buf.write(t_nanos, value, unit)
+        with self.lock:
+            self.check_write(t_nanos)
+            buf = self.series.get(sid)
+            if buf is None:
+                buf = SeriesBuffer(sid, self.opts.block_size_nanos)
+                self.series[sid] = buf
+            buf.write(t_nanos, value, unit)
 
     def read(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
+        with self.lock:
+            return self._read_locked(sid, start, end)
+
+    def _read_locked(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
         out: list[Datapoint] = []
         # flushed filesets first (older), then buffer (newer wins on dupes)
         for fid in self.filesets():
@@ -126,6 +141,10 @@ class Shard:
 
     def warm_flush(self, flush_before_nanos: int) -> list[FilesetID]:
         """shard.go:2146 — write filesets for complete blocks, then evict."""
+        with self.lock:
+            return self._warm_flush_locked(flush_before_nanos)
+
+    def _warm_flush_locked(self, flush_before_nanos: int) -> list[FilesetID]:
         blocks: dict[int, dict[bytes, bytes]] = {}
         for sid, buf in self.series.items():
             for bs, stream in buf.streams_before(flush_before_nanos).items():
@@ -150,6 +169,10 @@ class Shard:
         """shard.go:2212 + persist/fs/merger.go — out-of-order writes into
         already-flushed blocks merge with the existing fileset ONCE PER BLOCK
         (all cold series together) and go out as one new volume."""
+        with self.lock:
+            return self._cold_flush_locked(flush_before_nanos)
+
+    def _cold_flush_locked(self, flush_before_nanos: int) -> list[FilesetID]:
         # gather every cold stream per block first, so each block merges once
         cold: dict[int, dict[bytes, bytes]] = {}
         for sid, buf in list(self.series.items()):
@@ -191,6 +214,10 @@ class Shard:
     def tick(self, now_nanos: int) -> None:
         """shard.go:663 tickAndExpire: drop series/blocks past retention,
         expired filesets off disk, and stale cached readers."""
+        with self.lock:
+            self._tick_locked(now_nanos)
+
+    def _tick_locked(self, now_nanos: int) -> None:
         expire_before = now_nanos - self.opts.retention_nanos
         for sid in list(self.series):
             buf = self.series[sid]
@@ -244,9 +271,12 @@ class Database:
         # new-series insert rate limit (runtime options; 0 = unlimited)
         self._new_series_limit = 0
         self._new_series_window = (0, 0)  # (second, count)
-        # Serializes write/read/flush across request threads — the reference
-        # guards these paths with per-shard locks (shard.go RLock/Lock); a
-        # single re-entrant lock is the current granularity.
+        self._limit_lock = threading.Lock()
+        # Lifecycle lock: create_namespace / flush / snapshot / tick /
+        # bootstrap / stream_shard. Hot-path reads and writes take ONLY the
+        # per-shard locks (shard.go RWMutex granularity); lifecycle ops take
+        # this lock first, then shard locks, so the order is always
+        # db -> shard and a flush of one shard never blocks reads of others.
         self.lock = threading.RLock()
 
     def create_namespace(self, name: str, opts: NamespaceOptions | None = None) -> Namespace:
@@ -263,10 +293,11 @@ class Database:
     def write(
         self, ns: str, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND
     ) -> None:
-        with self.lock:
-            namespace = self.namespaces[ns]
-            shard = namespace.shard_for(sid)
-            is_new = self._check_new_series(shard, sid)
+        namespace = self.namespaces[ns]
+        shard = namespace.shard_for(sid)
+        with shard.lock:
+            with self._limit_lock:
+                is_new = self._check_new_series(shard, sid)
             # buffer first so rejected writes (ColdWriteError) never reach the
             # WAL — a logged-but-unacceptable entry would poison replay
             try:
@@ -275,31 +306,40 @@ class Database:
                 self._m_write_errors.inc()
                 raise
             if is_new and self._new_series_limit > 0:
-                self._consume_new_series()
-            self._m_writes.inc()
+                with self._limit_lock:
+                    self._consume_new_series()
+            # WAL append under the shard lock: buffer apply and log entry
+            # are one atomic unit per series, so replay order can't diverge
+            # from the order reads observed (the WAL lock nests inside
+            # shard locks everywhere)
             cl = self._commitlogs.get(ns)
             if cl is not None:
                 cl.write(CommitLogEntry(sid, t_nanos, value, unit))
+        self._m_writes.inc()
 
     def write_batch(self, ns: str, entries: list[tuple[bytes, int, float]]) -> None:
-        with self.lock:
-            namespace = self.namespaces[ns]
-            # validate the whole batch before applying any entry, so a
-            # rejected write can't leave a partially-applied unlogged batch
-            for sid, t, v in entries:
-                namespace.shard_for(sid).check_write(t)
+        namespace = self.namespaces[ns]
+        cl = self._commitlogs.get(ns)
+        # apply + log per entry; if an entry is rejected midway (a flush can
+        # seal a block between entries), everything ALREADY applied is still
+        # WAL-logged before the error propagates, so no applied write is
+        # ever unlogged
+        applied: list[CommitLogEntry] = []
+        try:
             for sid, t, v in entries:
                 shard = namespace.shard_for(sid)
-                is_new = self._check_new_series(shard, sid)
-                shard.write(sid, t, v)
-                if is_new and self._new_series_limit > 0:
-                    self._consume_new_series()
+                with shard.lock:
+                    with self._limit_lock:
+                        is_new = self._check_new_series(shard, sid)
+                    shard.write(sid, t, v)
+                    if is_new and self._new_series_limit > 0:
+                        with self._limit_lock:
+                            self._consume_new_series()
+                    applied.append(CommitLogEntry(sid, t, v))
                 self._m_writes.inc()
-            cl = self._commitlogs.get(ns)
-            if cl is not None:
-                cl.write_batch(
-                    [CommitLogEntry(sid, t, v) for sid, t, v in entries]
-                )
+        finally:
+            if cl is not None and applied:
+                cl.write_batch(applied)
 
     def apply_runtime_options(self, ro) -> None:
         """storage/runtime.py listener target: live-tunable node knobs."""
@@ -333,9 +373,10 @@ class Database:
         self._new_series_window = (sec, count + 1)
 
     def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
-        with self.lock:
-            self._m_reads.inc()
-            return self.namespaces[ns].shard_for(sid).read(sid, start, end)
+        self._m_reads.inc()
+        # per-shard locking (inside Shard.read): reads don't serialize
+        # against other shards or the database lifecycle lock
+        return self.namespaces[ns].shard_for(sid).read(sid, start, end)
 
     # --- tagged write / index query path (database.go:606 WriteTagged,
     # :785 QueryIDs; network FetchTagged mirrors this) ---
@@ -346,33 +387,30 @@ class Database:
         from ..rules.rules import encode_tags_id
 
         sid = encode_tags_id(tags)
-        with self.lock:
-            namespace = self.namespaces[ns]
-            # data first: a rejected write (ColdWriteError) must not leave a
-            # phantom entry in the reverse index
-            self.write(ns, sid, t_nanos, value, unit)
-            if namespace.index is not None:
-                namespace.index.write(sid, tags, t_nanos)
+        namespace = self.namespaces[ns]
+        # data first: a rejected write (ColdWriteError) must not leave a
+        # phantom entry in the reverse index
+        self.write(ns, sid, t_nanos, value, unit)
+        if namespace.index is not None:
+            namespace.index.write(sid, tags, t_nanos)
         return sid
 
     def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None):
-        with self.lock:
-            namespace = self.namespaces[ns]
-            if namespace.index is None:
-                raise RuntimeError(f"namespace {ns} has no index")
-            return namespace.index.query(query, start, end, limit=limit)
+        namespace = self.namespaces[ns]
+        if namespace.index is None:
+            raise RuntimeError(f"namespace {ns} has no index")
+        return namespace.index.query(query, start, end, limit=limit)
 
     def fetch_tagged(
         self, ns: str, query, start: int, end: int, limit: int | None = None
     ) -> list[tuple[bytes, tuple, list[Datapoint]]]:
         """Index query + per-series read (the FetchTagged server path,
         tchannelthrift/node/service.go:626)."""
-        with self.lock:
-            result = self.query_ids(ns, query, start, end, limit=limit)
-            out = []
-            for doc in result.docs:
-                out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
-            return out
+        result = self.query_ids(ns, query, start, end, limit=limit)
+        out = []
+        for doc in result.docs:
+            out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
+        return out
 
     def stream_shard(self, ns: str, shard_id: int) -> list:
         """Peer streaming (FetchBootstrapBlocksFromPeers / repair source):
@@ -381,12 +419,15 @@ class Database:
         with self.lock:
             namespace = self.namespaces[ns]
             sh = namespace.shards[shard_id]
-            sids = set(sh.series)
-            for fid in sh.filesets():
-                sids.update(sh.reader(fid).series_ids)
+            with sh.lock:
+                sids = set(sh.series)
+                for fid in sh.filesets():
+                    sids.update(sh.reader(fid).series_ids)
             docs: dict[bytes, tuple] = {}
             if namespace.index is not None and sids:
-                for blk in namespace.index.blocks.values():
+                with namespace.index.lock:
+                    blocks = list(namespace.index.blocks.values())
+                for blk in blocks:
                     for seg in blk.segments:
                         for d in seg.docs:
                             if d.id in sids:
@@ -448,15 +489,16 @@ class Database:
             namespace = self.namespaces[ns]
             total = 0
             for shard in namespace.shards:
-                vol_now = {f.block_start: f.volume for f in shard.filesets()}
-                records = []
-                for sid, buf in shard.series.items():
-                    for bs, bucket in buf.buckets.items():
-                        stream = bucket.merged_stream()
-                        if stream:
-                            records.append(
-                                (sid, bs, stream, vol_now.get(bs, -1))
-                            )
+                with shard.lock:  # consistent buffer capture vs writers
+                    vol_now = {f.block_start: f.volume for f in shard.filesets()}
+                    records = []
+                    for sid, buf in shard.series.items():
+                        for bs, bucket in buf.buckets.items():
+                            stream = bucket.merged_stream()
+                            if stream:
+                                records.append(
+                                    (sid, bs, stream, vol_now.get(bs, -1))
+                                )
                 if records:
                     write_snapshot(self.base, ns, shard.id, records)
                 else:
