@@ -35,7 +35,7 @@ def main() -> None:
 
     n_points = 720
     k = 24
-    n_series = int(os.environ.get("BENCH_SERIES", 262144))
+    n_series = int(os.environ.get("BENCH_SERIES", 524288))
     platform = jax.devices()[0].platform
     if platform == "cpu":
         n_series = min(n_series, 4096)
