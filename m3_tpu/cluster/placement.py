@@ -155,6 +155,87 @@ def remove_instance(p: Placement, iid: str) -> Placement:
     return p
 
 
+def replace_instance(p: Placement, old_id: str, new_id: str) -> Placement:
+    """algo/sharded.go ReplaceInstances: the new instance inherits ALL of the
+    old one's shards as INITIALIZING (streaming from the leaving instance);
+    the old instance's shards turn LEAVING and are removed once the new
+    instance marks them available (mark_shards_available)."""
+    if new_id in p.instances:
+        raise ValueError(f"instance {new_id} already in placement")
+    old = p.instances[old_id]
+    new_inst = Instance(new_id, isolation_group=old.isolation_group, weight=old.weight)
+    for s, a in old.shards.items():
+        # a shard the old instance was itself still INITIALIZING has no data
+        # there — the replacement inherits the ORIGINAL stream source
+        source = (
+            a.source_instance
+            if a.state == ShardState.INITIALIZING and a.source_instance
+            else old_id
+        )
+        new_inst.shards[s] = ShardAssignment(
+            s, ShardState.INITIALIZING, source_instance=source
+        )
+        a.state = ShardState.LEAVING
+    p.instances[new_id] = new_inst
+    p.version += 1
+    return p
+
+
+def mark_shards_available(p: Placement, iid: str, shards=None) -> Placement:
+    """MarkShardsAvailable (placement/service): INITIALIZING → AVAILABLE on
+    ``iid``; the matching LEAVING shard on the source instance is dropped
+    (and an emptied leaving instance is removed from the placement)."""
+    inst = p.instances[iid]
+    ids = list(inst.shards) if shards is None else shards
+    emptied_sources: set[str] = set()
+    for s in ids:
+        a = inst.shards.get(s)
+        if a is None or a.state != ShardState.INITIALIZING:
+            continue
+        if a.source_instance:
+            src = p.instances.get(a.source_instance)
+            if src is not None:
+                sa = src.shards.get(s)
+                if sa is not None and sa.state == ShardState.LEAVING:
+                    del src.shards[s]
+                    if not src.shards:
+                        emptied_sources.add(src.id)
+        a.state = ShardState.AVAILABLE
+        a.source_instance = None
+    # only sources THIS call emptied leave the placement — an instance that
+    # legitimately owns zero shards stays
+    for gone in emptied_sources:
+        if not p.instances[gone].shards:
+            del p.instances[gone]
+    p.version += 1
+    return p
+
+
+def build_mirrored_placement(
+    groups: list[list[str]], num_shards: int
+) -> Placement:
+    """algo/mirrored.go: instances within a group mirror each other — every
+    member owns the IDENTICAL shard set (the aggregator's leader/follower
+    pairs are placed this way); replica factor = group size."""
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError("mirrored placement requires equal-size groups")
+    rf = sizes.pop()
+    if rf == 0 or not groups:
+        raise ValueError("mirrored placement requires non-empty groups")
+    p = Placement(num_shards=num_shards, replica_factor=rf)
+    for gi, group in enumerate(groups):
+        for iid in group:
+            inst = Instance(iid, isolation_group=f"group{gi}")
+            # contiguous shard range per group, remainder to the last group
+            lo = num_shards * gi // len(groups)
+            hi = num_shards * (gi + 1) // len(groups)
+            for s in range(lo, hi):
+                inst.shards[s] = ShardAssignment(s, ShardState.AVAILABLE)
+            p.instances[iid] = inst
+    return p
+
+
 class PlacementService:
     """placement.Service: placements stored + versioned in KV."""
 

@@ -94,7 +94,8 @@ class Producer:
         self.max_retries = max_retries
         self._consumers: dict[str, list[Consumer]] = {}
         self._next_id = 0
-        self._unacked: list[tuple[Message, str, int]] = []  # (msg, service, attempts)
+        # (msg, service, target instance id or None, attempts)
+        self._unacked: list[tuple[Message, str, str | None, int]] = []
         self._lock = threading.RLock()
 
     def register(self, consumer: Consumer) -> None:
@@ -110,20 +111,34 @@ class Producer:
             return cs  # every instance gets every shard (replicated topic)
         return [cs[shard % len(cs)]]  # shared: shard-owned instance
 
+    def _is_replicated(self, service: str) -> bool:
+        svc = next(
+            (c for c in self.topic.consumer_services if c.name == service), None
+        )
+        return bool(svc and svc.consumption_type == "replicated")
+
     def produce(self, shard: int, payload: bytes) -> int:
-        """At-least-once: deliver to each consumer service; queue failures."""
+        """At-least-once: deliver to each consumer service; queue failures.
+        Replicated services track acks PER INSTANCE — one mirror acking must
+        not swallow another mirror's missed delivery."""
         with self._lock:
             self._next_id += 1
             mid = self._next_id
         for svc in self.topic.consumer_services:
             msg = Message(shard=shard % self.topic.num_shards, payload=payload, id=mid)
-            delivered = False
-            for c in self._route(svc.name, msg.shard):
-                if c.deliver(msg):
-                    delivered = True
-            if not delivered:
+            replicated = self._is_replicated(svc.name)
+            targets = self._route(svc.name, msg.shard)
+            any_ok = False
+            for c in targets:
+                ok = c.deliver(msg)
+                any_ok = any_ok or ok
+                if replicated and not ok:
+                    with self._lock:
+                        self._unacked.append((msg, svc.name, c.id, 0))
+            if not replicated and not any_ok:
+                # shared: re-route at retry time (the owner may change)
                 with self._lock:
-                    self._unacked.append((msg, svc.name, 0))
+                    self._unacked.append((msg, svc.name, None, 0))
         return mid
 
     def retry_unacked(self) -> int:
@@ -133,13 +148,18 @@ class Producer:
             pending = self._unacked
             self._unacked = []
         still = []
-        for msg, service, attempts in pending:
-            delivered = False
-            for c in self._route(service, msg.shard):
-                if c.deliver(msg):
-                    delivered = True
+        for msg, service, target_id, attempts in pending:
+            if target_id is None:
+                targets = self._route(service, msg.shard)
+            else:
+                targets = [
+                    c
+                    for c in self._consumers.get(service, [])
+                    if c.id == target_id
+                ]
+            delivered = any(c.deliver(msg) for c in targets)
             if not delivered and attempts + 1 < self.max_retries:
-                still.append((msg, service, attempts + 1))
+                still.append((msg, service, target_id, attempts + 1))
         with self._lock:
             self._unacked.extend(still)
         return len(self._unacked)

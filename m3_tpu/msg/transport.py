@@ -1,0 +1,141 @@
+"""m3msg wire transport: framed messages + acks over TCP.
+
+Reference: /root/reference/src/msg/protocol/proto/ (message / ack
+round-trip) and consumer/server — the bus's Producer routes to Consumer
+objects; RemoteConsumer is that surface over a socket, so the same producer
+code drives in-process queues in tests and real connections in deployment.
+Frames are net.wire values: {"id", "shard", "payload"} → {"ack": id}.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+from ..net import wire
+from .bus import Message
+
+
+class ConsumerServer:
+    """Socket front end for one consumer-service instance: decode message
+    frames, hand to the handler, ack on success."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.handler = handler  # Message -> bool (True = ack)
+        self.received = 0
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+                try:
+                    while True:
+                        try:
+                            req = wire.recv_frame(self.request)
+                        except (ConnectionError, OSError, ValueError):
+                            return
+                        msg = Message(
+                            shard=req["shard"], payload=req["payload"], id=req["id"]
+                        )
+                        outer.received += 1
+                        try:
+                            ok = bool(outer.handler(msg))
+                        except Exception:
+                            ok = False
+                        try:
+                            wire.send_frame(self.request, {"ack": req["id"], "ok": ok})
+                        except (ConnectionError, OSError):
+                            return
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="m3tpu-msg-consumer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        # shutdown() only stops new accepts; sever live connections too so a
+        # stopped consumer really goes away (its handler threads exit on the
+        # closed socket)
+        with self._conns_lock:
+            for conn in self._conns:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+            self._conns.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class RemoteConsumer:
+    """bus.Consumer surface over a socket: deliver() sends the frame and
+    waits for the ack, returning False on any transport failure (the
+    producer's unacked queue + retry sweep then take over)."""
+
+    def __init__(
+        self, service: str, instance_id: str, host: str, port: int,
+        timeout: float = 10.0,
+    ) -> None:
+        self.service = service
+        self.id = instance_id
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.is_up = True
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def deliver(self, msg: Message) -> bool:
+        if not self.is_up:
+            return False
+        with self._lock:
+            for fresh in (False, True):
+                try:
+                    if self._sock is None or fresh:
+                        if self._sock is not None:
+                            self._sock.close()
+                        self._sock = self._connect()
+                    wire.send_frame(
+                        self._sock,
+                        {"id": msg.id, "shard": msg.shard, "payload": msg.payload},
+                    )
+                    resp = wire.recv_frame(self._sock)
+                    return bool(resp.get("ok")) and resp.get("ack") == msg.id
+                except (ConnectionError, OSError, ValueError):
+                    if self._sock is not None:
+                        self._sock.close()
+                        self._sock = None
+                    continue
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

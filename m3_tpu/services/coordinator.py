@@ -173,14 +173,64 @@ class Coordinator:
     def graphite_find(self, pattern: str) -> list[dict]:
         return self._graphite_engine().find(pattern)
 
-    def labels(self) -> list[str]:
+    @staticmethod
+    def _parse_prom_matchers(expr: str) -> list[Matcher]:
+        """A match[] selector string → matchers (reuses the PromQL parser)."""
+        from ..query.promql import VectorSelector, parse
+
+        ast = parse(expr)
+        if not isinstance(ast, VectorSelector):
+            raise ValueError(f"match[] must be a series selector: {expr!r}")
+        matchers = list(ast.matchers)
+        if ast.name:
+            matchers.append(Matcher("__name__", "=", ast.name))
+        return matchers
+
+    def _index_query(self, match_exprs: list[str]):
+        from ..query.m3_storage import matchers_to_index_query
+
+        if not match_exprs:
+            return None
+        from ..index.query import disj
+
+        qs = [
+            matchers_to_index_query(self._parse_prom_matchers(e))
+            for e in match_exprs
+        ]
+        return qs[0] if len(qs) == 1 else disj(*qs)
+
+    def series(self, match_exprs: list[str], start_nanos: int, end_nanos: int):
+        """/api/v1/series (api/v1/handler/prometheus/native + remote in the
+        reference): label sets of series matching any selector."""
         ns = self.db.namespaces[self.namespace]
-        agg = ns.index.aggregate_query(None, 0, 2**62)
+        if not match_exprs:
+            # prometheus requires at least one selector; an unbounded full
+            # index dump would bypass the cost limits
+            raise ValueError("series endpoint requires at least one match[]")
+        q = self._index_query(match_exprs)
+        limit = None
+        if self.engine.limits is not None and self.engine.limits.max_series:
+            limit = self.engine.limits.max_series
+        result = ns.index.query(q, start_nanos, end_nanos, limit=limit)
+        return [
+            {k.decode(): v.decode() for k, v in doc.fields}
+            for doc in result.docs
+        ]
+
+    def labels(self, match_exprs: list[str] | None = None,
+               start_nanos: int = 0, end_nanos: int = 2**62) -> list[str]:
+        ns = self.db.namespaces[self.namespace]
+        q = self._index_query(match_exprs or [])
+        agg = ns.index.aggregate_query(q, start_nanos, end_nanos)
         return sorted(k.decode() for k in agg)
 
-    def label_values(self, name: str) -> list[str]:
+    def label_values(self, name: str, match_exprs: list[str] | None = None,
+                     start_nanos: int = 0, end_nanos: int = 2**62) -> list[str]:
         ns = self.db.namespaces[self.namespace]
-        agg = ns.index.aggregate_query(None, 0, 2**62, field_filter=[name.encode()])
+        q = self._index_query(match_exprs or [])
+        agg = ns.index.aggregate_query(
+            q, start_nanos, end_nanos, field_filter=[name.encode()]
+        )
         return sorted(v.decode() for v in agg.get(name.encode(), ()))
 
 
@@ -265,9 +315,22 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/api/v1/query":
                 self._json(c.query_instant(q["query"][0], float(q["time"][0])))
             elif url.path == "/api/v1/labels":
-                self._json({"status": "success", "data": c.labels()})
+                self._json(
+                    {"status": "success",
+                     "data": c.labels(q.get("match[]", []), *_prom_range(q))}
+                )
+            elif url.path == "/api/v1/series":
+                self._json(
+                    {"status": "success",
+                     "data": c.series(q.get("match[]", []), *_prom_range(q))}
+                )
             elif (m := re.match(r"^/api/v1/label/([^/]+)/values$", url.path)) is not None:
-                self._json({"status": "success", "data": c.label_values(m.group(1))})
+                self._json(
+                    {"status": "success",
+                     "data": c.label_values(
+                         m.group(1), q.get("match[]", []), *_prom_range(q)
+                     )}
+                )
             elif url.path == "/api/v1/services/m3db/placement":
                 p = c.placement_svc.get()
                 self._json(p.to_dict() if p else {}, 200 if p else 404)
@@ -351,6 +414,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": "not found"}, 404)
         except Exception as exc:
             self._json({"status": "error", "error": str(exc)}, 400)
+
+
+def _prom_range(q: dict) -> tuple[int, int]:
+    """start/end query params (epoch seconds) → nanos, unbounded defaults."""
+    start = q.get("start", [None])[0]
+    end = q.get("end", [None])[0]
+    s = int(float(start) * NANOS) if start is not None else 0
+    e = int(float(end) * NANOS) if end is not None else 2**62
+    return s, e
 
 
 def _graphite_time(s: str, now_s: float) -> float:

@@ -94,6 +94,36 @@ def test_labels_and_values(server):
     assert vals == ["a", "b"]
 
 
+def test_series_endpoint_and_matcher_scoped_labels(server):
+    base, coord = server
+    # seed distinct series (module fixture may already hold others)
+    for job, inst in (("apiX", "i1"), ("apiX", "i2"), ("dbX", "i3")):
+        body = json.dumps(
+            {
+                "tags": {"__name__": "sreqs", "job": job, "inst": inst},
+                "timestamp": T0,
+                "value": 1.0,
+            }
+        ).encode()
+        post(f"{base}/api/v1/json/write", body, ctype="application/json")
+
+    out = get_json(f"{base}/api/v1/series?match[]=sreqs{{job=\"apiX\"}}")
+    assert out["status"] == "success"
+    got = {frozenset(d.items()) for d in out["data"]}
+    assert got == {
+        frozenset({"__name__": "sreqs", "job": "apiX", "inst": "i1"}.items()),
+        frozenset({"__name__": "sreqs", "job": "apiX", "inst": "i2"}.items()),
+    }
+    # matcher-scoped label values: only apiX instances
+    vals = get_json(
+        f"{base}/api/v1/label/inst/values?match[]=sreqs{{job=\"apiX\"}}"
+    )["data"]
+    assert vals == ["i1", "i2"]
+    # matcher-scoped label names
+    names = get_json(f"{base}/api/v1/labels?match[]=sreqs")["data"]
+    assert set(names) == {"__name__", "job", "inst"}
+
+
 def test_admin_endpoints(server):
     base, coord = server
     resp = post(

@@ -1,0 +1,139 @@
+"""m3msg socket transport: the shard-routed producer delivering over real
+TCP connections with acks, outage queuing, and retry drains
+(msg/protocol + consumer server roles)."""
+
+import time
+
+from m3_tpu.msg.bus import ConsumerService, Producer, Topic
+from m3_tpu.msg.transport import ConsumerServer, RemoteConsumer
+
+
+def _topic():
+    return Topic(
+        "agg_metrics",
+        num_shards=8,
+        consumer_services=[
+            ConsumerService("ingest", "shared"),
+            ConsumerService("mirror", "replicated"),
+        ],
+    )
+
+
+def test_produce_over_sockets_shared_and_replicated():
+    got_ingest, got_mirror_a, got_mirror_b = [], [], []
+    servers = [
+        ConsumerServer(lambda m: got_ingest.append(m.payload) or True),
+        ConsumerServer(lambda m: got_mirror_a.append(m.payload) or True),
+        ConsumerServer(lambda m: got_mirror_b.append(m.payload) or True),
+    ]
+    for s in servers:
+        s.start()
+    try:
+        producer = Producer(_topic())
+        producer.register(
+            RemoteConsumer("ingest", "i0", servers[0].host, servers[0].port)
+        )
+        producer.register(
+            RemoteConsumer("mirror", "m0", servers[1].host, servers[1].port)
+        )
+        producer.register(
+            RemoteConsumer("mirror", "m1", servers[2].host, servers[2].port)
+        )
+        for i in range(10):
+            producer.produce(i, b"payload-%d" % i)
+        assert producer.num_unacked == 0
+        assert sorted(got_ingest) == sorted(b"payload-%d" % i for i in range(10))
+        # replicated: every instance received every message
+        assert len(got_mirror_a) == 10 and len(got_mirror_b) == 10
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_outage_queues_then_retry_drains():
+    got = []
+    server = ConsumerServer(lambda m: got.append(m.payload) or True)
+    server.start()
+    host, port = server.host, server.port
+    topic = Topic("t", 4, [ConsumerService("ingest", "shared")])
+    producer = Producer(topic)
+    consumer = RemoteConsumer("ingest", "i0", host, port)
+    producer.register(consumer)
+    producer.produce(0, b"before")
+    assert producer.num_unacked == 0
+
+    server.stop()  # consumer service goes away
+    producer.produce(1, b"during-1")
+    producer.produce(2, b"during-2")
+    assert producer.num_unacked == 2
+
+    # service returns on the same port; the retry sweep delivers everything
+    server2 = ConsumerServer(lambda m: got.append(m.payload) or True, port=port)
+    server2.start()
+    try:
+        deadline = time.time() + 10
+        while producer.num_unacked and time.time() < deadline:
+            producer.retry_unacked()
+            time.sleep(0.01)
+        assert producer.num_unacked == 0
+        assert sorted(got) == [b"before", b"during-1", b"during-2"]
+    finally:
+        server2.stop()
+        consumer.close()
+
+
+def test_replicated_mirror_outage_retries_per_instance():
+    """One mirror acking must not swallow another mirror's missed delivery:
+    unacked tracking is per instance for replicated services."""
+    got_a, got_b = [], []
+    sa = ConsumerServer(lambda m: got_a.append(m.payload) or True)
+    sb = ConsumerServer(lambda m: got_b.append(m.payload) or True)
+    sa.start()
+    sb.start()
+    b_port = sb.port
+    topic = Topic("t", 4, [ConsumerService("mirror", "replicated")])
+    producer = Producer(topic)
+    producer.register(RemoteConsumer("mirror", "ma", sa.host, sa.port))
+    producer.register(RemoteConsumer("mirror", "mb", sb.host, b_port))
+    try:
+        sb.stop()  # mirror b blips; a stays healthy
+        producer.produce(0, b"m1")
+        assert got_a == [b"m1"]
+        assert producer.num_unacked == 1  # queued FOR b despite a's ack
+        sb2 = ConsumerServer(lambda m: got_b.append(m.payload) or True, port=b_port)
+        sb2.start()
+        try:
+            deadline = time.time() + 10
+            while producer.num_unacked and time.time() < deadline:
+                producer.retry_unacked()
+                time.sleep(0.01)
+            assert got_b == [b"m1"]
+        finally:
+            sb2.stop()
+    finally:
+        sa.stop()
+
+
+def test_handler_failure_is_not_acked():
+    fail = [True]
+    got = []
+
+    def handler(m):
+        if fail[0]:
+            return False
+        got.append(m.payload)
+        return True
+
+    server = ConsumerServer(handler)
+    server.start()
+    try:
+        topic = Topic("t", 2, [ConsumerService("ingest", "shared")])
+        producer = Producer(topic)
+        producer.register(RemoteConsumer("ingest", "i0", server.host, server.port))
+        producer.produce(0, b"x")
+        assert producer.num_unacked == 1  # nack -> queued
+        fail[0] = False
+        producer.retry_unacked()
+        assert producer.num_unacked == 0 and got == [b"x"]
+    finally:
+        server.stop()

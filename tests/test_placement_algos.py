@@ -1,0 +1,66 @@
+"""Placement algorithms: replace, mark-available, mirrored groups
+(cluster/placement/algo/sharded.go ReplaceInstances + MarkShardsAvailable,
+algo/mirrored.go)."""
+
+import pytest
+
+from m3_tpu.cluster.placement import (
+    ShardState,
+    build_initial_placement,
+    build_mirrored_placement,
+    mark_shards_available,
+    replace_instance,
+)
+
+
+def test_replace_then_mark_available():
+    p = build_initial_placement(["a", "b", "c"], num_shards=12, replica_factor=2)
+    owned_by_b = set(p.instances["b"].shards)
+    p = replace_instance(p, "b", "b2")
+    # b2 initializes exactly b's shards, streaming from b; b is leaving
+    assert set(p.instances["b2"].shards) == owned_by_b
+    assert all(
+        a.state == ShardState.INITIALIZING and a.source_instance == "b"
+        for a in p.instances["b2"].shards.values()
+    )
+    assert all(
+        a.state == ShardState.LEAVING for a in p.instances["b"].shards.values()
+    )
+    # reads during the move: b2 not readable yet, b still is
+    for s in owned_by_b:
+        readable = {i.id for i in p.instances_for_shard(s, readable_only=True)}
+        assert "b2" not in readable and "b" in readable
+
+    p = mark_shards_available(p, "b2")
+    assert "b" not in p.instances, "emptied leaving instance is removed"
+    assert all(
+        a.state == ShardState.AVAILABLE and a.source_instance is None
+        for a in p.instances["b2"].shards.values()
+    )
+    # every shard still has replica_factor owners
+    for s in range(12):
+        assert len(p.instances_for_shard(s)) == 2
+
+
+def test_replace_rejects_duplicate_id():
+    p = build_initial_placement(["a", "b"], num_shards=4, replica_factor=1)
+    with pytest.raises(ValueError):
+        replace_instance(p, "a", "b")
+
+
+def test_mirrored_groups_share_shard_sets():
+    p = build_mirrored_placement(
+        [["agg0a", "agg0b"], ["agg1a", "agg1b"]], num_shards=16
+    )
+    assert p.replica_factor == 2
+    assert set(p.instances["agg0a"].shards) == set(p.instances["agg0b"].shards)
+    assert set(p.instances["agg1a"].shards) == set(p.instances["agg1b"].shards)
+    # groups partition the shard space
+    g0 = set(p.instances["agg0a"].shards)
+    g1 = set(p.instances["agg1a"].shards)
+    assert g0 | g1 == set(range(16)) and not (g0 & g1)
+
+
+def test_mirrored_requires_equal_groups():
+    with pytest.raises(ValueError):
+        build_mirrored_placement([["a", "b"], ["c"]], num_shards=4)
