@@ -1,0 +1,85 @@
+"""Cross-instance metric forwarding: multi-stage rollups between
+aggregator processes.
+
+Reference: /root/reference/src/aggregator/aggregator/forwarded_writer.go —
+a rollup pipeline's intermediate output is not flushed to storage but
+FORWARDED (as timed metrics) to the aggregator instance owning the rollup
+metric's shard, where the next stage aggregates it. Here a ForwardingHandler
+plugs into Aggregator.flush_handler and ships flushed aggregates over the
+rawtcp-role ingest socket (aggregator/server.py) as timed unaggregated
+messages, shard-routed by the destination id.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..metrics.encoding import UnaggregatedMessage
+from ..metrics.types import AggregationType, MetricType, Untimed
+from .server import AggregatorClient
+
+
+@dataclass
+class ForwardingRule:
+    """Which flushed metrics forward, and how they rename (the pipeline's
+    next-stage input id)."""
+
+    suffix: bytes = b""  # only ids ending in this forward (b"" = all)
+    rename: bytes | None = None  # replacement id; None keeps suffixed_id
+    # how the NEXT stage aggregates the forwarded values (pipeline op);
+    # forwarded partials are summed by default
+    aggregations: tuple = (AggregationType.SUM,)
+
+
+class ForwardingHandler:
+    """Aggregator.flush_handler that forwards matching aggregates to the
+    next aggregation stage over the wire; non-matching metrics fall through
+    to ``local_handler`` (the storage/m3msg egress)."""
+
+    def __init__(
+        self,
+        endpoints: list[tuple[str, int]],
+        rules: list[ForwardingRule] | None = None,
+        local_handler=None,
+        num_shards: int = 16,
+    ) -> None:
+        self.client = AggregatorClient(endpoints, num_shards=num_shards)
+        self.rules = rules or [ForwardingRule()]
+        self.local_handler = local_handler
+        self.forwarded = 0
+
+    def _rule_for(self, suffixed_id: bytes) -> ForwardingRule | None:
+        for rule in self.rules:
+            if suffixed_id.endswith(rule.suffix):
+                return rule
+        return None
+
+    def __call__(self, metrics) -> None:
+        passthrough = []
+        for m in metrics:
+            # match on the type-suffixed id (edge.reqs.sum), the form the
+            # next stage would ingest
+            rule = self._rule_for(m.suffixed_id)
+            if rule is None:
+                passthrough.append(m)
+                continue
+            out_id = rule.rename if rule.rename is not None else m.suffixed_id
+            # carry the SOURCE policy: with multiple storage policies the
+            # flush emits one aggregate per policy, and the next stage must
+            # keep them in separate per-policy buffers (summing them
+            # together would double count)
+            self.client.send(
+                UnaggregatedMessage(
+                    Untimed(type=MetricType.GAUGE, id=out_id, gauge_value=m.value),
+                    m.time_nanos,
+                    policies=(m.policy,),
+                    aggregations=tuple(rule.aggregations),
+                    timed=True,
+                )
+            )
+            self.forwarded += 1
+        if self.local_handler is not None and passthrough:
+            self.local_handler(passthrough)
+
+    def close(self) -> None:
+        self.client.close()

@@ -164,18 +164,23 @@ def replace_instance(p: Placement, old_id: str, new_id: str) -> Placement:
         raise ValueError(f"instance {new_id} already in placement")
     old = p.instances[old_id]
     new_inst = Instance(new_id, isolation_group=old.isolation_group, weight=old.weight)
-    for s, a in old.shards.items():
-        # a shard the old instance was itself still INITIALIZING has no data
-        # there — the replacement inherits the ORIGINAL stream source
-        source = (
-            a.source_instance
-            if a.state == ShardState.INITIALIZING and a.source_instance
-            else old_id
-        )
-        new_inst.shards[s] = ShardAssignment(
-            s, ShardState.INITIALIZING, source_instance=source
-        )
-        a.state = ShardState.LEAVING
+    for s, a in list(old.shards.items()):
+        if a.state == ShardState.INITIALIZING:
+            # the old instance never had this shard's data: nothing to hand
+            # off or read from — drop it there and inherit the ORIGINAL
+            # stream source (keeping it LEAVING would leave a phantom
+            # readable replica that mark_shards_available can never clear)
+            new_inst.shards[s] = ShardAssignment(
+                s, ShardState.INITIALIZING, source_instance=a.source_instance
+            )
+            del old.shards[s]
+        else:
+            new_inst.shards[s] = ShardAssignment(
+                s, ShardState.INITIALIZING, source_instance=old_id
+            )
+            a.state = ShardState.LEAVING
+    if not old.shards:
+        del p.instances[old_id]
     p.instances[new_id] = new_inst
     p.version += 1
     return p

@@ -111,12 +111,6 @@ class Producer:
             return cs  # every instance gets every shard (replicated topic)
         return [cs[shard % len(cs)]]  # shared: shard-owned instance
 
-    def _is_replicated(self, service: str) -> bool:
-        svc = next(
-            (c for c in self.topic.consumer_services if c.name == service), None
-        )
-        return bool(svc and svc.consumption_type == "replicated")
-
     def produce(self, shard: int, payload: bytes) -> int:
         """At-least-once: deliver to each consumer service; queue failures.
         Replicated services track acks PER INSTANCE — one mirror acking must
@@ -126,7 +120,7 @@ class Producer:
             mid = self._next_id
         for svc in self.topic.consumer_services:
             msg = Message(shard=shard % self.topic.num_shards, payload=payload, id=mid)
-            replicated = self._is_replicated(svc.name)
+            replicated = svc.consumption_type == "replicated"
             targets = self._route(svc.name, msg.shard)
             any_ok = False
             for c in targets:
@@ -135,8 +129,9 @@ class Producer:
                 if replicated and not ok:
                     with self._lock:
                         self._unacked.append((msg, svc.name, c.id, 0))
-            if not replicated and not any_ok:
-                # shared: re-route at retry time (the owner may change)
+            if not any_ok and (not replicated or not targets):
+                # shared service failure OR a (replicated) service with no
+                # registered instances yet: queue and re-route at retry time
                 with self._lock:
                     self._unacked.append((msg, svc.name, None, 0))
         return mid
