@@ -47,6 +47,11 @@ class ForwardingHandler:
         self.rules = rules or [ForwardingRule()]
         self.local_handler = local_handler
         self.forwarded = 0
+        # messages that failed to send (endpoint down): retried on the next
+        # flush by THIS handler. The handler never raises into the
+        # aggregator's _pending_emit batch retry — that would re-forward
+        # messages already delivered over TCP and double-count downstream.
+        self._pending_send: list = []
 
     def _rule_for(self, suffixed_id: bytes) -> ForwardingRule | None:
         for rule in self.rules:
@@ -54,8 +59,21 @@ class ForwardingHandler:
                 return rule
         return None
 
+    def _send(self, msg: UnaggregatedMessage) -> bool:
+        try:
+            self.client.send(msg)
+        except OSError:
+            self._pending_send.append(msg)
+            return False
+        self.forwarded += 1
+        return True
+
     def __call__(self, metrics) -> None:
+        # local egress FIRST: if it raises, nothing has been forwarded yet,
+        # so the aggregator's batch retry is safe (per-message forwarding
+        # failures never raise — they queue in _pending_send instead)
         passthrough = []
+        to_forward = []
         for m in metrics:
             # match on the type-suffixed id (edge.reqs.sum), the form the
             # next stage would ingest
@@ -68,7 +86,7 @@ class ForwardingHandler:
             # flush emits one aggregate per policy, and the next stage must
             # keep them in separate per-policy buffers (summing them
             # together would double count)
-            self.client.send(
+            to_forward.append(
                 UnaggregatedMessage(
                     Untimed(type=MetricType.GAUGE, id=out_id, gauge_value=m.value),
                     m.time_nanos,
@@ -77,9 +95,11 @@ class ForwardingHandler:
                     timed=True,
                 )
             )
-            self.forwarded += 1
         if self.local_handler is not None and passthrough:
             self.local_handler(passthrough)
+        retry, self._pending_send = self._pending_send, []
+        for msg in retry + to_forward:
+            self._send(msg)
 
     def close(self) -> None:
         self.client.close()

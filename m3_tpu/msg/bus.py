@@ -143,6 +143,10 @@ class Producer:
             pending = self._unacked
             self._unacked = []
         still = []
+        replicated_services = {
+            c.name for c in self.topic.consumer_services
+            if c.consumption_type == "replicated"
+        }
         for msg, service, target_id, attempts in pending:
             if target_id is None:
                 targets = self._route(service, msg.shard)
@@ -152,6 +156,15 @@ class Producer:
                     for c in self._consumers.get(service, [])
                     if c.id == target_id
                 ]
+            if target_id is None and service in replicated_services:
+                # a replicated entry queued before instances registered:
+                # every mirror must receive it; failures requeue per mirror
+                if not targets and attempts + 1 < self.max_retries:
+                    still.append((msg, service, None, attempts + 1))
+                for c in targets:
+                    if not c.deliver(msg) and attempts + 1 < self.max_retries:
+                        still.append((msg, service, c.id, attempts + 1))
+                continue
             delivered = any(c.deliver(msg) for c in targets)
             if not delivered and attempts + 1 < self.max_retries:
                 still.append((msg, service, target_id, attempts + 1))
