@@ -92,18 +92,27 @@ class Session:
     def fetch(self, sid: bytes, start_nanos: int, end_nanos: int):
         """Fetch one series by ID. Consistency gates ONLY on the shard this
         ID lives in (session.go:1789-1815 readConsistencyAchieved over the
-        attempted shard) — other shards being down cannot fail this read."""
+        attempted shard) — other shards being down cannot fail this read.
+
+        Replicas ship COMPRESSED segments (fetch_blocks, the fetchBlocksRaw
+        role); the merge runs client-side through the encoding iterator
+        stack — per-replica MultiReaderIterator, replica-dedupe
+        SeriesIterator (encoding/series_iterator.go)."""
+        from ..codec.iterator import MultiReaderIterator, SeriesIterator
+
         replies = self._fanout(
             "fetch",
             self._shard(sid),
             self.read_consistency.required(self.topology.replicas),
-            lambda node: node.read(self.namespace, sid, start_nanos, end_nanos),
+            lambda node: node.fetch_blocks(self.namespace, sid, start_nanos, end_nanos),
         )
-        merged: dict[int, object] = {}
-        for dps in replies:
-            for dp in dps:
-                merged.setdefault(dp.timestamp, dp)
-        return [merged[t] for t in sorted(merged)]
+        it = SeriesIterator(
+            sid,
+            [MultiReaderIterator(segments) for segments in replies],
+            start_nanos=start_nanos,
+            end_nanos=end_nanos,
+        )
+        return list(it)
 
     def fetch_tagged(self, query, start_nanos: int, end_nanos: int):
         """Fan out to replicas of every shard; merge + dedupe series across

@@ -120,6 +120,9 @@ class RemoteNode:
             self._call("fetch", ns=ns, sid=sid, start=start, end=end)
         )
 
+    def fetch_blocks(self, ns, sid, start, end):
+        return self._call("fetch_blocks", ns=ns, sid=sid, start=start, end=end)
+
     def fetch_tagged(self, ns, query, start, end, limit=None):
         return wire.series_from_wire(
             self._call(

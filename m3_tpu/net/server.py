@@ -65,6 +65,10 @@ class NodeService:
         dps = self.db.read(req["ns"], req["sid"], req["start"], req["end"])
         return wire.dps_to_wire(dps)
 
+    def op_fetch_blocks(self, req):
+        # compressed read: raw encoded segments (rpc.thrift fetchBlocksRaw)
+        return self.db.fetch_blocks(req["ns"], req["sid"], req["start"], req["end"])
+
     def op_fetch_tagged(self, req):
         q = wire.query_from_wire(req["query"])
         res = self.db.fetch_tagged(

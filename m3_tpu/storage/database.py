@@ -123,21 +123,32 @@ class Shard:
             return self._read_locked(sid, start, end)
 
     def _read_locked(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
-        out: list[Datapoint] = []
-        # flushed filesets first (older), then buffer (newer wins on dupes)
+        # flushed filesets first (older), then buffer segments: the
+        # MultiReaderIterator's latest-segment-wins dedupe gives buffer
+        # precedence over filesets (shard.go:1060 ReadEncoded ordering)
+        from ..codec.iterator import MultiReaderIterator
+
+        it = MultiReaderIterator(self._segments_locked(sid, start, end))
+        return [dp for dp in it if start <= dp.timestamp < end]
+
+    def _segments_locked(self, sid: bytes, start: int, end: int) -> list[bytes]:
+        """Raw encoded segments overlapping [start, end), oldest-first —
+        the compressed-read surface (rpc.thrift fetchBlocksRaw role)."""
+        segments: list[bytes] = []
         for fid in self.filesets():
             if fid.block_start + self.opts.block_size_nanos <= start or fid.block_start >= end:
                 continue
             stream = self.reader(fid).stream(sid)
             if stream:
-                out.extend(dp for dp in decode(stream) if start <= dp.timestamp < end)
+                segments.append(stream)
         buf = self.series.get(sid)
         if buf is not None:
-            out.extend(buf.read(start, end))
-        dedup: dict[int, Datapoint] = {}
-        for dp in out:
-            dedup[dp.timestamp] = dp
-        return [dedup[t] for t in sorted(dedup)]
+            segments.extend(buf.streams(start, end))
+        return segments
+
+    def fetch_blocks(self, sid: bytes, start: int, end: int) -> list[bytes]:
+        with self.lock:
+            return self._segments_locked(sid, start, end)
 
     def warm_flush(self, flush_before_nanos: int) -> list[FilesetID]:
         """shard.go:2146 — write filesets for complete blocks, then evict."""
@@ -377,6 +388,14 @@ class Database:
         # per-shard locking (inside Shard.read): reads don't serialize
         # against other shards or the database lifecycle lock
         return self.namespaces[ns].shard_for(sid).read(sid, start, end)
+
+    def fetch_blocks(self, ns: str, sid: bytes, start: int, end: int) -> list[bytes]:
+        """Compressed read surface: raw encoded segments overlapping the
+        range, oldest-first (rpc.thrift fetchBlocksRaw; the client session
+        merges replicas' segments with the SeriesIterator stack instead of
+        shipping decoded datapoints)."""
+        self._m_reads.inc()
+        return self.namespaces[ns].shard_for(sid).fetch_blocks(sid, start, end)
 
     # --- tagged write / index query path (database.go:606 WriteTagged,
     # :785 QueryIDs; network FetchTagged mirrors this) ---

@@ -92,6 +92,18 @@ class SeriesBuffer:
                     out.append(dp)
         return out
 
+    def streams(self, start_nanos: int, end_nanos: int) -> list[bytes]:
+        """Merged per-bucket encoded streams overlapping [start, end),
+        oldest block first (dbBuffer.ReadEncoded, buffer.go:633)."""
+        out = []
+        for bs in sorted(self.buckets):
+            if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                continue
+            stream = self.buckets[bs].merged_stream()
+            if stream:
+                out.append(stream)
+        return out
+
     def streams_before(self, flush_before_nanos: int) -> dict[int, bytes]:
         """Canonical merged streams for blocks entirely before the cutoff
         (WarmFlush input, shard.go:2146)."""

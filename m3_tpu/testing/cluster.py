@@ -62,6 +62,11 @@ class Node:
             raise ConnectionError(f"{self.id} down")
         return self.db.read(ns, sid, start, end)
 
+    def fetch_blocks(self, ns, sid, start, end):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        return self.db.fetch_blocks(ns, sid, start, end)
+
     def owned_shards(self) -> set[int]:
         return self.assigned_shards
 

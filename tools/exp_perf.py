@@ -1,0 +1,66 @@
+"""Kernel perf experiment harness (not part of the library).
+
+Sweeps chunk size k and batch size S for the packed Pallas kernel and
+prints the sustained decode+aggregate rate for each point.
+
+Usage: python tools/exp_perf.py [k1,k2,...] [s1,s2,...]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from m3_tpu.ops import fused
+from m3_tpu.ops.chunked import build_chunked, tile_chunked
+from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+from m3_tpu.utils.synthetic import synthetic_streams
+
+
+def run_point(streams, k: int, n_series: int, iters: int = 10) -> float:
+    batch = tile_chunked(build_chunked(streams, k=k), n_series)
+    packed = fused.pack_lane_inputs(batch)
+    w4 = jax.device_put(packed.windows4)
+    l4 = jax.device_put(packed.lanes4)
+    fn = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate_packed,
+            n=packed.n,
+            s=batch.num_series,
+            c=batch.num_chunks,
+            k=batch.k,
+        )
+    )
+    out = fn(w4, l4)
+    jax.block_until_ready(out)
+    total_points = int(out.total_count)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(w4, l4)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return total_points / dt, dt
+
+
+def main() -> None:
+    ks = [int(x) for x in (sys.argv[1] if len(sys.argv) > 1 else "24").split(",")]
+    ss = [int(x) for x in (sys.argv[2] if len(sys.argv) > 2 else "524288").split(",")]
+    n_points = 720
+    streams = synthetic_streams(64, n_points, seed=3)
+    for k in ks:
+        for s in ss:
+            rate, dt = run_point(streams, k, s)
+            print(
+                f"k={k:3d} S={s:8d}: {rate/1e9:6.2f}B dp/s  ({dt*1e3:.2f} ms/iter)",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()
