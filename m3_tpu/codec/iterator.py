@@ -50,10 +50,14 @@ class MultiReaderIterator:
             # heap orders by (timestamp, -priority): among equal timestamps
             # the highest-priority (newest) segment surfaces first
             heapq.heappush(self._heap, (dp.timestamp, -prio, dp, it))
-        elif it.err is not None and self.err is None:
-            self.err = it.err
+        elif it.err is not None and not isinstance(it.err, EOFError):
+            # EOF is stream end; anything else is real corruption and must
+            # surface, not silently truncate the merge (decode() parity)
+            self.err = self.err or it.err
 
     def next(self) -> bool:
+        if self.err is not None:
+            raise self.err
         if not self._heap:
             self._current = None
             return False
@@ -116,6 +120,8 @@ class SeriesIterator:
             self.err = err
 
     def next(self) -> bool:
+        if self.err is not None:
+            raise self.err
         if not self._heap:
             self._current = None
             return False

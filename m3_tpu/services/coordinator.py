@@ -217,6 +217,40 @@ class Coordinator:
             for doc in result.docs
         ]
 
+    def search(self, match_exprs: list[str], start_nanos: int, end_nanos: int,
+               limit: int | None = None):
+        """/api/v1/search (api/v1/handler/search.go): series IDs + tags
+        matching the given selectors."""
+        ns = self.db.namespaces[self.namespace]
+        if not match_exprs:
+            raise ValueError("search requires at least one match[]")
+        q = self._index_query(match_exprs)
+        result = ns.index.query(q, start_nanos, end_nanos, limit=limit)
+        return [
+            {
+                "id": doc.id.decode("utf-8", "replace"),
+                "tags": {k.decode(): v.decode() for k, v in doc.fields},
+            }
+            for doc in result.docs
+        ]
+
+    def write_influx(self, body: str, precision: str = "ns") -> int:
+        """InfluxDB line-protocol ingest (handler/influxdb/write.go)."""
+        from .influx import parse_body
+
+        points = parse_body(body, precision=precision)
+        for name, tags, t_nanos, value in points:
+            # __name__ must win over any same-named line tag
+            tag_pairs = make_tags({**tags, "__name__": name})
+            keep = True
+            if self.downsampler is not None:
+                keep = self.downsampler.write(
+                    tag_pairs, t_nanos, value, MetricType.GAUGE
+                )
+            if keep:
+                self.db.write_tagged(self.namespace, tag_pairs, t_nanos, value)
+        return len(points)
+
     def labels(self, match_exprs: list[str] | None = None,
                start_nanos: int = 0, end_nanos: int = 2**62) -> list[str]:
         ns = self.db.namespaces[self.namespace]
@@ -331,6 +365,15 @@ class _Handler(BaseHTTPRequestHandler):
                          m.group(1), q.get("match[]", []), *_prom_range(q)
                      )}
                 )
+            elif url.path == "/api/v1/search":
+                self._json(
+                    {"status": "success",
+                     "data": c.search(
+                         q.get("match[]", []) or q.get("query", []),
+                         *_prom_range(q),
+                         limit=int(q["limit"][0]) if "limit" in q else None,
+                     )}
+                )
             elif url.path == "/api/v1/services/m3db/placement":
                 p = c.placement_svc.get()
                 self._json(p.to_dict() if p else {}, 200 if p else 404)
@@ -379,6 +422,13 @@ class _Handler(BaseHTTPRequestHandler):
                     compress(resp.SerializeToString()),
                     ctype="application/x-protobuf",
                 )
+            elif url.path == "/api/v1/influxdb/write":
+                q = parse_qs(url.query)
+                n = c.write_influx(
+                    self._body().decode(),
+                    precision=q.get("precision", ["ns"])[0],
+                )
+                self._send(204, b"")
             elif url.path == "/api/v1/json/write":
                 body = json.loads(self._body())
                 tags = make_tags(body["tags"])

@@ -1,0 +1,160 @@
+"""InfluxDB line-protocol ingest (coordinator write path).
+
+Reference: /root/reference/src/query/api/v1/handler/influxdb/write.go —
+the coordinator accepts InfluxDB line protocol and maps each numeric field
+to one tagged series: measurement + '_' + field key becomes __name__
+(naming mirrors the reference's default promrewriter behavior), line tags
+become label pairs. Integer fields carry a trailing 'i'; string and boolean
+fields are droppable per the reference (only numeric values are storable).
+
+Line protocol:  measurement[,tag=val...] field=value[,field2=value2] [ts]
+with '\\ ', '\\,', '\\=' escapes in identifiers and double-quoted string
+field values.
+"""
+
+from __future__ import annotations
+
+PRECISION_NANOS = {
+    "ns": 1,
+    "u": 1_000,
+    "us": 1_000,
+    "ms": 1_000_000,
+    "s": 1_000_000_000,
+    "m": 60 * 1_000_000_000,
+    "h": 3_600 * 1_000_000_000,
+}
+
+
+class LineProtocolError(ValueError):
+    pass
+
+
+def _split_unescaped(s: str, sep: str) -> list[str]:
+    """Split on sep outside escapes and double quotes."""
+    out, cur, esc, quoted = [], [], False, False
+    for ch in s:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            cur.append(ch)
+            esc = True
+        elif ch == '"':
+            quoted = not quoted
+            cur.append(ch)
+        elif ch == sep and not quoted:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+def _unescape(s: str) -> str:
+    out, esc = [], False
+    for ch in s:
+        if esc:
+            out.append(ch)
+            esc = False
+        elif ch == "\\":
+            esc = True
+        else:
+            out.append(ch)
+    if esc:
+        out.append("\\")
+    return "".join(out)
+
+
+def parse_line(line: str):
+    """One line → (measurement, tags dict, fields dict, timestamp|None).
+
+    Numeric fields come back as float; string/bool fields are returned too
+    (callers decide what to drop). Raises LineProtocolError on bad syntax.
+    """
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    parts = _split_unescaped(line, " ")
+    parts = [p for p in parts if p != ""]
+    if len(parts) < 2 or len(parts) > 3:
+        raise LineProtocolError(f"expected 2-3 space-separated sections: {line!r}")
+    head, field_part = parts[0], parts[1]
+    ts = None
+    if len(parts) == 3:
+        try:
+            ts = int(parts[2])
+        except ValueError:
+            raise LineProtocolError(f"bad timestamp: {parts[2]!r}")
+
+    head_parts = _split_unescaped(head, ",")
+    measurement = _unescape(head_parts[0])
+    if not measurement:
+        raise LineProtocolError("empty measurement")
+    tags: dict[str, str] = {}
+    for tp in head_parts[1:]:
+        kv = _split_unescaped(tp, "=")
+        if len(kv) != 2:
+            raise LineProtocolError(f"bad tag: {tp!r}")
+        tags[_unescape(kv[0])] = _unescape(kv[1])
+
+    fields: dict[str, object] = {}
+    for fp in _split_unescaped(field_part, ","):
+        kv = _split_unescaped(fp, "=")
+        if len(kv) != 2:
+            raise LineProtocolError(f"bad field: {fp!r}")
+        key = _unescape(kv[0])
+        raw = kv[1]
+        if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+            fields[key] = _unescape(raw[1:-1])
+        elif raw in ("t", "T", "true", "True", "TRUE"):
+            fields[key] = True
+        elif raw in ("f", "F", "false", "False", "FALSE"):
+            fields[key] = False
+        elif raw.endswith(("i", "u")) and _is_int(raw[:-1]):
+            fields[key] = float(int(raw[:-1]))
+        else:
+            try:
+                fields[key] = float(raw)
+            except ValueError:
+                raise LineProtocolError(f"bad field value: {raw!r}")
+    if not fields:
+        raise LineProtocolError("no fields")
+    return measurement, tags, fields, ts
+
+
+def _is_int(s: str) -> bool:
+    if s.startswith(("-", "+")):
+        s = s[1:]
+    return s.isdigit() and bool(s)
+
+
+def parse_body(body: str, precision: str = "ns", now_nanos: int | None = None):
+    """Parse a write body → list of (name, tags, t_nanos, value) datapoints.
+
+    Non-numeric fields are dropped (reference behavior); each numeric field
+    yields one datapoint named measurement_field.
+    """
+    mult = PRECISION_NANOS.get(precision)
+    if mult is None:
+        raise LineProtocolError(f"bad precision {precision!r}")
+    out = []
+    for line in body.splitlines():
+        parsed = parse_line(line)
+        if parsed is None:
+            continue
+        measurement, tags, fields, ts = parsed
+        if ts is None:
+            if now_nanos is None:
+                import time
+
+                now_nanos = int(time.time() * 1e9)
+            t_nanos = now_nanos
+        else:
+            t_nanos = ts * mult
+        for key, val in fields.items():
+            if isinstance(val, bool) or not isinstance(val, float):
+                continue
+            name = f"{measurement}_{key}" if key != "value" else measurement
+            out.append((name, tags, t_nanos, val))
+    return out

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ..codec.m3tsz import Datapoint, decode
@@ -74,7 +75,10 @@ class Shard:
         self.series: dict[bytes, SeriesBuffer] = {}
         self._flushed_blocks: set[int] = set()
         self._filesets: list[FilesetID] | None = None  # listdir cache
-        self._readers: dict[int, FilesetReader] = {}  # block_start -> reader
+        # block_start -> reader, LRU-bounded (wired_list.go:77 role: a cap on
+        # resident block resources with least-recently-used eviction)
+        self._readers: "OrderedDict[int, FilesetReader]" = OrderedDict()
+        self.max_cached_readers = 128
         self.reader_materializations = 0  # observability: fileset loads
 
     def filesets(self) -> list[FilesetID]:
@@ -93,10 +97,14 @@ class Shard:
     def _reader_locked(self, fid: FilesetID) -> FilesetReader:
         cached = self._readers.get(fid.block_start)
         if cached is not None and cached.fid.volume == fid.volume:
+            self._readers.move_to_end(fid.block_start)
             return cached
         reader = FilesetReader(self.base, fid)
         self.reader_materializations += 1
         self._readers[fid.block_start] = reader
+        self._readers.move_to_end(fid.block_start)
+        while len(self._readers) > self.max_cached_readers:
+            self._readers.popitem(last=False)
         return reader
 
     def check_write(self, t_nanos: int) -> None:

@@ -29,6 +29,7 @@ if TYPE_CHECKING:  # ops.chunked pulls in jax; storage nodes import lazily
     from ..ops.chunked import ChunkedBatch
 
 CHUNK_K = 32
+SUMMARY_EVERY = 64  # index-entry sampling rate for the summaries file
 
 # per-chunk snapshot record (see snapshot_stream)
 SIDE_DTYPE = np.dtype(
@@ -110,6 +111,7 @@ def write_fileset(
     side_parts: list[bytes] = []
     bloom = BloomFilter(_bloom_bits(max(len(ids), 1)))
     offset = 0
+    index_off = 0
     summaries: list[bytes] = []
     if native.available():
         all_snaps = native.prescan_batch([series[sid] for sid in ids], k=chunk_k)
@@ -142,8 +144,12 @@ def write_fileset(
         side_parts.append(side_bytes)
         bloom.add(sid)
         offset += len(stream)
-        if i % 64 == 0:  # sampled summaries (summaries file role)
-            summaries.append(struct.pack("<IQ", len(sid), offset - len(stream)) + sid)
+        if i % SUMMARY_EVERY == 0:
+            # sampled summaries: (id, byte offset of this entry in the INDEX
+            # file) — the seeker bisects these then scans <= SUMMARY_EVERY
+            # index entries (persist/fs/seek.go:79 index-lookup search)
+            summaries.append(struct.pack("<IQ", len(sid), index_off) + sid)
+        index_off += len(index_entries[-1])
 
     files = {
         "info": json.dumps(
@@ -155,6 +161,7 @@ def write_fileset(
                 "chunkK": chunk_k,
                 "bloomBits": bloom.m,
                 "bloomK": bloom.k,
+                "summariesIndexOffsets": True,
             }
         ).encode(),
         "index": b"".join(index_entries),
@@ -247,7 +254,14 @@ def read_index_ids(base: str, fid: FilesetID) -> list[bytes]:
 
 
 class FilesetReader:
-    """read.go + seek.go: id lookup via bloom → index search → data slice."""
+    """The mmap seeker (read.go + seek.go): id lookup via bloom filter →
+    summaries binary search → bounded index scan → mmap'd data slice.
+
+    Nothing beyond the info/bloom/summaries files is materialized up front:
+    data, side, and index are memory-mapped and only the bytes a lookup
+    touches are faulted in (the reference's seeker mmaps data + index the
+    same way, seek.go:63). Full-index parses happen lazily and only for
+    whole-fileset consumers (series_ids, shard streaming)."""
 
     def __init__(self, base: str, fid: FilesetID) -> None:
         if not fileset_complete(base, fid):
@@ -259,39 +273,147 @@ class FilesetReader:
             self.info["bloomK"],
             np.frombuffer(self._read(base, "bloomfilter"), np.uint8).copy(),
         )
-        self._data = self._read(base, "data")
-        self._side = self._read(base, "side")
-        self.index: dict[bytes, tuple[int, int, int, int]] = {}
-        buf = self._read(base, "index")
-        pos = 0
-        side_off = 0
-        while pos < len(buf):
-            id_len, length, offset, n_chunks = struct.unpack_from("<IIQI", buf, pos)
-            pos += 20
-            sid = buf[pos : pos + id_len]
-            pos += id_len
-            self.index[sid] = (offset, length, side_off, n_chunks)
-            side_off += n_chunks * SIDE_DTYPE.itemsize
+        self._data = self._mmap(base, "data")
+        self._side = self._mmap(base, "side")
+        self._index_mm = self._mmap(base, "index")
+        self._entries: dict[bytes, tuple[int, int, int, int] | None] = {}
+        self._side_bases: dict[int, int] = {0: 0}
+        self._full_index: dict[bytes, tuple[int, int, int, int]] | None = None
+        self.full_index_parses = 0  # observability: whole-index scans
+        # summaries: sampled (sid, index offset) pairs, sorted by sid —
+        # absent on pre-seek filesets (no summariesIndexOffsets marker)
+        self._summary_ids: list[bytes] = []
+        self._summary_offs: list[int] = []
+        if self.info.get("summariesIndexOffsets"):
+            buf = self._read(base, "summaries")
+            pos = 0
+            while pos < len(buf):
+                id_len, index_off = struct.unpack_from("<IQ", buf, pos)
+                pos += 12
+                self._summary_ids.append(buf[pos : pos + id_len])
+                pos += id_len
+                self._summary_offs.append(index_off)
 
     def _read(self, base: str, suffix: str) -> bytes:
         with open(_path(base, self.fid, suffix), "rb") as f:
             return f.read()
 
+    def _mmap(self, base: str, suffix: str):
+        import mmap as _mmap_mod
+
+        with open(_path(base, self.fid, suffix), "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            if size == 0:
+                return memoryview(b"")
+            return memoryview(
+                _mmap_mod.mmap(f.fileno(), size, access=_mmap_mod.ACCESS_READ)
+            )
+
+    # --- index lookup ---
+
+    def _parse_entry(self, pos: int) -> tuple[bytes, tuple[int, int, int, int], int]:
+        """Index entry at byte ``pos`` → (sid, (data_off, length, side_off,
+        n_chunks), next_pos). side_off comes from a side-cursor walk at full
+        parse; for seek hits it is recomputed from the entry scan below."""
+        id_len, length, offset, n_chunks = struct.unpack_from(
+            "<IIQI", self._index_mm, pos
+        )
+        pos += 20
+        sid = bytes(self._index_mm[pos : pos + id_len])
+        return sid, (offset, length, 0, n_chunks), pos + id_len
+
+    def _ensure_full_index(self) -> dict[bytes, tuple[int, int, int, int]]:
+        if self._full_index is None:
+            self.full_index_parses += 1
+            out: dict[bytes, tuple[int, int, int, int]] = {}
+            pos = 0
+            side_off = 0
+            n = len(self._index_mm)
+            while pos < n:
+                sid, (offset, length, _, n_chunks), pos = self._parse_entry(pos)
+                out[sid] = (offset, length, side_off, n_chunks)
+                side_off += n_chunks * SIDE_DTYPE.itemsize
+            self._full_index = out
+        return self._full_index
+
+    def _lookup(self, sid: bytes) -> tuple[int, int, int, int] | None:
+        if self._full_index is not None:
+            return self._full_index.get(sid)
+        if sid in self._entries:
+            return self._entries[sid]
+        if not self._summary_ids:
+            return self._ensure_full_index().get(sid)
+        # bisect the sampled summaries for the scan start; side offsets are
+        # not sampled, so walk entries accumulating n_chunks from the sample.
+        # Side offsets accumulate from file start, so sample i's side base is
+        # unknown — recover it by scanning from the previous sample with a
+        # known base: samples are every SUMMARY_EVERY entries, so instead we
+        # accumulate side_off from entry 0 of the sampled region by storing
+        # the side cursor alongside each region's first scan (cached below).
+        import bisect
+
+        i = bisect.bisect_right(self._summary_ids, sid) - 1
+        if i < 0:
+            self._entries[sid] = None
+            return None
+        start = self._summary_offs[i]
+        side_base = self._side_base(i)
+        pos, side_off = start, side_base
+        n = len(self._index_mm)
+        count = 0
+        found = None
+        while pos < n and count < SUMMARY_EVERY:
+            entry_sid, (offset, length, _, n_chunks), pos = self._parse_entry(pos)
+            if entry_sid == sid:
+                found = (offset, length, side_off, n_chunks)
+                break
+            if entry_sid > sid:
+                break
+            side_off += n_chunks * SIDE_DTYPE.itemsize
+            count += 1
+        self._entries[sid] = found
+        return found
+
+    def _side_base(self, sample_i: int) -> int:
+        """Side-file byte offset of sample ``sample_i``'s first entry,
+        computed once per sample region by walking from the nearest earlier
+        known sample (region walks are <= SUMMARY_EVERY entries each)."""
+        bases = self._side_bases
+        known = sample_i
+        while known not in bases:
+            known -= 1
+        while known < sample_i:
+            pos = self._summary_offs[known]
+            stop = self._summary_offs[known + 1]
+            side_off = bases[known]
+            while pos < stop:
+                _, (_, _, _, n_chunks), pos = self._parse_entry(pos)
+                side_off += n_chunks * SIDE_DTYPE.itemsize
+            known += 1
+            bases[known] = side_off
+        return bases[sample_i]
+
+    @property
+    def index(self) -> dict[bytes, tuple[int, int, int, int]]:
+        return self._ensure_full_index()
+
     @property
     def series_ids(self) -> list[bytes]:
-        return list(self.index)
+        return list(self._ensure_full_index())
 
     def stream(self, sid: bytes) -> bytes | None:
         if not self.bloom.test(sid):
             return None
-        entry = self.index.get(sid)
+        entry = self._lookup(sid)
         if entry is None:
             return None
         offset, length, _, _ = entry
-        return self._data[offset : offset + length]
+        return bytes(self._data[offset : offset + length])
 
     def side_table(self, sid: bytes) -> list[dict] | None:
-        entry = self.index.get(sid)
+        if not self.bloom.test(sid):
+            return None
+        entry = self._lookup(sid)
         if entry is None:
             return None
         offset, length, side_off, n_chunks = entry

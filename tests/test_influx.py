@@ -1,0 +1,96 @@
+"""InfluxDB line-protocol parsing + coordinator ingest route
+(reference: src/query/api/v1/handler/influxdb/write.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from m3_tpu.services.coordinator import Coordinator, serve
+from m3_tpu.services.influx import LineProtocolError, parse_body, parse_line
+
+T0 = 1_600_000_000
+
+
+def test_parse_basic_line():
+    m, tags, fields, ts = parse_line("cpu,host=a,dc=ny usage=0.5 1600000000000000000")
+    assert m == "cpu"
+    assert tags == {"host": "a", "dc": "ny"}
+    assert fields == {"usage": 0.5}
+    assert ts == 1600000000000000000
+
+
+def test_parse_escapes_and_quotes():
+    m, tags, fields, ts = parse_line(
+        r'disk\ io,path=/var/a\,b used=12i,label="x y",ok=true'
+    )
+    assert m == "disk io"
+    assert tags == {"path": "/var/a,b"}
+    assert fields["used"] == 12.0
+    assert fields["ok"] is True
+    assert ts is None
+
+
+def test_parse_body_field_naming_and_precision():
+    pts = parse_body(
+        "cpu,host=a value=1.5 1600000000\ncpu,host=a idle=2.0 1600000000",
+        precision="s",
+    )
+    # field named "value" keeps the bare measurement name
+    assert pts[0][0] == "cpu" and pts[1][0] == "cpu_idle"
+    assert pts[0][2] == 1_600_000_000 * 10**9
+    assert pts[0][3] == 1.5
+
+
+def test_parse_body_drops_non_numeric_and_comments():
+    pts = parse_body('# comment\ncpu s="str",ok=true,v=3 1\n', precision="s")
+    assert [(p[0], p[3]) for p in pts] == [("cpu_v", 3.0)]
+
+
+def test_parse_errors():
+    for bad in ["cpu", "cpu,host 1", "cpu v=abc", "cpu v=1 notatime"]:
+        with pytest.raises(LineProtocolError):
+            parse_body(bad)
+    with pytest.raises(LineProtocolError):
+        parse_body("cpu v=1 1", precision="fortnights")
+
+
+@pytest.fixture(scope="module")
+def server():
+    coord = Coordinator()
+    srv, port = serve(coord)
+    yield f"http://127.0.0.1:{port}", coord
+    srv.shutdown()
+
+
+def get_json(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_influx_write_then_query_and_search(server):
+    base, coord = server
+    lines = "\n".join(
+        f"mem,host=h{j} used_percent={10.0 * j + i} {T0 + i * 10}"
+        for j in range(2)
+        for i in range(5)
+    )
+    req = urllib.request.Request(
+        f"{base}/api/v1/influxdb/write?precision=s",
+        data=lines.encode(),
+        headers={"Content-Type": "text/plain"},
+    )
+    assert urllib.request.urlopen(req).status == 204
+
+    out = get_json(
+        f"{base}/api/v1/query?query=mem_used_percent&time={T0 + 40}"
+    )
+    vals = {
+        r["metric"]["host"]: float(r["value"][1]) for r in out["data"]["result"]
+    }
+    assert vals == {"h0": 4.0, "h1": 14.0}
+
+    found = get_json(f"{base}/api/v1/search?match[]={{__name__=\"mem_used_percent\"}}")
+    assert found["status"] == "success"
+    hosts = sorted(e["tags"]["host"] for e in found["data"])
+    assert hosts == ["h0", "h1"]

@@ -87,6 +87,35 @@ def test_series_iterators_batch():
     assert batch[0].id == b"a"
 
 
+def test_corrupt_segment_raises_not_truncates():
+    import pytest
+
+    from m3_tpu.codec.m3tsz import decode
+
+    good = _seg([(10 * NANOS, 1.0), (20 * NANOS, 2.0)])
+    # find a corruption that decode() itself treats as a REAL error
+    corrupt = None
+    for i in range(len(good)):
+        for flip in (0x01, 0x10, 0x80):
+            cand = bytes(
+                b ^ (flip if j == i else 0) for j, b in enumerate(good)
+            )
+            try:
+                decode(cand)
+            except EOFError:
+                continue
+            except Exception:
+                corrupt = cand
+                break
+        if corrupt:
+            break
+    if corrupt is None:
+        pytest.skip("no single-bit corruption raises on this stream")
+    it = MultiReaderIterator([corrupt])
+    with pytest.raises(Exception):
+        list(it)
+
+
 def test_annotations_surface_through_stack():
     enc = Encoder(10 * NANOS)
     enc.encode(10 * NANOS, 1.0, annotation=b"meta")

@@ -1,0 +1,133 @@
+"""mmap fileset seeker: bloom -> summaries bisect -> bounded index scan
+(reference: persist/fs/seek.go:63,79; seek_manager.go; wired_list.go)."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from m3_tpu.codec.m3tsz import Encoder, decode
+from m3_tpu.storage.fs import (
+    SUMMARY_EVERY,
+    FilesetID,
+    FilesetReader,
+    _path,
+    write_fileset,
+)
+
+NANOS = 1_000_000_000
+BLOCK = 3600 * NANOS
+
+
+def _series(n):
+    out = {}
+    for i in range(n):
+        enc = Encoder(10 * NANOS)
+        for j in range(5):
+            enc.encode((10 + j) * NANOS, float(i * 100 + j))
+        out[b"series-%05d" % i] = enc.stream()
+    return out
+
+
+@pytest.fixture(scope="module")
+def fileset(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("fs"))
+    series = _series(300)  # several summary regions (SUMMARY_EVERY=64)
+    fid = FilesetID("ns", 0, 0)
+    write_fileset(base, fid, series, BLOCK)
+    return base, fid, series
+
+
+def test_seek_reads_without_full_index_parse(fileset):
+    base, fid, series = fileset
+    r = FilesetReader(base, fid)
+    # hit a series in the middle of a summary region
+    sid = b"series-00100"
+    got = r.stream(sid)
+    assert got == series[sid]
+    assert [dp.value for dp in decode(got)][0] == 10000.0
+    assert r.full_index_parses == 0
+
+
+def test_seek_boundary_series(fileset):
+    base, fid, series = fileset
+    r = FilesetReader(base, fid)
+    first, last = b"series-00000", b"series-00299"
+    assert r.stream(first) == series[first]
+    assert r.stream(last) == series[last]
+    # exactly-on-sample ids (every 64th) hit their own summary entry
+    on_sample = b"series-%05d" % SUMMARY_EVERY
+    assert r.stream(on_sample) == series[on_sample]
+    assert r.full_index_parses == 0
+
+
+def test_seek_missing_id(fileset):
+    base, fid, series = fileset
+    r = FilesetReader(base, fid)
+    assert r.stream(b"absent-id") is None
+    assert r.stream(b"series-99999") is None
+    assert r.stream(b"aaaa") is None  # sorts before every summary
+    assert r.full_index_parses == 0
+
+
+def test_side_table_offsets_match_full_parse(fileset):
+    base, fid, series = fileset
+    seek = FilesetReader(base, fid)
+    full = FilesetReader(base, fid)
+    full_index = full.index  # force whole-index parse
+    for sid in (b"series-00000", b"series-00077", b"series-00150", b"series-00299"):
+        st = seek.side_table(sid)
+        assert st is not None
+        assert seek._lookup(sid) == full_index[sid]
+    assert seek.full_index_parses == 0
+    assert full.full_index_parses == 1
+
+
+def test_series_ids_full_parse(fileset):
+    base, fid, series = fileset
+    r = FilesetReader(base, fid)
+    assert sorted(r.series_ids) == sorted(series)
+    assert r.full_index_parses == 1
+
+
+def test_legacy_fileset_without_summary_offsets(fileset, tmp_path):
+    # filesets written before the seek format (no summariesIndexOffsets
+    # marker) fall back to the full index parse
+    base, fid, series = fileset
+    info_path = _path(base, fid, "info")
+    info = json.loads(open(info_path, "rb").read())
+    legacy = dict(info)
+    legacy.pop("summariesIndexOffsets")
+    with open(info_path, "wb") as f:
+        f.write(json.dumps(legacy).encode())
+    try:
+        r = FilesetReader(base, fid)
+        sid = b"series-00123"
+        assert r.stream(sid) == series[sid]
+        assert r.full_index_parses == 1
+    finally:
+        with open(info_path, "wb") as f:
+            f.write(json.dumps(info).encode())
+
+
+def test_reader_cache_lru_bound(tmp_path):
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=1)
+    db.create_namespace("ns", NamespaceOptions(block_size_nanos=BLOCK))
+    sh = db.namespaces["ns"].shards[0]
+    sh.max_cached_readers = 2
+    for b in range(4):
+        fid = FilesetID("ns", 0, b * BLOCK)
+        enc = Encoder(b * BLOCK)
+        enc.encode(b * BLOCK + NANOS, 1.0)
+        write_fileset(str(tmp_path) + "/ns_unused", fid, {b"x": enc.stream()}, BLOCK)
+    # exercise the cache through reader() with synthetic filesets
+    base = str(tmp_path) + "/ns_unused"
+    sh.base = base
+    for b in range(4):
+        sh.reader(FilesetID("ns", 0, b * BLOCK))
+    assert len(sh._readers) == 2
+    assert sh.reader_materializations == 4
