@@ -44,10 +44,12 @@ def main() -> None:
     batch = tile_chunked(build_chunked(streams, k=k), n_series)
 
     if platform == "tpu":
-        # packed-layout Pallas kernel: 3 contiguous DMAs per grid program
+        # packed-layout Pallas kernel: 3 contiguous DMAs per grid program;
+        # chunk-major tiles route through the specialized all-int body
         packed = fused.pack_lane_inputs(batch)
         w4 = jax.device_put(packed.windows4)
         l4 = jax.device_put(packed.lanes4)
+        tf = jax.device_put(packed.tile_flags)
         fn0 = jax.jit(
             functools.partial(
                 chunked_scan_aggregate_packed,
@@ -55,9 +57,10 @@ def main() -> None:
                 s=batch.num_series,
                 c=batch.num_chunks,
                 k=batch.k,
+                lane_order=packed.order,
             )
         )
-        fn = lambda _args: fn0(w4, l4)
+        fn = lambda _args: fn0(w4, l4, tf)
         args = None
     else:
         args = chunked_device_args(batch)

@@ -474,6 +474,7 @@ class TimestampIterator:
         self.time_unit_changed = False
         self.done = False
         self.skip_markers = skip_markers
+        self.num_markers = 0  # markers consumed (EOS/annotation/time-unit)
 
     def read_timestamp(self, stream: IStream) -> bool:
         """Returns True when this was the first timestamp."""
@@ -523,14 +524,17 @@ class TimestampIterator:
         if marker == scheme.END_OF_STREAM_MARKER:
             stream.read_bits(scheme.NUM_MARKER_BITS)
             self.done = True
+            self.num_markers += 1
             return 0, True
         elif marker == scheme.ANNOTATION_MARKER:
             stream.read_bits(scheme.NUM_MARKER_BITS)
             self._read_annotation(stream)
+            self.num_markers += 1
             return self._read_marker_or_dod(stream), True
         elif marker == scheme.TIME_UNIT_MARKER:
             stream.read_bits(scheme.NUM_MARKER_BITS)
             self.read_time_unit(stream)
+            self.num_markers += 1
             return self._read_marker_or_dod(stream), True
         return 0, False
 

@@ -33,6 +33,7 @@ class _SnapRec(ctypes.Structure):
         ("sig", ctypes.c_uint8),
         ("mult", ctypes.c_uint8),
         ("is_float", ctypes.c_uint8),
+        ("flags", ctypes.c_uint8),  # bit 0: fast chunk
     ]
 
 
@@ -65,7 +66,12 @@ def load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build():
+    stale = (
+        os.path.exists(_LIB_PATH)
+        and os.path.exists(_SRC_PATH)
+        and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)
+    )
+    if (not os.path.exists(_LIB_PATH) or stale) and not _build():
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
@@ -212,6 +218,7 @@ def prescan_batch(
                     sig=r.sig,
                     mult=r.mult,
                     is_float=bool(r.is_float),
+                    fast=bool(r.flags & 1),
                     total_bits=total_bits,
                 )
             )

@@ -88,6 +88,10 @@ class ChunkedBatch:
     k: int
     num_series: int
     num_chunks: int  # C per series (uniform, zero-padded)
+    # host-classified fast chunks (all-int, marker-free, constant {s,ms}
+    # unit, exactly k records — see snapshot_stream); empty padding lanes
+    # are fast=True so they never force a mixed tile slow
+    fast: np.ndarray = None  # bool[N]
 
     @property
     def num_lanes(self) -> int:
@@ -109,6 +113,11 @@ def snapshot_stream(
     per: list[dict] = []
     nrec = 0
     total_bits = len(data) * 8
+    # fast-chunk classification (device kernel specialization, ops/fused.py):
+    # a chunk is fast iff all k records are marker-free int-mode records with
+    # a constant {s, ms} time unit; tracked record by record below
+    chunk_fast = True
+    chunk_recs = 0
 
     def snap():
         st = it.stream
@@ -132,18 +141,42 @@ def snapshot_stream(
 
     while True:
         pending = snap() if nrec % k == 0 else None
+        if pending is not None and per:
+            # the previous chunk just completed all k records: seal its flag
+            per[-1]["fast"] = chunk_fast and chunk_recs == k
+        if pending is not None:
+            chunk_fast, chunk_recs = True, 0
+        markers_before = it.ts_iterator.num_markers
         if not it.next():
             # no record followed: don't emit an empty trailing chunk
             break
         if pending is not None:
             per.append(pending)
         nrec += 1
+        chunk_recs += 1
+        if (
+            it.ts_iterator.num_markers != markers_before
+            or it.is_float
+            or int(it.ts_iterator.time_unit) not in (int(Unit.SECOND), int(Unit.MILLISECOND))
+            or not int_optimized
+            # int32-safety: the specialized body runs the whole int path in
+            # 32-bit (sig <= 31, value in i32 range after every record; the
+            # chunk's starting value is the previous record's, also checked)
+            or it.sig > 31
+            or abs(it.int_val) > 2147483647
+        ):
+            chunk_fast = False
         if it.ts_iterator.done or it.err is not None:
             break
+    if per and chunk_recs > 0:
+        # seal the trailing chunk; a break exactly on a boundary (chunk_recs
+        # == 0 after reset) means the last chunk was already sealed above
+        per[-1]["fast"] = chunk_fast and chunk_recs == k
     offs = [p["off"] for p in per] + [total_bits]
     for i, p in enumerate(per):
         p["span"] = offs[i + 1] - offs[i]
         p["total_bits"] = total_bits
+        p.setdefault("fast", False)
     return per
 
 
@@ -174,6 +207,7 @@ def assemble_chunked(
     sig = np.zeros(n, np.int32)
     mult = np.zeros(n, np.int32)
     isf = np.zeros(n, bool)
+    fast = np.ones(n, bool)  # empty padding lanes stay fast
 
     for si, (data, per) in enumerate(zip(streams, snaps)):
         padded = (
@@ -198,6 +232,9 @@ def assemble_chunked(
             sig[i] = p["sig"]
             mult[i] = p["mult"]
             isf[i] = p["is_float"]
+            # the first chunk decodes the 64-bit head + first-value format
+            # the fast body doesn't implement
+            fast[i] = bool(p.get("fast", False)) and ci != 0
 
     return ChunkedBatch(
         windows=windows,
@@ -216,6 +253,7 @@ def assemble_chunked(
         k=k,
         num_series=s,
         num_chunks=c,
+        fast=fast,
     )
 
 
@@ -255,6 +293,7 @@ def tile_chunked(batch: ChunkedBatch, n_series: int) -> ChunkedBatch:
         k=batch.k,
         num_series=n_series,
         num_chunks=batch.num_chunks,
+        fast=t(batch.fast) if batch.fast is not None else None,
     )
 
 
@@ -270,20 +309,30 @@ def _window_columns(windows):
     return cols
 
 
-def _fetch4_select(cols, cw, base_rel, pos):
+def _fetch4_select(cols, cw, base_rel, pos, max_widx: int | None = None):
     """Aligned 4-word fetch via a barrel shift over the lane-private window
     columns — O(CW + 4 log CW) VPU selects, no gather.
 
     One shared barrel shifter (high bit first, narrowing the live candidate
     list to 4 + remaining-shift entries each stage) replaces four independent
-    select trees: ~46 selects vs ~124 at CW=24."""
+    select trees: ~46 selects vs ~124 at CW=24.
+
+    ``max_widx`` (static) bounds the word index the caller can reach — for
+    unrolled record loops the cursor after j records is statically bounded,
+    so early records need far fewer barrel stages."""
     p = base_rel + pos
     widx = p >> 5
     zero = jnp.zeros_like(cols[0])
-    cand = list(cols[: cw + 3])
-    s = 1
-    while s * 2 <= cw - 1:
-        s *= 2
+    bound = cw - 1 if max_widx is None else min(max_widx, cw - 1)
+    cand = list(cols[: min(bound + 4, cw + 3)])
+    while len(cand) < 4:
+        cand.append(zero)
+    if bound <= 0:
+        s = 0  # cursor provably in word 0: no barrel stages at all
+    else:
+        s = 1
+        while s * 2 <= bound:
+            s *= 2
     while s >= 1:
         flag = (widx & s) != 0
         width = min(4 + s - 1, len(cand))

@@ -267,6 +267,91 @@ def _decode_timestamp(fetch4, num_bits, state, first, nt=None):
     return state, eos
 
 
+# ---------------------------------------------------------------------------
+# Fast-path record decode: host-classified chunks (ops/chunked.py prescan
+# flags) that contain ONLY int-mode value records, NO markers/annotations,
+# a constant time unit in {s, ms} (32-bit default dod bucket), full k
+# records, and are not the first chunk of their stream. The kernel picks
+# this body per tile (ops/fused.py); the general functions above remain the
+# semantics oracle.
+# ---------------------------------------------------------------------------
+
+
+def _ts_consumed_fast(ws):
+    """Marker-free timestamp record WIDTH: 7/9/12-bit buckets + 32-bit
+    default ({s, ms} units by classification).
+
+    The fused kernel emits only per-lane aggregates — timestamp VALUES never
+    leave it — so the fast body skips the dod value, the unit multiply, and
+    both 64-bit accumulator adds entirely: the record's only effect is how
+    many bits it consumed. Returns i32 consumed (4 head bits decide it)."""
+    head4 = _extract32(ws, 0, 4)
+    b0 = (head4 >> 3) & 1
+    b1 = (head4 >> 2) & 1
+    b2 = (head4 >> 1) & 1
+    zero_dod = b0 == 0
+    sel7 = (b0 == 1) & (b1 == 0)
+    sel9 = (b0 == 1) & (b1 == 1) & (b2 == 0)
+    return jnp.where(
+        zero_dod,
+        1,
+        jnp.where(
+            sel7, 9, jnp.where(sel9, 12, jnp.where((head4 & 1) == 0, 16, 36))
+        ),
+    ).astype(I32)
+
+
+def _decode_value_fast(fetch4, state):
+    """Int-mode-only value record: repeat / stay-int / update-int.
+
+    Fast chunks are additionally classified int32-safe (sig <= 31 and
+    int_val within int32 for every record — snapshot_stream), so the whole
+    value path runs in single-word 32-bit arithmetic: ``state.int_val`` here
+    is an i32 vector, the sig-bit diff is one aligned word read, and the
+    update is a plain i32 add."""
+    pos = state.pos
+    ws = fetch4(pos)
+    head2 = _extract32(ws, 0, 2)
+    b0 = (head2 >> 1) & 1
+    b1 = head2 & 1
+    repeat = (b0 == 0) & (b1 == 1)
+    to_int = (b0 == 0) & (b1 == 0)  # update, not repeat; float excluded
+
+    hdr12 = _extract32(ws, 3, 12)
+    h_sig, h_mult, h_consumed, _ = _read_int_header12(hdr12, state.sig, state.mult)
+    diff_off = jnp.where(to_int, 3 + h_consumed, 1)  # < 32 always
+    diff_sig = jnp.where(to_int, h_sig, state.sig)
+    # sign + <=31-bit diff from two words (diff_off in [1, 17] so the word
+    # shift amounts are always in range and never zero)
+    r = diff_off.astype(U32)
+    hi32 = (ws[0] << r) | (ws[1] >> (U32(32) - r))
+    bit32 = (ws[1] << r) >> 31  # window bit diff_off + 32
+    sign_bit = hi32 >> 31
+    body = (hi32 << 1) | bit32  # bits [diff_off+1, diff_off+33)
+    n = diff_sig.astype(U32)
+    diff = jnp.where(
+        n == 0, U32(0), body >> (U32(32) - jnp.where(n == 0, U32(1), n))
+    )
+    diff_i = diff.astype(I32)
+    delta = jnp.where(sign_bit == 1, diff_i, -diff_i)
+    d_int_val = state.int_val + delta
+
+    new_int_val = jnp.where(repeat, state.int_val, d_int_val)
+    new_sig = jnp.where(to_int, h_sig, state.sig)
+    new_mult = jnp.where(to_int, h_mult, state.mult)
+    consumed = jnp.where(
+        repeat,
+        2,
+        jnp.where(to_int, 3 + h_consumed + 1 + h_sig, 2 + state.sig),
+    ).astype(I32)
+    return state._replace(
+        pos=pos + consumed,
+        int_val=new_int_val,
+        sig=new_sig,
+        mult=new_mult,
+    )
+
+
 def _read_int_header12(hb, sig, mult):
     """sig/mult update header (iterator.go readIntSigMult) decoded from its
     12 head bits ``hb`` (the header never exceeds 12 bits: sig part <= 8,
@@ -540,13 +625,32 @@ def decode_batched(
     )
 
 
-def _int_val_to_f32(pair, mult):
-    v = u64.to_f32(pair)
-    scale = jnp.full_like(v, 1.0)
-    for m, s in enumerate((1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6)):
+def _mult_reciprocal(mult, like):
+    """10^-mult as a correctly-rounded f32 select chain (mult in [0, 6])."""
+    rcp = jnp.full_like(like, 1.0)
+    for m, s in enumerate((1.0, 0.1, 0.01, 1e-3, 1e-4, 1e-5, 1e-6)):
         if m:
-            scale = jnp.where(mult == m, jnp.float32(s), scale)
-    return v / scale
+            rcp = jnp.where(mult == m, jnp.float32(s), rcp)
+    return rcp
+
+
+def _int32_val_to_f32(iv, mult):
+    """Fast-path conversion: int32-safe int_val -> f32 * 10^-mult."""
+    v = iv.astype(jnp.float32)
+    return v * _mult_reciprocal(mult, v)
+
+
+def _int_val_to_f32(pair, mult):
+    """Approximate int-mode value for f32 aggregation: int_val * 10^-mult.
+
+    Multiply-by-reciprocal, not divide: a VPU divide costs an order of
+    magnitude more than a multiply and this runs once per record per lane in
+    the fused kernel. The reciprocal constants are correctly rounded f32, so
+    the result differs from a true divide by <= 1 ulp — inside the
+    documented approximation of the f32 aggregation path (bit-exact values
+    travel as (hi, lo) pairs)."""
+    v = u64.to_f32(pair)
+    return v * _mult_reciprocal(mult, v)
 
 
 def finalize_decode(res: DecodeResult):

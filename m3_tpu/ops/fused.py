@@ -20,6 +20,7 @@ aggregation matches parallel/scan._aggregate_decoded.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -32,8 +33,11 @@ from .decode import (
     DecodeState,
     _decode_timestamp,
     _decode_value,
+    _decode_value_fast,
     _extract,
+    _int32_val_to_f32,
     _int_val_to_f32,
+    _ts_consumed_fast,
 )
 
 I32 = jnp.int32
@@ -42,6 +46,10 @@ F32 = jnp.float32
 
 LANE_TILE = (8, 128)  # native f32/i32 VPU tile
 TILE_LANES = LANE_TILE[0] * LANE_TILE[1]
+# rows per packed-kernel grid program: taller blocks amortize per-program
+# grid/DMA overhead (measured best at 24-32); must be a multiple of 8
+# (sublane tiling)
+ROWS_DEFAULT = int(os.environ.get("M3_TPU_TILE_ROWS", "32"))
 
 
 class LaneAggregates(NamedTuple):
@@ -176,6 +184,86 @@ def _run_lane_tile(windows_cols, rel_pos, num_bits, first, prev_time, prev_delta
     )
 
 
+def _run_lane_tile_fast(windows_cols, rel_pos, num_bits, int_val, sig, mult,
+                        k: int, cw: int, unroll: bool = False) -> LaneAggregates:
+    """Specialized K-record body for host-classified fast chunks (see
+    ops/chunked.py prescan flags): every record is marker-free and int-mode,
+    the time unit is constant in {s, ms}, the value path is int32-safe, and
+    the chunk holds exactly k records (or the lane is empty).
+
+    Skips the float-XOR path, full-float extracts, marker/time-unit logic,
+    f64->f32 conversion, per-record done/err bookkeeping (the active mask is
+    constant per lane) — and the TIMESTAMP VALUES themselves: aggregates are
+    the kernel's only output, so a timestamp record contributes nothing but
+    its consumed-bit count (_ts_consumed_fast)."""
+    rel_pos = jnp.asarray(rel_pos, I32)
+    shape = rel_pos.shape
+    active = jnp.asarray(num_bits, I32) > rel_pos  # empty/padding lanes: False
+    # minimal carry: pos + the fields fast records can change (no done/err/
+    # float/timestamp planes; bool-free so the Mosaic i1 hazard never arises)
+    state0 = (
+        jnp.zeros(shape, I32),  # pos
+        # int32-safe by classification: only the low word carries the value
+        jax.lax.bitcast_convert_type(jnp.asarray(int_val[1], U32), I32),
+        jnp.asarray(sig, I32),
+        jnp.asarray(mult, I32),
+    )
+    acc0 = (
+        jnp.zeros(shape, F32),
+        jnp.zeros(shape, I32),
+        jnp.full(shape, jnp.inf, F32),
+        jnp.full(shape, -jnp.inf, F32),
+        jnp.full(shape, jnp.nan, F32),
+    )
+    active_i = active.astype(I32)
+    # a fast record consumes at most 36 (ts) + 80 (value) = 116 bits, so the
+    # cursor before record j is statically bounded — early records need only
+    # a shallow barrel (see _fetch4_select max_widx)
+    MAX_REC_BITS = 116
+
+    def body(c, ts_widx, val_widx):
+        (pos, iv, sg, ml), acc = c
+        s_sum, s_cnt, s_min, s_max, s_last = acc
+        ws_ts = _fetch4_select(windows_cols, cw, rel_pos, pos, max_widx=ts_widx)
+        pos = pos + _ts_consumed_fast(ws_ts)
+        st = DecodeState(
+            pos=pos, done=None, err=None, prev_time=None, prev_delta=None,
+            time_unit=None, prev_float_bits=None, prev_xor=None,
+            int_val=iv, mult=ml, sig=sg, is_float=None,
+        )
+        fetch_val = functools.partial(
+            _fetch4_select, windows_cols, cw, rel_pos, max_widx=val_widx
+        )
+        st = _decode_value_fast(fetch_val, st)
+        v = _int32_val_to_f32(st.int_val, st.mult)
+        s_sum = s_sum + jnp.where(active, v, F32(0))
+        s_cnt = s_cnt + active_i
+        s_min = jnp.minimum(s_min, jnp.where(active, v, F32(jnp.inf)))
+        s_max = jnp.maximum(s_max, jnp.where(active, v, F32(-jnp.inf)))
+        s_last = jnp.where(active, v, s_last)
+        return (
+            (st.pos, st.int_val, st.sig, st.mult),
+            (s_sum, s_cnt, s_min, s_max, s_last),
+        )
+
+    if unroll:
+        carry = (state0, acc0)
+        for j in range(k):
+            ts_widx = (31 + MAX_REC_BITS * j) >> 5
+            val_widx = (31 + MAX_REC_BITS * j + 36) >> 5
+            carry = body(carry, ts_widx, val_widx)
+        _state, acc = carry
+    else:
+        _state, acc = jax.lax.fori_loop(
+            0, k, lambda _i, c: body(c, None, None), (state0, acc0)
+        )
+    s_sum, s_cnt, s_min, s_max, s_last = acc
+    return LaneAggregates(
+        sum=s_sum, count=s_cnt, min=s_min, max=s_max, last=s_last,
+        err=jnp.zeros(shape, bool),
+    )
+
+
 # ---------------------------------------------------------------------------
 # jnp fallback path (CPU tests, oracle, non-TPU backends)
 # ---------------------------------------------------------------------------
@@ -222,24 +310,45 @@ NLANE = len(PACKED_LANE_PLANES)
 class PackedLanes(NamedTuple):
     """Host-packed kernel inputs (see pack_lane_inputs)."""
 
-    windows4: np.ndarray  # u32[tiles, CW, 8, 128]
-    lanes4: np.ndarray  # u32[tiles, NLANE, 8, 128]
+    windows4: np.ndarray  # u32[tiles, CW, R, 128]
+    lanes4: np.ndarray  # u32[tiles, NLANE, R, 128]
+    tile_flags: np.ndarray  # i32[tiles] 1 = every lane in tile is fast
     n: int  # true lane count (before tile padding)
+    order: str  # "c" (chunk-major) or "s" (series-major) lane ordering
 
 
-def pack_lane_inputs(batch) -> PackedLanes:
+def pack_lane_inputs(batch, order: str = "c", rows: int = ROWS_DEFAULT) -> PackedLanes:
     """Pack a ChunkedBatch's lane arrays into the kernel's DMA-friendly
-    layout on the host (numpy; one-time per batch / done at fileset load)."""
+    layout on the host (numpy; one-time per batch / done at fileset load).
+
+    ``order="c"`` lays lanes out chunk-major (lane j = chunk_idx * S +
+    series_idx): a tile then holds the SAME chunk position across ~1024
+    series, so host-classified fast chunks (ChunkedBatch.fast) cluster into
+    homogeneous tiles and the kernel picks the specialized body per tile.
+    Series-major ("s") keeps the original ordering (mixed tiles, general
+    body everywhere)."""
     windows = np.asarray(batch.windows, np.uint32)
     n, cw = windows.shape
-    tiles = -(-n // TILE_LANES)
-    npad = tiles * TILE_LANES
-    r, c = LANE_TILE
+    s, c = batch.num_series, batch.num_chunks
+
+    def reorder(x):
+        if order != "c":
+            return x
+        return np.ascontiguousarray(
+            x.reshape((s, c) + x.shape[1:]).swapaxes(0, 1).reshape(x.shape)
+        )
+
+    if rows <= 0 or rows % 8:
+        raise ValueError(f"rows must be a positive multiple of 8, got {rows}")
+    tile_lanes = rows * 128
+    tiles = -(-n // tile_lanes)
+    npad = tiles * tile_lanes
+    r, cc = rows, 128
 
     wpad = np.zeros((npad, cw), np.uint32)
-    wpad[:n] = windows
+    wpad[:n] = reorder(windows)
     windows4 = np.ascontiguousarray(
-        wpad.reshape(tiles, r, c, cw).transpose(0, 3, 1, 2)
+        wpad.reshape(tiles, r, cc, cw).transpose(0, 3, 1, 2)
     )
 
     def u32(x):
@@ -254,83 +363,143 @@ def pack_lane_inputs(batch) -> PackedLanes:
             return pair[0] if name.endswith("_hi") else pair[1]
         return getattr(batch, name)
 
-    fields = [u32(plane(name)) for name in PACKED_LANE_PLANES]
+    fields = [u32(reorder(np.asarray(plane(name)))) for name in PACKED_LANE_PLANES]
     lpad = np.zeros((NLANE, npad), np.uint32)
     for i, f in enumerate(fields):
         lpad[i, :n] = f
     lanes4 = np.ascontiguousarray(
-        lpad.reshape(NLANE, tiles, r, c).transpose(1, 0, 2, 3)
+        lpad.reshape(NLANE, tiles, r, cc).transpose(1, 0, 2, 3)
     )
-    return PackedLanes(windows4=windows4, lanes4=lanes4, n=n)
+
+    fast = getattr(batch, "fast", None)
+    if fast is None:
+        fpad = np.zeros(npad, bool)
+    else:
+        fpad = np.ones(npad, bool)  # padding lanes never force a tile slow
+        fpad[:n] = reorder(np.asarray(fast, bool))
+    tile_flags = fpad.reshape(tiles, tile_lanes).all(axis=1).astype(np.int32)
+    return PackedLanes(
+        windows4=windows4, lanes4=lanes4, tile_flags=tile_flags, n=n, order=order
+    )
 
 
-def _pallas_kernel_packed(k, cw, int_optimized, unroll, win_ref, lane_ref, out_ref):
+def _pallas_kernel_packed(
+    k, cw, int_optimized, unroll, specialize, flag_ref, win_ref, lane_ref, out_ref
+):
+    from jax.experimental import pallas as pl
+
     cols = [win_ref[0, j] for j in range(cw)]
-    zero = jnp.zeros(LANE_TILE, U32)
+    zero = jnp.zeros(win_ref.shape[2:], U32)
     cols = cols + [zero, zero, zero]
     ln = lambda name: lane_ref[0, PACKED_LANE_PLANES.index(name)]
     pair = lambda name: (ln(name + "_hi"), ln(name + "_lo"))
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, I32)
-    agg = _run_lane_tile(
-        cols,
-        as_i32(ln("rel_pos")),
-        as_i32(ln("num_bits")),
-        ln("first") != 0,
-        pair("prev_time"),
-        pair("prev_delta"),
-        pair("prev_float_bits"),
-        pair("prev_xor"),
-        pair("int_val"),
-        as_i32(ln("time_unit")),
-        as_i32(ln("sig")),
-        as_i32(ln("mult")),
-        ln("is_float") != 0,
-        k,
-        cw,
-        int_optimized,
-        use_scan=False,
-        unroll=unroll,
-    )
-    out_ref[0, 0] = agg.sum
-    # count <= k << 2^24, so f32 carries it exactly through the packed block
-    out_ref[0, 1] = agg.count.astype(F32)
-    out_ref[0, 2] = agg.min
-    out_ref[0, 3] = agg.max
-    out_ref[0, 4] = agg.last
-    out_ref[0, 5] = agg.err.astype(F32)
+
+    def write(agg):
+        out_ref[0, 0] = agg.sum
+        # count <= k << 2^24, so f32 carries it exactly through the packed block
+        out_ref[0, 1] = agg.count.astype(F32)
+        out_ref[0, 2] = agg.min
+        out_ref[0, 3] = agg.max
+        out_ref[0, 4] = agg.last
+        out_ref[0, 5] = agg.err.astype(F32)
+
+    def general():
+        write(
+            _run_lane_tile(
+                cols,
+                as_i32(ln("rel_pos")),
+                as_i32(ln("num_bits")),
+                ln("first") != 0,
+                pair("prev_time"),
+                pair("prev_delta"),
+                pair("prev_float_bits"),
+                pair("prev_xor"),
+                pair("int_val"),
+                as_i32(ln("time_unit")),
+                as_i32(ln("sig")),
+                as_i32(ln("mult")),
+                ln("is_float") != 0,
+                k,
+                cw,
+                int_optimized,
+                use_scan=False,
+                unroll=unroll,
+            )
+        )
+
+    if not specialize:
+        general()
+        return
+
+    is_fast = flag_ref[pl.program_id(0)] != 0
+    pl.when(~is_fast)(general)
+
+    @pl.when(is_fast)
+    def _fast():
+        write(
+            _run_lane_tile_fast(
+                cols,
+                as_i32(ln("rel_pos")),
+                as_i32(ln("num_bits")),
+                pair("int_val"),
+                as_i32(ln("sig")),
+                as_i32(ln("mult")),
+                k,
+                cw,
+                unroll=unroll,
+            )
+        )
 
 
-@functools.partial(jax.jit, static_argnames=("n", "k", "int_optimized", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("n", "k", "int_optimized", "interpret", "specialize"),
+)
 def lane_aggregates_packed(
-    windows4, lanes4, n: int, k: int, int_optimized: bool = True,
-    interpret: bool = False,
+    windows4, lanes4, tile_flags=None, n: int = 0, k: int = 0,
+    int_optimized: bool = True, interpret: bool = False, specialize: bool = True,
 ) -> LaneAggregates:
-    """Fast path: 3 contiguous DMAs per grid program (see module note)."""
+    """Fast path: 3 contiguous DMAs per grid program (see module note).
+
+    ``tile_flags`` (i32[tiles], from pack_lane_inputs) selects the
+    specialized all-int marker-free body per tile; None or
+    ``specialize=False`` compiles the general body only."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     windows4 = jnp.asarray(windows4, U32)
     lanes4 = jnp.asarray(lanes4, U32)
     tiles, cw = windows4.shape[0], windows4.shape[1]
-    npad = tiles * TILE_LANES
+    rows = windows4.shape[2]
+    npad = tiles * rows * 128
+    if tile_flags is None:
+        tile_flags = jnp.zeros((tiles,), I32)
+        specialize = False
+    tile_flags = jnp.asarray(tile_flags, I32)
 
-    outs = pl.pallas_call(
-        functools.partial(_pallas_kernel_packed, k, cw, int_optimized, not interpret),
+    # the tile flags ride scalar prefetch (SMEM); index maps gain the scalar
+    # ref as a trailing arg per PrefetchScalarGridSpec convention
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(tiles,),
         in_specs=[
-            pl.BlockSpec((1, cw, *LANE_TILE), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, NLANE, *LANE_TILE), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cw, rows, 128), lambda i, _f: (i, 0, 0, 0)),
+            pl.BlockSpec((1, NLANE, rows, 128), lambda i, _f: (i, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 6, *LANE_TILE), lambda i: (i, 0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((tiles, 6, *LANE_TILE), F32),
+        out_specs=pl.BlockSpec((1, 6, rows, 128), lambda i, _f: (i, 0, 0, 0)),
+    )
+    outs = pl.pallas_call(
+        functools.partial(
+            _pallas_kernel_packed, k, cw, int_optimized, not interpret, specialize
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tiles, 6, rows, 128), F32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
+            dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(windows4, lanes4)
+    )(tile_flags, windows4, lanes4)
     s_sum, s_cnt, s_min, s_max, s_last, s_err = (
         outs[:, i].reshape(npad)[:n] for i in range(6)
     )

@@ -97,9 +97,17 @@ def chunked_scan_aggregate(lane_args: dict, s: int, c: int, k: int, with_psum=Fa
     return _aggregate_decoded(vals, valid, with_psum)
 
 
-def _aggregates_from_lanes(lane_agg, s: int, c: int, with_psum: bool) -> ScanAggregates:
-    """Reduce per-lane (per-chunk) aggregates [S*C] to ScanAggregates."""
-    rs = lambda x: x.reshape(s, c)
+def _aggregates_from_lanes(
+    lane_agg, s: int, c: int, with_psum: bool, lane_order: str = "s"
+) -> ScanAggregates:
+    """Reduce per-lane (per-chunk) aggregates [S*C] to ScanAggregates.
+
+    ``lane_order``: "s" = series-major (lane = s*C + c), "c" = chunk-major
+    (lane = c*S + s, the specialized packed kernel layout)."""
+    if lane_order == "c":
+        rs = lambda x: x.reshape(c, s).T
+    else:
+        rs = lambda x: x.reshape(s, c)
     l_sum, l_cnt = rs(lane_agg.sum), rs(lane_agg.count)
     l_min, l_max, l_last = rs(lane_agg.min), rs(lane_agg.max), rs(lane_agg.last)
     s_sum = jnp.sum(l_sum, axis=1)
@@ -155,17 +163,20 @@ def chunked_scan_aggregate_fused(
 
 
 def chunked_scan_aggregate_packed(
-    windows4, lanes4, n: int, s: int, c: int, k: int, with_psum=False,
-    interpret: bool = False,
+    windows4, lanes4, tile_flags=None, n: int = 0, s: int = 0, c: int = 0,
+    k: int = 0, with_psum=False, interpret: bool = False,
+    lane_order: str = "c",
 ):
     """Packed-layout flagship path: 3 contiguous DMAs per Pallas grid program
-    (ops/fused.py packed kernel). Inputs come from fused.pack_lane_inputs."""
+    (ops/fused.py packed kernel). Inputs come from fused.pack_lane_inputs;
+    ``tile_flags`` routes homogeneous fast tiles through the specialized
+    all-int body."""
     from ..ops import fused
 
     lane_agg = fused.lane_aggregates_packed(
-        windows4, lanes4, n=n, k=k, interpret=interpret
+        windows4, lanes4, tile_flags, n=n, k=k, interpret=interpret
     )
-    return _aggregates_from_lanes(lane_agg, s, c, with_psum)
+    return _aggregates_from_lanes(lane_agg, s, c, with_psum, lane_order=lane_order)
 
 
 def chunked_device_args(batch: ChunkedBatch, device_put=True) -> dict:

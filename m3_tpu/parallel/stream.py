@@ -26,37 +26,93 @@ from ..ops import fused
 from .scan import chunked_scan_aggregate_packed
 
 
+@jax.jit
+def _fold_totals(acc, tsum, tcnt, tmin, tmax):
+    import jax.numpy as jnp
+
+    from ..ops import u64
+
+    a_sum, (c_hi, c_lo), a_min, a_max = acc
+    has = tcnt > 0
+    # count rides a (hi, lo) u32 pair: a plain i32 accumulator wraps past
+    # 2^31 datapoints (~6 benchmark batches) and x64 is disabled
+    c_hi, c_lo = u64.add((c_hi, c_lo), u64.from_u32(tcnt))
+    return (
+        a_sum + jnp.where(has, tsum, 0.0),
+        (c_hi, c_lo),
+        jnp.minimum(a_min, jnp.where(has, tmin, jnp.inf)),
+        jnp.maximum(a_max, jnp.where(has, tmax, -jnp.inf)),
+    )
+
+
 @dataclass
 class StreamTotals:
-    """Cross-batch aggregate of the per-batch ScanAggregates totals."""
+    """Cross-batch aggregate of the per-batch ScanAggregates totals.
 
-    total_sum: float = 0.0
-    total_count: int = 0
-    total_min: float = float("inf")
-    total_max: float = float("-inf")
+    Folding stays ON DEVICE (a jitted scalar reduce per batch) — per-batch
+    device→host scalar reads would serialize the pipeline on a sync each
+    batch; the single transfer happens at finalize()."""
+
+    _acc: tuple | None = None
     batches: int = 0
 
     def fold(self, agg) -> None:
-        self.total_sum += float(agg.total_sum)
-        self.total_count += int(agg.total_count)
-        cnt = int(agg.total_count)
-        if cnt:
-            self.total_min = min(self.total_min, float(agg.total_min))
-            self.total_max = max(self.total_max, float(agg.total_max))
+        import jax.numpy as jnp
+
+        if self._acc is None:
+            self._acc = (
+                jnp.float32(0.0),
+                (jnp.uint32(0), jnp.uint32(0)),
+                jnp.float32(jnp.inf),
+                jnp.float32(-jnp.inf),
+            )
+        self._acc = _fold_totals(
+            self._acc, agg.total_sum, agg.total_count, agg.total_min, agg.total_max
+        )
         self.batches += 1
+
+    def finalize(self) -> None:
+        if self._acc is not None and not isinstance(self._acc[0], float):
+            s, (c_hi, c_lo), lo, hi = jax.device_get(self._acc)
+            self._acc = (
+                float(s), (int(c_hi) << 32) | int(c_lo), float(lo), float(hi)
+            )
+
+    @property
+    def total_sum(self) -> float:
+        self.finalize()
+        return self._acc[0] if self._acc else 0.0
+
+    @property
+    def total_count(self) -> int:
+        self.finalize()
+        return self._acc[1] if self._acc else 0
+
+    @property
+    def total_min(self) -> float:
+        self.finalize()
+        return self._acc[2] if self._acc else float("inf")
+
+    @property
+    def total_max(self) -> float:
+        self.finalize()
+        return self._acc[3] if self._acc else float("-inf")
 
 
 def packed_batches(batches: Iterable) -> Iterator[tuple]:
-    """ChunkedBatch iterable → (windows4, lanes4, n, s, c, k) host tuples."""
+    """ChunkedBatch iterable → (windows4, lanes4, flags, n, s, c, k) host
+    tuples."""
     for batch in batches:
         packed = fused.pack_lane_inputs(batch)
         yield (
             packed.windows4,
             packed.lanes4,
+            packed.tile_flags,
             packed.n,
             batch.num_series,
             batch.num_chunks,
             batch.k,
+            packed.order,
         )
 
 
@@ -83,11 +139,19 @@ def stream_aggregate(
         if drain_times is not None:
             drain_times.append(_time.perf_counter())
 
-    for w4, l4, n, s, c, k in host_batches:
+    for w4, l4, flags, n, s, c, k, order in host_batches:
         dev_w = jax.device_put(w4)
         dev_l = jax.device_put(l4)
-        fn = _jitted(n, s, c, k)
-        inflight.append(fn(dev_w, dev_l))
+        dev_f = jax.device_put(flags)
+        # stage the upload to completion BEFORE dispatching the kernel:
+        # enqueueing a computation on still-in-flight transfers degrades the
+        # transfer path catastrophically on tunneled devices (measured 0.2s
+        # -> ~20s per batch), and the kernel (~ms) is far cheaper than the
+        # upload anyway — cross-batch overlap still comes from the inflight
+        # window below
+        jax.block_until_ready((dev_w, dev_l, dev_f))
+        fn = _jitted(n, s, c, k, order)
+        inflight.append(fn(dev_w, dev_l, dev_f))
         if len(inflight) > prefetch:
             drain_one()
     while inflight:
@@ -96,13 +160,14 @@ def stream_aggregate(
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted(n: int, s: int, c: int, k: int):
+def _jitted(n: int, s: int, c: int, k: int, lane_order: str = "c"):
     # Mosaic kernels are TPU-only; other backends run the kernel body in
     # Pallas interpret mode (same code path, no Mosaic lowering)
     interpret = jax.default_backend() != "tpu"
     return jax.jit(
         functools.partial(
-            chunked_scan_aggregate_packed, n=n, s=s, c=c, k=k, interpret=interpret
+            chunked_scan_aggregate_packed, n=n, s=s, c=c, k=k,
+            interpret=interpret, lane_order=lane_order,
         )
     )
 

@@ -148,7 +148,7 @@ def parse_body(body: str, precision: str = "ns", now_nanos: int | None = None):
             if now_nanos is None:
                 import time
 
-                now_nanos = int(time.time() * 1e9)
+                now_nanos = time.time_ns()
             t_nanos = now_nanos
         else:
             t_nanos = ts * mult

@@ -31,8 +31,9 @@ if TYPE_CHECKING:  # ops.chunked pulls in jax; storage nodes import lazily
 CHUNK_K = 32
 SUMMARY_EVERY = 64  # index-entry sampling rate for the summaries file
 
-# per-chunk snapshot record (see snapshot_stream)
-SIDE_DTYPE = np.dtype(
+# per-chunk snapshot record (see snapshot_stream); v2 adds the fast-chunk
+# classification flags byte (device kernel specialization, ops/fused.py)
+SIDE_DTYPE_V1 = np.dtype(
     [
         ("off", "<u4"),
         ("prev_time", "<u8"),
@@ -46,6 +47,8 @@ SIDE_DTYPE = np.dtype(
         ("is_float", "<u1"),
     ]
 )
+SIDE_DTYPE = np.dtype(SIDE_DTYPE_V1.descr + [("flags", "<u1")])
+SIDE_VERSION = 2
 
 SUFFIXES = ("info", "index", "summaries", "bloomfilter", "data", "side", "digest", "checkpoint")
 
@@ -135,6 +138,7 @@ def write_fileset(
                 p["sig"],
                 p["mult"],
                 int(p["is_float"]),
+                1 if p.get("fast") else 0,
             )
         side_bytes = side.tobytes()
         index_entries.append(
@@ -162,6 +166,7 @@ def write_fileset(
                 "bloomBits": bloom.m,
                 "bloomK": bloom.k,
                 "summariesIndexOffsets": True,
+                "sideVersion": SIDE_VERSION,
             }
         ).encode(),
         "index": b"".join(index_entries),
@@ -275,6 +280,9 @@ class FilesetReader:
         )
         self._data = self._mmap(base, "data")
         self._side = self._mmap(base, "side")
+        self._side_dtype = (
+            SIDE_DTYPE if self.info.get("sideVersion", 1) >= 2 else SIDE_DTYPE_V1
+        )
         self._index_mm = self._mmap(base, "index")
         self._entries: dict[bytes, tuple[int, int, int, int] | None] = {}
         self._side_bases: dict[int, int] = {0: 0}
@@ -332,7 +340,7 @@ class FilesetReader:
             while pos < n:
                 sid, (offset, length, _, n_chunks), pos = self._parse_entry(pos)
                 out[sid] = (offset, length, side_off, n_chunks)
-                side_off += n_chunks * SIDE_DTYPE.itemsize
+                side_off += n_chunks * self._side_dtype.itemsize
             self._full_index = out
         return self._full_index
 
@@ -369,7 +377,7 @@ class FilesetReader:
                 break
             if entry_sid > sid:
                 break
-            side_off += n_chunks * SIDE_DTYPE.itemsize
+            side_off += n_chunks * self._side_dtype.itemsize
             count += 1
         self._entries[sid] = found
         return found
@@ -388,7 +396,7 @@ class FilesetReader:
             side_off = bases[known]
             while pos < stop:
                 _, (_, _, _, n_chunks), pos = self._parse_entry(pos)
-                side_off += n_chunks * SIDE_DTYPE.itemsize
+                side_off += n_chunks * self._side_dtype.itemsize
             known += 1
             bases[known] = side_off
         return bases[sample_i]
@@ -418,7 +426,7 @@ class FilesetReader:
             return None
         offset, length, side_off, n_chunks = entry
         raw = np.frombuffer(
-            self._side, SIDE_DTYPE, count=n_chunks, offset=side_off
+            self._side, self._side_dtype, count=n_chunks, offset=side_off
         )
         snaps = []
         offs = list(raw["off"]) + [length * 8]
@@ -435,6 +443,9 @@ class FilesetReader:
                     sig=int(raw["sig"][j]),
                     mult=int(raw["mult"][j]),
                     is_float=bool(raw["is_float"][j]),
+                    fast=bool(raw["flags"][j] & 1)
+                    if "flags" in raw.dtype.names
+                    else False,
                     span=int(offs[j + 1]) - int(raw["off"][j]),
                     total_bits=length * 8,
                 )

@@ -495,7 +495,7 @@ int64_t sign_extend(uint64_t v, int n) {
 }
 
 #pragma pack(push, 1)
-struct SnapRec {  // matches storage/fs.py SIDE_DTYPE
+struct SnapRec {  // matches storage/fs.py SIDE_DTYPE (v2, with flags)
   uint32_t off;
   uint64_t prev_time;
   uint64_t prev_delta;
@@ -506,6 +506,7 @@ struct SnapRec {  // matches storage/fs.py SIDE_DTYPE
   uint8_t sig;
   uint8_t mult;
   uint8_t is_float;
+  uint8_t flags;  // bit 0: fast chunk (all-int, marker-free, {s,ms} unit)
 };
 #pragma pack(pop)
 
@@ -514,6 +515,7 @@ struct Iter {
   int64_t prev_time = 0, prev_delta = 0;
   int time_unit = 0;
   bool tu_changed = false;
+  int markers = 0;  // markers consumed (EOS/annotation/time-unit)
   bool done = false, err = false;
   uint64_t prev_float_bits = 0, prev_xor = 0;
   double int_val = 0;
@@ -552,14 +554,17 @@ struct Iter {
       if (marker == EOS_MARKER) {
         r.pos += NUM_MARKER_BITS;
         done = true;
+        markers++;
         *dod_out = 0;
         return true;
       } else if (marker == ANNOTATION_MARKER) {
         r.pos += NUM_MARKER_BITS;
+        markers++;
         if (!read_varint_skip()) return false;
         return read_dod(dod_out);
       } else if (marker == TIME_UNIT_MARKER) {
         r.pos += NUM_MARKER_BITS;
+        markers++;
         uint64_t tu;
         if (!r.read(8, &tu)) return false;
         if (unit_nanos((int)tu) != 0 && (int)tu != time_unit) tu_changed = true;
@@ -824,11 +829,20 @@ int32_t m3tsz_prescan(const uint8_t* data, int64_t len_bytes, int32_t k,
   it.default_unit = default_unit;
   int32_t nsnap = 0;
   int64_t nrec = 0;
+  // fast-chunk classification mirrors ops/chunked.snapshot_stream
+  bool chunk_fast = true;
+  int chunk_recs = 0;
   // initial unit for the first snapshot (mirrors snapshot_stream)
   while (true) {
     SnapRec pending;
     bool has_pending = false;
     if (nrec % k == 0 && nsnap < max_snaps) {
+      if (nsnap > 0) {
+        // previous chunk completed all k records: seal its flag
+        out[nsnap - 1].flags = (chunk_fast && chunk_recs == k) ? 1 : 0;
+      }
+      chunk_fast = true;
+      chunk_recs = 0;
       pending.off = (uint32_t)it.r.pos;
       pending.prev_time = (uint64_t)it.prev_time;
       pending.prev_delta = (uint64_t)it.prev_delta;
@@ -846,12 +860,23 @@ int32_t m3tsz_prescan(const uint8_t* data, int64_t len_bytes, int32_t k,
       pending.sig = (uint8_t)it.sig;
       pending.mult = (uint8_t)it.mult;
       pending.is_float = it.is_float ? 1 : 0;
+      pending.flags = 0;
       has_pending = true;
     }
+    int markers_before = it.markers;
     if (!it.next(nrec == 0)) break;
     if (has_pending) out[nsnap++] = pending;
     nrec++;
+    chunk_recs++;
+    if (it.markers != markers_before || it.is_float ||
+        !(it.time_unit == 1 || it.time_unit == 2) || !it.int_optimized ||
+        it.sig > 31 || std::fabs(it.int_val) > 2147483647.0) {
+      chunk_fast = false;
+    }
     if (it.done || it.err) break;
+  }
+  if (nsnap > 0 && chunk_recs > 0) {
+    out[nsnap - 1].flags = (chunk_fast && chunk_recs == k) ? 1 : 0;
   }
   return nsnap;
 }

@@ -58,14 +58,14 @@ def _fused(batch, args, backend):
     return fn(args)
 
 
-def _assert_matches(got, want):
+def _assert_matches(got, want, rtol=1e-6):
     np.testing.assert_array_equal(np.asarray(got.series_count), np.asarray(want.series_count))
-    np.testing.assert_allclose(np.asarray(got.series_sum), np.asarray(want.series_sum), rtol=1e-6)
-    np.testing.assert_allclose(np.asarray(got.series_min), np.asarray(want.series_min), rtol=1e-6)
-    np.testing.assert_allclose(np.asarray(got.series_max), np.asarray(want.series_max), rtol=1e-6)
-    np.testing.assert_allclose(np.asarray(got.series_last), np.asarray(want.series_last), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.series_sum), np.asarray(want.series_sum), rtol=rtol)
+    np.testing.assert_allclose(np.asarray(got.series_min), np.asarray(want.series_min), rtol=rtol)
+    np.testing.assert_allclose(np.asarray(got.series_max), np.asarray(want.series_max), rtol=rtol)
+    np.testing.assert_allclose(np.asarray(got.series_last), np.asarray(want.series_last), rtol=rtol)
     assert int(got.total_count) == int(want.total_count)
-    np.testing.assert_allclose(float(got.total_sum), float(want.total_sum), rtol=1e-6)
+    np.testing.assert_allclose(float(got.total_sum), float(want.total_sum), rtol=rtol)
 
 
 @pytest.mark.parametrize("k", [8, 16, 24])
@@ -111,6 +111,87 @@ def test_packed_pallas_interpret_matches_oracle(k):
     _assert_matches(got, _oracle(batch, args))
 
 
+@pytest.mark.parametrize("kind", ["gauge", "counter", "float"])
+def test_packed_specialized_interpret_matches_oracle(kind):
+    """Specialized fast-tile body (all-int marker-free chunks) vs oracle in
+    interpret mode, across workloads that classify differently."""
+    from m3_tpu.ops import fused
+    from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+
+    streams = synthetic_streams(32, 97, seed=13, kind=kind)
+    batch = tile_chunked(build_chunked(streams, k=16), 96)
+    if kind in ("gauge", "counter"):
+        # middle chunks of int-optimizable data must classify fast,
+        # otherwise the specialization never executes
+        assert np.asarray(batch.fast).sum() > 0
+    args = chunked_device_args(batch, device_put=False)
+    packed = fused.pack_lane_inputs(batch)
+    got = chunked_scan_aggregate_packed(
+        packed.windows4, packed.lanes4, packed.tile_flags, n=packed.n,
+        s=batch.num_series, c=batch.num_chunks, k=batch.k, interpret=True,
+    )
+    # rtol covers the chunk-major reduction's different f32 sum order
+    _assert_matches(got, _oracle(batch, args), rtol=1e-5)
+
+
+def test_fast_classification_boundaries():
+    """First chunks, EOS chunks, float records, and annotations must
+    classify slow; clean middle chunks fast."""
+    from m3_tpu.codec.m3tsz import Encoder
+    from m3_tpu.ops.chunked import snapshot_stream
+
+    NANOS = 1_000_000_000
+    # 40 int-mode points, k=8 -> 5 chunks; EOS consumed beyond chunk 5
+    enc = Encoder(10 * NANOS)
+    for i in range(40):
+        enc.encode((10 + i) * NANOS, float(i))
+    snaps = snapshot_stream(enc.stream(), 8)
+    assert [p["fast"] for p in snaps] == [True] * 5  # chunk 0 slowed later
+    from m3_tpu.ops.chunked import assemble_chunked
+
+    batch = assemble_chunked([enc.stream()], [snaps], 8)
+    assert list(np.asarray(batch.fast)) == [False, True, True, True, True]
+
+    # a float value mid-chunk de-classifies that chunk only
+    enc2 = Encoder(10 * NANOS)
+    for i in range(24):
+        v = 0.1234567890123 if i == 12 else float(i)  # not int-optimizable
+        enc2.encode((10 + i) * NANOS, v)
+    snaps2 = snapshot_stream(enc2.stream(), 8)
+    assert [p["fast"] for p in snaps2] == [True, False, True]
+
+    # an annotation mid-chunk de-classifies
+    enc3 = Encoder(10 * NANOS)
+    for i in range(24):
+        ann = b"x" if i == 12 else None
+        enc3.encode((10 + i) * NANOS, float(i), annotation=ann)
+    snaps3 = snapshot_stream(enc3.stream(), 8)
+    assert [p["fast"] for p in snaps3] == [True, False, True]
+
+    # partial trailing chunk (not k records) is slow
+    enc4 = Encoder(10 * NANOS)
+    for i in range(20):
+        enc4.encode((10 + i) * NANOS, float(i))
+    snaps4 = snapshot_stream(enc4.stream(), 8)
+    assert [p["fast"] for p in snaps4] == [True, True, False]
+
+
+def test_native_prescan_fast_flags_match_python():
+    from m3_tpu import native
+    from m3_tpu.ops.chunked import snapshot_stream
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    streams = synthetic_streams(16, 97, seed=3)
+    for k in (8, 16):
+        got = native.prescan_batch(streams, k=k)
+        for data, per_native in zip(streams, got):
+            per_py = snapshot_stream(data, k)
+            assert [bool(p["fast"]) for p in per_native] == [
+                bool(p["fast"]) for p in per_py
+            ]
+
+
 def test_fused_auto_backend_on_cpu_is_jnp():
     """ADVICE r2: backend='auto' must not pick the Mosaic kernel off-TPU."""
     batch = _batch()
@@ -153,13 +234,18 @@ np.testing.assert_allclose(
 from m3_tpu.ops import fused
 from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
 packed = fused.pack_lane_inputs(batch)
+assert packed.tile_flags.sum() > 0, "no fast tiles classified"
 pp = functools.partial(
     chunked_scan_aggregate_packed, n=packed.n, s=batch.num_series,
     c=batch.num_chunks, k=batch.k)
-got2 = jax.jit(pp)(packed.windows4, packed.lanes4)
+got2 = jax.jit(pp)(packed.windows4, packed.lanes4, packed.tile_flags)
 assert int(got2.total_count) == int(want.total_count)
 np.testing.assert_allclose(
     float(got2.total_sum), float(want.total_sum), rtol=1e-6)
+np.testing.assert_allclose(
+    np.asarray(got2.series_sum), np.asarray(want.series_sum), rtol=1e-5)
+np.testing.assert_array_equal(
+    np.asarray(got2.series_count), np.asarray(want.series_count))
 print("TPU_SMOKE_OK")
 """
     from m3_tpu.testing.cpu_mesh import original_env

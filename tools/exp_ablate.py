@@ -1,0 +1,64 @@
+"""Ablation timing for the fast kernel body (results are WRONG on purpose;
+timing only). Usage: python tools/exp_ablate.py <mode>
+
+modes: full | noval | nots | noconv
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODE = sys.argv[1] if len(sys.argv) > 1 else "full"
+
+import jax
+import jax.numpy as jnp
+
+from m3_tpu.ops import fused
+from m3_tpu.ops import decode as D
+from m3_tpu.ops.chunked import build_chunked, tile_chunked
+from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+from m3_tpu.utils.synthetic import synthetic_streams
+
+F32 = jnp.float32
+I32 = jnp.int32
+
+# patch the symbols the FAST body actually reads (fused module globals)
+if MODE == "noval":
+    fused._decode_value_fast = lambda fetch4, st: st._replace(pos=st.pos + 9)
+elif MODE == "nots":
+    fused._ts_consumed_fast = lambda ws: jnp.full(ws[0].shape, 10, I32)
+elif MODE == "noconv":
+    fused._int32_val_to_f32 = lambda iv, mult: iv.astype(F32)
+
+def main():
+    streams = synthetic_streams(64, 720, seed=3)
+    batch = tile_chunked(build_chunked(streams, k=24), 524288)
+    packed = fused.pack_lane_inputs(batch)
+    w4 = jax.device_put(packed.windows4)
+    l4 = jax.device_put(packed.lanes4)
+    tf = jax.device_put(packed.tile_flags)
+    fn = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate_packed,
+            n=packed.n, s=batch.num_series, c=batch.num_chunks, k=batch.k,
+        )
+    )
+    out = fn(w4, l4, tf)
+    jax.block_until_ready(out)
+    pts = batch.num_series * 720
+    print("warm total_count:", int(out.total_count))
+    t0 = time.perf_counter()
+    for i in range(20):
+        t1 = time.perf_counter()
+        out = fn(w4, l4, tf)
+        jax.block_until_ready(out)
+        pass
+    dt = (time.perf_counter() - t0) / 20
+    print(f"{MODE}: {dt*1e3:.2f} ms/iter ({pts/dt/1e9:.2f}B pts/s nominal)")
+
+main()

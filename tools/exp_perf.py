@@ -28,6 +28,7 @@ def run_point(streams, k: int, n_series: int, iters: int = 10) -> float:
     packed = fused.pack_lane_inputs(batch)
     w4 = jax.device_put(packed.windows4)
     l4 = jax.device_put(packed.lanes4)
+    tf = jax.device_put(packed.tile_flags)
     fn = jax.jit(
         functools.partial(
             chunked_scan_aggregate_packed,
@@ -37,12 +38,12 @@ def run_point(streams, k: int, n_series: int, iters: int = 10) -> float:
             k=batch.k,
         )
     )
-    out = fn(w4, l4)
+    out = fn(w4, l4, tf)
     jax.block_until_ready(out)
     total_points = int(out.total_count)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(w4, l4)
+        out = fn(w4, l4, tf)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
     return total_points / dt, dt
