@@ -54,7 +54,12 @@ class _PolicyBuffer:
 
 
 class _Shard:
-    """aggregatorShard (shard.go): owns interned metric entries + buffers."""
+    """aggregatorShard (shard.go): owns interned metric entries + buffers.
+
+    Interned ids play the role of entry.go's per-metric entries: each
+    carries a last-write stamp (TTL expiry, entry.go maybeExpire) and a
+    token-bucket state for the per-metric value rate limit
+    (rate_limit.go)."""
 
     def __init__(self) -> None:
         self.id_index: dict[bytes, int] = {}
@@ -62,6 +67,10 @@ class _Shard:
         self.metric_types: list[MetricType] = []
         self.agg_overrides: dict[int, tuple[AggregationType, ...]] = {}
         self.buffers: dict[StoragePolicy, _PolicyBuffer] = {}
+        self.last_write: list[int] = []  # nanos, per interned id
+        self.rl_tokens: list[float] = []
+        self.rl_stamp: list[int] = []  # last refill nanos
+        self.rate_limited = 0  # values dropped by the per-entry limit
 
     def intern(self, mid: bytes, mtype: MetricType) -> int:
         idx = self.id_index.get(mid)
@@ -70,7 +79,59 @@ class _Shard:
             self.id_index[mid] = idx
             self.ids.append(mid)
             self.metric_types.append(mtype)
+            self.last_write.append(0)
+            self.rl_tokens.append(0.0)
+            self.rl_stamp.append(0)
         return idx
+
+    def admit(self, idx: int, n_values: int, now_nanos: int, limit: float | None) -> bool:
+        """Token-bucket admission at ``limit`` values/sec (burst = one
+        second's worth); None = unlimited. A write is admitted whenever the
+        bucket is non-empty and may overdraw it (a batch larger than the
+        burst is throttled by the resulting debt, not dropped forever)."""
+        self.last_write[idx] = max(self.last_write[idx], now_nanos)
+        if limit is None:
+            return True
+        elapsed = max(now_nanos - self.rl_stamp[idx], 0)
+        if self.rl_stamp[idx] == 0:
+            self.rl_tokens[idx] = limit  # first write: full bucket
+        else:
+            self.rl_tokens[idx] = min(
+                limit, self.rl_tokens[idx] + limit * (elapsed / 1e9)
+            )
+        self.rl_stamp[idx] = now_nanos
+        if self.rl_tokens[idx] > 0:
+            self.rl_tokens[idx] -= n_values
+            return True
+        self.rate_limited += n_values
+        return False
+
+    def has_pending(self) -> bool:
+        return any(buf.ids for buf in self.buffers.values())
+
+    def expire_entries(self, before_nanos: int) -> int:
+        """Drop interned ids idle since ``before_nanos`` (entry TTL,
+        entry.go ShouldExpire). Only safe with no pending buffered values
+        (buffer rows hold indexes); callers run this right after a drain."""
+        if self.has_pending():
+            return 0
+        keep = [
+            i for i in range(len(self.ids)) if self.last_write[i] >= before_nanos
+        ]
+        if len(keep) == len(self.ids):
+            return 0
+        expired = len(self.ids) - len(keep)
+        remap = {old: new for new, old in enumerate(keep)}
+        self.ids = [self.ids[i] for i in keep]
+        self.metric_types = [self.metric_types[i] for i in keep]
+        self.last_write = [self.last_write[i] for i in keep]
+        self.rl_tokens = [self.rl_tokens[i] for i in keep]
+        self.rl_stamp = [self.rl_stamp[i] for i in keep]
+        self.id_index = {mid: i for i, mid in enumerate(self.ids)}
+        self.agg_overrides = {
+            remap[i]: v for i, v in self.agg_overrides.items() if i in remap
+        }
+        return expired
 
     def add(
         self,
@@ -80,12 +141,15 @@ class _Shard:
         values,
         policies,
         aggregations: tuple[AggregationType, ...] | None = None,
+        rate_limit: float | None = None,
     ) -> None:
         idx = self.intern(mid, mtype)
         if aggregations:
             self.agg_overrides[idx] = aggregations
         if not isinstance(values, (list, tuple)):
             values = [values]
+        if not self.admit(idx, len(values), time_nanos, rate_limit):
+            return
         for policy in policies:
             buf = self.buffers.setdefault(policy, _PolicyBuffer())
             for v in values:
@@ -108,6 +172,8 @@ class Aggregator:
         flush_handler: Callable[[list[AggregatedMetric]], None] | None = None,
         election=None,
         flush_times=None,
+        value_rate_limit: float | None = None,
+        entry_ttl_nanos: int | None = None,
     ) -> None:
         self.num_shards = num_shards
         self.shards = [_Shard() for _ in range(num_shards)]
@@ -121,6 +187,12 @@ class Aggregator:
         # (election=None) is always leader.
         self.election = election
         self.flush_times = flush_times
+        # per-metric value rate limit (values/sec, entry.go rate_limit role)
+        self.value_rate_limit = value_rate_limit
+        # idle interned entries older than this are dropped after a drain
+        # (entry.go ShouldExpire + close cycle)
+        self.entry_ttl_nanos = entry_ttl_nanos
+        self.expired_entries = 0
         # late datapoints a replicated leader dropped because their window
         # was already flushed (observability for the replication caveat)
         self.dropped_late = 0
@@ -162,6 +234,7 @@ class Aggregator:
                 values,
                 policies or self.default_policies,
                 aggregations,
+                rate_limit=self.value_rate_limit,
             )
 
     def add_timed(
@@ -177,6 +250,7 @@ class Aggregator:
             self.shards[self.shard_for(mid)].add(
                 mid, mtype, time_nanos, [value],
                 policies or self.default_policies, aggregations,
+                rate_limit=self.value_rate_limit,
             )
 
     # AddForwarded: multi-stage rollup input — same buffer path, the pipeline
@@ -222,7 +296,19 @@ class Aggregator:
                 raise
         if leader and self.flush_times is not None and flushed_boundaries:
             self.flush_times.update(flushed_boundaries)
+        if self.entry_ttl_nanos is not None:
+            # drained buffers make expiry safe; idle entries release their
+            # interned id slots (entry.go TTL close cycle)
+            with self._lock:
+                for shard in self.shards:
+                    self.expired_entries += shard.expire_entries(
+                        up_to_nanos - self.entry_ttl_nanos
+                    )
         return out
+
+    @property
+    def rate_limited(self) -> int:
+        return sum(s.rate_limited for s in self.shards)
 
     def _drain(self, leader, up_to_nanos, leader_times, flushed_boundaries, out):
         for shard in self.shards:

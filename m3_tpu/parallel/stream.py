@@ -53,7 +53,8 @@ class StreamTotals:
     device→host scalar reads would serialize the pipeline on a sync each
     batch; the single transfer happens at finalize()."""
 
-    _acc: tuple | None = None
+    _acc: tuple | None = None  # device accumulator (never downgraded)
+    _final: tuple | None = None  # host snapshot cache for the properties
     batches: int = 0
 
     def fold(self, agg) -> None:
@@ -69,34 +70,37 @@ class StreamTotals:
         self._acc = _fold_totals(
             self._acc, agg.total_sum, agg.total_count, agg.total_min, agg.total_max
         )
+        self._final = None  # invalidate any snapshot taken mid-stream
         self.batches += 1
 
-    def finalize(self) -> None:
-        if self._acc is not None and not isinstance(self._acc[0], float):
-            s, (c_hi, c_lo), lo, hi = jax.device_get(self._acc)
-            self._acc = (
-                float(s), (int(c_hi) << 32) | int(c_lo), float(lo), float(hi)
-            )
+    def finalize(self) -> tuple:
+        """One device→host transfer; safe to call mid-stream (the device
+        accumulator is left untouched so further fold()s keep working)."""
+        if self._final is None:
+            if self._acc is None:
+                self._final = (0.0, 0, float("inf"), float("-inf"))
+            else:
+                s, (c_hi, c_lo), lo, hi = jax.device_get(self._acc)
+                self._final = (
+                    float(s), (int(c_hi) << 32) | int(c_lo), float(lo), float(hi)
+                )
+        return self._final
 
     @property
     def total_sum(self) -> float:
-        self.finalize()
-        return self._acc[0] if self._acc else 0.0
+        return self.finalize()[0]
 
     @property
     def total_count(self) -> int:
-        self.finalize()
-        return self._acc[1] if self._acc else 0
+        return self.finalize()[1]
 
     @property
     def total_min(self) -> float:
-        self.finalize()
-        return self._acc[2] if self._acc else float("inf")
+        return self.finalize()[2]
 
     @property
     def total_max(self) -> float:
-        self.finalize()
-        return self._acc[3] if self._acc else float("-inf")
+        return self.finalize()[3]
 
 
 def packed_batches(batches: Iterable) -> Iterator[tuple]:
@@ -119,8 +123,9 @@ def packed_batches(batches: Iterable) -> Iterator[tuple]:
 def stream_aggregate(
     host_batches: Iterable[tuple], prefetch: int = 2, drain_times: list | None = None
 ) -> StreamTotals:
-    """Stream (windows4, lanes4, n, s, c, k) host batches through the packed
-    kernel with ``prefetch`` batches in flight.
+    """Stream (windows4, lanes4, tile_flags, n, s, c, k, lane_order) host
+    batches (packed_batches output) through the packed kernel with
+    ``prefetch`` batches in flight.
 
     Upload of batch N+1 overlaps compute of batch N (async dispatch); the
     oldest result is drained once the window exceeds ``prefetch``, bounding

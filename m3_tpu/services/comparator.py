@@ -1,0 +1,201 @@
+"""Query-correctness comparator: a deterministic synthetic storage + HTTP
+service for validating PromQL semantics against an independent oracle.
+
+Reference: /root/reference/src/cmd/services/m3comparator/main/querier.go —
+a service implementing the query storage API over reproducible synthetic
+data so query engines can be diff'd result-for-result. Here
+``SyntheticStorage`` plugs straight into the PromQL Engine (the role the
+querier's gRPC surface plays for m3query), every series is a pure function
+of (id hash, timestamp) so ANY implementation can regenerate the identical
+datapoints, and ``compare_range`` diffs engine output against a
+numpy-computed expectation.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.hash import murmur3_32
+
+NANOS = 1_000_000_000
+
+
+def _series_seed(tags: tuple) -> int:
+    blob = b";".join(b"=".join(kv) for kv in sorted(tags))
+    return murmur3_32(blob)
+
+
+def synthetic_value(seed: int, t_nanos: int) -> float:
+    """The deterministic value function: ramp + sinusoid, parameters from
+    the seed. Pure in (seed, t) — the comparator contract."""
+    t = t_nanos / NANOS
+    slope = 0.5 + (seed % 97) / 19.0
+    amp = 10.0 + (seed % 31)
+    period = 120.0 + (seed % 241)
+    phase = (seed % 628) / 100.0
+    return slope * (t % 86_400) + amp * math.sin(2 * math.pi * t / period + phase)
+
+
+@dataclass
+class SyntheticStorage:
+    """Engine-compatible storage over generated series.
+
+    ``num_series`` series named ``metric`` with host/job tags; samples on a
+    fixed ``step`` grid, values from synthetic_value. Matchers support =,
+    !=, =~, !~ over the generated tag sets (querier.go's matcher handling).
+    """
+
+    metric: str = "synthetic_metric"
+    num_series: int = 10
+    step_nanos: int = 10 * NANOS
+    extra_metrics: tuple = ()
+    series_tags: list = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.series_tags:
+            names = (self.metric,) + tuple(self.extra_metrics)
+            for name in names:
+                for i in range(self.num_series):
+                    self.series_tags.append(
+                        (
+                            (b"__name__", name.encode()),
+                            (b"host", b"host-%02d" % i),
+                            (b"job", b"job-%d" % (i % 3)),
+                        )
+                    )
+
+    @staticmethod
+    def _match(tags: tuple, matchers) -> bool:
+        tag_map = {k.decode(): v.decode() for k, v in tags}
+        for m in matchers:
+            val = tag_map.get(m.name, "")
+            if m.op == "=":
+                ok = val == m.value
+            elif m.op == "!=":
+                ok = val != m.value
+            elif m.op == "=~":
+                ok = re.fullmatch(m.value, val) is not None
+            elif m.op == "!~":
+                ok = re.fullmatch(m.value, val) is None
+            else:
+                raise ValueError(f"bad matcher op {m.op}")
+            if not ok:
+                return False
+        return True
+
+    def samples(self, tags: tuple, start_nanos: int, end_nanos: int):
+        seed = _series_seed(tags)
+        first = -(-start_nanos // self.step_nanos) * self.step_nanos
+        times = np.arange(first, end_nanos, self.step_nanos, dtype=np.int64)
+        vals = np.asarray([synthetic_value(seed, int(t)) for t in times], np.float64)
+        return times, vals
+
+    def fetch(self, matchers, start_nanos, end_nanos):
+        out = []
+        for tags in self.series_tags:
+            if self._match(tags, matchers):
+                times, vals = self.samples(tags, start_nanos, end_nanos)
+                out.append((tags, times, vals))
+        return out
+
+
+def make_engine(storage: SyntheticStorage | None = None):
+    from ..query.engine import Engine
+
+    return Engine(storage or SyntheticStorage())
+
+
+def serve(storage: SyntheticStorage | None = None, host: str = "127.0.0.1", port: int = 0):
+    """HTTP comparator service: the PromQL query API over synthetic data
+    (the m3comparator process role). Returns (server, port)."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    from .coordinator import _prom_matrix, _prom_vector
+
+    engine = make_engine(storage)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                if url.path == "/health":
+                    body = {"ok": True}
+                elif url.path == "/api/v1/query_range":
+                    start = float(q["start"][0])
+                    end = float(q["end"][0])
+                    step = float(q.get("step", ["10"])[0])
+                    r = engine.query_range(
+                        q["query"][0], int(start * NANOS), int(end * NANOS),
+                        int(step * NANOS),
+                    )
+                    body = _prom_matrix(r, int(start * NANOS), int(step * NANOS))
+                elif url.path == "/api/v1/query":
+                    t = float(q["time"][0])
+                    body = _prom_vector(engine.query_instant(q["query"][0], int(t * NANOS)), t)
+                else:
+                    self._reply(404, {"error": "not found"})
+                    return
+                self._reply(200, body)
+            except Exception as exc:
+                self._reply(400, {"status": "error", "error": str(exc)})
+
+        def _reply(self, code, obj):
+            payload = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    import threading
+
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+# --- the comparison harness (the "compare engines" purpose) ---
+
+
+def compare_range(
+    engine_result,
+    expected: dict,
+    rtol: float = 1e-9,
+) -> list[str]:
+    """Diff an Engine query_range Result against expected {frozenset(tags):
+    np.ndarray} values (NaN = absent). Returns human-readable mismatches
+    (empty = match)."""
+    problems = []
+    got = {}
+    for row, meta in zip(engine_result.values, engine_result.metas):
+        key = frozenset((k.decode(), v.decode()) for k, v in meta.tags)
+        got[key] = np.asarray(row, np.float64)
+    for key in set(got) | set(expected):
+        if key not in got:
+            problems.append(f"missing series {sorted(key)}")
+            continue
+        if key not in expected:
+            problems.append(f"unexpected series {sorted(key)}")
+            continue
+        g, e = got[key], np.asarray(expected[key], np.float64)
+        if g.shape != e.shape:
+            problems.append(f"shape {g.shape} != {e.shape} for {sorted(key)}")
+            continue
+        both = ~(np.isnan(g) & np.isnan(e))
+        if not np.allclose(g[both], e[both], rtol=rtol, equal_nan=True):
+            bad = np.nonzero(~np.isclose(g, e, rtol=rtol, equal_nan=True))[0]
+            problems.append(
+                f"values differ at steps {bad[:5].tolist()} for {sorted(key)}: "
+                f"got {g[bad[:3]].tolist()} want {e[bad[:3]].tolist()}"
+            )
+    return problems

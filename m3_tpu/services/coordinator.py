@@ -33,6 +33,20 @@ from ..query.promql import Matcher
 from ..storage.database import Database, NamespaceOptions
 from ..utils.snappy import compress, decompress
 
+
+class _Noop:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return None
+
+    def set_tag(self, *a, **k):
+        return self
+
+
+_NOOP_SPAN = _Noop()
+
 NANOS = 1_000_000_000
 MS = 1_000_000
 
@@ -324,65 +338,117 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(n)
 
+    def _debug_dump(self) -> bytes:
+        """x/debug/debug.go zip dump: thread stacks, metrics, namespaces,
+        placement, recent traces."""
+        import io
+        import sys
+        import traceback
+        import zipfile
+
+        from ..utils.instrument import DEFAULT as METRICS
+        from ..utils.trace import TRACER
+
+        c = self.coordinator
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            stacks = []
+            for tid, frame in sys._current_frames().items():
+                stacks.append(f"--- thread {tid} ---")
+                stacks.extend(traceback.format_stack(frame))
+            z.writestr("stacks.txt", "\n".join(stacks))
+            z.writestr("metrics.txt", METRICS.expose())
+            z.writestr("traces.json", json.dumps(TRACER.dump(limit=512), indent=1))
+            ns_info = {
+                name: {
+                    "blockSizeNanos": ns.opts.block_size_nanos,
+                    "retentionNanos": ns.opts.retention_nanos,
+                    "numShards": len(ns.shards),
+                    "numSeries": sum(len(s.series) for s in ns.shards),
+                }
+                for name, ns in c.db.namespaces.items()
+            }
+            z.writestr("namespaces.json", json.dumps(ns_info, indent=1))
+            p = c.placement_svc.get()
+            z.writestr("placement.json", json.dumps(p.to_dict() if p else {}, indent=1))
+        return buf.getvalue()
+
     def do_GET(self) -> None:
+        from ..utils.trace import TRACER
+
         c = self.coordinator
         url = urlparse(self.path)
         q = parse_qs(url.query)
         try:
-            if url.path == "/health":
-                self._json({"ok": True})
-            elif url.path == "/metrics":
-                from ..utils.instrument import DEFAULT as METRICS
+            # poller endpoints (health checks, metric scrapes, the trace
+            # endpoints themselves) would evict useful spans from the ring
+            span = (
+                _NOOP_SPAN
+                if url.path in ("/health", "/metrics", "/debug/traces", "/debug/dump")
+                else TRACER.span("http.get", path=url.path)
+            )
+            with span:
+                if url.path == "/health":
+                    self._json({"ok": True})
+                elif url.path == "/metrics":
+                    from ..utils.instrument import DEFAULT as METRICS
 
-                self._send(
-                    200, METRICS.expose().encode(), ctype="text/plain; version=0.0.4"
-                )
-            elif url.path == "/api/v1/query_range":
-                self._json(
-                    c.query_range(
-                        q["query"][0],
-                        float(q["start"][0]),
-                        float(q["end"][0]),
-                        _parse_step(q.get("step", ["15"])[0]),
+                    self._send(
+                        200, METRICS.expose().encode(), ctype="text/plain; version=0.0.4"
                     )
-                )
-            elif url.path == "/api/v1/query":
-                self._json(c.query_instant(q["query"][0], float(q["time"][0])))
-            elif url.path == "/api/v1/labels":
-                self._json(
-                    {"status": "success",
-                     "data": c.labels(q.get("match[]", []), *_prom_range(q))}
-                )
-            elif url.path == "/api/v1/series":
-                self._json(
-                    {"status": "success",
-                     "data": c.series(q.get("match[]", []), *_prom_range(q))}
-                )
-            elif (m := re.match(r"^/api/v1/label/([^/]+)/values$", url.path)) is not None:
-                self._json(
-                    {"status": "success",
-                     "data": c.label_values(
-                         m.group(1), q.get("match[]", []), *_prom_range(q)
-                     )}
-                )
-            elif url.path == "/api/v1/search":
-                self._json(
-                    {"status": "success",
-                     "data": c.search(
-                         q.get("match[]", []) or q.get("query", []),
-                         *_prom_range(q),
-                         limit=int(q["limit"][0]) if "limit" in q else None,
-                     )}
-                )
-            elif url.path == "/api/v1/services/m3db/placement":
-                p = c.placement_svc.get()
-                self._json(p.to_dict() if p else {}, 200 if p else 404)
-            elif url.path in ("/api/v1/graphite/render", "/render"):
-                self._json(c.graphite_render(q))
-            elif url.path in ("/api/v1/graphite/metrics/find", "/metrics/find"):
-                self._json(c.graphite_find(q.get("query", ["*"])[0]))
-            else:
-                self._json({"error": "not found"}, 404)
+                elif url.path == "/api/v1/query_range":
+                    self._json(
+                        c.query_range(
+                            q["query"][0],
+                            float(q["start"][0]),
+                            float(q["end"][0]),
+                            _parse_step(q.get("step", ["15"])[0]),
+                        )
+                    )
+                elif url.path == "/api/v1/query":
+                    self._json(c.query_instant(q["query"][0], float(q["time"][0])))
+                elif url.path == "/api/v1/labels":
+                    self._json(
+                        {"status": "success",
+                         "data": c.labels(q.get("match[]", []), *_prom_range(q))}
+                    )
+                elif url.path == "/api/v1/series":
+                    self._json(
+                        {"status": "success",
+                         "data": c.series(q.get("match[]", []), *_prom_range(q))}
+                    )
+                elif (m := re.match(r"^/api/v1/label/([^/]+)/values$", url.path)) is not None:
+                    self._json(
+                        {"status": "success",
+                         "data": c.label_values(
+                             m.group(1), q.get("match[]", []), *_prom_range(q)
+                         )}
+                    )
+                elif url.path == "/api/v1/search":
+                    self._json(
+                        {"status": "success",
+                         "data": c.search(
+                             q.get("match[]", []) or q.get("query", []),
+                             *_prom_range(q),
+                             limit=int(q["limit"][0]) if "limit" in q else None,
+                         )}
+                    )
+                elif url.path == "/api/v1/services/m3db/placement":
+                    p = c.placement_svc.get()
+                    self._json(p.to_dict() if p else {}, 200 if p else 404)
+                elif url.path == "/debug/traces":
+                    limit = int(q.get("limit", ["256"])[0])
+                    self._json({"spans": TRACER.dump(limit=limit)})
+                elif url.path == "/debug/dump":
+                    self._send(
+                        200, self._debug_dump(), ctype="application/zip"
+                    )
+                elif url.path in ("/api/v1/graphite/render", "/render"):
+                    self._json(c.graphite_render(q))
+                elif url.path in ("/api/v1/graphite/metrics/find", "/metrics/find"):
+                    self._json(c.graphite_find(q.get("query", ["*"])[0]))
+                else:
+                    self._json({"error": "not found"}, 404)
         except Exception as exc:  # surface handler errors as 4xx
             from ..query.cost import QueryLimitError
 
@@ -390,78 +456,81 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"status": "error", "error": str(exc)}, code)
 
     def do_POST(self) -> None:
+        from ..utils.trace import TRACER
+
         c = self.coordinator
         url = urlparse(self.path)
         try:
-            if url.path in (
-                "/api/v1/graphite/render",
-                "/render",
-                "/api/v1/graphite/metrics/find",
-                "/metrics/find",
-            ):
-                # Grafana's graphite datasource POSTs form-encoded bodies
-                form = parse_qs(self._body().decode())
-                form.update(parse_qs(url.query))
-                if url.path.endswith("find"):
-                    self._json(c.graphite_find(form.get("query", ["*"])[0]))
+            with TRACER.span("http.post", path=url.path):
+                if url.path in (
+                    "/api/v1/graphite/render",
+                    "/render",
+                    "/api/v1/graphite/metrics/find",
+                    "/metrics/find",
+                ):
+                    # Grafana's graphite datasource POSTs form-encoded bodies
+                    form = parse_qs(self._body().decode())
+                    form.update(parse_qs(url.query))
+                    if url.path.endswith("find"):
+                        self._json(c.graphite_find(form.get("query", ["*"])[0]))
+                    else:
+                        self._json(c.graphite_render(form))
+                elif url.path == "/api/v1/prom/remote/write":
+                    raw = decompress(self._body())
+                    req = prompb.WriteRequest()
+                    req.ParseFromString(raw)
+                    n = c.write_prom(req)
+                    self._send(200, b"")
+                elif url.path == "/api/v1/prom/remote/read":
+                    raw = decompress(self._body())
+                    req = prompb.ReadRequest()
+                    req.ParseFromString(raw)
+                    resp = c.read_prom(req)
+                    self._send(
+                        200,
+                        compress(resp.SerializeToString()),
+                        ctype="application/x-protobuf",
+                    )
+                elif url.path == "/api/v1/influxdb/write":
+                    q = parse_qs(url.query)
+                    n = c.write_influx(
+                        self._body().decode(),
+                        precision=q.get("precision", ["ns"])[0],
+                    )
+                    self._send(204, b"")
+                elif url.path == "/api/v1/json/write":
+                    body = json.loads(self._body())
+                    tags = make_tags(body["tags"])
+                    c.db.write_tagged(
+                        c.namespace, tags, int(body["timestamp"] * NANOS), float(body["value"])
+                    )
+                    self._json({"ok": True})
+                elif url.path == "/api/v1/services/m3db/database/create":
+                    body = json.loads(self._body())
+                    name = body["namespaceName"]
+                    opts = NamespaceOptions(
+                        retention_nanos=int(
+                            _parse_step(body.get("retentionTime", "48h")) * NANOS
+                        )
+                    )
+                    if name not in c.db.namespaces:
+                        c.db.create_namespace(name, opts)
+                    self._json({"namespace": name}, 201)
+                elif url.path == "/api/v1/topic":
+                    body = json.loads(self._body())
+                    c.topic_svc.add(
+                        Topic(
+                            body["name"],
+                            body.get("numberOfShards", 64),
+                            [
+                                ConsumerService(s["serviceName"], s.get("consumptionType", "shared"))
+                                for s in body.get("consumerServices", [])
+                            ],
+                        )
+                    )
+                    self._json({"ok": True}, 201)
                 else:
-                    self._json(c.graphite_render(form))
-            elif url.path == "/api/v1/prom/remote/write":
-                raw = decompress(self._body())
-                req = prompb.WriteRequest()
-                req.ParseFromString(raw)
-                n = c.write_prom(req)
-                self._send(200, b"")
-            elif url.path == "/api/v1/prom/remote/read":
-                raw = decompress(self._body())
-                req = prompb.ReadRequest()
-                req.ParseFromString(raw)
-                resp = c.read_prom(req)
-                self._send(
-                    200,
-                    compress(resp.SerializeToString()),
-                    ctype="application/x-protobuf",
-                )
-            elif url.path == "/api/v1/influxdb/write":
-                q = parse_qs(url.query)
-                n = c.write_influx(
-                    self._body().decode(),
-                    precision=q.get("precision", ["ns"])[0],
-                )
-                self._send(204, b"")
-            elif url.path == "/api/v1/json/write":
-                body = json.loads(self._body())
-                tags = make_tags(body["tags"])
-                c.db.write_tagged(
-                    c.namespace, tags, int(body["timestamp"] * NANOS), float(body["value"])
-                )
-                self._json({"ok": True})
-            elif url.path == "/api/v1/services/m3db/database/create":
-                body = json.loads(self._body())
-                name = body["namespaceName"]
-                opts = NamespaceOptions(
-                    retention_nanos=int(
-                        _parse_step(body.get("retentionTime", "48h")) * NANOS
-                    )
-                )
-                if name not in c.db.namespaces:
-                    c.db.create_namespace(name, opts)
-                self._json({"namespace": name}, 201)
-            elif url.path == "/api/v1/topic":
-                body = json.loads(self._body())
-                c.topic_svc.add(
-                    Topic(
-                        body["name"],
-                        body.get("numberOfShards", 64),
-                        [
-                            ConsumerService(s["serviceName"], s.get("consumptionType", "shared"))
-                            for s in body.get("consumerServices", [])
-                        ],
-                    )
-                )
-                self._json({"ok": True}, 201)
-            else:
-                self._json({"error": "not found"}, 404)
+                    self._json({"error": "not found"}, 404)
         except Exception as exc:
             self._json({"status": "error", "error": str(exc)}, 400)
 

@@ -19,6 +19,7 @@ from ..codec.m3tsz import Datapoint, decode
 from ..utils.hash import shard_for
 from ..utils.instrument import DEFAULT as METRICS
 from ..utils.serialize import decode_tags, is_tag_id
+from ..utils.trace import TRACER
 from ..utils.xtime import Unit
 from .commitlog import CommitLog, CommitLogEntry
 from .fs import (
@@ -467,77 +468,79 @@ class Database:
             return out
 
     def flush(self, ns: str, flush_before_nanos: int) -> list[FilesetID]:
-        with self.lock:
-            namespace = self.namespaces[ns]
-            out = []
-            for shard in namespace.shards:
-                out.extend(shard.warm_flush(flush_before_nanos))
-                if namespace.opts.cold_writes_enabled:
-                    out.extend(shard.cold_flush(flush_before_nanos))
-            # Rotate the WAL, then drop only sealed segments whose every entry
-            # is now durable in a flushed fileset. Coverage is BLOCK-aligned:
-            # only entries whose whole block is before the cutoff were
-            # flushed (streams_before), so an entry in a partial block at the
-            # cutoff edge keeps its segment alive. With cold writes enabled,
-            # warm+cold flush together make every such point durable; with
-            # cold writes disabled, writes into flushed blocks are rejected
-            # at write time (never logged), so the same coverage rule holds
-            # (the reference removes commit logs only once covered by
-            # snapshot/fileset data — storage/cleanup.go).
-            cl = self._commitlogs.get(ns)
-            bsz = namespace.opts.block_size_nanos
-            if cl is not None:
-                cl.rotate()
-                cl.cleanup(
-                    lambda e: (e.time_nanos // bsz) * bsz + bsz
-                    <= flush_before_nanos
-                )
-            # Snapshots whose every record now lives in a flushed block are
-            # covered by filesets; drop them so bootstrap doesn't re-buffer
-            # flushed points (storage/cleanup.go snapshot cleanup).
-            for shard in namespace.shards:
-                snap = read_latest_snapshot(self.base, ns, shard.id)
-                if snap and all(
-                    bs + bsz <= flush_before_nanos and bs in shard._flushed_blocks
-                    for _, bs, _, _ in snap
-                ):
-                    remove_snapshots(self.base, ns, shard.id)
-            # WarmFlush of index blocks (storage/index.go:868): seal + persist
-            if namespace.index is not None:
-                namespace.index.persist_before(self.base, ns, flush_before_nanos)
-            return out
+        with TRACER.span("db.flush", namespace=ns):
+            with self.lock:
+                namespace = self.namespaces[ns]
+                out = []
+                for shard in namespace.shards:
+                    out.extend(shard.warm_flush(flush_before_nanos))
+                    if namespace.opts.cold_writes_enabled:
+                        out.extend(shard.cold_flush(flush_before_nanos))
+                # Rotate the WAL, then drop only sealed segments whose every entry
+                # is now durable in a flushed fileset. Coverage is BLOCK-aligned:
+                # only entries whose whole block is before the cutoff were
+                # flushed (streams_before), so an entry in a partial block at the
+                # cutoff edge keeps its segment alive. With cold writes enabled,
+                # warm+cold flush together make every such point durable; with
+                # cold writes disabled, writes into flushed blocks are rejected
+                # at write time (never logged), so the same coverage rule holds
+                # (the reference removes commit logs only once covered by
+                # snapshot/fileset data — storage/cleanup.go).
+                cl = self._commitlogs.get(ns)
+                bsz = namespace.opts.block_size_nanos
+                if cl is not None:
+                    cl.rotate()
+                    cl.cleanup(
+                        lambda e: (e.time_nanos // bsz) * bsz + bsz
+                        <= flush_before_nanos
+                    )
+                # Snapshots whose every record now lives in a flushed block are
+                # covered by filesets; drop them so bootstrap doesn't re-buffer
+                # flushed points (storage/cleanup.go snapshot cleanup).
+                for shard in namespace.shards:
+                    snap = read_latest_snapshot(self.base, ns, shard.id)
+                    if snap and all(
+                        bs + bsz <= flush_before_nanos and bs in shard._flushed_blocks
+                        for _, bs, _, _ in snap
+                    ):
+                        remove_snapshots(self.base, ns, shard.id)
+                # WarmFlush of index blocks (storage/index.go:868): seal + persist
+                if namespace.index is not None:
+                    namespace.index.persist_before(self.base, ns, flush_before_nanos)
+                return out
 
     def snapshot(self, ns: str) -> int:
         """shard.go:2335 Snapshot: capture every un-flushed buffer stream so
         commit-log replay is bounded. Returns the number of records written.
         All sealed WAL segments become removable afterwards: their entries are
         either in flushed filesets or in this snapshot."""
-        with self.lock:
-            namespace = self.namespaces[ns]
-            total = 0
-            for shard in namespace.shards:
-                with shard.lock:  # consistent buffer capture vs writers
-                    vol_now = {f.block_start: f.volume for f in shard.filesets()}
-                    records = []
-                    for sid, buf in shard.series.items():
-                        for bs, bucket in buf.buckets.items():
-                            stream = bucket.merged_stream()
-                            if stream:
-                                records.append(
-                                    (sid, bs, stream, vol_now.get(bs, -1))
-                                )
-                if records:
-                    write_snapshot(self.base, ns, shard.id, records)
-                else:
-                    # nothing buffered: an absent snapshot says the same
-                    # thing as an empty one without the file churn
-                    remove_snapshots(self.base, ns, shard.id)
-                total += len(records)
-            cl = self._commitlogs.get(ns)
-            if cl is not None:
-                cl.rotate()
-                cl.remove_inactive()
-            return total
+        with TRACER.span("db.snapshot", namespace=ns):
+            with self.lock:
+                namespace = self.namespaces[ns]
+                total = 0
+                for shard in namespace.shards:
+                    with shard.lock:  # consistent buffer capture vs writers
+                        vol_now = {f.block_start: f.volume for f in shard.filesets()}
+                        records = []
+                        for sid, buf in shard.series.items():
+                            for bs, bucket in buf.buckets.items():
+                                stream = bucket.merged_stream()
+                                if stream:
+                                    records.append(
+                                        (sid, bs, stream, vol_now.get(bs, -1))
+                                    )
+                    if records:
+                        write_snapshot(self.base, ns, shard.id, records)
+                    else:
+                        # nothing buffered: an absent snapshot says the same
+                        # thing as an empty one without the file churn
+                        remove_snapshots(self.base, ns, shard.id)
+                    total += len(records)
+                cl = self._commitlogs.get(ns)
+                if cl is not None:
+                    cl.rotate()
+                    cl.remove_inactive()
+                return total
 
     def tick(self, now_nanos: int) -> None:
         """storage/mediator.go tick: expire buffers, filesets, and index
@@ -573,123 +576,124 @@ class Database:
         Replay never skips entries: a replayed point that also exists in a
         flushed fileset dedupes at read/merge time, whereas skipping loses
         cold writes that were logged but not yet cold-flushed."""
-        with self.lock:
-            result = {"commitlog_entries": 0, "filesets": 0, "snapshot_records": 0}
-            for name, ns in self.namespaces.items():
-                # Re-buffering a point that already sits in a flushed fileset
-                # would make the next cold_flush rewrite an identical volume,
-                # so snapshot records and commitlog entries for flushed blocks
-                # are checked against the fileset first (decoded lazily,
-                # cached per (shard, block, series)). Points NOT in the
-                # fileset are genuine un-flushed cold writes and must replay.
-                pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
-                bsz = ns.opts.block_size_nanos
+        with TRACER.span("db.bootstrap"):
+            with self.lock:
+                result = {"commitlog_entries": 0, "filesets": 0, "snapshot_records": 0}
+                for name, ns in self.namespaces.items():
+                    # Re-buffering a point that already sits in a flushed fileset
+                    # would make the next cold_flush rewrite an identical volume,
+                    # so snapshot records and commitlog entries for flushed blocks
+                    # are checked against the fileset first (decoded lazily,
+                    # cached per (shard, block, series)). Points NOT in the
+                    # fileset are genuine un-flushed cold writes and must replay.
+                    pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
+                    bsz = ns.opts.block_size_nanos
 
-                def _covered(sh: Shard, sid: bytes, t_nanos: int, value: float) -> bool:
-                    bs = (t_nanos // bsz) * bsz
-                    if bs not in sh._flushed_blocks:
-                        return False
-                    fid = next(
-                        (f for f in sh.filesets() if f.block_start == bs), None
-                    )
-                    if fid is None:
-                        return False
-                    pk = (sh.id, bs, sid)
-                    if pk not in pts:
-                        stream = sh.reader(fid).stream(sid)
-                        pts[pk] = (
-                            {dp.timestamp: dp.value for dp in decode(stream)}
-                            if stream
-                            else {}
+                    def _covered(sh: Shard, sid: bytes, t_nanos: int, value: float) -> bool:
+                        bs = (t_nanos // bsz) * bsz
+                        if bs not in sh._flushed_blocks:
+                            return False
+                        fid = next(
+                            (f for f in sh.filesets() if f.block_start == bs), None
                         )
-                    return pts[pk].get(t_nanos) == value
+                        if fid is None:
+                            return False
+                        pk = (sh.id, bs, sid)
+                        if pk not in pts:
+                            stream = sh.reader(fid).stream(sid)
+                            pts[pk] = (
+                                {dp.timestamp: dp.value for dp in decode(stream)}
+                                if stream
+                                else {}
+                            )
+                        return pts[pk].get(t_nanos) == value
 
-                def _restore(sh: Shard, sid: bytes, t: int, v: float, unit) -> bool:
-                    if _covered(sh, sid, t, v):
-                        return False
-                    try:
-                        sh.write(sid, t, v, unit)
-                    except ColdWriteError:
-                        # pre-crash WAL/snapshot entry in a flushed block of a
-                        # cold-disabled namespace whose value changed: drop it
-                        return False
-                    return True
+                    def _restore(sh: Shard, sid: bytes, t: int, v: float, unit) -> bool:
+                        if _covered(sh, sid, t, v):
+                            return False
+                        try:
+                            sh.write(sid, t, v, unit)
+                        except ColdWriteError:
+                            # pre-crash WAL/snapshot entry in a flushed block of a
+                            # cold-disabled namespace whose value changed: drop it
+                            return False
+                        return True
 
-                def _has_fileset_point(sh: Shard, sid: bytes, t: int) -> bool:
-                    bs = (t // bsz) * bsz
-                    fid = next(
-                        (f for f in sh.filesets() if f.block_start == bs), None
-                    )
-                    if fid is None:
-                        return False
-                    pk = (sh.id, bs, sid)
-                    if pk not in pts:
-                        stream = sh.reader(fid).stream(sid)
-                        pts[pk] = (
-                            {dp.timestamp: dp.value for dp in decode(stream)}
-                            if stream
-                            else {}
+                    def _has_fileset_point(sh: Shard, sid: bytes, t: int) -> bool:
+                        bs = (t // bsz) * bsz
+                        fid = next(
+                            (f for f in sh.filesets() if f.block_start == bs), None
                         )
-                    return t in pts[pk]
+                        if fid is None:
+                            return False
+                        pk = (sh.id, bs, sid)
+                        if pk not in pts:
+                            stream = sh.reader(fid).stream(sid)
+                            pts[pk] = (
+                                {dp.timestamp: dp.value for dp in decode(stream)}
+                                if stream
+                                else {}
+                            )
+                        return t in pts[pk]
 
-                # persisted index blocks load wholesale; blocks without one
-                # are rebuilt below from fileset IDs (tag wire format)
-                persisted: set[int] = set()
-                if ns.index is not None:
-                    persisted = ns.index.load_persisted(self.base, name)
-                for shard in ns.shards:
-                    fids = shard.filesets()
-                    result["filesets"] += len(fids)
-                    for fid in fids:
-                        shard._flushed_blocks.add(fid.block_start)
-                        if fid.block_start in persisted:
-                            continue
-                        for sid in read_index_ids(self.base, fid):
-                            self._reindex(ns, sid, fid.block_start)
-                    snap = read_latest_snapshot(self.base, name, shard.id)
-                    if snap:
-                        vol_now = {
-                            f.block_start: f.volume for f in shard.filesets()
-                        }
-                        for sid, bs, stream, rec_vol in snap:
-                            # Ordering vs filesets (the recorded volume is
-                            # the arbiter): every warm/cold flush bumps the
-                            # block's fileset volume, so a volume that has
-                            # advanced since the snapshot means the fileset
-                            # superseded this record — restoring it would
-                            # shadow newer flushed values (buffer wins on
-                            # read dedupe). An unchanged volume means the
-                            # record is a cold-write overlay NEWER than the
-                            # fileset.
-                            if vol_now.get(bs, -1) > rec_vol:
+                    # persisted index blocks load wholesale; blocks without one
+                    # are rebuilt below from fileset IDs (tag wire format)
+                    persisted: set[int] = set()
+                    if ns.index is not None:
+                        persisted = ns.index.load_persisted(self.base, name)
+                    for shard in ns.shards:
+                        fids = shard.filesets()
+                        result["filesets"] += len(fids)
+                        for fid in fids:
+                            shard._flushed_blocks.add(fid.block_start)
+                            if fid.block_start in persisted:
                                 continue
-                            for dp in decode(stream):
-                                _restore(shard, sid, dp.timestamp, dp.value, dp.unit)
-                            self._reindex(ns, sid, bs)
-                        result["snapshot_records"] += len(snap)
-                entries = CommitLog.replay(self._commitlog_dir(name))
-                # The WAL is totally ordered, so for duplicate (sid, t) the
-                # LAST entry is the live value (an earlier entry may be a
-                # stale overwrite whose newer value now lives only in a
-                # fileset — replaying it would shadow the fileset).
-                final: dict[tuple[bytes, int], CommitLogEntry] = {}
-                for e in entries:
-                    final[(e.series_id, e.time_nanos)] = e
-                for e in final.values():
-                    sh = ns.shard_for(e.series_id)
-                    if _covered(sh, e.series_id, e.time_nanos, e.value):
-                        continue
-                    # value differs from (or is absent in) the fileset: the
-                    # last-ordered WAL write is newer than the flush unless
-                    # the point exists there with another value AND this
-                    # entry predates the flush — with last-wins dedupe the
-                    # only such survivors are post-flush cold writes, so
-                    # replay them
-                    if _restore(sh, e.series_id, e.time_nanos, e.value, e.unit):
-                        self._reindex(ns, e.series_id, e.time_nanos)
-                result["commitlog_entries"] += len(entries)
-            self.bootstrapped = True
-            return result
+                            for sid in read_index_ids(self.base, fid):
+                                self._reindex(ns, sid, fid.block_start)
+                        snap = read_latest_snapshot(self.base, name, shard.id)
+                        if snap:
+                            vol_now = {
+                                f.block_start: f.volume for f in shard.filesets()
+                            }
+                            for sid, bs, stream, rec_vol in snap:
+                                # Ordering vs filesets (the recorded volume is
+                                # the arbiter): every warm/cold flush bumps the
+                                # block's fileset volume, so a volume that has
+                                # advanced since the snapshot means the fileset
+                                # superseded this record — restoring it would
+                                # shadow newer flushed values (buffer wins on
+                                # read dedupe). An unchanged volume means the
+                                # record is a cold-write overlay NEWER than the
+                                # fileset.
+                                if vol_now.get(bs, -1) > rec_vol:
+                                    continue
+                                for dp in decode(stream):
+                                    _restore(shard, sid, dp.timestamp, dp.value, dp.unit)
+                                self._reindex(ns, sid, bs)
+                            result["snapshot_records"] += len(snap)
+                    entries = CommitLog.replay(self._commitlog_dir(name))
+                    # The WAL is totally ordered, so for duplicate (sid, t) the
+                    # LAST entry is the live value (an earlier entry may be a
+                    # stale overwrite whose newer value now lives only in a
+                    # fileset — replaying it would shadow the fileset).
+                    final: dict[tuple[bytes, int], CommitLogEntry] = {}
+                    for e in entries:
+                        final[(e.series_id, e.time_nanos)] = e
+                    for e in final.values():
+                        sh = ns.shard_for(e.series_id)
+                        if _covered(sh, e.series_id, e.time_nanos, e.value):
+                            continue
+                        # value differs from (or is absent in) the fileset: the
+                        # last-ordered WAL write is newer than the flush unless
+                        # the point exists there with another value AND this
+                        # entry predates the flush — with last-wins dedupe the
+                        # only such survivors are post-flush cold writes, so
+                        # replay them
+                        if _restore(sh, e.series_id, e.time_nanos, e.value, e.unit):
+                            self._reindex(ns, e.series_id, e.time_nanos)
+                    result["commitlog_entries"] += len(entries)
+                self.bootstrapped = True
+                return result
 
     def close(self) -> None:
         with self.lock:

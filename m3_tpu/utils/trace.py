@@ -1,0 +1,140 @@
+"""In-process request tracing: sampled spans in a bounded ring buffer.
+
+Reference: the reference threads opentracing through its contexts
+(/root/reference/src/x/context/context.go StartSampledTraceSpan,
+src/dbnode/server wiring of jaeger/lightstep tracers) and exposes debug
+dumps (x/debug). This framework keeps the same shape without external
+backends: a process-wide sampled tracer whose finished spans land in a ring
+buffer served by the coordinator's /debug/traces route and bundled into the
+/debug/dump archive.
+
+Usage::
+
+    from m3_tpu.utils.trace import TRACER
+    with TRACER.span("db.write", namespace=ns):
+        ...
+
+Spans nest through a thread-local stack: a span started while another is
+open on the same thread becomes its child.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_id: int | None
+    name: str
+    start_nanos: int
+    end_nanos: int | None = None
+    tags: dict = field(default_factory=dict)
+    error: str | None = None
+
+    @property
+    def duration_nanos(self) -> int | None:
+        if self.end_nanos is None:
+            return None
+        return self.end_nanos - self.start_nanos
+
+    def to_dict(self) -> dict:
+        return {
+            "traceId": f"{self.trace_id:016x}",
+            "spanId": f"{self.span_id:016x}",
+            "parentId": f"{self.parent_id:016x}" if self.parent_id else None,
+            "name": self.name,
+            "startNanos": self.start_nanos,
+            "durationNanos": self.duration_nanos,
+            "tags": {k: str(v) for k, v in self.tags.items()},
+            "error": self.error,
+        }
+
+
+class _ActiveSpan:
+    """Context manager binding a span to the thread-local stack."""
+
+    def __init__(self, tracer: "Tracer", span: Span | None) -> None:
+        self.tracer = tracer
+        self.span = span  # None = unsampled (no-op)
+
+    def set_tag(self, key: str, value) -> "_ActiveSpan":
+        if self.span is not None:
+            self.span.tags[key] = value
+        return self
+
+    def __enter__(self) -> "_ActiveSpan":
+        if self.span is not None:
+            self.tracer._stack().append(self.span)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.span is None:
+            return
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self.span:
+            stack.pop()
+        self.span.end_nanos = time.time_ns()
+        if exc is not None:
+            self.span.error = f"{exc_type.__name__}: {exc}"
+        self.tracer._record(self.span)
+
+
+class Tracer:
+    """Process tracer: sample_rate in [0, 1], ring buffer of finished spans."""
+
+    def __init__(self, sample_rate: float = 1.0, capacity: int = 4096) -> None:
+        self.sample_rate = sample_rate
+        self.finished: deque[Span] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self.started = 0
+        self.sampled = 0
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def span(self, name: str, **tags) -> _ActiveSpan:
+        self.started += 1
+        parent = self._stack()[-1] if self._stack() else None
+        if parent is None and self.sample_rate < 1.0:
+            if random.random() >= self.sample_rate:
+                return _ActiveSpan(self, None)
+        self.sampled += 1
+        with self._lock:
+            span_id = next(self._ids)
+        sp = Span(
+            trace_id=parent.trace_id if parent else span_id,
+            span_id=span_id,
+            parent_id=parent.span_id if parent else None,
+            name=name,
+            start_nanos=time.time_ns(),
+            tags=tags,
+        )
+        return _ActiveSpan(self, sp)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self.finished.append(span)
+
+    def dump(self, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self.finished)
+        if limit is not None:
+            spans = spans[-limit:] if limit > 0 else []
+        return [s.to_dict() for s in spans]
+
+
+# process-wide default (the reference hangs its tracer off instrument opts)
+TRACER = Tracer()

@@ -1,0 +1,107 @@
+"""Aggregator entry model: per-metric rate limiting + TTL expiry
+(reference: aggregator/aggregator/entry.go, rate_limit.go)."""
+
+from m3_tpu.aggregator.aggregator import Aggregator
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.types import AggregationType, MetricType, Untimed
+
+COUNT = (AggregationType.COUNT,)
+
+NANOS = 1_000_000_000
+P10S = (StoragePolicy.parse("10s:2d"),)
+
+
+def _gauge(mid, v):
+    return Untimed(id=mid, type=MetricType.GAUGE, gauge_value=v)
+
+
+def test_rate_limit_drops_excess_values():
+    agg = Aggregator(num_shards=2, default_policies=P10S, value_rate_limit=2.0)
+    t0 = 1000 * NANOS
+    # 5 writes in the same instant: bucket holds 2
+    for i in range(5):
+        agg.add_untimed(_gauge(b"noisy", float(i)), t0, aggregations=COUNT)
+    out = agg.flush(t0 + 20 * NANOS)
+    by_type = {m.agg_type.name: m.value for m in out if m.id == b"noisy"}
+    assert by_type["COUNT"] == 2  # 3 of 5 dropped
+    assert agg.rate_limited == 3
+
+    # a second's elapse refills the bucket
+    agg.add_untimed(_gauge(b"noisy", 9.0), t0 + 30 * NANOS)
+    out = agg.flush(t0 + 50 * NANOS)
+    assert any(m.id == b"noisy" for m in out)
+
+
+def test_rate_limit_per_entry_isolation():
+    agg = Aggregator(num_shards=2, default_policies=P10S, value_rate_limit=1.0)
+    t0 = 1000 * NANOS
+    agg.add_untimed(_gauge(b"a", 1.0), t0, aggregations=COUNT)
+    agg.add_untimed(_gauge(b"a", 2.0), t0, aggregations=COUNT)  # dropped
+    agg.add_untimed(_gauge(b"b", 3.0), t0, aggregations=COUNT)  # own bucket
+    out = agg.flush(t0 + 20 * NANOS)
+    counts = {m.id: m.value for m in out if m.agg_type.name == "COUNT"}
+    assert counts == {b"a": 1, b"b": 1}
+
+
+def test_entry_ttl_expires_idle_ids():
+    agg = Aggregator(
+        num_shards=2, default_policies=P10S, entry_ttl_nanos=60 * NANOS
+    )
+    t0 = 1000 * NANOS
+    agg.add_untimed(_gauge(b"old", 1.0), t0)
+    agg.add_untimed(_gauge(b"fresh", 2.0), t0)
+    agg.flush(t0 + 20 * NANOS)
+    # 'fresh' keeps writing; 'old' goes idle
+    t1 = t0 + 100 * NANOS
+    agg.add_untimed(_gauge(b"fresh", 3.0), t1)
+    agg.flush(t1 + 20 * NANOS)
+    interned = {mid for s in agg.shards for mid in s.ids}
+    assert b"old" not in interned
+    assert b"fresh" in interned
+    assert agg.expired_entries >= 1
+
+    # re-writing an expired id re-interns and aggregates correctly
+    t2 = t1 + 30 * NANOS
+    agg.add_untimed(_gauge(b"old", 7.0), t2)
+    out = agg.flush(t2 + 20 * NANOS)
+    vals = {m.id: m.value for m in out if m.agg_type.name == "LAST"}
+    assert vals.get(b"old") == 7.0
+
+
+def test_expiry_skips_shards_with_pending_buffers():
+    agg = Aggregator(
+        num_shards=1, default_policies=P10S, entry_ttl_nanos=10 * NANOS
+    )
+    t0 = 1000 * NANOS
+    agg.add_untimed(_gauge(b"x", 1.0), t0)
+    # a partial window stays buffered after the flush boundary, so the
+    # shard's entries must survive even past their TTL
+    agg.add_untimed(_gauge(b"x", 2.0), t0 + 95 * NANOS)
+    agg.flush(t0 + 90 * NANOS)
+    assert b"x" in agg.shards[0].id_index
+
+
+def test_remap_preserves_agg_overrides():
+    from m3_tpu.metrics.types import AggregationType
+
+    agg = Aggregator(
+        num_shards=1, default_policies=P10S, entry_ttl_nanos=60 * NANOS
+    )
+    t0 = 1000 * NANOS
+    agg.add_untimed(_gauge(b"dead", 1.0), t0)
+    agg.add_untimed(
+        _gauge(b"kept", 5.0), t0, aggregations=(AggregationType.MAX,)
+    )
+    agg.flush(t0 + 20 * NANOS)
+    t1 = t0 + 100 * NANOS
+    agg.add_untimed(
+        _gauge(b"kept", 9.0), t1, aggregations=(AggregationType.MAX,)
+    )
+    agg.flush(t1 + 20 * NANOS)
+    # after 'dead' expired, 'kept' was remapped; its override must follow
+    t2 = t1 + 30 * NANOS
+    agg.add_untimed(_gauge(b"kept", 4.0), t2)
+    out = agg.flush(t2 + 20 * NANOS)
+    mine = [m for m in out if m.id == b"kept"]
+    assert {m.agg_type for m in mine} == {AggregationType.MAX}
+    assert mine[0].value == 4.0

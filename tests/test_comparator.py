@@ -1,0 +1,123 @@
+"""Comparator harness: PromQL engine vs an independent numpy oracle over
+deterministic synthetic data (reference: m3comparator/main/querier.go)."""
+
+import json
+import math
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.services.comparator import (
+    SyntheticStorage,
+    _series_seed,
+    compare_range,
+    make_engine,
+    serve,
+    synthetic_value,
+)
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000
+
+
+def _grid(start_s, end_s, step_s):
+    return np.arange(start_s * NANOS, end_s * NANOS + 1, step_s * NANOS, dtype=np.int64)
+
+
+def test_synthetic_determinism():
+    st1, st2 = SyntheticStorage(num_series=4), SyntheticStorage(num_series=4)
+    for tags in st1.series_tags:
+        t1, v1 = st1.samples(tags, T0 * NANOS, (T0 + 100) * NANOS)
+        t2, v2 = st2.samples(tags, T0 * NANOS, (T0 + 100) * NANOS)
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(v1, v2)
+
+
+def test_matchers():
+    from m3_tpu.query.promql import Matcher
+
+    st = SyntheticStorage(num_series=6)
+    got = st.fetch([Matcher("__name__", "=", "synthetic_metric"),
+                    Matcher("job", "=", "job-0")], T0 * NANOS, (T0 + 30) * NANOS)
+    assert len(got) == 2  # hosts 0 and 3
+    got = st.fetch([Matcher("__name__", "=", "synthetic_metric"),
+                    Matcher("host", "=~", "host-0[01]")], T0 * NANOS, (T0 + 30) * NANOS)
+    assert len(got) == 2
+
+
+def test_raw_selector_matches_value_function():
+    """Engine range query of the bare metric == synthetic_value at each
+    aligned step (samples sit exactly on the step grid)."""
+    st = SyntheticStorage(num_series=3)
+    engine = make_engine(st)
+    start, end, step = T0, T0 + 120, 10
+    r = engine.query_range(
+        "synthetic_metric", start * NANOS, end * NANOS, step * NANOS
+    )
+    expected = {}
+    for tags in st.series_tags:
+        seed = _series_seed(tags)
+        key = frozenset((k.decode(), v.decode()) for k, v in tags)
+        expected[key] = np.asarray(
+            [synthetic_value(seed, int(t)) for t in _grid(start, end, step)]
+        )
+    assert compare_range(r, expected, rtol=1e-9) == []
+
+
+def test_sum_matches_numpy_oracle():
+    st = SyntheticStorage(num_series=5)
+    engine = make_engine(st)
+    start, end, step = T0, T0 + 60, 10
+    r = engine.query_range(
+        "sum(synthetic_metric)", start * NANOS, end * NANOS, step * NANOS
+    )
+    grid = _grid(start, end, step)
+    want = np.zeros(len(grid))
+    for tags in st.series_tags:
+        seed = _series_seed(tags)
+        want += np.asarray([synthetic_value(seed, int(t)) for t in grid])
+    got = np.asarray(r.values[0], np.float64)
+    # the engine aggregates in f32 on device; oracle runs in f64
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_avg_by_job_matches_numpy_oracle():
+    st = SyntheticStorage(num_series=6)
+    engine = make_engine(st)
+    start, end, step = T0, T0 + 30, 10
+    r = engine.query_range(
+        "avg by (job) (synthetic_metric)", start * NANOS, end * NANOS, step * NANOS
+    )
+    grid = _grid(start, end, step)
+    expected = {}
+    for tags in st.series_tags:
+        seed = _series_seed(tags)
+        job = dict((k.decode(), v.decode()) for k, v in tags)["job"]
+        expected.setdefault(job, []).append(
+            np.asarray([synthetic_value(seed, int(t)) for t in grid])
+        )
+    expected = {
+        frozenset({("job", j)}): np.mean(rows, axis=0) for j, rows in expected.items()
+    }
+    assert compare_range(r, expected, rtol=1e-5) == []
+
+
+def test_comparator_http_service():
+    st = SyntheticStorage(num_series=2)
+    srv, port = serve(st)
+    try:
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/query_range?"
+            f"query=synthetic_metric&start={T0}&end={T0+30}&step=10"
+        ).read())
+        assert out["status"] == "success"
+        assert len(out["data"]["result"]) == 2
+        series = out["data"]["result"][0]
+        seed = _series_seed(
+            tuple(sorted((k.encode(), v.encode()) for k, v in series["metric"].items()))
+        )
+        t, v = series["values"][0]
+        assert math.isclose(float(v), synthetic_value(seed, int(t) * NANOS), rel_tol=1e-9)
+    finally:
+        srv.shutdown()

@@ -1,0 +1,88 @@
+"""Tracing + debug dump (reference: x/context opentracing wiring,
+x/debug/debug.go zip dump)."""
+
+import io
+import json
+import urllib.request
+import zipfile
+
+import pytest
+
+from m3_tpu.utils.trace import Tracer
+
+
+def test_span_nesting_and_timing():
+    tr = Tracer()
+    with tr.span("outer", op="write") as outer:
+        with tr.span("inner"):
+            pass
+    spans = tr.dump()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner, outer_d = spans
+    assert inner["parentId"] == outer_d["spanId"]
+    assert inner["traceId"] == outer_d["traceId"]
+    assert outer_d["parentId"] is None
+    assert outer_d["durationNanos"] >= inner["durationNanos"] >= 0
+    assert outer_d["tags"] == {"op": "write"}
+
+
+def test_span_error_capture():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("failing"):
+            raise ValueError("boom")
+    (span,) = tr.dump()
+    assert span["error"] == "ValueError: boom"
+
+
+def test_sampling_zero_records_nothing():
+    tr = Tracer(sample_rate=0.0)
+    with tr.span("never"):
+        pass
+    assert tr.dump() == []
+    assert tr.started == 1
+
+
+def test_ring_buffer_bounded():
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.dump()
+    assert len(spans) == 4
+    assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"]
+
+
+@pytest.fixture(scope="module")
+def server():
+    from m3_tpu.services.coordinator import Coordinator, serve
+
+    coord = Coordinator()
+    srv, port = serve(coord)
+    yield f"http://127.0.0.1:{port}", coord
+    srv.shutdown()
+
+
+def test_debug_traces_route(server):
+    base, _ = server
+    urllib.request.urlopen(f"{base}/health").read()  # pollers are NOT traced
+    urllib.request.urlopen(f"{base}/api/v1/labels").read()
+    out = json.loads(urllib.request.urlopen(f"{base}/debug/traces").read())
+    spans = out["spans"]
+    assert any(
+        s["name"] == "http.get" and s["tags"].get("path") == "/api/v1/labels"
+        for s in spans
+    )
+    assert not any(s["tags"].get("path") == "/health" for s in spans)
+
+
+def test_debug_dump_zip(server):
+    base, _ = server
+    raw = urllib.request.urlopen(f"{base}/debug/dump").read()
+    z = zipfile.ZipFile(io.BytesIO(raw))
+    names = set(z.namelist())
+    assert {"stacks.txt", "metrics.txt", "traces.json",
+            "namespaces.json", "placement.json"} <= names
+    assert b"thread" in z.read("stacks.txt")
+    ns = json.loads(z.read("namespaces.json"))
+    assert "default" in ns
