@@ -81,7 +81,7 @@ class _Shard:
             self.metric_types.append(mtype)
             self.last_write.append(0)
             self.rl_tokens.append(0.0)
-            self.rl_stamp.append(0)
+            self.rl_stamp.append(-1)  # -1 = never refilled (0 is a valid time)
         return idx
 
     def admit(self, idx: int, n_values: int, now_nanos: int, limit: float | None) -> bool:
@@ -92,14 +92,16 @@ class _Shard:
         self.last_write[idx] = max(self.last_write[idx], now_nanos)
         if limit is None:
             return True
-        elapsed = max(now_nanos - self.rl_stamp[idx], 0)
-        if self.rl_stamp[idx] == 0:
+        if self.rl_stamp[idx] < 0:
             self.rl_tokens[idx] = limit  # first write: full bucket
         else:
+            # out-of-order writes must not rewind the stamp (a rewound
+            # stamp hands the next in-order write a spurious refill)
+            elapsed = max(now_nanos - self.rl_stamp[idx], 0)
             self.rl_tokens[idx] = min(
                 limit, self.rl_tokens[idx] + limit * (elapsed / 1e9)
             )
-        self.rl_stamp[idx] = now_nanos
+        self.rl_stamp[idx] = max(self.rl_stamp[idx], now_nanos)
         if self.rl_tokens[idx] > 0:
             self.rl_tokens[idx] -= n_values
             return True

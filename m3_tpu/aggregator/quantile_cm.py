@@ -1,0 +1,138 @@
+"""Cormode–Muthukrishnan targeted-quantile stream (host-side).
+
+Reference: /root/reference/src/aggregator/aggregation/quantile/cm/stream.go
+(statsite-derived biased-quantiles sketch). The device aggregation path
+(kernels.py) computes EXACT quantiles by sorting whole windows on the TPU —
+strictly more accurate and the framework default — but the streaming sketch
+matters where windows never materialize (host-side forwarding stages,
+collector pre-aggregation), so the reference's component exists here with
+the same contract: targeted quantiles with per-target error eps.
+
+Algorithm (Cormode & Muthukrishnan, "Effective Computation of Biased
+Quantiles over Data Streams"): a sorted list of (value, g, delta) samples;
+inserts buffer and merge in sorted order; compress() merges adjacent
+samples whose combined weight stays within the invariant f(r, n); query(q)
+walks cumulative weights to the first sample crossing the target rank.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+from dataclasses import dataclass
+
+
+@dataclass
+class _Sample:
+    value: float
+    g: int  # rank gap to the previous sample
+    delta: int  # rank uncertainty
+
+
+class QuantileStream:
+    """Targeted quantiles: quantiles q with error eps on each target.
+
+    insert() amortizes through a buffer; samples stay O((1/eps) log(eps n)).
+    """
+
+    def __init__(self, quantiles=(0.5, 0.95, 0.99), eps: float = 0.01,
+                 buffer_size: int = 512) -> None:
+        if not quantiles:
+            raise ValueError("need at least one target quantile")
+        self.targets = tuple(sorted(float(q) for q in quantiles))
+        if any(q <= 0.0 or q >= 1.0 for q in self.targets):
+            raise ValueError("quantiles must be in (0, 1)")
+        self.eps = eps
+        self._samples: list[_Sample] = []
+        self._buffer: list[float] = []
+        self._buffer_size = buffer_size
+        self.n = 0
+
+    # invariant f(r, n): allowed weight span for a sample at rank r
+    def _invariant(self, r: float, n: int) -> float:
+        out = math.inf
+        for q in self.targets:
+            if r < q * n:
+                err = 2 * self.eps * (n - r) / (1 - q)
+            else:
+                err = 2 * self.eps * r / q
+            out = min(out, err)
+        return max(out, 1.0)
+
+    def insert(self, value: float) -> None:
+        self._buffer.append(float(value))
+        if len(self._buffer) >= self._buffer_size:
+            self._flush_buffer()
+
+    def _flush_buffer(self) -> None:
+        if not self._buffer:
+            return
+        self._buffer.sort()
+        samples = self._samples
+        values = [s.value for s in samples]
+        r = 0  # cumulative g before the insertion point
+        idx = 0
+        for v in self._buffer:
+            while idx < len(samples) and samples[idx].value <= v:
+                r += samples[idx].g
+                idx += 1
+            if idx == 0 or idx == len(samples):
+                delta = 0
+            else:
+                delta = int(self._invariant(r, self.n)) - 1
+            samples.insert(idx, _Sample(v, 1, max(delta, 0)))
+            idx += 1
+            self.n += 1
+        self._buffer.clear()
+        self._compress()
+
+    def _compress(self) -> None:
+        samples = self._samples
+        if len(samples) < 3:
+            return
+        out = [samples[0]]
+        r = samples[0].g
+        for s in samples[1:-1]:
+            merged = out[-1]
+            if (
+                merged is not samples[0]
+                and merged.g + s.g + s.delta <= self._invariant(r, self.n)
+            ):
+                # merge into s (keep the larger value as representative)
+                s.g += merged.g
+                out[-1] = s
+            else:
+                out.append(s)
+            r += s.g
+        out.append(samples[-1])
+        self._samples = out
+
+    def query(self, q: float) -> float:
+        self._flush_buffer()
+        samples = self._samples
+        if not samples:
+            return math.nan
+        if len(samples) == 1:
+            return samples[0].value
+        target = q * self.n + self._invariant(q * self.n, self.n) / 2
+        r = 0.0
+        for i in range(1, len(samples)):
+            r += samples[i - 1].g
+            if r + samples[i].g + samples[i].delta > target:
+                return samples[i - 1].value
+        return samples[-1].value
+
+    def flush(self) -> None:
+        self._flush_buffer()
+
+    @property
+    def num_samples(self) -> int:
+        return len(self._samples) + len(self._buffer)
+
+    def min(self) -> float:
+        self._flush_buffer()
+        return self._samples[0].value if self._samples else math.nan
+
+    def max(self) -> float:
+        self._flush_buffer()
+        return self._samples[-1].value if self._samples else math.nan

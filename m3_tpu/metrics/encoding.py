@@ -183,3 +183,110 @@ def decode_batch(buf: bytes) -> list[UnaggregatedMessage]:
         msgs.append(msg)
         pos += n
     return msgs
+
+
+# ---------------------------------------------------------------------------
+# Aggregated codec: flushed (already aggregated) metrics on the wire —
+# reference src/metrics/encoding/protobuf/aggregated_encoder.go (the format
+# aggregator flush handlers hand to m3msg producers).
+# ---------------------------------------------------------------------------
+
+KIND_AGGREGATED = 3
+
+
+class AggregatedMessage:
+    """One flushed datapoint + its storage policy (metric/aggregated)."""
+
+    def __init__(
+        self,
+        mid: bytes,
+        time_nanos: int,
+        value: float,
+        policy: StoragePolicy,
+        agg_type: AggregationType = AggregationType.LAST,
+    ) -> None:
+        self.id = mid
+        self.time_nanos = time_nanos
+        self.value = float(value)
+        self.policy = policy
+        self.agg_type = agg_type
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, AggregatedMessage)
+            and self.id == other.id
+            and self.time_nanos == other.time_nanos
+            and self.value == other.value
+            and self.policy == other.policy
+            and self.agg_type == other.agg_type
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"AggregatedMessage({self.id!r}, t={self.time_nanos}, "
+            f"v={self.value}, {self.policy}, {self.agg_type.name})"
+        )
+
+
+def encode_aggregated(msg: AggregatedMessage) -> bytes:
+    out = BytesIO()
+    out.write(_U8.pack(KIND_AGGREGATED))
+    out.write(_U32.pack(len(msg.id)))
+    out.write(msg.id)
+    out.write(_I64.pack(msg.time_nanos))
+    out.write(_F64.pack(msg.value))
+    out.write(_I64.pack(msg.policy.resolution.window_nanos))
+    out.write(_I64.pack(msg.policy.retention.period_nanos))
+    out.write(_U8.pack(int(msg.agg_type)))
+    return out.getvalue()
+
+
+def decode_aggregated(buf: bytes, pos: int = 0) -> tuple[AggregatedMessage, int]:
+    (kind,) = _U8.unpack_from(buf, pos)
+    pos += 1
+    if kind != KIND_AGGREGATED:
+        raise ValueError(f"not an aggregated message (kind {kind})")
+    (id_len,) = _U32.unpack_from(buf, pos)
+    pos += 4
+    mid = buf[pos : pos + id_len]
+    pos += id_len
+    (t,) = _I64.unpack_from(buf, pos)
+    pos += 8
+    (v,) = _F64.unpack_from(buf, pos)
+    pos += 8
+    (res,) = _I64.unpack_from(buf, pos)
+    pos += 8
+    (ret,) = _I64.unpack_from(buf, pos)
+    pos += 8
+    (at,) = _U8.unpack_from(buf, pos)
+    pos += 1
+    return (
+        AggregatedMessage(
+            mid, t, v, StoragePolicy(Resolution(res), Retention(ret)),
+            AggregationType(at),
+        ),
+        pos,
+    )
+
+
+def encode_aggregated_batch(msgs) -> bytes:
+    out = BytesIO()
+    for m in msgs:
+        payload = encode_aggregated(m)
+        out.write(_U32.pack(len(payload)))
+        out.write(payload)
+    return out.getvalue()
+
+
+def decode_aggregated_batch(buf: bytes) -> list[AggregatedMessage]:
+    msgs = []
+    pos = 0
+    while pos < len(buf):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        msg, end = decode_aggregated(buf, pos)
+        if end - pos != n:
+            raise ValueError(f"message length mismatch ({end - pos} != {n})")
+        msgs.append(msg)
+        pos += n
+    return msgs

@@ -1,0 +1,166 @@
+"""r2 rule-management API: JSON codec + KV-backed CRUD for rulesets.
+
+Reference: /root/reference/src/ctl/service/r2/ — the rules REST service the
+r2ctl UI drives (routes over namespaces + mapping/rollup rules), persisting
+versioned rulesets the matcher service (rules/matcher.py) watches from KV.
+This module is the JSON <-> RuleSet codec plus a small store facade; the
+coordinator exposes the HTTP routes.
+"""
+
+from __future__ import annotations
+
+from ..metrics.policy import StoragePolicy
+from ..metrics.types import AggregationType
+from .filters import TagsFilter
+from .matcher import NAMESPACES_KEY, ruleset_key
+from .rules import (
+    MappingRule,
+    RollupRule,
+    RollupTarget,
+    RuleSet,
+    TransformationType,
+)
+
+
+def _filter_to_str(f: TagsFilter) -> str:
+    return " ".join(
+        f"{name.decode()}:{flt.pattern}" for name, flt in sorted(f.filters.items())
+    )
+
+
+def mapping_rule_to_dict(r: MappingRule) -> dict:
+    return {
+        "name": r.name,
+        "filter": _filter_to_str(r.filter),
+        "policies": [str(p) for p in r.policies],
+        "aggregations": [a.name for a in r.aggregations],
+        "drop": r.drop,
+        "cutoverNanos": r.cutover_nanos,
+    }
+
+
+def rollup_rule_to_dict(r: RollupRule) -> dict:
+    return {
+        "name": r.name,
+        "filter": _filter_to_str(r.filter),
+        "targets": [
+            {
+                "newName": t.new_name.decode(),
+                "groupBy": [g.decode() for g in t.group_by],
+                "aggregations": [a.name for a in t.aggregations],
+                "policies": [str(p) for p in t.policies],
+                "pipeline": [op.name for op in t.pipeline],
+            }
+            for t in r.targets
+        ],
+        "cutoverNanos": r.cutover_nanos,
+    }
+
+
+def ruleset_to_dict(rs: RuleSet) -> dict:
+    return {
+        "version": rs.version,
+        "mappingRules": [mapping_rule_to_dict(r) for r in rs.mapping_rules],
+        "rollupRules": [rollup_rule_to_dict(r) for r in rs.rollup_rules],
+    }
+
+
+def mapping_rule_from_dict(d: dict) -> MappingRule:
+    return MappingRule(
+        name=d["name"],
+        filter=TagsFilter.parse(d["filter"]),
+        policies=tuple(StoragePolicy.parse(p) for p in d.get("policies", [])),
+        aggregations=tuple(
+            AggregationType[a] for a in d.get("aggregations", [])
+        ),
+        drop=bool(d.get("drop", False)),
+        cutover_nanos=int(d.get("cutoverNanos", 0)),
+    )
+
+
+def rollup_rule_from_dict(d: dict) -> RollupRule:
+    return RollupRule(
+        name=d["name"],
+        filter=TagsFilter.parse(d["filter"]),
+        targets=tuple(
+            RollupTarget(
+                new_name=t["newName"].encode(),
+                group_by=tuple(g.encode() for g in t.get("groupBy", [])),
+                aggregations=tuple(
+                    AggregationType[a] for a in t.get("aggregations", [])
+                ),
+                policies=tuple(
+                    StoragePolicy.parse(p) for p in t.get("policies", [])
+                ),
+                pipeline=tuple(
+                    TransformationType[op] for op in t.get("pipeline", [])
+                ),
+            )
+            for t in d.get("targets", [])
+        ),
+        cutover_nanos=int(d.get("cutoverNanos", 0)),
+    )
+
+
+def ruleset_from_dict(d: dict) -> RuleSet:
+    return RuleSet(
+        mapping_rules=[mapping_rule_from_dict(r) for r in d.get("mappingRules", [])],
+        rollup_rules=[rollup_rule_from_dict(r) for r in d.get("rollupRules", [])],
+        version=int(d.get("version", 1)),
+    )
+
+
+class RuleStore:
+    """CRUD facade over the matcher's KV keys (r2/store role): updates are
+    seen live by any rules/matcher.Matcher watching the same KV.
+
+    Namespace-list and version updates ride CAS loops — the coordinator
+    serves these routes from a threading HTTP server, and a lost
+    read-modify-write would orphan a namespace's ruleset."""
+
+    def __init__(self, kv) -> None:
+        self.kv = kv
+
+    def namespaces(self) -> list[str]:
+        vv = self.kv.get(NAMESPACES_KEY)
+        return list(vv.value) if vv is not None and vv.value else []
+
+    def get(self, namespace: str) -> RuleSet | None:
+        vv = self.kv.get(ruleset_key(namespace))
+        return vv.value if vv is not None else None
+
+    def _edit_namespaces(self, fn) -> None:
+        while True:
+            vv = self.kv.get(NAMESPACES_KEY)
+            names = list(vv.value) if vv is not None and vv.value else []
+            new = fn(names)
+            if new == names:
+                return
+            try:
+                self.kv.check_and_set(
+                    NAMESPACES_KEY, vv.version if vv is not None else 0, new
+                )
+                return
+            except ValueError:
+                continue  # lost the race; retry on fresh state
+
+    def set(self, namespace: str, rs: RuleSet) -> None:
+        key = ruleset_key(namespace)
+        while True:
+            vv = self.kv.get(key)
+            rs.version = (vv.value.version + 1) if vv is not None else 1
+            try:
+                self.kv.check_and_set(key, vv.version if vv is not None else 0, rs)
+                break
+            except ValueError:
+                continue
+        self._edit_namespaces(
+            lambda names: names if namespace in names else names + [namespace]
+        )
+
+    def delete(self, namespace: str) -> bool:
+        if namespace not in self.namespaces():
+            return False
+        self._edit_namespaces(lambda names: [n for n in names if n != namespace])
+        self.kv.delete(ruleset_key(namespace))
+        return True

@@ -1,0 +1,130 @@
+"""Collector agent: JSON report API → aggregator client.
+
+Reference: /root/reference/src/collector/ — a collection agent applications
+report metrics to (reporter/m3aggregator/reporter.go): it matches each
+metric against the rule matcher and ships the results to the aggregation
+tier. Here: a small HTTP service accepting JSON counter/gauge/timer
+reports, running them through an optional rules matcher for storage
+policies, and forwarding over the aggregator's rawtcp-role socket protocol
+(aggregator/server.AggregatorClient).
+
+Report body (POST /report)::
+
+    {"metrics": [
+      {"type": "counter", "id": "requests", "value": 3},
+      {"type": "gauge",   "id": "temp", "value": 21.5},
+      {"type": "timer",   "id": "latency", "values": [0.1, 0.2]}
+    ]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..aggregator.server import AggregatorClient
+from ..metrics.encoding import UnaggregatedMessage
+from ..metrics.types import MetricType, Untimed
+from ..rules.rules import decode_tags_id, encode_tags_id
+
+_TYPES = {
+    "counter": MetricType.COUNTER,
+    "gauge": MetricType.GAUGE,
+    "timer": MetricType.TIMER,
+}
+
+
+class Collector:
+    """Parses reports and forwards them (reporter.go ReportCounter/
+    ReportGauge/ReportBatchTimer)."""
+
+    def __init__(self, client: AggregatorClient, matcher=None,
+                 match_namespace: str = "default") -> None:
+        self.client = client
+        self.matcher = matcher  # optional rules/matcher.Matcher
+        self.match_namespace = match_namespace
+        self.reported = 0
+        self.dropped = 0
+
+    def report(self, metrics: list[dict], now_nanos: int | None = None) -> int:
+        now = now_nanos if now_nanos is not None else time.time_ns()
+        sent = 0
+        for m in metrics:
+            mtype = _TYPES.get(m.get("type", ""))
+            if mtype is None:
+                raise ValueError(f"bad metric type {m.get('type')!r}")
+            mid = m["id"].encode() if isinstance(m["id"], str) else bytes(m["id"])
+            tags = m.get("tags")
+            if tags:
+                mid = encode_tags_id(
+                    tuple(
+                        (k.encode(), v.encode()) for k, v in sorted(tags.items())
+                    )
+                    + ((b"__name__", mid),)
+                )
+            if mtype == MetricType.COUNTER:
+                metric = Untimed(id=mid, type=mtype, counter_value=int(m["value"]))
+            elif mtype == MetricType.GAUGE:
+                metric = Untimed(id=mid, type=mtype, gauge_value=float(m["value"]))
+            else:
+                metric = Untimed(
+                    id=mid, type=mtype,
+                    batch_timer_values=tuple(float(v) for v in m["values"]),
+                )
+            policies = ()
+            if self.matcher is not None:
+                try:
+                    tag_pairs = decode_tags_id(mid)
+                except Exception:
+                    tag_pairs = ((b"__name__", mid),)
+                result = self.matcher.match(self.match_namespace, tag_pairs, now)
+                if result.drop:
+                    self.dropped += 1
+                    continue
+                policies = result.policies
+            self.client.send(
+                UnaggregatedMessage(metric, now, policies=policies)
+            )
+            sent += 1
+        self.reported += sent
+        return sent
+
+
+def serve(collector: Collector, host: str = "127.0.0.1", port: int = 0):
+    """HTTP report endpoint (collector's JSON report API role)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            try:
+                if self.path != "/report":
+                    self._reply(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                sent = collector.report(body.get("metrics", []))
+                self._reply(200, {"sent": sent})
+            except Exception as exc:
+                self._reply(400, {"error": str(exc)})
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._reply(200, {"ok": True, "reported": collector.reported})
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def _reply(self, code, obj):
+            payload = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]

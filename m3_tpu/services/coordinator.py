@@ -34,19 +34,6 @@ from ..storage.database import Database, NamespaceOptions
 from ..utils.snappy import compress, decompress
 
 
-class _Noop:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return None
-
-    def set_tag(self, *a, **k):
-        return self
-
-
-_NOOP_SPAN = _Noop()
-
 NANOS = 1_000_000_000
 MS = 1_000_000
 
@@ -359,15 +346,20 @@ class _Handler(BaseHTTPRequestHandler):
             z.writestr("stacks.txt", "\n".join(stacks))
             z.writestr("metrics.txt", METRICS.expose())
             z.writestr("traces.json", json.dumps(TRACER.dump(limit=512), indent=1))
-            ns_info = {
-                name: {
+            with c.db.lock:
+                namespaces = list(c.db.namespaces.items())
+            ns_info = {}
+            for name, ns in namespaces:
+                counts = []
+                for s in ns.shards:
+                    with s.lock:
+                        counts.append(len(s.series))
+                ns_info[name] = {
                     "blockSizeNanos": ns.opts.block_size_nanos,
                     "retentionNanos": ns.opts.retention_nanos,
                     "numShards": len(ns.shards),
-                    "numSeries": sum(len(s.series) for s in ns.shards),
+                    "numSeries": sum(counts),
                 }
-                for name, ns in c.db.namespaces.items()
-            }
             z.writestr("namespaces.json", json.dumps(ns_info, indent=1))
             p = c.placement_svc.get()
             z.writestr("placement.json", json.dumps(p.to_dict() if p else {}, indent=1))
@@ -382,8 +374,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             # poller endpoints (health checks, metric scrapes, the trace
             # endpoints themselves) would evict useful spans from the ring
+            from ..utils.trace import NOOP_SPAN
+
             span = (
-                _NOOP_SPAN
+                NOOP_SPAN
                 if url.path in ("/health", "/metrics", "/debug/traces", "/debug/dump")
                 else TRACER.span("http.get", path=url.path)
             )
@@ -436,6 +430,28 @@ class _Handler(BaseHTTPRequestHandler):
                 elif url.path == "/api/v1/services/m3db/placement":
                     p = c.placement_svc.get()
                     self._json(p.to_dict() if p else {}, 200 if p else 404)
+                elif url.path == "/api/v1/rules":
+                    from ..rules.r2 import RuleStore, ruleset_to_dict
+
+                    store = RuleStore(c.kv)
+                    self._json(
+                        {
+                            "namespaces": store.namespaces(),
+                            "rulesets": {
+                                ns: ruleset_to_dict(rs)
+                                for ns in store.namespaces()
+                                if (rs := store.get(ns)) is not None
+                            },
+                        }
+                    )
+                elif (m := re.match(r"^/api/v1/rules/([^/]+)$", url.path)) is not None:
+                    from ..rules.r2 import RuleStore, ruleset_to_dict
+
+                    rs = RuleStore(c.kv).get(m.group(1))
+                    if rs is None:
+                        self._json({"error": "not found"}, 404)
+                    else:
+                        self._json(ruleset_to_dict(rs))
                 elif url.path == "/debug/traces":
                     limit = int(q.get("limit", ["256"])[0])
                     self._json({"spans": TRACER.dump(limit=limit)})
@@ -516,6 +532,12 @@ class _Handler(BaseHTTPRequestHandler):
                     if name not in c.db.namespaces:
                         c.db.create_namespace(name, opts)
                     self._json({"namespace": name}, 201)
+                elif (m := re.match(r"^/api/v1/rules/([^/]+)$", url.path)) is not None:
+                    from ..rules.r2 import RuleStore, ruleset_from_dict
+
+                    rs = ruleset_from_dict(json.loads(self._body()))
+                    RuleStore(c.kv).set(m.group(1), rs)
+                    self._json({"namespace": m.group(1), "version": rs.version}, 200)
                 elif url.path == "/api/v1/topic":
                     body = json.loads(self._body())
                     c.topic_svc.add(

@@ -138,3 +138,7 @@ class Tracer:
 
 # process-wide default (the reference hangs its tracer off instrument opts)
 TRACER = Tracer()
+
+# shared no-op span (what span() returns when unsampled): for callers that
+# decide themselves not to trace something
+NOOP_SPAN = _ActiveSpan(None, None)

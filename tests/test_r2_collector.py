@@ -1,0 +1,187 @@
+"""r2 rules API, CM quantile stream, aggregated codec, collector agent
+(reference: src/ctl/service/r2, aggregation/quantile/cm/stream.go,
+encoding/protobuf/aggregated_encoder.go, src/collector)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.quantile_cm import QuantileStream
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.metrics.encoding import (
+    AggregatedMessage,
+    decode_aggregated_batch,
+    encode_aggregated_batch,
+)
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.types import AggregationType
+from m3_tpu.rules.r2 import RuleStore, ruleset_from_dict, ruleset_to_dict
+
+NANOS = 1_000_000_000
+
+RULESET_JSON = {
+    "mappingRules": [
+        {
+            "name": "keep-api",
+            "filter": "service:api* env:prod",
+            "policies": ["10s:2d", "1m:40d"],
+            "aggregations": ["SUM", "COUNT"],
+        },
+        {"name": "drop-dev", "filter": "env:dev", "drop": True},
+    ],
+    "rollupRules": [
+        {
+            "name": "per-dc",
+            "filter": "service:api*",
+            "targets": [
+                {
+                    "newName": "api_by_dc",
+                    "groupBy": ["dc"],
+                    "aggregations": ["SUM"],
+                    "policies": ["1m:40d"],
+                    "pipeline": ["PERSECOND"],
+                }
+            ],
+        }
+    ],
+}
+
+
+def test_ruleset_json_roundtrip():
+    rs = ruleset_from_dict(RULESET_JSON)
+    d = ruleset_to_dict(rs)
+    assert d["mappingRules"][0]["filter"] == "env:prod service:api*"
+    assert d["mappingRules"][0]["policies"] == ["10s:2d", "1m:40d"]
+    assert d["mappingRules"][1]["drop"] is True
+    assert d["rollupRules"][0]["targets"][0]["pipeline"] == ["PERSECOND"]
+    # round-trip is stable
+    assert ruleset_to_dict(ruleset_from_dict(d)) == d
+
+
+def test_rule_store_versions_and_matcher_sees_updates():
+    from m3_tpu.rules.matcher import Matcher
+
+    kv = KVStore()
+    store = RuleStore(kv)
+    matcher = Matcher(kv)
+    store.set("prod", ruleset_from_dict(RULESET_JSON))
+    assert store.namespaces() == ["prod"]
+    assert store.get("prod").version == 1
+    store.set("prod", ruleset_from_dict(RULESET_JSON))
+    assert store.get("prod").version == 2
+
+    tags = ((b"env", b"prod"), (b"service", b"api-gw"))
+    result = matcher.match("prod", tags, 10 * NANOS)
+    assert [str(p) for p in result.policies] == ["10s:2d", "1m:40d"]
+    assert store.delete("prod") is True
+    assert store.namespaces() == []
+
+
+def test_rules_http_api():
+    from m3_tpu.services.coordinator import Coordinator, serve
+
+    coord = Coordinator()
+    srv, port = serve(coord)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/api/v1/rules/staging",
+            data=json.dumps(RULESET_JSON).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out == {"namespace": "staging", "version": 1}
+        got = json.loads(urllib.request.urlopen(f"{base}/api/v1/rules/staging").read())
+        assert got["mappingRules"][0]["name"] == "keep-api"
+        idx = json.loads(urllib.request.urlopen(f"{base}/api/v1/rules").read())
+        assert idx["namespaces"] == ["staging"]
+        assert "staging" in idx["rulesets"]
+    finally:
+        srv.shutdown()
+
+
+def test_cm_stream_targeted_quantiles():
+    rng = np.random.default_rng(5)
+    data = rng.normal(100.0, 15.0, 20_000)
+    qs = QuantileStream(quantiles=(0.5, 0.95, 0.99), eps=0.01)
+    for v in data:
+        qs.insert(float(v))
+    ranked = np.sort(data)
+    n = len(data)
+    for q in (0.5, 0.95, 0.99):
+        got = qs.query(q)
+        # eps-targeted guarantee: got's true rank within q +/- 2*eps
+        rank = np.searchsorted(ranked, got) / n
+        assert abs(rank - q) <= 0.02, (q, got, rank)
+    # the sketch is actually a sketch, not a full buffer
+    assert qs.num_samples < 2_000
+    assert qs.min() == pytest.approx(ranked[0])
+    assert qs.max() == pytest.approx(ranked[-1])
+
+
+def test_cm_stream_edge_cases():
+    qs = QuantileStream(quantiles=(0.5,))
+    assert np.isnan(qs.query(0.5))
+    qs.insert(7.0)
+    assert qs.query(0.5) == 7.0
+    with pytest.raises(ValueError):
+        QuantileStream(quantiles=())
+    with pytest.raises(ValueError):
+        QuantileStream(quantiles=(1.5,))
+
+
+def test_aggregated_codec_roundtrip():
+    msgs = [
+        AggregatedMessage(
+            b"cpu.p99", 1000 * NANOS, 0.93, StoragePolicy.parse("10s:2d"),
+            AggregationType.P99,
+        ),
+        AggregatedMessage(
+            b"mem.sum", 2000 * NANOS, 12345.5, StoragePolicy.parse("1m:40d"),
+            AggregationType.SUM,
+        ),
+    ]
+    assert decode_aggregated_batch(encode_aggregated_batch(msgs)) == msgs
+
+
+def test_collector_end_to_end():
+    """JSON report over HTTP → collector → socket ingress → aggregator."""
+    from m3_tpu.aggregator.aggregator import Aggregator
+    from m3_tpu.aggregator.server import AggregatorClient, AggregatorIngestServer
+    from m3_tpu.services.collector import Collector, serve as cserve
+
+    agg = Aggregator(num_shards=4)
+    ingress = AggregatorIngestServer(agg)
+    ingress.start()
+    try:
+        client = AggregatorClient([("127.0.0.1", ingress.port)], num_shards=4)
+        coll = Collector(client)
+        srv, port = cserve(coll)
+        try:
+            body = json.dumps(
+                {
+                    "metrics": [
+                        {"type": "counter", "id": "reqs", "value": 3},
+                        {"type": "gauge", "id": "temp", "value": 21.5},
+                        {"type": "timer", "id": "lat", "values": [0.1, 0.3]},
+                    ]
+                }
+            ).encode()
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/report", data=body)
+            out = json.loads(urllib.request.urlopen(req).read())
+            assert out == {"sent": 3}
+            import time
+
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                interned = {mid for s in agg.shards for mid in s.ids}
+                if {b"reqs", b"temp", b"lat"} <= interned:
+                    break
+                time.sleep(0.05)
+            assert {b"reqs", b"temp", b"lat"} <= interned
+        finally:
+            srv.shutdown()
+    finally:
+        ingress.stop()
