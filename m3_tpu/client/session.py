@@ -45,13 +45,19 @@ class Session:
     def _shard(self, sid: bytes) -> int:
         return shard_for(sid, self.num_shards)
 
-    def _fanout(self, op_name: str, shard: int, required: int, call):
+    def _fanout(self, op_name: str, shard: int, required: int, call,
+                readable_only: bool = False):
         """Try ``call(node)`` on every replica of ``shard``; a raising
         replica must not abort the fan-out — remaining replicas can still
         reach quorum (session.go:1068). Returns the per-replica results;
-        raises ConsistencyError when fewer than ``required`` succeed."""
+        raises ConsistencyError when fewer than ``required`` succeed.
+
+        ``readable_only`` gates on shard state: an INITIALIZING replica is
+        still bootstrapping the shard and must not serve reads for it
+        (topology readable-shard filtering; writes go to every replica so
+        the initializing one doesn't miss data)."""
         success, errors, results = 0, [], []
-        for host in self.topology.hosts_for_shard(shard):
+        for host in self.topology.hosts_for_shard(shard, readable_only=readable_only):
             node = self.nodes.get(host)
             if node is None or not node.is_up:
                 errors.append(f"{host}: down")
@@ -105,6 +111,7 @@ class Session:
             self._shard(sid),
             self.read_consistency.required(self.topology.replicas),
             lambda node: node.fetch_blocks(self.namespace, sid, start_nanos, end_nanos),
+            readable_only=True,
         )
         it = SeriesIterator(
             sid,
@@ -128,8 +135,13 @@ class Session:
                 res = node.fetch_tagged(self.namespace, query, start_nanos, end_nanos)
             except Exception:
                 continue
-            for shard in node.owned_shards():
-                responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
+            # count this replica only for shards whose copy here is READABLE
+            # per the placement — an INITIALIZING replica is still
+            # bootstrapping and must not count toward read consistency
+            owned = node.owned_shards()
+            for shard in owned:
+                if host in self.topology.hosts_for_shard(shard, readable_only=True):
+                    responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
             for sid, tags, dps in res:
                 cur = by_series.get(sid)
                 if cur is None:

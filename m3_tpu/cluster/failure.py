@@ -1,0 +1,142 @@
+"""Failure detection → topology reaction: heartbeat expiry drives placement.
+
+Reference behavior (SURVEY §5 failure detection / elastic recovery): the
+reference watches service heartbeats (cluster/services/heartbeat) and
+operators — or automation over the placement APIs — replace dead instances;
+replicas stream the replacement's shards via peers bootstrap, and reads are
+gated on shard state so an INITIALIZING replica never serves data it
+doesn't have yet (topology readable-shard filtering).
+
+``FailureDetector`` closes the loop in-process: it polls Services liveness
+for one service, emits events on death/recovery, and (when given a spare
+pool) runs placement.replace_instance through the PlacementService so the
+cluster heals without an operator.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .placement import PlacementService, replace_instance
+from .services import Services
+
+
+@dataclass
+class FailureEvent:
+    instance_id: str
+    kind: str  # "dead" | "recovered" | "replaced"
+    replacement_id: str | None = None
+    at_monotonic: float = field(default_factory=time.monotonic)
+
+
+class FailureDetector:
+    """Polls heartbeat liveness; optionally auto-replaces dead instances.
+
+    - ``grace``: how long past the heartbeat timeout before declaring death
+      (debounces transient misses).
+    - ``spares``: instance ids eligible to take over a dead instance's
+      shards. Replacement consumes a spare; the placement change rides the
+      PlacementService so every watcher (topology maps, nodes) converges.
+    - ``on_event``: callback for observability / tests.
+    """
+
+    def __init__(
+        self,
+        services: Services,
+        placement_svc: PlacementService,
+        service_name: str = "m3db",
+        grace: float = 5.0,
+        spares: list[str] | None = None,
+        on_event: Callable[[FailureEvent], None] | None = None,
+        auto_replace: bool = True,
+    ) -> None:
+        self.services = services
+        self.placement_svc = placement_svc
+        self.service_name = service_name
+        self.grace = grace
+        self.spares = list(spares or [])
+        self.on_event = on_event
+        self.auto_replace = auto_replace
+        self.events: list[FailureEvent] = []
+        self._dead: set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- liveness math ---
+
+    def _live_ids(self) -> set[str]:
+        return {i.id for i in self.services.instances(self.service_name, live_only=True)}
+
+    def _known_ids(self) -> set[str]:
+        return {
+            i.id for i in self.services.instances(self.service_name, live_only=False)
+        }
+
+    def _emit(self, ev: FailureEvent) -> None:
+        self.events.append(ev)
+        if self.on_event is not None:
+            self.on_event(ev)
+
+    # --- one detection pass (callable directly from tests/clock drivers) ---
+
+    def check(self, now: float | None = None) -> list[FailureEvent]:
+        """Run one liveness pass; returns the events it produced."""
+        now = time.monotonic() if now is None else now
+        produced: list[FailureEvent] = []
+        with self._lock:
+            p = self.placement_svc.get()
+            placed = set(p.instances) if p is not None else set()
+            live = self._live_ids()
+            timeout = self.services.heartbeat_timeout
+            for inst in self.services.instances(self.service_name, live_only=False):
+                age = now - inst.last_heartbeat
+                if inst.id in self._dead:
+                    if age < timeout:
+                        self._dead.discard(inst.id)
+                        ev = FailureEvent(inst.id, "recovered")
+                        self._emit(ev)
+                        produced.append(ev)
+                    continue
+                if age < timeout + self.grace or inst.id not in placed:
+                    continue
+                self._dead.add(inst.id)
+                ev = FailureEvent(inst.id, "dead")
+                self._emit(ev)
+                produced.append(ev)
+                if self.auto_replace and p is not None:
+                    spare = next(
+                        (s for s in self.spares if s not in placed and s not in self._dead),
+                        None,
+                    )
+                    if spare is not None:
+                        self.spares.remove(spare)
+                        replace_instance(p, inst.id, spare)
+                        self.placement_svc.set(p)
+                        placed = set(p.instances)
+                        rev = FailureEvent(inst.id, "replaced", replacement_id=spare)
+                        self._emit(rev)
+                        produced.append(rev)
+        return produced
+
+    # --- background driver ---
+
+    def start(self, interval: float = 1.0) -> None:
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                try:
+                    self.check()
+                except Exception:
+                    pass  # detector must never die to a transient error
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

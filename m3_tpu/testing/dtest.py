@@ -1,0 +1,161 @@
+"""dtest-role destructive test harness over m3em agents.
+
+Reference: /root/reference/src/cmd/tools/dtest/ — scripted destructive
+scenarios (seeded bootstrap, node stop/start, add/replace) driven through
+m3em-managed real processes, asserting the cluster converges. Here the
+harness provisions REAL dbnode processes through testing/m3em.py agents,
+seeds data over the socket client, and exposes the destructive primitives
+scenarios compose.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..client.session import Session
+from ..cluster.placement import build_initial_placement
+from ..cluster.topology import ConsistencyLevel, TopologyMap
+from ..net.client import RemoteNode
+from ..testing.m3em import AgentClient, AgentServer
+from ..utils.xtime import Unit
+
+NANOS = 1_000_000_000
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class DTestHarness:
+    """Provision + destroy dbnode processes through agents.
+
+    ``agents`` maps node id -> AgentClient; one local AgentServer per node
+    is created when none are given (the single-host dtest docker mode)."""
+
+    def __init__(
+        self,
+        node_ids: list[str],
+        base_dir: str,
+        num_shards: int = 4,
+        replica_factor: int = 2,
+        agents: dict[str, AgentClient] | None = None,
+    ) -> None:
+        self.node_ids = list(node_ids)
+        self.base_dir = base_dir
+        self.num_shards = num_shards
+        self._own_agents: list[AgentServer] = []
+        if agents is None:
+            agents = {}
+            for nid in node_ids:
+                srv = AgentServer(f"{base_dir}/agent-{nid}")
+                self._own_agents.append(srv)
+                agents[nid] = AgentClient("127.0.0.1", srv.port)
+        self.agents = agents
+        self.ports = {nid: _free_port() for nid in node_ids}
+        self.placement = build_initial_placement(
+            self.node_ids, num_shards, replica_factor
+        )
+        self.nodes: dict[str, RemoteNode] = {}
+
+    def node_argv(self, nid: str) -> list[str]:
+        shards = ",".join(
+            str(s) for s in sorted(self.placement.instances[nid].shards)
+        )
+        return [
+            sys.executable,
+            "-m",
+            "m3_tpu.services.dbnode",
+            "--base-dir",
+            "data",  # relative to the agent target dir
+            "--port",
+            str(self.ports[nid]),
+            "--node-id",
+            nid,
+            "--num-shards",
+            str(self.num_shards),
+            "--shards",
+            shards,
+        ]
+
+    # --- lifecycle primitives (dtest harness verbs) ---
+
+    def setup_all(self) -> None:
+        for nid in self.node_ids:
+            self.agents[nid].setup(nid, self.node_argv(nid))
+
+    def start(self, nid: str) -> None:
+        self.agents[nid].start(nid, env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": self._pythonpath()})
+        self.nodes[nid] = RemoteNode("127.0.0.1", self.ports[nid], node_id=nid)
+        self._await_health(nid)
+
+    @staticmethod
+    def _pythonpath() -> str:
+        import m3_tpu
+
+        import os
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(m3_tpu.__file__)))
+        existing = os.environ.get("PYTHONPATH", "")
+        return f"{pkg_root}:{existing}" if existing else pkg_root
+
+    def _await_health(self, nid: str, timeout: float = 30) -> None:
+        deadline = time.monotonic() + timeout
+        node = self.nodes[nid]
+        while time.monotonic() < deadline:
+            try:
+                if node.health().get("bootstrapped"):
+                    return
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(f"{nid} did not become healthy")
+
+    def start_all(self) -> None:
+        for nid in self.node_ids:
+            self.start(nid)
+
+    def kill(self, nid: str) -> None:
+        import signal
+
+        self.agents[nid].stop(nid, sig=signal.SIGKILL, timeout=5)
+
+    def restart(self, nid: str) -> None:
+        self.start(nid)
+
+    def session(self, read_cl=ConsistencyLevel.MAJORITY,
+                write_cl=ConsistencyLevel.MAJORITY) -> Session:
+        return Session(
+            topology=TopologyMap(self.placement),
+            nodes=self.nodes,
+            read_consistency=read_cl,
+            write_consistency=write_cl,
+        )
+
+    def seed(self, n_series: int = 4, n_points: int = 10,
+             t0: int = 1000 * NANOS) -> dict[bytes, list[float]]:
+        """Seeded write load (dtest seeded-bootstrap input)."""
+        session = self.session()
+        written: dict[bytes, list[float]] = {}
+        for i in range(n_series):
+            sid = b"dtest-series-%d" % i
+            vals = []
+            for j in range(n_points):
+                v = float(i * 100 + j)
+                session.write(sid, t0 + j * 10 * NANOS, v, Unit.SECOND)
+                vals.append(v)
+            written[sid] = vals
+        return written
+
+    def close(self) -> None:
+        for nid in self.node_ids:
+            try:
+                self.agents[nid].teardown(nid)
+            except Exception:
+                pass
+        for srv in self._own_agents:
+            srv.close()

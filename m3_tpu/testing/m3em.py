@@ -1,0 +1,198 @@
+"""m3em-role environment manager: remote process-lifecycle agents.
+
+Reference: /root/reference/src/m3em/ — an agent daemon runs on each target
+host (agent/agent.go, operator.proto): the operator pushes build/config
+files to it, then drives Setup/Start/Stop/Teardown of the service process
+and watches agent heartbeats; node/cluster layers (m3em/node, m3em/cluster)
+orchestrate placements of such nodes for destructive tests (dtest).
+
+Here the agent is an HTTP service managing child processes under a working
+directory; the operator is its client. Orchestration lives in
+testing/dtest.py. Process targets are command argv lists — for this
+framework that's ``python -m m3_tpu.services.dbnode ...``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import shutil
+import signal
+import subprocess
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class AgentServer:
+    """One host's agent: setup files + manage one process per target id."""
+
+    def __init__(self, base_dir: str, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._argv: dict[str, list[str]] = {}
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, obj):
+                payload = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if self.path == "/heartbeat":
+                    with outer._lock:
+                        procs = {
+                            tid: {
+                                "pid": p.pid,
+                                "running": p.poll() is None,
+                                "returncode": p.returncode,
+                            }
+                            for tid, p in outer._procs.items()
+                        }
+                    self._reply(200, {"ok": True, "uptime": time.time() - outer.started_at,
+                                      "processes": procs})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    op = self.path.strip("/")
+                    fn = getattr(outer, f"op_{op}", None)
+                    if fn is None:
+                        self._reply(404, {"error": f"unknown op {op}"})
+                        return
+                    self._reply(200, fn(body))
+                except Exception as exc:
+                    self._reply(400, {"error": str(exc)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    # --- operator ops (operator.proto Setup/Start/Stop/Teardown) ---
+
+    def _dir(self, target: str) -> str:
+        safe = "".join(c for c in target if c.isalnum() or c in "-_")
+        if not safe:
+            raise ValueError(f"bad target id {target!r}")
+        return os.path.join(self.base_dir, safe)
+
+    def op_setup(self, body: dict) -> dict:
+        """Create the target's working dir and place transferred files."""
+        target = body["target"]
+        d = self._dir(target)
+        os.makedirs(d, exist_ok=True)
+        for rel, b64 in (body.get("files") or {}).items():
+            if os.path.isabs(rel) or ".." in rel.split("/"):
+                raise ValueError(f"bad file path {rel!r}")
+            path = os.path.join(d, rel)
+            os.makedirs(os.path.dirname(path) or d, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(base64.b64decode(b64))
+        with self._lock:
+            self._argv[target] = list(body["argv"])
+        return {"dir": d}
+
+    def op_start(self, body: dict) -> dict:
+        target = body["target"]
+        with self._lock:
+            argv = self._argv.get(target)
+            if argv is None:
+                raise ValueError(f"target {target} not set up")
+            cur = self._procs.get(target)
+            if cur is not None and cur.poll() is None:
+                return {"pid": cur.pid, "alreadyRunning": True}
+            proc = subprocess.Popen(
+                argv,
+                cwd=self._dir(target),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                env={**os.environ, **(body.get("env") or {})},
+            )
+            self._procs[target] = proc
+        return {"pid": proc.pid}
+
+    def op_stop(self, body: dict) -> dict:
+        target = body["target"]
+        sig = int(body.get("signal", signal.SIGTERM))
+        with self._lock:
+            proc = self._procs.get(target)
+        if proc is None or proc.poll() is not None:
+            return {"stopped": False}
+        proc.send_signal(sig)
+        try:
+            proc.wait(timeout=float(body.get("timeout", 10)))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+        return {"stopped": True, "returncode": proc.returncode}
+
+    def op_teardown(self, body: dict) -> dict:
+        target = body["target"]
+        self.op_stop({"target": target, "signal": signal.SIGKILL, "timeout": 2})
+        with self._lock:
+            self._procs.pop(target, None)
+            self._argv.pop(target, None)
+        if body.get("removeData", True):
+            shutil.rmtree(self._dir(target), ignore_errors=True)
+        return {"torn": True}
+
+    def close(self) -> None:
+        with self._lock:
+            targets = list(self._procs)
+        for t in targets:
+            self.op_stop({"target": t, "signal": signal.SIGKILL, "timeout": 2})
+        self._server.shutdown()
+
+
+class AgentClient:
+    """Operator-side client of one agent (m3em operator role)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.base = f"http://{host}:{port}"
+
+    def _post(self, op: str, **body):
+        req = urllib.request.Request(
+            f"{self.base}/{op}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        return out
+
+    def heartbeat(self) -> dict:
+        with urllib.request.urlopen(f"{self.base}/heartbeat", timeout=5) as r:
+            return json.loads(r.read())
+
+    def setup(self, target: str, argv: list[str], files: dict[str, bytes] | None = None):
+        return self._post(
+            "setup",
+            target=target,
+            argv=argv,
+            files={
+                k: base64.b64encode(v).decode() for k, v in (files or {}).items()
+            },
+        )
+
+    def start(self, target: str, env: dict | None = None):
+        return self._post("start", target=target, env=env or {})
+
+    def stop(self, target: str, sig: int = signal.SIGTERM, timeout: float = 10):
+        return self._post("stop", target=target, signal=int(sig), timeout=timeout)
+
+    def teardown(self, target: str, remove_data: bool = True):
+        return self._post("teardown", target=target, removeData=remove_data)

@@ -1,0 +1,101 @@
+"""Failure detection → topology reaction + shard-state read gating
+(SURVEY §5 failure detection / elastic recovery)."""
+
+import time
+
+import pytest
+
+from m3_tpu.cluster.failure import FailureDetector
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.cluster.placement import (
+    PlacementService,
+    ShardState,
+    build_initial_placement,
+    mark_shards_available,
+    replace_instance,
+)
+from m3_tpu.cluster.services import ServiceInstance, Services
+from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+
+
+def _setup(heartbeat_timeout=0.2, spares=("n3",)):
+    kv = KVStore()
+    services = Services(kv, heartbeat_timeout=heartbeat_timeout)
+    psvc = PlacementService(kv)
+    psvc.set(build_initial_placement(["n0", "n1", "n2"], 8, 2))
+    for nid in ("n0", "n1", "n2"):
+        services.advertise("m3db", ServiceInstance(id=nid, endpoint=f"{nid}:9000"))
+    det = FailureDetector(
+        services, psvc, grace=0.1, spares=list(spares), auto_replace=True
+    )
+    return kv, services, psvc, det
+
+
+def test_detector_replaces_dead_instance_with_spare():
+    kv, services, psvc, det = _setup()
+    # all instances healthy: no events
+    assert det.check() == []
+    # n1 stops heartbeating: backdate its last heartbeat past timeout+grace
+    services._instances["m3db"]["n1"].last_heartbeat -= 0.4
+    events = det.check()
+    kinds = [(e.kind, e.instance_id) for e in events]
+    assert ("dead", "n1") in kinds
+    assert ("replaced", "n1") in kinds
+    p = psvc.get()
+    assert "n3" in p.instances
+    # n3 inherits n1's shards as INITIALIZING, streaming from n1
+    for a in p.instances["n3"].shards.values():
+        assert a.state == ShardState.INITIALIZING
+        assert a.source_instance == "n1"
+    # spare consumed; a second pass emits nothing new for n1
+    assert det.spares == []
+    assert det.check() == []
+
+
+def test_detector_without_spare_emits_dead_only():
+    kv, services, psvc, det = _setup(spares=())
+    services._instances["m3db"]["n1"].last_heartbeat -= 0.4
+    events = det.check()
+    assert [(e.kind, e.instance_id) for e in events] == [("dead", "n1")]
+    assert set(psvc.get().instances) == {"n0", "n1", "n2"}
+
+
+def test_detector_recovery_event():
+    kv, services, psvc, det = _setup(spares=())
+    services._instances["m3db"]["n0"].last_heartbeat -= 0.4
+    det.check()  # n0 declared dead
+    services.heartbeat("m3db", "n0")
+    events = det.check()
+    assert ("recovered", "n0") in [(e.kind, e.instance_id) for e in events]
+
+
+def test_initializing_replica_gated_from_reads():
+    """An INITIALIZING replica serves no reads: the session's read fan-out
+    skips it entirely while its bootstrap is pending."""
+    from m3_tpu.cluster.placement import add_instance
+    from m3_tpu.testing.cluster import LocalCluster, Node
+
+    cluster = LocalCluster(num_nodes=2, num_shards=4, replica_factor=2)
+    NANOS = 1_000_000_000
+    session = cluster.session(read_cl=ConsistencyLevel.ONE)
+    sid = session.write_tagged(
+        ((b"__name__", b"m"), (b"host", b"a")), 1000 * NANOS, 1.0
+    )
+    # join a node WITHOUT running its bootstrap: shards stay INITIALIZING
+    node = Node("n_new", cluster.base_dir, cluster.num_shards, cluster.ns_opts)
+    cluster.nodes["n_new"] = node
+    placement = add_instance(cluster.placement_svc.get(), "n_new")
+    cluster.placement_svc.set(placement)
+    inst = placement.instances["n_new"]
+    init_shards = [
+        s for s, a in inst.shards.items() if a.state == ShardState.INITIALIZING
+    ]
+    assert init_shards, "expected initializing shards on the new node"
+    session2 = cluster.session(read_cl=ConsistencyLevel.ONE)
+    for s in init_shards:
+        assert "n_new" not in session2.topology.hosts_for_shard(s, readable_only=True)
+        assert "n_new" in session2.topology.hosts_for_shard(s)
+    # the series' shard reads fine from available replicas, and the new
+    # (empty) node is never asked even if it owns the shard
+    dps = session2.fetch(sid, 0, 2**62)
+    assert [dp.value for dp in dps] == [1.0]
