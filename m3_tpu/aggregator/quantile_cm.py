@@ -17,7 +17,6 @@ walks cumulative weights to the first sample crossing the target rank.
 
 from __future__ import annotations
 
-import bisect
 import math
 from dataclasses import dataclass
 
@@ -69,17 +68,20 @@ class QuantileStream:
             return
         self._buffer.sort()
         samples = self._samples
-        values = [s.value for s in samples]
-        r = 0  # cumulative g before the insertion point
         idx = 0
         for v in self._buffer:
             while idx < len(samples) and samples[idx].value <= v:
-                r += samples[idx].g
                 idx += 1
             if idx == 0 or idx == len(samples):
                 delta = 0
             else:
-                delta = int(self._invariant(r, self.n)) - 1
+                # stream.go insert(): delta = successor.numRanks +
+                # successor.delta - 1. Neighbor-based (not invariant-based)
+                # deltas keep freshly inserted regions mergeable — with
+                # invariant-based deltas a monotone stream's sketch
+                # degenerates to a near-full buffer.
+                nxt = samples[idx]
+                delta = nxt.g + nxt.delta - 1
             samples.insert(idx, _Sample(v, 1, max(delta, 0)))
             idx += 1
             self.n += 1
@@ -87,25 +89,30 @@ class QuantileStream:
         self._compress()
 
     def _compress(self) -> None:
+        # Back-to-front merge pass, mirroring the reference's compress cursor
+        # (stream.go walks from the tail maintaining exact minRank). Merging
+        # s[i] into its successor cascades naturally on monotone streams, and
+        # the rank used for the invariant is the sample's true pre-merge
+        # minRank — no double counting of absorbed weight.
         samples = self._samples
         if len(samples) < 3:
             return
-        out = [samples[0]]
-        r = samples[0].g
-        for s in samples[1:-1]:
-            merged = out[-1]
-            if (
-                merged is not samples[0]
-                and merged.g + s.g + s.delta <= self._invariant(r, self.n)
-            ):
-                # merge into s (keep the larger value as representative)
-                s.g += merged.g
-                out[-1] = s
+        ranks = []  # ranks[i] = exact cumulative g through samples[i]
+        acc = 0
+        for s in samples:
+            acc += s.g
+            ranks.append(acc)
+        out_rev = [samples[-1]]
+        for i in range(len(samples) - 2, 0, -1):
+            s = samples[i]
+            nxt = out_rev[-1]
+            max_rank = ranks[i] + s.delta  # stream.go compress(): maxRank
+            if s.g + nxt.g + nxt.delta <= self._invariant(max_rank, self.n):
+                nxt.g += s.g
             else:
-                out.append(s)
-            r += s.g
-        out.append(samples[-1])
-        self._samples = out
+                out_rev.append(s)
+        out_rev.append(samples[0])
+        self._samples = out_rev[::-1]
 
     def query(self, q: float) -> float:
         self._flush_buffer()

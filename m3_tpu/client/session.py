@@ -121,10 +121,11 @@ class Session:
         )
         return list(it)
 
-    def fetch_tagged(self, query, start_nanos: int, end_nanos: int):
+    def fetch_tagged(self, query, start_nanos: int, end_nanos: int,
+                     limit: int | None = None):
         """Fan out to replicas of every shard; merge + dedupe series across
         replicas (last-written value wins on equal timestamps, the
-        SeriesIterator default)."""
+        SeriesIterator default). ``limit`` caps the merged series count."""
         required = self.read_consistency.required(self.topology.replicas)
         by_series: dict[bytes, tuple] = {}
         responded_by_shard: dict[int, int] = {}
@@ -132,7 +133,9 @@ class Session:
             if not node.is_up:
                 continue
             try:
-                res = node.fetch_tagged(self.namespace, query, start_nanos, end_nanos)
+                res = node.fetch_tagged(
+                    self.namespace, query, start_nanos, end_nanos, limit=limit
+                )
             except Exception:
                 continue
             # count this replica only for shards whose copy here is READABLE
@@ -161,6 +164,69 @@ class Session:
         for sid in sorted(by_series):
             tags, merged = by_series[sid]
             out.append((sid, tags, [merged[t] for t in sorted(merged)]))
+        if limit is not None and len(out) > limit:
+            out = out[:limit]
+        return out
+
+    # --- index-only reads (QueryIDs / AggregateQuery fan-out) ---
+
+    def query_ids(self, query, start_nanos: int, end_nanos: int,
+                  limit: int | None = None):
+        """Fan out the index query; union docs by id. Requires at least one
+        live replica overall (index listings are best-effort breadth, like
+        the reference's aggregate paths). Returns (docs, exhaustive):
+        ``limit`` applies to the MERGED union (the per-node limit alone
+        would let N nodes return N×limit series past the cost cap), and
+        exhaustive is False when this or any node truncated."""
+        docs: dict[bytes, tuple] = {}
+        responded = 0
+        exhaustive = True
+        for node in self.nodes.values():
+            if not node.is_up:
+                continue
+            try:
+                res = node.query_ids(self.namespace, query, start_nanos,
+                                     end_nanos, limit=limit)
+            except Exception:
+                # an unreachable placed replica may hold docs no one else
+                # returned — the union can no longer claim completeness
+                exhaustive = False
+                continue
+            responded += 1
+            if not res.get("exhaustive", True):
+                exhaustive = False
+            for did, fields in res.get("docs", []):
+                docs.setdefault(
+                    bytes(did), tuple((bytes(k), bytes(v)) for k, v in fields)
+                )
+        if responded == 0:
+            raise ConsistencyError("query_ids", 0, 1, ["no replica responded"])
+        out = [(did, docs[did]) for did in sorted(docs)]
+        if limit is not None and len(out) > limit:
+            out = out[:limit]
+            exhaustive = False
+        return out, exhaustive
+
+    def aggregate_query(self, query, start_nanos: int, end_nanos: int,
+                        field_filter=None):
+        """Union of tag name → value sets across replicas."""
+        out: dict[bytes, set[bytes]] = {}
+        responded = 0
+        for node in self.nodes.values():
+            if not node.is_up:
+                continue
+            try:
+                agg = node.aggregate_query(
+                    self.namespace, query, start_nanos, end_nanos,
+                    field_filter=field_filter,
+                )
+            except Exception:
+                continue  # best-effort breadth; zero responders still raise
+            responded += 1
+            for k, vs in agg.items():
+                out.setdefault(k, set()).update(vs)
+        if responded == 0:
+            raise ConsistencyError("aggregate_query", 0, 1, ["no replica responded"])
         return out
 
     # --- peer streaming (peers bootstrapper / repair seam) ---

@@ -1,0 +1,156 @@
+"""SessionDatabase: the storage-Database surface backed by a cluster
+Session over the live placement.
+
+Reference: the coordinator never embeds storage — it reaches dbnodes
+through the cluster-aware client (src/dbnode/client/session.go), resolving
+topology from the KV-watched placement (src/dbnode/topology/dynamic.go:107)
+and fanning out per consistency level. This adapter gives the coordinator
+(and anything else written against the Database surface) that same remote
+data plane: point it at the control-plane KV, and writes/reads route to the
+node processes named by the placement.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..cluster.placement import Placement, PlacementService
+from ..cluster.topology import ConsistencyLevel, TopologyMap
+from ..utils.xtime import Unit
+from .session import Session
+
+
+class SessionDatabase:
+    """Database-surface adapter over placement-routed cluster sessions."""
+
+    def __init__(
+        self,
+        kv,
+        namespaces: tuple[str, ...] = ("default",),
+        write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY,
+        read_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY,
+        placement_name: str = "default",
+    ) -> None:
+        self.kv = kv
+        self._ns_names = tuple(namespaces)
+        self.write_consistency = write_consistency
+        self.read_consistency = read_consistency
+        self.placement_svc = PlacementService(kv, name=placement_name)
+        self._lock = threading.Lock()
+        self._placement: Placement | None = None
+        self._have_placement = threading.Event()
+        self._nodes: dict = {}
+        self._sessions: dict[str, Session] = {}
+        self._unsub = self.placement_svc.watch(self._on_placement)
+
+    # the coordinator probes `"graphite" in db.namespaces`
+    @property
+    def namespaces(self):
+        return self._ns_names
+
+    @property
+    def bootstrapped(self) -> bool:
+        with self._lock:
+            return self._placement is not None
+
+    def _on_placement(self, p: Placement) -> None:
+        from ..net.client import RemoteNode
+
+        nodes = {}
+        for nid, inst in p.instances.items():
+            if not inst.endpoint:
+                continue
+            host, port = inst.endpoint.rsplit(":", 1)
+            nodes[nid] = RemoteNode(host, int(port), node_id=nid)
+        with self._lock:
+            old = self._nodes
+            self._placement = p
+            self._nodes = nodes
+            self._sessions.clear()
+        self._have_placement.set()
+        for node in old.values():
+            try:
+                node.close()
+            except Exception:
+                pass
+
+    def _session(self, ns: str) -> Session:
+        # a coordinator can come up before the operator writes the first
+        # placement (or before the watch's first delivery) — block briefly
+        # rather than failing ingest during boot
+        if not self._have_placement.wait(timeout=10.0):
+            raise RuntimeError("no placement yet (is the control plane up?)")
+        with self._lock:
+            if self._placement is None:
+                raise RuntimeError("no placement yet (is the control plane up?)")
+            sess = self._sessions.get(ns)
+            if sess is None:
+                sess = Session(
+                    topology=TopologyMap(self._placement),
+                    nodes=self._nodes,
+                    namespace=ns,
+                    write_consistency=self.write_consistency,
+                    read_consistency=self.read_consistency,
+                )
+                self._sessions[ns] = sess
+            return sess
+
+    # --- Database surface ---
+
+    def write(self, ns, sid, t, v, unit=Unit.SECOND):
+        return self._session(ns).write(sid, t, v, unit)
+
+    def write_tagged(self, ns, tags, t, v, unit=Unit.SECOND):
+        return self._session(ns).write_tagged(tags, t, v, unit)
+
+    def read(self, ns, sid, start, end):
+        return self._session(ns).fetch(sid, start, end)
+
+    def fetch_tagged(self, ns, query, start, end, limit=None):
+        return [
+            (sid, tags, dps)
+            for sid, tags, dps in self._session(ns).fetch_tagged(
+                query, start, end, limit=limit
+            )
+        ]
+
+    def query_ids(self, ns, query, start, end, limit=None):
+        class _Result:
+            pass
+
+        docs, exhaustive = self._session(ns).query_ids(query, start, end, limit=limit)
+
+        class _Doc:
+            __slots__ = ("id", "fields")
+
+            def __init__(self, did, fields):
+                self.id = did
+                self.fields = fields
+
+        r = _Result()
+        r.docs = [_Doc(did, fields) for did, fields in docs]
+        r.exhaustive = exhaustive
+        return r
+
+    def aggregate_query(self, ns, query, start, end, field_filter=None):
+        if query is None:  # "all docs" — the wire codec needs a real AST node
+            from ..index.query import AllQuery
+
+            query = AllQuery()
+        return self._session(ns).aggregate_query(
+            query, start, end, field_filter=field_filter
+        )
+
+    def close(self) -> None:
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+        with self._lock:
+            nodes = dict(self._nodes)
+            self._nodes.clear()
+            self._sessions.clear()
+        for node in nodes.values():
+            try:
+                node.close()
+            except Exception:
+                pass

@@ -66,16 +66,6 @@ class FailureDetector:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    # --- liveness math ---
-
-    def _live_ids(self) -> set[str]:
-        return {i.id for i in self.services.instances(self.service_name, live_only=True)}
-
-    def _known_ids(self) -> set[str]:
-        return {
-            i.id for i in self.services.instances(self.service_name, live_only=False)
-        }
-
     def _emit(self, ev: FailureEvent) -> None:
         self.events.append(ev)
         if self.on_event is not None:
@@ -85,14 +75,19 @@ class FailureDetector:
 
     def check(self, now: float | None = None) -> list[FailureEvent]:
         """Run one liveness pass; returns the events it produced."""
-        now = time.monotonic() if now is None else now
+        now = self.services.clock() if now is None else now
         produced: list[FailureEvent] = []
         with self._lock:
-            p = self.placement_svc.get()
+            p, p_version = self.placement_svc.get_versioned()
             placed = set(p.instances) if p is not None else set()
-            live = self._live_ids()
             timeout = self.services.heartbeat_timeout
-            for inst in self.services.instances(self.service_name, live_only=False):
+            # ONE discovery snapshot per pass (one bulk KV read) serves
+            # liveness, the dead scan, and spare endpoint lookup
+            all_insts = self.services.instances(self.service_name, live_only=False)
+            alive = {
+                i.id: i for i in all_insts if now - i.last_heartbeat < timeout
+            }
+            for inst in all_insts:
                 age = now - inst.last_heartbeat
                 if inst.id in self._dead:
                     if age < timeout:
@@ -108,18 +103,54 @@ class FailureDetector:
                 self._emit(ev)
                 produced.append(ev)
                 if self.auto_replace and p is not None:
+                    # a spare must be unplaced, LIVE, and advertised with an
+                    # endpoint — promoting a crashed spare would wedge the
+                    # cluster with unbootstrappable INITIALIZING shards
                     spare = next(
-                        (s for s in self.spares if s not in placed and s not in self._dead),
+                        (
+                            s
+                            for s in self.spares
+                            if s not in placed
+                            and s not in self._dead
+                            and s in alive
+                            and alive[s].endpoint
+                        ),
                         None,
                     )
                     if spare is not None:
-                        self.spares.remove(spare)
-                        replace_instance(p, inst.id, spare)
-                        self.placement_svc.set(p)
-                        placed = set(p.instances)
-                        rev = FailureEvent(inst.id, "replaced", replacement_id=spare)
-                        self._emit(rev)
-                        produced.append(rev)
+                        spare_ep = alive[spare].endpoint
+                        # CAS loop: a concurrent placement change (admin
+                        # add/remove via the coordinator's threaded HTTP
+                        # server) must not be clobbered by get→mutate→set.
+                        # replace errors ("already in placement") terminate
+                        # the loop — only CAS version conflicts retry.
+                        replaced = False
+                        while True:
+                            try:
+                                replace_instance(p, inst.id, spare)
+                            except ValueError:
+                                break  # another actor placed the spare
+                            p.instances[spare].endpoint = spare_ep
+                            try:
+                                p_version = self.placement_svc.check_and_set(p, p_version)
+                                replaced = True
+                                break
+                            except ValueError:
+                                p, p_version = self.placement_svc.get_versioned()
+                                if (
+                                    p is None
+                                    or inst.id not in p.instances
+                                    or spare in p.instances
+                                ):
+                                    break  # someone else handled it
+                        if replaced:
+                            self.spares.remove(spare)
+                            placed = set(p.instances)
+                            rev = FailureEvent(inst.id, "replaced", replacement_id=spare)
+                            self._emit(rev)
+                            produced.append(rev)
+                        else:
+                            placed = set(p.instances) if p is not None else placed
         return produced
 
     # --- background driver ---

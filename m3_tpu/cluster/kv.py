@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -28,6 +29,7 @@ class KVStore:
 
     def __init__(self, backing_path: str | None = None) -> None:
         self._lock = threading.RLock()
+        self._change = threading.Condition(self._lock)
         self._data: dict[str, VersionedValue] = {}
         self._watchers: dict[str, list[Callable[[VersionedValue], None]]] = {}
         self._path = backing_path
@@ -51,14 +53,18 @@ class KVStore:
         with self._lock:
             return self._data.get(key)
 
+    def _set_locked(self, key: str, value: Any):
+        cur = self._data.get(key)
+        version = (cur.version + 1) if cur else 1
+        vv = VersionedValue(version, value)
+        self._data[key] = vv
+        self._persist()
+        self._change.notify_all()
+        return version, vv, list(self._watchers.get(key, ()))
+
     def set(self, key: str, value: Any) -> int:
         with self._lock:
-            cur = self._data.get(key)
-            version = (cur.version + 1) if cur else 1
-            vv = VersionedValue(version, value)
-            self._data[key] = vv
-            self._persist()
-            watchers = list(self._watchers.get(key, ()))
+            version, vv, watchers = self._set_locked(key, value)
         for w in watchers:
             w(vv)
         return version
@@ -67,10 +73,14 @@ class KVStore:
         with self._lock:
             if key in self._data:
                 raise KeyError(f"key {key} already exists")
-        return self.set(key, value)
+            version, vv, watchers = self._set_locked(key, value)
+        for w in watchers:
+            w(vv)
+        return version
 
     def check_and_set(self, key: str, expect_version: int, value: Any) -> int:
-        """CAS (kv/types.go CheckAndSet): version 0 = must not exist."""
+        """CAS (kv/types.go CheckAndSet): version 0 = must not exist.
+        Check and write are atomic under the store lock."""
         with self._lock:
             cur = self._data.get(key)
             cur_version = cur.version if cur else 0
@@ -78,12 +88,48 @@ class KVStore:
                 raise ValueError(
                     f"version mismatch for {key}: have {cur_version}, want {expect_version}"
                 )
-        return self.set(key, value)
+            version, vv, watchers = self._set_locked(key, value)
+        for w in watchers:
+            w(vv)
+        return version
 
     def delete(self, key: str) -> None:
         with self._lock:
             self._data.pop(key, None)
             self._persist()
+            self._change.notify_all()
+
+    def keys(self, prefix: str = "") -> list[str]:
+        """Sorted keys under a prefix (etcd range-read role; service
+        discovery and topic listing scan by prefix)."""
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    def get_prefix(self, prefix: str = "") -> dict[str, VersionedValue]:
+        """Bulk range read: key → VersionedValue under a prefix in ONE call
+        (one RPC over the networked store — discovery and detector passes
+        must not pay a round trip per instance)."""
+        with self._lock:
+            return {
+                k: v for k, v in sorted(self._data.items()) if k.startswith(prefix)
+            }
+
+    def wait_for_version_gt(
+        self, key: str, after_version: int, timeout: float
+    ) -> VersionedValue | None:
+        """Block until key's version exceeds ``after_version`` (long-poll
+        watch primitive for the networked KV service). Returns the current
+        value immediately if already newer; None on timeout or deletion."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                cur = self._data.get(key)
+                if cur is not None and cur.version > after_version:
+                    return cur
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._change.wait(remaining)
 
     def watch(self, key: str, fn: Callable[[VersionedValue], None]) -> Callable[[], None]:
         """Register a watcher; returns an unsubscribe fn. Fires immediately

@@ -1,0 +1,191 @@
+"""Networked control plane: the KV store served over the wire protocol.
+
+Reference: /root/reference/src/cluster/kv/etcd/store.go:54 — every process
+in the reference reaches placements, namespaces, rules, topics, elections,
+and runtime config through etcd. Here the same role is played by a KVStore
+served over the framework's framed-RPC protocol (net/wire): one process
+(standalone ``services.kvnode`` binary, or embedded in a dbnode seed node)
+owns the store; every other process speaks to it through ``RemoteKVStore``,
+which implements the exact KVStore interface — get/set/CAS/delete/keys plus
+watches — so PlacementService, Services, TopicService, RuleStore, and
+LeaderElection run unchanged against a remote control plane.
+
+Watches are long-polls: the client asks "anything newer than version V?"
+and the server blocks on the store's condition variable until there is
+(etcd watch semantics without a push channel; at-least-once delivery, and
+a watcher can never miss a final state because it always re-reads the
+current version).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..net.client import RpcClient
+from ..net.server import RpcServer
+from .kv import KVStore, VersionedValue
+
+WATCH_POLL_TIMEOUT = 30.0
+
+
+class KVService:
+    """Dispatch table over a KVStore (the server side)."""
+
+    def __init__(self, store: KVStore) -> None:
+        self.store = store
+
+    def handle(self, req: dict):
+        op = req.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown op {op!r}")
+        return fn(req)
+
+    def op_health(self, req):
+        return {"role": "kv", "keys": len(self.store.keys())}
+
+    def op_kv_get(self, req):
+        vv = self.store.get(req["key"])
+        return None if vv is None else {"version": vv.version, "value": vv.value}
+
+    def op_kv_set(self, req):
+        return self.store.set(req["key"], req["value"])
+
+    def op_kv_cas(self, req):
+        return self.store.check_and_set(req["key"], req["expect"], req["value"])
+
+    def op_kv_set_if_not_exists(self, req):
+        return self.store.set_if_not_exists(req["key"], req["value"])
+
+    def op_kv_delete(self, req):
+        self.store.delete(req["key"])
+        return True
+
+    def op_kv_keys(self, req):
+        return self.store.keys(req.get("prefix", ""))
+
+    def op_kv_get_prefix(self, req):
+        return [
+            [k, vv.version, vv.value]
+            for k, vv in self.store.get_prefix(req.get("prefix", "")).items()
+        ]
+
+    def op_kv_watch(self, req):
+        """Long-poll: block until key's version > after, or timeout."""
+        timeout = min(float(req.get("timeout", WATCH_POLL_TIMEOUT)), 120.0)
+        vv = self.store.wait_for_version_gt(req["key"], req["after"], timeout)
+        return None if vv is None else {"version": vv.version, "value": vv.value}
+
+
+class KVServer(RpcServer):
+    """TCP front end for a KVService."""
+
+    def __init__(self, store: KVStore | None = None, host: str = "127.0.0.1", port: int = 0):
+        self.store = store or KVStore()
+        super().__init__(KVService(self.store), host=host, port=port)
+
+
+class RemoteKVStore(RpcClient):
+    """Client-side kv.Store: same interface as KVStore, state lives in the
+    KV server process. Watches run on a dedicated long-poll thread per key
+    (its own connection, so data-plane calls never queue behind a poll)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+        super().__init__(host, port, pool_size=2, timeout=timeout)
+        self._watch_stops: list[threading.Event] = []
+
+    @classmethod
+    def connect(cls, endpoint: str, timeout: float = 10.0) -> "RemoteKVStore":
+        host, port = endpoint.rsplit(":", 1)
+        return cls(host, int(port), timeout=timeout)
+
+    # -- kv.Store surface --
+
+    def get(self, key: str) -> VersionedValue | None:
+        r = self._call("kv_get", key=key)
+        return None if r is None else VersionedValue(r["version"], r["value"])
+
+    def set(self, key: str, value) -> int:
+        return self._call("kv_set", key=key, value=value)
+
+    def set_if_not_exists(self, key: str, value) -> int:
+        # remote KeyError arrives as RemoteError(etype="KeyError"); re-raise
+        # the local type so callers' except clauses work unchanged
+        from .kv import KVStore as _  # noqa: F401  (doc anchor)
+        from ..net.client import RemoteError
+
+        try:
+            return self._call("kv_set_if_not_exists", key=key, value=value)
+        except RemoteError as exc:
+            if exc.etype == "KeyError":
+                raise KeyError(str(exc)) from exc
+            raise
+
+    def check_and_set(self, key: str, expect_version: int, value) -> int:
+        from ..net.client import RemoteError
+
+        try:
+            return self._call("kv_cas", key=key, expect=expect_version, value=value)
+        except RemoteError as exc:
+            if exc.etype == "ValueError":
+                raise ValueError(str(exc)) from exc
+            raise
+
+    def delete(self, key: str) -> None:
+        self._call("kv_delete", key=key)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        return self._call("kv_keys", prefix=prefix)
+
+    def get_prefix(self, prefix: str = "") -> dict[str, VersionedValue]:
+        return {
+            k: VersionedValue(ver, val)
+            for k, ver, val in self._call("kv_get_prefix", prefix=prefix)
+        }
+
+    def watch(self, key: str, fn) -> callable:
+        """Fire fn(VersionedValue) on every version the poll observes,
+        starting with the current value if the key exists. Returns an
+        unsubscribe callable. Poll errors back off and retry — a watch
+        survives a KV server restart (backed stores reload their state)."""
+        stop = threading.Event()
+        self._watch_stops.append(stop)
+        poller = RpcClient(self.host, self.port, pool_size=1, timeout=self.timeout)
+
+        def loop() -> None:
+            last = 0
+            while not stop.is_set():
+                try:
+                    r = poller._call(
+                        "kv_watch",
+                        key=key,
+                        after=last,
+                        timeout=WATCH_POLL_TIMEOUT,
+                        _timeout=WATCH_POLL_TIMEOUT + 5.0,
+                    )
+                except Exception:
+                    stop.wait(0.2)
+                    continue
+                if stop.is_set():
+                    break
+                if r is None:
+                    continue  # poll timeout; re-ask
+                last = r["version"]
+                try:
+                    fn(VersionedValue(r["version"], r["value"]))
+                except Exception:
+                    pass  # a watcher callback must not kill the poll loop
+
+        t = threading.Thread(target=loop, daemon=True, name=f"kv-watch-{key}")
+        t.start()
+
+        def unsub() -> None:
+            stop.set()
+            poller.close()
+
+        return unsub
+
+    def close(self) -> None:
+        for stop in self._watch_stops:
+            stop.set()
+        super().close()

@@ -254,8 +254,16 @@ class PlacementService:
         vv = self.kv.get(self.key)
         return Placement.from_dict(vv.value) if vv else None
 
+    def get_versioned(self) -> tuple[Placement | None, int]:
+        """Placement plus its KV version, for CAS mutation loops."""
+        vv = self.kv.get(self.key)
+        return (Placement.from_dict(vv.value), vv.version) if vv else (None, 0)
+
     def set(self, p: Placement) -> int:
         return self.kv.set(self.key, p.to_dict())
+
+    def check_and_set(self, p: Placement, expect_version: int) -> int:
+        return self.kv.check_and_set(self.key, expect_version, p.to_dict())
 
     def watch(self, fn) -> callable:
         return self.kv.watch(self.key, lambda vv: fn(Placement.from_dict(vv.value)))

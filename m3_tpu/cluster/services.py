@@ -5,11 +5,15 @@ Reference: /root/reference/src/cluster/services/ — advertise+watch instances
 (services/leader wrapping etcd concurrency primitives; the aggregator's
 election_mgr.go campaigns through it, and the coordinator's in-process
 downsampler uses a local stub leader_local.go — which this also covers).
+
+All state lives in the KV store — point Services at a RemoteKVStore and
+advertisement/heartbeats/liveness work across real processes, exactly as
+the reference's etcd heartbeat store does. Heartbeats are wall-clock
+timestamps written into the instance record; liveness is derived by age.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 
@@ -21,41 +25,74 @@ class ServiceInstance:
     id: str
     endpoint: str
     zone: str = "embedded"
-    last_heartbeat: float = field(default_factory=time.monotonic)
+    last_heartbeat: float = field(default_factory=time.time)
 
 
 class Services:
-    """Advertise + watch + heartbeat liveness."""
+    """Advertise + watch + heartbeat liveness (KV-backed)."""
 
-    def __init__(self, kv: KVStore, heartbeat_timeout: float = 10.0) -> None:
+    PREFIX = "_services/"
+
+    def __init__(self, kv: KVStore, heartbeat_timeout: float = 10.0, clock=time.time) -> None:
         self.kv = kv
         self.heartbeat_timeout = heartbeat_timeout
-        self._lock = threading.RLock()
-        self._instances: dict[str, dict[str, ServiceInstance]] = {}
+        self.clock = clock
+        # instances advertised BY THIS PROCESS: id → (service, endpoint, zone)
+        # so heartbeat() is a single KV set, not get+set
+        self._own: dict[tuple[str, str], tuple[str, str]] = {}
+
+    def _key(self, service: str, instance_id: str) -> str:
+        return f"{self.PREFIX}{service}/{instance_id}"
 
     def advertise(self, service: str, instance: ServiceInstance) -> None:
-        with self._lock:
-            self._instances.setdefault(service, {})[instance.id] = instance
-        self.kv.set(f"_services/{service}/{instance.id}", instance.endpoint)
+        self._own[(service, instance.id)] = (instance.endpoint, instance.zone)
+        self.kv.set(
+            self._key(service, instance.id),
+            {"endpoint": instance.endpoint, "zone": instance.zone, "hb": self.clock()},
+        )
 
     def heartbeat(self, service: str, instance_id: str) -> None:
-        with self._lock:
-            inst = self._instances.get(service, {}).get(instance_id)
-            if inst:
-                inst.last_heartbeat = time.monotonic()
+        own = self._own.get((service, instance_id))
+        if own is not None:
+            endpoint, zone = own
+        else:
+            vv = self.kv.get(self._key(service, instance_id))
+            if vv is None:
+                return
+            endpoint, zone = vv.value["endpoint"], vv.value.get("zone", "embedded")
+        self.kv.set(
+            self._key(service, instance_id),
+            {"endpoint": endpoint, "zone": zone, "hb": self.clock()},
+        )
 
     def unadvertise(self, service: str, instance_id: str) -> None:
-        with self._lock:
-            self._instances.get(service, {}).pop(instance_id, None)
-        self.kv.delete(f"_services/{service}/{instance_id}")
+        self._own.pop((service, instance_id), None)
+        self.kv.delete(self._key(service, instance_id))
 
     def instances(self, service: str, live_only: bool = True) -> list[ServiceInstance]:
-        now = time.monotonic()
-        with self._lock:
-            out = list(self._instances.get(service, {}).values())
-        if live_only:
-            out = [i for i in out if now - i.last_heartbeat < self.heartbeat_timeout]
+        now = self.clock()
+        prefix = f"{self.PREFIX}{service}/"
+        out = []
+        # one bulk range read (one RPC on the networked store)
+        for key, vv in self.kv.get_prefix(prefix).items():
+            rec = vv.value
+            inst = ServiceInstance(
+                key[len(prefix):], rec["endpoint"], rec.get("zone", "embedded"),
+                rec.get("hb", 0.0),
+            )
+            if live_only and now - inst.last_heartbeat >= self.heartbeat_timeout:
+                continue
+            out.append(inst)
         return sorted(out, key=lambda i: i.id)
+
+    # test hook: age an instance's heartbeat (fault injection without sleeping)
+    def _backdate(self, service: str, instance_id: str, secs: float) -> None:
+        key = self._key(service, instance_id)
+        vv = self.kv.get(key)
+        if vv is not None:
+            rec = dict(vv.value)
+            rec["hb"] = rec.get("hb", 0.0) - secs
+            self.kv.set(key, rec)
 
 
 class LeaderElection:

@@ -26,23 +26,23 @@ class RemoteError(RuntimeError):
         self.etype = etype
 
 
-class RemoteNode:
+class RpcClient:
+    """Generic pooled request/response client over the wire framing; the
+    base for RemoteNode (data plane) and RemoteKVStore (control plane)."""
+
     def __init__(
         self,
         host: str,
         port: int,
-        node_id: str | None = None,
         pool_size: int = 4,
         timeout: float = 10.0,
     ) -> None:
         self.host = host
         self.port = port
-        self.id = node_id or f"{host}:{port}"
         self.timeout = timeout
         self._pool: list[socket.socket] = []
         self._pool_lock = threading.Lock()
         self._pool_size = pool_size
-        self._shards_cache: tuple[float, set[int]] | None = None
 
     # -- connection pool --
 
@@ -70,22 +70,40 @@ class RemoteNode:
                 sock.close()
             self._pool.clear()
 
-    def _call(self, op: str, _retry: bool = True, **args):
+    def _call(self, op: str, _retry: bool = True, _timeout: float | None = None, **args):
         req = {"op": op, **args}
         sock = self._acquire()
         try:
+            if _timeout is not None:
+                sock.settimeout(_timeout)
             wire.send_frame(sock, req)
             resp = wire.recv_frame(sock)
+            if _timeout is not None:
+                sock.settimeout(self.timeout)
         except (ConnectionError, OSError, ValueError):
             sock.close()
             if _retry:
                 # one retry on a fresh connection (stale pooled socket)
-                return self._call(op, _retry=False, **args)
+                return self._call(op, _retry=False, _timeout=_timeout, **args)
             raise
         self._release(sock)
         if not resp.get("ok"):
             raise RemoteError(resp.get("etype", ""), resp.get("error", "remote error"))
         return resp.get("result")
+
+
+class RemoteNode(RpcClient):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        node_id: str | None = None,
+        pool_size: int = 4,
+        timeout: float = 10.0,
+    ) -> None:
+        super().__init__(host, port, pool_size=pool_size, timeout=timeout)
+        self.id = node_id or f"{host}:{port}"
+        self._shards_cache: tuple[float, set[int]] | None = None
 
     # -- node surface (mirrors testing/cluster.Node) --
 
@@ -144,6 +162,17 @@ class RemoteNode:
             end=end,
             limit=limit,
         )
+
+    def aggregate_query(self, ns, query, start, end, field_filter=None):
+        out = self._call(
+            "aggregate_query",
+            ns=ns,
+            query=wire.query_to_wire(query),
+            start=start,
+            end=end,
+            field_filter=[bytes(f) for f in field_filter] if field_filter else None,
+        )
+        return {bytes(k): {bytes(v) for v in vs} for k, vs in out}
 
     def stream_shard(self, ns, shard):
         return wire.series_from_wire(self._call("stream_shard", ns=ns, shard=shard))

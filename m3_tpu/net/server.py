@@ -82,9 +82,18 @@ class NodeService:
             req["ns"], q, req["start"], req["end"], limit=req.get("limit")
         )
         return {
-            "ids": [d.id for d in result.docs],
+            "docs": [[d.id, [[k, v] for k, v in d.fields]] for d in result.docs],
             "exhaustive": result.exhaustive,
         }
+
+    def op_aggregate_query(self, req):
+        q = wire.query_from_wire(req["query"])
+        ff = req.get("field_filter")
+        agg = self.db.aggregate_query(
+            req["ns"], q, req["start"], req["end"],
+            field_filter=[bytes(f) for f in ff] if ff else None,
+        )
+        return [[k, sorted(vs)] for k, vs in agg.items()]
 
     def op_stream_shard(self, req):
         return wire.series_to_wire(self.db.stream_shard(req["ns"], req["shard"]))
@@ -117,10 +126,13 @@ class NodeService:
         return True
 
 
-class NodeServer:
-    """TCP front end for a NodeService."""
+class RpcServer:
+    """Threaded TCP front end for any service exposing handle(req)->result.
 
-    def __init__(self, service: NodeService, host: str = "127.0.0.1", port: int = 0):
+    Serves the data plane (NodeService) and the control plane (cluster KV
+    service) over the same framing."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
         self.service = service
         svc = service
 
@@ -169,3 +181,7 @@ class NodeServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+
+class NodeServer(RpcServer):
+    """TCP front end for a NodeService."""

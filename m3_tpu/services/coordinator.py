@@ -203,7 +203,6 @@ class Coordinator:
     def series(self, match_exprs: list[str], start_nanos: int, end_nanos: int):
         """/api/v1/series (api/v1/handler/prometheus/native + remote in the
         reference): label sets of series matching any selector."""
-        ns = self.db.namespaces[self.namespace]
         if not match_exprs:
             # prometheus requires at least one selector; an unbounded full
             # index dump would bypass the cost limits
@@ -212,7 +211,7 @@ class Coordinator:
         limit = None
         if self.engine.limits is not None and self.engine.limits.max_series:
             limit = self.engine.limits.max_series
-        result = ns.index.query(q, start_nanos, end_nanos, limit=limit)
+        result = self.db.query_ids(self.namespace, q, start_nanos, end_nanos, limit=limit)
         return [
             {k.decode(): v.decode() for k, v in doc.fields}
             for doc in result.docs
@@ -222,11 +221,10 @@ class Coordinator:
                limit: int | None = None):
         """/api/v1/search (api/v1/handler/search.go): series IDs + tags
         matching the given selectors."""
-        ns = self.db.namespaces[self.namespace]
         if not match_exprs:
             raise ValueError("search requires at least one match[]")
         q = self._index_query(match_exprs)
-        result = ns.index.query(q, start_nanos, end_nanos, limit=limit)
+        result = self.db.query_ids(self.namespace, q, start_nanos, end_nanos, limit=limit)
         return [
             {
                 "id": doc.id.decode("utf-8", "replace"),
@@ -254,17 +252,15 @@ class Coordinator:
 
     def labels(self, match_exprs: list[str] | None = None,
                start_nanos: int = 0, end_nanos: int = 2**62) -> list[str]:
-        ns = self.db.namespaces[self.namespace]
         q = self._index_query(match_exprs or [])
-        agg = ns.index.aggregate_query(q, start_nanos, end_nanos)
+        agg = self.db.aggregate_query(self.namespace, q, start_nanos, end_nanos)
         return sorted(k.decode() for k in agg)
 
     def label_values(self, name: str, match_exprs: list[str] | None = None,
                      start_nanos: int = 0, end_nanos: int = 2**62) -> list[str]:
-        ns = self.db.namespaces[self.namespace]
         q = self._index_query(match_exprs or [])
-        agg = ns.index.aggregate_query(
-            q, start_nanos, end_nanos, field_filter=[name.encode()]
+        agg = self.db.aggregate_query(
+            self.namespace, q, start_nanos, end_nanos, field_filter=[name.encode()]
         )
         return sorted(v.decode() for v in agg.get(name.encode(), ()))
 
@@ -633,6 +629,26 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--base-dir", default=None)
     p.add_argument("--namespace", default=None)
+    p.add_argument(
+        "--kv-endpoint",
+        default="",
+        help="host:port of the control-plane KV server: admin APIs "
+        "(placement/topic/rules) operate on the shared control plane",
+    )
+    p.add_argument(
+        "--cluster",
+        action="store_true",
+        help="route the data plane through the placement to dbnode "
+        "processes (requires --kv-endpoint) instead of embedding storage",
+    )
+    p.add_argument(
+        "--failure-detector",
+        action="store_true",
+        help="run the liveness→auto-replace loop in this coordinator "
+        "(requires --kv-endpoint); spares via --spare",
+    )
+    p.add_argument("--spare", action="append", default=[])
+    p.add_argument("--heartbeat-timeout", type=float, default=10.0)
     args = p.parse_args(argv)
 
     cfg = load_config(CoordinatorConfig, args.config) if args.config else CoordinatorConfig()
@@ -641,8 +657,20 @@ def main(argv=None) -> int:
     base_dir = args.base_dir if args.base_dir is not None else (cfg.base_dir or None)
     namespace = args.namespace if args.namespace is not None else cfg.namespace
 
+    kv = None
+    if args.kv_endpoint:
+        from ..cluster.kv_service import RemoteKVStore
+
+        kv = RemoteKVStore.connect(args.kv_endpoint)
+
     db = None
-    if base_dir:
+    if args.cluster:
+        if kv is None:
+            p.error("--cluster requires --kv-endpoint")
+        from ..client.session_db import SessionDatabase
+
+        db = SessionDatabase(kv, namespaces=(namespace,))
+    elif base_dir:
         db = Database(base_dir, num_shards=cfg.num_shards)
         db.create_namespace(namespace, NamespaceOptions())
         db.bootstrap()
@@ -652,8 +680,23 @@ def main(argv=None) -> int:
             max_series=cfg.limits.max_series,
             max_datapoints=cfg.limits.max_datapoints,
         )
-    coord = Coordinator(db=db, namespace=namespace, query_limits=limits)
+    coord = Coordinator(db=db, namespace=namespace, query_limits=limits, kv=kv)
     server, bound = serve(coord, port, host=host)
+
+    detector = None
+    if args.failure_detector:
+        if kv is None:
+            p.error("--failure-detector requires --kv-endpoint")
+        from ..cluster.failure import FailureDetector
+        from ..cluster.services import Services
+
+        detector = FailureDetector(
+            Services(kv, heartbeat_timeout=args.heartbeat_timeout),
+            coord.placement_svc,
+            grace=args.heartbeat_timeout / 2.0,
+            spares=list(args.spare),
+        )
+        detector.start(interval=max(args.heartbeat_timeout / 4.0, 0.1))
 
     def shutdown(signum, frame):
         raise SystemExit(0)
@@ -667,8 +710,12 @@ def main(argv=None) -> int:
         # a signal raises SystemExit.
         threading.Event().wait()
     finally:
+        if detector is not None:
+            detector.stop()
         server.shutdown()
         coord.db.close()
+        if kv is not None:
+            kv.close()
     return 0
 
 

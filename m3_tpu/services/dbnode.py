@@ -37,6 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-cold-writes", action="store_true")
     p.add_argument("--no-mediator", action="store_true")
     p.add_argument("--no-bootstrap", action="store_true")
+    p.add_argument(
+        "--kv-endpoint",
+        default="",
+        help="host:port of the control-plane KV server; enables dynamic "
+        "topology: the node advertises itself, heartbeats, watches its "
+        "placement, and peers-bootstraps gained shards",
+    )
+    p.add_argument("--heartbeat-timeout", type=float, default=10.0)
     return p
 
 
@@ -62,6 +70,38 @@ def main(argv=None) -> int:
     service = NodeService(db, node_id=args.node_id, assigned_shards=shards)
     server = NodeServer(service, host=args.host, port=args.port)
 
+    # dynamic topology via the networked control plane
+    # (server.go: embedded etcd + topology watch + KV runtime reconfig)
+    kv = cluster_db = None
+    hb_stop = None
+    if args.kv_endpoint:
+        import threading
+
+        from ..cluster.kv_service import RemoteKVStore
+        from ..cluster.placement import PlacementService
+        from ..cluster.services import ServiceInstance, Services
+        from ..storage.cluster_db import ClusterDatabase
+
+        kv = RemoteKVStore.connect(args.kv_endpoint)
+        services = Services(kv, heartbeat_timeout=args.heartbeat_timeout)
+        endpoint = f"{server.host}:{server.port}"
+        services.advertise("m3db", ServiceInstance(args.node_id, endpoint))
+        hb_stop = threading.Event()
+
+        def hb_loop() -> None:
+            interval = max(args.heartbeat_timeout / 3.0, 0.05)
+            while not hb_stop.wait(interval):
+                try:
+                    services.heartbeat("m3db", args.node_id)
+                except Exception:
+                    pass  # KV hiccups must not kill the node
+
+        threading.Thread(target=hb_loop, daemon=True, name="heartbeat").start()
+        cluster_db = ClusterDatabase(
+            db, args.node_id, PlacementService(kv), node_service=service
+        )
+        cluster_db.start()
+
     def shutdown(signum, frame):
         # SystemExit propagates out of serve_forever's select loop; the
         # finally block below closes the database cleanly
@@ -74,6 +114,12 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        if hb_stop is not None:
+            hb_stop.set()
+        if cluster_db is not None:
+            cluster_db.stop()
+        if kv is not None:
+            kv.close()
         if mediator is not None:
             mediator.stop()
         db.close()

@@ -14,6 +14,8 @@ field values.
 
 from __future__ import annotations
 
+import math
+
 PRECISION_NANOS = {
     "ns": 1,
     "u": 1_000,
@@ -115,9 +117,14 @@ def parse_line(line: str):
             fields[key] = float(int(raw[:-1]))
         else:
             try:
-                fields[key] = float(raw)
+                val = float(raw)
             except ValueError:
                 raise LineProtocolError(f"bad field value: {raw!r}")
+            if not math.isfinite(val):
+                # line protocol has no literal for nan/inf; '1e999' etc.
+                # overflow to inf and must be rejected, not ingested
+                raise LineProtocolError(f"non-finite field value: {raw!r}")
+            fields[key] = val
     if not fields:
         raise LineProtocolError("no fields")
     return measurement, tags, fields, ts

@@ -1,0 +1,43 @@
+"""Control-plane KV server binary (the framework's etcd).
+
+Reference: /root/reference/src/cluster/kv/etcd/ + the embedded etcd a
+dbnode seed node runs (src/dbnode/server/server.go:266-324). Run:
+
+    python -m m3_tpu.services.kvnode --port 2379 [--backing /path/state.json]
+
+Prints ``LISTENING <host> <port>`` once serving. With ``--backing`` the
+store is durable across restarts (etcd persistence role).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from ..cluster.kv import KVStore
+from ..cluster.kv_service import KVServer
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="m3tpu-kvnode", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--backing", default=None, help="JSON file for durability")
+    args = p.parse_args(argv)
+
+    server = KVServer(KVStore(backing_path=args.backing), host=args.host, port=args.port)
+
+    def shutdown(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    print(f"LISTENING {server.host} {server.port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""Cluster-aware node runtime: placement watch → shard assignment → peers
+bootstrap, inside the node process.
+
+Reference: /root/reference/src/dbnode/storage/cluster/database.go — the
+clusterDatabase wraps a storage database, watches the dynamic topology
+(src/dbnode/topology/dynamic.go:107), and on placement change calls
+db.AssignShardSet (src/dbnode/storage/database.go:386), which triggers a
+bootstrap of the gained shards; the peers bootstrapper then streams those
+shards' data from replicas (bootstrapper/peers/source.go:117). Once a
+gained shard's data is in, the node marks it AVAILABLE through the
+placement service CAS so the source's LEAVING shard is dropped
+(placement/service MarkShardsAvailable).
+
+Here the same loop runs over the networked control plane: the placement
+arrives through a (Remote)KVStore watch; peers are reached through the
+socket data plane (net.client.RemoteNode) using the endpoints recorded in
+the placement instances.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..cluster.placement import Placement, PlacementService, ShardState
+from ..utils.instrument import DEFAULT as METRICS
+
+
+def _default_peer_factory(endpoint: str):
+    from ..net.client import RemoteNode
+
+    host, port = endpoint.rsplit(":", 1)
+    return RemoteNode(host, int(port))
+
+
+class ClusterDatabase:
+    """Watch placement; apply shard ownership; peers-bootstrap gained shards.
+
+    ``node_service`` is the RPC dispatch object whose ``assigned_shards``
+    gates reads; ``db`` is the storage Database written into during peer
+    streaming.
+    """
+
+    def __init__(
+        self,
+        db,
+        node_id: str,
+        placement_svc: PlacementService,
+        node_service=None,
+        peer_factory=_default_peer_factory,
+        on_bootstrapped=None,
+        retry_secs: float = 2.0,
+    ) -> None:
+        self.db = db
+        self.node_id = node_id
+        self.placement_svc = placement_svc
+        self.node_service = node_service
+        self.peer_factory = peer_factory
+        self.on_bootstrapped = on_bootstrapped
+        self.retry_secs = retry_secs
+        self._lock = threading.Lock()
+        self._bootstrapping: set[int] = set()
+        self._stopped = threading.Event()
+        self._unsub = None
+
+    def start(self) -> None:
+        self._stopped.clear()
+        self._unsub = self.placement_svc.watch(self._on_placement)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+
+    # -- placement reaction --
+
+    def _on_placement(self, p: Placement) -> None:
+        inst = p.instances.get(self.node_id)
+        shards = set(inst.shards) if inst else set()
+        if self.node_service is not None:
+            self.node_service.assigned_shards = shards
+        if inst is None:
+            return
+        with self._lock:
+            gained = [
+                (s, a)
+                for s, a in inst.shards.items()
+                if a.state == ShardState.INITIALIZING and s not in self._bootstrapping
+            ]
+            self._bootstrapping.update(s for s, _ in gained)
+        if gained:
+            # streaming can take a while; never block the watch thread
+            threading.Thread(
+                target=self._bootstrap_gained, args=(p, gained), daemon=True,
+                name=f"peers-bootstrap-{self.node_id}",
+            ).start()
+
+    # -- peers bootstrap for gained INITIALIZING shards --
+
+    def _stream_sources(self, p: Placement, shard: int, preferred: str | None):
+        """Candidate peers ordered: preferred source first (the leaving
+        instance during a handoff, if still up), then AVAILABLE replicas."""
+        ordered = []
+        if preferred and preferred in p.instances:
+            ordered.append(p.instances[preferred])
+        for inst in p.instances.values():
+            a = inst.shards.get(shard)
+            if inst.id in (self.node_id, preferred) or a is None:
+                continue
+            if a.state in (ShardState.AVAILABLE, ShardState.LEAVING):
+                ordered.append(inst)
+        return [i for i in ordered if i.endpoint]
+
+    def _bootstrap_gained(self, p: Placement, gained) -> None:
+        done: list[int] = []
+        failed = False
+        for shard, a in gained:
+            ok = self._stream_one_shard(p, shard, a.source_instance)
+            if ok:
+                done.append(shard)
+            else:
+                failed = True
+            with self._lock:
+                self._bootstrapping.discard(shard)
+        if done:
+            self._mark_available(done)
+            METRICS.counter("peers_bootstrap_shards_total").inc(len(done))
+            if self.on_bootstrapped is not None:
+                self.on_bootstrapped(done)
+        if failed and not self._stopped.is_set():
+            # a transiently unreachable source must not wedge the shard in
+            # INITIALIZING until some unrelated placement write: re-drive
+            # the current placement after a backoff (bootstrap retry loop,
+            # bootstrap.go's repeated-attempt semantics)
+            def _retry() -> None:
+                if self._stopped.wait(self.retry_secs):
+                    return
+                try:
+                    cur = self.placement_svc.get()
+                except Exception:
+                    cur = None
+                if cur is not None:
+                    self._on_placement(cur)
+
+            threading.Thread(
+                target=_retry, daemon=True,
+                name=f"peers-bootstrap-retry-{self.node_id}",
+            ).start()
+
+    def _stream_one_shard(self, p: Placement, shard: int, preferred) -> bool:
+        for src in self._stream_sources(p, shard, preferred):
+            try:
+                peer = self.peer_factory(src.endpoint)
+            except Exception:
+                continue
+            try:
+                for ns_name in list(self.db.namespaces):
+                    for sid, tags, dps in peer.stream_shard(ns_name, shard):
+                        for dp in dps:
+                            if tags:
+                                self.db.write_tagged(
+                                    ns_name, tags, dp.timestamp, dp.value, dp.unit
+                                )
+                            else:
+                                self.db.write(
+                                    ns_name, sid, dp.timestamp, dp.value, dp.unit
+                                )
+                return True
+            except Exception:
+                continue  # dead/unreachable peer: try the next replica
+            finally:
+                try:
+                    peer.close()
+                except Exception:
+                    pass
+        # no reachable source: a brand-new cluster's shards have no data to
+        # stream — claiming the shard empty matches the reference's
+        # uninitialized_topology bootstrapper (no other replica has data)
+        return not any(
+            inst.shards.get(shard) is not None
+            and inst.shards[shard].state in (ShardState.AVAILABLE, ShardState.LEAVING)
+            for inst in p.instances.values()
+            if inst.id != self.node_id
+        )
+
+    def _mark_available(self, shards: list[int]) -> None:
+        from ..cluster.placement import mark_shards_available
+
+        while True:
+            cur, version = self.placement_svc.get_versioned()
+            if cur is None or self.node_id not in cur.instances:
+                return
+            mark_shards_available(cur, self.node_id, shards)
+            try:
+                self.placement_svc.check_and_set(cur, version)
+                return
+            except ValueError:
+                continue  # placement moved; re-read and re-apply

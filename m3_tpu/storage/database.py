@@ -429,6 +429,18 @@ class Database:
             raise RuntimeError(f"namespace {ns} has no index")
         return namespace.index.query(query, start, end, limit=limit)
 
+    def aggregate_query(
+        self, ns: str, query, start: int, end: int, field_filter=None
+    ):
+        """AggregateQuery (storage/index.go:1218): distinct field names →
+        values over matched docs (labels / label-values endpoints)."""
+        namespace = self.namespaces[ns]
+        if namespace.index is None:
+            raise RuntimeError(f"namespace {ns} has no index")
+        return namespace.index.aggregate_query(
+            query, start, end, field_filter=field_filter
+        )
+
     def fetch_tagged(
         self, ns: str, query, start: int, end: int, limit: int | None = None
     ) -> list[tuple[bytes, tuple, list[Datapoint]]]:

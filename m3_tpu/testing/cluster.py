@@ -52,10 +52,10 @@ class Node:
             raise ConnectionError(f"{self.id} down")
         return self.db.write_tagged(ns, tags, t, v, unit)
 
-    def fetch_tagged(self, ns, query, start, end):
+    def fetch_tagged(self, ns, query, start, end, limit=None):
         if not self.is_up:
             raise ConnectionError(f"{self.id} down")
-        return self.db.fetch_tagged(ns, query, start, end)
+        return self.db.fetch_tagged(ns, query, start, end, limit=limit)
 
     def read(self, ns, sid, start, end):
         if not self.is_up:
@@ -69,6 +69,20 @@ class Node:
 
     def owned_shards(self) -> set[int]:
         return self.assigned_shards
+
+    def query_ids(self, ns, query, start, end, limit=None):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        result = self.db.query_ids(ns, query, start, end, limit=limit)
+        return {
+            "docs": [[d.id, list(d.fields)] for d in result.docs],
+            "exhaustive": result.exhaustive,
+        }
+
+    def aggregate_query(self, ns, query, start, end, field_filter=None):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        return self.db.aggregate_query(ns, query, start, end, field_filter=field_filter)
 
     def stream_shard(self, ns, shard):
         """Peer streaming: all (sid, tags, datapoints) owned by one shard."""

@@ -1,26 +1,85 @@
-"""Multi-process cluster fixture: real node processes on localhost sockets.
+"""Multi-process cluster fixture: real node processes on localhost sockets,
+coordinated through a real networked control plane.
 
 Reference: /root/reference/src/dbnode/integration + dtest — the reference's
-integration tier runs real node binaries against each other. Here each node
-is a `python -m m3_tpu.services.dbnode` subprocess serving the net RPC
-protocol; the Session speaks sockets via net.client.RemoteNode, so quorum /
-node-down behavior crosses real serialization + process boundaries.
+integration tier runs real node binaries against each other with etcd (or a
+fake) as the control plane. Here:
+
+- one `python -m m3_tpu.services.kvnode` subprocess is the control plane
+  (etcd's role);
+- each node is a `python -m m3_tpu.services.dbnode --kv-endpoint ...`
+  subprocess that advertises itself, heartbeats, watches the placement and
+  peers-bootstraps gained shards — the fixture never pushes shard
+  assignments; it only writes the placement into the KV, exactly like an
+  operator using the placement API.
 """
 
 from __future__ import annotations
 
 import os
+import queue as _queue
 import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from dataclasses import dataclass, field
 
 from ..client.session import Session
-from ..cluster.placement import build_initial_placement
+from ..cluster.kv_service import RemoteKVStore
+from ..cluster.placement import PlacementService, build_initial_placement
 from ..cluster.topology import ConsistencyLevel, TopologyMap
 from ..net.client import RemoteNode
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+
+
+def _spawn_listening(cmd: list[str], what: str, timeout: float = 60.0):
+    """Start a subprocess that prints LISTENING <host> <port>; returns
+    (proc, host, port)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=_REPO_ROOT,
+    )
+    # a reader thread owns the (buffered) pipe; the main thread waits on a
+    # queue with a deadline, so a child hanging before LISTENING (or a line
+    # already sitting in the TextIOWrapper buffer, which select(2) on the
+    # raw fd cannot see) can neither block nor be missed
+    lines: _queue.Queue = _queue.Queue()
+
+    def _pump():
+        for ln in proc.stdout:
+            lines.put(ln)
+        lines.put(None)
+
+    threading.Thread(target=_pump, daemon=True).start()
+    deadline = time.time() + timeout
+    line = ""
+    while True:
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            proc.kill()
+            raise TimeoutError(f"{what} did not start: {line!r}")
+        try:
+            item = lines.get(timeout=min(remaining, 1.0))
+        except _queue.Empty:
+            if proc.poll() is not None:
+                raise RuntimeError(f"{what} died at startup")
+            continue
+        if item is None:
+            raise RuntimeError(f"{what} died at startup")
+        line = item
+        if line.startswith("LISTENING"):
+            break
+    _, host, port_s = line.split()
+    return proc, host, int(port_s)
 
 
 @dataclass
@@ -28,6 +87,10 @@ class ProcNode:
     node_id: str
     proc: subprocess.Popen
     client: RemoteNode
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.client.host}:{self.client.port}"
 
     @property
     def alive(self) -> bool:
@@ -52,21 +115,42 @@ class ProcCluster:
     num_shards: int = 8
     replica_factor: int = 3
     block_size_secs: int = 2 * 3600
+    heartbeat_timeout: float = 2.0
     base_dir: str | None = None
     extra_args: list = field(default_factory=list)
     nodes: dict = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         self.base_dir = self.base_dir or tempfile.mkdtemp(prefix="m3tpu-proc-")
-        ids = [f"node{i}" for i in range(self.num_nodes)]
-        self.placement = build_initial_placement(
-            ids, self.num_shards, self.replica_factor
+        self.kv_proc, kv_host, kv_port = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.kvnode", "--port", "0"],
+            "kvnode",
         )
-        for nid in ids:
-            self.nodes[nid] = self._spawn(nid)
-        for nid, pn in self.nodes.items():
-            inst = self.placement.instances[nid]
-            pn.client.assign_shards(set(inst.shards))
+        self.kv_endpoint = f"{kv_host}:{kv_port}"
+        try:
+            self.kv = RemoteKVStore.connect(self.kv_endpoint)
+            self.placement_svc = PlacementService(self.kv)
+
+            ids = [f"node{i}" for i in range(self.num_nodes)]
+            for nid in ids:
+                self.nodes[nid] = self._spawn(nid)
+            placement = build_initial_placement(
+                ids, self.num_shards, self.replica_factor
+            )
+            for nid in ids:
+                placement.instances[nid].endpoint = self.nodes[nid].endpoint
+            self.placement_svc.set(placement)
+            self.wait_for_shards()
+        except BaseException:
+            # a half-started cluster must not orphan its processes — the
+            # fixture object never reaches the caller, so close() would
+            # never run
+            self.close()
+            raise
+
+    @property
+    def placement(self):
+        return self.placement_svc.get()
 
     def _spawn(self, node_id: str, port: int = 0) -> ProcNode:
         cmd = [
@@ -83,72 +167,84 @@ class ProcCluster:
             str(self.num_shards),
             "--block-size-secs",
             str(self.block_size_secs),
+            "--kv-endpoint",
+            self.kv_endpoint,
+            "--heartbeat-timeout",
+            str(self.heartbeat_timeout),
             "--no-mediator",
             *self.extra_args,
         ]
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
-            env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        )
-        # a reader thread owns the (buffered) pipe; the main thread waits on
-        # a queue with a deadline, so a child hanging before LISTENING (or a
-        # line already sitting in the TextIOWrapper buffer, which select(2)
-        # on the raw fd cannot see) can neither block nor be missed
-        import queue as _queue
-        import threading
-
-        lines: _queue.Queue = _queue.Queue()
-
-        def _pump():
-            for ln in proc.stdout:
-                lines.put(ln)
-            lines.put(None)
-
-        threading.Thread(target=_pump, daemon=True).start()
-        deadline = time.time() + 60
-        line = ""
-        while True:
-            remaining = deadline - time.time()
-            if remaining <= 0:
-                proc.kill()
-                raise TimeoutError(f"{node_id} did not start: {line!r}")
-            try:
-                item = lines.get(timeout=min(remaining, 1.0))
-            except _queue.Empty:
-                if proc.poll() is not None:
-                    raise RuntimeError(f"{node_id} died at startup")
-                continue
-            if item is None:
-                raise RuntimeError(f"{node_id} died at startup")
-            line = item
-            if line.startswith("LISTENING"):
-                break
-        _, host, port_s = line.split()
-        client = RemoteNode(host, int(port_s), node_id=node_id)
+        proc, host, port_n = _spawn_listening(cmd, node_id)
+        client = RemoteNode(host, port_n, node_id=node_id)
         return ProcNode(node_id, proc, client)
+
+    def spawn_spare(self, node_id: str) -> ProcNode:
+        """A node process that advertises + heartbeats but owns no shards
+        until the placement says so (the replacement pool)."""
+        pn = self._spawn(node_id)
+        self.nodes[node_id] = pn
+        return pn
+
+    def wait_for_shards(self, timeout: float = 30.0) -> None:
+        """Block until every placed, live node's served shard set matches
+        the placement (watch propagation is asynchronous)."""
+        deadline = time.time() + timeout
+        while True:
+            p = self.placement_svc.get()
+            pending = []
+            for nid, inst in (p.instances if p else {}).items():
+                pn = self.nodes.get(nid)
+                if pn is None or not pn.alive:
+                    continue
+                try:
+                    owned = pn.client.owned_shards(cache_secs=0.0)
+                except Exception:
+                    pending.append((nid, "unreachable"))
+                    continue
+                want = set(inst.shards)
+                if owned != want:
+                    pending.append((nid, f"{sorted(owned)} != {sorted(want)}"))
+            if not pending:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(f"shard propagation timed out: {pending}")
+            time.sleep(0.05)
 
     def restart(self, node_id: str) -> None:
         """Kill + respawn a node on a fresh port (data dir persists, so the
-        node bootstraps from its WAL/filesets)."""
+        node bootstraps from its WAL/filesets); the placement's endpoint is
+        updated via CAS as an operator would."""
         self.nodes[node_id].kill()
         self.nodes[node_id] = self._spawn(node_id)
-        inst = self.placement.instances[node_id]
-        self.nodes[node_id].client.assign_shards(set(inst.shards))
+        while True:
+            p, version = self.placement_svc.get_versioned()
+            if p is None or node_id not in p.instances:
+                break
+            p.instances[node_id].endpoint = self.nodes[node_id].endpoint
+            try:
+                self.placement_svc.check_and_set(p, version)
+                break
+            except ValueError:
+                continue
+        self.wait_for_shards()
 
     def session(
         self,
         write_cl: ConsistencyLevel = ConsistencyLevel.MAJORITY,
         read_cl: ConsistencyLevel = ConsistencyLevel.MAJORITY,
     ) -> Session:
+        p = self.placement_svc.get()
+        nodes = {}
+        for nid, inst in p.instances.items():
+            pn = self.nodes.get(nid)
+            if pn is not None:
+                nodes[nid] = pn.client
+            elif inst.endpoint:
+                host, port = inst.endpoint.rsplit(":", 1)
+                nodes[nid] = RemoteNode(host, int(port), node_id=nid)
         return Session(
-            topology=TopologyMap(self.placement),
-            nodes={nid: pn.client for nid, pn in self.nodes.items()},
+            topology=TopologyMap(p),
+            nodes=nodes,
             write_consistency=write_cl,
             read_consistency=read_cl,
         )
@@ -156,3 +252,10 @@ class ProcCluster:
     def close(self) -> None:
         for pn in self.nodes.values():
             pn.kill()
+        try:
+            if getattr(self, "kv", None) is not None:
+                self.kv.close()
+        finally:
+            if self.kv_proc.poll() is None:
+                self.kv_proc.kill()
+                self.kv_proc.wait(timeout=10)

@@ -23,7 +23,9 @@ def _setup(heartbeat_timeout=0.2, spares=("n3",)):
     services = Services(kv, heartbeat_timeout=heartbeat_timeout)
     psvc = PlacementService(kv)
     psvc.set(build_initial_placement(["n0", "n1", "n2"], 8, 2))
-    for nid in ("n0", "n1", "n2"):
+    # spares must be advertised + live to be promotable (a crashed spare
+    # would wedge the cluster with unbootstrappable INITIALIZING shards)
+    for nid in ("n0", "n1", "n2", *spares):
         services.advertise("m3db", ServiceInstance(id=nid, endpoint=f"{nid}:9000"))
     det = FailureDetector(
         services, psvc, grace=0.1, spares=list(spares), auto_replace=True
@@ -36,7 +38,7 @@ def test_detector_replaces_dead_instance_with_spare():
     # all instances healthy: no events
     assert det.check() == []
     # n1 stops heartbeating: backdate its last heartbeat past timeout+grace
-    services._instances["m3db"]["n1"].last_heartbeat -= 0.4
+    services._backdate("m3db", "n1", 0.4)
     events = det.check()
     kinds = [(e.kind, e.instance_id) for e in events]
     assert ("dead", "n1") in kinds
@@ -54,15 +56,36 @@ def test_detector_replaces_dead_instance_with_spare():
 
 def test_detector_without_spare_emits_dead_only():
     kv, services, psvc, det = _setup(spares=())
-    services._instances["m3db"]["n1"].last_heartbeat -= 0.4
+    services._backdate("m3db", "n1", 0.4)
     events = det.check()
     assert [(e.kind, e.instance_id) for e in events] == [("dead", "n1")]
     assert set(psvc.get().instances) == {"n0", "n1", "n2"}
 
 
+def test_detector_skips_crashed_spare():
+    """A spare whose process died (heartbeats stale) must NOT be promoted —
+    its INITIALIZING shards could never bootstrap; keep the spare for later
+    and leave the dead instance in place for the operator."""
+    kv, services, psvc, det = _setup()
+    services._backdate("m3db", "n3", 0.4)  # the spare is itself dead
+    services._backdate("m3db", "n1", 0.4)
+    events = det.check()
+    kinds = [(e.kind, e.instance_id) for e in events]
+    assert ("dead", "n1") in kinds
+    assert not any(k == "replaced" for k, _ in kinds)
+    assert "n3" not in psvc.get().instances
+    assert det.spares == ["n3"]  # not consumed
+    # spare comes back: the still-dead n1 was already replaced? no — n1
+    # stays dead, and a later pass can only replace NEWLY dead instances;
+    # the operator resolves n1 (reference semantics: detector is an edge
+    # trigger, not a reconciler)
+    services.heartbeat("m3db", "n3")
+    assert det.check() == []
+
+
 def test_detector_recovery_event():
     kv, services, psvc, det = _setup(spares=())
-    services._instances["m3db"]["n0"].last_heartbeat -= 0.4
+    services._backdate("m3db", "n0", 0.4)
     det.check()  # n0 declared dead
     services.heartbeat("m3db", "n0")
     events = det.check()

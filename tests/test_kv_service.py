@@ -1,0 +1,113 @@
+"""Networked control-plane KV: remote store semantics + watches + the
+control-plane stack (placement service, services discovery, election)
+running over a live KV server (cluster/kv/etcd/store.go:54 role)."""
+
+import threading
+import time
+
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.cluster.kv_service import KVServer, RemoteKVStore
+from m3_tpu.cluster.placement import PlacementService, build_initial_placement
+from m3_tpu.cluster.services import LeaderElection, ServiceInstance, Services
+
+
+@pytest.fixture()
+def remote_kv():
+    srv = KVServer()
+    srv.start()
+    kv = RemoteKVStore(srv.host, srv.port)
+    yield kv
+    kv.close()
+    srv.stop()
+
+
+def test_remote_kv_store_semantics(remote_kv):
+    kv = remote_kv
+    assert kv.get("missing") is None
+    assert kv.set("k", {"a": [1, 2]}) == 1
+    assert kv.get("k").value == {"a": [1, 2]}
+    assert kv.check_and_set("k", 1, "v2") == 2
+    with pytest.raises(ValueError):
+        kv.check_and_set("k", 1, "stale")
+    with pytest.raises(KeyError):
+        kv.set_if_not_exists("k", "nope")
+    assert kv.set_if_not_exists("fresh", 7) == 1
+    kv.set("pre/a", 1)
+    kv.set("pre/b", 2)
+    assert kv.keys("pre/") == ["pre/a", "pre/b"]
+    kv.delete("pre/a")
+    assert kv.keys("pre/") == ["pre/b"]
+
+
+def test_remote_kv_watch_delivers_every_observed_version(remote_kv):
+    kv = remote_kv
+    kv.set("w", "v1")
+    seen = []
+    done = threading.Event()
+
+    def on_change(vv):
+        seen.append((vv.version, vv.value))
+        if len(seen) >= 2:
+            done.set()
+
+    unsub = kv.watch("w", on_change)
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.02)
+    assert seen == [(1, "v1")]  # immediate fire with current value
+    kv.set("w", "v2")
+    assert done.wait(5)
+    assert seen[-1] == (2, "v2")
+    unsub()
+    kv.set("w", "v3")
+    time.sleep(0.3)
+    assert seen[-1] == (2, "v2")  # unsubscribed: no more deliveries
+
+
+def test_placement_service_over_remote_kv(remote_kv):
+    svc = PlacementService(remote_kv)
+    p = build_initial_placement(["a", "b", "c"], 8, 3)
+    svc.set(p)
+    got, version = svc.get_versioned()
+    assert set(got.instances) == {"a", "b", "c"}
+    assert version == 1
+    got.instances["a"].endpoint = "127.0.0.1:9999"
+    svc.check_and_set(got, 1)
+    assert svc.get().instances["a"].endpoint == "127.0.0.1:9999"
+
+    events = []
+    unsub = svc.watch(lambda pl: events.append(set(pl.instances)))
+    deadline = time.time() + 5
+    while not events and time.time() < deadline:
+        time.sleep(0.02)
+    assert events and events[0] == {"a", "b", "c"}
+    unsub()
+
+
+def test_services_discovery_and_election_over_remote_kv(remote_kv):
+    # two "processes": two independent Services clients on one KV server
+    s1 = Services(remote_kv, heartbeat_timeout=0.5)
+    s2 = Services(remote_kv, heartbeat_timeout=0.5)
+    s1.advertise("m3db", ServiceInstance("n0", "127.0.0.1:1"))
+    s2.advertise("m3db", ServiceInstance("n1", "127.0.0.1:2"))
+    # each sees the other through the KV
+    assert [i.id for i in s1.instances("m3db")] == ["n0", "n1"]
+    assert [i.endpoint for i in s2.instances("m3db")] == ["127.0.0.1:1", "127.0.0.1:2"]
+    # liveness decays without heartbeats
+    s1._backdate("m3db", "n0", 1.0)
+    assert [i.id for i in s2.instances("m3db")] == ["n1"]
+    assert [i.id for i in s2.instances("m3db", live_only=False)] == ["n0", "n1"]
+    # heartbeat revives
+    s1.heartbeat("m3db", "n0")
+    assert [i.id for i in s2.instances("m3db")] == ["n0", "n1"]
+
+    e1 = LeaderElection(remote_kv, "shardset-0")
+    e2 = LeaderElection(remote_kv, "shardset-0")
+    assert e1.campaign("n0") is True
+    assert e2.campaign("n1") is False
+    assert e2.leader() == "n0"
+    e1.resign("n0")
+    assert e2.campaign("n1") is True
+    assert e1.leader() == "n1"

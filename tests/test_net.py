@@ -95,7 +95,7 @@ def test_node_service_roundtrip(served_db):
     assert [dp.value for dp in got_dps] == [7.0]
 
     ids = client.query_ids("default", term(b"host", b"a"), T0, T0 + HOUR)
-    assert ids["ids"] == [sid] and ids["exhaustive"]
+    assert [bytes(d) for d, _ in ids["docs"]] == [sid] and ids["exhaustive"]
 
     streamed = client.stream_shard("default", db.namespaces["default"].shard_for(sid).id)
     assert any(s[0] == sid for s in streamed)

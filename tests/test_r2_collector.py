@@ -121,6 +121,40 @@ def test_cm_stream_targeted_quantiles():
     assert qs.max() == pytest.approx(ranked[-1])
 
 
+def test_cm_stream_bimodal_rank_accuracy():
+    # regression: _compress used to accumulate rank AFTER absorbing the
+    # merged sample's weight, double-counting g and over-merging near the
+    # upper quantiles (q=0.95 returned a rank-0.999 value on this shape)
+    rng = np.random.default_rng(11)
+    data = np.concatenate(
+        [rng.normal(10.0, 1.0, 25_000), rng.normal(1000.0, 5.0, 25_000)]
+    )
+    rng.shuffle(data)
+    qs = QuantileStream(quantiles=(0.5, 0.95, 0.99), eps=0.01)
+    for v in data:
+        qs.insert(float(v))
+    ranked = np.sort(data)
+    n = len(data)
+    for q in (0.5, 0.95, 0.99):
+        got = qs.query(q)
+        rank = np.searchsorted(ranked, got) / n
+        assert abs(rank - q) <= 0.02, (q, got, rank)
+
+
+def test_cm_stream_descending_input_compresses():
+    # regression: a single forward compress pass barely compressed
+    # monotonically decreasing streams (13-20k samples retained at 50k
+    # inserts); the back-to-front cursor pass restores the sketch bound
+    qs = QuantileStream(quantiles=(0.5, 0.99), eps=0.01)
+    for v in range(50_000, 0, -1):
+        qs.insert(float(v))
+    qs.flush()
+    assert qs.num_samples < 3_000, qs.num_samples
+    for q in (0.5, 0.99):
+        got = qs.query(q)
+        assert abs(got / 50_000 - q) <= 0.02, (q, got)
+
+
 def test_cm_stream_edge_cases():
     qs = QuantileStream(quantiles=(0.5,))
     assert np.isnan(qs.query(0.5))
