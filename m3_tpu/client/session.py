@@ -183,6 +183,7 @@ class Session:
         exhaustive = True
         for node in self.nodes.values():
             if not node.is_up:
+                exhaustive = False  # a down replica may hold unseen docs
                 continue
             try:
                 res = node.query_ids(self.namespace, query, start_nanos,

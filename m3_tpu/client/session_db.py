@@ -14,10 +14,24 @@ from __future__ import annotations
 
 import threading
 
+from dataclasses import dataclass
+
 from ..cluster.placement import Placement, PlacementService
 from ..cluster.topology import ConsistencyLevel, TopologyMap
 from ..utils.xtime import Unit
 from .session import Session
+
+
+@dataclass
+class IndexDoc:
+    id: bytes
+    fields: tuple
+
+
+@dataclass
+class IndexQueryResult:
+    docs: list
+    exhaustive: bool
 
 
 class SessionDatabase:
@@ -60,8 +74,7 @@ class SessionDatabase:
         for nid, inst in p.instances.items():
             if not inst.endpoint:
                 continue
-            host, port = inst.endpoint.rsplit(":", 1)
-            nodes[nid] = RemoteNode(host, int(port), node_id=nid)
+            nodes[nid] = RemoteNode.connect(inst.endpoint, node_id=nid)
         with self._lock:
             old = self._nodes
             self._placement = p
@@ -115,22 +128,11 @@ class SessionDatabase:
         ]
 
     def query_ids(self, ns, query, start, end, limit=None):
-        class _Result:
-            pass
-
         docs, exhaustive = self._session(ns).query_ids(query, start, end, limit=limit)
-
-        class _Doc:
-            __slots__ = ("id", "fields")
-
-            def __init__(self, did, fields):
-                self.id = did
-                self.fields = fields
-
-        r = _Result()
-        r.docs = [_Doc(did, fields) for did, fields in docs]
-        r.exhaustive = exhaustive
-        return r
+        return IndexQueryResult(
+            docs=[IndexDoc(did, fields) for did, fields in docs],
+            exhaustive=exhaustive,
+        )
 
     def aggregate_query(self, ns, query, start, end, field_filter=None):
         if query is None:  # "all docs" — the wire codec needs a real AST node

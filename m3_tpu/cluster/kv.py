@@ -31,12 +31,23 @@ class KVStore:
         self._lock = threading.RLock()
         self._change = threading.Condition(self._lock)
         self._data: dict[str, VersionedValue] = {}
+        # last version at deletion: a re-created key resumes from here so
+        # version-gated watchers (remote long-polls) never miss the rebirth
+        self._tombstones: dict[str, int] = {}
         self._watchers: dict[str, list[Callable[[VersionedValue], None]]] = {}
         self._path = backing_path
         if backing_path and os.path.exists(backing_path):
             with open(backing_path) as f:
                 raw = json.load(f)
-            self._data = {k: VersionedValue(v["version"], v["value"]) for k, v in raw.items()}
+            if isinstance(raw, dict) and set(raw) == {"data", "tombstones"}:
+                data, self._tombstones = raw["data"], {
+                    k: int(v) for k, v in raw["tombstones"].items()
+                }
+            else:  # legacy flat format
+                data = raw
+            self._data = {
+                k: VersionedValue(v["version"], v["value"]) for k, v in data.items()
+            }
 
     def _persist(self) -> None:
         if not self._path:
@@ -44,7 +55,13 @@ class KVStore:
         tmp = self._path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(
-                {k: {"version": v.version, "value": v.value} for k, v in self._data.items()},
+                {
+                    "data": {
+                        k: {"version": v.version, "value": v.value}
+                        for k, v in self._data.items()
+                    },
+                    "tombstones": self._tombstones,
+                },
                 f,
             )
         os.replace(tmp, self._path)
@@ -55,7 +72,7 @@ class KVStore:
 
     def _set_locked(self, key: str, value: Any):
         cur = self._data.get(key)
-        version = (cur.version + 1) if cur else 1
+        version = (cur.version if cur else self._tombstones.get(key, 0)) + 1
         vv = VersionedValue(version, value)
         self._data[key] = vv
         self._persist()
@@ -95,7 +112,9 @@ class KVStore:
 
     def delete(self, key: str) -> None:
         with self._lock:
-            self._data.pop(key, None)
+            gone = self._data.pop(key, None)
+            if gone is not None:
+                self._tombstones[key] = gone.version
             self._persist()
             self._change.notify_all()
 
@@ -119,7 +138,10 @@ class KVStore:
     ) -> VersionedValue | None:
         """Block until key's version exceeds ``after_version`` (long-poll
         watch primitive for the networked KV service). Returns the current
-        value immediately if already newer; None on timeout or deletion."""
+        value immediately if already newer; None on timeout. Deletions are
+        not delivered (matching in-process watch semantics) — but a
+        re-created key resumes versioning past its tombstone, so watchers
+        always see the rebirth."""
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:

@@ -94,11 +94,6 @@ class RemoteKVStore(RpcClient):
         super().__init__(host, port, pool_size=2, timeout=timeout)
         self._watch_stops: list[threading.Event] = []
 
-    @classmethod
-    def connect(cls, endpoint: str, timeout: float = 10.0) -> "RemoteKVStore":
-        host, port = endpoint.rsplit(":", 1)
-        return cls(host, int(port), timeout=timeout)
-
     # -- kv.Store surface --
 
     def get(self, key: str) -> VersionedValue | None:

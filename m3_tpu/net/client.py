@@ -44,6 +44,13 @@ class RpcClient:
         self._pool_lock = threading.Lock()
         self._pool_size = pool_size
 
+    @classmethod
+    def connect(cls, endpoint: str, **kwargs):
+        """Build a client from a 'host:port' endpoint string (the one
+        parser for placement/discovery endpoints)."""
+        host, port = endpoint.rsplit(":", 1)
+        return cls(host, int(port), **kwargs)
+
     # -- connection pool --
 
     def _connect(self) -> socket.socket:

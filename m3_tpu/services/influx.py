@@ -114,7 +114,10 @@ def parse_line(line: str):
         elif raw in ("f", "F", "false", "False", "FALSE"):
             fields[key] = False
         elif raw.endswith(("i", "u")) and _is_int(raw[:-1]):
-            fields[key] = float(int(raw[:-1]))
+            try:
+                fields[key] = float(int(raw[:-1]))
+            except OverflowError:
+                raise LineProtocolError(f"integer field overflows: {raw!r}")
         else:
             try:
                 val = float(raw)

@@ -28,8 +28,7 @@ from ..utils.instrument import DEFAULT as METRICS
 def _default_peer_factory(endpoint: str):
     from ..net.client import RemoteNode
 
-    host, port = endpoint.rsplit(":", 1)
-    return RemoteNode(host, int(port))
+    return RemoteNode.connect(endpoint)
 
 
 class ClusterDatabase:
@@ -112,15 +111,53 @@ class ClusterDatabase:
         return [i for i in ordered if i.endpoint]
 
     def _bootstrap_gained(self, p: Placement, gained) -> None:
-        done: list[int] = []
-        failed = False
-        for shard, a in gained:
-            ok = self._stream_one_shard(p, shard, a.source_instance)
-            if ok:
-                done.append(shard)
-            else:
-                failed = True
-            with self._lock:
+        """Run the gained shards through the node's OWN bootstrap chain
+        (fs → commitlog+snapshot → peers → uninitialized) with
+        shard-time-range accounting — the AssignShardSet-driven bootstrap
+        of database.go:386/:442 with bootstrapper/peers as the streaming
+        source."""
+        gained_ids = [s for s, _ in gained]
+        preferred = {s: a.source_instance for s, a in gained}
+
+        def peers_source(ns_name: str, shard: int):
+            for src in self._stream_sources(p, shard, preferred.get(shard)):
+                try:
+                    peer = self.peer_factory(src.endpoint)
+                except Exception:
+                    continue
+                try:
+                    return peer.stream_shard(ns_name, shard)
+                except Exception:
+                    continue  # dead/unreachable peer: try the next replica
+                finally:
+                    try:
+                        peer.close()
+                    except Exception:
+                        pass
+            return None  # nothing reachable held this shard
+
+        def has_peer_with_shard(shard: int) -> bool:
+            return any(
+                inst.shards.get(shard) is not None
+                and inst.shards[shard].state
+                in (ShardState.AVAILABLE, ShardState.LEAVING)
+                for inst in p.instances.values()
+                if inst.id != self.node_id
+            )
+
+        try:
+            res = self.db.bootstrap_shards(
+                gained_ids, peers_source, has_peer_with_shard
+            )
+            unfulfilled: set[int] = set()
+            for ns_res in res.get("sources", {}).values():
+                unfulfilled |= {int(s) for s in ns_res.get("unfulfilled", {})}
+        except Exception:
+            unfulfilled = set(gained_ids)
+        done = [s for s in gained_ids if s not in unfulfilled]
+        failed = bool(unfulfilled)
+        with self._lock:
+            for shard in gained_ids:
                 self._bootstrapping.discard(shard)
         if done:
             self._mark_available(done)
@@ -146,42 +183,6 @@ class ClusterDatabase:
                 target=_retry, daemon=True,
                 name=f"peers-bootstrap-retry-{self.node_id}",
             ).start()
-
-    def _stream_one_shard(self, p: Placement, shard: int, preferred) -> bool:
-        for src in self._stream_sources(p, shard, preferred):
-            try:
-                peer = self.peer_factory(src.endpoint)
-            except Exception:
-                continue
-            try:
-                for ns_name in list(self.db.namespaces):
-                    for sid, tags, dps in peer.stream_shard(ns_name, shard):
-                        for dp in dps:
-                            if tags:
-                                self.db.write_tagged(
-                                    ns_name, tags, dp.timestamp, dp.value, dp.unit
-                                )
-                            else:
-                                self.db.write(
-                                    ns_name, sid, dp.timestamp, dp.value, dp.unit
-                                )
-                return True
-            except Exception:
-                continue  # dead/unreachable peer: try the next replica
-            finally:
-                try:
-                    peer.close()
-                except Exception:
-                    pass
-        # no reachable source: a brand-new cluster's shards have no data to
-        # stream — claiming the shard empty matches the reference's
-        # uninitialized_topology bootstrapper (no other replica has data)
-        return not any(
-            inst.shards.get(shard) is not None
-            and inst.shards[shard].state in (ShardState.AVAILABLE, ShardState.LEAVING)
-            for inst in p.instances.values()
-            if inst.id != self.node_id
-        )
 
     def _mark_available(self, shards: list[int]) -> None:
         from ..cluster.placement import mark_shards_available

@@ -18,9 +18,11 @@ the wrong series.
 from __future__ import annotations
 
 import os
+import queue
 import re
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass
 
@@ -58,14 +60,35 @@ def _list_segments(dir_path: str) -> list[tuple[int, str]]:
 
 
 class CommitLog:
-    """Single-writer segmented WAL. fsync policy: batched every N writes
-    (the reference's flush interval maps to flush_every here)."""
+    """Segmented WAL with WRITE-BEHIND: callers enqueue onto a bounded
+    queue and return immediately; a single writer thread drains the queue,
+    appends, and fsyncs when either ``flush_every`` records are pending or
+    ``flush_interval`` seconds have elapsed with anything pending — the
+    reference's single writer goroutine + flush interval/fsync policy
+    (commit_log.go:293 writerLoop, :408/:804 writeBehind). The loss window
+    on a hard kill is therefore bounded by the flush interval, even at
+    arbitrarily low write rates.
 
-    def __init__(self, dir_path: str, flush_every: int = 64) -> None:
+    ``flush()`` is a durability barrier: it blocks until every previously
+    enqueued record is appended AND fsynced. ``write_behind=False`` gives
+    the fully synchronous mode (tests, tools)."""
+
+    _SENTINEL = object()
+
+    def __init__(
+        self,
+        dir_path: str,
+        flush_every: int = 64,
+        flush_interval: float = 1.0,
+        write_behind: bool = True,
+        queue_size: int = 65536,
+    ) -> None:
         self.dir = dir_path
         self.flush_every = flush_every
-        # single-writer lock: appends from per-shard write paths serialize
-        # here (the reference's commit log has its own writer queue)
+        self.flush_interval = flush_interval
+        self.write_behind = write_behind
+        # the writer thread owns the file; this lock only guards the
+        # synchronous mode and open/close edges
         self._wlock = threading.RLock()
         os.makedirs(dir_path, exist_ok=True)
         segs = _list_segments(dir_path)
@@ -74,6 +97,20 @@ class CommitLog:
         self._f = self._open_segment(self.active_seq)
         self._pending = 0
         self._active_entries = 0
+        self._closed = False
+        # serializes enqueue vs close: once close() wins, no barrier/entry
+        # command can slip into the queue behind the 'close' command (it
+        # would never be serviced — its waiter would hang forever). The
+        # writer thread never takes this lock, so a blocked bounded put
+        # under it still drains.
+        self._qlock = threading.Lock()
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._writer: threading.Thread | None = None
+        if write_behind:
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True, name="commitlog-writer"
+            )
+            self._writer.start()
 
     def _open_segment(self, seq: int):
         f = open(_seg_path(self.dir, seq), "ab")
@@ -83,11 +120,127 @@ class CommitLog:
             os.fsync(f.fileno())
         return f
 
-    def write(self, entry: CommitLogEntry) -> None:
-        with self._wlock:
-            self._write_locked(entry)
+    # --- caller-facing surface ---
 
-    def _write_locked(self, entry: CommitLogEntry) -> None:
+    def _enqueue(self, cmd) -> bool:
+        """Enqueue unless closed. Returns False when the log is closed."""
+        with self._qlock:
+            if self._closed:
+                return False
+            self._q.put(cmd)
+            return True
+
+    def write(self, entry: CommitLogEntry) -> None:
+        if self.write_behind:
+            if not self._enqueue(("entry", entry)):  # blocks when full
+                raise ValueError("commit log is closed")
+        else:
+            with self._wlock:
+                if self._closed:
+                    raise ValueError("commit log is closed")
+                self._append(entry)
+                if self._pending >= self.flush_every:
+                    self._fsync()
+
+    def write_batch(self, entries: list[CommitLogEntry]) -> None:
+        if self.write_behind:
+            for e in entries:
+                self.write(e)
+        else:
+            with self._wlock:
+                if self._closed:
+                    raise ValueError("commit log is closed")
+                for e in entries:
+                    self._append(e)
+                self._fsync()
+
+    def flush(self) -> None:
+        """Durability barrier: everything enqueued before this call is on
+        disk when it returns. No-op after close (close fsyncs)."""
+        if self.write_behind:
+            ev = threading.Event()
+            if self._enqueue(("flush", ev)):
+                ev.wait()
+        else:
+            with self._wlock:
+                if not self._closed:
+                    self._fsync()
+
+    def rotate(self) -> int:
+        """RotateLogs (:370): seal the active segment, open the next.
+        Returns the sealed segment's sequence number. Rotating an EMPTY
+        active segment is a no-op (a periodic mediator would otherwise
+        mint one segment file per pass)."""
+        if self.write_behind:
+            ev = threading.Event()
+            holder: list[int] = []
+            if not self._enqueue(("rotate", ev, holder)):
+                return self.active_seq
+            ev.wait()
+            return holder[0]
+        with self._wlock:
+            if self._closed:
+                return self.active_seq
+            return self._rotate_now()
+
+    def close(self) -> None:
+        if self.write_behind:
+            with self._qlock:
+                if self._closed:
+                    return
+                self._closed = True  # no further command can follow 'close'
+                ev = threading.Event()
+                self._q.put(("close", ev))
+            ev.wait()
+            if self._writer is not None:
+                self._writer.join(timeout=5)
+                self._writer = None
+        else:
+            with self._wlock:
+                if not self._closed:
+                    self._fsync()
+                    self._f.close()
+                    self._closed = True
+
+    # --- writer thread (single owner of the file in write-behind mode) ---
+
+    def _writer_loop(self) -> None:
+        last_fsync = time.monotonic()
+        while True:
+            timeout = None
+            if self._pending:
+                timeout = max(
+                    0.0, self.flush_interval - (time.monotonic() - last_fsync)
+                )
+            try:
+                cmd = self._q.get(timeout=timeout)
+            except queue.Empty:
+                self._fsync()  # interval elapsed with records pending
+                last_fsync = time.monotonic()
+                continue
+            kind = cmd[0]
+            if kind == "entry":
+                self._append(cmd[1])
+                if self._pending >= self.flush_every:
+                    self._fsync()
+                    last_fsync = time.monotonic()
+            elif kind == "flush":
+                self._fsync()
+                last_fsync = time.monotonic()
+                cmd[1].set()
+            elif kind == "rotate":
+                cmd[2].append(self._rotate_now())
+                last_fsync = time.monotonic()
+                cmd[1].set()
+            elif kind == "close":
+                self._fsync()
+                self._f.close()
+                cmd[1].set()
+                return
+
+    # --- file ops (writer thread in write-behind mode; else under _wlock) ---
+
+    def _append(self, entry: CommitLogEntry) -> None:
         payload = (
             struct.pack(
                 "<qdBH",
@@ -103,44 +256,48 @@ class CommitLog:
         self._f.write(rec)
         self._pending += 1
         self._active_entries += 1
-        if self._pending >= self.flush_every:
-            self.flush()
 
-    def write_batch(self, entries: list[CommitLogEntry]) -> None:
-        with self._wlock:
-            for e in entries:
-                self._write_locked(e)
-            self.flush()
+    def _fsync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._pending = 0
 
-    def flush(self) -> None:
-        with self._wlock:
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            self._pending = 0
-
-    def close(self) -> None:
-        with self._wlock:
-            self.flush()
-            self._f.close()
-
-    def rotate(self) -> int:
-        with self._wlock:
-            return self._rotate_locked()
-
-    def _rotate_locked(self) -> int:
-        """RotateLogs (:370): seal the active segment, open the next.
-        Returns the sealed segment's sequence number. Rotating an EMPTY
-        active segment is a no-op (a periodic mediator would otherwise
-        mint one segment file per pass)."""
+    def _rotate_now(self) -> int:
         sealed = self.active_seq
         if self._active_entries == 0:
             return sealed
-        self.close()
+        self._fsync()
+        self._f.close()
         self.active_seq += 1
         self._f = self._open_segment(self.active_seq)
         self._pending = 0
         self._active_entries = 0
         return sealed
+
+    def _crash(self) -> None:
+        """TEST ONLY: simulate a hard process kill (SIGKILL). Acked writes
+        still sitting in the queue die; so does the Python-level file
+        buffer. Bytes already written through to the OS survive, exactly as
+        they would a real process death."""
+        self._closed = True
+        try:
+            while True:
+                cmd = self._q.get_nowait()
+                if cmd[0] in ("flush", "close"):
+                    cmd[1].set()  # unblock any barrier waiter
+                elif cmd[0] == "rotate":
+                    cmd[2].append(self.active_seq)
+                    cmd[1].set()
+        except queue.Empty:
+            pass
+        try:
+            os.close(self._f.fileno())  # yank the fd out from the buffer
+        except OSError:
+            pass
+        try:
+            self._f.close()  # its flush of buffered bytes now fails: lost
+        except (OSError, ValueError):
+            pass
 
     # --- cleanup (storage/cleanup.go commit-log removal semantics) ---
 

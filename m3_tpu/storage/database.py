@@ -579,133 +579,262 @@ class Database:
                 return
             namespace.index.write(sid, tags, t_nanos)
 
-    def bootstrap(self) -> dict:
-        """filesystem → snapshot → commitlog — the fs source marks flushed
-        blocks (fileset data is read lazily at query time) and re-indexes
-        flushed series; the snapshot source restores buffered streams; the
-        commitlog source replays remaining WAL segments into buffers.
+    def bootstrap(
+        self,
+        peers_source=None,
+        shard_filter: set[int] | None = None,
+        now_nanos: int | None = None,
+        has_peer_with_shard=None,
+    ) -> dict:
+        """Run the bootstrapper chain with shard-time-range accounting:
+        filesystem → commitlog+snapshot → peers → uninitialized
+        (bootstrap/process.go:147). Each source claims the block ranges it
+        fulfilled; the remainder passes down the chain.
 
-        Replay never skips entries: a replayed point that also exists in a
-        flushed fileset dedupes at read/merge time, whereas skipping loses
-        cold writes that were logged but not yet cold-flushed."""
+        - filesystem marks flushed blocks (fileset data reads lazily) and
+          re-indexes flushed series;
+        - commitlog+snapshot restores buffered streams and replays WAL
+          segments — replay never skips entries: a replayed point that also
+          exists in a flushed fileset dedupes at read/merge time, whereas
+          skipping loses cold writes not yet cold-flushed;
+        - peers (``peers_source(ns, shard) -> series|None``) streams shards
+          with no local provenance from replicas
+          (bootstrapper/peers/source.go:117) — wired by ClusterDatabase for
+          shards gained via placement change (AssignShardSet,
+          database.go:386);
+        - uninitialized claims what no replica can serve.
+
+        ``shard_filter`` restricts the pass to gained shards on a live node.
+        """
         with TRACER.span("db.bootstrap"):
+            result = {
+                "commitlog_entries": 0,
+                "filesets": 0,
+                "snapshot_records": 0,
+                "sources": {},
+            }
+            for name, ns in self.namespaces.items():
+                r = self._bootstrap_namespace(
+                    name, ns, peers_source, shard_filter, now_nanos, result,
+                    has_peer_with_shard,
+                )
+                result["sources"][name] = {
+                    "target_blocks": r.target_blocks,
+                    "fulfilled": dict(r.fulfilled_by_source),
+                    "unfulfilled": r.unfulfilled,
+                }
+            self.bootstrapped = True
+            return result
+
+    def bootstrap_shards(
+        self, shard_ids, peers_source=None, has_peer_with_shard=None
+    ) -> dict:
+        """Bootstrap only the given (newly gained) shards through the full
+        chain — the AssignShardSet → queued-bootstrap path (database.go:386,
+        :442)."""
+        result = self.bootstrap(
+            peers_source=peers_source,
+            shard_filter=set(shard_ids),
+            has_peer_with_shard=has_peer_with_shard,
+        )
+        # durability barrier BEFORE the caller CASes the shards AVAILABLE:
+        # once the source's LEAVING copy is dropped, this replica's WAL may
+        # be the only record of the streamed data
+        self.flush_wals()
+        return result
+
+    def flush_wals(self) -> None:
+        """Barrier-fsync every namespace's commit log (write-behind WALs
+        ack before fsync; callers needing a durability point use this)."""
+        for cl in self._commitlogs.values():
+            cl.flush()
+
+    def _bootstrap_namespace(
+        self, name: str, ns: Namespace, peers_source, shard_filter, now_nanos,
+        result, has_peer_with_shard=None,
+    ):
+        from .bootstrap import BootstrapProcess, ShardTimeRanges, uninitialized_source
+
+        bsz = ns.opts.block_size_nanos
+        shards = [
+            sh for sh in ns.shards if shard_filter is None or sh.id in shard_filter
+        ]
+        shard_ids = [sh.id for sh in shards]
+        by_id = {sh.id: sh for sh in shards}
+
+        # Re-buffering a point that already sits in a flushed fileset would
+        # make the next cold_flush rewrite an identical volume, so snapshot
+        # records and commitlog entries for flushed blocks are checked
+        # against the fileset first (decoded lazily, cached per
+        # (shard, block, series)). Points NOT in the fileset are genuine
+        # un-flushed cold writes and must replay.
+        pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
+
+        def _covered(sh: Shard, sid: bytes, t_nanos: int, value: float) -> bool:
+            bs = (t_nanos // bsz) * bsz
+            if bs not in sh._flushed_blocks:
+                return False
+            fid = next((f for f in sh.filesets() if f.block_start == bs), None)
+            if fid is None:
+                return False
+            pk = (sh.id, bs, sid)
+            if pk not in pts:
+                stream = sh.reader(fid).stream(sid)
+                pts[pk] = (
+                    {dp.timestamp: dp.value for dp in decode(stream)}
+                    if stream
+                    else {}
+                )
+            return pts[pk].get(t_nanos) == value
+
+        def _restore(sh: Shard, sid: bytes, t: int, v: float, unit) -> bool:
+            if _covered(sh, sid, t, v):
+                return False
+            try:
+                sh.write(sid, t, v, unit)
+            except ColdWriteError:
+                # pre-crash WAL/snapshot entry in a flushed block of a
+                # cold-disabled namespace whose value changed: drop it
+                return False
+            return True
+
+        # --- chain sources (each claims block ranges it fulfilled) ---
+
+        def fs_source(ns_name: str, remaining: ShardTimeRanges) -> ShardTimeRanges:
+            fulfilled = ShardTimeRanges()
             with self.lock:
-                result = {"commitlog_entries": 0, "filesets": 0, "snapshot_records": 0}
-                for name, ns in self.namespaces.items():
-                    # Re-buffering a point that already sits in a flushed fileset
-                    # would make the next cold_flush rewrite an identical volume,
-                    # so snapshot records and commitlog entries for flushed blocks
-                    # are checked against the fileset first (decoded lazily,
-                    # cached per (shard, block, series)). Points NOT in the
-                    # fileset are genuine un-flushed cold writes and must replay.
-                    pts: dict[tuple[int, int, bytes], dict[int, float]] = {}
-                    bsz = ns.opts.block_size_nanos
-
-                    def _covered(sh: Shard, sid: bytes, t_nanos: int, value: float) -> bool:
-                        bs = (t_nanos // bsz) * bsz
-                        if bs not in sh._flushed_blocks:
-                            return False
-                        fid = next(
-                            (f for f in sh.filesets() if f.block_start == bs), None
-                        )
-                        if fid is None:
-                            return False
-                        pk = (sh.id, bs, sid)
-                        if pk not in pts:
-                            stream = sh.reader(fid).stream(sid)
-                            pts[pk] = (
-                                {dp.timestamp: dp.value for dp in decode(stream)}
-                                if stream
-                                else {}
-                            )
-                        return pts[pk].get(t_nanos) == value
-
-                    def _restore(sh: Shard, sid: bytes, t: int, v: float, unit) -> bool:
-                        if _covered(sh, sid, t, v):
-                            return False
-                        try:
-                            sh.write(sid, t, v, unit)
-                        except ColdWriteError:
-                            # pre-crash WAL/snapshot entry in a flushed block of a
-                            # cold-disabled namespace whose value changed: drop it
-                            return False
-                        return True
-
-                    def _has_fileset_point(sh: Shard, sid: bytes, t: int) -> bool:
-                        bs = (t // bsz) * bsz
-                        fid = next(
-                            (f for f in sh.filesets() if f.block_start == bs), None
-                        )
-                        if fid is None:
-                            return False
-                        pk = (sh.id, bs, sid)
-                        if pk not in pts:
-                            stream = sh.reader(fid).stream(sid)
-                            pts[pk] = (
-                                {dp.timestamp: dp.value for dp in decode(stream)}
-                                if stream
-                                else {}
-                            )
-                        return t in pts[pk]
-
-                    # persisted index blocks load wholesale; blocks without one
-                    # are rebuilt below from fileset IDs (tag wire format)
-                    persisted: set[int] = set()
-                    if ns.index is not None:
-                        persisted = ns.index.load_persisted(self.base, name)
-                    for shard in ns.shards:
-                        fids = shard.filesets()
-                        result["filesets"] += len(fids)
-                        for fid in fids:
-                            shard._flushed_blocks.add(fid.block_start)
-                            if fid.block_start in persisted:
-                                continue
-                            for sid in read_index_ids(self.base, fid):
-                                self._reindex(ns, sid, fid.block_start)
-                        snap = read_latest_snapshot(self.base, name, shard.id)
-                        if snap:
-                            vol_now = {
-                                f.block_start: f.volume for f in shard.filesets()
-                            }
-                            for sid, bs, stream, rec_vol in snap:
-                                # Ordering vs filesets (the recorded volume is
-                                # the arbiter): every warm/cold flush bumps the
-                                # block's fileset volume, so a volume that has
-                                # advanced since the snapshot means the fileset
-                                # superseded this record — restoring it would
-                                # shadow newer flushed values (buffer wins on
-                                # read dedupe). An unchanged volume means the
-                                # record is a cold-write overlay NEWER than the
-                                # fileset.
-                                if vol_now.get(bs, -1) > rec_vol:
-                                    continue
-                                for dp in decode(stream):
-                                    _restore(shard, sid, dp.timestamp, dp.value, dp.unit)
-                                self._reindex(ns, sid, bs)
-                            result["snapshot_records"] += len(snap)
-                    entries = CommitLog.replay(self._commitlog_dir(name))
-                    # The WAL is totally ordered, so for duplicate (sid, t) the
-                    # LAST entry is the live value (an earlier entry may be a
-                    # stale overwrite whose newer value now lives only in a
-                    # fileset — replaying it would shadow the fileset).
-                    final: dict[tuple[bytes, int], CommitLogEntry] = {}
-                    for e in entries:
-                        final[(e.series_id, e.time_nanos)] = e
-                    for e in final.values():
-                        sh = ns.shard_for(e.series_id)
-                        if _covered(sh, e.series_id, e.time_nanos, e.value):
+                persisted: set[int] = set()
+                if ns.index is not None:
+                    persisted = ns.index.load_persisted(self.base, ns_name)
+                for shard in shards:
+                    fids = shard.filesets()
+                    result["filesets"] += len(fids)
+                    for fid in fids:
+                        shard._flushed_blocks.add(fid.block_start)
+                        fulfilled.add(shard.id, fid.block_start)
+                        if fid.block_start in persisted:
                             continue
-                        # value differs from (or is absent in) the fileset: the
-                        # last-ordered WAL write is newer than the flush unless
-                        # the point exists there with another value AND this
-                        # entry predates the flush — with last-wins dedupe the
-                        # only such survivors are post-flush cold writes, so
-                        # replay them
-                        if _restore(sh, e.series_id, e.time_nanos, e.value, e.unit):
-                            self._reindex(ns, e.series_id, e.time_nanos)
-                    result["commitlog_entries"] += len(entries)
-                self.bootstrapped = True
-                return result
+                        for sid in read_index_ids(self.base, fid):
+                            self._reindex(ns, sid, fid.block_start)
+            return fulfilled
+
+        def commitlog_snapshot_source(
+            ns_name: str, remaining: ShardTimeRanges
+        ) -> ShardTimeRanges:
+            fulfilled = ShardTimeRanges()
+            with self.lock:
+                for shard in shards:
+                    snap = read_latest_snapshot(self.base, ns_name, shard.id)
+                    if not snap:
+                        continue
+                    vol_now = {f.block_start: f.volume for f in shard.filesets()}
+                    for sid, bs, stream, rec_vol in snap:
+                        # Ordering vs filesets (the recorded volume is the
+                        # arbiter): every warm/cold flush bumps the block's
+                        # fileset volume, so a volume that has advanced since
+                        # the snapshot means the fileset superseded this
+                        # record — restoring it would shadow newer flushed
+                        # values (buffer wins on read dedupe). An unchanged
+                        # volume means the record is a cold-write overlay
+                        # NEWER than the fileset.
+                        if vol_now.get(bs, -1) > rec_vol:
+                            continue
+                        for dp in decode(stream):
+                            _restore(shard, sid, dp.timestamp, dp.value, dp.unit)
+                        fulfilled.add(shard.id, bs)
+                        self._reindex(ns, sid, bs)
+                    result["snapshot_records"] += len(snap)
+                # The WAL is totally ordered, so for duplicate (sid, t) the
+                # LAST entry is the live value (an earlier entry may be a
+                # stale overwrite whose newer value now lives only in a
+                # fileset — replaying it would shadow the fileset).
+                final: dict[tuple[bytes, int], CommitLogEntry] = {}
+                replayed = 0
+                for e in wal_entries:
+                    sh = ns.shard_for(e.series_id)
+                    if sh.id not in by_id:
+                        continue  # outside this pass's shard filter
+                    final[(e.series_id, e.time_nanos)] = e
+                    replayed += 1
+                for e in final.values():
+                    sh = ns.shard_for(e.series_id)
+                    fulfilled.add(sh.id, (e.time_nanos // bsz) * bsz)
+                    if _covered(sh, e.series_id, e.time_nanos, e.value):
+                        continue
+                    # value differs from (or is absent in) the fileset: with
+                    # last-wins dedupe the only such survivors are post-flush
+                    # cold writes, so replay them
+                    if _restore(sh, e.series_id, e.time_nanos, e.value, e.unit):
+                        self._reindex(ns, e.series_id, e.time_nanos)
+                result["commitlog_entries"] += replayed
+            return fulfilled
+
+        def peers_src(ns_name: str, remaining: ShardTimeRanges) -> ShardTimeRanges:
+            fulfilled = ShardTimeRanges()
+            if peers_source is None:
+                return fulfilled
+            for shard_id in remaining.shards():
+                series = peers_source(ns_name, shard_id)
+                if series is None:
+                    continue  # no reachable replica holds this shard
+                for sid, tags, dps in series:
+                    for dp in dps:
+                        # full write path: WAL-logged (a restart before the
+                        # next flush must be able to replay this replica's
+                        # copy) and indexed per point (series spanning
+                        # several index blocks stay queryable in each)
+                        try:
+                            if tags:
+                                self.write_tagged(
+                                    ns_name, tags, dp.timestamp, dp.value, dp.unit
+                                )
+                            else:
+                                self.write(
+                                    ns_name, sid, dp.timestamp, dp.value, dp.unit
+                                )
+                                self._reindex(ns, sid, dp.timestamp)
+                        except (ColdWriteError, ValueError):
+                            continue
+                # a reachable peer hands over everything it has for the
+                # shard: the remaining ranges are fulfilled (blocks with no
+                # data are legitimately empty on the replica too)
+                fulfilled.add_shard_blocks(shard_id, remaining.ranges[shard_id])
+            return fulfilled
+
+        # target = retention window (live operation) ∪ locally discovered
+        # blocks (restarts with data older than the window still replay);
+        # the WAL is read ONCE here and reused by the commitlog source
+        import time as _time
+
+        now = int(_time.time() * NANOS) if now_nanos is None else now_nanos
+        target = ShardTimeRanges.for_window(
+            shard_ids, now - ns.opts.retention_nanos, now + bsz, bsz
+        )
+        with self.lock:
+            wal_entries = CommitLog.replay(self._commitlog_dir(name))
+            for shard in shards:
+                for fid in shard.filesets():
+                    target.add(shard.id, fid.block_start)
+                snap = read_latest_snapshot(self.base, name, shard.id)
+                for _, bs, _, _ in snap or ():
+                    target.add(shard.id, bs)
+            for e in wal_entries:
+                sh = ns.shard_for(e.series_id)
+                if sh.id in by_id:
+                    target.add(sh.id, (e.time_nanos // bsz) * bsz)
+
+        process = BootstrapProcess(
+            [
+                ("filesystem", fs_source),
+                ("commitlog_snapshot", commitlog_snapshot_source),
+                ("peers", peers_src),
+                # uninitialized claims ranges only when topology says NO
+                # replica holds the shard (fresh cluster) — an unreachable
+                # replica leaves them unfulfilled so the caller retries
+                ("uninitialized", uninitialized_source(has_peer_with_shard)),
+            ]
+        )
+        return process.run(name, target)
 
     def close(self) -> None:
         with self.lock:

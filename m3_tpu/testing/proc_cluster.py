@@ -240,8 +240,7 @@ class ProcCluster:
             if pn is not None:
                 nodes[nid] = pn.client
             elif inst.endpoint:
-                host, port = inst.endpoint.rsplit(":", 1)
-                nodes[nid] = RemoteNode(host, int(port), node_id=nid)
+                nodes[nid] = RemoteNode.connect(inst.endpoint, node_id=nid)
         return Session(
             topology=TopologyMap(p),
             nodes=nodes,

@@ -66,6 +66,29 @@ def test_remote_kv_watch_delivers_every_observed_version(remote_kv):
     assert seen[-1] == (2, "v2")  # unsubscribed: no more deliveries
 
 
+def test_delete_then_recreate_resumes_versioning(remote_kv):
+    """A re-created key must continue past its tombstone version so
+    version-gated long-poll watchers never miss the rebirth."""
+    kv = remote_kv
+    kv.set("r", "v1")
+    kv.set("r", "v2")  # version 2
+    kv.delete("r")
+    assert kv.set("r", "v3") == 3  # resumes, not back to 1
+    seen = []
+    unsub = kv.watch("r", lambda vv: seen.append(vv.version))
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.02)
+    assert seen == [3]
+    kv.delete("r")
+    kv.set("r", "v4")
+    deadline = time.time() + 5
+    while len(seen) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert seen == [3, 4]  # watcher saw the re-creation
+    unsub()
+
+
 def test_placement_service_over_remote_kv(remote_kv):
     svc = PlacementService(remote_kv)
     p = build_initial_placement(["a", "b", "c"], 8, 3)

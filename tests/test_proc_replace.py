@@ -112,6 +112,54 @@ def test_cross_process_kill_detect_replace_stream_quorum(tmp_path):
         cluster.close()
 
 
+def test_replacement_survives_immediate_kill_after_available(tmp_path):
+    """The replacement CASes its shards AVAILABLE only after a WAL
+    durability barrier (bootstrap_shards → flush_wals): SIGKILL it the
+    moment it reports AVAILABLE, restart it on the same data dir, and its
+    own bootstrap chain must replay the peers-streamed copy."""
+    from m3_tpu.index.query import term as term_q
+
+    cluster = ProcCluster(
+        num_nodes=3, num_shards=4, replica_factor=3,
+        heartbeat_timeout=1.0, base_dir=str(tmp_path),
+    )
+    try:
+        session = cluster.session()
+        for i in range(8):
+            session.write_tagged(
+                ((b"host", f"w{i}".encode()), (b"name", b"reqs")), T0 + NANOS, float(i)
+            )
+        cluster.spawn_spare("node3")
+        detector = FailureDetector(
+            Services(cluster.kv, heartbeat_timeout=1.0),
+            cluster.placement_svc, grace=0.5, spares=["node3"],
+        )
+        cluster.nodes["node1"].proc.kill()
+        cluster.nodes["node1"].proc.wait(timeout=10)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            detector.check()
+            p = cluster.placement_svc.get()
+            inst = p.instances.get("node3")
+            if inst and inst.shards and all(
+                a.state == ShardState.AVAILABLE for a in inst.shards.values()
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("replacement never became AVAILABLE")
+        cluster.nodes["node3"].proc.kill()
+        cluster.nodes["node3"].proc.wait(timeout=10)
+        cluster.restart("node3")
+        res = cluster.nodes["node3"].client.fetch_tagged(
+            "default", term_q(b"name", b"reqs"), T0, T0 + HOUR
+        )
+        assert len(res) == 8
+        assert sum(len(d) for _, _, d in res) == 8
+    finally:
+        cluster.close()
+
+
 def test_cross_process_node_add_streams_from_donors(tmp_path):
     """Placement add-instance over real processes: the new node's OWN
     placement watch triggers peers streaming from the donor replicas

@@ -179,6 +179,39 @@ def test_wal_random_crash_point_replays_prefix(tmp_path_factory, series, cut):
         assert g.value == w.value or (math.isnan(g.value) and math.isnan(w.value))
 
 
+@settings(**_SETTINGS)
+@given(
+    _series(min_size=1, max_size=60),
+    st.integers(min_value=0, max_value=60),
+)
+def test_wal_write_behind_crash_loses_at_most_unflushed_tail(
+    tmp_path_factory, series, barrier_at
+):
+    """Write-behind async window (commit_log.go:293,408): a hard kill may
+    lose acked-but-unflushed records, but what replays must be an exact
+    PREFIX of the acked order that includes everything before the last
+    durability barrier — never reordered, never corrupted."""
+    ts, vals = series
+    d = tmp_path_factory.mktemp("walwb")
+    cl = CommitLog(str(d), flush_every=10**9, flush_interval=3600.0)
+    entries = [
+        CommitLogEntry(f"s{i % 3}".encode(), t, v)
+        for i, (t, v) in enumerate(zip(ts, vals))
+    ]
+    barrier_at = min(barrier_at, len(entries))
+    for e in entries[:barrier_at]:
+        cl.write(e)
+    cl.flush()  # durability barrier
+    for e in entries[barrier_at:]:
+        cl.write(e)
+    cl._crash()  # SIGKILL: queue + python file buffer die
+    got = CommitLog.replay(str(d))
+    assert barrier_at <= len(got) <= len(entries)
+    for g, w in zip(got, entries):
+        assert (g.series_id, g.time_nanos) == (w.series_id, w.time_nanos)
+        assert g.value == w.value or (math.isnan(g.value) and math.isnan(w.value))
+
+
 @settings(max_examples=15, deadline=None, suppress_health_check=[HealthCheck.too_slow])
 @given(
     st.lists(st.integers(min_value=0, max_value=59), min_size=1, max_size=30),
@@ -207,7 +240,11 @@ def test_storage_crash_recovery_random_schedule(tmp_path_factory, offsets, n_ops
             db.flush("ns", ((t // HOUR) + 1) * HOUR)
         elif op == 1:
             db.snapshot("ns")
-    # crash: no close/flush — tail lives only in the WAL
+    # crash AFTER the WAL durability barrier (write-behind acks before
+    # fsync; the barrier models the state a real fsync interval leaves on
+    # disk — the async window itself is covered by
+    # test_wal_write_behind_crash_loses_at_most_unflushed_tail)
+    db.flush_wals()
     del db
 
     db2 = Database(base, num_shards=2)
