@@ -1,0 +1,491 @@
+"""Full benchmark suite: BASELINE.md configs 1-5, the mixed-workload bench,
+and the scan p50 latency — the honest numbers the round-3 verdict asked for
+(bench.py stays the driver's single headline line; this writes PERF_r04.json).
+
+Run:  python bench_suite.py [--configs 1,2,3,4,5,mixed,scan] [--series N]
+
+Each config prints one BENCH-style JSON line and all records land in
+PERF_r04.json. On CPU the workloads shrink (sanity only — real numbers come
+from the TPU chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import numpy as np
+
+NANOS = 1_000_000_000
+NORTH_STAR = 10e9
+T0 = 1_600_000_000 * NANOS
+
+
+def _rec(metric, value, unit, **extra):
+    rec = {
+        "metric": metric,
+        "value": round(float(value), 4),
+        "unit": unit,
+        "vs_baseline": round(float(value) / NORTH_STAR, 6)
+        if unit == "datapoints/s"
+        else None,
+        **extra,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _fetch(out):
+    """Force a REAL device→host sync by materializing one scalar (the axon
+    tunnel's block_until_ready can return early; a data fetch cannot).
+    Indexes a single element so big outputs don't ride the tunnel."""
+    leaf = out
+    if hasattr(out, "total_count"):
+        leaf = out.total_count
+    elif isinstance(out, (tuple, list)):
+        leaf = out[0]
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf[(0,) * leaf.ndim]
+    return float(leaf)
+
+
+def _timeit(fn, args, iters=10):
+    """Self-validating timing: pipelined (block-at-end, amortizes the
+    tunnel's ~10ms dispatch rtt) cross-checked against synchronous
+    fetch-per-iter. A pipelined number >20x faster than sync means the
+    block didn't block (observed on the axon tunnel for some shapes) —
+    report sync instead."""
+    import jax
+
+    out = fn(args)
+    jax.block_until_ready(out)
+    _fetch(out)
+    n_sync = max(3, iters // 3)
+    t0 = time.perf_counter()
+    for _ in range(n_sync):
+        _fetch(fn(args))
+    dt_sync = (time.perf_counter() - t0) / n_sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(args)
+    jax.block_until_ready(out)
+    dt_pipe = (time.perf_counter() - t0) / iters
+    dt = dt_sync if dt_pipe < dt_sync / 20 else dt_pipe
+    return dt, out
+
+
+def _latencies(fn, args, iters=20):
+    for _ in range(4):  # compile + argument residency settle
+        _fetch(fn(args))
+    lats = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _fetch(fn(args))
+        lats.append(time.perf_counter() - t0)
+    return np.asarray(lats)
+
+
+# --- config 1: CPU codec round trip (m3tsz_benchmark_test.go role) ---
+
+
+def bench_config1():
+    from m3_tpu.codec.m3tsz import decode
+    from m3_tpu.utils.synthetic import synthetic_streams
+
+    streams = synthetic_streams(1000, 720, seed=1)
+    nbytes = sum(map(len, streams))
+    npts = 1000 * 720
+    t0 = time.perf_counter()
+    total = 0
+    for s in streams:
+        total += len(decode(s))
+    dt = time.perf_counter() - t0
+    assert total == npts
+    return _rec(
+        "config1_cpu_decode_roundtrip",
+        npts / dt,
+        "datapoints/s",
+        bytes_per_datapoint=round(nbytes / npts, 3),
+        series=1000,
+    )
+
+
+# --- config 2: S x 720 packed decode+aggregate (the headline shape) ---
+
+
+def _packed_fn(batch, order="c"):
+    import jax
+
+    from m3_tpu.ops import fused
+    from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+
+    packed = fused.pack_lane_inputs(batch, order=order)
+    w4 = jax.device_put(packed.windows4)
+    l4 = jax.device_put(packed.lanes4)
+    tf = jax.device_put(packed.tile_flags)
+    fn0 = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate_packed,
+            n=packed.n,
+            s=batch.num_series,
+            c=batch.num_chunks,
+            k=batch.k,
+            lane_order=packed.order,
+            # cross-series totals are order-independent; per-series arrays
+            # come back in sorted order and unpermute on host via inv
+            unpermute_series=False,
+        )
+    )
+    fn = lambda _: fn0(w4, l4, tf)
+    return fn, packed
+
+
+def _jnp_fn(batch):
+    import jax
+
+    from m3_tpu.parallel.scan import chunked_device_args, chunked_scan_aggregate_fused
+
+    args = chunked_device_args(batch)
+    fn0 = jax.jit(
+        functools.partial(
+            chunked_scan_aggregate_fused,
+            s=batch.num_series,
+            c=batch.num_chunks,
+            k=batch.k,
+        )
+    )
+    return lambda _: fn0(args)
+
+
+def _build(streams, n_series, k=24):
+    from m3_tpu.ops.chunked import build_chunked, tile_chunked
+
+    return tile_chunked(build_chunked(streams, k=k), n_series)
+
+
+def bench_config2(n_series, on_tpu):
+    from m3_tpu.utils.synthetic import synthetic_streams
+
+    batch = _build(synthetic_streams(64, 720, seed=3), n_series)
+    fn = _packed_fn(batch)[0] if on_tpu else _jnp_fn(batch)
+    dt, out = _timeit(fn, None)
+    pts = int(out.total_count)
+    return _rec(
+        "config2_decode_aggregate",
+        pts / dt,
+        "datapoints/s",
+        series=n_series,
+        points=720,
+    )
+
+
+def bench_mixed(n_series, on_tpu):
+    """Mixed workload: >=30% float-mode + counters + time-unit changes +
+    annotations + varied gauge entropy, interleaved (not 64 tiled uniques).
+    Sorted lane packing routes the fast majority to the specialized body."""
+    from m3_tpu.utils.synthetic import synthetic_mixed_streams
+
+    batch = _build(synthetic_mixed_streams(256, 720, seed=11), n_series)
+    fast_frac = float(np.asarray(batch.fast).mean())
+    if on_tpu:
+        fn, packed = _packed_fn(batch, order="sorted")
+        fast_tiles = float(packed.tile_flags.mean())
+    else:
+        fn = _jnp_fn(batch)
+        fast_tiles = 0.0
+    dt, out = _timeit(fn, None)
+    pts = int(out.total_count)
+    return _rec(
+        "mixed_workload_decode_aggregate",
+        pts / dt,
+        "datapoints/s",
+        series=n_series,
+        fast_lane_fraction=round(fast_frac, 4),
+        fast_tile_fraction=round(fast_tiles, 4),
+        composition="30% float, 8% counter, 5% tu-change, 2% annotation, 55% gauge",
+    )
+
+
+def bench_scan_p50(n_series, on_tpu):
+    """1M->50M scan p50: per-dispatch latency of the full decode+aggregate
+    at the given series count (the second half of the north-star metric)."""
+    from m3_tpu.utils.synthetic import synthetic_streams
+
+    batch = _build(synthetic_streams(64, 720, seed=3), n_series)
+    fn = _packed_fn(batch)[0] if on_tpu else _jnp_fn(batch)
+    lats = _latencies(fn, None)
+    return _rec(
+        "scan_latency_p50",
+        float(np.percentile(lats, 50)),
+        "seconds",
+        series=n_series,
+        p90=round(float(np.percentile(lats, 90)), 6),
+        p99=round(float(np.percentile(lats, 99)), 6),
+    )
+
+
+# --- config 3: temporal functions over a decoded block ---
+
+
+def bench_config3(n_series):
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.query.functions import temporal
+
+    t = 720
+    rng = np.random.default_rng(0)
+    vals = rng.normal(100, 10, (n_series, t)).astype(np.float32)
+    vals[rng.random((n_series, t)) < 0.01] = np.nan  # missing samples
+    x = jax.device_put(jnp.asarray(vals))
+    window = 7  # 1m range at 10s step
+
+    @jax.jit
+    def fn(v):
+        r = temporal.rate(v, window, step_seconds=10.0)
+        a = temporal.avg_over_time(v, window)
+        return r.sum() + a.sum()
+
+    dt, _ = _timeit(fn, x)
+    # two functions over S*T points each
+    return _rec(
+        "config3_temporal_functions",
+        2 * n_series * t / dt,
+        "datapoints/s",
+        series=n_series,
+        functions="rate+avg_over_time",
+    )
+
+
+# --- config 4: 10M active series 10s->1m rollups ---
+
+
+def bench_config4(n_series):
+    import jax
+
+    from m3_tpu.aggregator.kernels import (
+        aggregate_dense,
+        dense_quantiles,
+        pack_dense_groups,
+        window_keys,
+    )
+
+    per = 6  # datapoints per series in the 1m window (10s resolution)
+    n = n_series * per
+    rng = np.random.default_rng(2)
+    ids = np.repeat(np.arange(n_series, dtype=np.int64), per)
+    times = T0 + np.tile((np.arange(per) * 10 * NANOS), n_series) + rng.integers(
+        0, 10 * NANOS, n
+    )
+    values = rng.lognormal(0, 1, n).astype(np.float32)
+    keys, _, order = window_keys(ids, times, T0, 60 * NANOS, 1)
+    t0 = time.perf_counter()
+    dv, dt_, dvalid = pack_dense_groups(keys, values, order, n_series)
+    pack_s = time.perf_counter() - t0
+    dvd = jax.device_put(dv)
+    dtd = jax.device_put(dt_)
+    dvld = jax.device_put(dvalid)
+
+    dt_agg, _ = _timeit(lambda _: aggregate_dense(dvd, dtd, dvld), None)
+
+    # timer quantiles on a 10% timer population (p50/p95/p99)
+    n_t = max(n_series // 10, 1)
+    qfn = functools.partial(dense_quantiles, qs=(0.5, 0.95, 0.99))
+    vq = jax.device_put(dv[:n_t])
+    vlq = jax.device_put(dvalid[:n_t])
+    dt_q, _ = _timeit(lambda _: qfn(vq, vlq), None)
+
+    tmask = n_t * per
+    total_dps = n + tmask
+    return _rec(
+        "config4_rollup_10s_to_1m",
+        total_dps / (dt_agg + dt_q),
+        "datapoints/s",
+        active_series=n_series,
+        agg_dps=round(n / dt_agg, 1),
+        timer_quantile_dps=round(tmask / dt_q, 1),
+        host_densify_s=round(pack_s, 3),
+    )
+
+
+# --- config 5: regexp index query -> decode -> aggregate (fan-out) ---
+
+
+def bench_config5(n_series, on_tpu):
+    from m3_tpu.index.query import RegexpQuery, search_segment
+    from m3_tpu.index.segment import Document, MutableSegment
+    from m3_tpu.ops.chunked import select_series
+    from m3_tpu.utils.synthetic import synthetic_streams
+
+    # index S series: name=metric_{i%100}, dc, host
+    seg = MutableSegment()
+    t_ix0 = time.perf_counter()
+    for i in range(n_series):
+        seg.insert(
+            Document(
+                id=str(i).encode(),
+                fields=(
+                    (b"name", f"metric_{i % 100}".encode()),
+                    (b"dc", f"dc{i % 4}".encode()),
+                ),
+            )
+        )
+    sealed = seg.seal()
+    index_build_s = time.perf_counter() - t_ix0
+
+    q = RegexpQuery(b"name", b"metric_1[0-9]")  # ~10% of series
+    t_q0 = time.perf_counter()
+    postings = search_segment(sealed, q)
+    query_s = time.perf_counter() - t_q0
+    sel = np.asarray(postings, np.int64)
+
+    batch = _build(synthetic_streams(64, 720, seed=3), n_series)
+    t_s0 = time.perf_counter()
+    sub = select_series(batch, sel)
+    select_s = time.perf_counter() - t_s0
+
+    fn = _packed_fn(sub)[0] if on_tpu else _jnp_fn(sub)
+    dt, out = _timeit(fn, None)
+    pts = int(out.total_count)
+    return _rec(
+        "config5_regexp_fanout_decode_aggregate",
+        pts / dt,
+        "datapoints/s",
+        indexed_series=n_series,
+        matched_series=int(sel.size),
+        index_query_ms=round(query_s * 1e3, 2),
+        index_build_s=round(index_build_s, 2),
+        select_pack_s=round(select_s, 2),
+    )
+
+
+def bench_index(n_series, tmpdir="/tmp/m3tpu-index-bench"):
+    """Index-at-scale microbench: build an n_series namespace index, persist
+    to the mmap segment format, reopen zero-copy, and serve term + regexp
+    queries (segment/fst/segment.go role + postings_list_cache.go)."""
+    import shutil
+
+    from m3_tpu.index.disk_segment import DiskSegment
+    from m3_tpu.index.ns_index import NamespaceIndex
+    from m3_tpu.index.query import regexp as regexp_q
+    from m3_tpu.index.query import term as term_q
+
+    HOUR = 3600 * NANOS
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    ix = NamespaceIndex(block_size_nanos=HOUR)
+    t0 = time.perf_counter()
+    batch = [
+        (
+            f"s{i}".encode(),
+            (
+                (b"dc", b"dc%d" % (i % 4)),
+                (b"host", b"h%d" % (i % 50021)),
+                (b"name", b"metric_%d" % (i % 100)),
+            ),
+            T0,
+        )
+        for i in range(n_series)
+    ]
+    ix.write_batch(batch)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ix.persist_before(tmpdir, "bench", T0 + 2 * HOUR)
+    persist_s = time.perf_counter() - t0
+
+    ix2 = NamespaceIndex(block_size_nanos=HOUR)
+    t0 = time.perf_counter()
+    ix2.load_persisted(tmpdir, "bench")
+    open_s = time.perf_counter() - t0
+
+    def lat(q, iters=5):
+        out = []
+        n = 0
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            r = ix2.query(q, T0 - HOUR, T0 + HOUR)
+            out.append(time.perf_counter() - t0)
+            n = len(r.docs)
+        return out, n
+
+    term_lats, term_n = lat(term_q(b"name", b"metric_42"))
+    re_lats, re_n = lat(regexp_q(b"name", b"metric_1[0-9]"))
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return _rec(
+        "index_5m_mmap_segment",
+        n_series / build_s,
+        "docs_indexed/s",
+        series=n_series,
+        persist_s=round(persist_s, 2),
+        mmap_open_ms=round(open_s * 1e3, 2),
+        term_query_ms_cold=round(term_lats[0] * 1e3, 3),
+        term_query_ms_warm=round(float(np.median(term_lats[1:])) * 1e3, 3),
+        term_matched=term_n,
+        regexp_query_ms_cold=round(re_lats[0] * 1e3, 3),
+        regexp_query_ms_cached=round(float(np.median(re_lats[1:])) * 1e3, 3),
+        regexp_matched=re_n,
+    )
+
+
+def main() -> None:
+    import jax
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,5,mixed,scan,index")
+    ap.add_argument("--series", type=int, default=0, help="override config-2 series")
+    ap.add_argument("--out", default="PERF_r04.json")
+    args = ap.parse_args()
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    big = on_tpu
+    s2 = args.series or (1048576 if big else 2048)
+    s_mixed = 524288 if big else 2048
+    s3 = 102400 if big else 4096
+    s4 = 10_000_000 if big else 100_000
+    s5 = 1_000_000 if big else 20_000
+
+    want = set(args.configs.split(","))
+    records = []
+    if "1" in want:
+        records.append(bench_config1())
+    if "2" in want:
+        records.append(bench_config2(s2, on_tpu))
+    if "mixed" in want:
+        records.append(bench_mixed(s_mixed, on_tpu))
+    if "scan" in want:
+        records.append(bench_scan_p50(s2, on_tpu))
+    if "3" in want:
+        records.append(bench_config3(s3))
+    if "4" in want:
+        records.append(bench_config4(s4))
+    if "5" in want:
+        records.append(bench_config5(s5, on_tpu))
+    if "index" in want:
+        records.append(bench_index(5_000_000 if big else 100_000))
+
+    # merge into an existing results file: re-running a subset of configs
+    # replaces those records and keeps the rest
+    merged: dict[str, dict] = {}
+    try:
+        with open(args.out) as f:
+            for r in json.load(f).get("records", []):
+                merged[r["metric"]] = r
+    except (OSError, ValueError):
+        pass
+    for r in records:
+        merged[r["metric"]] = r
+    with open(args.out, "w") as f:
+        json.dump(
+            {
+                "platform": jax.devices()[0].device_kind,
+                "records": list(merged.values()),
+            },
+            f,
+            indent=1,
+        )
+
+
+if __name__ == "__main__":
+    main()

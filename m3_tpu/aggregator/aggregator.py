@@ -24,7 +24,12 @@ import numpy as np
 from ..metrics.policy import StoragePolicy
 from ..metrics.types import AggregationType, MetricType, Untimed
 from ..utils.hash import shard_for
-from .kernels import aggregate_segments, segment_quantiles, window_keys
+from .kernels import (
+    aggregate_dense,
+    dense_quantiles,
+    pack_dense_groups,
+    window_keys,
+)
 
 
 @dataclass
@@ -363,7 +368,10 @@ class Aggregator:
         n_metrics = len(shard.ids)
         keys, widx, torder = window_keys(ids, ts, w0, res, n_windows)
         n_groups = n_metrics * n_windows
-        agg = aggregate_segments(keys, vals, torder, n_groups)
+        # dense TPU path: host densify → vector reductions (segment_* would
+        # lower to device scatters, see kernels.py dense section)
+        dvals, dtor, dvalid = pack_dense_groups(keys, vals, torder, n_groups)
+        agg = aggregate_dense(dvals, dtor, dvalid)
 
         # quantiles only for groups containing timer values
         need_q = sorted(
@@ -379,7 +387,7 @@ class Aggregator:
         )
         quantiles = {}
         if need_q:
-            qvals = np.asarray(segment_quantiles(keys, vals, n_groups, tuple(need_q)))
+            qvals = np.asarray(dense_quantiles(dvals, dvalid, tuple(need_q)))
             quantiles = {q: qvals[i] for i, q in enumerate(need_q)}
 
         count = np.asarray(agg.count)

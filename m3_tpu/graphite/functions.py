@@ -582,3 +582,491 @@ def random_walk(ctx, name="randomWalk"):
     # uses it for demos only)
     t = np.arange(ctx.steps, dtype=float)
     return [GSeries(str(name), np.sin(t / 3.0))]
+
+
+# ---------------------------------------------------------------------------
+# round-4 breadth pass: the remaining reference registrations
+# (builtin_functions.go MustRegisterFunction list)
+# ---------------------------------------------------------------------------
+
+
+@func("group")
+def group(ctx, *lists):
+    """Flatten several series lists into one (builtin_functions.go group)."""
+    out = []
+    for lst in lists:
+        out.extend(lst)
+    return out
+
+
+@func("identity", "timeFunction")
+def identity_fn(ctx, name="identity"):
+    """Value at each step = the step's unix timestamp in seconds."""
+    t = (ctx.start_nanos + ctx.step_nanos * np.arange(ctx.steps)) / NANOS
+    return [GSeries(str(name), t.astype(float))]
+
+
+@func("threshold")
+def threshold(ctx, value, label=None, color=None):
+    name = str(label) if label is not None else f"{float(value):g}"
+    return [GSeries(name, np.full(ctx.steps, float(value)))]
+
+
+@func("aggregateLine")
+def aggregate_line(ctx, series, fn="avg"):
+    """Constant line at the aggregate of the FIRST series
+    (builtin_functions.go:1538)."""
+    if not series:
+        raise ValueError("aggregateLine: empty series list")
+    v = _series_agg(series[0], str(fn))
+    return [GSeries(f"aggregateLine({series[0].name},{v:.6f})",
+                    np.full(ctx.steps, v))]
+
+
+@func("fallbackSeries")
+def fallback_series(ctx, series, fallback):
+    """series if non-empty, else the fallback list."""
+    return series if series else fallback
+
+
+@func("dashed")
+def dashed(ctx, series, dash_length=5):
+    return [
+        s.with_values(s.values, f"dashed({s.name}, {float(dash_length):g})")
+        for s in series
+    ]
+
+
+@func("consolidateBy")
+def consolidate_by(ctx, series, fn="average"):
+    """Rendering-consolidation hint: renames; values pass through."""
+    return [
+        s.with_values(s.values, f'consolidateBy({s.name},"{fn}")') for s in series
+    ]
+
+
+@func("changed")
+def changed_fn(ctx, series):
+    """1 when the value changed vs the LAST NON-NULL value, else 0 — the
+    reference carries previous across NaN gaps (common.Changed,
+    basic_functions.go:251): [2, NaN, 3] → [0, 0, 1]."""
+    out = []
+    for s in series:
+        v = s.values
+        # forward-fill the previous non-null value
+        idx = np.where(~np.isnan(v), np.arange(len(v)), -1)
+        ffi = np.maximum.accumulate(idx)
+        prev_i = np.concatenate([[-1], ffi[:-1]])
+        prev = np.where(prev_i >= 0, v[np.maximum(prev_i, 0)], np.nan)
+        ch = (~np.isnan(v)) & (~np.isnan(prev)) & (v != prev)
+        out.append(s.with_values(ch.astype(float), f"changed({s.name})"))
+    return out
+
+
+@func("isNonNull")
+def is_non_null(ctx, series):
+    return [
+        s.with_values((~np.isnan(s.values)).astype(float), f"isNonNull({s.name})")
+        for s in series
+    ]
+
+
+@func("offsetToZero")
+def offset_to_zero(ctx, series):
+    out = []
+    for s in series:
+        m = np.nanmin(s.values) if not np.all(np.isnan(s.values)) else 0.0
+        out.append(s.with_values(s.values - m, f"offsetToZero({s.name})"))
+    return out
+
+
+@func("squareRoot")
+def square_root(ctx, series):
+    with np.errstate(invalid="ignore"):
+        return [
+            s.with_values(np.sqrt(s.values), f"squareRoot({s.name})")
+            for s in series
+        ]
+
+
+@func("rangeOfSeries")
+def range_of_series(ctx, *lists):
+    series = [s for lst in lists for s in lst]
+    return _combine("rangeOfSeries", series,
+                    lambda a: _nan_fn(np.nanmax, a) - _nan_fn(np.nanmin, a))
+
+
+def _graphite_percentile(arr: np.ndarray, pct: float, interpolate=False, axis=0):
+    """GetPercentile (common/percentiles.go:75): rank = ceil(p/100 * n) on
+    the sorted non-NaN values; optional linear interpolation from the
+    previous rank. NOT numpy's linear-interpolation percentile."""
+    a = np.moveaxis(np.asarray(arr, float), axis, -1)
+    sv = np.sort(a, axis=-1)  # NaNs sort to the end
+    cnt = (~np.isnan(a)).sum(axis=-1)
+    frac_rank = (pct / 100.0) * cnt
+    rank = np.ceil(frac_rank).astype(int)
+    ri = np.clip(rank - 1, 0, np.maximum(cnt - 1, 0))
+    out = np.take_along_axis(sv, ri[..., None], axis=-1)[..., 0]
+    if interpolate:
+        prev = np.take_along_axis(
+            sv, np.clip(rank - 2, 0, np.maximum(cnt - 1, 0))[..., None], axis=-1
+        )[..., 0]
+        frac = frac_rank - (rank - 1)
+        out = np.where(rank > 1, prev + frac * (out - prev), out)
+    return np.where(cnt > 0, out, np.nan)
+
+
+@func("percentileOfSeries")
+def percentile_of_series(ctx, series, n, interpolate=False):
+    """Cross-series nth percentile per step (reference rank method)."""
+    if not series:
+        return []
+    vals = _graphite_percentile(_stack(series), float(n), bool(interpolate), axis=0)
+    name = f"percentileOfSeries({series[0].name},{float(n):g})"
+    return [GSeries(name, vals)]
+
+
+@func("removeEmptySeries")
+def remove_empty_series(ctx, series):
+    return [s for s in series if not np.all(np.isnan(s.values))]
+
+
+@func("removeAbovePercentile")
+def remove_above_percentile(ctx, series, n):
+    out = []
+    for s in series:
+        if np.all(np.isnan(s.values)):
+            out.append(s)
+            continue
+        p = _graphite_percentile(s.values, float(n))
+        v = np.where(s.values > p, np.nan, s.values)
+        out.append(s.with_values(v, f"removeAbovePercentile({s.name}, {float(n):g})"))
+    return out
+
+
+@func("removeBelowPercentile")
+def remove_below_percentile(ctx, series, n):
+    out = []
+    for s in series:
+        if np.all(np.isnan(s.values)):
+            out.append(s)
+            continue
+        p = _graphite_percentile(s.values, float(n))
+        v = np.where(s.values < p, np.nan, s.values)
+        out.append(s.with_values(v, f"removeBelowPercentile({s.name}, {float(n):g})"))
+    return out
+
+
+@func("currentBelow")
+def current_below(ctx, series, n):
+    def last_val(s):
+        v = s.values[~np.isnan(s.values)]
+        return v[-1] if len(v) else np.nan
+    return [s for s in series if not np.isnan(last_val(s)) and last_val(s) <= float(n)]
+
+
+@func("mostDeviant")
+def most_deviant(ctx, series, n):
+    """Top-n series by population stddev (ignoring NaN)."""
+    def dev(s):
+        v = s.values[~np.isnan(s.values)]
+        return float(np.std(v)) if len(v) else -1.0
+    ranked = sorted(series, key=dev, reverse=True)
+    return ranked[: int(n)]
+
+
+@func("stdev", "stddev")
+def stdev_fn(ctx, series, points, window_tolerance=0.1):
+    """Moving population stddev over a point-count window
+    (builtin_functions.go stdev: emit NaN until the window holds at least
+    windowTolerance of its points)."""
+    npts = max(int(points), 1)
+    out = []
+    for s in series:
+        v = s.values
+        padded = np.concatenate([np.full(npts - 1, np.nan), v])
+        w = np.lib.stride_tricks.sliding_window_view(padded, npts)
+        valid = ~np.isnan(w)
+        cnt = valid.sum(axis=1)
+        with np.errstate(all="ignore"):
+            sd = np.where(cnt > 0, np.nanstd(np.where(valid, w, np.nan), axis=1), np.nan)
+        sd = np.where(cnt >= max(1, int(np.ceil(float(window_tolerance) * npts))), sd, np.nan)
+        out.append(s.with_values(sd, f"stddev({s.name},{npts})"))
+    return out
+
+
+@func("substr")
+def substr(ctx, series, start=0, stop=0):
+    out = []
+    for s in series:
+        parts = _base_path(s.name).split(".")
+        a, b = int(start), int(stop)
+        sel = parts[a:] if b == 0 else parts[a:b]
+        out.append(s.with_values(s.values, ".".join(sel)))
+    return out
+
+
+@func("aliasByMetric")
+def alias_by_metric(ctx, series):
+    return [
+        s.with_values(s.values, _base_path(s.name).split(".")[-1]) for s in series
+    ]
+
+
+@func("legendValue")
+def legend_value(ctx, series, *value_types):
+    out = []
+    for s in series:
+        name = s.name
+        for vt in value_types:
+            name += f" ({vt}: {_series_agg(s, str(vt)):g})"
+        out.append(s.with_values(s.values, name))
+    return out
+
+
+@func("cactiStyle")
+def cacti_style(ctx, series, system=None):
+    out = []
+    for s in series:
+        cur = s.values[~np.isnan(s.values)]
+        current = cur[-1] if len(cur) else np.nan
+        mx = np.nanmax(s.values) if len(cur) else np.nan
+        mn = np.nanmin(s.values) if len(cur) else np.nan
+        out.append(s.with_values(
+            s.values,
+            f"{s.name} Current:{current:g} Max:{mx:g} Min:{mn:g}",
+        ))
+    return out
+
+
+@func("sustainedAbove")
+def sustained_above(ctx, series, threshold_v, interval):
+    return _sustained(ctx, series, float(threshold_v), interval,
+                      lambda v, t: v >= t,
+                      float(threshold_v) - abs(float(threshold_v)),
+                      "sustainedAbove")
+
+
+@func("sustainedBelow")
+def sustained_below(ctx, series, threshold_v, interval):
+    return _sustained(ctx, series, float(threshold_v), interval,
+                      lambda v, t: v <= t,
+                      float(threshold_v) + abs(float(threshold_v)),
+                      "sustainedBelow")
+
+
+def _sustained(ctx, series, thresh, interval, cmp, zero_value, fname):
+    """builtin_functions.go:401 sustainedCompare: emit the value only once
+    the comparison has held for >= interval; else the zero value."""
+    min_steps = max(int(parse_interval(interval) // ctx.step_nanos), 1)
+    out = []
+    for s in series:
+        v = s.values
+        ok = cmp(np.nan_to_num(v, nan=np.inf if fname == "sustainedBelow" else -np.inf), thresh)
+        # run length of consecutive ok up to each index
+        run = np.zeros(len(v), int)
+        c = 0
+        for i, o in enumerate(ok):
+            c = c + 1 if o else 0
+            run[i] = c
+        vals = np.where(run >= min_steps, v, zero_value)
+        out.append(s.with_values(vals, f"{fname}({s.name}, {thresh:f}, '{interval}')"))
+    return out
+
+
+@func("hitcount")
+def hitcount(ctx, series, interval, align_to_interval=False):
+    """Rate × time per bucket (builtin_functions.go:1042): estimates the
+    number of hits per interval from a per-second rate series."""
+    iv_s = parse_interval(interval) / NANOS
+    step_s = ctx.step_nanos / NANOS
+    out = []
+    for s in series:
+        total_s = ctx.steps * step_s
+        buckets = int(np.ceil(total_s / iv_s))
+        # buckets align to the series END (builtin_functions.go:1057
+        # newStart = end - bucketCount*interval); empty buckets stay NaN
+        new_start = total_s - buckets * iv_s
+        acc = np.full(buckets, np.nan)
+
+        def add(b, amount):
+            acc[b] = amount if np.isnan(acc[b]) else acc[b] + amount
+
+        start_s = np.arange(ctx.steps) * step_s - new_start
+        end_s = start_s + step_s
+        for i, v in enumerate(s.values):
+            if np.isnan(v):
+                continue
+            b0 = max(int(start_s[i] // iv_s), 0)
+            b1 = int(end_s[i] // iv_s)
+            if b1 >= buckets:
+                b1 = buckets - 1
+                end_here = buckets * iv_s
+            else:
+                end_here = end_s[i]
+            if b0 == b1:
+                add(b0, v * (end_here - start_s[i]))
+            else:
+                add(b0, v * (iv_s * (b0 + 1) - start_s[i]))
+                for j in range(b0 + 1, b1):
+                    add(j, v * iv_s)
+                rem = end_here - iv_s * b1
+                if rem > 0:
+                    add(b1, v * rem)
+        out.append(GSeries(f'hitcount({s.name}, "{interval}")', acc))
+    return out
+
+
+@func("weightedAverage")
+def weighted_average(ctx, series, weights, node):
+    """Pair value/weight series by path node; sum(v*w)/sum(w) per step
+    (aggregation_functions.go:317)."""
+    def key(s):
+        parts = _base_path(s.name).split(".")
+        n = int(node)
+        return parts[n] if -len(parts) <= n < len(parts) else ""
+    vals = {key(s): s for s in series}
+    wts = {key(s): s for s in weights}
+    prods, ws = [], []
+    for k in sorted(vals):
+        if k not in wts:
+            continue
+        prods.append(vals[k].values * wts[k].values)
+        ws.append(wts[k].values)
+    if not prods:
+        return []
+    num = _nan_fn(np.nansum, np.stack(prods))
+    den = _nan_fn(np.nansum, np.stack(ws))
+    with np.errstate(all="ignore"):
+        out = np.where(den != 0, num / den, np.nan)
+    return [GSeries(f"weightedAverage({len(prods)} series)", out)]
+
+
+def _with_wildcards(name, series, positions, reducer):
+    groups: dict[str, list] = {}
+    for s in series:
+        parts = _base_path(s.name).split(".")
+        kept = [p for i, p in enumerate(parts) if i not in positions]
+        groups.setdefault(".".join(kept), []).append(s)
+    out = []
+    for k in sorted(groups):
+        arr = _stack(groups[k])
+        out.append(GSeries(k, _nan_fn(reducer, arr)))
+    return out
+
+
+@func("sumSeriesWithWildcards")
+def sum_series_with_wildcards(ctx, series, *positions):
+    return _with_wildcards("sum", series, {int(p) for p in positions}, np.nansum)
+
+
+@func("averageSeriesWithWildcards")
+def average_series_with_wildcards(ctx, series, *positions):
+    return _with_wildcards("avg", series, {int(p) for p in positions}, np.nanmean)
+
+
+# --- Holt-Winters family (builtin_functions.go:1222-1420) ---
+
+_HW_ALPHA, _HW_BETA, _HW_GAMMA = 0.1, 0.0035, 0.1
+
+
+def _hw_analysis(values: np.ndarray, season_steps: int):
+    """Triple exponential smoothing exactly as holtWintersAnalysis — same
+    constants, same NaN handling. NOTE: the reference bootstraps with an
+    extra week of history (FetchWithBootstrap); this engine warms up over
+    the requested range instead, so early predictions differ until one
+    season of data has passed."""
+    n = len(values)
+    intercepts = np.full(n, np.nan)
+    slopes = np.zeros(n)
+    seasonals = np.zeros(n)
+    predictions = np.full(n, np.nan)
+    deviations = np.zeros(n)
+
+    def last_seasonal(i):
+        j = i - season_steps
+        return seasonals[j] if j >= 0 else 0.0
+
+    def last_deviation(i):
+        j = i - season_steps
+        return deviations[j] if j >= 0 else 0.0
+
+    next_pred = np.nan
+    for i in range(n):
+        actual = values[i]
+        if np.isnan(actual):
+            intercepts[i] = np.nan
+            predictions[i] = next_pred
+            deviations[i] = 0.0
+            next_pred = np.nan
+            continue
+        if i == 0:
+            last_intercept, last_slope, prediction = actual, 0.0, actual
+        else:
+            last_intercept = intercepts[i - 1]
+            last_slope = slopes[i - 1]
+            if np.isnan(last_intercept):
+                last_intercept = actual
+            prediction = next_pred
+        last_season = last_seasonal(i)
+        intercept = _HW_ALPHA * (actual - last_season) + (1 - _HW_ALPHA) * (
+            last_intercept + last_slope
+        )
+        intercepts[i] = intercept
+        slope = _HW_BETA * (intercept - last_intercept) + (1 - _HW_BETA) * last_slope
+        slopes[i] = slope
+        seasonals[i] = _HW_GAMMA * (actual - intercept) + (1 - _HW_GAMMA) * last_season
+        next_pred = intercept + slope + last_seasonal(i + 1)
+        pred_for_dev = 0.0 if np.isnan(prediction) else prediction
+        predictions[i] = prediction
+        deviations[i] = _HW_GAMMA * abs(actual - pred_for_dev) + (
+            1 - _HW_GAMMA
+        ) * last_deviation(i)
+    return predictions, deviations
+
+
+def _hw_season_steps(ctx) -> int:
+    return max(int(86400 * NANOS // ctx.step_nanos), 1)
+
+
+@func("holtWintersForecast")
+def holt_winters_forecast(ctx, series):
+    season = _hw_season_steps(ctx)
+    return [
+        s.with_values(
+            _hw_analysis(s.values, season)[0], f"holtWintersForecast({s.name})"
+        )
+        for s in series
+    ]
+
+
+@func("holtWintersConfidenceBands")
+def holt_winters_confidence_bands(ctx, series, delta=3):
+    season = _hw_season_steps(ctx)
+    out = []
+    for s in series:
+        pred, dev = _hw_analysis(s.values, season)
+        up = np.where(~np.isnan(pred), pred + float(delta) * dev, np.nan)
+        lo = np.where(~np.isnan(pred), pred - float(delta) * dev, np.nan)
+        out.append(s.with_values(lo, f"holtWintersConfidenceLower({s.name})"))
+        out.append(s.with_values(up, f"holtWintersConfidenceUpper({s.name})"))
+    return out
+
+
+@func("holtWintersAberration")
+def holt_winters_aberration(ctx, series, delta=3):
+    season = _hw_season_steps(ctx)
+    out = []
+    for s in series:
+        pred, dev = _hw_analysis(s.values, season)
+        up = pred + float(delta) * dev
+        lo = pred - float(delta) * dev
+        v = s.values
+        ab = np.zeros(len(v))
+        with np.errstate(invalid="ignore"):
+            above = (~np.isnan(v)) & (~np.isnan(up)) & (v > up)
+            below = (~np.isnan(v)) & (~np.isnan(lo)) & (v < lo)
+        ab[above] = (v - up)[above]
+        ab[below] = (v - lo)[below]
+        out.append(s.with_values(ab, f"holtWintersAberration({s.name})"))
+    return out

@@ -23,7 +23,7 @@ from .query import Query, execute
 from .segment import Document, MutableSegment, SealedSegment
 
 _SEG_MAGIC = 0x6D334958  # "m3IX"
-_SEG_FILE_RE = _re.compile(r"^segments-(-?\d+)\.db$")
+_SEG_FILE_RE = _re.compile(r"^segments-(-?\d+)\.(db|idx)$")
 
 
 class IndexBlock:
@@ -63,6 +63,11 @@ class NamespaceIndex:
         # the index has its own lock (storage/index.go insert queue +
         # RWMutex role); hot write/query paths no longer ride the db lock
         self.lock = threading.RLock()
+        # computed postings for regexp/field scans over immutable segments
+        # (postings_list_cache.go:59)
+        from .postings_cache import PostingsListCache
+
+        self.postings_cache = PostingsListCache()
 
     def _block_for(self, t_nanos: int) -> IndexBlock:
         bs = (t_nanos // self.block_size) * self.block_size
@@ -92,7 +97,7 @@ class NamespaceIndex:
                 if bs + self.block_size <= start_nanos or bs >= end_nanos:
                     continue
                 segs.extend(self.blocks[bs].segments)
-        docs = execute(segs, q, limit=limit)
+        docs = execute(segs, q, limit=limit, cache=self.postings_cache)
         exhaustive = limit is None or len(docs) < limit
         return QueryResult(docs=docs, exhaustive=exhaustive)
 
@@ -164,10 +169,16 @@ class NamespaceIndex:
         return os.path.join(base, "index", ns_name)
 
     def persist_before(self, base: str, ns_name: str, t_nanos: int) -> list[str]:
-        """Seal blocks entirely before the cutoff and write each DIRTY
-        block's sealed segments to one atomically-replaced file
-        (utils/blob.py framing). Unchanged blocks are skipped so flush cost
-        does not grow with retention. Returns paths written."""
+        """Seal blocks entirely before the cutoff; each DIRTY block's
+        sealed segments are COMPACTED into one immutable segment
+        (builder/multi_segments role) and written in the mmap format
+        (disk_segment.py, the fst segment file's role) with an atomic
+        replace. The in-memory sealed list is then swapped for the
+        zero-copy DiskSegment, so a persisted block's memory cost is page
+        cache, not heap. Unchanged blocks are skipped. Returns paths."""
+        from .disk_segment import DiskSegment, write_disk_segment
+        from .segment import merge_segments
+
         self.seal_before(t_nanos)
         out = []
         d = self._seg_dir(base, ns_name)
@@ -176,15 +187,22 @@ class NamespaceIndex:
         for bs, blk in blocks:
             if bs + self.block_size > t_nanos or not blk.sealed:
                 continue
-            path = os.path.join(d, f"segments-{bs}.db")
+            path = os.path.join(d, f"segments-{bs}.idx")
             if not blk.dirty and os.path.exists(path):
                 continue
-            payloads = [seg.serialize() for seg in blk.sealed]
-            body = struct.pack("<I", len(payloads)) + b"".join(
-                struct.pack("<Q", len(p)) + p for p in payloads
+            os.makedirs(d, exist_ok=True)
+            seg = (
+                blk.sealed[0]
+                if len(blk.sealed) == 1
+                else merge_segments(blk.sealed)
             )
-            write_atomic_checked_blob(path, _SEG_MAGIC, body)
-            blk.dirty = False
+            write_disk_segment(path, seg)
+            with self.lock:
+                blk.sealed = [DiskSegment(path)]
+                blk.dirty = False
+            legacy = os.path.join(d, f"segments-{bs}.db")
+            if os.path.exists(legacy):
+                os.remove(legacy)
             out.append(path)
         return out
 
@@ -197,26 +215,40 @@ class NamespaceIndex:
             names = os.listdir(d)
         except FileNotFoundError:
             return set()
-        loaded: set[int] = set()
+        # one file per block; the mmap format wins over a legacy leftover
+        chosen: dict[int, tuple[str, str]] = {}
         for n in sorted(names):
             m = _SEG_FILE_RE.match(n)
             if not m:
                 continue
-            bs = int(m.group(1))
-            body = read_checked_blob(os.path.join(d, n), _SEG_MAGIC)
-            if body is None:
-                continue
-            try:
-                (count,) = struct.unpack_from("<I", body, 0)
-                pos = 4
-                segs = []
-                for _ in range(count):
-                    (ln,) = struct.unpack_from("<Q", body, pos)
-                    pos += 8
-                    segs.append(SealedSegment.deserialize(body[pos : pos + ln]))
-                    pos += ln
-            except (struct.error, ValueError):
-                continue
+            bs, kind = int(m.group(1)), m.group(2)
+            if bs not in chosen or kind == "idx":
+                chosen[bs] = (kind, n)
+        loaded: set[int] = set()
+        for bs, (kind, n) in sorted(chosen.items()):
+            if kind == "idx":
+                # mmap format: open is O(1), nothing deserialized
+                from .disk_segment import DiskSegment
+
+                try:
+                    segs = [DiskSegment(os.path.join(d, n))]
+                except (ValueError, OSError):
+                    continue
+            else:  # legacy in-memory blob format
+                body = read_checked_blob(os.path.join(d, n), _SEG_MAGIC)
+                if body is None:
+                    continue
+                try:
+                    (count,) = struct.unpack_from("<I", body, 0)
+                    pos = 4
+                    segs = []
+                    for _ in range(count):
+                        (ln,) = struct.unpack_from("<Q", body, pos)
+                        pos += 8
+                        segs.append(SealedSegment.deserialize(body[pos : pos + ln]))
+                        pos += ln
+                except (struct.error, ValueError):
+                    continue
             blk = self._block_for(bs)
             blk.sealed = segs
             blk.dirty = False

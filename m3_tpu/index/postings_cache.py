@@ -1,0 +1,65 @@
+"""Postings-list LRU cache.
+
+Reference: /root/reference/src/dbnode/storage/index/postings_list_cache.go:59
+— the reference caches computed postings lists per (segment, pattern) for
+regexp/term searches so repeated queries against immutable segments skip
+the FST walk. Here the cache keys (segment, kind, field, pattern); only
+IMMUTABLE segments (sealed / on-disk) are cacheable — mutable segments
+mutate under writes, so they bypass the cache entirely.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+_seg_keys = itertools.count(1)
+
+
+def segment_cache_key(seg) -> int | None:
+    """Stable per-immutable-segment identity; None = not cacheable."""
+    # mutable segments grow in place: never cache them
+    if hasattr(seg, "insert"):
+        return None
+    key = getattr(seg, "_plc_key", None)
+    if key is None:
+        key = next(_seg_keys)
+        try:
+            seg._plc_key = key
+        except AttributeError:
+            return None
+    return key
+
+
+class PostingsListCache:
+    """LRU of computed postings arrays."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._od: OrderedDict[tuple, np.ndarray] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> np.ndarray | None:
+        with self._lock:
+            arr = self._od.get(key)
+            if arr is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            self.hits += 1
+            return arr
+
+    def put(self, key: tuple, arr: np.ndarray) -> None:
+        with self._lock:
+            self._od[key] = arr
+            self._od.move_to_end(key)
+            while len(self._od) > self.capacity:
+                self._od.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._od)

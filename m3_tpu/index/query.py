@@ -79,28 +79,57 @@ def neg(q: Query) -> NegationQuery:
     return NegationQuery(q)
 
 
-def search_segment(seg, query: Query) -> np.ndarray:
-    """Postings for one segment (search/searcher dispatch); sorted unique."""
+def search_segment(seg, query: Query, cache=None) -> np.ndarray:
+    """Postings for one segment (search/searcher dispatch); sorted unique.
+
+    ``cache`` is a PostingsListCache: regexp/field scans over IMMUTABLE
+    segments are O(total terms) to compute, so repeated queries serve from
+    the LRU (postings_list_cache.go:59)."""
     if isinstance(query, TermQuery):
         return np.asarray(seg.postings(query.field, query.value), np.int32)
     if isinstance(query, RegexpQuery):
+        hit, key = _cache_lookup(cache, seg, ("re", query.field, query.pattern))
+        if hit is not None:
+            return hit
         if hasattr(seg, "postings_regexp"):
-            return seg.postings_regexp(query.field, query.pattern)
-        import re
+            out = seg.postings_regexp(query.field, query.pattern)
+        else:
+            import re
 
-        rx = re.compile(b"^(?:" + query.pattern + b")$")
-        out = [
-            np.asarray(seg.postings(query.field, t), np.int32)
-            for t in seg.terms(query.field)
-            if rx.match(t)
-        ]
-        return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int32)
+            rx = re.compile(b"^(?:" + query.pattern + b")$")
+            if hasattr(seg, "postings_for_terms"):
+                out = seg.postings_for_terms(query.field, rx.match)
+            else:
+                found = [
+                    np.asarray(seg.postings(query.field, t), np.int32)
+                    for t in seg.terms(query.field)
+                    if rx.match(t)
+                ]
+                out = (
+                    np.unique(np.concatenate(found))
+                    if found
+                    else np.zeros(0, np.int32)
+                )
+        if key is not None:
+            cache.put(key, out)
+        return out
     if isinstance(query, FieldQuery):
-        out = [
-            np.asarray(seg.postings(query.field, t), np.int32)
-            for t in seg.terms(query.field)
-        ]
-        return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int32)
+        hit, key = _cache_lookup(cache, seg, ("field", query.field))
+        if hit is not None:
+            return hit
+        if hasattr(seg, "postings_for_terms"):
+            out = seg.postings_for_terms(query.field, lambda t: True)
+        else:
+            found = [
+                np.asarray(seg.postings(query.field, t), np.int32)
+                for t in seg.terms(query.field)
+            ]
+            out = (
+                np.unique(np.concatenate(found)) if found else np.zeros(0, np.int32)
+            )
+        if key is not None:
+            cache.put(key, out)
+        return out
     if isinstance(query, AllQuery):
         return np.arange(len(seg), dtype=np.int32)
     if isinstance(query, ConjunctionQuery):
@@ -110,32 +139,49 @@ def search_segment(seg, query: Query) -> np.ndarray:
         pos = [q for q in query.queries if not isinstance(q, NegationQuery)]
         negs = [q for q in query.queries if isinstance(q, NegationQuery)]
         if pos:
-            acc = search_segment(seg, pos[0])
+            acc = search_segment(seg, pos[0], cache)
             for q in pos[1:]:
-                acc = np.intersect1d(acc, search_segment(seg, q), assume_unique=False)
+                acc = np.intersect1d(
+                    acc, search_segment(seg, q, cache), assume_unique=False
+                )
         else:
             acc = np.arange(len(seg), dtype=np.int32)
         for q in negs:
-            acc = np.setdiff1d(acc, search_segment(seg, q.query), assume_unique=False)
+            acc = np.setdiff1d(
+                acc, search_segment(seg, q.query, cache), assume_unique=False
+            )
         return acc.astype(np.int32)
     if isinstance(query, DisjunctionQuery):
-        out = [search_segment(seg, q) for q in query.queries]
+        out = [search_segment(seg, q, cache) for q in query.queries]
         out = [o for o in out if len(o)]
         return np.unique(np.concatenate(out)).astype(np.int32) if out else np.zeros(0, np.int32)
     if isinstance(query, NegationQuery):
         return np.setdiff1d(
-            np.arange(len(seg), dtype=np.int32), search_segment(seg, query.query)
+            np.arange(len(seg), dtype=np.int32), search_segment(seg, query.query, cache)
         ).astype(np.int32)
     raise TypeError(f"unknown query {query!r}")
 
 
-def execute(segments, query: Query, limit: int | None = None) -> list[Document]:
+def _cache_lookup(cache, seg, subkey):
+    """(cached postings | None, cache key | None)."""
+    if cache is None:
+        return None, None
+    from .postings_cache import segment_cache_key
+
+    sk = segment_cache_key(seg)
+    if sk is None:
+        return None, None
+    key = (sk,) + subkey
+    return cache.get(key), key
+
+
+def execute(segments, query: Query, limit: int | None = None, cache=None) -> list[Document]:
     """search/executor: iterate matched docs across segments (docs dedupe by
     id — later segments don't re-emit ids already seen)."""
     out: list[Document] = []
     seen: set[bytes] = set()
     for seg in segments:
-        for i in search_segment(seg, query):
+        for i in search_segment(seg, query, cache):
             doc = seg.docs[int(i)]
             if doc.id in seen:
                 continue

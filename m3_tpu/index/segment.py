@@ -197,3 +197,17 @@ class SealedSegment:
         pos += 8
         data = np.frombuffer(buf, "<i4", count=raw_len // 4, offset=pos).copy()
         return SealedSegment(docs, field_terms, postings_index, data)
+
+
+def merge_segments(segments) -> "SealedSegment":
+    """Merge immutable segments into one, deduping docs by id — the
+    reference's multi-segment builder used for flush compaction
+    (m3ninx/index/segment/builder/multi_segments_*). Doc order follows the
+    input segment order (earlier segments win duplicates, matching the
+    executor's dedupe)."""
+    m = MutableSegment()
+    for seg in segments:
+        docs = seg.docs
+        for i in range(len(seg)):
+            m.insert(docs[i])
+    return m.seal()

@@ -297,6 +297,26 @@ def tile_chunked(batch: ChunkedBatch, n_series: int) -> ChunkedBatch:
     )
 
 
+def select_series(batch: ChunkedBatch, series_idx) -> ChunkedBatch:
+    """Query-fanout gather: a new ChunkedBatch holding only the selected
+    series (index query postings → decode, the config-5 fan-out shape).
+    Host-side numpy fancy indexing over the series-major lane layout."""
+    sel = np.asarray(series_idx, np.int64)
+    c = batch.num_chunks
+    lanes = (sel[:, None] * c + np.arange(c)[None, :]).ravel()
+
+    def g(x):
+        return np.ascontiguousarray(np.asarray(x)[lanes])
+
+    return ChunkedBatch(
+        **lane_kwargs(batch, transform=g),
+        k=batch.k,
+        num_series=int(sel.size),
+        num_chunks=c,
+        fast=g(batch.fast) if batch.fast is not None else None,
+    )
+
+
 def _window_columns(windows):
     """Pre-split the [N, CW] window into CW+3 column vectors (zero-padded).
 

@@ -314,7 +314,9 @@ class PackedLanes(NamedTuple):
     lanes4: np.ndarray  # u32[tiles, NLANE, R, 128]
     tile_flags: np.ndarray  # i32[tiles] 1 = every lane in tile is fast
     n: int  # true lane count (before tile padding)
-    order: str  # "c" (chunk-major) or "s" (series-major) lane ordering
+    order: str  # "c" (chunk-major), "s" (series-major), "sorted"
+    inv: np.ndarray | None = None  # "sorted": i32[S]; original series i's
+    #                                results sit at packed row inv[i]
 
 
 def pack_lane_inputs(batch, order: str = "c", rows: int = ROWS_DEFAULT) -> PackedLanes:
@@ -326,17 +328,40 @@ def pack_lane_inputs(batch, order: str = "c", rows: int = ROWS_DEFAULT) -> Packe
     series, so host-classified fast chunks (ChunkedBatch.fast) cluster into
     homogeneous tiles and the kernel picks the specialized body per tile.
     Series-major ("s") keeps the original ordering (mixed tiles, general
-    body everywhere)."""
+    body everywhere).
+
+    ``order="sorted"`` additionally PERMUTES THE SERIES AXIS so series rich
+    in fast chunks pack first: on a MIXED workload (float-mode series
+    interleaved with int gauges) chunk-major tiles would all contain some
+    slow lane and the whole batch would fall to the general body; sorting
+    series by fast-chunk count reclusters the fast majority into
+    homogeneous tiles. Permuting whole series (not individual lanes) keeps
+    the per-series reduction a plain reshape — only the [S]-sized output
+    arrays need a small inverse gather (PackedLanes.inv; a full [S*C] lane
+    gather measured ~325 ms at 8M lanes on TPU, 8x the decode itself)."""
     windows = np.asarray(batch.windows, np.uint32)
     n, cw = windows.shape
     s, c = batch.num_series, batch.num_chunks
 
+    perm_series = None
+    inv_series = None
+    if order == "sorted":
+        fast_lanes = getattr(batch, "fast", None)
+        if fast_lanes is None:
+            key = np.zeros(s, np.int64)
+        else:
+            key = np.asarray(fast_lanes, bool).reshape(s, c).sum(axis=1)
+        # stable: preserves input locality within each class
+        perm_series = np.argsort(-key, kind="stable")
+        inv_series = np.argsort(perm_series).astype(np.int32)
+
     def reorder(x):
-        if order != "c":
+        if order == "s":
             return x
-        return np.ascontiguousarray(
-            x.reshape((s, c) + x.shape[1:]).swapaxes(0, 1).reshape(x.shape)
-        )
+        xs = x.reshape((s, c) + x.shape[1:])
+        if perm_series is not None:
+            xs = xs[perm_series]
+        return np.ascontiguousarray(xs.swapaxes(0, 1).reshape(x.shape))
 
     if rows <= 0 or rows % 8:
         raise ValueError(f"rows must be a positive multiple of 8, got {rows}")
@@ -379,7 +404,8 @@ def pack_lane_inputs(batch, order: str = "c", rows: int = ROWS_DEFAULT) -> Packe
         fpad[:n] = reorder(np.asarray(fast, bool))
     tile_flags = fpad.reshape(tiles, tile_lanes).all(axis=1).astype(np.int32)
     return PackedLanes(
-        windows4=windows4, lanes4=lanes4, tile_flags=tile_flags, n=n, order=order
+        windows4=windows4, lanes4=lanes4, tile_flags=tile_flags, n=n,
+        order=order, inv=inv_series,
     )
 
 

@@ -1,0 +1,76 @@
+"""Compensated (float-float) summation for device aggregates.
+
+Reference context: the reference's query math runs in float64 end to end
+(src/query/functions/temporal/aggregation.go:62-267, ts.Datapoints are
+float64). TPUs are f32-native (f64 is software-emulated and slow), so this
+framework's aggregation paths default to f32 — fine per-window, but a
+cross-series sum over tens of millions of values accumulates rounding.
+This module provides the documented-precision option (TOLERANCE.md):
+
+- ``two_sum(a, b)``: Knuth's error-free transformation — s = fl(a+b) and
+  the EXACT rounding error e, so (s, e) represents a+b exactly.
+- ``compensated_sum(x, axis)``: binary-tree reduction carrying (hi, lo)
+  float-float pairs; the returned pair is within 1 ulp of the exact sum
+  for n ≤ 2^24 addends (vs O(log n) ulp for XLA's plain tree sum and
+  O(n) ulp for sequential f32).
+- ``dd_add(a, b)``: combine two (hi, lo) pairs — also the cross-chip
+  reduction operator: psum hi and lo separately, then renormalize.
+
+Everything is shape-polymorphic jnp and TPU-friendly: log2(n) vectorized
+combine levels, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def two_sum(a, b):
+    """Error-free transformation: a + b = s + e exactly (Knuth 2Sum)."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def fast_two_sum(a, b):
+    """Dekker's version; requires |a| >= |b| (used for renormalization)."""
+    s = a + b
+    e = b - (s - a)
+    return s, e
+
+
+def dd_add(a, b):
+    """(hi, lo) + (hi, lo) → normalized (hi, lo)."""
+    s, e = two_sum(a[0], b[0])
+    e = e + (a[1] + b[1])
+    return fast_two_sum(s, e)
+
+
+def compensated_sum(x, axis: int = -1):
+    """Float-float tree sum along ``axis``; returns (hi, lo) arrays with
+    that axis reduced. hi + lo is within ~1 ulp of the exact f32-input sum.
+    """
+    x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    hi = x
+    lo = jnp.zeros_like(x)
+    # pad to a power of two with exact zeros
+    p = 1
+    while p < n:
+        p *= 2
+    if p != n:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, p - n)]
+        hi = jnp.pad(hi, pad)
+        lo = jnp.pad(lo, pad)
+    while hi.shape[-1] > 1:
+        half = hi.shape[-1] // 2
+        a = (hi[..., :half], lo[..., :half])
+        b = (hi[..., half:], lo[..., half:])
+        hi, lo = dd_add(a, b)
+    return hi[..., 0], lo[..., 0]
+
+
+def compensated_value(pair) -> jnp.ndarray:
+    """Collapse (hi, lo) to the closest single float."""
+    return pair[0] + pair[1]

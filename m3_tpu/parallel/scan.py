@@ -98,19 +98,42 @@ def chunked_scan_aggregate(lane_args: dict, s: int, c: int, k: int, with_psum=Fa
 
 
 def _aggregates_from_lanes(
-    lane_agg, s: int, c: int, with_psum: bool, lane_order: str = "s"
+    lane_agg, s: int, c: int, with_psum: bool, lane_order: str = "s",
+    inv=None, precise: bool = False, unpermute_series: bool = True,
 ) -> ScanAggregates:
     """Reduce per-lane (per-chunk) aggregates [S*C] to ScanAggregates.
 
     ``lane_order``: "s" = series-major (lane = s*C + c), "c" = chunk-major
-    (lane = c*S + s, the specialized packed kernel layout)."""
-    if lane_order == "c":
+    (lane = c*S + s, the specialized packed kernel layout), "sorted" =
+    chunk-major with the SERIES axis permuted fast-first; ``inv`` (i32[S])
+    gathers the per-series outputs back to original order — an [S] gather,
+    not an [S*C] one (TPU gathers are expensive)."""
+    unperm = lambda x: x
+    if lane_order == "sorted":
+        rs = lambda x: x.reshape(c, s).T
+        if unpermute_series:
+            # [S]-sized gather (~20 ms/262k series on TPU) — callers that
+            # only consume cross-series totals (order-independent) pass
+            # unpermute_series=False and unpermute fetched arrays on host
+            # with PackedLanes.inv when needed
+            inv_d = jnp.asarray(inv)
+            unperm = lambda x: x[inv_d]
+    elif lane_order == "c":
         rs = lambda x: x.reshape(c, s).T
     else:
         rs = lambda x: x.reshape(s, c)
     l_sum, l_cnt = rs(lane_agg.sum), rs(lane_agg.count)
     l_min, l_max, l_last = rs(lane_agg.min), rs(lane_agg.max), rs(lane_agg.last)
-    s_sum = jnp.sum(l_sum, axis=1)
+    if precise:
+        # float-float tree sums (ops/precise.py): per-series and the
+        # cross-series total carry (hi, lo) pairs — ~1 ulp of exact vs
+        # O(log n) ulp for the plain tree (TOLERANCE.md)
+        from ..ops import precise as pr
+
+        sp_hi, sp_lo = pr.compensated_sum(l_sum, axis=1)
+        s_sum = sp_hi + sp_lo
+    else:
+        s_sum = jnp.sum(l_sum, axis=1)
     s_count = jnp.sum(l_cnt, axis=1)
     s_min = jnp.min(l_min, axis=1)
     s_max = jnp.max(l_max, axis=1)
@@ -121,23 +144,44 @@ def _aggregates_from_lanes(
     s_last = jnp.where(last_c >= 0, s_last, jnp.nan)
 
     has = s_count > 0
-    t_sum = jnp.sum(jnp.where(has, s_sum, 0.0))
+    if precise:
+        from ..ops import precise as pr
+
+        t_pair = pr.compensated_sum(jnp.where(has, sp_hi, 0.0)[None, :], axis=1)
+        t_lo_pair = pr.compensated_sum(jnp.where(has, sp_lo, 0.0)[None, :], axis=1)
+        t_pair = pr.dd_add(
+            (t_pair[0][0], t_pair[1][0]), (t_lo_pair[0][0], t_lo_pair[1][0])
+        )
+        t_sum = None  # assembled below (pair form survives the psum)
+    else:
+        t_sum = jnp.sum(jnp.where(has, s_sum, 0.0))
     t_count = jnp.sum(s_count)
     t_min = jnp.min(jnp.where(has, s_min, jnp.inf))
     t_max = jnp.max(jnp.where(has, s_max, -jnp.inf))
     if with_psum:
-        t_sum = jax.lax.psum(t_sum, SHARD_AXIS)
+        if precise:
+            from ..ops import precise as pr
+
+            # psum hi and lo separately; renormalize after the collective
+            t_pair = pr.fast_two_sum(
+                jax.lax.psum(t_pair[0], SHARD_AXIS),
+                jax.lax.psum(t_pair[1], SHARD_AXIS),
+            )
+        else:
+            t_sum = jax.lax.psum(t_sum, SHARD_AXIS)
         t_count = jax.lax.psum(t_count, SHARD_AXIS)
         t_min = jax.lax.pmin(t_min, SHARD_AXIS)
         t_max = jax.lax.pmax(t_max, SHARD_AXIS)
+    if precise:
+        t_sum = t_pair[0] + t_pair[1]
     t_min = jnp.where(t_count > 0, t_min, jnp.nan)
     t_max = jnp.where(t_count > 0, t_max, jnp.nan)
     return ScanAggregates(
-        series_sum=s_sum,
-        series_count=s_count,
-        series_min=jnp.where(has, s_min, jnp.nan),
-        series_max=jnp.where(has, s_max, jnp.nan),
-        series_last=s_last,
+        series_sum=unperm(s_sum),
+        series_count=unperm(s_count),
+        series_min=unperm(jnp.where(has, s_min, jnp.nan)),
+        series_max=unperm(jnp.where(has, s_max, jnp.nan)),
+        series_last=unperm(s_last),
         total_sum=t_sum,
         total_count=t_count,
         total_min=t_min,
@@ -165,18 +209,23 @@ def chunked_scan_aggregate_fused(
 def chunked_scan_aggregate_packed(
     windows4, lanes4, tile_flags=None, n: int = 0, s: int = 0, c: int = 0,
     k: int = 0, with_psum=False, interpret: bool = False,
-    lane_order: str = "c",
+    lane_order: str = "c", inv=None, precise: bool = False,
+    unpermute_series: bool = True,
 ):
     """Packed-layout flagship path: 3 contiguous DMAs per Pallas grid program
     (ops/fused.py packed kernel). Inputs come from fused.pack_lane_inputs;
     ``tile_flags`` routes homogeneous fast tiles through the specialized
-    all-int body."""
+    all-int body; ``inv`` (with lane_order="sorted") gathers the fast-first
+    permuted lanes back to series order."""
     from ..ops import fused
 
     lane_agg = fused.lane_aggregates_packed(
         windows4, lanes4, tile_flags, n=n, k=k, interpret=interpret
     )
-    return _aggregates_from_lanes(lane_agg, s, c, with_psum, lane_order=lane_order)
+    return _aggregates_from_lanes(
+        lane_agg, s, c, with_psum, lane_order=lane_order, inv=inv,
+        precise=precise, unpermute_series=unpermute_series,
+    )
 
 
 def chunked_device_args(batch: ChunkedBatch, device_put=True) -> dict:

@@ -98,6 +98,8 @@ class CommitLog:
         self._pending = 0
         self._active_entries = 0
         self._closed = False
+        self._failed: BaseException | None = None
+        self._inflight = None  # command being served by the writer thread
         # serializes enqueue vs close: once close() wins, no barrier/entry
         # command can slip into the queue behind the 'close' command (it
         # would never be serviced — its waiter would hang forever). The
@@ -122,6 +124,10 @@ class CommitLog:
 
     # --- caller-facing surface ---
 
+    def _check_failed(self) -> None:
+        if self._failed is not None:
+            raise RuntimeError("commit log writer failed") from self._failed
+
     def _enqueue(self, cmd) -> bool:
         """Enqueue unless closed. Returns False when the log is closed."""
         with self._qlock:
@@ -133,6 +139,7 @@ class CommitLog:
     def write(self, entry: CommitLogEntry) -> None:
         if self.write_behind:
             if not self._enqueue(("entry", entry)):  # blocks when full
+                self._check_failed()
                 raise ValueError("commit log is closed")
         else:
             with self._wlock:
@@ -161,6 +168,7 @@ class CommitLog:
             ev = threading.Event()
             if self._enqueue(("flush", ev)):
                 ev.wait()
+            self._check_failed()
         else:
             with self._wlock:
                 if not self._closed:
@@ -205,8 +213,39 @@ class CommitLog:
     # --- writer thread (single owner of the file in write-behind mode) ---
 
     def _writer_loop(self) -> None:
+        try:
+            self._writer_loop_inner()
+        except BaseException as exc:  # disk full, fd error, ...
+            # a dead writer must not hang the process: record the failure,
+            # refuse further work, and release every barrier waiter —
+            # INCLUDING the command that was in flight when the failure
+            # struck (it was already dequeued, so the drain below would
+            # miss it). Callers re-raise via _check_failed.
+            self._failed = exc
+            with self._qlock:
+                self._closed = True
+
+            def release(cmd) -> None:
+                if cmd is None:
+                    return
+                if cmd[0] in ("flush", "close"):
+                    cmd[1].set()
+                elif cmd[0] == "rotate":
+                    cmd[2].append(self.active_seq)
+                    cmd[1].set()
+
+            release(self._inflight)
+            self._inflight = None
+            try:
+                while True:
+                    release(self._q.get_nowait())
+            except queue.Empty:
+                pass
+
+    def _writer_loop_inner(self) -> None:
         last_fsync = time.monotonic()
         while True:
+            self._inflight = None
             timeout = None
             if self._pending:
                 timeout = max(
@@ -218,6 +257,7 @@ class CommitLog:
                 self._fsync()  # interval elapsed with records pending
                 last_fsync = time.monotonic()
                 continue
+            self._inflight = cmd
             kind = cmd[0]
             if kind == "entry":
                 self._append(cmd[1])

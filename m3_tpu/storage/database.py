@@ -724,7 +724,7 @@ class Database:
             fulfilled = ShardTimeRanges()
             with self.lock:
                 for shard in shards:
-                    snap = read_latest_snapshot(self.base, ns_name, shard.id)
+                    snap = snapshots.get(shard.id)
                     if not snap:
                         continue
                     vol_now = {f.block_start: f.volume for f in shard.filesets()}
@@ -803,19 +803,22 @@ class Database:
 
         # target = retention window (live operation) ∪ locally discovered
         # blocks (restarts with data older than the window still replay);
-        # the WAL is read ONCE here and reused by the commitlog source
+        # the WAL and each shard's snapshot are read ONCE here and reused
+        # by the commitlog+snapshot source
         import time as _time
 
         now = int(_time.time() * NANOS) if now_nanos is None else now_nanos
         target = ShardTimeRanges.for_window(
             shard_ids, now - ns.opts.retention_nanos, now + bsz, bsz
         )
+        snapshots: dict[int, list] = {}
         with self.lock:
             wal_entries = CommitLog.replay(self._commitlog_dir(name))
             for shard in shards:
                 for fid in shard.filesets():
                     target.add(shard.id, fid.block_start)
                 snap = read_latest_snapshot(self.base, name, shard.id)
+                snapshots[shard.id] = snap or []
                 for _, bs, _, _ in snap or ():
                     target.add(shard.id, bs)
             for e in wal_entries:

@@ -164,3 +164,39 @@ def test_count_values(block):
     total = np.nansum(out, axis=0)
     want = np.sum(~np.isnan(v), axis=0)
     np.testing.assert_allclose(total[want > 0], want[want > 0])
+
+
+def test_dense_path_matches_segment_path():
+    """The TPU-first dense rollup (pack_dense_groups + aggregate_dense +
+    dense_quantiles) must reproduce the segment-reduction path exactly,
+    including last's first-arrival tie-breaking and quantile interpolation."""
+    import numpy as np
+
+    from m3_tpu.aggregator.kernels import (
+        aggregate_dense,
+        aggregate_segments,
+        dense_quantiles,
+        pack_dense_groups,
+        segment_quantiles,
+    )
+
+    rng = np.random.default_rng(5)
+    n, g = 20_000, 700
+    keys = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.lognormal(0, 1, n).astype(np.float32)
+    torder = rng.integers(0, 50, n).astype(np.int32)  # duplicate orders: ties
+
+    seg = aggregate_segments(keys, vals, torder, g)
+    dv, dt, dvalid = pack_dense_groups(keys, vals, torder, g)
+    den = aggregate_dense(dv, dt, dvalid)
+    for f in ("sum", "count", "min", "max", "sum_sq", "mean", "stdev", "last"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(den, f)), np.asarray(getattr(seg, f)),
+            rtol=2e-5, atol=1e-6, err_msg=f,
+        )
+    qs = (0.5, 0.95, 0.99)
+    np.testing.assert_allclose(
+        np.asarray(dense_quantiles(dv, dvalid, qs)),
+        np.asarray(segment_quantiles(keys, vals, g, qs)),
+        rtol=1e-6,
+    )

@@ -1,0 +1,141 @@
+"""mmap'd immutable index segments (index/disk_segment.py — the fst
+segment's role: segment/fst/segment.go:181), merge compaction, and the
+postings-list LRU cache (postings_list_cache.go:59)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from m3_tpu.index.disk_segment import DiskSegment, write_disk_segment
+from m3_tpu.index.ns_index import NamespaceIndex
+from m3_tpu.index.postings_cache import PostingsListCache
+from m3_tpu.index.query import conj, neg, regexp, search_segment, term
+from m3_tpu.index.query import FieldQuery
+from m3_tpu.index.segment import Document, MutableSegment, merge_segments
+
+NANOS = 1_000_000_000
+HOUR = 3600 * NANOS
+T0 = 1_600_000_000 * NANOS
+
+
+def _mseg(n=500, prefix=""):
+    m = MutableSegment()
+    for i in range(n):
+        m.insert(
+            Document(
+                f"{prefix}id{i}".encode(),
+                (
+                    (b"host", f"h{i % 7}".encode()),
+                    (b"name", f"metric_{i % 13}".encode()),
+                ),
+            )
+        )
+    return m
+
+
+def test_disk_segment_roundtrip(tmp_path):
+    sealed = _mseg().seal()
+    path = write_disk_segment(str(tmp_path / "seg.idx"), sealed)
+    disk = DiskSegment(path)
+    assert len(disk) == len(sealed)
+    assert disk.fields() == sealed.fields()
+    for f in sealed.fields():
+        assert disk.terms(f) == list(sealed.terms(f))
+        for t in sealed.terms(f):
+            np.testing.assert_array_equal(
+                np.asarray(disk.postings(f, t)), np.asarray(sealed.postings(f, t))
+            )
+    for i in (0, 1, 250, 499):
+        assert disk.doc(i) == sealed.docs[i]
+    # missing lookups
+    assert disk.postings(b"host", b"nope").size == 0
+    assert disk.postings(b"ghost", b"x").size == 0
+
+
+@pytest.mark.parametrize(
+    "q",
+    [
+        term(b"host", b"h3"),
+        regexp(b"name", b"metric_1[0-2]"),
+        FieldQuery(b"host"),
+        conj(term(b"host", b"h1"), regexp(b"name", b"metric_.*")),
+        conj(term(b"host", b"h1"), neg(term(b"name", b"metric_3"))),
+    ],
+)
+def test_disk_matches_sealed_search(tmp_path, q):
+    sealed = _mseg().seal()
+    disk = DiskSegment(write_disk_segment(str(tmp_path / "s.idx"), sealed))
+    np.testing.assert_array_equal(
+        search_segment(disk, q), search_segment(sealed, q)
+    )
+
+
+def test_merge_segments_dedupes_by_id():
+    a = _mseg(50)
+    b = _mseg(80)  # overlaps a's ids
+    merged = merge_segments([a.seal(), b.seal()])
+    assert len(merged) == 80
+    assert len(merged.postings(b"host", b"h0")) == len(b.seal().postings(b"host", b"h0"))
+
+
+def test_ns_index_persists_mmap_and_reloads(tmp_path):
+    ix = NamespaceIndex(block_size_nanos=HOUR)
+    for i in range(300):
+        ix.write(
+            f"s{i}".encode(),
+            ((b"host", f"h{i % 5}".encode()), (b"name", b"cpu")),
+            T0 + (i % 2) * 10 * NANOS,
+        )
+    paths = ix.persist_before(str(tmp_path), "ns", T0 + 2 * HOUR)
+    assert paths and all(p.endswith(".idx") for p in paths)
+    # the in-memory block now serves from the mmap'd segment
+    bs = (T0 // HOUR) * HOUR
+    from m3_tpu.index.disk_segment import DiskSegment as DS
+
+    assert isinstance(ix.blocks[bs].sealed[0], DS)
+    r = ix.query(term(b"host", b"h2"), T0 - HOUR, T0 + HOUR)
+    assert len(r.docs) == 60
+
+    ix2 = NamespaceIndex(block_size_nanos=HOUR)
+    loaded = ix2.load_persisted(str(tmp_path), "ns")
+    assert bs in loaded
+    r2 = ix2.query(regexp(b"host", b"h[12]"), T0 - HOUR, T0 + HOUR)
+    assert len(r2.docs) == 120
+    agg = ix2.aggregate_query(None, T0 - HOUR, T0 + HOUR)
+    assert agg[b"name"] == {b"cpu"}
+
+
+def test_postings_cache_hits_on_repeated_regexp(tmp_path):
+    ix = NamespaceIndex(block_size_nanos=HOUR)
+    for i in range(200):
+        ix.write(f"s{i}".encode(), ((b"host", f"h{i % 5}".encode()),), T0)
+    ix.persist_before(str(tmp_path), "ns", T0 + 2 * HOUR)  # immutable now
+    q = regexp(b"host", b"h[0-3]")
+    r1 = ix.query(q, T0 - HOUR, T0 + HOUR)
+    misses = ix.postings_cache.misses
+    r2 = ix.query(q, T0 - HOUR, T0 + HOUR)
+    assert [d.id for d in r1.docs] == [d.id for d in r2.docs]
+    assert ix.postings_cache.hits >= 1
+    assert ix.postings_cache.misses == misses  # second run fully cached
+
+
+def test_mutable_segments_bypass_cache():
+    cache = PostingsListCache()
+    m = _mseg(50)
+    out1 = search_segment(m, regexp(b"host", b"h1"), cache)
+    m.insert(Document(b"new", ((b"host", b"h1"),)))
+    out2 = search_segment(m, regexp(b"host", b"h1"), cache)
+    assert len(out2) == len(out1) + 1  # fresh result, not a stale cache hit
+    assert len(cache) == 0
+
+
+def test_cache_lru_eviction():
+    cache = PostingsListCache(capacity=2)
+    cache.put(("a",), np.zeros(1, np.int32))
+    cache.put(("b",), np.zeros(2, np.int32))
+    cache.get(("a",))
+    cache.put(("c",), np.zeros(3, np.int32))  # evicts b (LRU)
+    assert cache.get(("b",)) is None
+    assert cache.get(("a",)) is not None
+    assert cache.get(("c",)) is not None

@@ -255,3 +255,69 @@ def test_coordinator_graphite_routes(tmp_path):
         assert isinstance(rel, list)  # data is old, empty result is fine
     finally:
         server.shutdown()
+
+
+def test_round4_breadth_functions():
+    """Spot checks over the round-4 builtins breadth pass."""
+    import numpy as np
+
+    from m3_tpu.graphite.functions import FUNCS, Context, GSeries
+
+    NANOS = 1_000_000_000
+    ctx = Context(start_nanos=1_600_000_000 * NANOS, step_nanos=10 * NANOS, steps=6)
+    a = GSeries("x.a", np.array([1.0, 2.0, 2.0, np.nan, 5.0, 4.0]))
+    b = GSeries("x.b", np.array([3.0, 1.0, 4.0, 4.0, 1.0, 2.0]))
+
+    # identity/timeFunction: unix seconds of each step
+    (ident,) = FUNCS["identity"](ctx, "t")
+    assert ident.values[0] == 1_600_000_000 and ident.values[1] == 1_600_000_010
+
+    (thr,) = FUNCS["threshold"](ctx, 4.5, "limit")
+    assert thr.name == "limit" and np.all(thr.values == 4.5)
+
+    (rng,) = FUNCS["rangeOfSeries"](ctx, [a, b])
+    assert rng.values[0] == 2.0 and rng.values[3] == 0.0  # nan ignored
+
+    (ch,) = FUNCS["changed"](ctx, [a])
+    # previous carries across the NaN gap (common.Changed): 5 vs carried 2
+    assert list(ch.values) == [0.0, 1.0, 0.0, 0.0, 1.0, 1.0]
+
+    (nn,) = FUNCS["isNonNull"](ctx, [a])
+    assert list(nn.values) == [1.0, 1.0, 1.0, 0.0, 1.0, 1.0]
+
+    (oz,) = FUNCS["offsetToZero"](ctx, [b])
+    assert np.nanmin(oz.values) == 0.0
+
+    got = FUNCS["removeEmptySeries"](
+        ctx, [a, GSeries("x.e", np.full(6, np.nan))]
+    )
+    assert [s.name for s in got] == ["x.a"]
+
+    # sustainedAbove: >= 3 only counts once held for 20s (2 steps)
+    (sa,) = FUNCS["sustainedAbove"](ctx, [b], 3.0, "20s")
+    assert list(sa.values) == [0.0, 0.0, 0.0, 4.0, 0.0, 0.0]
+
+    va = GSeries("v.k", a.values)
+    wb = GSeries("w.k", b.values)
+    (wa,) = FUNCS["weightedAverage"](ctx, [va], [wb], 1)
+    # per-step (a*b)/b where both defined = a
+    assert wa.values[0] == 1.0 and wa.values[2] == 2.0
+
+    (sw,) = FUNCS["sumSeriesWithWildcards"](ctx, [a, b], 1)
+    assert sw.name == "x" and sw.values[0] == 4.0
+
+    # holt-winters smoke: finite forecast, bands bracket it
+    rng_ = np.random.default_rng(0)
+    s = GSeries("hw", 100 + rng_.normal(0, 1, 6))
+    (fc,) = FUNCS["holtWintersForecast"](ctx, [s])
+    lo, up = FUNCS["holtWintersConfidenceBands"](ctx, [s], 3)
+    assert np.isfinite(fc.values[1:]).all()
+    assert np.all(up.values[1:] >= lo.values[1:])
+
+    (hc,) = FUNCS["hitcount"](ctx, [b], "30s")
+    assert len(hc.values) == 2
+    assert hc.values[0] == (3 + 1 + 4) * 10.0
+
+    (pc,) = FUNCS["percentileOfSeries"](ctx, [a, b], 50)
+    # reference rank method: ceil(0.5*2)=1 -> sorted[0], not numpy interp
+    assert pc.values[0] == 1.0

@@ -398,3 +398,27 @@ def test_tick_expires_retention(tmp_path):
     shard = db.namespaces["ns"].shards[0]
     assert b"old" not in shard.series
     assert b"new" in shard.series
+
+
+def test_commitlog_writer_failure_surfaces_not_hangs(tmp_path):
+    """A dead write-behind writer (disk error) must surface on the next
+    write()/flush() instead of hanging barrier waiters forever."""
+    import os as _os
+
+    import pytest as _pytest
+
+    from m3_tpu.storage.commitlog import CommitLog, CommitLogEntry
+
+    cl = CommitLog(str(tmp_path), flush_interval=3600.0, flush_every=10**9)
+    cl.write(CommitLogEntry(b"s", 1, 1.0))
+    cl.flush()
+    # break the fd under the writer, then force an fsync through it
+    _os.close(cl._f.fileno())
+    with _pytest.raises(RuntimeError):
+        cl.write(CommitLogEntry(b"s", 2, 2.0))
+        cl.flush()  # the flush path re-raises the writer's stored failure
+        # if neither raised (timing), a subsequent write must
+        for _ in range(100):
+            cl.write(CommitLogEntry(b"s", 3, 3.0))
+    # close() is safe after failure (no hang)
+    cl.close()
