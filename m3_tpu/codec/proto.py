@@ -196,6 +196,62 @@ _DEFAULTS = {
 }
 
 
+_SCHEMA_ANN = b"\x00SCH"  # annotation payload magic for schema changes
+
+
+def _serialize_schema(schema: Schema, seq: int) -> bytes:
+    # varint counts/lengths, matching the stream header (a 300-field
+    # schema or a >255-byte field name must not overflow a byte)
+    from ..utils import varint as _vi
+
+    out = [bytes([seq & 0xFF]), _vi.put_varint(len(schema))]
+    for f in schema:
+        name = f.name.encode()
+        out.append(bytes([int(f.type)]))
+        out.append(_vi.put_varint(len(name)))
+        out.append(name)
+    return _SCHEMA_ANN + b"".join(out)
+
+
+def _deserialize_schema(payload: bytes) -> Schema:
+    pos = len(_SCHEMA_ANN) + 1  # skip magic + seq
+
+    def read_varint() -> int:
+        nonlocal pos
+        from ..utils import varint as _vi
+
+        def rb() -> int:
+            nonlocal pos
+            b = payload[pos]
+            pos += 1
+            return b
+
+        return _vi.read_varint(rb)
+
+    n = read_varint()
+    fields = []
+    for _ in range(n):
+        ftype = FieldType(payload[pos])
+        pos += 1
+        nlen = read_varint()
+        fields.append(Field(payload[pos : pos + nlen].decode(), ftype))
+        pos += nlen
+    return tuple(fields)
+
+
+def _migrate_states(old_schema, old_states, new_schema):
+    """Schema evolution (proto/docs/encoding.md schema-change semantics):
+    fields matched by (name, type) carry their compression state across
+    the change; added / type-changed fields restart from defaults."""
+    by_name = {
+        (f.name, f.type): st for f, st in zip(old_schema, old_states)
+    }
+    return [
+        by_name.get((f.name, f.type)) or _FIELD_STATES[f.type]()
+        for f in new_schema
+    ]
+
+
 class ProtoEncoder:
     def __init__(self, start_nanos: int, schema: Schema, unit: Unit = Unit.SECOND) -> None:
         self.schema = tuple(schema)
@@ -203,6 +259,8 @@ class ProtoEncoder:
         self.ts = TimestampEncoder(start_nanos, unit)
         self.unit = unit
         self._states = [_FIELD_STATES[f.type]() for f in self.schema]
+        self._pending_schema: Schema | None = None
+        self._schema_seq = 0
         self._write_header()
 
     def _write_header(self) -> None:
@@ -215,8 +273,24 @@ class ProtoEncoder:
             for b in name:
                 self.os.write_bits(b, 8)
 
+    def set_schema(self, schema: Schema) -> None:
+        """Mid-stream schema change (encoder.go control-bit schema change;
+        here the new schema rides the annotation marker channel on the
+        NEXT record, so EOS detection stays unambiguous). Matching fields
+        keep their compression state."""
+        self._pending_schema = tuple(schema)
+
     def encode(self, t_nanos: int, values: dict) -> None:
-        self.ts.write_time(self.os, t_nanos, None, self.unit)
+        ann = None
+        if self._pending_schema is not None:
+            self._schema_seq += 1
+            ann = _serialize_schema(self._pending_schema, self._schema_seq)
+            self._states = _migrate_states(
+                self.schema, self._states, self._pending_schema
+            )
+            self.schema = self._pending_schema
+            self._pending_schema = None
+        self.ts.write_time(self.os, t_nanos, ann, self.unit)
         changed = []
         for f, st in zip(self.schema, self._states):
             v = values.get(f.name, st.value)
@@ -247,6 +321,8 @@ class ProtoReaderIterator:
         self.schema = self._read_header()
         self._states = [_FIELD_STATES[f.type]() for f in self.schema]
         self.current: ProtoPoint | None = None
+        self.err: Exception | None = None  # corruption surfaces here
+        self._seen_ann = None
 
     def _read_header(self) -> Schema:
         version = self.stream.read_bits(8)
@@ -264,21 +340,42 @@ class ProtoReaderIterator:
         return tuple(fields)
 
     def next(self) -> bool:
+        if self.err is not None:
+            return False
         try:
             self.ts.read_timestamp(self.stream)
+            if self.ts.done:
+                return False
+            ann = getattr(self.ts, "prev_annotation", None)
+            if (
+                ann is not None
+                and ann is not self._seen_ann
+                and ann.startswith(_SCHEMA_ANN)
+            ):
+                # mid-stream schema change delivered via the annotation
+                # marker: remap field states by (name, type)
+                new_schema = _deserialize_schema(ann)
+                self._states = _migrate_states(
+                    self.schema, self._states, new_schema
+                )
+                self.schema = new_schema
+                self._seen_ann = ann
+            changed = [self.stream.read_bits(1) == 1 for _ in self.schema]
+            values = {}
+            for f, st, c in zip(self.schema, self._states, changed):
+                if c:
+                    values[f.name] = st.read(self.stream)
+                else:
+                    values[f.name] = st.value
+            self.current = ProtoPoint(self.ts.prev_time, values)
+            return True
         except EOFError:
             return False
-        if self.ts.done:
+        except (ValueError, IndexError, OverflowError, KeyError) as exc:
+            # corruption must stop iteration cleanly, never propagate
+            # garbage points (corruption_prop_test.go contract)
+            self.err = exc
             return False
-        changed = [self.stream.read_bits(1) == 1 for _ in self.schema]
-        values = {}
-        for f, st, c in zip(self.schema, self._states, changed):
-            if c:
-                values[f.name] = st.read(self.stream)
-            else:
-                values[f.name] = st.value
-        self.current = ProtoPoint(self.ts.prev_time, values)
-        return True
 
 
 def encode_proto_series(
@@ -299,4 +396,8 @@ def decode_proto(data: bytes, default_unit: Unit = Unit.SECOND) -> list[ProtoPoi
     out = []
     while it.next():
         out.append(it.current)
+    if it.err is not None:
+        # the iterator contains corruption for streaming callers; the
+        # whole-stream decode keeps raising (prior behavior)
+        raise it.err
     return out

@@ -36,6 +36,8 @@ class ScanAggregates(NamedTuple):
     total_count: jnp.ndarray  # i32[]
     total_min: jnp.ndarray  # f32[]
     total_max: jnp.ndarray  # f32[]
+    series_err: jnp.ndarray | None = None  # bool[S] device decode bailed
+    #   (annotations etc.) — stitch_host_errors() recomputes those series
 
 
 def _aggregate_decoded(vals, valid, with_psum):
@@ -124,6 +126,9 @@ def _aggregates_from_lanes(
         rs = lambda x: x.reshape(s, c)
     l_sum, l_cnt = rs(lane_agg.sum), rs(lane_agg.count)
     l_min, l_max, l_last = rs(lane_agg.min), rs(lane_agg.max), rs(lane_agg.last)
+    s_err = None
+    if getattr(lane_agg, "err", None) is not None:
+        s_err = jnp.any(rs(jnp.asarray(lane_agg.err).astype(jnp.int32)) != 0, axis=1)
     if precise:
         # float-float tree sums (ops/precise.py): per-series and the
         # cross-series total carry (hi, lo) pairs — ~1 ulp of exact vs
@@ -186,6 +191,59 @@ def _aggregates_from_lanes(
         total_count=t_count,
         total_min=t_min,
         total_max=t_max,
+        series_err=unperm(s_err) if s_err is not None else None,
+    )
+
+
+def stitch_host_errors(aggs: ScanAggregates, stream_for) -> ScanAggregates:
+    """Query-layer stitch for device-erred lanes: series whose device
+    decode bailed (annotations and other host-only features set the
+    per-lane err flag, ops/decode.py) are recomputed through the host
+    codec and patched into the aggregate block; totals are rebuilt from
+    the patched per-series arrays in float64.
+
+    ``stream_for(series_idx) -> bytes`` returns the series' encoded
+    stream (the caller owns the segment source)."""
+    import numpy as np
+
+    from ..codec.m3tsz import decode
+
+    if aggs.series_err is None:
+        return aggs
+    err = np.asarray(aggs.series_err).astype(bool)
+    idxs = np.nonzero(err)[0]
+    if idxs.size == 0:
+        return aggs
+    s_sum = np.asarray(aggs.series_sum).copy()
+    s_cnt = np.asarray(aggs.series_count).copy()
+    s_min = np.asarray(aggs.series_min).copy()
+    s_max = np.asarray(aggs.series_max).copy()
+    s_last = np.asarray(aggs.series_last).copy()
+    for i in idxs:
+        dps = decode(stream_for(int(i)))
+        if not dps:
+            s_sum[i] = 0.0
+            s_cnt[i] = 0
+            s_min[i] = s_max[i] = s_last[i] = np.nan
+            continue
+        vals32 = np.asarray([dp.value for dp in dps], np.float32)
+        s_sum[i] = np.float32(np.sum(vals32.astype(np.float64)))
+        s_cnt[i] = len(vals32)
+        s_min[i] = vals32.min()
+        s_max[i] = vals32.max()
+        s_last[i] = vals32[-1]
+    has = s_cnt > 0
+    return ScanAggregates(
+        series_sum=s_sum,
+        series_count=s_cnt,
+        series_min=s_min,
+        series_max=s_max,
+        series_last=s_last,
+        total_sum=np.float32(np.sum(s_sum[has].astype(np.float64))),
+        total_count=int(s_cnt.sum()),
+        total_min=np.float32(np.min(s_min[has])) if has.any() else np.float32(np.nan),
+        total_max=np.float32(np.max(s_max[has])) if has.any() else np.float32(np.nan),
+        series_err=np.zeros_like(err),
     )
 
 

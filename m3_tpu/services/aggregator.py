@@ -35,22 +35,57 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flush-interval-secs", type=float, default=1.0)
     p.add_argument("--forward", default="", help="dbnode host:port for output")
     p.add_argument("--forward-namespace", default="default")
+    p.add_argument(
+        "--msg-consumer",
+        default="",
+        help="m3msg consumer endpoint host:port (the coordinator's "
+        "--msg-listen): flushed aggregates ride the message bus with "
+        "at-least-once acks instead of direct dbnode writes",
+    )
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     forward_node = None
+    producer = None
     if args.forward:
         from ..net.client import RemoteNode
 
-        host, port = args.forward.rsplit(":", 1)
-        forward_node = RemoteNode(host, int(port))
+        forward_node = RemoteNode.connect(args.forward)
+    if args.msg_consumer:
+        # aggregator flush → m3msg producer → coordinator ingest
+        # (aggregator/handler/ + msg/producer; serve.go wiring)
+        from ..metrics.encoding import AggregatedMessage, encode_aggregated_batch
+        from ..msg.bus import ConsumerService, Producer, Topic
+        from ..msg.transport import RemoteConsumer
+        from ..utils.hash import shard_for
+
+        host, port = args.msg_consumer.rsplit(":", 1)
+        topic = Topic(
+            "aggregated_metrics",
+            num_shards=args.num_shards,
+            consumer_services=[ConsumerService("coordinator")],
+        )
+        producer = Producer(topic)
+        producer.register(
+            RemoteConsumer("coordinator", "coordinator0", host, int(port))
+        )
 
     flushed_count = [0]
 
     def handler(metrics):
         flushed_count[0] += len(metrics)
+        if producer is not None:
+            by_shard: dict[int, list] = {}
+            for m in metrics:
+                by_shard.setdefault(shard_for(m.id, args.num_shards), []).append(
+                    AggregatedMessage(
+                        m.id, m.time_nanos, m.value, m.policy, m.agg_type
+                    )
+                )
+            for shard, msgs in by_shard.items():
+                producer.produce(shard, encode_aggregated_batch(msgs))
         if forward_node is not None:
             forward_node.write_batch(
                 args.forward_namespace,
@@ -72,6 +107,8 @@ def main(argv=None) -> int:
         while not stop.wait(args.flush_interval_secs):
             try:
                 agg.flush(time.time_ns())
+                if producer is not None:
+                    producer.retry_unacked()  # at-least-once redelivery sweep
             except Exception as exc:
                 # keep the loop alive (mediator-style resilience); drained
                 # aggregates stay in agg._pending_emit and retry next pass
@@ -93,6 +130,8 @@ def main(argv=None) -> int:
     finally:
         stop.set()
         agg.flush(time.time_ns() + 10**12)  # drain on shutdown
+        if producer is not None:
+            producer.retry_unacked()
         if forward_node is not None:
             forward_node.close()
     return 0

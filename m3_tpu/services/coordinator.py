@@ -80,6 +80,52 @@ class Coordinator:
 
     # --- ingest (downsamplerAndWriter ingest/write.go:138) ---
 
+    def ingest_aggregated(self, msgs) -> int:
+        """m3msg ingest (ingest/m3msg/ingest.go): aggregated metrics from
+        the aggregator tier land in storage. Tag-wire metric IDs are
+        decoded back to tags and written tagged (indexed) with the
+        aggregation type as an extra label (the reference's suffix scheme,
+        label-form so PromQL metric names stay valid); opaque IDs write
+        untagged."""
+        from ..utils.serialize import decode_tags, is_tag_id
+
+        n = 0
+        for m in msgs:
+            if is_tag_id(m.id):
+                try:
+                    tags = tuple(sorted(decode_tags(m.id)))
+                except ValueError:
+                    tags = None
+                if tags is not None:
+                    tags = tuple(tags) + ((b"agg", m.agg_type.type_string.encode()),)
+                    self.db.write_tagged(self.namespace, tags, m.time_nanos, m.value)
+                    n += 1
+                    continue
+            # opaque IDs: the aggregation type must still split series —
+            # same suffix scheme as the direct-forward path (suffixed_id)
+            sid = m.id + b"." + m.agg_type.type_string.encode()
+            self.db.write(self.namespace, sid, m.time_nanos, m.value)
+            n += 1
+        return n
+
+    def serve_msg_ingest(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the m3msg consumer endpoint (coordinator m3msg ingester,
+        src/cmd/services/m3coordinator/ingest/m3msg/) — returns the
+        ConsumerServer (its .port is the listen port)."""
+        from ..metrics.encoding import decode_aggregated_batch
+        from ..msg.transport import ConsumerServer
+
+        def handler(message) -> bool:
+            try:
+                self.ingest_aggregated(decode_aggregated_batch(message.payload))
+                return True
+            except Exception:
+                return False  # nack: the producer's retry sweep redelivers
+
+        server = ConsumerServer(handler, host=host, port=port)
+        server.start()
+        return server
+
     def write_prom(self, req: prompb.WriteRequest) -> int:
         count = 0
         for ts in req.timeseries:
@@ -649,6 +695,12 @@ def main(argv=None) -> int:
     )
     p.add_argument("--spare", action="append", default=[])
     p.add_argument("--heartbeat-timeout", type=float, default=10.0)
+    p.add_argument(
+        "--msg-listen",
+        action="store_true",
+        help="serve an m3msg consumer endpoint for aggregated-metric "
+        "ingest (prints MSG_LISTENING <host> <port>)",
+    )
     args = p.parse_args(argv)
 
     cfg = load_config(CoordinatorConfig, args.config) if args.config else CoordinatorConfig()
@@ -701,9 +753,15 @@ def main(argv=None) -> int:
     def shutdown(signum, frame):
         raise SystemExit(0)
 
+    msg_server = None
+    if args.msg_listen:
+        msg_server = coord.serve_msg_ingest(host=host)
+
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
     print(f"LISTENING {host} {bound}", flush=True)
+    if msg_server is not None:
+        print(f"MSG_LISTENING {host} {msg_server.port}", flush=True)
     try:
         # serve() already runs the accept loop on a daemon thread; a second
         # serve_forever() here would race it on the same socket. Park until
@@ -712,6 +770,8 @@ def main(argv=None) -> int:
     finally:
         if detector is not None:
             detector.stop()
+        if msg_server is not None:
+            msg_server.stop()
         server.shutdown()
         coord.db.close()
         if kv is not None:

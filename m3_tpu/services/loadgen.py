@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="m3tpu-loadgen", description=__doc__)
     p.add_argument("--node", default="", help="dbnode RPC host:port")
     p.add_argument("--coordinator", default="", help="coordinator HTTP host:port")
+    p.add_argument(
+        "--aggregator", default="",
+        help="aggregator rawtcp ingress host:port — sends TAGGED untimed "
+        "gauges (tag-wire IDs) so downstream rollups stay indexable",
+    )
     p.add_argument("--namespace", default="default")
     p.add_argument("--series", type=int, default=1000, help="unique series")
     p.add_argument("--rate", type=float, default=1000.0, help="target writes/sec")
@@ -104,7 +109,39 @@ def run(args, make_client) -> dict:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if args.node:
+    if args.aggregator:
+        from ..aggregator.server import AggregatorClient
+        from ..metrics.encoding import UnaggregatedMessage
+        from ..metrics.types import MetricType, Untimed
+        from ..rules.rules import encode_tags_id
+
+        host, port = args.aggregator.rsplit(":", 1)
+
+        def make_client():
+            ac = AggregatorClient([(host, int(port))])
+
+            class AggClient:
+                def write_batch(self, ns, batch):
+                    for sid, t, v in batch:
+                        tags = ((b"__name__", b"load"), (b"series", sid))
+                        ac.send(
+                            UnaggregatedMessage(
+                                Untimed(
+                                    MetricType.GAUGE,
+                                    encode_tags_id(tags),
+                                    gauge_value=v,
+                                ),
+                                t,
+                                timed=True,
+                            )
+                        )
+
+                def read(self, ns, sid, start, end):
+                    return []
+
+            return AggClient()
+
+    elif args.node:
         from ..net.client import RemoteNode
 
         host, port = args.node.rsplit(":", 1)

@@ -36,6 +36,16 @@ class AgentServer:
         self._argv: dict[str, list[str]] = {}
         self._lock = threading.Lock()
         self.started_at = time.time()
+        # panicmon (x/panicmon + agent/heartbeater.go): watch spawned
+        # processes for SILENT death — an exit not requested through
+        # op_stop/op_teardown is recorded and surfaces in /heartbeat
+        self._expected_exit: set[str] = set()
+        self._exit_events: list[dict] = []
+        self._reported_exit: set[str] = set()
+        self._watch_stop = threading.Event()
+        threading.Thread(
+            target=self._watch_loop, daemon=True, name="m3em-panicmon"
+        ).start()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -61,8 +71,10 @@ class AgentServer:
                             }
                             for tid, p in outer._procs.items()
                         }
+                    with outer._lock:
+                        exits = list(outer._exit_events)
                     self._reply(200, {"ok": True, "uptime": time.time() - outer.started_at,
-                                      "processes": procs})
+                                      "processes": procs, "exits": exits})
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -110,6 +122,8 @@ class AgentServer:
     def op_start(self, body: dict) -> dict:
         target = body["target"]
         with self._lock:
+            self._expected_exit.discard(target)
+            self._reported_exit.discard(target)
             argv = self._argv.get(target)
             if argv is None:
                 raise ValueError(f"target {target} not set up")
@@ -126,10 +140,30 @@ class AgentServer:
             self._procs[target] = proc
         return {"pid": proc.pid}
 
+    def _watch_loop(self) -> None:
+        while not self._watch_stop.wait(0.2):
+            with self._lock:
+                for tid, p in self._procs.items():
+                    if (
+                        p.poll() is not None
+                        and tid not in self._expected_exit
+                        and tid not in self._reported_exit
+                    ):
+                        self._reported_exit.add(tid)
+                        self._exit_events.append(
+                            {
+                                "target": tid,
+                                "returncode": p.returncode,
+                                "pid": p.pid,
+                                "at": time.time(),
+                            }
+                        )
+
     def op_stop(self, body: dict) -> dict:
         target = body["target"]
         sig = int(body.get("signal", signal.SIGTERM))
         with self._lock:
+            self._expected_exit.add(target)
             proc = self._procs.get(target)
         if proc is None or proc.poll() is not None:
             return {"stopped": False}
@@ -152,6 +186,7 @@ class AgentServer:
         return {"torn": True}
 
     def close(self) -> None:
+        self._watch_stop.set()
         with self._lock:
             targets = list(self._procs)
         for t in targets:

@@ -35,9 +35,24 @@ from ..net.client import RemoteNode
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 
 
-def _spawn_listening(cmd: list[str], what: str, timeout: float = 60.0):
+def _spawn_listening(cmd: list[str], what: str, timeout: float = 60.0,
+                     collect: dict | None = None,
+                     expect_markers: set[str] | None = None):
     """Start a subprocess that prints LISTENING <host> <port>; returns
-    (proc, host, port)."""
+    (proc, host, port). Named marker lines (``expect_markers``, e.g.
+    {"MSG_LISTENING"}) printed before/after it are collected into
+    ``collect`` as (host, port), read from the same pump (reading
+    proc.stdout directly would race the pump thread that owns the pipe)."""
+    expect_markers = expect_markers or set()
+
+    def _maybe_collect(parts) -> None:
+        if (
+            collect is not None
+            and len(parts) == 3
+            and parts[0] in expect_markers
+            and parts[2].isdigit()
+        ):
+            collect[parts[0]] = (parts[1], int(parts[2]))
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.Popen(
@@ -76,9 +91,21 @@ def _spawn_listening(cmd: list[str], what: str, timeout: float = 60.0):
         if item is None:
             raise RuntimeError(f"{what} died at startup")
         line = item
+        _maybe_collect(line.split())
         if line.startswith("LISTENING"):
             break
     _, host, port_s = line.split()
+    # expected markers may follow LISTENING: wait until all are present
+    if expect_markers:
+        wait_until = time.time() + 10
+        while time.time() < wait_until and not expect_markers <= set(collect or {}):
+            try:
+                item = lines.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if item is None:
+                break
+            _maybe_collect(item.split())
     return proc, host, int(port_s)
 
 

@@ -187,3 +187,111 @@ def test_msg_bus_at_least_once():
     flaky_state["fail"] = False
     assert prod.retry_unacked() == 0
     assert got == [(3, b"p1")]
+
+
+def test_concurrent_queries_and_ingest():
+    """Parallel HTTP queries against engine/storage concurrently with
+    ingest (the reference exercises cost reporters + per-query worker
+    pools under its docker tests): no errors, no deadlocks, monotonically
+    growing results, and per-query cost limits still enforced."""
+    import threading
+
+    from m3_tpu.query.cost import QueryLimits
+
+    coord = Coordinator(query_limits=QueryLimits(max_series=50, max_datapoints=100_000))
+    srv, port = serve(coord)
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    errors: list = []
+
+    def writer(wid: int) -> None:
+        i = 0
+        while not stop.is_set():
+            w = prompb.WriteRequest()
+            ts = w.timeseries.add()
+            ts.labels.add(name="__name__", value="conc")
+            ts.labels.add(name="w", value=str(wid))
+            ts.samples.add(value=float(i), timestamp=(T0 + i) * 1000)
+            try:
+                resp = post(
+                    f"{base}/api/v1/prom/remote/write", compress(w.SerializeToString())
+                )
+                assert resp.status == 200
+            except Exception as exc:  # noqa: BLE001
+                errors.append(("write", wid, exc))
+                return
+            i += 1
+
+    def reader(rid: int) -> None:
+        while not stop.is_set():
+            try:
+                out = get_json(
+                    f"{base}/api/v1/query_range?query=sum(conc)"
+                    f"&start={T0}&end={T0 + 300}&step=15"
+                )
+                assert out["status"] == "success"
+            except Exception as exc:  # noqa: BLE001
+                errors.append(("read", rid, exc))
+                return
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)] + [
+        threading.Thread(target=reader, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=15)
+        assert not t.is_alive(), "worker deadlocked"
+    srv.shutdown()
+    assert errors == [], errors[:3]
+
+
+def test_cost_limit_enforced_under_concurrency():
+    """max_series must reject an over-limit query even while ingest runs."""
+    import threading
+    import urllib.error
+
+    from m3_tpu.query.cost import QueryLimits
+
+    coord = Coordinator(query_limits=QueryLimits(max_series=10, max_datapoints=10**9))
+    srv, port = serve(coord)
+    base = f"http://127.0.0.1:{port}"
+    w = prompb.WriteRequest()
+    for i in range(40):  # 40 series > max_series=10
+        ts = w.timeseries.add()
+        ts.labels.add(name="__name__", value="many")
+        ts.labels.add(name="i", value=str(i))
+        ts.samples.add(value=1.0, timestamp=T0 * 1000)
+    assert post(f"{base}/api/v1/prom/remote/write", compress(w.SerializeToString())).status == 200
+
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            w2 = prompb.WriteRequest()
+            ts = w2.timeseries.add()
+            ts.labels.add(name="__name__", value="bg")
+            ts.samples.add(value=1.0, timestamp=(T0 + i) * 1000)
+            post(f"{base}/api/v1/prom/remote/write", compress(w2.SerializeToString()))
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        got_limit_error = False
+        for _ in range(5):
+            try:
+                get_json(f"{base}/api/v1/query?query=many&time={T0}")
+            except urllib.error.HTTPError as e:
+                assert e.code in (400, 422, 500)
+                got_limit_error = True
+        assert got_limit_error
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        srv.shutdown()

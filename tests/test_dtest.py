@@ -101,3 +101,40 @@ def test_dtest_kill_restart_bootstrap(tmp_path):
         assert any(recovered.values()), "restarted node served no data"
     finally:
         h.close()
+
+
+def test_agent_panicmon_detects_silent_death(tmp_path):
+    """x/panicmon + agent/heartbeater.go: a managed process that dies
+    WITHOUT an operator stop request surfaces as an exit event in the
+    heartbeat; operator-initiated stops do not."""
+    import sys
+    import time as _time
+
+    srv = AgentServer(str(tmp_path / "agent"))
+    try:
+        client = AgentClient("127.0.0.1", srv.port)
+        # target that exits on its own with code 3
+        client.setup("dier", argv=[sys.executable, "-c", "import sys; sys.exit(3)"])
+        client.start("dier")
+        # target we stop deliberately
+        client.setup("sleeper", argv=[sys.executable, "-c", "import time; time.sleep(60)"])
+        client.start("sleeper")
+
+        deadline = _time.time() + 10
+        exits = []
+        while _time.time() < deadline:
+            hb = client.heartbeat()
+            exits = hb.get("exits", [])
+            if exits:
+                break
+            _time.sleep(0.1)
+        assert [e["target"] for e in exits] == ["dier"]
+        assert exits[0]["returncode"] == 3
+
+        client.stop("sleeper")
+        _time.sleep(0.5)
+        hb = client.heartbeat()
+        # the deliberate stop did NOT produce a new unexpected-exit event
+        assert [e["target"] for e in hb["exits"]] == ["dier"]
+    finally:
+        srv.close()

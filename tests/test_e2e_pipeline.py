@@ -1,0 +1,116 @@
+"""End-to-end multi-process metrics pipeline (the reference's
+scripts/docker-integration-tests/aggregator/ scenario):
+
+    loadgen → aggregator rawtcp ingress → windowed flush → m3msg producer
+    → coordinator m3msg ingest → dbnode quorum writes → PromQL query_range
+
+Seven real processes: kvnode, 3 dbnodes, coordinator (cluster data plane +
+m3msg consumer endpoint), aggregator, loadgen. The test only orchestrates
+spawning and asserts through the coordinator's HTTP API.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from m3_tpu.testing.proc_cluster import ProcCluster, _spawn_listening
+
+
+def _spawn_with_msg(cmd, what):
+    """Like _spawn_listening but also captures the MSG_LISTENING marker."""
+    markers: dict = {}
+    proc, host, port = _spawn_listening(
+        cmd, what, collect=markers, expect_markers={"MSG_LISTENING"}
+    )
+    assert "MSG_LISTENING" in markers, markers
+    mhost, mport = markers["MSG_LISTENING"]
+    return proc, f"http://{host}:{port}", f"{mhost}:{mport}"
+
+
+def get_json(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_five_stage_pipeline_across_processes(tmp_path):
+    cluster = ProcCluster(
+        num_nodes=3, num_shards=4, replica_factor=3,
+        heartbeat_timeout=2.0, base_dir=str(tmp_path),
+    )
+    coord = agg = None
+    try:
+        coord, base, msg_ep = _spawn_with_msg(
+            [
+                sys.executable, "-m", "m3_tpu.services.coordinator",
+                "--port", "0", "--kv-endpoint", cluster.kv_endpoint,
+                "--cluster", "--msg-listen",
+            ],
+            "coordinator",
+        )
+        agg, agg_host, agg_port = _spawn_listening(
+            [
+                sys.executable, "-m", "m3_tpu.services.aggregator",
+                "--port", "0", "--policy", "10s:2d",
+                "--flush-interval-secs", "0.5",
+                "--msg-consumer", msg_ep,
+            ],
+            "aggregator",
+        )
+
+        # loadgen: 5 tagged series at ~200 writes/s for 3 seconds
+        lg = subprocess.run(
+            [
+                sys.executable, "-m", "m3_tpu.services.loadgen",
+                "--aggregator", f"{agg_host}:{agg_port}",
+                "--series", "5", "--rate", "200", "--duration", "3",
+                "--batch", "10", "--workers", "2",
+            ],
+            capture_output=True, text=True, timeout=60,
+            cwd="/root/repo",
+        )
+        stats = json.loads(lg.stdout.strip().splitlines()[-1])
+        assert stats["errors"] == 0 and stats["writes"] > 100
+
+        # the 10s windows close once wall time passes their boundary; the
+        # aggregator then flushes through m3msg into the coordinator which
+        # quorum-writes to the dbnodes
+        t_lo = int(time.time()) - 60
+        deadline = time.time() + 40
+        result = []
+        while time.time() < deadline:
+            t_hi = int(time.time()) + 20
+            out = get_json(
+                f"{base}/api/v1/query_range?query=load"
+                f"&start={t_lo}&end={t_hi}&step=10"
+            )
+            result = out["data"]["result"]
+            if len(result) == 5 and all(s["values"] for s in result):
+                break
+            time.sleep(1.0)
+        assert len(result) == 5, f"expected 5 rolled-up series, got {len(result)}"
+        for s in result:
+            assert s["metric"]["__name__"] == "load"
+            assert s["metric"]["agg"] == "last"  # gauge default aggregation
+            assert len(s["values"]) >= 1
+
+        # the rollups really live on the dbnodes with RF=3: every node
+        # serves them directly
+        from m3_tpu.index.query import term
+
+        NANOS = 10**9
+        for pn in cluster.nodes.values():
+            res = pn.client.fetch_tagged(
+                "default", term(b"__name__", b"load"),
+                (t_lo) * NANOS, (int(time.time()) + 20) * NANOS,
+            )
+            assert len(res) == 5, pn.node_id
+    finally:
+        for p in (coord, agg):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)
+        cluster.close()

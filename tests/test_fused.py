@@ -178,6 +178,64 @@ def test_sorted_pack_tile_flags_recover_fast_majority():
     assert frac_s > frac_c
 
 
+def test_err_lane_host_stitch_on_mixed_batch():
+    """A MIXED batch where some lanes err on device (annotation streams):
+    the query layer stitches host-decoded results back in
+    (stitch_host_errors) and the final block matches a full host oracle
+    for EVERY series, annotated ones included."""
+    from m3_tpu.codec.m3tsz import decode
+    from m3_tpu.ops import fused
+    from m3_tpu.parallel.scan import chunked_scan_aggregate_packed, stitch_host_errors
+    from m3_tpu.utils.synthetic import synthetic_mixed_streams
+
+    streams = synthetic_mixed_streams(
+        32, 97, seed=31, frac_annotation=0.2  # plenty of err lanes
+    )
+    n_series = 64
+    batch = tile_chunked(build_chunked(streams, k=16), n_series)
+    packed = fused.pack_lane_inputs(batch, order="sorted")
+    got = chunked_scan_aggregate_packed(
+        packed.windows4, packed.lanes4, packed.tile_flags, n=packed.n,
+        s=batch.num_series, c=batch.num_chunks, k=batch.k, interpret=True,
+        lane_order="sorted", inv=packed.inv,
+    )
+    err = np.asarray(got.series_err)
+    assert err.any(), "annotation streams must err on device"
+
+    stitched = stitch_host_errors(got, lambda i: streams[i % len(streams)])
+    assert not np.asarray(stitched.series_err).any()
+
+    # full host oracle over every series
+    per = []
+    for srm in streams:
+        vals = np.asarray([dp.value for dp in decode(srm)], np.float32)
+        per.append((
+            float(np.sum(vals.astype(np.float64))), len(vals),
+            float(vals.min()), float(vals.max()), float(vals[-1]),
+        ))
+    want = [per[i % len(streams)] for i in range(n_series)]
+    np.testing.assert_allclose(
+        np.asarray(stitched.series_sum, np.float64),
+        [w[0] for w in want], rtol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(stitched.series_count), [w[1] for w in want]
+    )
+    np.testing.assert_allclose(
+        np.asarray(stitched.series_min), [w[2] for w in want], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(stitched.series_max), [w[3] for w in want], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(stitched.series_last), [w[4] for w in want], rtol=1e-6
+    )
+    assert float(stitched.total_count) == sum(w[1] for w in want)
+    assert float(stitched.total_sum) == pytest.approx(
+        sum(w[0] for w in want), rel=1e-5
+    )
+
+
 def test_fast_classification_boundaries():
     """First chunks, EOS chunks, float records, and annotations must
     classify slow; clean middle chunks fast."""
