@@ -189,12 +189,14 @@ def bench_mixed(n_series, on_tpu):
 
     batch = _build(synthetic_mixed_streams(256, 720, seed=11), n_series)
     fast_frac = float(np.asarray(batch.fast).mean())
+    ff_frac = float(np.asarray(batch.fast_float).mean())
+    int_tiles = float_tiles = 0.0
     if on_tpu:
         fn, packed = _packed_fn(batch, order="sorted")
-        fast_tiles = float(packed.tile_flags.mean())
+        int_tiles = float((packed.tile_flags == 1).mean())
+        float_tiles = float((packed.tile_flags == 2).mean())
     else:
         fn = _jnp_fn(batch)
-        fast_tiles = 0.0
     dt, out = _timeit(fn, None)
     pts = int(out.total_count)
     return _rec(
@@ -203,7 +205,9 @@ def bench_mixed(n_series, on_tpu):
         "datapoints/s",
         series=n_series,
         fast_lane_fraction=round(fast_frac, 4),
-        fast_tile_fraction=round(fast_tiles, 4),
+        fast_float_lane_fraction=round(ff_frac, 4),
+        int_tile_fraction=round(int_tiles, 4),
+        float_tile_fraction=round(float_tiles, 4),
         composition="30% float, 8% counter, 5% tu-change, 2% annotation, 55% gauge",
     )
 

@@ -33,7 +33,7 @@ class _SnapRec(ctypes.Structure):
         ("sig", ctypes.c_uint8),
         ("mult", ctypes.c_uint8),
         ("is_float", ctypes.c_uint8),
-        ("flags", ctypes.c_uint8),  # bit 0: fast chunk
+        ("flags", ctypes.c_uint8),  # bit 0: int-fast chunk; bit 1: float-fast
     ]
 
 
@@ -219,6 +219,7 @@ def prescan_batch(
                     mult=r.mult,
                     is_float=bool(r.is_float),
                     fast=bool(r.flags & 1),
+                    fast_float=bool(r.flags & 2),
                     total_bits=total_bits,
                 )
             )

@@ -92,6 +92,9 @@ class ChunkedBatch:
     # unit, exactly k records — see snapshot_stream); empty padding lanes
     # are fast=True so they never force a mixed tile slow
     fast: np.ndarray = None  # bool[N]
+    # float-mode analogue: marker-free XOR/repeat records, float at chunk
+    # start and after every record (the float-specialized kernel body)
+    fast_float: np.ndarray = None  # bool[N]
 
     @property
     def num_lanes(self) -> int:
@@ -115,8 +118,16 @@ def snapshot_stream(
     total_bits = len(data) * 8
     # fast-chunk classification (device kernel specialization, ops/fused.py):
     # a chunk is fast iff all k records are marker-free int-mode records with
-    # a constant {s, ms} time unit; tracked record by record below
+    # a constant {s, ms} time unit; tracked record by record below.
+    # fast_float: the float-mode analogue — every record marker-free and
+    # float-mode with the chunk ALREADY in float mode at its start, so the
+    # device sees only "1"+XOR (OPCODE_NO_UPDATE=1) or "01" repeat
+    # (OPCODE_UPDATE=0 + OPCODE_REPEAT=1) records; an int→float transition
+    # record carries a full float the float body can't parse — requiring
+    # is_float at start AND after every record excludes it.
     chunk_fast = True
+    chunk_fast_float = True
+    chunk_start_float = False
     chunk_recs = 0
 
     def snap():
@@ -144,8 +155,13 @@ def snapshot_stream(
         if pending is not None and per:
             # the previous chunk just completed all k records: seal its flag
             per[-1]["fast"] = chunk_fast and chunk_recs == k
+            per[-1]["fast_float"] = (
+                chunk_fast_float and chunk_start_float and chunk_recs == k
+            )
         if pending is not None:
             chunk_fast, chunk_recs = True, 0
+            chunk_fast_float = True
+            chunk_start_float = bool(it.is_float) and int_optimized
         markers_before = it.ts_iterator.num_markers
         if not it.next():
             # no record followed: don't emit an empty trailing chunk
@@ -154,10 +170,14 @@ def snapshot_stream(
             per.append(pending)
         nrec += 1
         chunk_recs += 1
+        marker_seen = it.ts_iterator.num_markers != markers_before
+        unit_ok = int(it.ts_iterator.time_unit) in (
+            int(Unit.SECOND), int(Unit.MILLISECOND)
+        )
         if (
-            it.ts_iterator.num_markers != markers_before
+            marker_seen
             or it.is_float
-            or int(it.ts_iterator.time_unit) not in (int(Unit.SECOND), int(Unit.MILLISECOND))
+            or not unit_ok
             or not int_optimized
             # int32-safety: the specialized body runs the whole int path in
             # 32-bit (sig <= 31, value in i32 range after every record; the
@@ -166,17 +186,23 @@ def snapshot_stream(
             or abs(it.int_val) > 2147483647
         ):
             chunk_fast = False
+        if marker_seen or not it.is_float or not unit_ok or not int_optimized:
+            chunk_fast_float = False
         if it.ts_iterator.done or it.err is not None:
             break
     if per and chunk_recs > 0:
         # seal the trailing chunk; a break exactly on a boundary (chunk_recs
         # == 0 after reset) means the last chunk was already sealed above
         per[-1]["fast"] = chunk_fast and chunk_recs == k
+        per[-1]["fast_float"] = (
+            chunk_fast_float and chunk_start_float and chunk_recs == k
+        )
     offs = [p["off"] for p in per] + [total_bits]
     for i, p in enumerate(per):
         p["span"] = offs[i + 1] - offs[i]
         p["total_bits"] = total_bits
         p.setdefault("fast", False)
+        p.setdefault("fast_float", False)
     return per
 
 
@@ -208,6 +234,7 @@ def assemble_chunked(
     mult = np.zeros(n, np.int32)
     isf = np.zeros(n, bool)
     fast = np.ones(n, bool)  # empty padding lanes stay fast
+    fast_float = np.ones(n, bool)  # likewise
 
     for si, (data, per) in enumerate(zip(streams, snaps)):
         padded = (
@@ -233,8 +260,9 @@ def assemble_chunked(
             mult[i] = p["mult"]
             isf[i] = p["is_float"]
             # the first chunk decodes the 64-bit head + first-value format
-            # the fast body doesn't implement
+            # the fast bodies don't implement
             fast[i] = bool(p.get("fast", False)) and ci != 0
+            fast_float[i] = bool(p.get("fast_float", False)) and ci != 0
 
     return ChunkedBatch(
         windows=windows,
@@ -254,6 +282,7 @@ def assemble_chunked(
         num_series=s,
         num_chunks=c,
         fast=fast,
+        fast_float=fast_float,
     )
 
 
@@ -294,6 +323,7 @@ def tile_chunked(batch: ChunkedBatch, n_series: int) -> ChunkedBatch:
         num_series=n_series,
         num_chunks=batch.num_chunks,
         fast=t(batch.fast) if batch.fast is not None else None,
+        fast_float=t(batch.fast_float) if batch.fast_float is not None else None,
     )
 
 
@@ -314,6 +344,7 @@ def select_series(batch: ChunkedBatch, series_idx) -> ChunkedBatch:
         num_series=int(sel.size),
         num_chunks=c,
         fast=g(batch.fast) if batch.fast is not None else None,
+        fast_float=g(batch.fast_float) if batch.fast_float is not None else None,
     )
 
 

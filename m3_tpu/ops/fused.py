@@ -35,8 +35,10 @@ from .decode import (
     _decode_value,
     _decode_value_fast,
     _extract,
+    _extract32,
     _int32_val_to_f32,
     _int_val_to_f32,
+    _read_xor,
     _ts_consumed_fast,
 )
 
@@ -264,6 +266,74 @@ def _run_lane_tile_fast(windows_cols, rel_pos, num_bits, int_val, sig, mult,
     )
 
 
+def _run_lane_tile_fast_float(windows_cols, rel_pos, num_bits,
+                              prev_float_bits, prev_xor,
+                              k: int, cw: int, unroll: bool = False) -> LaneAggregates:
+    """Specialized K-record body for FLOAT-MODE fast chunks (fast_float
+    classification, ops/chunked.py): every record is marker-free with the
+    stream in float mode at the chunk start and after every record, unit
+    constant in {s, ms}. The only value formats are therefore
+    "1" + Gorilla XOR (NO_UPDATE) and the 2-bit "01" repeat — no int
+    paths, no mode-transition full floats, no marker peeks, no done/err
+    planes.
+    Timestamps contribute only their consumed width (_ts_consumed_fast)."""
+    rel_pos = jnp.asarray(rel_pos, I32)
+    shape = rel_pos.shape
+    active = jnp.asarray(num_bits, I32) > rel_pos
+    pfb0 = (jnp.asarray(prev_float_bits[0], U32), jnp.asarray(prev_float_bits[1], U32))
+    pxr0 = (jnp.asarray(prev_xor[0], U32), jnp.asarray(prev_xor[1], U32))
+    state0 = (jnp.zeros(shape, I32), pfb0, pxr0)
+    acc0 = (
+        jnp.zeros(shape, F32),
+        jnp.zeros(shape, I32),
+        jnp.full(shape, jnp.inf, F32),
+        jnp.full(shape, -jnp.inf, F32),
+        jnp.full(shape, jnp.nan, F32),
+    )
+    active_i = active.astype(I32)
+    # ts <= 36 bits; value <= 1 + 14 + 64 = 79 bits
+    MAX_REC_BITS = 36 + 79
+
+    def body(c, ts_widx, val_widx):
+        (pos, pfb, pxr), acc = c
+        s_sum, s_cnt, s_min, s_max, s_last = acc
+        ws_ts = _fetch4_select(windows_cols, cw, rel_pos, pos, max_widx=ts_widx)
+        pos = pos + _ts_consumed_fast(ws_ts)
+        ws = _fetch4_select(windows_cols, cw, rel_pos, pos, max_widx=val_widx)
+        # OPCODE_UPDATE = 0: the only update record a fast_float chunk can
+        # contain is "01" (update+repeat, 2 bits); NO_UPDATE = 1 prefixes
+        # the Gorilla XOR record at offset 1
+        repeat = _extract32(ws, 0, 1) == 0
+        nb, nx, consumed = _read_xor(ws, 1, pfb, pxr)
+        pfb = u64.select(repeat, pfb, nb)
+        pxr = u64.select(repeat, pxr, nx)
+        pos = pos + jnp.where(repeat, 2, 1 + consumed)
+        v = u64.f64_bits_to_f32(pfb)
+        s_sum = s_sum + jnp.where(active, v, F32(0))
+        s_cnt = s_cnt + active_i
+        s_min = jnp.minimum(s_min, jnp.where(active, v, F32(jnp.inf)))
+        s_max = jnp.maximum(s_max, jnp.where(active, v, F32(-jnp.inf)))
+        s_last = jnp.where(active, v, s_last)
+        return ((pos, pfb, pxr), (s_sum, s_cnt, s_min, s_max, s_last))
+
+    if unroll:
+        carry = (state0, acc0)
+        for j in range(k):
+            ts_widx = (31 + MAX_REC_BITS * j) >> 5
+            val_widx = (31 + MAX_REC_BITS * j + 36) >> 5
+            carry = body(carry, ts_widx, val_widx)
+        _state, acc = carry
+    else:
+        _state, acc = jax.lax.fori_loop(
+            0, k, lambda _i, c: body(c, None, None), (state0, acc0)
+        )
+    s_sum, s_cnt, s_min, s_max, s_last = acc
+    return LaneAggregates(
+        sum=s_sum, count=s_cnt, min=s_min, max=s_max, last=s_last,
+        err=jnp.zeros(shape, bool),
+    )
+
+
 # ---------------------------------------------------------------------------
 # jnp fallback path (CPU tests, oracle, non-TPU backends)
 # ---------------------------------------------------------------------------
@@ -312,7 +382,8 @@ class PackedLanes(NamedTuple):
 
     windows4: np.ndarray  # u32[tiles, CW, R, 128]
     lanes4: np.ndarray  # u32[tiles, NLANE, R, 128]
-    tile_flags: np.ndarray  # i32[tiles] 1 = every lane in tile is fast
+    tile_flags: np.ndarray  # i32[tiles]: 0 general, 1 every lane int-fast,
+    #                         2 every lane float-fast
     n: int  # true lane count (before tile padding)
     order: str  # "c" (chunk-major), "s" (series-major), "sorted"
     inv: np.ndarray | None = None  # "sorted": i32[S]; original series i's
@@ -347,12 +418,23 @@ def pack_lane_inputs(batch, order: str = "c", rows: int = ROWS_DEFAULT) -> Packe
     inv_series = None
     if order == "sorted":
         fast_lanes = getattr(batch, "fast", None)
-        if fast_lanes is None:
-            key = np.zeros(s, np.int64)
-        else:
-            key = np.asarray(fast_lanes, bool).reshape(s, c).sum(axis=1)
-        # stable: preserves input locality within each class
-        perm_series = np.argsort(-key, kind="stable")
+        ff_lanes = getattr(batch, "fast_float", None)
+        int_cnt = (
+            np.asarray(fast_lanes, bool).reshape(s, c).sum(axis=1)
+            if fast_lanes is not None
+            else np.zeros(s, np.int64)
+        )
+        flt_cnt = (
+            np.asarray(ff_lanes, bool).reshape(s, c).sum(axis=1)
+            if ff_lanes is not None
+            else np.zeros(s, np.int64)
+        )
+        # group series by dominant class (int-fast, then float-fast, then
+        # slow) so each class's tiles stay homogeneous; stable order within
+        group = np.where(
+            (int_cnt > 0) & (int_cnt >= flt_cnt), 0, np.where(flt_cnt > 0, 1, 2)
+        )
+        perm_series = np.argsort(group, kind="stable")
         inv_series = np.argsort(perm_series).astype(np.int32)
 
     def reorder(x):
@@ -396,13 +478,26 @@ def pack_lane_inputs(batch, order: str = "c", rows: int = ROWS_DEFAULT) -> Packe
         lpad.reshape(NLANE, tiles, r, cc).transpose(1, 0, 2, 3)
     )
 
-    fast = getattr(batch, "fast", None)
-    if fast is None:
-        fpad = np.zeros(npad, bool)
-    else:
-        fpad = np.ones(npad, bool)  # padding lanes never force a tile slow
-        fpad[:n] = reorder(np.asarray(fast, bool))
-    tile_flags = fpad.reshape(tiles, tile_lanes).all(axis=1).astype(np.int32)
+    # tile class: 1 = every lane int-fast, 2 = every lane float-fast,
+    # 0 = mixed/slow (general body). Padding lanes are wildcard-fast.
+    def _pad_flags(arr):
+        if arr is None:
+            return np.zeros(npad, bool)
+        p = np.ones(npad, bool)  # padding lanes never force a tile slow
+        p[:n] = reorder(np.asarray(arr, bool))
+        return p
+
+    int_tiles = (
+        _pad_flags(getattr(batch, "fast", None))
+        .reshape(tiles, tile_lanes)
+        .all(axis=1)
+    )
+    flt_tiles = (
+        _pad_flags(getattr(batch, "fast_float", None))
+        .reshape(tiles, tile_lanes)
+        .all(axis=1)
+    )
+    tile_flags = np.where(int_tiles, 1, np.where(flt_tiles, 2, 0)).astype(np.int32)
     return PackedLanes(
         windows4=windows4, lanes4=lanes4, tile_flags=tile_flags, n=n,
         order=order, inv=inv_series,
@@ -458,10 +553,10 @@ def _pallas_kernel_packed(
         general()
         return
 
-    is_fast = flag_ref[pl.program_id(0)] != 0
-    pl.when(~is_fast)(general)
+    flag = flag_ref[pl.program_id(0)]
+    pl.when(flag == 0)(general)
 
-    @pl.when(is_fast)
+    @pl.when(flag == 1)
     def _fast():
         write(
             _run_lane_tile_fast(
@@ -471,6 +566,21 @@ def _pallas_kernel_packed(
                 pair("int_val"),
                 as_i32(ln("sig")),
                 as_i32(ln("mult")),
+                k,
+                cw,
+                unroll=unroll,
+            )
+        )
+
+    @pl.when(flag == 2)
+    def _fast_float():
+        write(
+            _run_lane_tile_fast_float(
+                cols,
+                as_i32(ln("rel_pos")),
+                as_i32(ln("num_bits")),
+                pair("prev_float_bits"),
+                pair("prev_xor"),
                 k,
                 cw,
                 unroll=unroll,

@@ -138,7 +138,8 @@ def write_fileset(
                 p["sig"],
                 p["mult"],
                 int(p["is_float"]),
-                1 if p.get("fast") else 0,
+                # flags: bit 0 int-fast chunk, bit 1 float-fast chunk
+                (1 if p.get("fast") else 0) | (2 if p.get("fast_float") else 0),
             )
         side_bytes = side.tobytes()
         index_entries.append(
@@ -444,6 +445,9 @@ class FilesetReader:
                     mult=int(raw["mult"][j]),
                     is_float=bool(raw["is_float"][j]),
                     fast=bool(raw["flags"][j] & 1)
+                    if "flags" in raw.dtype.names
+                    else False,
+                    fast_float=bool(raw["flags"][j] & 2)
                     if "flags" in raw.dtype.names
                     else False,
                     span=int(offs[j + 1]) - int(raw["off"][j]),

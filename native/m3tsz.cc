@@ -506,7 +506,7 @@ struct SnapRec {  // matches storage/fs.py SIDE_DTYPE (v2, with flags)
   uint8_t sig;
   uint8_t mult;
   uint8_t is_float;
-  uint8_t flags;  // bit 0: fast chunk (all-int, marker-free, {s,ms} unit)
+  uint8_t flags;  // bit 0: int fast chunk; bit 1: float-mode fast chunk
 };
 #pragma pack(pop)
 
@@ -831,6 +831,8 @@ int32_t m3tsz_prescan(const uint8_t* data, int64_t len_bytes, int32_t k,
   int64_t nrec = 0;
   // fast-chunk classification mirrors ops/chunked.snapshot_stream
   bool chunk_fast = true;
+  bool chunk_fast_float = true;   // flags bit 1: float-mode fast chunk
+  bool chunk_start_float = false;
   int chunk_recs = 0;
   // initial unit for the first snapshot (mirrors snapshot_stream)
   while (true) {
@@ -838,10 +840,14 @@ int32_t m3tsz_prescan(const uint8_t* data, int64_t len_bytes, int32_t k,
     bool has_pending = false;
     if (nrec % k == 0 && nsnap < max_snaps) {
       if (nsnap > 0) {
-        // previous chunk completed all k records: seal its flag
-        out[nsnap - 1].flags = (chunk_fast && chunk_recs == k) ? 1 : 0;
+        // previous chunk completed all k records: seal its flags
+        uint8_t fl = (chunk_fast && chunk_recs == k) ? 1 : 0;
+        if (chunk_fast_float && chunk_start_float && chunk_recs == k) fl |= 2;
+        out[nsnap - 1].flags = fl;
       }
       chunk_fast = true;
+      chunk_fast_float = true;
+      chunk_start_float = it.is_float && it.int_optimized;
       chunk_recs = 0;
       pending.off = (uint32_t)it.r.pos;
       pending.prev_time = (uint64_t)it.prev_time;
@@ -868,15 +874,21 @@ int32_t m3tsz_prescan(const uint8_t* data, int64_t len_bytes, int32_t k,
     if (has_pending) out[nsnap++] = pending;
     nrec++;
     chunk_recs++;
-    if (it.markers != markers_before || it.is_float ||
-        !(it.time_unit == 1 || it.time_unit == 2) || !it.int_optimized ||
+    bool marker_seen = it.markers != markers_before;
+    bool unit_ok = (it.time_unit == 1 || it.time_unit == 2);
+    if (marker_seen || it.is_float || !unit_ok || !it.int_optimized ||
         it.sig > 31 || std::fabs(it.int_val) > 2147483647.0) {
       chunk_fast = false;
+    }
+    if (marker_seen || !it.is_float || !unit_ok || !it.int_optimized) {
+      chunk_fast_float = false;
     }
     if (it.done || it.err) break;
   }
   if (nsnap > 0 && chunk_recs > 0) {
-    out[nsnap - 1].flags = (chunk_fast && chunk_recs == k) ? 1 : 0;
+    uint8_t fl = (chunk_fast && chunk_recs == k) ? 1 : 0;
+    if (chunk_fast_float && chunk_start_float && chunk_recs == k) fl |= 2;
+    out[nsnap - 1].flags = fl;
   }
   return nsnap;
 }

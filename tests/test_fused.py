@@ -168,14 +168,71 @@ def test_sorted_pack_tile_flags_recover_fast_majority():
     fast_frac = float(np.asarray(batch.fast).mean())
     packed_c = fused.pack_lane_inputs(batch, order="c", rows=8)
     packed_s = fused.pack_lane_inputs(batch, order="sorted", rows=8)
-    frac_c = packed_c.tile_flags.mean()
-    frac_s = packed_s.tile_flags.mean()
+    frac_c = (packed_c.tile_flags == 1).mean()
+    frac_s = (packed_s.tile_flags == 1).mean()
     # series-granularity sorting can't reclaim a fast-rich series' own slow
     # boundary chunks (chunk 0 + EOS tail, ~2/C of its lanes) — the bound
     # is fast_frac minus that structural loss, not fast_frac itself
     c = batch.num_chunks
     assert frac_s >= fast_frac - 2.5 / c
     assert frac_s > frac_c
+
+
+def test_float_fast_tiles_interpret_match_oracle():
+    """fast_float tiles (class 2) route through the float-specialized body:
+    all-float batch large enough for homogeneous float tiles must match the
+    oracle, including repeated values (the 2-bit '01' repeat record)."""
+    from m3_tpu.codec.m3tsz import Encoder
+    from m3_tpu.ops import fused
+    from m3_tpu.ops.chunked import lane_kwargs
+    from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+
+    NANOS = 1_000_000_000
+    T0 = 1_600_000_000 * NANOS
+    rng = np.random.RandomState(3)
+    streams = []
+    for s in range(32):
+        enc = Encoder(T0)
+        v = 0.12345
+        for j in range(97):
+            if rng.rand() < 0.3:
+                pass  # repeat the previous value → '01' repeat records
+            else:
+                v = float(rng.lognormal(0, 2))
+            enc.encode(T0 + j * NANOS, v)
+        streams.append(enc.stream())
+    batch = tile_chunked(build_chunked(streams, k=16), 2048)
+    assert np.asarray(batch.fast_float).mean() > 0.5
+    packed = fused.pack_lane_inputs(batch, order="sorted", rows=8)
+    assert (packed.tile_flags == 2).sum() >= 5
+    got = chunked_scan_aggregate_packed(
+        packed.windows4, packed.lanes4, packed.tile_flags, n=packed.n,
+        s=batch.num_series, c=batch.num_chunks, k=batch.k, interpret=True,
+        lane_order="sorted", inv=packed.inv,
+    )
+    args = chunked_device_args(batch, device_put=False)
+    _assert_matches(got, _oracle(batch, args), rtol=1e-5)
+
+
+def test_three_class_sorted_mixed_interpret():
+    """Mixed workload through all three bodies at once (general + int fast
+    + float fast) with the series-sorted layout."""
+    from m3_tpu.ops import fused
+    from m3_tpu.parallel.scan import chunked_scan_aggregate_packed
+    from m3_tpu.utils.synthetic import synthetic_mixed_streams
+
+    streams = synthetic_mixed_streams(64, 97, seed=5, frac_float=0.5)
+    batch = tile_chunked(build_chunked(streams, k=16), 4096)
+    packed = fused.pack_lane_inputs(batch, order="sorted", rows=8)
+    classes = np.bincount(packed.tile_flags, minlength=3)
+    assert classes[1] > 0 and classes[2] > 0, classes
+    got = chunked_scan_aggregate_packed(
+        packed.windows4, packed.lanes4, packed.tile_flags, n=packed.n,
+        s=batch.num_series, c=batch.num_chunks, k=batch.k, interpret=True,
+        lane_order="sorted", inv=packed.inv,
+    )
+    args = chunked_device_args(batch, device_put=False)
+    _assert_matches(got, _oracle(batch, args), rtol=1e-5)
 
 
 def test_err_lane_host_stitch_on_mixed_batch():

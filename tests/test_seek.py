@@ -131,3 +131,33 @@ def test_reader_cache_lru_bound(tmp_path):
         sh.reader(FilesetID("ns", 0, b * BLOCK))
     assert len(sh._readers) == 2
     assert sh.reader_materializations == 4
+
+
+def test_fileset_side_tables_carry_fast_float(tmp_path):
+    """The side-file flags byte round-trips BOTH classification bits: a
+    float-mode stream read back from a fileset must classify fast_float so
+    the float-specialized kernel body engages on fileset-backed batches."""
+    import numpy as np
+
+    from m3_tpu.storage.fs import FilesetID, FilesetReader, write_fileset
+    from m3_tpu.utils.synthetic import synthetic_streams
+
+    NANOS = 1_000_000_000
+    streams_f = synthetic_streams(4, 97, seed=13, kind="float")
+    streams_g = synthetic_streams(4, 97, seed=13, kind="gauge")
+    k = 16
+    series = {
+        f"s{i}".encode(): s for i, s in enumerate(streams_f + streams_g)
+    }
+    fid = FilesetID(namespace="ns", shard=0, block_start=1_600_000_000 * NANOS)
+    write_fileset(str(tmp_path), fid, series, block_size_nanos=7200 * NANOS, chunk_k=k)
+    reader = FilesetReader(str(tmp_path), fid)
+    batch = reader.chunked_batch()
+    ff = np.asarray(batch.fast_float).reshape(8, -1)
+    fast = np.asarray(batch.fast).reshape(8, -1)
+    # float streams: middle chunks float-fast, none int-fast
+    assert ff[:4, 1:-2].all()
+    assert not fast[:4].any()
+    # gauge streams: middle chunks int-fast, none float-fast
+    assert fast[4:, 1:-2].all()
+    assert not ff[4:, :].any()
