@@ -1,0 +1,55 @@
+"""Dynamic namespace registry in the control-plane KV.
+
+Reference: /root/reference/src/dbnode/namespace/dynamic.go — namespaces are
+a single versioned registry value in etcd; every dbnode watches it and
+applies adds/updates live (server.go KV-watch reconfig), and the
+coordinator's database-create admin API writes it. Same shape here: one KV
+key holding {name → options}, CAS-mutated, watched by nodes.
+"""
+
+from __future__ import annotations
+
+KEY = "_namespaces"
+
+
+class NamespaceRegistry:
+    """Versioned registry of namespace options (namespace/dynamic.go)."""
+
+    def __init__(self, kv) -> None:
+        self.kv = kv
+
+    def get_all(self) -> dict[str, dict]:
+        vv = self.kv.get(KEY)
+        return dict(vv.value) if vv and vv.value else {}
+
+    def add(
+        self,
+        name: str,
+        retention_nanos: int,
+        block_size_nanos: int,
+        cold_writes_enabled: bool = True,
+    ) -> None:
+        """CAS upsert (concurrent admin calls must not clobber each other)."""
+        rec = {
+            "retention_nanos": int(retention_nanos),
+            "block_size_nanos": int(block_size_nanos),
+            "cold_writes_enabled": bool(cold_writes_enabled),
+        }
+        while True:
+            vv = self.kv.get(KEY)
+            cur = dict(vv.value) if vv and vv.value else {}
+            if cur.get(name) == rec:
+                return
+            cur[name] = rec
+            try:
+                if vv is None:
+                    self.kv.set_if_not_exists(KEY, cur)
+                else:
+                    self.kv.check_and_set(KEY, vv.version, cur)
+                return
+            except (ValueError, KeyError):
+                continue  # raced; re-read and retry
+
+    def watch(self, fn):
+        """fn(registry_dict) on every version; fires with current value."""
+        return self.kv.watch(KEY, lambda vv: fn(dict(vv.value or {})))

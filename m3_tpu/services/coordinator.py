@@ -566,13 +566,42 @@ class _Handler(BaseHTTPRequestHandler):
                 elif url.path == "/api/v1/services/m3db/database/create":
                     body = json.loads(self._body())
                     name = body["namespaceName"]
-                    opts = NamespaceOptions(
-                        retention_nanos=int(
-                            _parse_step(body.get("retentionTime", "48h")) * NANOS
-                        )
+                    retention = int(
+                        _parse_step(body.get("retentionTime", "48h")) * NANOS
                     )
-                    if name not in c.db.namespaces:
-                        c.db.create_namespace(name, opts)
+                    block_size = int(
+                        _parse_step(body.get("blockSize", "2h")) * NANOS
+                    )
+                    # dynamic registry (namespace/dynamic.go): every dbnode
+                    # watching the control plane creates the namespace live
+                    from ..cluster.namespaces import NamespaceRegistry
+
+                    reg = NamespaceRegistry(c.kv)
+                    existing = reg.get_all().get(name)
+                    if existing is not None and (
+                        existing["retention_nanos"] != retention
+                        or existing["block_size_nanos"] != block_size
+                    ):
+                        # running nodes never re-shape a live namespace —
+                        # accepting different options here would diverge
+                        # new/restarted replicas from live ones
+                        self._json(
+                            {
+                                "error": f"namespace {name} already exists "
+                                "with different options",
+                            },
+                            409,
+                        )
+                        return
+                    reg.add(name, retention, block_size)
+                    if hasattr(c.db, "create_namespace") and name not in c.db.namespaces:
+                        c.db.create_namespace(
+                            name,
+                            NamespaceOptions(
+                                retention_nanos=retention,
+                                block_size_nanos=block_size,
+                            ),
+                        )
                     self._json({"namespace": name}, 201)
                 elif (m := re.match(r"^/api/v1/rules/([^/]+)$", url.path)) is not None:
                     from ..rules.r2 import RuleStore, ruleset_from_dict

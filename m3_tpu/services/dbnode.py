@@ -58,6 +58,36 @@ def main(argv=None) -> int:
     )
     for ns in args.namespace or ["default"]:
         db.create_namespace(ns, opts)
+
+    # dynamic namespaces (namespace/dynamic.go): the control-plane registry
+    # is applied BEFORE bootstrap so registered namespaces recover their
+    # data, and watched after so admin-created namespaces appear live
+    kv = None
+    ns_registry = None
+    if args.kv_endpoint:
+        from ..cluster.kv_service import RemoteKVStore
+        from ..cluster.namespaces import NamespaceRegistry
+
+        kv = RemoteKVStore.connect(args.kv_endpoint)
+        ns_registry = NamespaceRegistry(kv)
+
+        def _apply_registry(reg: dict) -> None:
+            for name, rec in reg.items():
+                if name in db.namespaces:
+                    continue
+                db.create_namespace(
+                    name,
+                    NamespaceOptions(
+                        retention_nanos=int(rec["retention_nanos"]),
+                        block_size_nanos=int(rec["block_size_nanos"]),
+                        cold_writes_enabled=bool(
+                            rec.get("cold_writes_enabled", True)
+                        ),
+                    ),
+                )
+
+        _apply_registry(ns_registry.get_all())
+
     if not args.no_bootstrap:
         db.bootstrap()
 
@@ -72,17 +102,27 @@ def main(argv=None) -> int:
 
     # dynamic topology via the networked control plane
     # (server.go: embedded etcd + topology watch + KV runtime reconfig)
-    kv = cluster_db = None
+    cluster_db = None
     hb_stop = None
     if args.kv_endpoint:
         import threading
 
-        from ..cluster.kv_service import RemoteKVStore
         from ..cluster.placement import PlacementService
         from ..cluster.services import ServiceInstance, Services
         from ..storage.cluster_db import ClusterDatabase
 
-        kv = RemoteKVStore.connect(args.kv_endpoint)
+        # live namespace adds (bootstrap already applied the current set)
+        ns_registry.watch(_apply_registry)
+
+        # KV-watched runtime knobs over the NETWORKED control plane
+        # (server.go:1007-1268 runtime reconfig; kvconfig keys)
+        from ..storage.runtime import RuntimeOptionsManager
+
+        runtime_mgr = RuntimeOptionsManager(kv)
+        # watch() replays the current KV options to the new listener; with
+        # no KV value yet the defaults equal the Database's own
+        runtime_mgr.watch(db.apply_runtime_options)
+
         services = Services(kv, heartbeat_timeout=args.heartbeat_timeout)
         endpoint = f"{server.host}:{server.port}"
         services.advertise("m3db", ServiceInstance(args.node_id, endpoint))

@@ -558,7 +558,7 @@ class Database:
         """storage/mediator.go tick: expire buffers, filesets, and index
         blocks past retention (including their persisted segment files)."""
         with self.lock:
-            for name, ns in self.namespaces.items():
+            for name, ns in list(self.namespaces.items()):
                 for shard in ns.shards:
                     shard.tick(now_nanos)
                 if ns.index is not None:
@@ -613,7 +613,7 @@ class Database:
                 "snapshot_records": 0,
                 "sources": {},
             }
-            for name, ns in self.namespaces.items():
+            for name, ns in list(self.namespaces.items()):
                 r = self._bootstrap_namespace(
                     name, ns, peers_source, shard_filter, now_nanos, result,
                     has_peer_with_shard,
@@ -646,7 +646,7 @@ class Database:
     def flush_wals(self) -> None:
         """Barrier-fsync every namespace's commit log (write-behind WALs
         ack before fsync; callers needing a durability point use this)."""
-        for cl in self._commitlogs.values():
+        for cl in list(self._commitlogs.values()):
             cl.flush()
 
     def _bootstrap_namespace(
@@ -841,5 +841,5 @@ class Database:
 
     def close(self) -> None:
         with self.lock:
-            for cl in self._commitlogs.values():
+            for cl in list(self._commitlogs.values()):
                 cl.close()
