@@ -49,8 +49,12 @@ class FlushTimesStore:
 class ElectionManager:
     """Campaign/observe leadership for one aggregator replica."""
 
-    def __init__(self, kv, scope: str, instance_id: str) -> None:
-        self.election = LeaderElection(kv, f"aggregator/{scope}")
+    def __init__(
+        self, kv, scope: str, instance_id: str, lease_secs: float = 10.0
+    ) -> None:
+        self.election = LeaderElection(
+            kv, f"aggregator/{scope}", lease_secs=lease_secs
+        )
         self.instance_id = instance_id
 
     def elect(self) -> bool:

@@ -12,6 +12,10 @@ from __future__ import annotations
 KEY = "_namespaces"
 
 
+class NamespaceExistsError(ValueError):
+    """Create with options differing from the registered namespace."""
+
+
 class NamespaceRegistry:
     """Versioned registry of namespace options (namespace/dynamic.go)."""
 
@@ -29,7 +33,11 @@ class NamespaceRegistry:
         block_size_nanos: int,
         cold_writes_enabled: bool = True,
     ) -> None:
-        """CAS upsert (concurrent admin calls must not clobber each other)."""
+        """CAS insert. A namespace that already exists with DIFFERENT
+        options raises NamespaceExistsError from INSIDE the retry loop —
+        checking before calling would be a TOCTOU race between concurrent
+        admin calls, and silently overwriting would diverge replicas that
+        already created the namespace from the old record."""
         rec = {
             "retention_nanos": int(retention_nanos),
             "block_size_nanos": int(block_size_nanos),
@@ -38,8 +46,13 @@ class NamespaceRegistry:
         while True:
             vv = self.kv.get(KEY)
             cur = dict(vv.value) if vv and vv.value else {}
-            if cur.get(name) == rec:
+            existing = cur.get(name)
+            if existing == rec:
                 return
+            if existing is not None:
+                raise NamespaceExistsError(
+                    f"namespace {name} already exists with different options"
+                )
             cur[name] = rec
             try:
                 if vv is None:

@@ -98,31 +98,64 @@ class Services:
 class LeaderElection:
     """Per-electionID campaign/resign/leader (services/leader/election).
 
-    CAS on a KV key; leadership is lost when the leader resigns or its
-    session is explicitly expired (the fake-clusterservices pattern the
-    reference's integration tests rely on)."""
+    LEASED leadership over a CAS'd KV key (etcd-session semantics without
+    etcd): the leader's record carries a wall-clock lease timestamp it
+    refreshes on every campaign() call; a challenger may CAS-take the key
+    once the lease has aged past ``lease_secs`` — so a SIGKILLed leader
+    expires on its own across real processes. ``expire()`` force-expires
+    for tests (the fake-clusterservices pattern)."""
 
-    def __init__(self, kv: KVStore, election_id: str) -> None:
+    def __init__(
+        self, kv: KVStore, election_id: str, lease_secs: float = 10.0, clock=time.time
+    ) -> None:
         self.kv = kv
         self.key = f"_election/{election_id}"
+        self.lease_secs = lease_secs
+        self.clock = clock
+
+    @staticmethod
+    def _id_of(value) -> str | None:
+        if value is None:
+            return None
+        return value["id"] if isinstance(value, dict) else value
 
     def campaign(self, candidate: str) -> bool:
         vv = self.kv.get(self.key)
-        if vv is None or vv.value is None:
+        now = self.clock()
+        cur = vv.value if vv else None
+        cur_id = self._id_of(cur)
+        if cur_id == candidate:
+            # refresh the lease; a successful CAS proves we still hold it
             try:
-                self.kv.check_and_set(self.key, vv.version if vv else 0, candidate)
+                self.kv.check_and_set(
+                    self.key, vv.version, {"id": candidate, "t": now}
+                )
                 return True
-            except (ValueError, KeyError):
+            except ValueError:
                 return self.leader() == candidate
-        return vv.value == candidate
+        if cur_id is not None:
+            # a record with no parseable lease (legacy string value, missing
+            # 't') must count as EXPIRED — treating it as fresh would block
+            # takeover from a dead leader forever
+            held_at = cur.get("t", 0) if isinstance(cur, dict) else 0
+            if now - held_at <= self.lease_secs:
+                return False  # live leader
+            # lease expired: fall through to take over
+        try:
+            self.kv.check_and_set(
+                self.key, vv.version if vv else 0, {"id": candidate, "t": now}
+            )
+            return True
+        except (ValueError, KeyError):
+            return self.leader() == candidate
 
     def leader(self) -> str | None:
         vv = self.kv.get(self.key)
-        return vv.value if vv else None
+        return self._id_of(vv.value) if vv else None
 
     def resign(self, candidate: str) -> None:
         vv = self.kv.get(self.key)
-        if vv and vv.value == candidate:
+        if vv and self._id_of(vv.value) == candidate:
             self.kv.check_and_set(self.key, vv.version, None)
 
     def expire(self) -> None:
@@ -132,4 +165,4 @@ class LeaderElection:
             self.kv.check_and_set(self.key, vv.version, None)
 
     def watch(self, fn) -> callable:
-        return self.kv.watch(self.key, lambda vv: fn(vv.value))
+        return self.kv.watch(self.key, lambda vv: fn(self._id_of(vv.value)))

@@ -42,6 +42,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--msg-listen): flushed aggregates ride the message bus with "
         "at-least-once acks instead of direct dbnode writes",
     )
+    p.add_argument(
+        "--kv-endpoint",
+        default="",
+        help="control-plane KV for replicated HA: leased leader election "
+        "per --election-scope + shared flush times (followers keep warm "
+        "state and take over without re-emitting windows)",
+    )
+    p.add_argument("--instance-id", default="agg0")
+    p.add_argument("--election-scope", default="default")
+    p.add_argument("--election-lease-secs", type=float, default=10.0)
     return p
 
 
@@ -92,11 +102,29 @@ def main(argv=None) -> int:
                 [(m.suffixed_id, m.time_nanos, m.value) for m in metrics],
             )
 
+    # replicated HA over the networked control plane (election_mgr.go +
+    # follower_flush_mgr.go): leased election decides the emitter; shared
+    # flush times let a takeover resume exactly where the leader stopped
+    election = flush_times = None
+    kv = None
+    if args.kv_endpoint:
+        from ..aggregator.election import ElectionManager, FlushTimesStore
+        from ..cluster.kv_service import RemoteKVStore
+
+        kv = RemoteKVStore.connect(args.kv_endpoint)
+        election = ElectionManager(
+            kv, args.election_scope, args.instance_id,
+            lease_secs=args.election_lease_secs,
+        )
+        flush_times = FlushTimesStore(kv, scope=args.election_scope)
+
     policies = tuple(StoragePolicy.parse(s) for s in args.policy) or ()
     agg = Aggregator(
         num_shards=args.num_shards,
         default_policies=policies,
         flush_handler=handler,
+        election=election,
+        flush_times=flush_times,
     )
     server = AggregatorIngestServer(agg, host=args.host, port=args.port)
 
@@ -134,6 +162,8 @@ def main(argv=None) -> int:
             producer.retry_unacked()
         if forward_node is not None:
             forward_node.close()
+        if kv is not None:
+            kv.close()
     return 0
 
 

@@ -576,24 +576,18 @@ class _Handler(BaseHTTPRequestHandler):
                     # watching the control plane creates the namespace live
                     from ..cluster.namespaces import NamespaceRegistry
 
-                    reg = NamespaceRegistry(c.kv)
-                    existing = reg.get_all().get(name)
-                    if existing is not None and (
-                        existing["retention_nanos"] != retention
-                        or existing["block_size_nanos"] != block_size
-                    ):
+                    from ..cluster.namespaces import NamespaceExistsError
+
+                    try:
+                        # conflict detection lives INSIDE add()'s CAS loop
+                        # (a pre-check here would race concurrent creates)
+                        NamespaceRegistry(c.kv).add(name, retention, block_size)
+                    except NamespaceExistsError as exc:
                         # running nodes never re-shape a live namespace —
-                        # accepting different options here would diverge
-                        # new/restarted replicas from live ones
-                        self._json(
-                            {
-                                "error": f"namespace {name} already exists "
-                                "with different options",
-                            },
-                            409,
-                        )
+                        # accepting different options would diverge new/
+                        # restarted replicas from live ones
+                        self._json({"error": str(exc)}, 409)
                         return
-                    reg.add(name, retention, block_size)
                     if hasattr(c.db, "create_namespace") and name not in c.db.namespaces:
                         c.db.create_namespace(
                             name,

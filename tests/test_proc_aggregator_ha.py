@@ -1,0 +1,107 @@
+"""Aggregator leader/follower HA across REAL processes over the networked
+control plane (election_mgr.go + follower_flush_mgr.go semantics with
+leased leadership instead of etcd sessions):
+
+  two aggregator processes with mirrored input -> only the LEASED leader
+  emits; SIGKILL the leader -> the follower's next flush pass takes over
+  once the lease ages out, resuming from the shared flush times without
+  re-emitting already-flushed windows.
+"""
+
+import sys
+import time
+
+from m3_tpu.aggregator.server import AggregatorClient
+from m3_tpu.metrics.encoding import UnaggregatedMessage
+from m3_tpu.metrics.types import MetricType, Untimed
+from m3_tpu.rules.rules import encode_tags_id
+from m3_tpu.testing.proc_cluster import ProcCluster, _spawn_listening
+
+
+def test_leader_death_cross_process_takeover(tmp_path):
+    cluster = ProcCluster(
+        num_nodes=1, num_shards=4, replica_factor=1,
+        heartbeat_timeout=2.0, base_dir=str(tmp_path),
+    )
+    aggs = []
+    try:
+        node = next(iter(cluster.nodes.values()))
+        for iid in ("aggA", "aggB"):
+            proc, host, port = _spawn_listening(
+                [
+                    sys.executable, "-m", "m3_tpu.services.aggregator",
+                    "--port", "0", "--policy", "10s:2d",
+                    "--flush-interval-secs", "0.4",
+                    "--forward", node.endpoint,
+                    "--kv-endpoint", cluster.kv_endpoint,
+                    "--instance-id", iid,
+                    "--election-lease-secs", "2.0",
+                ],
+                f"aggregator-{iid}",
+            )
+            aggs.append((proc, AggregatorClient([(host, port)])))
+
+        tags = ((b"__name__", b"ha_metric"),)
+        mid = encode_tags_id(tags)
+        t0 = time.time_ns() - 60 * 10**9  # a minute ago: windows closed
+
+        def send(t, v):
+            # mirrored ingest: every replica sees every metric
+            for _, client in aggs:
+                client.send(
+                    UnaggregatedMessage(
+                        Untimed(MetricType.GAUGE, mid, gauge_value=v), t, timed=True
+                    )
+                )
+
+        for i in range(6):  # one point per 10s window over 1 minute
+            send(t0 + i * 10 * 10**9, float(i))
+
+        # the direct-forward path writes UNTAGGED suffixed ids
+        # (AggregatedMetric.suffixed_id): read the series directly
+        sid = mid + b".last"  # gauge default aggregation
+
+        def fetch_points():
+            dps = node.client.read(
+                "default", sid, t0 - 10**9, time.time_ns() + 120 * 10**9
+            )
+            return sorted(dp.value for dp in dps)
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            pts = fetch_points()
+            if len(pts) >= 6:
+                break
+            time.sleep(0.3)
+        # exactly once: both replicas aggregated, only the leader emitted
+        assert pts == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0], pts
+
+        # SIGKILL the leader (whichever holds the lease — kill aggA, which
+        # campaigned first; if B somehow leads, the symmetric logic holds)
+        aggs[0][0].kill()
+        aggs[0][0].wait(timeout=10)
+
+        # new data must land AFTER the dead leader's shared flush boundary
+        # (anything older is late data both replicas correctly drop) — send
+        # into the CURRENT window and wait for it to close + takeover
+        t1 = time.time_ns()
+        aggs[1][1].send(
+            UnaggregatedMessage(
+                Untimed(MetricType.GAUGE, mid, gauge_value=777.0), t1, timed=True
+            )
+        )
+        deadline = time.time() + 40  # lease (2s) + window close (<=10s) + slack
+        while time.time() < deadline:
+            pts = fetch_points()
+            if len(pts) >= 7:
+                break
+            time.sleep(0.3)
+        # the FOLLOWER emitted the new window exactly once after takeover
+        assert pts == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 777.0], pts
+    finally:
+        for proc, client in aggs:
+            client.close()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        cluster.close()
