@@ -302,7 +302,19 @@ class Aggregator:
                 self._pending_emit = to_send
                 raise
         if leader and self.flush_times is not None and flushed_boundaries:
-            self.flush_times.update(flushed_boundaries)
+            from ..cluster.kv import FenceError
+
+            try:
+                self.flush_times.update(
+                    flushed_boundaries,
+                    fence=self.election.fence if self.election is not None else None,
+                )
+            except FenceError:
+                # leadership was superseded between elect() and here (e.g. a
+                # long stall): the new leader re-emits these windows from its
+                # mirror, so dropping the stale progress write is the safe,
+                # exactly-once-preserving outcome
+                pass
         if self.entry_ttl_nanos is not None:
             # drained buffers make expiry safe; idle entries release their
             # interned id slots (entry.go TTL close cycle)

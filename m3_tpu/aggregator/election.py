@@ -28,18 +28,22 @@ class FlushTimesStore:
         vv = self.kv.get(self.key)
         return dict(vv.value) if vv and vv.value else {}
 
-    def update(self, updates: dict[str, int]) -> None:
-        """Merge updates, keeping the max boundary per policy (CAS loop)."""
+    def update(self, updates: dict[str, int], fence=None) -> None:
+        """Merge updates, keeping the max boundary per policy (CAS loop).
+
+        ``fence`` is the leader's (lease_key, holder, token): the KV store
+        rejects the write (FenceError) if the writer's lease was superseded
+        — a deposed leader resuming from a GC pause cannot clobber the new
+        leader's flush progress."""
         for _ in range(16):
             vv = self.kv.get(self.key)
             cur = dict(vv.value) if vv and vv.value else {}
             for k, boundary in updates.items():
                 cur[k] = max(boundary, cur.get(k, 0))
             try:
-                if vv is None:
-                    self.kv.set_if_not_exists(self.key, cur)
-                else:
-                    self.kv.check_and_set(self.key, vv.version, cur)
+                self.kv.check_and_set(
+                    self.key, vv.version if vv else 0, cur, fence=fence
+                )
                 return
             except (ValueError, KeyError):
                 continue  # raced another writer; re-read and retry
@@ -67,6 +71,12 @@ class ElectionManager:
     @property
     def is_leader(self) -> bool:
         return self.election.leader() == self.instance_id
+
+    @property
+    def fence(self):
+        """(lease_key, holder, token) proving this instance's leadership;
+        attached to flush-time writes so a deposed leader is fenced out."""
+        return self.election.fence(self.instance_id)
 
     def resign(self) -> None:
         self.election.resign(self.instance_id)

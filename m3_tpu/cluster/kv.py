@@ -24,10 +24,35 @@ class VersionedValue:
     value: Any
 
 
-class KVStore:
-    """kv.Store: Get/Set/SetIfNotExists/CheckAndSet + watches."""
+class LeaseHeld(Exception):
+    """Lease acquisition rejected: another holder's lease is still live."""
 
-    def __init__(self, backing_path: str | None = None) -> None:
+    def __init__(self, holder: str, expires_in: float) -> None:
+        super().__init__(f"lease held by {holder} for another {expires_in:.3f}s")
+        self.holder = holder
+        self.expires_in = expires_in
+
+
+class FenceError(Exception):
+    """A fenced write's lease token no longer matches the live lease
+    (the writer's leadership was lost or superseded)."""
+
+
+class KVStore:
+    """kv.Store: Get/Set/SetIfNotExists/CheckAndSet + watches + leases.
+
+    Leases (etcd lease/session role, arbitrated on the STORE's clock — not
+    the clients', so cross-process clock skew cannot yield two leaders):
+    a lease is an ordinary versioned KV record whose value is
+    ``{"holder", "token", "ttl", "acquired_at"}``; watches, persistence and
+    CAS therefore work on it unchanged. ``token`` is a per-key fencing
+    counter that increases on every distinct acquisition; fenced writes
+    (``fence=(lease_key, holder, token)``) are rejected once the token is
+    stale, which makes a suspended ex-leader's late flushes harmless
+    (the etcd-session + STM pattern of the reference's election_mgr)."""
+
+    def __init__(self, backing_path: str | None = None, clock=time.time) -> None:
+        self.clock = clock
         self._lock = threading.RLock()
         self._change = threading.Condition(self._lock)
         self._data: dict[str, VersionedValue] = {}
@@ -70,6 +95,102 @@ class KVStore:
         with self._lock:
             return self._data.get(key)
 
+    # -- leases (server-clock arbitration + fencing tokens) --
+
+    def _lease_rec(self, key: str) -> dict | None:
+        vv = self._data.get(key)
+        if vv is None or not isinstance(vv.value, dict) or "token" not in vv.value:
+            return None
+        return vv.value
+
+    @staticmethod
+    def _live(rec: dict | None, now: float) -> bool:
+        return (
+            rec is not None
+            and rec.get("holder") is not None
+            and now - rec["acquired_at"] <= rec["ttl"]
+        )
+
+    def lease_acquire(
+        self, key: str, holder: str, ttl: float, now: float | None = None
+    ) -> int:
+        """Acquire/refresh ``key``'s lease for ``holder``; returns the
+        fencing token. The token is stable across refreshes by the same
+        live holder and strictly increases on every distinct acquisition.
+        Raises LeaseHeld while another holder's lease is live."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            rec = self._lease_rec(key)
+            if self._live(rec, now):
+                if rec["holder"] != holder:
+                    raise LeaseHeld(
+                        rec["holder"], rec["ttl"] - (now - rec["acquired_at"])
+                    )
+                token = rec["token"]  # refresh, keep fencing token
+            else:
+                token = (rec["token"] if rec else 0) + 1
+            _, vv, watchers = self._set_locked(
+                key, {"holder": holder, "token": token, "ttl": ttl, "acquired_at": now}
+            )
+        for w in watchers:
+            w(vv)
+        return token
+
+    def lease_keepalive(
+        self, key: str, holder: str, token: int, now: float | None = None
+    ) -> bool:
+        """Refresh the lease iff ``holder`` still holds it under ``token``."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            rec = self._lease_rec(key)
+            if not self._live(rec, now) or rec["holder"] != holder or rec["token"] != token:
+                return False
+            _, vv, watchers = self._set_locked(key, {**rec, "acquired_at": now})
+        for w in watchers:
+            w(vv)
+        return True
+
+    def lease_release(self, key: str, holder: str, token: int) -> bool:
+        """Vacate the lease (holder -> None; token survives in the record so
+        the next acquisition still fences out stale writers)."""
+        with self._lock:
+            rec = self._lease_rec(key)
+            if rec is None or rec.get("holder") != holder or rec["token"] != token:
+                return False
+            _, vv, watchers = self._set_locked(key, {**rec, "holder": None})
+        for w in watchers:
+            w(vv)
+        return True
+
+    def lease_get(self, key: str, now: float | None = None) -> tuple[str, int] | None:
+        """(holder, token) if the lease is live on the store's clock."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            rec = self._lease_rec(key)
+            return (rec["holder"], rec["token"]) if self._live(rec, now) else None
+
+    def lease_expire(self, key: str) -> None:
+        """Force-expire (test hook: simulates the holder's death without
+        waiting out the TTL)."""
+        with self._lock:
+            rec = self._lease_rec(key)
+            if rec is None:
+                return
+            _, vv, watchers = self._set_locked(
+                key, {**rec, "acquired_at": -float(rec["ttl"]) - 1e9}
+            )
+        for w in watchers:
+            w(vv)
+
+    def _fence_check(self, fence, now: float) -> None:
+        lease_key, holder, token = fence
+        rec = self._lease_rec(lease_key)
+        if not self._live(rec, now) or rec["holder"] != holder or rec["token"] != token:
+            raise FenceError(
+                f"stale fence for {lease_key}: held={rec.get('holder') if rec else None}"
+                f" token={rec.get('token') if rec else None}, writer={holder}/{token}"
+            )
+
     def _set_locked(self, key: str, value: Any):
         cur = self._data.get(key)
         version = (cur.version if cur else self._tombstones.get(key, 0)) + 1
@@ -79,8 +200,13 @@ class KVStore:
         self._change.notify_all()
         return version, vv, list(self._watchers.get(key, ()))
 
-    def set(self, key: str, value: Any) -> int:
+    def set(self, key: str, value: Any, fence=None, now: float | None = None) -> int:
+        """Plain set; with ``fence=(lease_key, holder, token)`` the write is
+        rejected (FenceError) unless that lease is live for that token —
+        check and write are atomic under the store lock."""
         with self._lock:
+            if fence is not None:
+                self._fence_check(fence, self.clock() if now is None else now)
             version, vv, watchers = self._set_locked(key, value)
         for w in watchers:
             w(vv)
@@ -95,10 +221,16 @@ class KVStore:
             w(vv)
         return version
 
-    def check_and_set(self, key: str, expect_version: int, value: Any) -> int:
+    def check_and_set(
+        self, key: str, expect_version: int, value: Any, fence=None,
+        now: float | None = None,
+    ) -> int:
         """CAS (kv/types.go CheckAndSet): version 0 = must not exist.
-        Check and write are atomic under the store lock."""
+        Check and write are atomic under the store lock. ``fence`` as in
+        :meth:`set`."""
         with self._lock:
+            if fence is not None:
+                self._fence_check(fence, self.clock() if now is None else now)
             cur = self._data.get(key)
             cur_version = cur.version if cur else 0
             if cur_version != expect_version:
@@ -132,6 +264,40 @@ class KVStore:
             return {
                 k: v for k, v in sorted(self._data.items()) if k.startswith(prefix)
             }
+
+    # -- wholesale snapshot (raft install-snapshot / compaction) --
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "data": {
+                    k: {"version": v.version, "value": v.value}
+                    for k, v in self._data.items()
+                },
+                "tombstones": dict(self._tombstones),
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Replace the entire contents (follower installing a snapshot).
+        Long-poll watchers wake and re-read; per-key callbacks fire for
+        keys whose version advanced."""
+        with self._lock:
+            old = self._data
+            self._data = {
+                k: VersionedValue(v["version"], v["value"])
+                for k, v in snap["data"].items()
+            }
+            self._tombstones = {k: int(v) for k, v in snap["tombstones"].items()}
+            self._persist()
+            self._change.notify_all()
+            fired = [
+                (w, vv)
+                for k, vv in self._data.items()
+                if (not (o := old.get(k)) or o.version != vv.version)
+                for w in self._watchers.get(k, ())
+            ]
+        for w, vv in fired:
+            w(vv)
 
     def wait_for_version_gt(
         self, key: str, after_version: int, timeout: float

@@ -48,11 +48,37 @@ class KVService:
         vv = self.store.get(req["key"])
         return None if vv is None else {"version": vv.version, "value": vv.value}
 
+    @staticmethod
+    def _fence(req):
+        f = req.get("fence")
+        return tuple(f) if f else None
+
     def op_kv_set(self, req):
-        return self.store.set(req["key"], req["value"])
+        return self.store.set(req["key"], req["value"], fence=self._fence(req))
 
     def op_kv_cas(self, req):
-        return self.store.check_and_set(req["key"], req["expect"], req["value"])
+        return self.store.check_and_set(
+            req["key"], req["expect"], req["value"], fence=self._fence(req)
+        )
+
+    # -- leases: expiry arbitrated on THIS server's clock (etcd lease role) --
+
+    def op_kv_lease_acquire(self, req):
+        return self.store.lease_acquire(req["key"], req["holder"], req["ttl"])
+
+    def op_kv_lease_keepalive(self, req):
+        return self.store.lease_keepalive(req["key"], req["holder"], req["token"])
+
+    def op_kv_lease_release(self, req):
+        return self.store.lease_release(req["key"], req["holder"], req["token"])
+
+    def op_kv_lease_get(self, req):
+        got = self.store.lease_get(req["key"])
+        return None if got is None else list(got)
+
+    def op_kv_lease_expire(self, req):
+        self.store.lease_expire(req["key"])
+        return True
 
     def op_kv_set_if_not_exists(self, req):
         return self.store.set_if_not_exists(req["key"], req["value"])
@@ -85,14 +111,96 @@ class KVServer(RpcServer):
         super().__init__(KVService(self.store), host=host, port=port)
 
 
-class RemoteKVStore(RpcClient):
+class RemoteKVStore:
     """Client-side kv.Store: same interface as KVStore, state lives in the
-    KV server process. Watches run on a dedicated long-poll thread per key
-    (its own connection, so data-plane calls never queue behind a poll)."""
+    KV server process(es). Watches run on a dedicated long-poll thread per
+    key (its own connection, so data-plane calls never queue behind a poll).
+
+    FAILOVER (etcd-client role): construct with one endpoint or several
+    ("host:port,host:port,..."). Calls rotate to the next endpoint on
+    connection failure, and follow NotLeaderError redirects to the raft
+    leader for writes/leases — so a SIGKILLed KV replica (leader included)
+    is transparent to placement watches, elections, and heartbeats."""
+
+    FAILOVER_WINDOW = 20.0  # give a 3-node quorum time to elect + settle
 
     def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
-        super().__init__(host, port, pool_size=2, timeout=timeout)
-        self._watch_stops: list[threading.Event] = []
+        self.endpoints = [f"{host}:{port}"]
+        self.timeout = timeout
+        self._cur = 0
+        self._lock = threading.Lock()
+        self._clients: dict[str, RpcClient] = {}
+        self._unsubs: list = []
+
+    @classmethod
+    def connect(cls, endpoint: str, **kwargs):
+        """'host:port' or comma-separated 'host:port,host:port,...'."""
+        eps = [e.strip() for e in endpoint.split(",") if e.strip()]
+        host, port = eps[0].rsplit(":", 1)
+        store = cls(host, int(port), **kwargs)
+        store.endpoints = eps
+        return store
+
+    # compat: single-endpoint callers read .host/.port
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._cur].rsplit(":", 1)[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.endpoints[self._cur].rsplit(":", 1)[1])
+
+    def _client_for(self, endpoint: str) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(endpoint)
+            if c is None:
+                host, port = endpoint.rsplit(":", 1)
+                c = RpcClient(host, int(port), pool_size=2, timeout=self.timeout)
+                self._clients[endpoint] = c
+            return c
+
+    def _rotate(self, away_from: str) -> None:
+        with self._lock:
+            if self.endpoints[self._cur] == away_from:
+                self._cur = (self._cur + 1) % len(self.endpoints)
+
+    def _redirect(self, hint: str) -> None:
+        if not hint or ":" not in hint:
+            self._rotate(self.endpoints[self._cur])
+            return
+        with self._lock:
+            if hint not in self.endpoints:
+                self.endpoints.append(hint)
+            self._cur = self.endpoints.index(hint)
+
+    def _call(self, op: str, _timeout: float | None = None, **args):
+        """Failover-aware call: rotates endpoints on connection errors and
+        follows leader redirects until FAILOVER_WINDOW elapses."""
+        import time as _time
+
+        from ..net.client import RemoteError
+
+        deadline = _time.monotonic() + self.FAILOVER_WINDOW
+        last_exc: Exception | None = None
+        while True:
+            ep = self.endpoints[self._cur]
+            try:
+                return self._client_for(ep)._call(op, _timeout=_timeout, **args)
+            except RemoteError as exc:
+                if exc.etype == "NotLeaderError":
+                    last_exc = exc
+                    hint = str(exc).rsplit(" ", 1)[-1]
+                    self._redirect(hint if hint != "NotLeaderError:" else "")
+                elif exc.etype == "RetryableError":
+                    last_exc = exc  # e.g. no leader yet / commit timeout
+                else:
+                    raise
+            except (ConnectionError, OSError, ValueError) as exc:
+                last_exc = exc
+                self._rotate(ep)
+            if _time.monotonic() > deadline:
+                raise last_exc
+            _time.sleep(0.05)
 
     # -- kv.Store surface --
 
@@ -100,13 +208,22 @@ class RemoteKVStore(RpcClient):
         r = self._call("kv_get", key=key)
         return None if r is None else VersionedValue(r["version"], r["value"])
 
-    def set(self, key: str, value) -> int:
-        return self._call("kv_set", key=key, value=value)
+    def set(self, key: str, value, fence=None) -> int:
+        from .kv import FenceError
+        from ..net.client import RemoteError
+
+        try:
+            return self._call(
+                "kv_set", key=key, value=value, fence=list(fence) if fence else None
+            )
+        except RemoteError as exc:
+            if exc.etype == "FenceError":
+                raise FenceError(str(exc)) from exc
+            raise
 
     def set_if_not_exists(self, key: str, value) -> int:
         # remote KeyError arrives as RemoteError(etype="KeyError"); re-raise
         # the local type so callers' except clauses work unchanged
-        from .kv import KVStore as _  # noqa: F401  (doc anchor)
         from ..net.client import RemoteError
 
         try:
@@ -116,14 +233,20 @@ class RemoteKVStore(RpcClient):
                 raise KeyError(str(exc)) from exc
             raise
 
-    def check_and_set(self, key: str, expect_version: int, value) -> int:
+    def check_and_set(self, key: str, expect_version: int, value, fence=None) -> int:
+        from .kv import FenceError
         from ..net.client import RemoteError
 
         try:
-            return self._call("kv_cas", key=key, expect=expect_version, value=value)
+            return self._call(
+                "kv_cas", key=key, expect=expect_version, value=value,
+                fence=list(fence) if fence else None,
+            )
         except RemoteError as exc:
             if exc.etype == "ValueError":
                 raise ValueError(str(exc)) from exc
+            if exc.etype == "FenceError":
+                raise FenceError(str(exc)) from exc
             raise
 
     def delete(self, key: str) -> None:
@@ -138,20 +261,58 @@ class RemoteKVStore(RpcClient):
             for k, ver, val in self._call("kv_get_prefix", prefix=prefix)
         }
 
+    # -- leases (arbitrated on the KV server's clock, never this host's) --
+
+    def lease_acquire(self, key: str, holder: str, ttl: float) -> int:
+        from .kv import LeaseHeld
+        from ..net.client import RemoteError
+
+        try:
+            return self._call("kv_lease_acquire", key=key, holder=holder, ttl=ttl)
+        except RemoteError as exc:
+            if exc.etype == "LeaseHeld":
+                # message: "LeaseHeld: lease held by <holder> for another <s>s"
+                msg = str(exc)
+                cur = msg.split("held by ", 1)[-1].split(" for another", 1)[0]
+                raise LeaseHeld(cur, 0.0) from exc
+            raise
+
+    def lease_keepalive(self, key: str, holder: str, token: int) -> bool:
+        return self._call("kv_lease_keepalive", key=key, holder=holder, token=token)
+
+    def lease_release(self, key: str, holder: str, token: int) -> bool:
+        return self._call("kv_lease_release", key=key, holder=holder, token=token)
+
+    def lease_get(self, key: str) -> tuple[str, int] | None:
+        got = self._call("kv_lease_get", key=key)
+        return None if got is None else (got[0], got[1])
+
+    def lease_expire(self, key: str) -> None:
+        self._call("kv_lease_expire", key=key)
+
     def watch(self, key: str, fn) -> callable:
         """Fire fn(VersionedValue) on every version the poll observes,
         starting with the current value if the key exists. Returns an
-        unsubscribe callable. Poll errors back off and retry — a watch
-        survives a KV server restart (backed stores reload their state)."""
+        unsubscribe callable. Poll errors rotate to the next KV replica and
+        retry — a watch survives both a KV server restart (backed stores
+        reload their state) and a raft leader kill (followers serve watches
+        from their applied state)."""
         stop = threading.Event()
-        self._watch_stops.append(stop)
-        poller = RpcClient(self.host, self.port, pool_size=1, timeout=self.timeout)
+        # unsub/close must be able to interrupt an in-flight long-poll: the
+        # current poller is shared so they can close its socket from outside
+        holder: list = [None]
 
         def loop() -> None:
             last = 0
+            cur = self._cur
             while not stop.is_set():
                 try:
-                    r = poller._call(
+                    if holder[0] is None:
+                        host, port = self.endpoints[cur].rsplit(":", 1)
+                        holder[0] = RpcClient(
+                            host, int(port), pool_size=1, timeout=self.timeout
+                        )
+                    r = holder[0]._call(
                         "kv_watch",
                         key=key,
                         after=last,
@@ -159,6 +320,10 @@ class RemoteKVStore(RpcClient):
                         _timeout=WATCH_POLL_TIMEOUT + 5.0,
                     )
                 except Exception:
+                    if holder[0] is not None:
+                        holder[0].close()
+                    holder[0] = None
+                    cur = (cur + 1) % len(self.endpoints)
                     stop.wait(0.2)
                     continue
                 if stop.is_set():
@@ -170,17 +335,29 @@ class RemoteKVStore(RpcClient):
                     fn(VersionedValue(r["version"], r["value"]))
                 except Exception:
                     pass  # a watcher callback must not kill the poll loop
+            if holder[0] is not None:
+                holder[0].close()
+                holder[0] = None
 
         t = threading.Thread(target=loop, daemon=True, name=f"kv-watch-{key}")
         t.start()
 
         def unsub() -> None:
             stop.set()
-            poller.close()
+            if holder[0] is not None:
+                holder[0].close()  # interrupt the in-flight long-poll
+            with self._lock:
+                if unsub in self._unsubs:
+                    self._unsubs.remove(unsub)
 
+        with self._lock:
+            self._unsubs.append(unsub)
         return unsub
 
     def close(self) -> None:
-        for stop in self._watch_stops:
-            stop.set()
-        super().close()
+        for unsub in list(self._unsubs):
+            unsub()
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()

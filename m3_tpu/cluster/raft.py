@@ -1,0 +1,804 @@
+"""Replicated control plane: raft-lite consensus over the framed RPC wire.
+
+Reference: the reference's control plane is an etcd raft quorum — embedded
+seed nodes inside dbnodes (/root/reference/src/dbnode/server/server.go:266-324)
+with every cluster subsystem reaching it through kv.Store
+(/root/reference/src/cluster/kv/etcd/store.go:54). This module plays raft's
+role for the tpu framework's kvnode: three (or any odd number of) kvnode
+processes form a quorum; placements, namespaces, rules, topics, elections,
+leases and flush times survive the loss of any minority, including the
+leader, with no committed write lost.
+
+Design (raft, simplified where the paper allows):
+- Leader election with randomized timeouts, term monotonicity, and the
+  log-up-to-date voting restriction (§5.2, §5.4.1) — so only a replica
+  holding every committed entry can win.
+- Log replication with consistency check + conflict truncation (§5.3);
+  followers return their last index as a hint for fast next_index backup.
+- Commit rule: an entry is committed once a majority holds it AND it is
+  from the leader's current term (§5.4.2); a no-op entry is appended at
+  election so prior-term entries commit promptly.
+- Snapshot + log compaction (§7): the state machine (a cluster.kv.KVStore)
+  dumps/restores wholesale; laggards receive an install-snapshot RPC.
+- Persistence: term/vote in meta.json, entries appended to log.jsonl
+  (flushed per append), snapshots in snap.json — a restarted node rejoins
+  with its log intact and re-learns commit from the leader.
+
+Determinism: every state-machine command carries the proposing leader's
+wall clock (``now``) IN the log entry, so lease-expiry arbitration and
+fence checks replay identically on every replica — replicas never read
+their own clocks while applying.
+
+Client semantics: writes and lease ops are leader-only (followers raise
+NotLeaderError with the leader's endpoint as a redirect hint); reads and
+long-poll watches are served from any replica's applied state (followers
+lag by at most one replication round; watch correctness only needs version
+monotonicity, which applied order guarantees).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+from ..net.client import RpcClient
+from .kv import KVStore
+
+
+class NotLeaderError(RuntimeError):
+    """Raised by a non-leader replica for a write; message is the leader's
+    endpoint hint (may be empty if unknown)."""
+
+
+class RetryableError(RuntimeError):
+    """Transient condition (no leader yet / commit timed out / leadership
+    lost mid-commit): the client should retry, possibly elsewhere."""
+
+
+MAX_ENTRIES_PER_APPEND = 1024
+
+
+class RaftNode:
+    """One consensus replica wrapping a KVStore state machine."""
+
+    def __init__(
+        self,
+        node_id: str,
+        store: KVStore | None = None,
+        data_dir: str | None = None,
+        heartbeat_interval: float = 0.1,
+        election_timeout: tuple[float, float] = (0.4, 0.8),
+        compact_threshold: int = 20000,
+        clock=time.time,
+    ) -> None:
+        self.node_id = node_id
+        self.store = store or KVStore()
+        self.clock = clock
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.compact_threshold = compact_threshold
+
+        self._mu = threading.RLock()
+        self._commit_cv = threading.Condition(self._mu)
+        self._prop_cv = threading.Condition(self._mu)
+
+        # persistent raft state
+        self.term = 0
+        self.voted_for: str | None = None
+        self.log: list[dict] = []  # {"term": int, "cmd": {...}}
+        self.snap_index = 0  # last index covered by the snapshot
+        self.snap_term = 0
+
+        # volatile
+        self.role = "follower"
+        self.leader_id: str | None = None
+        self.leader_endpoint: str = ""
+        self.commit_index = 0
+        self.last_applied = 0
+        self._last_hb = time.monotonic()
+        self._timeout = random.uniform(*election_timeout)
+
+        # leader volatile
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        # membership: id -> endpoint for ALL members (incl. self)
+        self.members: dict[str, str] = {}
+        self.endpoint = ""
+
+        self._waiters: dict[int, _Waiter] = {}
+        self._peer_clients: dict[str, RpcClient] = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._log_fh = None
+
+        self._dir = os.path.join(data_dir, "raft") if data_dir else None
+        if self._dir:
+            os.makedirs(self._dir, exist_ok=True)
+            self._recover()
+
+    # ---------- persistence ----------
+
+    def _meta_path(self):
+        return os.path.join(self._dir, "meta.json")
+
+    def _log_path(self):
+        return os.path.join(self._dir, "log.jsonl")
+
+    def _snap_path(self):
+        return os.path.join(self._dir, "snap.json")
+
+    def _persist_meta(self) -> None:
+        if not self._dir:
+            return
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"term": self.term, "voted_for": self.voted_for,
+                 "members": self.members, "endpoint": self.endpoint},
+                f,
+            )
+        os.replace(tmp, self._meta_path())
+
+    def _append_log_disk(self, entries: list[dict], first: int) -> None:
+        """``first`` is the raft index of entries[0]; every on-disk record
+        carries its index so recovery can realign after a crash between a
+        snapshot persist and the log rewrite."""
+        if not self._dir:
+            return
+        if self._log_fh is None:
+            self._log_fh = open(self._log_path(), "a")
+        for off, e in enumerate(entries):
+            self._log_fh.write(json.dumps({"i": first + off, **e}) + "\n")
+        self._log_fh.flush()
+
+    def _rewrite_log_disk(self) -> None:
+        """Full rewrite (conflict truncation or compaction)."""
+        if not self._dir:
+            return
+        if self._log_fh is not None:
+            self._log_fh.close()
+            self._log_fh = None
+        tmp = self._log_path() + ".tmp"
+        with open(tmp, "w") as f:
+            for off, e in enumerate(self.log):
+                f.write(json.dumps({"i": self.first_index + off, **e}) + "\n")
+        os.replace(tmp, self._log_path())
+
+    def _persist_snap(self) -> None:
+        if not self._dir:
+            return
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"index": self.snap_index, "term": self.snap_term,
+                 "state": self.store.dump()},
+                f,
+            )
+        os.replace(tmp, self._snap_path())
+
+    def _recover(self) -> None:
+        if os.path.exists(self._snap_path()):
+            with open(self._snap_path()) as f:
+                snap = json.load(f)
+            self.snap_index = snap["index"]
+            self.snap_term = snap["term"]
+            self.store.restore(snap["state"])
+        if os.path.exists(self._meta_path()):
+            with open(self._meta_path()) as f:
+                meta = json.load(f)
+            self.term = meta["term"]
+            self.voted_for = meta["voted_for"]
+            members = meta.get("members") or {}
+            if members:
+                self.members = members
+                self.endpoint = meta.get("endpoint", "")
+        if os.path.exists(self._log_path()):
+            # realign by each record's index: drop entries the snapshot
+            # already covers, stop at any gap (torn write / crash between
+            # snapshot persist and log rewrite)
+            self.log = []
+            expect = self.first_index
+            with open(self._log_path()) as f:
+                for ln in f:
+                    if not ln.strip():
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        break  # torn tail
+                    idx = rec.pop("i", expect)
+                    if idx < expect:
+                        continue  # covered by the snapshot / duplicate
+                    if idx > expect:
+                        break  # gap: discard the rest
+                    self.log.append(rec)
+                    expect += 1
+        self.commit_index = self.last_applied = self.snap_index
+
+    # ---------- log indexing (1-based; snapshot covers <= snap_index) ----------
+
+    @property
+    def first_index(self) -> int:
+        return self.snap_index + 1
+
+    @property
+    def last_log_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        if index == self.snap_index:
+            return self.snap_term
+        return self.log[index - self.first_index]["term"]
+
+    def _entries_from(self, index: int) -> list[dict]:
+        return self.log[index - self.first_index:]
+
+    # ---------- membership / lifecycle ----------
+
+    def configure(self, members: dict[str, str], self_endpoint: str | None = None) -> None:
+        """Set the member map (id -> endpoint, including this node) and
+        start timers/replicators. Idempotent; persisted so a restarted node
+        rejoins on its own."""
+        with self._mu:
+            self.members = dict(members)
+            self.endpoint = self_endpoint or self.members.get(self.node_id, "")
+            self.members[self.node_id] = self.endpoint
+            # peer endpoints may have changed (restart on a fresh port)
+            for pid in list(self._peer_clients):
+                self._peer_clients.pop(pid).close()
+            self._persist_meta()
+            started = bool(self._threads)
+        if not started:
+            for t in (
+                threading.Thread(
+                    target=self._ticker, daemon=True, name=f"raft-tick-{self.node_id}"
+                ),
+                threading.Thread(
+                    target=self._applier, daemon=True, name=f"raft-apply-{self.node_id}"
+                ),
+            ):
+                self._threads.append(t)
+                t.start()
+        with self._mu:
+            # always (re)ensure replicators — reconfiguration may add members
+            self._ensure_replicators()
+            if len(self.members) == 1 and self.role != "leader":
+                self._become_leader()
+
+    def _ensure_replicators(self) -> None:
+        for pid in self.members:
+            if pid == self.node_id:
+                continue
+            name = f"raft-repl-{self.node_id}->{pid}"
+            if any(t.name == name for t in self._threads):
+                continue
+            t = threading.Thread(
+                target=self._replicator, args=(pid,), daemon=True, name=name
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._mu:
+            self.role = "follower"  # a stopped node must not accept proposals
+            self._fail_waiters(RetryableError("node stopping"))
+            self._prop_cv.notify_all()
+            self._commit_cv.notify_all()
+        for c in self._peer_clients.values():
+            c.close()
+        if self._log_fh is not None:
+            self._log_fh.close()
+            self._log_fh = None
+
+    def _client(self, pid: str) -> RpcClient:
+        c = self._peer_clients.get(pid)
+        if c is None:
+            host, port = self.members[pid].rsplit(":", 1)
+            c = RpcClient(host, int(port), pool_size=1, timeout=2.0)
+            self._peer_clients[pid] = c
+        return c
+
+    @property
+    def quorum(self) -> int:
+        return len(self.members) // 2 + 1
+
+    # ---------- roles ----------
+
+    def _step_down(self, term: int) -> None:
+        """Caller holds the lock."""
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_meta()
+        if self.role == "leader":
+            # entries past commit may or may not survive; clients retry
+            self._fail_waiters(RetryableError("leadership lost"))
+        self.role = "follower"
+        self._timeout = random.uniform(*self.election_timeout)
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        for w in self._waiters.values():
+            w.error = exc
+            w.event.set()
+        self._waiters.clear()
+
+    def _become_leader(self) -> None:
+        """Caller holds the lock."""
+        self.role = "leader"
+        self.leader_id = self.node_id
+        self.leader_endpoint = self.endpoint
+        for pid in self.members:
+            if pid != self.node_id:
+                self.next_index[pid] = self.last_log_index + 1
+                self.match_index[pid] = 0
+        # no-op from the new term so earlier entries commit (§5.4.2)
+        self._append_local({"op": "noop"})
+        self._advance_commit()
+        self._prop_cv.notify_all()
+
+    def _ticker(self) -> None:
+        while not self._stop.wait(0.03):
+            with self._mu:
+                if self.role == "leader" or len(self.members) <= 1:
+                    continue
+                if time.monotonic() - self._last_hb < self._timeout:
+                    continue
+                # become candidate
+                self.term += 1
+                self.voted_for = self.node_id
+                self.role = "candidate"
+                self._persist_meta()
+                term = self.term
+                last_i, last_t = self.last_log_index, self._term_at(self.last_log_index)
+                self._last_hb = time.monotonic()
+                self._timeout = random.uniform(*self.election_timeout)
+                peers = [p for p in self.members if p != self.node_id]
+            votes = [1]  # self
+            done = threading.Event()
+            lock = threading.Lock()
+
+            def ask(pid: str) -> None:
+                try:
+                    r = self._client(pid)._call(
+                        "raft_vote", term=term, candidate=self.node_id,
+                        last_log_index=last_i, last_log_term=last_t,
+                        _timeout=0.5,
+                    )
+                except Exception:
+                    return
+                with self._mu:
+                    if r["term"] > self.term:
+                        self._step_down(r["term"])
+                        done.set()
+                        return
+                if r.get("granted"):
+                    with lock:
+                        votes[0] += 1
+                        if votes[0] >= self.quorum:
+                            done.set()
+
+            askers = [threading.Thread(target=ask, args=(p,), daemon=True) for p in peers]
+            for t in askers:
+                t.start()
+            done.wait(self.election_timeout[0])
+            with self._mu:
+                if self.role == "candidate" and self.term == term and votes[0] >= self.quorum:
+                    self._become_leader()
+
+    # ---------- replication (leader side) ----------
+
+    def _replicator(self, pid: str) -> None:
+        backoff = 0.0
+        while not self._stop.is_set():
+            with self._mu:
+                if self.role == "leader" and self.next_index.get(pid, 1) <= self.last_log_index:
+                    pass  # work to do now
+                else:
+                    self._prop_cv.wait(self.heartbeat_interval)
+                if self.role != "leader" or self._stop.is_set():
+                    continue
+                term = self.term
+                ni = self.next_index.get(pid, self.last_log_index + 1)
+                if ni <= self.snap_index:
+                    snap = {
+                        "term": term, "leader": self.node_id,
+                        "leader_endpoint": self.endpoint,
+                        "snap_index": self.snap_index, "snap_term": self.snap_term,
+                        "state": self.store.dump(),
+                    }
+                    req = ("raft_snapshot", snap)
+                else:
+                    prev = ni - 1
+                    entries = self.log[ni - self.first_index:][:MAX_ENTRIES_PER_APPEND]
+                    req = (
+                        "raft_append",
+                        {
+                            "term": term, "leader": self.node_id,
+                            "leader_endpoint": self.endpoint,
+                            "prev_index": prev, "prev_term": self._term_at(prev),
+                            "entries": entries, "leader_commit": self.commit_index,
+                        },
+                    )
+            if backoff:
+                if self._stop.wait(backoff):
+                    return
+            try:
+                r = self._client(pid)._call(req[0], _timeout=2.0, **req[1])
+                backoff = 0.0
+            except Exception:
+                backoff = min((backoff or 0.05) * 2, 1.0)
+                continue
+            with self._mu:
+                if r["term"] > self.term:
+                    self._step_down(r["term"])
+                    continue
+                if self.role != "leader" or self.term != term:
+                    continue
+                if req[0] == "raft_snapshot":
+                    self.next_index[pid] = self.snap_index + 1
+                    self.match_index[pid] = self.snap_index
+                    continue
+                if r.get("ok"):
+                    match = req[1]["prev_index"] + len(req[1]["entries"])
+                    self.match_index[pid] = max(self.match_index.get(pid, 0), match)
+                    self.next_index[pid] = self.match_index[pid] + 1
+                    self._advance_commit()
+                else:
+                    # fast backup using the follower's hint
+                    hint = r.get("hint", req[1]["prev_index"] - 1)
+                    self.next_index[pid] = max(1, min(req[1]["prev_index"], hint + 1))
+
+    def _advance_commit(self) -> None:
+        """Caller holds the lock (leader)."""
+        for n in range(self.last_log_index, self.commit_index, -1):
+            if self._term_at(n) != self.term:
+                break  # only current-term entries commit by counting (§5.4.2)
+            count = 1 + sum(1 for p, m in self.match_index.items() if m >= n)
+            if count >= self.quorum:
+                self.commit_index = n
+                self._commit_cv.notify_all()
+                break
+
+    # ---------- RPC handlers (follower side) ----------
+
+    def handle_vote(self, req: dict) -> dict:
+        with self._mu:
+            if req["term"] < self.term:
+                return {"term": self.term, "granted": False}
+            if req["term"] > self.term:
+                self._step_down(req["term"])
+            mine = (self._term_at(self.last_log_index), self.last_log_index)
+            theirs = (req["last_log_term"], req["last_log_index"])
+            if self.voted_for in (None, req["candidate"]) and theirs >= mine:
+                self.voted_for = req["candidate"]
+                self._persist_meta()
+                self._last_hb = time.monotonic()
+                return {"term": self.term, "granted": True}
+            return {"term": self.term, "granted": False}
+
+    def handle_append(self, req: dict) -> dict:
+        with self._mu:
+            if req["term"] < self.term:
+                return {"term": self.term, "ok": False}
+            if req["term"] > self.term or self.role != "follower":
+                self._step_down(req["term"])
+            self.leader_id = req["leader"]
+            self.leader_endpoint = req.get("leader_endpoint", "")
+            self._last_hb = time.monotonic()
+
+            prev = req["prev_index"]
+            if prev > self.last_log_index:
+                return {"term": self.term, "ok": False, "hint": self.last_log_index}
+            if prev >= self.first_index - 1 and prev > 0:
+                if prev >= self.first_index or prev == self.snap_index:
+                    if self._term_at(prev) != req["prev_term"]:
+                        # conflict: drop the tail from prev on
+                        self.log = self.log[: prev - self.first_index]
+                        self._rewrite_log_disk()
+                        self._fail_waiters(RetryableError("log truncated"))
+                        return {
+                            "term": self.term, "ok": False,
+                            "hint": max(self.snap_index, prev - 1),
+                        }
+            elif prev < self.snap_index:
+                # entries before our snapshot are committed by definition;
+                # skip the overlap
+                skip = self.snap_index - prev
+                req = {**req, "entries": req["entries"][skip:], "prev_index": self.snap_index}
+                prev = self.snap_index
+
+            new = req["entries"]
+            if new:
+                # truncate any conflicting suffix, then append the rest
+                idx = prev + 1
+                keep = []
+                for e in new:
+                    if idx <= self.last_log_index:
+                        if self._term_at(idx) != e["term"]:
+                            self.log = self.log[: idx - self.first_index]
+                            self._rewrite_log_disk()
+                            self._fail_waiters(RetryableError("log truncated"))
+                            keep.append(e)
+                    else:
+                        keep.append(e)
+                    idx += 1
+                if keep:
+                    first = self.last_log_index + 1
+                    self.log.extend(keep)
+                    self._append_log_disk(keep, first)
+            match = prev + len(new)
+            if req["leader_commit"] > self.commit_index:
+                self.commit_index = min(req["leader_commit"], self.last_log_index)
+                self._commit_cv.notify_all()
+            return {"term": self.term, "ok": True, "match": match}
+
+    def handle_snapshot(self, req: dict) -> dict:
+        with self._mu:
+            if req["term"] < self.term:
+                return {"term": self.term, "ok": False}
+            if req["term"] > self.term or self.role != "follower":
+                self._step_down(req["term"])
+            self.leader_id = req["leader"]
+            self.leader_endpoint = req.get("leader_endpoint", "")
+            self._last_hb = time.monotonic()
+            if req["snap_index"] <= self.snap_index:
+                return {"term": self.term, "ok": True}
+            self.store.restore(req["state"])
+            self.snap_index = req["snap_index"]
+            self.snap_term = req["snap_term"]
+            self.log = []
+            self.commit_index = max(self.commit_index, self.snap_index)
+            self.last_applied = self.snap_index
+            self._rewrite_log_disk()
+            self._persist_snap()
+            return {"term": self.term, "ok": True}
+
+    # ---------- propose / apply ----------
+
+    def _append_local(self, cmd: dict) -> int:
+        """Caller holds the lock (leader)."""
+        entry = {"term": self.term, "cmd": cmd}
+        self.log.append(entry)
+        self._append_log_disk([entry], self.last_log_index)
+        return self.last_log_index
+
+    def propose(self, cmd: dict, timeout: float = 10.0):
+        """Replicate one state-machine command; returns its apply result
+        (or raises its apply error). Leader-only."""
+        with self._mu:
+            if self.role != "leader":
+                raise NotLeaderError(self.leader_endpoint or "")
+            cmd = {**cmd, "now": self.clock()}
+            index = self._append_local(cmd)
+            waiter = _Waiter(self.term)
+            self._waiters[index] = waiter
+            if len(self.members) == 1:
+                self.commit_index = index
+                self._commit_cv.notify_all()
+            self._prop_cv.notify_all()
+        if not waiter.event.wait(timeout):
+            with self._mu:
+                self._waiters.pop(index, None)
+            raise RetryableError("commit timeout")
+        if waiter.error is not None:
+            raise waiter.error
+        return waiter.result
+
+    def _applier(self) -> None:
+        # each entry is applied UNDER the raft lock so a concurrent
+        # install-snapshot or conflict truncation can never interleave with
+        # an apply (it would regress last_applied / index into a cleared log)
+        while not self._stop.is_set():
+            with self._mu:
+                while self.last_applied >= self.commit_index and not self._stop.is_set():
+                    self._commit_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                index = self.last_applied + 1
+                if index < self.first_index:
+                    # a snapshot install moved the floor past us
+                    self.last_applied = self.snap_index
+                    continue
+                entry = self.log[index - self.first_index]
+                result, error = self._apply_cmd(entry["cmd"])
+                self.last_applied = index
+                w = self._waiters.pop(index, None)
+                if w is not None:
+                    if entry["term"] == w.term:
+                        w.result, w.error = result, error
+                    else:
+                        w.error = RetryableError("entry superseded")
+                    w.event.set()
+            self._maybe_compact()
+
+    def _apply_cmd(self, cmd: dict):
+        """Apply one command to the KVStore. Deterministic: the only clock
+        is cmd['now'], stamped by the proposing leader."""
+        op = cmd["op"]
+        now = cmd.get("now", 0.0)
+        fence = tuple(cmd["fence"]) if cmd.get("fence") else None
+        s = self.store
+        try:
+            if op == "noop":
+                return None, None
+            if op == "set":
+                return s.set(cmd["key"], cmd["value"], fence=fence, now=now), None
+            if op == "snei":
+                return s.set_if_not_exists(cmd["key"], cmd["value"]), None
+            if op == "cas":
+                return (
+                    s.check_and_set(
+                        cmd["key"], cmd["expect"], cmd["value"], fence=fence, now=now
+                    ),
+                    None,
+                )
+            if op == "delete":
+                s.delete(cmd["key"])
+                return True, None
+            if op == "lease_acquire":
+                return s.lease_acquire(cmd["key"], cmd["holder"], cmd["ttl"], now=now), None
+            if op == "lease_keepalive":
+                return (
+                    s.lease_keepalive(cmd["key"], cmd["holder"], cmd["token"], now=now),
+                    None,
+                )
+            if op == "lease_release":
+                return s.lease_release(cmd["key"], cmd["holder"], cmd["token"]), None
+            if op == "lease_expire":
+                s.lease_expire(cmd["key"])
+                return True, None
+            return None, ValueError(f"unknown raft cmd {op!r}")
+        except Exception as exc:  # deterministic domain errors (CAS, fence, lease)
+            return None, exc
+
+    def _maybe_compact(self) -> None:
+        with self._mu:
+            if len(self.log) < self.compact_threshold:
+                return
+            # keep a tail of applied entries so followers a few heartbeats
+            # behind catch up by append, not by full install-snapshot
+            tail = min(MAX_ENTRIES_PER_APPEND, max(16, self.compact_threshold // 4))
+            keep_from = self.last_applied - tail
+            if keep_from <= self.snap_index:
+                return
+            self.snap_term = self._term_at(keep_from)
+            self.log = self.log[keep_from - self.first_index + 1:]
+            self.snap_index = keep_from
+            self._persist_snap()
+            self._rewrite_log_disk()
+
+    # ---------- introspection ----------
+
+    def status(self) -> dict:
+        with self._mu:
+            return {
+                "id": self.node_id,
+                "role": self.role,
+                "term": self.term,
+                "leader": self.leader_id,
+                "leader_endpoint": self.leader_endpoint,
+                "commit": self.commit_index,
+                "applied": self.last_applied,
+                "last_log_index": self.last_log_index,
+                "members": dict(self.members),
+            }
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == "leader"
+
+
+class _Waiter:
+    __slots__ = ("event", "result", "error", "term")
+
+    def __init__(self, term: int) -> None:
+        self.event = threading.Event()
+        self.result = None
+        self.error: Exception | None = None
+        self.term = term
+
+
+class RaftKVService:
+    """KV service front end over a RaftNode: reads + watches from local
+    applied state (any replica), writes + leases proposed through the log
+    (leader only; followers redirect with NotLeaderError). Peer raft RPCs
+    ride the same dispatch table — one server port per kvnode."""
+
+    def __init__(self, node: RaftNode) -> None:
+        from .kv_service import KVService
+
+        self.node = node
+        self.store = node.store
+        self._reads = KVService(node.store)
+
+    # linearizable-by-default reads (etcd's default): a follower's applied
+    # state may lag the commit point, so plain reads redirect to the leader;
+    # watches are version-gated long-polls and stay on any replica (they
+    # deliver eventually and never regress)
+    LEADER_READS = frozenset({"kv_get", "kv_keys", "kv_get_prefix"})
+
+    def handle(self, req: dict):
+        op = req.get("op")
+        if op in self.LEADER_READS and not self.node.is_leader:
+            raise NotLeaderError(self.node.leader_endpoint or "")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is not None:
+            return fn(req)
+        # watches, health fall through to the plain KV service
+        return self._reads.handle(req)
+
+    # -- raft peer RPCs --
+
+    def op_raft_vote(self, req):
+        return self.node.handle_vote(req)
+
+    def op_raft_append(self, req):
+        return self.node.handle_append(req)
+
+    def op_raft_snapshot(self, req):
+        return self.node.handle_snapshot(req)
+
+    def op_raft_configure(self, req):
+        self.node.configure(req["members"], req.get("self_endpoint"))
+        return True
+
+    def op_raft_status(self, req):
+        return self.node.status()
+
+    # -- writes: replicate through the log --
+
+    def _propose(self, cmd: dict):
+        return self.node.propose(cmd)
+
+    def op_kv_set(self, req):
+        return self._propose(
+            {"op": "set", "key": req["key"], "value": req["value"],
+             "fence": req.get("fence")}
+        )
+
+    def op_kv_set_if_not_exists(self, req):
+        return self._propose({"op": "snei", "key": req["key"], "value": req["value"]})
+
+    def op_kv_cas(self, req):
+        return self._propose(
+            {"op": "cas", "key": req["key"], "expect": req["expect"],
+             "value": req["value"], "fence": req.get("fence")}
+        )
+
+    def op_kv_delete(self, req):
+        return self._propose({"op": "delete", "key": req["key"]})
+
+    # -- leases: leader-only, server-clock arbitration rides the log --
+
+    def op_kv_lease_acquire(self, req):
+        return self._propose(
+            {"op": "lease_acquire", "key": req["key"], "holder": req["holder"],
+             "ttl": req["ttl"]}
+        )
+
+    def op_kv_lease_keepalive(self, req):
+        return self._propose(
+            {"op": "lease_keepalive", "key": req["key"], "holder": req["holder"],
+             "token": req["token"]}
+        )
+
+    def op_kv_lease_release(self, req):
+        return self._propose(
+            {"op": "lease_release", "key": req["key"], "holder": req["holder"],
+             "token": req["token"]}
+        )
+
+    def op_kv_lease_expire(self, req):
+        return self._propose({"op": "lease_expire", "key": req["key"]})
+
+    def op_kv_lease_get(self, req):
+        # expiry is judged on the LEADER's clock against the freshest state
+        if not self.node.is_leader:
+            raise NotLeaderError(self.node.leader_endpoint or "")
+        got = self.store.lease_get(req["key"])
+        return None if got is None else list(got)

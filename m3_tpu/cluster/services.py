@@ -98,71 +98,55 @@ class Services:
 class LeaderElection:
     """Per-electionID campaign/resign/leader (services/leader/election).
 
-    LEASED leadership over a CAS'd KV key (etcd-session semantics without
-    etcd): the leader's record carries a wall-clock lease timestamp it
-    refreshes on every campaign() call; a challenger may CAS-take the key
-    once the lease has aged past ``lease_secs`` — so a SIGKILLed leader
-    expires on its own across real processes. ``expire()`` force-expires
-    for tests (the fake-clusterservices pattern)."""
+    Leadership is a SERVER-ARBITRATED lease (cluster/kv.py lease ops —
+    etcd-session semantics): expiry is judged on the KV server's clock, so
+    cross-process client clock skew (or a suspended leader resuming) can
+    never yield two live leaders; a SIGKILLed leader expires on its own.
+    Every distinct acquisition carries a strictly-increasing FENCING TOKEN
+    (``fence``) that leaders attach to their flush/state writes — the store
+    rejects writes fenced with a superseded token, so a deposed leader's
+    late writes are harmless. ``expire()`` force-expires for tests (the
+    fake-clusterservices pattern)."""
 
-    def __init__(
-        self, kv: KVStore, election_id: str, lease_secs: float = 10.0, clock=time.time
-    ) -> None:
+    def __init__(self, kv: KVStore, election_id: str, lease_secs: float = 10.0) -> None:
         self.kv = kv
         self.key = f"_election/{election_id}"
         self.lease_secs = lease_secs
-        self.clock = clock
-
-    @staticmethod
-    def _id_of(value) -> str | None:
-        if value is None:
-            return None
-        return value["id"] if isinstance(value, dict) else value
+        self._tokens: dict[str, int] = {}
 
     def campaign(self, candidate: str) -> bool:
-        vv = self.kv.get(self.key)
-        now = self.clock()
-        cur = vv.value if vv else None
-        cur_id = self._id_of(cur)
-        if cur_id == candidate:
-            # refresh the lease; a successful CAS proves we still hold it
-            try:
-                self.kv.check_and_set(
-                    self.key, vv.version, {"id": candidate, "t": now}
-                )
-                return True
-            except ValueError:
-                return self.leader() == candidate
-        if cur_id is not None:
-            # a record with no parseable lease (legacy string value, missing
-            # 't') must count as EXPIRED — treating it as fresh would block
-            # takeover from a dead leader forever
-            held_at = cur.get("t", 0) if isinstance(cur, dict) else 0
-            if now - held_at <= self.lease_secs:
-                return False  # live leader
-            # lease expired: fall through to take over
+        from .kv import LeaseHeld
+
         try:
-            self.kv.check_and_set(
-                self.key, vv.version if vv else 0, {"id": candidate, "t": now}
+            self._tokens[candidate] = self.kv.lease_acquire(
+                self.key, candidate, self.lease_secs
             )
             return True
-        except (ValueError, KeyError):
-            return self.leader() == candidate
+        except LeaseHeld:
+            return False
+
+    def fence(self, candidate: str):
+        """(lease_key, holder, token) for fenced writes; None if this
+        candidate never won."""
+        token = self._tokens.get(candidate)
+        return None if token is None else (self.key, candidate, token)
 
     def leader(self) -> str | None:
-        vv = self.kv.get(self.key)
-        return self._id_of(vv.value) if vv else None
+        got = self.kv.lease_get(self.key)
+        return got[0] if got else None
 
     def resign(self, candidate: str) -> None:
-        vv = self.kv.get(self.key)
-        if vv and self._id_of(vv.value) == candidate:
-            self.kv.check_and_set(self.key, vv.version, None)
+        token = self._tokens.pop(candidate, None)
+        if token is not None:
+            self.kv.lease_release(self.key, candidate, token)
 
     def expire(self) -> None:
         """Simulate session expiry (leader process died)."""
-        vv = self.kv.get(self.key)
-        if vv:
-            self.kv.check_and_set(self.key, vv.version, None)
+        self.kv.lease_expire(self.key)
 
     def watch(self, fn) -> callable:
-        return self.kv.watch(self.key, lambda vv: fn(self._id_of(vv.value)))
+        def relay(vv) -> None:
+            v = vv.value
+            fn(v.get("holder") if isinstance(v, dict) else None)
+
+        return self.kv.watch(self.key, relay)

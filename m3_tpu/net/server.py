@@ -135,28 +135,40 @@ class RpcServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
         self.service = service
         svc = service
+        # live connections, force-closed on stop() so blocked long-polls and
+        # pooled client sockets see a reset (SIGKILL semantics) instead of
+        # silently talking to a stopped server
+        conns: set = set()
+        conns_lock = threading.Lock()
+        self._conns, self._conns_lock = conns, conns_lock
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                while True:
-                    try:
-                        req = wire.recv_frame(self.request)
-                    except (ConnectionError, OSError):
-                        return
-                    try:
-                        result = svc.handle(req)
-                        resp = {"ok": True, "result": result}
-                    except Exception as exc:  # per-request isolation
-                        resp = {
-                            "ok": False,
-                            "error": f"{type(exc).__name__}: {exc}",
-                            "etype": type(exc).__name__,
-                        }
-                    try:
-                        wire.send_frame(self.request, resp)
-                    except (ConnectionError, OSError):
-                        return
+                with conns_lock:
+                    conns.add(self.request)
+                try:
+                    while True:
+                        try:
+                            req = wire.recv_frame(self.request)
+                        except (ConnectionError, OSError):
+                            return
+                        try:
+                            result = svc.handle(req)
+                            resp = {"ok": True, "result": result}
+                        except Exception as exc:  # per-request isolation
+                            resp = {
+                                "ok": False,
+                                "error": f"{type(exc).__name__}: {exc}",
+                                "etype": type(exc).__name__,
+                            }
+                        try:
+                            wire.send_frame(self.request, resp)
+                        except (ConnectionError, OSError):
+                            return
+                finally:
+                    with conns_lock:
+                        conns.discard(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -178,6 +190,17 @@ class RpcServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            for sock in list(self._conns):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

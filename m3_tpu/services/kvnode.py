@@ -3,10 +3,18 @@
 Reference: /root/reference/src/cluster/kv/etcd/ + the embedded etcd a
 dbnode seed node runs (src/dbnode/server/server.go:266-324). Run:
 
+standalone (single node, durable via JSON backing):
+
     python -m m3_tpu.services.kvnode --port 2379 [--backing /path/state.json]
 
-Prints ``LISTENING <host> <port>`` once serving. With ``--backing`` the
-store is durable across restarts (etcd persistence role).
+replicated (raft-lite quorum — survives any minority, leader included):
+
+    python -m m3_tpu.services.kvnode --node-id kv0 --raft --data-dir /d0
+    ... (one per replica; then configure each with the full member map via
+    the raft_configure RPC, or pass --members kv0=h:p,kv1=h:p,kv2=h:p)
+
+Prints ``LISTENING <host> <port>`` once serving. A raft node with
+``--data-dir`` persists its log + snapshots and rejoins on restart.
 """
 
 from __future__ import annotations
@@ -17,16 +25,45 @@ import sys
 
 from ..cluster.kv import KVStore
 from ..cluster.kv_service import KVServer
+from ..cluster.raft import RaftKVService, RaftNode
+from ..net.server import RpcServer
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="m3tpu-kvnode", description=__doc__)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
-    p.add_argument("--backing", default=None, help="JSON file for durability")
+    p.add_argument("--backing", default=None, help="JSON file for durability (standalone)")
+    p.add_argument("--raft", action="store_true", help="replicated mode")
+    p.add_argument("--node-id", default="kv0")
+    p.add_argument("--data-dir", default=None, help="raft log/snapshot dir")
+    p.add_argument(
+        "--members", default=None,
+        help="full member map id=host:port,... (else send raft_configure)",
+    )
+    p.add_argument("--heartbeat-interval", type=float, default=0.1)
+    p.add_argument("--election-timeout-lo", type=float, default=0.4)
+    p.add_argument("--election-timeout-hi", type=float, default=0.8)
     args = p.parse_args(argv)
 
-    server = KVServer(KVStore(backing_path=args.backing), host=args.host, port=args.port)
+    if args.raft:
+        node = RaftNode(
+            args.node_id,
+            KVStore(),
+            data_dir=args.data_dir,
+            heartbeat_interval=args.heartbeat_interval,
+            election_timeout=(args.election_timeout_lo, args.election_timeout_hi),
+        )
+        server = RpcServer(RaftKVService(node), host=args.host, port=args.port)
+        self_ep = f"{server.host}:{server.port}"
+        if args.members:
+            members = dict(kv.split("=", 1) for kv in args.members.split(","))
+            # the address we actually bound wins over any configured one
+            node.configure(members, self_endpoint=self_ep)
+        elif node.members:  # recovered membership from a previous run
+            node.configure(node.members, self_endpoint=self_ep)
+    else:
+        server = KVServer(KVStore(backing_path=args.backing), host=args.host, port=args.port)
 
     def shutdown(signum, frame):
         raise SystemExit(0)

@@ -136,6 +136,55 @@ class ProcNode:
         self.client.close()
 
 
+def spawn_kv_quorum(n: int, base_dir: str, what: str = "kvnode"):
+    """Spawn an n-replica raft kvnode quorum (etcd-cluster role). Returns
+    (procs, endpoints): every replica is configured with the full member
+    map over the raft_configure RPC and the call blocks until a leader is
+    elected."""
+    procs, endpoints = [], {}
+    for i in range(n):
+        nid = f"kv{i}"
+        proc, host, port = _spawn_listening(
+            [
+                sys.executable, "-m", "m3_tpu.services.kvnode",
+                "--port", "0", "--raft", "--node-id", nid,
+                "--data-dir", os.path.join(base_dir, nid),
+            ],
+            f"{what}-{nid}",
+        )
+        procs.append(proc)
+        endpoints[nid] = f"{host}:{port}"
+    from ..net.client import RpcClient
+
+    clients = []
+    try:
+        for nid, ep in endpoints.items():
+            c = RpcClient.connect(ep)
+            clients.append(c)
+            c._call("raft_configure", members=endpoints)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            leaders = set()
+            for c in clients:
+                try:
+                    st = c._call("raft_status")
+                except Exception:
+                    continue
+                if st["role"] == "leader":
+                    leaders.add(st["id"])
+            if len(leaders) == 1:
+                return procs, list(endpoints.values())
+            time.sleep(0.05)
+        raise TimeoutError("kv quorum did not elect a leader")
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    finally:
+        for c in clients:
+            c.close()
+
+
 @dataclass
 class ProcCluster:
     num_nodes: int = 3
@@ -146,14 +195,22 @@ class ProcCluster:
     base_dir: str | None = None
     extra_args: list = field(default_factory=list)
     nodes: dict = field(default_factory=dict)
+    kv_replicas: int = 1  # >1: raft quorum (reference: embedded etcd seeds)
 
     def __post_init__(self) -> None:
         self.base_dir = self.base_dir or tempfile.mkdtemp(prefix="m3tpu-proc-")
-        self.kv_proc, kv_host, kv_port = _spawn_listening(
-            [sys.executable, "-m", "m3_tpu.services.kvnode", "--port", "0"],
-            "kvnode",
-        )
-        self.kv_endpoint = f"{kv_host}:{kv_port}"
+        if self.kv_replicas > 1:
+            self.kv_procs, kv_eps = spawn_kv_quorum(
+                self.kv_replicas, os.path.join(self.base_dir, "kv")
+            )
+            self.kv_endpoint = ",".join(kv_eps)
+        else:
+            kv_proc, kv_host, kv_port = _spawn_listening(
+                [sys.executable, "-m", "m3_tpu.services.kvnode", "--port", "0"],
+                "kvnode",
+            )
+            self.kv_procs = [kv_proc]
+            self.kv_endpoint = f"{kv_host}:{kv_port}"
         try:
             self.kv = RemoteKVStore.connect(self.kv_endpoint)
             self.placement_svc = PlacementService(self.kv)
@@ -275,6 +332,25 @@ class ProcCluster:
             read_consistency=read_cl,
         )
 
+    def kill_kv_leader(self) -> int:
+        """SIGKILL the raft leader among the KV replicas (control-plane
+        fault injection); returns the index of the killed process."""
+        from ..net.client import RpcClient
+
+        for i, ep in enumerate(self.kv_endpoint.split(",")):
+            c = RpcClient.connect(ep)
+            try:
+                st = c._call("raft_status")
+            except Exception:
+                continue
+            finally:
+                c.close()
+            if st["role"] == "leader":
+                self.kv_procs[i].kill()
+                self.kv_procs[i].wait(timeout=10)
+                return i
+        raise RuntimeError("no KV leader found")
+
     def close(self) -> None:
         for pn in self.nodes.values():
             pn.kill()
@@ -282,6 +358,7 @@ class ProcCluster:
             if getattr(self, "kv", None) is not None:
                 self.kv.close()
         finally:
-            if self.kv_proc.poll() is None:
-                self.kv_proc.kill()
-                self.kv_proc.wait(timeout=10)
+            for proc in getattr(self, "kv_procs", []):
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)

@@ -1,0 +1,314 @@
+"""Raft-lite replicated control plane: leases, fencing, and the 3-node
+kvnode quorum (in-process servers on localhost sockets).
+
+Reference behavior: /root/reference/src/cluster/kv/etcd/store.go (etcd raft
+quorum) + embedded seed nodes (src/dbnode/server/server.go:266-324) — the
+control plane must survive any single node, including the leader, with no
+committed write lost.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from m3_tpu.cluster.kv import FenceError, KVStore, LeaseHeld
+from m3_tpu.cluster.kv_service import RemoteKVStore
+from m3_tpu.cluster.raft import RaftKVService, RaftNode
+from m3_tpu.cluster.services import LeaderElection
+from m3_tpu.net.server import RpcServer
+
+
+# ---------- server-side leases + fencing (single store) ----------
+
+
+def test_lease_acquire_refresh_and_conflict():
+    clock = [100.0]
+    kv = KVStore(clock=lambda: clock[0])
+    t1 = kv.lease_acquire("L", "a", ttl=10.0)
+    # refresh by the live holder keeps the fencing token stable
+    assert kv.lease_acquire("L", "a", ttl=10.0) == t1
+    with pytest.raises(LeaseHeld):
+        kv.lease_acquire("L", "b", ttl=10.0)
+    assert kv.lease_get("L") == ("a", t1)
+    # expiry is judged on the STORE's clock
+    clock[0] += 11.0
+    assert kv.lease_get("L") is None
+    t2 = kv.lease_acquire("L", "b", ttl=10.0)
+    assert t2 == t1 + 1  # token strictly increases across acquisitions
+
+
+def test_lease_keepalive_and_release():
+    clock = [0.0]
+    kv = KVStore(clock=lambda: clock[0])
+    t = kv.lease_acquire("L", "a", ttl=5.0)
+    clock[0] += 4.0
+    assert kv.lease_keepalive("L", "a", t)
+    clock[0] += 4.0  # 8s after acquire but only 4 after keepalive
+    assert kv.lease_get("L") == ("a", t)
+    assert kv.lease_release("L", "a", t)
+    assert kv.lease_get("L") is None
+    assert not kv.lease_keepalive("L", "a", t)  # released
+    # next acquisition still fences out the old token
+    assert kv.lease_acquire("L", "b", ttl=5.0) == t + 1
+
+
+def test_fenced_writes_reject_stale_tokens():
+    clock = [0.0]
+    kv = KVStore(clock=lambda: clock[0])
+    t_old = kv.lease_acquire("L", "a", ttl=5.0)
+    kv.set("flushed", 1, fence=("L", "a", t_old))
+    clock[0] += 6.0  # a's lease dies; b takes over
+    t_new = kv.lease_acquire("L", "b", ttl=5.0)
+    with pytest.raises(FenceError):
+        kv.set("flushed", 2, fence=("L", "a", t_old))  # deposed leader's write
+    kv.set("flushed", 3, fence=("L", "b", t_new))
+    assert kv.get("flushed").value == 3
+    vv = kv.get("flushed")
+    with pytest.raises(FenceError):
+        kv.check_and_set("flushed", vv.version, 4, fence=("L", "a", t_old))
+
+
+def test_leader_election_rides_server_leases():
+    kv = KVStore()
+    el = LeaderElection(kv, "ss", lease_secs=30.0)
+    assert el.campaign("a")
+    assert not el.campaign("b")
+    fence = el.fence("a")
+    assert fence is not None and fence[1] == "a"
+    kv.set("x", 1, fence=fence)  # leader's fenced write passes
+    el.expire()  # holder process dies
+    assert el.campaign("b")
+    with pytest.raises(FenceError):
+        kv.set("x", 2, fence=fence)  # old leader fenced out
+    seen = []
+    el.watch(seen.append)
+    assert seen[-1] == "b"
+
+
+# ---------- 3-node raft quorum ----------
+
+
+class _Quorum:
+    def __init__(self, n=3, tmp=None, compact_threshold=20000):
+        self.nodes, self.servers = {}, {}
+        for i in range(n):
+            nid = f"kv{i}"
+            node = RaftNode(
+                nid,
+                KVStore(),
+                data_dir=str(tmp / nid) if tmp else None,
+                heartbeat_interval=0.05,
+                election_timeout=(0.15, 0.3),
+                compact_threshold=compact_threshold,
+            )
+            self.nodes[nid] = node
+            self.servers[nid] = RpcServer(RaftKVService(node))
+        self.members = {
+            nid: f"{s.host}:{s.port}" for nid, s in self.servers.items()
+        }
+        for s in self.servers.values():
+            s.start()
+        for nid, node in self.nodes.items():
+            node.configure(self.members)
+
+    def leader_id(self, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leaders = [n.node_id for n in self.nodes.values() if n.is_leader]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        raise TimeoutError("no single leader")
+
+    def kill(self, nid):
+        """SIGKILL equivalent: stop serving + stop raft threads abruptly."""
+        self.servers[nid].stop()
+        self.nodes[nid].stop()
+
+    def client(self) -> RemoteKVStore:
+        return RemoteKVStore.connect(",".join(self.members.values()))
+
+    def close(self):
+        for nid in self.nodes:
+            self.kill(nid)
+
+
+@pytest.fixture
+def quorum(tmp_path):
+    q = _Quorum(3, tmp=tmp_path)
+    yield q
+    q.close()
+
+
+def test_quorum_elects_and_replicates(quorum):
+    leader = quorum.leader_id()
+    kv = quorum.client()
+    v = kv.set("ns/placement", {"gen": 1})
+    assert v == 1
+    assert kv.check_and_set("ns/placement", 1, {"gen": 2}) == 2
+    with pytest.raises(ValueError):
+        kv.check_and_set("ns/placement", 1, {"gen": 99})
+    # committed entries reach every replica's applied state
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        vals = [
+            n.store.get("ns/placement") for n in quorum.nodes.values()
+        ]
+        if all(vv is not None and vv.value == {"gen": 2} for vv in vals):
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError(f"replication lag: {vals}")
+    assert leader in quorum.nodes
+    kv.close()
+
+
+def test_leader_kill_no_committed_write_lost(quorum):
+    kv = quorum.client()
+    for i in range(20):
+        kv.set(f"k{i}", i)
+    leader = quorum.leader_id()
+    quorum.kill(leader)
+    # a new leader emerges from the survivors and has every committed write
+    survivors = {nid: n for nid, n in quorum.nodes.items() if nid != leader}
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if any(n.is_leader for n in survivors.values()):
+            break
+        time.sleep(0.02)
+    else:
+        raise TimeoutError("no failover leader")
+    # client transparently fails over for both reads and writes
+    for i in range(20):
+        assert kv.get(f"k{i}").value == i
+    assert kv.set("after-failover", 1) >= 1
+    assert kv.get("after-failover").value == 1
+    kv.close()
+
+
+def test_watch_survives_leader_kill(quorum):
+    kv = quorum.client()
+    got = []
+    event = threading.Event()
+
+    def on_change(vv):
+        got.append(vv.value)
+        event.set()
+
+    kv.watch("watched", on_change)
+    kv.set("watched", "v1")
+    assert event.wait(5.0)
+    event.clear()
+
+    leader = quorum.leader_id()
+    quorum.kill(leader)
+    # write through the new leader; the long-poll watch must deliver it
+    kv.set("watched", "v2")
+    assert event.wait(10.0)
+    assert got[-1] == "v2"
+    kv.close()
+
+
+def test_lease_election_fails_over_with_kv_leader(quorum):
+    """Aggregator-style leased election keeps working when the KV raft
+    leader is killed: the lease (replicated through the log) survives."""
+    kv = quorum.client()
+    el = LeaderElection(kv, "agg/ss0", lease_secs=1.0)
+    assert el.campaign("aggA")
+    leader = quorum.leader_id()
+    quorum.kill(leader)
+    # holder keeps refreshing through the new KV leader
+    assert el.campaign("aggA")
+    assert el.leader() == "aggA"
+    # holder dies; challenger takes over once the lease ages out, judged on
+    # the new KV leader's clock
+    deadline = time.time() + 10
+    won = False
+    while time.time() < deadline and not won:
+        won = el.campaign("aggB")
+        time.sleep(0.1)
+    assert won
+    assert el.leader() == "aggB"
+    kv.close()
+
+
+def test_follower_restart_rejoins_from_disk(tmp_path):
+    q = _Quorum(3, tmp=tmp_path)
+    try:
+        kv = q.client()
+        for i in range(10):
+            kv.set(f"k{i}", i)
+        leader = q.leader_id()
+        follower = next(nid for nid in q.nodes if nid != leader)
+        q.kill(follower)
+        kv.set("while-down", 42)
+        # restart the follower from its persisted log on the SAME endpoint
+        host, port = q.members[follower].rsplit(":", 1)
+        node = RaftNode(
+            follower, KVStore(), data_dir=str(tmp_path / follower),
+            heartbeat_interval=0.05, election_timeout=(0.15, 0.3),
+        )
+        server = RpcServer(RaftKVService(node), host=host, port=int(port))
+        server.start()
+        q.nodes[follower], q.servers[follower] = node, server
+        node.configure(q.members)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            vv = node.store.get("while-down")
+            if vv is not None and vv.value == 42:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("restarted follower did not catch up")
+        kv.close()
+    finally:
+        q.close()
+
+
+def test_snapshot_catchup_for_lagging_follower(tmp_path):
+    """With an aggressive compaction threshold the leader's log is compacted
+    past a dead follower's position; on rejoin the follower must be caught
+    up via install-snapshot, not append."""
+    q = _Quorum(3, tmp=tmp_path, compact_threshold=50)
+    try:
+        kv = q.client()
+        leader = q.leader_id()
+        follower = next(nid for nid in q.nodes if nid != leader)
+        q.kill(follower)
+        for i in range(300):  # >> compact_threshold: forces compaction
+            kv.set(f"k{i}", i)
+        # wait for the leader to actually compact
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(
+                n.snap_index > 0 for nid, n in q.nodes.items()
+                if nid != follower and n.is_leader
+            ) and any(n.is_leader for n in q.nodes.values()):
+                break
+            time.sleep(0.05)
+        host, port = q.members[follower].rsplit(":", 1)
+        node = RaftNode(
+            follower, KVStore(), data_dir=str(tmp_path / follower),
+            heartbeat_interval=0.05, election_timeout=(0.15, 0.3),
+        )
+        server = RpcServer(RaftKVService(node), host=host, port=int(port))
+        server.start()
+        q.nodes[follower], q.servers[follower] = node, server
+        node.configure(q.members)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            vv = node.store.get("k299")
+            if vv is not None and vv.value == 299:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"snapshot catch-up failed: snap={node.snap_index} "
+                f"applied={node.last_applied}"
+            )
+        kv.close()
+    finally:
+        q.close()
