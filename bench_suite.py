@@ -76,6 +76,50 @@ def _timeit(fn, args, iters=10):
     return dt, out
 
 
+def _timeit_chain(scalar_step, args, k_lo=4, k_hi=16, reps=3):
+    """Per-application time of a kernel via the K-slope method.
+
+    ``scalar_step(carry, *args) -> f32 scalar`` applies the kernel once with
+    a data dependency on ``carry`` (so XLA cannot CSE/DCE the chain). We jit
+    a lax.scan of K applications, synchronously time (result fetch) K_hi and
+    K_lo dispatches, and divide the difference by (K_hi - K_lo): fixed costs
+    — the tunnel's ~10ms dispatch RTT, result transfer — cancel exactly.
+    This measures sustained throughput, which is what a streaming flush/query
+    pipeline sees; sub-ms kernels are otherwise swamped by dispatch latency
+    (the r04 config3/config4 numbers were RTT-bound, not compute-bound).
+    Falls back to plain sync timing if the slope is non-positive (noise)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def chained(k):
+        @jax.jit
+        def f(*a):
+            def body(c, _):
+                return scalar_step(c, *a) * 1e-30, None
+
+            c, _ = lax.scan(body, jnp.float32(0), None, length=k)
+            return c
+
+        return f
+
+    f_lo, f_hi = chained(k_lo), chained(k_hi)
+    _fetch(f_lo(*args))
+    _fetch(f_hi(*args))  # compile + residency settle
+    lo_ts, hi_ts = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _fetch(f_lo(*args))
+        lo_ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _fetch(f_hi(*args))
+        hi_ts.append(time.perf_counter() - t0)
+    slope = (np.median(hi_ts) - np.median(lo_ts)) / (k_hi - k_lo)
+    if slope <= 0:  # noise floor: report the conservative sync latency
+        return np.median(hi_ts) / k_hi
+    return slope
+
+
 def _latencies(fn, args, iters=20):
     for _ in range(4):  # compile + argument residency settle
         _fetch(fn(args))
@@ -246,13 +290,15 @@ def bench_config3(n_series):
     x = jax.device_put(jnp.asarray(vals))
     window = 7  # 1m range at 10s step
 
-    @jax.jit
-    def fn(v):
-        r = temporal.rate(v, window, step_seconds=10.0)
-        a = temporal.avg_over_time(v, window)
-        return r.sum() + a.sum()
+    from m3_tpu.query.functions.temporal_fused import fused_temporal
 
-    dt, _ = _timeit(fn, x)
+    def step(carry, v):
+        r, a = fused_temporal(
+            v + carry, window, 10.0, ("rate", "avg_over_time")
+        )
+        return jnp.nansum(r) + jnp.nansum(a)
+
+    dt = _timeit_chain(step, (x,))
     # two functions over S*T points each
     return _rec(
         "config3_temporal_functions",
@@ -268,13 +314,10 @@ def bench_config3(n_series):
 
 def bench_config4(n_series):
     import jax
+    import jax.numpy as jnp
 
-    from m3_tpu.aggregator.kernels import (
-        aggregate_dense,
-        dense_quantiles,
-        pack_dense_groups,
-        window_keys,
-    )
+    from m3_tpu import native
+    from m3_tpu.aggregator.kernels import aggregate_dense, dense_quantiles
 
     per = 6  # datapoints per series in the 1m window (10s resolution)
     n = n_series * per
@@ -284,22 +327,34 @@ def bench_config4(n_series):
         0, 10 * NANOS, n
     )
     values = rng.lognormal(0, 1, n).astype(np.float32)
-    keys, _, order = window_keys(ids, times, T0, 60 * NANOS, 1)
+    # fused native densify (m3agg_* in native/m3tsz.cc): window bucketing +
+    # counts + arrival-order-exact dense scatter, memory-bound C++ passes
     t0 = time.perf_counter()
-    dv, dt_, dvalid = pack_dense_groups(keys, values, order, n_series)
+    dv, dt_, dvalid = native.pack_windowed_dense(
+        ids, times, values, T0, 60 * NANOS, 1, n_series
+    )
     pack_s = time.perf_counter() - t0
     dvd = jax.device_put(dv)
     dtd = jax.device_put(dt_)
     dvld = jax.device_put(dvalid)
 
-    dt_agg, _ = _timeit(lambda _: aggregate_dense(dvd, dtd, dvld), None)
+    def agg_step(carry, vals, torder, valid):
+        out = aggregate_dense(vals + carry, torder, valid)
+        return out.sum.sum() + out.last.sum() + out.min.sum() + out.max.sum()
+
+    dt_agg = _timeit_chain(agg_step, (dvd, dtd, dvld))
 
     # timer quantiles on a 10% timer population (p50/p95/p99)
     n_t = max(n_series // 10, 1)
-    qfn = functools.partial(dense_quantiles, qs=(0.5, 0.95, 0.99))
     vq = jax.device_put(dv[:n_t])
     vlq = jax.device_put(dvalid[:n_t])
-    dt_q, _ = _timeit(lambda _: qfn(vq, vlq), None)
+
+    def q_step(carry, vals, valid):
+        return jnp.nansum(dense_quantiles(vals + carry, valid, qs=(0.5, 0.95, 0.99)))
+
+    # the timer slice is 10x smaller: longer chains keep the slope above the
+    # dispatch-jitter noise floor
+    dt_q = _timeit_chain(q_step, (vq, vlq), k_lo=32, k_hi=256)
 
     tmask = n_t * per
     total_dps = n + tmask

@@ -89,8 +89,17 @@ class RaftNode:
         self.term = 0
         self.voted_for: str | None = None
         self.log: list[dict] = []  # {"term": int, "cmd": {...}}
-        self.snap_index = 0  # last index covered by the snapshot
+        # snapshot point: the persisted/installed snapshot is the state
+        # machine EXACTLY at snap_index (dumped at last_applied when taken)
+        self.snap_index = 0
         self.snap_term = 0
+        # log floor: index of the entry just below log[0]. Kept <= snap_index
+        # so a tail of already-applied entries can be retained for follower
+        # catch-up by append — WITHOUT mislabelling the snapshot (state@X
+        # must never be paired with index<X, or re-applied tail entries
+        # double-apply and replicas diverge).
+        self.log_floor = 0
+        self.floor_term = 0
 
         # volatile
         self.role = "follower"
@@ -111,6 +120,8 @@ class RaftNode:
 
         self._waiters: dict[int, _Waiter] = {}
         self._peer_clients: dict[str, RpcClient] = {}
+        self._clients_lock = threading.Lock()  # _peer_clients is touched
+        # by replicators/vote askers OUTSIDE _mu
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._log_fh = None
@@ -138,7 +149,8 @@ class RaftNode:
         with open(tmp, "w") as f:
             json.dump(
                 {"term": self.term, "voted_for": self.voted_for,
-                 "members": self.members, "endpoint": self.endpoint},
+                 "members": self.members, "endpoint": self.endpoint,
+                 "log_floor": self.log_floor, "floor_term": self.floor_term},
                 f,
             )
         os.replace(tmp, self._meta_path())
@@ -187,6 +199,7 @@ class RaftNode:
             self.snap_index = snap["index"]
             self.snap_term = snap["term"]
             self.store.restore(snap["state"])
+        self.log_floor, self.floor_term = self.snap_index, self.snap_term
         if os.path.exists(self._meta_path()):
             with open(self._meta_path()) as f:
                 meta = json.load(f)
@@ -196,6 +209,10 @@ class RaftNode:
             if members:
                 self.members = members
                 self.endpoint = meta.get("endpoint", "")
+            floor = meta.get("log_floor")
+            if floor is not None and floor <= self.snap_index:
+                self.log_floor = floor
+                self.floor_term = meta.get("floor_term", 0)
         if os.path.exists(self._log_path()):
             # realign by each record's index: drop entries the snapshot
             # already covers, stop at any gap (torn write / crash between
@@ -219,19 +236,19 @@ class RaftNode:
                     expect += 1
         self.commit_index = self.last_applied = self.snap_index
 
-    # ---------- log indexing (1-based; snapshot covers <= snap_index) ----------
+    # ---------- log indexing (1-based; log starts above log_floor) ----------
 
     @property
     def first_index(self) -> int:
-        return self.snap_index + 1
+        return self.log_floor + 1
 
     @property
     def last_log_index(self) -> int:
-        return self.snap_index + len(self.log)
+        return self.log_floor + len(self.log)
 
     def _term_at(self, index: int) -> int:
-        if index == self.snap_index:
-            return self.snap_term
+        if index == self.log_floor:
+            return self.floor_term
         return self.log[index - self.first_index]["term"]
 
     def _entries_from(self, index: int) -> list[dict]:
@@ -248,8 +265,10 @@ class RaftNode:
             self.endpoint = self_endpoint or self.members.get(self.node_id, "")
             self.members[self.node_id] = self.endpoint
             # peer endpoints may have changed (restart on a fresh port)
-            for pid in list(self._peer_clients):
-                self._peer_clients.pop(pid).close()
+            with self._clients_lock:
+                stale, self._peer_clients = list(self._peer_clients.values()), {}
+            for c in stale:
+                c.close()
             self._persist_meta()
             started = bool(self._threads)
         if not started:
@@ -289,19 +308,22 @@ class RaftNode:
             self._fail_waiters(RetryableError("node stopping"))
             self._prop_cv.notify_all()
             self._commit_cv.notify_all()
-        for c in self._peer_clients.values():
+        with self._clients_lock:
+            clients, self._peer_clients = list(self._peer_clients.values()), {}
+        for c in clients:
             c.close()
         if self._log_fh is not None:
             self._log_fh.close()
             self._log_fh = None
 
     def _client(self, pid: str) -> RpcClient:
-        c = self._peer_clients.get(pid)
-        if c is None:
-            host, port = self.members[pid].rsplit(":", 1)
-            c = RpcClient(host, int(port), pool_size=1, timeout=2.0)
-            self._peer_clients[pid] = c
-        return c
+        with self._clients_lock:
+            c = self._peer_clients.get(pid)
+            if c is None:
+                host, port = self.members[pid].rsplit(":", 1)
+                c = RpcClient(host, int(port), pool_size=1, timeout=2.0)
+                self._peer_clients[pid] = c
+            return c
 
     @property
     def quorum(self) -> int:
@@ -404,11 +426,16 @@ class RaftNode:
                     continue
                 term = self.term
                 ni = self.next_index.get(pid, self.last_log_index + 1)
-                if ni <= self.snap_index:
+                if ni <= self.log_floor:
+                    # install-snapshot: the dump reflects the state machine
+                    # at last_applied, so it MUST be labelled last_applied —
+                    # labelling it lower would re-apply retained tail
+                    # entries on the follower and diverge replicas
                     snap = {
                         "term": term, "leader": self.node_id,
                         "leader_endpoint": self.endpoint,
-                        "snap_index": self.snap_index, "snap_term": self.snap_term,
+                        "snap_index": self.last_applied,
+                        "snap_term": self._term_at(self.last_applied),
                         "state": self.store.dump(),
                     }
                     req = ("raft_snapshot", snap)
@@ -440,8 +467,10 @@ class RaftNode:
                 if self.role != "leader" or self.term != term:
                     continue
                 if req[0] == "raft_snapshot":
-                    self.next_index[pid] = self.snap_index + 1
-                    self.match_index[pid] = self.snap_index
+                    sent = req[1]["snap_index"]
+                    self.next_index[pid] = sent + 1
+                    self.match_index[pid] = max(self.match_index.get(pid, 0), sent)
+                    self._advance_commit()
                     continue
                 if r.get("ok"):
                     match = req[1]["prev_index"] + len(req[1]["entries"])
@@ -494,23 +523,22 @@ class RaftNode:
             prev = req["prev_index"]
             if prev > self.last_log_index:
                 return {"term": self.term, "ok": False, "hint": self.last_log_index}
-            if prev >= self.first_index - 1 and prev > 0:
-                if prev >= self.first_index or prev == self.snap_index:
-                    if self._term_at(prev) != req["prev_term"]:
-                        # conflict: drop the tail from prev on
-                        self.log = self.log[: prev - self.first_index]
-                        self._rewrite_log_disk()
-                        self._fail_waiters(RetryableError("log truncated"))
-                        return {
-                            "term": self.term, "ok": False,
-                            "hint": max(self.snap_index, prev - 1),
-                        }
-            elif prev < self.snap_index:
-                # entries before our snapshot are committed by definition;
-                # skip the overlap
-                skip = self.snap_index - prev
-                req = {**req, "entries": req["entries"][skip:], "prev_index": self.snap_index}
-                prev = self.snap_index
+            if prev >= self.log_floor and prev > 0:
+                if self._term_at(prev) != req["prev_term"]:
+                    # conflict: drop the tail from prev on
+                    self.log = self.log[: prev - self.first_index]
+                    self._rewrite_log_disk()
+                    self._fail_waiters(RetryableError("log truncated"))
+                    return {
+                        "term": self.term, "ok": False,
+                        "hint": max(self.log_floor, prev - 1),
+                    }
+            elif prev < self.log_floor:
+                # entries at/below our floor are committed by definition
+                # (floor <= snap_index <= last_applied); skip the overlap
+                skip = self.log_floor - prev
+                req = {**req, "entries": req["entries"][skip:], "prev_index": self.log_floor}
+                prev = self.log_floor
 
             new = req["entries"]
             if new:
@@ -552,10 +580,12 @@ class RaftNode:
             self.snap_index = req["snap_index"]
             self.snap_term = req["snap_term"]
             self.log = []
+            self.log_floor, self.floor_term = self.snap_index, self.snap_term
             self.commit_index = max(self.commit_index, self.snap_index)
             self.last_applied = self.snap_index
-            self._rewrite_log_disk()
             self._persist_snap()
+            self._persist_meta()
+            self._rewrite_log_disk()
             return {"term": self.term, "ok": True}
 
     # ---------- propose / apply ----------
@@ -601,8 +631,9 @@ class RaftNode:
                     return
                 index = self.last_applied + 1
                 if index < self.first_index:
-                    # a snapshot install moved the floor past us
-                    self.last_applied = self.snap_index
+                    # a snapshot install moved the floor past us; the
+                    # snapshot state already covers through snap_index
+                    self.last_applied = max(self.last_applied, self.snap_index)
                     continue
                 entry = self.log[index - self.first_index]
                 result, error = self._apply_cmd(entry["cmd"])
@@ -664,12 +695,18 @@ class RaftNode:
             # behind catch up by append, not by full install-snapshot
             tail = min(MAX_ENTRIES_PER_APPEND, max(16, self.compact_threshold // 4))
             keep_from = self.last_applied - tail
-            if keep_from <= self.snap_index:
+            if keep_from <= self.log_floor:
                 return
-            self.snap_term = self._term_at(keep_from)
+            # the snapshot is the state machine AT last_applied (dump below);
+            # the log floor moves only to keep_from, retaining the tail —
+            # the two indices are distinct on purpose (see __init__ notes)
+            self.snap_index = self.last_applied
+            self.snap_term = self._term_at(self.last_applied)
+            self.floor_term = self._term_at(keep_from)
             self.log = self.log[keep_from - self.first_index + 1:]
-            self.snap_index = keep_from
+            self.log_floor = keep_from
             self._persist_snap()
+            self._persist_meta()
             self._rewrite_log_disk()
 
     # ---------- introspection ----------

@@ -81,6 +81,9 @@ def load():
     lib.m3tsz_encode_series.restype = ctypes.c_int64
     lib.m3tsz_prescan.restype = ctypes.c_int32
     lib.m3tsz_prescan_batch.restype = ctypes.c_int32
+    lib.m3agg_window_keys.restype = None
+    lib.m3agg_count.restype = ctypes.c_int32
+    lib.m3agg_pack.restype = None
     _lib = lib
     return lib
 
@@ -228,3 +231,84 @@ def prescan_batch(
             p["span"] = offs[j + 1] - p["off"]
         out.append(per)
     return out
+
+
+def pack_windowed_dense(
+    ids: np.ndarray,
+    times_nanos: np.ndarray,
+    values: np.ndarray,
+    window0_nanos: int,
+    resolution_nanos: int,
+    n_windows: int,
+    n_series: int,
+    n_threads: int = 0,
+):
+    """Fused window bucketing + dense [G, P] pack for the device rollup
+    kernels (aggregator/kernels.py aggregate_dense): keys/torder, counts and
+    the arrival-order-exact dense scatter in three memory-bound C++ passes.
+    Returns (vals[G, P] f32, torder[G, P] i32, valid[G, P] bool).
+
+    Falls back to the numpy path (kernels.window_keys + pack_dense_groups)
+    when the native lib is unavailable. Reference hot loop:
+    /root/reference/src/aggregator/aggregation/{counter,timer,gauge}.go."""
+    lib = load()
+    n = len(ids)
+    n_groups = n_series * n_windows
+    if lib is None:
+        from ..aggregator.kernels import pack_dense_groups, window_keys
+
+        keys, _, order = window_keys(
+            np.asarray(ids), np.asarray(times_nanos), window0_nanos,
+            resolution_nanos, n_windows,
+        )
+        return pack_dense_groups(keys, values, order, n_groups)
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 1, 16)
+    ids = np.ascontiguousarray(ids, np.int64)
+    times_nanos = np.ascontiguousarray(times_nanos, np.int64)
+    values = np.ascontiguousarray(values, np.float32)
+    keys = np.empty(n, np.int32)
+    torder = np.empty(n, np.int32)
+    lib.m3agg_window_keys(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        times_nanos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n),
+        ctypes.c_int64(window0_nanos),
+        ctypes.c_int64(resolution_nanos),
+        ctypes.c_int32(n_windows),
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        torder.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n_threads),
+    )
+    counts = np.zeros(n_groups, np.int32)
+    p = int(
+        lib.m3agg_count(
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(n),
+            ctypes.c_int64(n_groups),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(n_threads),
+        )
+    )
+    p = max(p, 1)
+    vals = np.empty((n_groups, p), np.float32)
+    tor = np.empty((n_groups, p), np.int32)
+    lib.m3agg_pack(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        torder.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n),
+        ctypes.c_int64(n_groups),
+        ctypes.c_int32(p),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        tor.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n_threads),
+    )
+    # match the numpy fallback exactly: a NaN input value occupies a slot
+    # but must be INVALID (stale markers etc. are dropped, not folded into
+    # sum/min/max as NaN)
+    valid = (np.arange(p, dtype=np.int32)[None, :] < counts[:, None]) & ~np.isnan(
+        vals
+    )
+    return vals, tor, valid

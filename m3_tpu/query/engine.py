@@ -22,6 +22,7 @@ from .functions import aggregation as A
 from .functions import binary as B
 from .functions import linear as L
 from .functions import temporal as T
+from .functions import temporal_fused as TF
 from .promql import (
     Aggregation,
     BinaryOp,
@@ -268,7 +269,11 @@ class Engine:
         name = e.func
         if name in self._TEMPORAL:
             vals, metas, w, step_s, post = self._range_arg(e.args[0], bounds)
-            out = np.asarray(self._TEMPORAL[name](vals, w, step_s))
+            if name in TF.FUSABLE:
+                # one VMEM-resident pallas pass on TPU (temporal_fused.py)
+                out = np.asarray(TF.temporal_apply(name, vals, w, step_s))
+            else:
+                out = np.asarray(self._TEMPORAL[name](vals, w, step_s))
             return Result(post(out[:, w - 1 :]), metas)
         if name == "quantile_over_time":
             q = _number(e.args[0])

@@ -254,15 +254,48 @@ def _window_valid_indices(values, window):
     return last_idx, first_idx, count
 
 
-def _prev_valid(values):
-    """Per index t: (prev_idx, prev_val) of the last valid sample at index < t."""
+def _ffill(values):
+    """Forward fill along time: out[t] = last valid value at index <= t
+    (NaN before any valid sample). Log-depth doubling on the ONE f32 array —
+    measured ~4x cheaper than a tuple associative_scan, which XLA lowers to
+    a generic combinator over every component at every level."""
+    x = values
+    t = x.shape[1]
+    j = 1
+    while j < t:
+        shifted = jnp.pad(x, ((0, 0), (j, 0)), constant_values=jnp.nan)[:, :t]
+        x = jnp.where(jnp.isnan(x), shifted, x)
+        j *= 2
+    return x
+
+
+def _prev_valid_val(values):
+    """Per index t: value of the last valid sample at index < t (NaN none).
+    The cheap path for rate/increase/delta — no index array needed."""
     s, t = values.shape
-    ffi, ffv = lax.associative_scan(
-        _comb_later, (_iota_valid(values), _masked(values)), axis=1
+    ff = _ffill(values)
+    return jnp.concatenate(
+        [jnp.full((s, 1), jnp.nan, values.dtype), ff[:, :-1]], axis=1
     )
-    prev_idx = jnp.concatenate([jnp.full((s, 1), -1, jnp.int32), ffi[:, :-1]], axis=1)
+
+
+def _prev_valid(values):
+    """Per index t: (prev_idx, prev_val) of the last valid sample at index < t.
+    Pair doubling driven by idx validity (same recurrence as _ffill)."""
+    s, t = values.shape
+    iv = _iota_valid(values)
+    vv = _masked(values)
+    j = 1
+    while j < t:
+        iv_s = jnp.pad(iv, ((0, 0), (j, 0)), constant_values=-1)[:, :t]
+        vv_s = jnp.pad(vv, ((0, 0), (j, 0)), constant_values=0.0)[:, :t]
+        hole = iv < 0
+        iv = jnp.where(hole, iv_s, iv)
+        vv = jnp.where(hole, vv_s, vv)
+        j *= 2
+    prev_idx = jnp.concatenate([jnp.full((s, 1), -1, jnp.int32), iv[:, :-1]], axis=1)
     prev_val = jnp.concatenate(
-        [jnp.zeros((s, 1), values.dtype), ffv[:, :-1]], axis=1
+        [jnp.zeros((s, 1), values.dtype), vv[:, :-1]], axis=1
     )
     prev_val = jnp.where(prev_idx >= 0, prev_val, jnp.nan)
     return prev_idx, prev_val
@@ -294,7 +327,7 @@ def _rate_impl(values, window, step_seconds, is_rate, is_counter):
     s, t = values.shape
     duration = (window - 1) * step_seconds
 
-    _, prev_val = _prev_valid(values)
+    prev_val = _prev_valid_val(values)
     valid = _valid(values)
     reset = valid & ~jnp.isnan(prev_val) & (values < prev_val)
     corr_amount = jnp.where(reset & is_counter, _masked(prev_val), 0.0).astype(dt)
@@ -307,7 +340,9 @@ def _rate_impl(values, window, step_seconds, is_rate, is_counter):
     fi = jnp.maximum(first_idx, 0)
 
     # grid timestamps relative to each output step's rangeEnd, in seconds
-    out_idx = jnp.arange(t, dtype=jnp.float32)[None, :]
+    # (int iota + cast: Mosaic/pallas has no float iota, and this code also
+    # runs inside the fused temporal kernel)
+    out_idx = jnp.arange(t, dtype=jnp.int32).astype(jnp.float32)[None, :]
     t_last = (li.astype(jnp.float32) - out_idx) * step_seconds  # <= 0
     t_first = (fi.astype(jnp.float32) - out_idx) * step_seconds
     range_start = -duration
@@ -432,7 +467,7 @@ def predict_linear(values, window, step_seconds, predict_seconds):
 
 
 def _count_pairs(values, window, cmp):
-    _, prev_val = _prev_valid(values)
+    prev_val = _prev_valid_val(values)
     valid = _valid(values)
     event = valid & ~jnp.isnan(prev_val) & cmp(values, prev_val)
     count, last_idx, first_idx = _pair_event_window_sum(

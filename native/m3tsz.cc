@@ -11,9 +11,12 @@
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 -o libm3tsz.so m3tsz.cc -lpthread
 
+#include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <thread>
 #include <vector>
 
@@ -921,6 +924,143 @@ int32_t m3tsz_prescan_batch(const uint8_t* data, const int64_t* offsets,
     for (auto& th : ts) th.join();
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Aggregator host densify (aggregation/{counter,timer,gauge}.go hot loop):
+// fused window bucketing + dense [G, P] pack feeding the device reduction
+// kernels (m3_tpu/aggregator/kernels.py aggregate_dense). The numpy path
+// pays ~3.5s at 60M samples in gather/scatter chains; these single-purpose
+// passes are memory-bound.
+
+// Fused window keys: key = id * n_windows + clamp(w), torder = in-window
+// nanos offset downshifted so it always fits i32. The shift is derived from
+// the DATA's max offset (two passes), exactly like the numpy fallback
+// (kernels.py window_keys): clamped out-of-range samples carry offsets far
+// beyond the resolution, so a resolution-derived shift would overflow i32
+// and invert their `last` ordering.
+void m3agg_window_keys(const int64_t* ids, const int64_t* times, int64_t n,
+                       int64_t window0, int64_t resolution, int32_t n_windows,
+                       int32_t* out_keys, int32_t* out_torder,
+                       int32_t n_threads) {
+  auto run = [&](auto body) {
+    if (n_threads <= 1 || n < (1 << 16)) {
+      body(0, 0, n);
+      return 1;
+    }
+    std::vector<std::thread> ts;
+    int64_t per = (n + n_threads - 1) / n_threads;
+    int32_t used = 0;
+    for (int32_t t = 0; t < n_threads; t++) {
+      int64_t lo = t * per, hi = std::min(n, lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back(body, t, lo, hi);
+      used++;
+    }
+    for (auto& th : ts) th.join();
+    return (int)used;
+  };
+
+  auto window_of = [&](int64_t t) {
+    int64_t w = (t - window0) / resolution;
+    // C++ division truncates toward zero; match python floor division for
+    // pre-window0 samples before clamping
+    if (w * resolution > t - window0) w--;
+    if (w < 0) w = 0;
+    if (w >= n_windows) w = n_windows - 1;
+    return w;
+  };
+
+  std::vector<int64_t> tmax(std::max(n_threads, 1), 0);
+  run([&](int32_t tid, int64_t lo, int64_t hi) {
+    int64_t mx = 0;
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t w = window_of(times[i]);
+      out_keys[i] = (int32_t)(ids[i] * n_windows + w);
+      int64_t off = times[i] - (window0 + w * resolution);
+      if (off > mx) mx = off;
+    }
+    tmax[tid] = mx;
+  });
+  int64_t maxoff = 0;
+  for (int64_t m : tmax) maxoff = std::max(maxoff, m);
+  int shift = 0;
+  while ((maxoff >> shift) > 0x3FFFFFFF) shift++;
+
+  run([&](int32_t, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t w = window_of(times[i]);
+      out_torder[i] =
+          (int32_t)((times[i] - (window0 + w * resolution)) >> shift);
+    }
+  });
+}
+
+// Histogram per group (atomic adds; low contention — P entries per group).
+// Returns the max group count (the dense P dimension).
+int32_t m3agg_count(const int32_t* keys, int64_t n, int64_t n_groups,
+                    int32_t* counts, int32_t n_threads) {
+  auto* acounts = reinterpret_cast<std::atomic<int32_t>*>(counts);
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++)
+      acounts[keys[i]].fetch_add(1, std::memory_order_relaxed);
+  };
+  if (n_threads <= 1 || n < (1 << 16)) {
+    work(0, n);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t per = (n + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; t++) {
+      int64_t lo = t * per, hi = std::min(n, lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back(work, lo, hi);
+    }
+    for (auto& th : ts) th.join();
+  }
+  int32_t mx = 0;
+  for (int64_t g = 0; g < n_groups; g++) mx = std::max(mx, counts[g]);
+  return mx;
+}
+
+// Dense pack: out_vals[g*P + c] = values[i] in ARRIVAL ORDER within each
+// group (first-arrival tie semantics for `last`, gauge.go:57-66). Threads
+// shard the GROUP range and each scans all keys, so writes are disjoint and
+// order is exact — no atomics, no cross-thread interleaving. Slots past a
+// group's count are NaN / 0.
+void m3agg_pack(const int32_t* keys, const float* values,
+                const int32_t* torder, int64_t n, int64_t n_groups, int32_t P,
+                const int32_t* counts, float* out_vals, int32_t* out_tor,
+                int32_t n_threads) {
+  float nanf = std::numeric_limits<float>::quiet_NaN();
+  auto work = [&](int64_t glo, int64_t ghi) {
+    std::vector<int32_t> cursor(ghi - glo, 0);
+    for (int64_t g = glo; g < ghi; g++) {
+      int64_t base = g * P;
+      for (int32_t c = counts[g]; c < P; c++) {
+        out_vals[base + c] = nanf;
+        out_tor[base + c] = 0;
+      }
+    }
+    for (int64_t i = 0; i < n; i++) {
+      int64_t g = keys[i];
+      if (g < glo || g >= ghi) continue;
+      int32_t c = cursor[g - glo]++;
+      out_vals[g * P + c] = values[i];
+      out_tor[g * P + c] = torder[i];
+    }
+  };
+  if (n_threads <= 1 || n < (1 << 16)) {
+    work(0, n_groups);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t per = (n_groups + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; t++) {
+      int64_t lo = t * per, hi = std::min(n_groups, lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back(work, lo, hi);
+    }
+    for (auto& th : ts) th.join();
+  }
 }
 
 }  // extern "C"

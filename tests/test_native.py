@@ -96,3 +96,43 @@ def test_native_prescan_device_decode_roundtrip():
         got_t = ts[i][valid[i]]
         assert len(got_t) == len(want)
         assert all(got_t[j] == want[j].timestamp for j in range(len(want)))
+
+
+def test_pack_windowed_dense_matches_numpy():
+    """Native m3agg_* fused densify == numpy window_keys+pack_dense_groups,
+    including clamped out-of-range samples (whose in-window offsets exceed
+    the resolution and stress the torder downshift) and NaN values (which
+    occupy a slot but must be invalid)."""
+    from m3_tpu import native
+    from m3_tpu.aggregator.kernels import pack_dense_groups, window_keys
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+
+    rng = np.random.default_rng(11)
+    g, nw, per = 500, 4, 6
+    n = g * nw * per
+    nanos = 10**9
+    t0 = 1_700_000_000 * nanos
+    res = 60 * nanos
+    ids = rng.integers(0, g, n).astype(np.int64)
+    times = t0 + rng.integers(0, nw * res, n)
+    # late stragglers: far past the last window (late-clamp overflow case)
+    late = rng.random(n) < 0.01
+    times[late] += rng.integers(2, 200, late.sum()) * res
+    values = rng.normal(0, 1, n).astype(np.float32)
+    values[rng.random(n) < 0.02] = np.nan  # stale markers
+
+    keys, _, order = window_keys(ids, times, t0, res, nw)
+    v1, t1, m1 = pack_dense_groups(keys, values, order, g * nw)
+    v2, t2, m2 = native.pack_windowed_dense(ids, times, values, t0, res, nw, g)
+
+    assert v1.shape == v2.shape
+    assert np.array_equal(m1, m2)
+    assert np.array_equal(np.nan_to_num(v1), np.nan_to_num(v2))
+    assert np.array_equal(np.isnan(v1), np.isnan(v2))
+    # torder parity wherever a slot is occupied (padding torder is 0 in both)
+    occupied = np.arange(v1.shape[1])[None, :] < np.bincount(
+        keys, minlength=g * nw
+    )[:, None]
+    assert np.array_equal(t1[occupied], t2[occupied])

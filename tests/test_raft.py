@@ -309,6 +309,23 @@ def test_snapshot_catchup_for_lagging_follower(tmp_path):
                 f"snapshot catch-up failed: snap={node.snap_index} "
                 f"applied={node.last_applied}"
             )
+        # replicas must converge at the VERSION level too: if the snapshot
+        # were mislabelled below the state it carries, the retained log tail
+        # would re-apply on the restarted follower and bump versions past
+        # the leader's (silent divergence)
+        leader_node = next(n for n in q.nodes.values() if n.is_leader)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            mismatch = [
+                k for k in ("k0", "k150", "k299")
+                if (a := node.store.get(k)) is None
+                or (b := leader_node.store.get(k)) is None
+                or a.version != b.version
+            ]
+            if not mismatch:
+                break
+            time.sleep(0.05)
+        assert not mismatch, f"version divergence on {mismatch}"
         kv.close()
     finally:
         q.close()
