@@ -14,7 +14,8 @@ TChannel/Thrift.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 
 from ..cluster.topology import ConsistencyLevel, TopologyMap
 from ..utils.hash import shard_for
@@ -30,6 +31,96 @@ class ConsistencyError(Exception):
         self.required = required
 
 
+class _PendingWrite:
+    """One enqueued write awaiting its host-queue flush."""
+
+    __slots__ = ("entry", "event", "error")
+
+    def __init__(self, entry) -> None:
+        self.entry = entry
+        self.event = threading.Event()
+        self.error: str | None = None
+
+
+class HostQueue:
+    """Per-host asynchronous write queue (host_queue.go): writes buffer
+    here and flush to the host as ONE write_tagged_batch RPC when the batch
+    fills or the flush interval elapses — the data plane stops paying one
+    synchronous round trip per datapoint. Per-entry errors come back with
+    the batch so the session still counts quorum per datapoint.
+
+    Reference: /root/reference/src/dbnode/client/host_queue.go (op batching
+    + drain loop), session.go:1068 writeAttempt enqueueing per-shard ops."""
+
+    def __init__(
+        self,
+        node,
+        namespace: str,
+        batch_size: int = 128,
+        flush_interval: float = 0.005,
+    ) -> None:
+        self.node = node
+        self.namespace = namespace
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self._buf: list[_PendingWrite] = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"host-queue-{getattr(node, 'id', '?')}",
+        )
+        self._thread.start()
+
+    def enqueue(self, pw: _PendingWrite) -> None:
+        with self._cv:
+            self._buf.append(pw)
+            if len(self._buf) >= self.batch_size:
+                self._cv.notify()
+
+    def flush_now(self) -> None:
+        with self._cv:
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if not self._buf and not self._stop:
+                    self._cv.wait(self.flush_interval)
+                if self._stop and not self._buf:
+                    return
+                batch, self._buf = self._buf, []
+            if batch:
+                self._flush(batch)
+
+    def _flush(self, batch: list[_PendingWrite]) -> None:
+        try:
+            if hasattr(self.node, "write_tagged_batch"):
+                errs = self.node.write_tagged_batch(
+                    self.namespace, [pw.entry for pw in batch]
+                )
+            else:  # node without the batch op: per-entry fallback
+                errs = []
+                for pw in batch:
+                    tags, t, v, unit = pw.entry
+                    try:
+                        self.node.write_tagged(self.namespace, tags, t, v, Unit(unit))
+                        errs.append(None)
+                    except Exception as exc:
+                        errs.append(str(exc))
+        except Exception as exc:  # transport failure fails the whole batch
+            errs = [f"{type(exc).__name__}: {exc}"] * len(batch)
+        for pw, err in zip(batch, errs):
+            pw.error = err
+            pw.event.set()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=5)
+
+
 @dataclass
 class Session:
     topology: TopologyMap
@@ -37,6 +128,8 @@ class Session:
     namespace: str = "default"
     write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
     read_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
+    # per-host async write queues, created lazily by write_batch_tagged
+    _queues: dict = field(default_factory=dict, repr=False)
 
     @property
     def num_shards(self) -> int:
@@ -92,6 +185,76 @@ class Session:
             self.write_consistency.required(self.topology.replicas),
             lambda node: node.write(self.namespace, sid, t_nanos, value, unit),
         )
+
+    # --- batched writes over per-host queues (host_queue.go data plane) ---
+
+    def _host_queue(self, host: str) -> HostQueue | None:
+        q = self._queues.get(host)
+        if q is None:
+            node = self.nodes.get(host)
+            if node is None:
+                return None
+            q = self._queues[host] = HostQueue(node, self.namespace)
+        return q
+
+    def write_batch_tagged(self, entries, timeout: float = 30.0) -> list[bytes]:
+        """Batched tagged writes: every entry fans out to its shard's
+        replicas through per-host queues (one RPC per host per flush, not
+        one per datapoint), then quorum is counted PER ENTRY from the
+        returned per-element errors. ``entries``: (tags, t_nanos, value) or
+        (tags, t_nanos, value, unit). Returns the series ids; raises
+        ConsistencyError if any entry misses its write quorum."""
+        from ..rules.rules import encode_tags_id
+
+        required = self.write_consistency.required(self.topology.replicas)
+        sids: list[bytes] = []
+        pendings: list[list[_PendingWrite]] = []
+        down: list[int] = []
+        touched: set[str] = set()
+        for e in entries:
+            tags, t, v = e[0], e[1], e[2]
+            unit = int(e[3]) if len(e) > 3 else int(Unit.SECOND)
+            sid = encode_tags_id(tags)
+            sids.append(sid)
+            per_entry: list[_PendingWrite] = []
+            for host in self.topology.hosts_for_shard(self._shard(sid)):
+                node = self.nodes.get(host)
+                if node is None or not node.is_up:
+                    continue
+                q = self._host_queue(host)
+                if q is None:
+                    continue
+                pw = _PendingWrite((tags, t, v, unit))
+                q.enqueue(pw)
+                per_entry.append(pw)
+                touched.add(host)
+            if len(per_entry) < required:
+                down.append(len(sids) - 1)
+            pendings.append(per_entry)
+        for host in touched:
+            self._queues[host].flush_now()
+        failed = list(down)
+        for i, per_entry in enumerate(pendings):
+            if i in down:
+                continue
+            ok = 0
+            for pw in per_entry:
+                pw.event.wait(timeout)
+                if pw.event.is_set() and pw.error is None:
+                    ok += 1
+            if ok < required:
+                failed.append(i)
+        if failed:
+            raise ConsistencyError(
+                "write_batch", len(entries) - len(failed), len(entries),
+                [f"{len(failed)} entries under quorum (first idx {failed[0]})"],
+            )
+        return sids
+
+    def close(self) -> None:
+        for q in self._queues.values():
+            q.stop()
+        self._queues.clear()
 
     # --- reads (session.go:1269-1530 + series_iterator replica merge) ---
 

@@ -116,6 +116,19 @@ class SessionDatabase:
     def write_tagged(self, ns, tags, t, v, unit=Unit.SECOND):
         return self._session(ns).write_tagged(tags, t, v, unit)
 
+    def write_tagged_batch(self, ns, entries):
+        """Batched ingest through per-host queues (host_queue.go seam) —
+        one RPC per host per flush instead of one per datapoint. Per-entry
+        quorum failures surface as ConsistencyError strings, matching the
+        storage Database's per-entry error contract."""
+        try:
+            self._session(ns).write_batch_tagged(
+                [(tags, t, v, unit) for tags, t, v, unit in entries]
+            )
+        except Exception as exc:
+            return [f"{type(exc).__name__}: {exc}"] * len(entries)
+        return [None] * len(entries)
+
     def read(self, ns, sid, start, end):
         return self._session(ns).fetch(sid, start, end)
 

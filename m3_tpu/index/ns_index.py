@@ -84,8 +84,11 @@ class NamespaceIndex:
             blk.dirty = True
 
     def write_batch(self, entries: list[tuple[bytes, Tags, int]]) -> None:
-        for sid, tags, t in entries:
-            self.write(sid, tags, t)
+        with self.lock:  # one acquisition for the whole batch
+            for sid, tags, t in entries:
+                blk = self._block_for(t)
+                blk.mutable.insert(Document(sid, tags))
+                blk.dirty = True
 
     def query(
         self, q: Query, start_nanos: int, end_nanos: int, limit: int | None = None

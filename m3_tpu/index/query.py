@@ -175,18 +175,99 @@ def _cache_lookup(cache, seg, subkey):
     return cache.get(key), key
 
 
-def execute(segments, query: Query, limit: int | None = None, cache=None) -> list[Document]:
-    """search/executor: iterate matched docs across segments (docs dedupe by
-    id — later segments don't re-emit ids already seen)."""
-    out: list[Document] = []
-    seen: set[bytes] = set()
-    for seg in segments:
-        for i in search_segment(seg, query, cache):
-            doc = seg.docs[int(i)]
-            if doc.id in seen:
+class MatchedDocs:
+    """Lazy matched-document sequence (search/executor iterator role).
+
+    Postings are computed eagerly (cheap, postings-cache-served); Document
+    objects materialize only on access — so `len(result.docs)`, id-only
+    consumers (series select), and partial iteration never pay the per-doc
+    tag decode that dominated large regexp fan-outs (2.5s at 500k docs).
+    Cross-segment id-dedupe extracts only ids, via the segment's batch
+    ``doc_ids`` fast path when it has one; the common single-segment case
+    (ids unique within a segment by construction) skips dedupe entirely."""
+
+    def __init__(self, parts, limit: int | None = None) -> None:
+        """``parts`` is an ITERABLE of (segment, postings): it is consumed
+        lazily so a satisfied ``limit`` stops searching later segments
+        entirely (the executor's early exit). Id-dedupe engages only once a
+        SECOND non-empty segment appears — the common single-segment case
+        never extracts ids at all."""
+        self._parts: list = []
+        seen: set[bytes] | None = None
+        total = 0
+        for seg, post in parts:
+            if limit is not None and total >= limit:
+                break
+            if not len(post):
                 continue
-            seen.add(doc.id)
-            out.append(doc)
-            if limit is not None and len(out) >= limit:
-                return out
-    return out
+            if self._parts and seen is None:
+                # second live part: seed the dedupe set from earlier parts
+                seen = set()
+                for s0, p0 in self._parts:
+                    seen.update(self._ids_of(s0, p0))
+            if seen is None:
+                if limit is not None and total + len(post) > limit:
+                    post = post[: limit - total]
+                self._parts.append((seg, post))
+                total += len(post)
+            else:
+                ids = self._ids_of(seg, post)
+                keep = []
+                for j, did in enumerate(ids):
+                    if did in seen:
+                        continue
+                    seen.add(did)
+                    keep.append(j)
+                    total += 1
+                    if limit is not None and total >= limit:
+                        break
+                self._parts.append(
+                    (seg, post[np.asarray(keep, np.int64)] if keep else post[:0])
+                )
+        self._len = total
+        self._offsets = np.cumsum([0] + [len(p) for _, p in self._parts])
+
+    @staticmethod
+    def _ids_of(seg, post):
+        if hasattr(seg, "doc_ids"):
+            return seg.doc_ids(post)
+        docs = seg.docs
+        return [docs[int(i)].id for i in post]
+
+    def ids(self) -> list[bytes]:
+        """All matched doc ids without tag materialization."""
+        out: list[bytes] = []
+        for seg, post in self._parts:
+            out.extend(self._ids_of(seg, post))
+        return out
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._len))]
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        k = int(np.searchsorted(self._offsets, i, side="right")) - 1
+        seg, post = self._parts[k]
+        return seg.docs[int(post[i - int(self._offsets[k])])]
+
+    def __iter__(self):
+        for seg, post in self._parts:
+            docs = seg.docs
+            for i in post:
+                yield docs[int(i)]
+
+
+def execute(segments, query: Query, limit: int | None = None, cache=None) -> MatchedDocs:
+    """search/executor: matched docs across segments as a LAZY sequence
+    (docs dedupe by id — later segments don't re-emit ids already seen).
+    Segments are searched lazily: once ``limit`` is reached, remaining
+    segments are never scanned."""
+    return MatchedDocs(
+        ((seg, search_segment(seg, query, cache)) for seg in segments),
+        limit=limit,
+    )

@@ -30,6 +30,67 @@ class Document:
     fields: Tags
 
 
+def _top_level_alternation(pattern: bytes) -> bool:
+    """True if the pattern has an unparenthesized '|' — then NO prefix is
+    common to all alternatives and pruning is unsafe."""
+    depth = 0
+    in_class = False
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == 0x5C:  # backslash: skip escaped char
+            i += 2
+            continue
+        if in_class:
+            if c == 0x5D:  # ]
+                in_class = False
+        elif c == 0x5B:  # [
+            in_class = True
+        elif c == 0x28:  # (
+            depth += 1
+        elif c == 0x29:  # )
+            depth -= 1
+        elif c == 0x7C and depth == 0:  # |
+            return True
+        i += 1
+    return False
+
+
+def literal_prefix(pattern: bytes) -> bytes:
+    """Longest literal prefix of a regexp — the prune the reference gets
+    from intersecting the compiled automaton with the term FST
+    (segment/fst/regexp/regexp.go): only terms in [prefix, next(prefix))
+    can match, so the scan touches a fraction of the dictionary."""
+    if pattern.startswith(b"^"):
+        pattern = pattern[1:]
+    if _top_level_alternation(pattern):
+        return b""
+    out = bytearray()
+    i = 0
+    while i < len(pattern):
+        c = pattern[i : i + 1]
+        if c in b".^$*+?{}[]|()\\":
+            break
+        out += c
+        i += 1
+    # a quantifier after the last literal makes that char optional
+    if i < len(pattern) and pattern[i : i + 1] in b"*+?{" and out:
+        out = out[:-1]
+    return bytes(out)
+
+
+def prefix_upper(pre: bytes) -> bytes | None:
+    """Smallest byte string greater than every string with prefix ``pre``
+    (None if unbounded)."""
+    b = bytearray(pre)
+    while b:
+        if b[-1] < 0xFF:
+            b[-1] += 1
+            return bytes(b)
+        b.pop()
+    return None
+
+
 class MutableSegment:
     """segment/mem: built live on ingest."""
 
@@ -77,9 +138,22 @@ class SealedSegment:
         self._field_terms: dict[bytes, list[bytes]] = field_terms
         self._postings_index: dict[bytes, np.ndarray] = postings_index  # [n_terms, 2]
         self._postings_data: np.ndarray = postings_data  # int32 concatenated
+        # per-field object arrays for searchsorted, built once — rebuilding
+        # them per postings() call made persist O(n_terms^2)
+        self._term_arrs: dict[bytes, np.ndarray] = {}
 
     def __len__(self) -> int:
         return len(self.docs)
+
+    def _term_arr(self, name: bytes) -> np.ndarray | None:
+        arr = self._term_arrs.get(name)
+        if arr is None:
+            terms = self._field_terms.get(name)
+            if not terms:
+                return None
+            arr = np.asarray(terms, object)
+            self._term_arrs[name] = arr
+        return arr
 
     @staticmethod
     def from_mutable(seg: MutableSegment) -> "SealedSegment":
@@ -107,26 +181,43 @@ class SealedSegment:
         return self._field_terms.get(name, [])
 
     def postings(self, name: bytes, value: bytes) -> np.ndarray:
-        terms = self._field_terms.get(name)
-        if not terms:
+        arr = self._term_arr(name)
+        if arr is None:
             return np.zeros(0, np.int32)
-        i = np.searchsorted(np.asarray(terms, object), value)
+        terms = self._field_terms[name]
+        i = np.searchsorted(arr, value)
         if i >= len(terms) or terms[i] != value:
             return np.zeros(0, np.int32)
         s, e = self._postings_index[name][i]
         return self._postings_data[s:e]
 
+    def iter_term_postings(self, name: bytes):
+        """(term, postings) pairs in sorted term order — the segment
+        writer's walk, without a per-term search."""
+        idx = self._postings_index.get(name)
+        for i, t in enumerate(self._field_terms.get(name, [])):
+            s, e = idx[i]
+            yield t, self._postings_data[s:e]
+
     def postings_regexp(self, name: bytes, pattern: bytes) -> np.ndarray:
-        """segment/fst/regexp: regex → automaton over the term FST; here a
-        compiled re over the sorted term dict."""
-        terms = self._field_terms.get(name)
-        if not terms:
+        """segment/fst/regexp: regex → automaton intersected with the term
+        dict; here literal-prefix pruning narrows the sorted dict to the
+        only range that can match, then a compiled re filters it."""
+        arr = self._term_arr(name)
+        if arr is None:
             return np.zeros(0, np.int32)
+        terms = self._field_terms[name]
+        lo, hi = 0, len(terms)
+        pre = literal_prefix(pattern)
+        if pre:
+            lo = int(np.searchsorted(arr, pre))
+            up = prefix_upper(pre)
+            hi = int(np.searchsorted(arr, up)) if up is not None else len(terms)
         rx = re.compile(b"^(?:" + pattern + b")$")
         out = []
         idx = self._postings_index[name]
-        for i, t in enumerate(terms):
-            if rx.match(t):
+        for i in range(lo, hi):
+            if rx.match(terms[i]):
                 s, e = idx[i]
                 out.append(self._postings_data[s:e])
         if not out:

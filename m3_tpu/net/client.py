@@ -140,6 +140,17 @@ class RemoteNode(RpcClient):
             unit=int(unit),
         )
 
+    def write_tagged_batch(self, ns, entries):
+        """entries: (tags, t, v, unit) — one framed RPC, per-entry errors."""
+        return self._call(
+            "write_tagged_batch",
+            ns=ns,
+            entries=[
+                [[[n, v2] for n, v2 in tags], t, v, int(unit)]
+                for tags, t, v, unit in entries
+            ],
+        )
+
     def read(self, ns, sid, start, end):
         return wire.dps_from_wire(
             self._call("fetch", ns=ns, sid=sid, start=start, end=end)

@@ -61,6 +61,15 @@ class NodeService:
             req["ns"], tags, req["t"], req["v"], Unit(req.get("unit", 1))
         )
 
+    def op_write_tagged_batch(self, req):
+        """One RPC per host-queue flush (host_queue.go role); per-entry
+        errors ride back so the session counts quorum per datapoint."""
+        entries = [
+            (tuple((n, v) for n, v in tags), t, val, unit)
+            for tags, t, val, unit in req["entries"]
+        ]
+        return self.db.write_tagged_batch(req["ns"], entries)
+
     def op_fetch(self, req):
         dps = self.db.read(req["ns"], req["sid"], req["start"], req["end"])
         return wire.dps_to_wire(dps)

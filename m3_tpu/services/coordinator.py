@@ -127,7 +127,11 @@ class Coordinator:
         return server
 
     def write_prom(self, req: prompb.WriteRequest) -> int:
+        """Remote-write ingest; storage writes ride the BATCHED path
+        end-to-end (client host queues → one write_tagged_batch RPC per
+        host) when the backing db supports it."""
         count = 0
+        batch = []
         for ts in req.timeseries:
             tags = make_tags([(l.name, l.value) for l in ts.labels])
             for s in ts.samples:
@@ -136,8 +140,17 @@ class Coordinator:
                 if self.downsampler is not None:
                     keep = self.downsampler.write(tags, t_nanos, s.value, MetricType.GAUGE)
                 if keep:
-                    self.db.write_tagged(self.namespace, tags, t_nanos, s.value)
+                    batch.append((tags, t_nanos, s.value, 1))
                 count += 1
+        if batch:
+            if hasattr(self.db, "write_tagged_batch"):
+                errs = self.db.write_tagged_batch(self.namespace, batch)
+                bad = next((e for e in errs if e), None)
+                if bad is not None:
+                    raise RuntimeError(f"remote write partial failure: {bad}")
+            else:
+                for tags, t_nanos, v, unit in batch:
+                    self.db.write_tagged(self.namespace, tags, t_nanos, v)
         return count
 
     def read_prom(self, req: prompb.ReadRequest) -> prompb.ReadResponse:

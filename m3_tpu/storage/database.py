@@ -136,8 +136,13 @@ class Shard:
         # MultiReaderIterator's latest-segment-wins dedupe gives buffer
         # precedence over filesets (shard.go:1060 ReadEncoded ordering)
         from ..codec.iterator import MultiReaderIterator
+        from ..codec.native_read import read_segments
 
-        it = MultiReaderIterator(self._segments_locked(sid, start, end))
+        segments = self._segments_locked(sid, start, end)
+        fast = read_segments(segments, start, end)  # native decoder; None
+        if fast is not None:  # when annotations must survive
+            return fast
+        it = MultiReaderIterator(segments)
         return [dp for dp in it if start <= dp.timestamp < end]
 
     def _segments_locked(self, sid: bytes, start: int, end: int) -> list[bytes]:
@@ -422,6 +427,26 @@ class Database:
         if namespace.index is not None:
             namespace.index.write(sid, tags, t_nanos)
         return sid
+
+    def write_tagged_batch(self, ns: str, entries) -> list[str | None]:
+        """Batched tagged writes with PER-ENTRY error isolation (the node
+        side of the client's host queue, rpc.thrift writeTaggedBatchRaw +
+        per-element error semantics). ``entries``: (tags, t_nanos, value,
+        unit). Returns one error string or None per entry, in order."""
+        errs: list[str | None] = []
+        for tags, t, v, unit in entries:
+            try:
+                self.write_tagged(
+                    ns,
+                    tuple((bytes(a), bytes(b)) for a, b in tags),
+                    t,
+                    v,
+                    Unit(unit),
+                )
+                errs.append(None)
+            except Exception as exc:
+                errs.append(f"{type(exc).__name__}: {exc}")
+        return errs
 
     def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None):
         namespace = self.namespaces[ns]

@@ -39,16 +39,23 @@ def default_tags_for(sid: bytes):
 def _canonical_digest(sh, sid: bytes, bs: int, bsz: int):
     """(count, checksum) over the DECODED merged point set of one series
     block — canonical across flush states (buffered, flushed, or cold
-    writes atop a flushed volume all digest identically)."""
+    writes atop a flushed volume all digest identically). The digest bytes
+    are the packed '<qdB' per-point records; the numpy structured layout
+    below is byte-identical, so the native-array fast path and the
+    Datapoint fallback produce the same checksum."""
     dps = sh.read(sid, bs, bs + bsz)
     if not dps:
         return None
-    h = 0
-    for dp in dps:
-        h = zlib.adler32(
-            _PT.pack(dp.timestamp, dp.value, int(dp.unit)), h
-        )
-    return [len(dps), h]
+    import numpy as np
+
+    rec = np.empty(
+        len(dps), dtype=np.dtype([("t", "<i8"), ("v", "<f8"), ("u", "u1")])
+    )
+    rec["t"] = [dp.timestamp for dp in dps]
+    rec["v"] = [dp.value for dp in dps]
+    rec["u"] = [int(dp.unit) for dp in dps]
+    assert rec.dtype.itemsize == _PT.size
+    return [len(dps), zlib.adler32(rec.tobytes())]
 
 
 def block_metadata(db, ns: str, shard_id: int) -> list[list]:

@@ -52,6 +52,11 @@ class Node:
             raise ConnectionError(f"{self.id} down")
         return self.db.write_tagged(ns, tags, t, v, unit)
 
+    def write_tagged_batch(self, ns, entries):
+        if not self.is_up:
+            raise ConnectionError(f"{self.id} down")
+        return self.db.write_tagged_batch(ns, entries)
+
     def fetch_tagged(self, ns, query, start, end, limit=None):
         if not self.is_up:
             raise ConnectionError(f"{self.id} down")

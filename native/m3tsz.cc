@@ -468,25 +468,45 @@ struct BitReader {
   int64_t nbits;
   int64_t pos = 0;
 
+  // byte-wise big-endian extraction (the bit-at-a-time loop was the decode
+  // hot spot: up to 64 iterations per read; 1-bit control reads dominate)
+  static uint64_t extract(const uint8_t* data, int64_t p, int n) {
+    uint64_t v = 0;
+    int remaining = n;
+    int bit_off = (int)(p & 7);
+    if (bit_off) {
+      int take = 8 - bit_off;
+      if (take > remaining) take = remaining;
+      uint8_t byte = data[p >> 3];
+      v = (byte >> (8 - bit_off - take)) & ((1u << take) - 1);
+      remaining -= take;
+      p += take;
+    }
+    while (remaining >= 8) {
+      v = (v << 8) | data[p >> 3];
+      remaining -= 8;
+      p += 8;
+    }
+    if (remaining) {
+      v = (v << remaining) | (data[p >> 3] >> (8 - remaining));
+    }
+    return v;
+  }
+
   bool read(int n, uint64_t* out) {
     if (pos + n > nbits) return false;
-    uint64_t v = 0;
-    for (int i = 0; i < n; i++) {
-      int64_t p = pos + i;
-      v = (v << 1) | ((data[p >> 3] >> (7 - (p & 7))) & 1);
+    if (n == 1) {
+      *out = (data[pos >> 3] >> (7 - (pos & 7))) & 1;
+      pos++;
+      return true;
     }
-    *out = v;
+    *out = extract(data, pos, n);
     pos += n;
     return true;
   }
   bool peek(int n, uint64_t* out) const {
     if (pos + n > nbits) return false;
-    uint64_t v = 0;
-    for (int i = 0; i < n; i++) {
-      int64_t p = pos + i;
-      v = (v << 1) | ((data[p >> 3] >> (7 - (p & 7))) & 1);
-    }
-    *out = v;
+    *out = extract(data, pos, n);
     return true;
   }
 };
@@ -519,6 +539,7 @@ struct Iter {
   int time_unit = 0;
   bool tu_changed = false;
   int markers = 0;  // markers consumed (EOS/annotation/time-unit)
+  int annotations = 0;  // annotation markers specifically
   bool done = false, err = false;
   uint64_t prev_float_bits = 0, prev_xor = 0;
   double int_val = 0;
@@ -563,6 +584,7 @@ struct Iter {
       } else if (marker == ANNOTATION_MARKER) {
         r.pos += NUM_MARKER_BITS;
         markers++;
+        annotations++;
         if (!read_varint_skip()) return false;
         return read_dod(dod_out);
       } else if (marker == TIME_UNIT_MARKER) {
@@ -894,6 +916,85 @@ int32_t m3tsz_prescan(const uint8_t* data, int64_t len_bytes, int32_t k,
     out[nsnap - 1].flags = fl;
   }
   return nsnap;
+}
+
+// Decode one stream into (times, values); returns count, or -1 on a real
+// decode error (EOF-at-end is stream end, matching decode() in
+// codec/m3tsz.py and the Go iterator's io.EOF handling,
+// /root/reference/src/dbnode/encoding/m3tsz/iterator.go:64).
+static int64_t decode_one(const uint8_t* data, int64_t len_bytes,
+                          int default_unit, int int_optimized, int64_t cap,
+                          int64_t* out_times, double* out_values,
+                          uint8_t* out_units, uint8_t* flags) {
+  *flags = 0;
+  if (len_bytes <= 0) return 0;
+  Iter it;  // the reader state machine (shared with prescan)
+  it.r.data = data;
+  it.r.pos = 0;
+  it.r.nbits = len_bytes * 8;
+  it.int_optimized = int_optimized != 0;
+  it.default_unit = default_unit;
+  static const double MULT10[MAX_MULT + 1] = {1.0,    10.0,    100.0,  1000.0,
+                                              10000.0, 100000.0, 1000000.0};
+  int64_t n = 0;
+  while (it.next(n == 0)) {
+    if (n >= cap) return -2;  // caller's capacity too small
+    out_times[n] = it.prev_time;
+    out_units[n] = (uint8_t)it.time_unit;
+    double v;
+    if (!it.int_optimized || it.is_float) {
+      uint64_t b = it.prev_float_bits;
+      double d;
+      std::memcpy(&d, &b, 8);
+      v = d;
+    } else {
+      v = it.mult <= MAX_MULT ? it.int_val / MULT10[it.mult] : it.int_val;
+    }
+    out_values[n] = v;
+    n++;
+    if (it.done || it.err) break;
+  }
+  if (it.annotations > 0) *flags |= 1;  // caller re-decodes via the
+                                        // annotation-capable path
+  return it.err ? -1 : n;
+}
+
+// Batch decode with threads: streams concatenated; offsets[n+1]. Each
+// series writes up to cap points at out_{times,values,units} + i*cap;
+// counts[i] receives the point count (-1 decode error, -2 cap overflow);
+// out_flags[i] bit0 = stream carries annotations. Returns the number of
+// series that failed.
+int32_t m3tsz_decode_batch(const uint8_t* data, const int64_t* offsets,
+                           int32_t n_series, int default_unit,
+                           int int_optimized, int64_t cap, int64_t* out_times,
+                           double* out_values, uint8_t* out_units,
+                           int64_t* out_counts, uint8_t* out_flags,
+                           int32_t n_threads) {
+  std::atomic<int32_t> failed{0};
+  auto work = [&](int32_t lo, int32_t hi) {
+    for (int32_t i = lo; i < hi; i++) {
+      int64_t r = decode_one(data + offsets[i], offsets[i + 1] - offsets[i],
+                             default_unit, int_optimized, cap,
+                             out_times + (int64_t)i * cap,
+                             out_values + (int64_t)i * cap,
+                             out_units + (int64_t)i * cap, out_flags + i);
+      out_counts[i] = r;
+      if (r < 0) failed.fetch_add(1, std::memory_order_relaxed);
+    }
+  };
+  if (n_threads <= 1 || n_series < 4) {
+    work(0, n_series);
+  } else {
+    std::vector<std::thread> ts;
+    int32_t per = (n_series + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; t++) {
+      int32_t lo = t * per, hi = std::min(n_series, lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back(work, lo, hi);
+    }
+    for (auto& th : ts) th.join();
+  }
+  return failed.load();
 }
 
 // Batch prescan with threads. data: concatenated streams; offsets[n+1].

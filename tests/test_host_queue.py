@@ -1,0 +1,95 @@
+"""Per-host write queues: batched cluster writes with per-entry quorum.
+
+Reference: /root/reference/src/dbnode/client/host_queue.go (op batching +
+drain) and session.go:1068 (per-shard write fan-out) — the data plane must
+not pay one synchronous RPC per datapoint.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from m3_tpu.client.session import ConsistencyError
+from m3_tpu.cluster.topology import ConsistencyLevel
+from m3_tpu.testing.cluster import LocalCluster
+from m3_tpu.testing.proc_cluster import ProcCluster
+
+
+def make_tags(i):
+    return (
+        (b"__name__", b"batched_metric"),
+        (b"host", b"h%d" % (i % 7)),
+        (b"idx", b"%d" % i),
+    )
+
+
+def test_write_batch_tagged_quorum_and_read(tmp_path):
+    cluster = LocalCluster(num_nodes=3, num_shards=8, replica_factor=3,
+                           base_dir=str(tmp_path))
+    sess = cluster.session()
+    try:
+        t0 = 1_700_000_000 * 10**9
+        entries = [(make_tags(i), t0 + i * 10**9, float(i)) for i in range(300)]
+        sids = sess.write_batch_tagged(entries)
+        assert len(sids) == 300
+        # every entry readable at quorum
+        for i in (0, 7, 299):
+            dps = sess.fetch(sids[i], t0 - 1, t0 + 10**12)
+            assert [dp.value for dp in dps] == [float(i)]
+    finally:
+        sess.close()
+
+
+def test_write_batch_one_replica_down_still_quorum(tmp_path):
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    sess = cluster.session()
+    try:
+        cluster.nodes["node2"].is_up = False
+        t0 = 1_700_000_000 * 10**9
+        entries = [(make_tags(i), t0, float(i)) for i in range(50)]
+        sess.write_batch_tagged(entries)  # 2/3 replicas = majority, fine
+        cluster.nodes["node1"].is_up = False
+        with pytest.raises(ConsistencyError):
+            sess.write_batch_tagged(entries)  # 1/3 under majority
+    finally:
+        sess.close()
+
+
+def test_write_batch_unavailable_consistency_one(tmp_path):
+    cluster = LocalCluster(num_nodes=2, num_shards=4, replica_factor=2,
+                           base_dir=str(tmp_path))
+    sess = cluster.session(write_cl=ConsistencyLevel.ONE)
+    try:
+        cluster.nodes["node1"].is_up = False
+        t0 = 1_700_000_000 * 10**9
+        sess.write_batch_tagged([(make_tags(1), t0, 1.0)])  # ONE suffices
+    finally:
+        sess.close()
+
+
+def test_batched_writes_over_sockets(tmp_path):
+    """End-to-end over real node processes: the batch rides ONE
+    write_tagged_batch RPC per host flush, and everything is readable."""
+    cluster = ProcCluster(
+        num_nodes=2, num_shards=4, replica_factor=2,
+        heartbeat_timeout=2.0, base_dir=str(tmp_path),
+    )
+    try:
+        sess = cluster.session()
+        t0 = 1_700_000_000 * 10**9
+        n = 500
+        entries = [(make_tags(i), t0 + (i // 7) * 10**9, float(i)) for i in range(n)]
+        t_start = time.perf_counter()
+        sids = sess.write_batch_tagged(entries)
+        batch_s = time.perf_counter() - t_start
+        # sanity read-back via quorum fetch
+        vals = [dp.value for dp in sess.fetch(sids[123], t0 - 1, t0 + 10**12)]
+        assert vals == [123.0]
+        # throughput floor: batched >> per-datapoint sync fan-out. 500
+        # writes x 2 replicas in well under a second even on a loaded box.
+        assert batch_s < 5.0, batch_s
+        sess.close()
+    finally:
+        cluster.close()

@@ -136,3 +136,50 @@ def test_pack_windowed_dense_matches_numpy():
         keys, minlength=g * nw
     )[:, None]
     assert np.array_equal(t1[occupied], t2[occupied])
+
+
+def test_decode_batch_matches_python():
+    """Native m3tsz_decode_batch == Python decoder on (t, v, unit),
+    including float/int mode switches and unit changes."""
+    from m3_tpu.codec.m3tsz import decode as py_decode
+    from m3_tpu.native import decode_batch
+
+    streams = []
+    rng = np.random.default_rng(3)
+    t0 = 1_700_000_000 * 10**9
+    # ints, floats, mixed, singletons
+    for kind in range(8):
+        n = int(rng.integers(1, 200))
+        times = t0 + np.cumsum(rng.integers(1, 30, n)) * 10**9
+        if kind % 3 == 0:
+            vals = rng.integers(0, 1000, n).astype(float)
+        elif kind % 3 == 1:
+            vals = rng.normal(0, 1e6, n)
+        else:
+            vals = np.where(rng.random(n) < 0.5, rng.integers(0, 9, n), rng.normal())
+        streams.append(encode_series(list(map(int, times)), list(map(float, vals))))
+    out = decode_batch(streams)
+    for s, (t, v, u) in zip(streams, out):
+        dps = py_decode(s)
+        assert len(dps) == len(t)
+        for d, tt, vv, uu in zip(dps, t, v, u):
+            assert d.timestamp == int(tt)
+            assert d.value == vv or (np.isnan(d.value) and np.isnan(vv))
+            assert int(d.unit) == int(uu)
+
+
+def test_decode_batch_flags_annotations():
+    from m3_tpu.codec.m3tsz import Encoder
+    from m3_tpu.native import decode_batch
+
+    t0 = 1_700_000_000 * 10**9
+    enc = Encoder(t0)
+    enc.encode(t0, 1.0)
+    enc.encode(t0 + 10**9, 2.0, annotation=b"meta")
+    with_ann = enc.stream()
+    plain = encode_series([t0, t0 + 10**9], [1.0, 2.0])
+    triples, flags = decode_batch([plain, with_ann], with_flags=True)
+    assert list(flags) == [0, 1]
+    # annotations don't perturb (t, v) decoding
+    assert list(triples[1][0]) == [t0, t0 + 10**9]
+    assert list(triples[1][1]) == [1.0, 2.0]
