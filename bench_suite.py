@@ -434,6 +434,53 @@ def bench_config5(n_series, on_tpu):
     )
 
 
+def bench_compression(n_series=2000, n_points=720):
+    """bytes/datapoint on a PRODUCTION-LIKE trace, next to the reference's
+    1.45 bytes/dp production claim (docs/m3db/architecture/engine.md:11).
+    Composition modeled on a typical Prometheus scrape: regular 10s
+    timestamps; step-y monotone request counters; low-cardinality gauges
+    that mostly repeat (memory/queue sizes); one-decimal utilization
+    gauges; a tail of higher-entropy latency floats."""
+    from m3_tpu import native
+
+    rng = np.random.default_rng(9)
+    times = (T0 + np.arange(n_points) * 10 * NANOS).astype(np.int64)
+    all_t, all_v, lens = [], [], []
+    comp = {"counter": 0.4, "repeat_gauge": 0.3, "decimal_gauge": 0.2, "latency": 0.1}
+    for i in range(n_series):
+        r = i / n_series
+        if r < comp["counter"]:
+            # ~5 req/s with bursts; cumulative counter
+            vals = np.cumsum(rng.poisson(50, n_points)).astype(float)
+        elif r < comp["counter"] + comp["repeat_gauge"]:
+            # changes rarely (queue depth, memory pages)
+            base = float(rng.integers(100, 10000))
+            steps = rng.choice([0, 0, 0, 0, 0, 0, 0, 1, -1], n_points)
+            vals = base + np.cumsum(steps).astype(float)
+        elif r < comp["counter"] + comp["repeat_gauge"] + comp["decimal_gauge"]:
+            # one-decimal utilization percentage
+            vals = np.round(rng.normal(55, 6, n_points), 1)
+        else:
+            # latency seconds, 3 decimals
+            vals = np.round(rng.lognormal(-3, 0.4, n_points), 3)
+        all_t.append(times)
+        all_v.append(vals)
+        lens.append(n_points)
+    streams = native.encode_batch(
+        np.concatenate(all_t), np.concatenate(all_v), np.asarray(lens, np.int32)
+    )
+    nbytes = sum(map(len, streams))
+    npts = n_series * n_points
+    return _rec(
+        "compression_production_trace",
+        nbytes / npts,
+        "bytes/datapoint",
+        series=n_series,
+        reference_production_claim=1.45,
+        composition="40% counters, 30% repeat gauges, 20% 1-decimal gauges, 10% latency",
+    )
+
+
 def bench_index(n_series, tmpdir="/tmp/m3tpu-index-bench"):
     """Index-at-scale microbench: build an n_series namespace index, persist
     to the mmap segment format, reopen zero-copy, and serve term + regexp
@@ -519,9 +566,9 @@ def main() -> None:
     import jax
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,mixed,scan,index")
+    ap.add_argument("--configs", default="1,2,3,4,5,mixed,scan,index,compression")
     ap.add_argument("--series", type=int, default=0, help="override config-2 series")
-    ap.add_argument("--out", default="PERF_r04.json")
+    ap.add_argument("--out", default="PERF_r05.json")
     args = ap.parse_args()
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -550,6 +597,8 @@ def main() -> None:
         records.append(bench_config5(s5, on_tpu))
     if "index" in want:
         records.append(bench_index(5_000_000 if big else 100_000))
+    if "compression" in want:
+        records.append(bench_compression())
 
     # merge into an existing results file: re-running a subset of configs
     # replaces those records and keeps the rest

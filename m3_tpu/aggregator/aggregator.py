@@ -213,6 +213,11 @@ class Aggregator:
         # ingest servers call add_* from handler threads while a flush loop
         # drains; one lock guards the column buffers (entry.go lock role)
         self._lock = threading.Lock()
+        # passthrough lane (AddPassthrough): leadership as observed at the
+        # last flush pass (standalone: always leader)
+        self._last_leader = election is None
+        self.passthrough_count = 0
+        self.passthrough_follower_noops = 0
 
     def shard_for(self, mid: bytes) -> int:
         return shard_for(mid, self.num_shards)
@@ -264,6 +269,37 @@ class Aggregator:
     # stage lives in rules (forwarded_writer.go equivalence).
     add_forwarded = add_timed
 
+    def add_passthrough(
+        self,
+        mid: bytes,
+        time_nanos: int,
+        value: float,
+        policy: StoragePolicy,
+        agg_type: AggregationType = AggregationType.LAST,
+    ) -> None:
+        """AddPassthrough (aggregator.go:267-302): an ALREADY-AGGREGATED
+        metric is written straight through with its storage policy — no
+        windowing, no re-aggregation. Follower replicas no-op (mirrored
+        ingest must not double-emit; the reference checks ElectionState the
+        same way); leadership is the cached last flush-pass observation,
+        matching the reference's cached election state rather than a KV
+        round trip per metric."""
+        if not self._last_leader:
+            self.passthrough_follower_noops += 1
+            return
+        m = AggregatedMetric(mid, time_nanos, value, policy, agg_type)
+        if self.flush_handler is not None:
+            try:
+                self.flush_handler([m])
+            except Exception:
+                # transient downstream outage: ride the same retry lane as
+                # flushed output (_pending_emit, re-delivered next flush)
+                # instead of losing the metric or surfacing as a decode
+                # error at the ingress
+                with self._lock:
+                    self._pending_emit.append(m)
+        self.passthrough_count += 1
+
     @property
     def is_leader(self) -> bool:
         return self.election is None or self.election.is_leader
@@ -275,6 +311,7 @@ class Aggregator:
         # campaigning at flush time means takeover is observed within one
         # flush interval of the old leader's session expiring
         leader = self.election.elect() if self.election is not None else True
+        self._last_leader = leader  # cached for the passthrough lane
         leader_times = self.flush_times.get() if self.flush_times is not None else {}
         flushed_boundaries: dict[str, int] = {}
         out: list[AggregatedMetric] = []
@@ -287,19 +324,23 @@ class Aggregator:
         # followers keep their mirror of these windows and a takeover
         # re-emits them instead of losing them. Standalone (no followers),
         # undelivered aggregates stay in _pending_emit and retry next flush.
-        if not leader and self._pending_emit:
+        # _pending_emit handoff under the lock: the passthrough lane
+        # (add_passthrough, ingest threads) appends to it concurrently
+        with self._lock:
+            pending, self._pending_emit = self._pending_emit, []
+        if not leader and pending:
             # leadership lost with undelivered output: the flush times for
             # those windows never advanced, so the NEW leader re-emits them
             # from its mirror — retrying here would double-deliver
-            self.dropped_pending += len(self._pending_emit)
-            self._pending_emit = []
-        if self.flush_handler and (out or self._pending_emit):
-            to_send = self._pending_emit + out
+            self.dropped_pending += len(pending)
+            pending = []
+        if self.flush_handler and (out or pending):
+            to_send = pending + out
             try:
                 self.flush_handler(to_send)
-                self._pending_emit = []
             except Exception:
-                self._pending_emit = to_send
+                with self._lock:
+                    self._pending_emit = to_send + self._pending_emit
                 raise
         if leader and self.flush_times is not None and flushed_boundaries:
             from ..cluster.kv import FenceError

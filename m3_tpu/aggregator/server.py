@@ -13,7 +13,15 @@ import socket
 import socketserver
 import threading
 
-from ..metrics.encoding import UnaggregatedMessage, decode_message, encode_message
+from ..metrics.encoding import (
+    KIND_AGGREGATED,
+    AggregatedMessage,
+    UnaggregatedMessage,
+    decode_aggregated,
+    decode_message,
+    encode_aggregated,
+    encode_message,
+)
 from ..metrics.types import MetricType
 from ..net.wire import FrameDecoder, pack_frame
 from ..utils.hash import shard_for
@@ -47,8 +55,17 @@ class AggregatorIngestServer:
                         return  # poisoned stream; drop connection
                     for payload in payloads:
                         try:
-                            msg, _ = decode_message(payload)
-                            outer._apply(msg)
+                            if payload and payload[0] == KIND_AGGREGATED:
+                                # passthrough lane: already-aggregated
+                                # metrics skip re-aggregation entirely
+                                am, _ = decode_aggregated(payload)
+                                outer.aggregator.add_passthrough(
+                                    am.id, am.time_nanos, am.value,
+                                    am.policy, am.agg_type,
+                                )
+                            else:
+                                msg, _ = decode_message(payload)
+                                outer._apply(msg)
                             outer.received += 1
                         except Exception:
                             outer.decode_errors += 1
@@ -123,9 +140,15 @@ class AggregatorClient:
     def _instance_for(self, mid: bytes) -> int:
         return shard_for(mid, self.num_shards) % len(self.endpoints)
 
-    def send(self, msg: UnaggregatedMessage) -> None:
-        frame = pack_frame(encode_message(msg))
-        idx = self._instance_for(msg.metric.id)
+    def send(self, msg) -> None:
+        if isinstance(msg, AggregatedMessage):
+            # passthrough lane: already-aggregated, shard-routed unchanged
+            frame = pack_frame(encode_aggregated(msg))
+            mid = msg.id
+        else:
+            frame = pack_frame(encode_message(msg))
+            mid = msg.metric.id
+        idx = self._instance_for(mid)
         with self._locks[idx]:
             try:
                 self._sock(idx).sendall(frame)

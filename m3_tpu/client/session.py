@@ -75,7 +75,9 @@ class HostQueue:
     def enqueue(self, pw: _PendingWrite) -> None:
         with self._cv:
             self._buf.append(pw)
-            if len(self._buf) >= self.batch_size:
+            # wake on the FIRST item (arms the flush-interval timer) and on
+            # a full batch; in between the loop sleeps on the interval
+            if len(self._buf) == 1 or len(self._buf) >= self.batch_size:
                 self._cv.notify()
 
     def flush_now(self) -> None:
@@ -86,6 +88,10 @@ class HostQueue:
         while True:
             with self._cv:
                 if not self._buf and not self._stop:
+                    # idle: no timeout — zero wakeups until work arrives
+                    self._cv.wait()
+                if self._buf and len(self._buf) < self.batch_size and not self._stop:
+                    # partial batch: give it one flush interval to fill
                     self._cv.wait(self.flush_interval)
                 if self._stop and not self._buf:
                     return
@@ -197,19 +203,22 @@ class Session:
             q = self._queues[host] = HostQueue(node, self.namespace)
         return q
 
-    def write_batch_tagged(self, entries, timeout: float = 30.0) -> list[bytes]:
-        """Batched tagged writes: every entry fans out to its shard's
-        replicas through per-host queues (one RPC per host per flush, not
-        one per datapoint), then quorum is counted PER ENTRY from the
-        returned per-element errors. ``entries``: (tags, t_nanos, value) or
-        (tags, t_nanos, value, unit). Returns the series ids; raises
-        ConsistencyError if any entry misses its write quorum."""
+    def try_write_batch_tagged(
+        self, entries, timeout: float = 30.0
+    ) -> tuple[list[bytes], list[str | None]]:
+        """Batched tagged writes with PER-ENTRY outcomes: every entry fans
+        out to its shard's replicas through per-host queues (one RPC per
+        host per flush, not one per datapoint), then quorum is counted PER
+        ENTRY from the returned per-element errors. ``entries``:
+        (tags, t_nanos, value) or (tags, t_nanos, value, unit). Returns
+        (series ids, per-entry error-or-None) — entries that achieved
+        quorum are good even when neighbors failed."""
         from ..rules.rules import encode_tags_id
 
         required = self.write_consistency.required(self.topology.replicas)
         sids: list[bytes] = []
+        errs: list[str | None] = []
         pendings: list[list[_PendingWrite]] = []
-        down: list[int] = []
         touched: set[str] = set()
         for e in entries:
             tags, t, v = e[0], e[1], e[2]
@@ -228,26 +237,37 @@ class Session:
                 q.enqueue(pw)
                 per_entry.append(pw)
                 touched.add(host)
-            if len(per_entry) < required:
-                down.append(len(sids) - 1)
+            errs.append(
+                None if len(per_entry) >= required
+                else f"replicas down ({len(per_entry)}/{required})"
+            )
             pendings.append(per_entry)
         for host in touched:
             self._queues[host].flush_now()
-        failed = list(down)
         for i, per_entry in enumerate(pendings):
-            if i in down:
+            if errs[i] is not None:
                 continue
             ok = 0
+            last_err = None
             for pw in per_entry:
                 pw.event.wait(timeout)
                 if pw.event.is_set() and pw.error is None:
                     ok += 1
+                else:
+                    last_err = pw.error or "timeout"
             if ok < required:
-                failed.append(i)
+                errs[i] = f"quorum {ok}/{required}: {last_err}"
+        return sids, errs
+
+    def write_batch_tagged(self, entries, timeout: float = 30.0) -> list[bytes]:
+        """try_write_batch_tagged, raising ConsistencyError if ANY entry
+        missed its write quorum (single-write call-site semantics)."""
+        sids, errs = self.try_write_batch_tagged(entries, timeout=timeout)
+        failed = [i for i, e in enumerate(errs) if e is not None]
         if failed:
             raise ConsistencyError(
                 "write_batch", len(entries) - len(failed), len(entries),
-                [f"{len(failed)} entries under quorum (first idx {failed[0]})"],
+                [f"{len(failed)} entries under quorum (first: {errs[failed[0]]})"],
             )
         return sids
 

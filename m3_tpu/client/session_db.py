@@ -122,12 +122,12 @@ class SessionDatabase:
         quorum failures surface as ConsistencyError strings, matching the
         storage Database's per-entry error contract."""
         try:
-            self._session(ns).write_batch_tagged(
+            _, errs = self._session(ns).try_write_batch_tagged(
                 [(tags, t, v, unit) for tags, t, v, unit in entries]
             )
-        except Exception as exc:
+            return errs
+        except Exception as exc:  # transport/topology failure: all entries
             return [f"{type(exc).__name__}: {exc}"] * len(entries)
-        return [None] * len(entries)
 
     def read(self, ns, sid, start, end):
         return self._session(ns).fetch(sid, start, end)

@@ -37,6 +37,25 @@ class _SnapRec(ctypes.Structure):
     ]
 
 
+def _cpu_signature() -> str:
+    """Identity of this host's ISA (for -march=native cache safety): a
+    library built on a wider-ISA host would SIGILL here, so the cached .so
+    is only trusted when the CPU flags that produced it match."""
+    import hashlib
+    import platform
+
+    sig = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    sig += hashlib.sha256(line.encode()).hexdigest()[:16]
+                    break
+    except OSError:
+        pass
+    return sig
+
+
 def _build() -> bool:
     if not os.path.exists(_SRC_PATH):
         return False
@@ -45,7 +64,7 @@ def _build() -> bool:
             [
                 "g++",
                 "-O3",
-                "-march=native",  # built on the host it runs on (lazy build)
+                "-march=native",  # cached per-CPU-signature (see load())
                 "-shared",
                 "-fPIC",
                 "-std=c++17",
@@ -57,8 +76,10 @@ def _build() -> bool:
             check=True,
             capture_output=True,
         )
+        with open(_LIB_PATH + ".buildinfo", "w") as f:
+            f.write(_cpu_signature())
         return True
-    except (subprocess.CalledProcessError, FileNotFoundError):
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError):
         return False
 
 
@@ -72,6 +93,15 @@ def load():
         and os.path.exists(_SRC_PATH)
         and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)
     )
+    if os.path.exists(_LIB_PATH) and not stale:
+        # a -march=native .so copied from a wider-ISA host would SIGILL
+        # (uncatchably) on first call: rebuild unless the recorded CPU
+        # signature matches this host
+        try:
+            with open(_LIB_PATH + ".buildinfo") as f:
+                stale = f.read() != _cpu_signature()
+        except OSError:
+            stale = True
     if (not os.path.exists(_LIB_PATH) or stale) and not _build():
         return None
     try:
@@ -413,3 +443,49 @@ def decode_batch(
         for i in range(n)
     ]
     return (triples, flags) if with_flags else triples
+
+
+def encode_one(
+    times: np.ndarray,
+    values: np.ndarray,
+    units: np.ndarray | None = None,
+    default_unit: int = 1,
+    int_optimized: bool = True,
+) -> bytes | None:
+    """Encode ONE series with optional per-point units via the native
+    encoder (m3tsz_encode_series); None when the lib is unavailable (the
+    caller uses the Python reference encoder). The buffer-bucket merge
+    path (storage/series.py) is the hot consumer."""
+    lib = load()
+    if lib is None:
+        return None
+    times = np.ascontiguousarray(times, np.int64)
+    values = np.ascontiguousarray(values, np.float64)
+    n = len(times)
+    if n == 0:
+        return b""
+    u_ptr = None
+    if units is not None:
+        units = np.ascontiguousarray(units, np.int32)
+        u_ptr = units.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    cap = n * 16 + 1024
+    for _ in range(2):
+        out = np.zeros(cap, np.uint8)
+        r = int(
+            lib.m3tsz_encode_series(
+                times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                ctypes.c_int32(n),
+                ctypes.c_int(default_unit),
+                u_ptr,
+                ctypes.c_int(1 if int_optimized else 0),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(cap),
+            )
+        )
+        if r >= 0:
+            return out[:r].tobytes()
+        if r == -1:
+            return None  # encode error: let the python path raise properly
+        cap = -r
+    return None

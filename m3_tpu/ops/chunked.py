@@ -327,6 +327,41 @@ def tile_chunked(batch: ChunkedBatch, n_series: int) -> ChunkedBatch:
     )
 
 
+def pad_series(batch: ChunkedBatch, multiple: int) -> ChunkedBatch:
+    """Pad with EMPTY series (zero-bit lanes decode zero records) so the
+    series count divides a mesh size — the query fan-out's matched count is
+    arbitrary, the mesh's shard axis is not. Empty lanes match
+    build_chunked's padding exactly (all-zero state, fast=True) so they
+    route through the fast kernel body and contribute nothing."""
+    pad = (-batch.num_series) % multiple
+    if pad == 0:
+        return batch
+    n_new = pad * batch.num_chunks
+
+    def t(x):
+        x = np.asarray(x)
+        z = np.zeros((n_new,) + x.shape[1:], x.dtype)
+        return np.concatenate([x, z])
+
+    kw = lane_kwargs(batch, transform=t)
+    return ChunkedBatch(
+        **kw,
+        k=batch.k,
+        num_series=batch.num_series + pad,
+        num_chunks=batch.num_chunks,
+        fast=(
+            np.concatenate([np.asarray(batch.fast), np.ones(n_new, bool)])
+            if batch.fast is not None
+            else None
+        ),
+        fast_float=(
+            np.concatenate([np.asarray(batch.fast_float), np.ones(n_new, bool)])
+            if batch.fast_float is not None
+            else None
+        ),
+    )
+
+
 def select_series(batch: ChunkedBatch, series_idx) -> ChunkedBatch:
     """Query-fanout gather: a new ChunkedBatch holding only the selected
     series (index query postings → decode, the config-5 fan-out shape).

@@ -139,8 +139,14 @@ def stream_aggregate(
 
     def drain_one():
         agg = inflight.popleft()
-        jax.block_until_ready(agg)
         totals.fold(agg)
+        # HARD sync via a scalar device→host fetch: on tunneled transports
+        # block_until_ready can return early for some shapes, which lets
+        # the producer loop run arbitrarily far ahead and buffer every
+        # pending upload in host RAM (observed: ~60GB for an unbounded
+        # 80-batch stream). A 4-byte fetch is ordered after the batch's
+        # compute, so it bounds in-flight batches for real.
+        np.asarray(agg.total_count)
         if drain_times is not None:
             drain_times.append(_time.perf_counter())
 
@@ -153,8 +159,11 @@ def stream_aggregate(
         # transfer path catastrophically on tunneled devices (measured 0.2s
         # -> ~20s per batch), and the kernel (~ms) is far cheaper than the
         # upload anyway — cross-batch overlap still comes from the inflight
-        # window below
+        # window below. The 1-element fetch is a real barrier (transfers
+        # execute in order per device; see drain_one on why
+        # block_until_ready alone is not)
         jax.block_until_ready((dev_w, dev_l, dev_f))
+        np.asarray(dev_f.ravel()[:1])
         fn = _jitted(n, s, c, k, order)
         inflight.append(fn(dev_w, dev_l, dev_f))
         if len(inflight) > prefetch:

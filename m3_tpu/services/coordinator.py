@@ -145,9 +145,14 @@ class Coordinator:
         if batch:
             if hasattr(self.db, "write_tagged_batch"):
                 errs = self.db.write_tagged_batch(self.namespace, batch)
-                bad = next((e for e in errs if e), None)
-                if bad is not None:
-                    raise RuntimeError(f"remote write partial failure: {bad}")
+                failed = [e for e in errs if e]
+                if failed:
+                    # entries that reached quorum stay written; the client
+                    # retry re-upserts them idempotently
+                    raise RuntimeError(
+                        f"remote write partial failure: {len(failed)}/{len(errs)} "
+                        f"samples (first: {failed[0]})"
+                    )
             else:
                 for tags, t_nanos, v, unit in batch:
                     self.db.write_tagged(self.namespace, tags, t_nanos, v)

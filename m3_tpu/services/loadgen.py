@@ -1,15 +1,26 @@
 """Load generator: sustained synthetic write/query load against a node or
-coordinator.
+coordinator — single-process, or DISTRIBUTED as an m3nsch-role
+coordinator + agents.
 
-Reference: /root/reference/src/m3nsch/ (+ m3comparator) — the load tier
-drives configurable concurrent write workloads with unique series cardinality
-and reports achieved rates. Run:
+Reference: /root/reference/src/m3nsch/ — a gRPC coordinator splits the
+workload across agent processes, each driving its own share; achieved
+rates aggregate centrally. Here the same roles ride the framework's framed
+RPC. Run:
+
+single process:
 
     python -m m3_tpu.services.loadgen --node 127.0.0.1:9000 \
         --series 10000 --rate 5000 --duration 10
 
-or against a coordinator's JSON write API with --coordinator host:port.
-Prints one JSON line of achieved stats at the end.
+distributed (one agent per host, then a coordinator invocation):
+
+    python -m m3_tpu.services.loadgen --listen 0          # x N agents
+    python -m m3_tpu.services.loadgen --agents h1:p,h2:p,h3:p \
+        --node 127.0.0.1:9000 --rate 600000 --duration 10
+
+The coordinator splits rate + DISJOINT series ranges across agents,
+polls them, and prints the aggregated stats line. Prints one JSON line of
+achieved stats at the end.
 """
 
 from __future__ import annotations
@@ -40,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch", type=int, default=100, help="writes per RPC batch")
     p.add_argument("--read-fraction", type=float, default=0.0,
                    help="fraction of ops that are reads of a random series")
+    p.add_argument("--series-offset", type=int, default=0,
+                   help="first series index (agents get disjoint ranges)")
+    p.add_argument("--listen", type=int, default=None,
+                   help="AGENT mode: serve the loadgen RPC on this port (0=auto)")
+    p.add_argument("--agents", default="",
+                   help="COORDINATOR mode: comma-separated agent host:port list")
     return p
 
 
@@ -69,8 +86,9 @@ def run(args, make_client) -> dict:
         while time.monotonic() < stop:
             batch = []
             now_nanos = time.time_ns()
+            off = getattr(args, "series_offset", 0)
             for i in range(args.batch):
-                sid = f"load.series.{(rnd + i) % args.series}".encode()
+                sid = f"load.series.{off + (rnd + i) % args.series}".encode()
                 batch.append((sid, now_nanos + i, float(i)))
             rnd = (rnd + args.batch) % args.series
             try:
@@ -107,8 +125,8 @@ def run(args, make_client) -> dict:
     }
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+def make_client_factory(args):
+    """Target client factory from args, or None if no target given."""
     if args.aggregator:
         from ..aggregator.server import AggregatorClient
         from ..metrics.encoding import UnaggregatedMessage
@@ -178,7 +196,133 @@ def main(argv=None) -> int:
             return HttpClient()
 
     else:
-        print("loadgen: need --node or --coordinator", file=sys.stderr)
+        return None
+    return make_client
+
+
+class LoadgenAgentService:
+    """Agent side of the m3nsch split: lg_start launches a run with the
+    coordinator-supplied workload slice; lg_poll reports progress/result."""
+
+    def __init__(self) -> None:
+        self._runs: dict[int, dict] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def handle(self, req: dict):
+        op = req.get("op")
+        if op == "health":
+            return {"role": "loadgen-agent"}
+        if op == "lg_start":
+            ns = argparse.Namespace(**req["args"])
+            make_client = make_client_factory(ns)
+            if make_client is None:
+                raise ValueError("agent: no target in args")
+            with self._lock:
+                token = self._next
+                self._next += 1
+                rec = self._runs[token] = {"done": False, "result": None}
+
+            def _go():
+                try:
+                    rec["result"] = run(ns, make_client)
+                except Exception as exc:
+                    rec["result"] = {"error": f"{type(exc).__name__}: {exc}"}
+                rec["done"] = True
+
+            threading.Thread(target=_go, daemon=True).start()
+            return token
+        if op == "lg_poll":
+            rec = self._runs.get(req["token"])
+            if rec is None:
+                raise KeyError(f"no run {req['token']}")
+            return {"done": rec["done"], "result": rec["result"]}
+        raise ValueError(f"unknown op {op!r}")
+
+
+def _run_agent(args) -> int:
+    import signal
+
+    from ..net.server import RpcServer
+
+    server = RpcServer(LoadgenAgentService(), port=args.listen)
+
+    def shutdown(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    print(f"LISTENING {server.host} {server.port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+def _run_coordinator(args) -> int:
+    """m3nsch coordinator: split rate + disjoint series ranges across
+    agents, start them all, poll to completion, aggregate."""
+    from ..net.client import RpcClient
+
+    endpoints = [e.strip() for e in args.agents.split(",") if e.strip()]
+    n = len(endpoints)
+    clients = [RpcClient.connect(ep) for ep in endpoints]
+    per_series = max(args.series // n, 1)
+    tokens = []
+    for i, c in enumerate(clients):
+        sub = dict(
+            vars(args),
+            agents="",
+            listen=None,
+            rate=args.rate / n,
+            series=per_series,
+            series_offset=args.series_offset + i * per_series,
+        )
+        tokens.append(c._call("lg_start", args=sub))
+    agg = {"writes": 0, "reads": 0, "errors": 0, "elapsed_secs": 0.0}
+    per_agent = []
+    deadline = time.monotonic() + args.duration + 60
+    pending = set(range(n))
+    while pending and time.monotonic() < deadline:
+        time.sleep(0.3)
+        for i in sorted(pending):
+            st = clients[i]._call("lg_poll", token=tokens[i])
+            if st["done"]:
+                pending.discard(i)
+                r = st["result"] or {}
+                per_agent.append(r)
+                if "error" in r:
+                    agg["errors"] += 1
+                    continue
+                agg["writes"] += r["writes"]
+                agg["reads"] += r["reads"]
+                agg["errors"] += r["errors"]
+                agg["elapsed_secs"] = max(agg["elapsed_secs"], r["elapsed_secs"])
+    for c in clients:
+        c.close()
+    if pending:
+        agg["errors"] += len(pending)
+    elapsed = agg["elapsed_secs"] or 1.0
+    out = {
+        **agg,
+        "achieved_writes_per_sec": round(agg["writes"] / elapsed, 1),
+        "target_writes_per_sec": args.rate,
+        "series": args.series,
+        "agents": n,
+        "per_agent_writes_per_sec": [
+            r.get("achieved_writes_per_sec") for r in per_agent
+        ],
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.listen is not None:
+        return _run_agent(args)
+    if args.agents:
+        return _run_coordinator(args)
+    make_client = make_client_factory(args)
+    if make_client is None:
+        print("loadgen: need --node, --coordinator or --aggregator", file=sys.stderr)
         return 2
     print(json.dumps(run(args, make_client)), flush=True)
     return 0

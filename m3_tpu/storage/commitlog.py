@@ -151,8 +151,11 @@ class CommitLog:
 
     def write_batch(self, entries: list[CommitLogEntry]) -> None:
         if self.write_behind:
-            for e in entries:
-                self.write(e)
+            # ONE queue command for the whole batch: per-entry queue puts
+            # were ~6µs each and dominated batched ingest
+            if not self._enqueue(("batch", entries)):
+                self._check_failed()
+                raise ValueError("commit log is closed")
         else:
             with self._wlock:
                 if self._closed:
@@ -261,6 +264,12 @@ class CommitLog:
             kind = cmd[0]
             if kind == "entry":
                 self._append(cmd[1])
+                if self._pending >= self.flush_every:
+                    self._fsync()
+                    last_fsync = time.monotonic()
+            elif kind == "batch":
+                for e in cmd[1]:
+                    self._append(e)
                 if self._pending >= self.flush_every:
                     self._fsync()
                     last_fsync = time.monotonic()

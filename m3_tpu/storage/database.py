@@ -269,6 +269,7 @@ class Namespace:
         self.opts = opts
         self.num_shards = num_shards
         self.shards = [Shard(i, name, opts, base) for i in range(num_shards)]
+        self._shard_cache: dict[bytes, Shard] = {}
         self.index = None
         if opts.index_enabled:
             from ..index.ns_index import NamespaceIndex
@@ -276,7 +277,15 @@ class Namespace:
             self.index = NamespaceIndex(opts.block_size_nanos, opts.retention_nanos)
 
     def shard_for(self, sid: bytes) -> Shard:
-        return self.shards[shard_for(sid, self.num_shards)]
+        # memoized: the pure-python murmur3 costs ~4µs/id, dominating
+        # batched ingest; the mapping is pure so a cache is exact. Bounded
+        # by a crude clear (entries are one dict slot per active series)
+        sh = self._shard_cache.get(sid)
+        if sh is None:
+            if len(self._shard_cache) > 4_000_000:
+                self._shard_cache.clear()
+            sh = self._shard_cache[sid] = self.shards[shard_for(sid, self.num_shards)]
+        return sh
 
 
 class Database:
@@ -343,25 +352,62 @@ class Database:
         self._m_writes.inc()
 
     def write_batch(self, ns: str, entries: list[tuple[bytes, int, float]]) -> None:
+        """Batched ingest, flattened to one tight loop per shard: entries
+        group by shard (one lock acquisition each), then append directly
+        into the raw-column buffer buckets — the per-entry method chain
+        (Shard.write → SeriesBuffer.write → BufferBucket.write) cost ~12µs
+        per datapoint and capped node ingest at ~80k writes/s/core. If an
+        entry is rejected midway (a flush can seal a block between
+        entries), everything ALREADY applied is still WAL-logged before
+        the error propagates, so no applied write is ever unlogged."""
+        from .series import BufferBucket, SeriesBuffer
+
         namespace = self.namespaces[ns]
         cl = self._commitlogs.get(ns)
-        # apply + log per entry; if an entry is rejected midway (a flush can
-        # seal a block between entries), everything ALREADY applied is still
-        # WAL-logged before the error propagates, so no applied write is
-        # ever unlogged
+        limit_on = self._new_series_limit > 0
+        unit_s = int(Unit.SECOND)
+        by_shard: dict[int, tuple] = {}
+        ns_shard_for = namespace.shard_for
+        for e in entries:
+            sh = ns_shard_for(e[0])
+            rec = by_shard.get(sh.id)
+            if rec is None:
+                rec = by_shard[sh.id] = (sh, [])
+            rec[1].append(e)
         applied: list[CommitLogEntry] = []
         try:
-            for sid, t, v in entries:
-                shard = namespace.shard_for(sid)
-                with shard.lock:
-                    with self._limit_lock:
-                        is_new = self._check_new_series(shard, sid)
-                    shard.write(sid, t, v)
-                    if is_new and self._new_series_limit > 0:
-                        with self._limit_lock:
-                            self._consume_new_series()
-                    applied.append(CommitLogEntry(sid, t, v))
-                self._m_writes.inc()
+            for sh, items in by_shard.values():
+                bsz = sh.opts.block_size_nanos
+                cold_ok = sh.opts.cold_writes_enabled
+                flushed = sh._flushed_blocks
+                with sh.lock:
+                    series = sh.series
+                    for sid, t, v in items:
+                        bs = (t // bsz) * bsz
+                        if bs in flushed and not cold_ok:
+                            raise ColdWriteError(
+                                f"write at {t} targets flushed block {bs} and "
+                                f"namespace {sh.namespace} has cold writes disabled"
+                            )
+                        buf = series.get(sid)
+                        if buf is None:
+                            if limit_on:
+                                with self._limit_lock:
+                                    self._check_new_series(sh, sid)
+                                    self._consume_new_series()
+                            buf = series[sid] = SeriesBuffer(sid, bsz)
+                        bucket = buf.buckets.get(bs)
+                        if bucket is None:
+                            bucket = buf.buckets[bs] = BufferBucket(block_start=bs)
+                        bucket.times.append(t)
+                        bucket.values.append(v)
+                        bucket.units.append(unit_s)
+                        if t > bucket.last_write_nanos:
+                            bucket.last_write_nanos = t
+                        bucket.num_writes += 1
+                        bucket._stream_cache = None
+                        applied.append(CommitLogEntry(sid, t, v))
+            self._m_writes.inc(len(applied))
         finally:
             if cl is not None and applied:
                 cl.write_batch(applied)

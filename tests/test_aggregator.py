@@ -175,3 +175,80 @@ def test_follower_does_not_emit():
     assert not agg.is_leader
     agg.add_timed(b"m", MetricType.COUNTER, t0, 1.0)
     assert agg.flush(t0 + 60 * NANOS) == []
+
+
+def test_add_passthrough_direct_emit():
+    """AddPassthrough (aggregator.go:267): already-aggregated metrics are
+    written straight through — no windowing, no re-aggregation."""
+    from m3_tpu.aggregator.aggregator import Aggregator
+    from m3_tpu.metrics.policy import StoragePolicy
+    from m3_tpu.metrics.types import AggregationType
+
+    got = []
+    agg = Aggregator(num_shards=2, flush_handler=got.extend)
+    pol = StoragePolicy.parse("1m:40d")
+    agg.add_passthrough(b"svc.p99", 1_700_000_000 * 10**9, 123.0, pol,
+                        AggregationType.P99)
+    assert len(got) == 1
+    m = got[0]
+    assert (m.id, m.value, m.policy, m.agg_type) == (
+        b"svc.p99", 123.0, pol, AggregationType.P99
+    )
+    assert agg.passthrough_count == 1
+    # no buffered state: a flush emits nothing extra
+    assert agg.flush(2_000_000_000 * 10**9) == []
+
+
+def test_add_passthrough_follower_noop():
+    from m3_tpu.aggregator.aggregator import Aggregator
+    from m3_tpu.aggregator.election import ElectionManager, FlushTimesStore
+    from m3_tpu.cluster.kv import KVStore
+    from m3_tpu.metrics.policy import StoragePolicy
+
+    kv = KVStore()
+    got_a, got_b = [], []
+    a = Aggregator(num_shards=2, flush_handler=got_a.extend,
+                   election=ElectionManager(kv, "pt", "a"),
+                   flush_times=FlushTimesStore(kv, "pt"))
+    b = Aggregator(num_shards=2, flush_handler=got_b.extend,
+                   election=ElectionManager(kv, "pt", "b"),
+                   flush_times=FlushTimesStore(kv, "pt"))
+    t = 1_700_000_000 * 10**9
+    a.flush(t)  # a campaigns first -> leader
+    b.flush(t)
+    pol = StoragePolicy.parse("1m:40d")
+    for agg in (a, b):  # mirrored ingest
+        agg.add_passthrough(b"m.p50", t, 1.0, pol)
+    assert len(got_a) == 1 and len(got_b) == 0  # leader emits exactly once
+    assert b.passthrough_follower_noops == 1
+
+
+def test_passthrough_over_rawtcp_socket():
+    """The rawtcp ingress dispatches KIND_AGGREGATED payloads to the
+    passthrough lane."""
+    import time
+
+    from m3_tpu.aggregator.aggregator import Aggregator
+    from m3_tpu.aggregator.server import AggregatorClient, AggregatorIngestServer
+    from m3_tpu.metrics.encoding import AggregatedMessage
+    from m3_tpu.metrics.policy import StoragePolicy
+    from m3_tpu.metrics.types import AggregationType
+
+    got = []
+    agg = Aggregator(num_shards=4, flush_handler=got.extend)
+    server = AggregatorIngestServer(agg)
+    server.start()
+    try:
+        client = AggregatorClient([(server.host, server.port)])
+        pol = StoragePolicy.parse("10s:2d")
+        client.send(
+            AggregatedMessage(b"pre.agg", 1_700_000_000 * 10**9, 7.5, pol,
+                              AggregationType.MAX)
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.01)
+        assert got and got[0].id == b"pre.agg" and got[0].value == 7.5
+        client.close()
+    finally:
+        server.stop()
