@@ -218,6 +218,9 @@ class Aggregator:
         self._last_leader = election is None
         self.passthrough_count = 0
         self.passthrough_follower_noops = 0
+        # undelivered passthrough metrics (no follower mirror: retried at
+        # every flush regardless of leadership)
+        self._pending_passthrough: list[AggregatedMetric] = []
 
     def shard_for(self, mid: bytes) -> int:
         return shard_for(mid, self.num_shards)
@@ -292,12 +295,14 @@ class Aggregator:
             try:
                 self.flush_handler([m])
             except Exception:
-                # transient downstream outage: ride the same retry lane as
-                # flushed output (_pending_emit, re-delivered next flush)
-                # instead of losing the metric or surfacing as a decode
-                # error at the ingress
+                # transient downstream outage: park for retry at the next
+                # flush. A DEDICATED queue, not _pending_emit — windowed
+                # pending is dropped on leadership loss (the new leader
+                # re-emits from its mirror), but followers no-op'd this
+                # passthrough metric, so NO replica holds it: it must
+                # retry here regardless of leadership (at-least-once)
                 with self._lock:
-                    self._pending_emit.append(m)
+                    self._pending_passthrough.append(m)
         self.passthrough_count += 1
 
     @property
@@ -324,23 +329,27 @@ class Aggregator:
         # followers keep their mirror of these windows and a takeover
         # re-emits them instead of losing them. Standalone (no followers),
         # undelivered aggregates stay in _pending_emit and retry next flush.
-        # _pending_emit handoff under the lock: the passthrough lane
-        # (add_passthrough, ingest threads) appends to it concurrently
+        # pending handoffs under the lock (ingest threads append
+        # passthrough retries concurrently)
         with self._lock:
             pending, self._pending_emit = self._pending_emit, []
+            pt_pending, self._pending_passthrough = self._pending_passthrough, []
         if not leader and pending:
-            # leadership lost with undelivered output: the flush times for
-            # those windows never advanced, so the NEW leader re-emits them
-            # from its mirror — retrying here would double-deliver
+            # leadership lost with undelivered WINDOWED output: the flush
+            # times for those windows never advanced, so the NEW leader
+            # re-emits them from its mirror — retrying here would
+            # double-deliver. (Passthrough retries are NOT dropped: no
+            # replica mirrors them.)
             self.dropped_pending += len(pending)
             pending = []
-        if self.flush_handler and (out or pending):
-            to_send = pending + out
+        if self.flush_handler and (out or pending or pt_pending):
+            to_send = pt_pending + pending + out
             try:
                 self.flush_handler(to_send)
             except Exception:
                 with self._lock:
-                    self._pending_emit = to_send + self._pending_emit
+                    self._pending_passthrough = pt_pending + self._pending_passthrough
+                    self._pending_emit = pending + out + self._pending_emit
                 raise
         if leader and self.flush_times is not None and flushed_boundaries:
             from ..cluster.kv import FenceError

@@ -66,6 +66,8 @@ class HostQueue:
         self._buf: list[_PendingWrite] = []
         self._cv = threading.Condition()
         self._stop = False
+        self._flush_req = False  # flush_now() latch: a bare notify is lost
+        # when the worker isn't parked in a wait
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"host-queue-{getattr(node, 'id', '?')}",
@@ -82,6 +84,7 @@ class HostQueue:
 
     def flush_now(self) -> None:
         with self._cv:
+            self._flush_req = True
             self._cv.notify()
 
     def _loop(self) -> None:
@@ -90,12 +93,18 @@ class HostQueue:
                 if not self._buf and not self._stop:
                     # idle: no timeout — zero wakeups until work arrives
                     self._cv.wait()
-                if self._buf and len(self._buf) < self.batch_size and not self._stop:
+                if (
+                    self._buf
+                    and len(self._buf) < self.batch_size
+                    and not self._stop
+                    and not self._flush_req
+                ):
                     # partial batch: give it one flush interval to fill
                     self._cv.wait(self.flush_interval)
                 if self._stop and not self._buf:
                     return
                 batch, self._buf = self._buf, []
+                self._flush_req = False
             if batch:
                 self._flush(batch)
 

@@ -116,6 +116,7 @@ def load():
     lib.m3agg_count.restype = ctypes.c_int32
     lib.m3agg_pack.restype = None
     lib.m3tsz_decode_batch.restype = ctypes.c_int32
+    lib.m3hash_shards.restype = None
     _lib = lib
     return lib
 
@@ -489,3 +490,27 @@ def encode_one(
             return None  # encode error: let the python path raise properly
         cap = -r
     return None
+
+
+def shard_batch(ids: list[bytes], num_shards: int) -> "np.ndarray | None":
+    """murmur3-32 shard routing for a batch of series ids in one native
+    call (sharding/shardset.go DefaultHashFn; parity with utils/hash.py).
+    None when the lib is unavailable (callers hash per-id in Python)."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(ids)
+    blob = b"".join(ids)
+    offsets = np.zeros(n + 1, np.int64)
+    for i, s in enumerate(ids):
+        offsets[i + 1] = offsets[i] + len(s)
+    arr = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    out = np.empty(n, np.int32)
+    lib.m3hash_shards(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int32(n),
+        ctypes.c_int32(num_shards),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

@@ -75,6 +75,14 @@ class Matcher:
 
         def on_ruleset(vv) -> None:
             rs = vv.value
+            if isinstance(rs, dict):
+                # networked KV delivers the wire-safe dict form (r2.py)
+                from .r2 import ruleset_from_dict
+
+                try:
+                    rs = ruleset_from_dict(rs)
+                except (KeyError, ValueError, TypeError):
+                    return
             if not isinstance(rs, RuleSet):
                 return
             with self._lock:

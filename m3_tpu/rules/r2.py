@@ -127,7 +127,12 @@ class RuleStore:
 
     def get(self, namespace: str) -> RuleSet | None:
         vv = self.kv.get(ruleset_key(namespace))
-        return vv.value if vv is not None else None
+        if vv is None:
+            return None
+        # rulesets are stored as WIRE-SAFE dicts (a Python RuleSet object
+        # cannot cross the networked KV); in-process writers may still have
+        # stored the object form
+        return ruleset_from_dict(vv.value) if isinstance(vv.value, dict) else vv.value
 
     def _edit_namespaces(self, fn) -> None:
         while True:
@@ -148,9 +153,17 @@ class RuleStore:
         key = ruleset_key(namespace)
         while True:
             vv = self.kv.get(key)
-            rs.version = (vv.value.version + 1) if vv is not None else 1
+            if vv is None:
+                cur_ver = 0
+            elif isinstance(vv.value, dict):
+                cur_ver = int(vv.value.get("version", 0))
+            else:
+                cur_ver = vv.value.version
+            rs.version = cur_ver + 1
             try:
-                self.kv.check_and_set(key, vv.version if vv is not None else 0, rs)
+                self.kv.check_and_set(
+                    key, vv.version if vv is not None else 0, ruleset_to_dict(rs)
+                )
                 break
             except ValueError:
                 continue

@@ -283,7 +283,15 @@ def _run_coordinator(args) -> int:
     while pending and time.monotonic() < deadline:
         time.sleep(0.3)
         for i in sorted(pending):
-            st = clients[i]._call("lg_poll", token=tokens[i])
+            try:
+                st = clients[i]._call("lg_poll", token=tokens[i])
+            except Exception as exc:
+                # agent died mid-run: count it and keep aggregating the
+                # survivors instead of crashing the coordinator
+                pending.discard(i)
+                per_agent.append({"error": f"agent unreachable: {exc}"})
+                agg["errors"] += 1
+                continue
             if st["done"]:
                 pending.discard(i)
                 r = st["result"] or {}

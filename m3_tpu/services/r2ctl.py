@@ -1,0 +1,177 @@
+"""r2ctl: standalone rule-management service (+ minimal operational UI).
+
+Reference: /root/reference/src/ctl/ — the r2 REST service
+(ctl/service/r2/, routes over namespaces + mapping/rollup rules) behind
+the r2ctl UI. Here the same CRUD rides the framework's KV-backed RuleStore
+(rules/r2.py) against a kvnode (or quorum) endpoint, so edits propagate to
+every matcher watcher cluster-wide; "/" serves a small HTML view of every
+namespace's ruleset (the operational-UI role — rule browsing without
+tooling). Run:
+
+    python -m m3_tpu.services.r2ctl --kv-endpoint 127.0.0.1:2379 --port 7201
+
+Endpoints:
+    GET    /                      HTML ruleset browser
+    GET    /health
+    GET    /api/v1/rules          all namespaces + rulesets
+    GET    /api/v1/rules/{ns}     one ruleset
+    POST   /api/v1/rules/{ns}     replace ruleset (JSON, bumps version)
+    DELETE /api/v1/rules/{ns}     drop ruleset
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import re
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..rules.r2 import RuleStore, ruleset_from_dict, ruleset_to_dict
+
+
+def make_server(kv, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServer:
+    store = RuleStore(kv)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, obj, code: int = 200) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _html(self, body: str, code: int = 200) -> None:
+            raw = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n)
+
+        def do_GET(self):
+            try:
+                if self.path == "/health":
+                    self._json({"ok": True, "role": "r2ctl"})
+                elif self.path == "/":
+                    self._html(_render_index(store))
+                elif self.path == "/api/v1/rules":
+                    self._json(
+                        {
+                            "namespaces": store.namespaces(),
+                            "rulesets": {
+                                ns: ruleset_to_dict(rs)
+                                for ns in store.namespaces()
+                                if (rs := store.get(ns)) is not None
+                            },
+                        }
+                    )
+                elif (m := re.match(r"^/api/v1/rules/([^/]+)$", self.path)):
+                    rs = store.get(m.group(1))
+                    if rs is None:
+                        self._json({"error": "not found"}, 404)
+                    else:
+                        self._json(ruleset_to_dict(rs))
+                else:
+                    self._json({"error": "not found"}, 404)
+            except Exception as exc:
+                self._json({"error": str(exc)}, 500)
+
+        def do_POST(self):
+            try:
+                if (m := re.match(r"^/api/v1/rules/([^/]+)$", self.path)):
+                    rs = ruleset_from_dict(json.loads(self._body()))
+                    store.set(m.group(1), rs)
+                    self._json({"namespace": m.group(1), "version": rs.version})
+                else:
+                    self._json({"error": "not found"}, 404)
+            except Exception as exc:
+                self._json({"error": str(exc)}, 400)
+
+        def do_DELETE(self):
+            try:
+                if (m := re.match(r"^/api/v1/rules/([^/]+)$", self.path)):
+                    if store.delete(m.group(1)):
+                        self._json({"deleted": m.group(1)})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                else:
+                    self._json({"error": "not found"}, 404)
+            except Exception as exc:
+                self._json({"error": str(exc)}, 400)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def _render_index(store: RuleStore) -> str:
+    rows = []
+    for ns in store.namespaces():
+        rs = store.get(ns)
+        if rs is None:
+            continue
+        d = ruleset_to_dict(rs)
+        rules = []
+        for r in d.get("mappingRules", []):
+            target = "drop" if r.get("drop") else ", ".join(r["policies"])
+            rules.append(
+                f"<li><b>map</b> {html.escape(r['name'])} — filter "
+                f"<code>{html.escape(r['filter'])}</code> → {html.escape(target)}</li>"
+            )
+        for r in d.get("rollupRules", []):
+            tgt = "; ".join(
+                html.escape(t.get("newName", "")) for t in r.get("targets", [])
+            )
+            rules.append(
+                f"<li><b>rollup</b> {html.escape(r['name'])} — filter "
+                f"<code>{html.escape(r['filter'])}</code> → {tgt}</li>"
+            )
+        rows.append(
+            f"<h2>{html.escape(ns)} <small>v{d.get('version')}</small></h2>"
+            f"<ul>{''.join(rules) or '<li><i>no rules</i></li>'}</ul>"
+        )
+    return (
+        "<!doctype html><title>r2ctl — rulesets</title>"
+        "<h1>r2ctl: metric rulesets</h1>"
+        + ("".join(rows) or "<p><i>no namespaces</i></p>")
+        + "<p>API: GET/POST/DELETE /api/v1/rules/{namespace}</p>"
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="m3tpu-r2ctl", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--kv-endpoint", required=True,
+                   help="kvnode host:port (or comma-separated quorum)")
+    args = p.parse_args(argv)
+
+    from ..cluster.kv_service import RemoteKVStore
+
+    kv = RemoteKVStore.connect(args.kv_endpoint)
+    server = make_server(kv, host=args.host, port=args.port)
+
+    def shutdown(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    host, port = server.server_address
+    print(f"LISTENING {host} {port}", flush=True)
+    server.serve_forever()
+    kv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -269,7 +269,6 @@ class Namespace:
         self.opts = opts
         self.num_shards = num_shards
         self.shards = [Shard(i, name, opts, base) for i in range(num_shards)]
-        self._shard_cache: dict[bytes, Shard] = {}
         self.index = None
         if opts.index_enabled:
             from ..index.ns_index import NamespaceIndex
@@ -277,15 +276,7 @@ class Namespace:
             self.index = NamespaceIndex(opts.block_size_nanos, opts.retention_nanos)
 
     def shard_for(self, sid: bytes) -> Shard:
-        # memoized: the pure-python murmur3 costs ~4µs/id, dominating
-        # batched ingest; the mapping is pure so a cache is exact. Bounded
-        # by a crude clear (entries are one dict slot per active series)
-        sh = self._shard_cache.get(sid)
-        if sh is None:
-            if len(self._shard_cache) > 4_000_000:
-                self._shard_cache.clear()
-            sh = self._shard_cache[sid] = self.shards[shard_for(sid, self.num_shards)]
-        return sh
+        return self.shards[shard_for(sid, self.num_shards)]
 
 
 class Database:
@@ -366,14 +357,28 @@ class Database:
         cl = self._commitlogs.get(ns)
         limit_on = self._new_series_limit > 0
         unit_s = int(Unit.SECOND)
+        # shard routing for the whole batch in ONE native murmur3 call
+        # (the pure-python hash costs ~4µs/id; exact parity tested) —
+        # python per-id fallback without the lib
+        from .. import native
+
+        shard_ids = native.shard_batch([e[0] for e in entries], namespace.num_shards)
         by_shard: dict[int, tuple] = {}
-        ns_shard_for = namespace.shard_for
-        for e in entries:
-            sh = ns_shard_for(e[0])
-            rec = by_shard.get(sh.id)
-            if rec is None:
-                rec = by_shard[sh.id] = (sh, [])
-            rec[1].append(e)
+        if shard_ids is None:
+            ns_shard_for = namespace.shard_for
+            for e in entries:
+                sh = ns_shard_for(e[0])
+                rec = by_shard.get(sh.id)
+                if rec is None:
+                    rec = by_shard[sh.id] = (sh, [])
+                rec[1].append(e)
+        else:
+            shards = namespace.shards
+            for e, si in zip(entries, shard_ids.tolist()):
+                rec = by_shard.get(si)
+                if rec is None:
+                    rec = by_shard[si] = (shards[si], [])
+                rec[1].append(e)
         applied: list[CommitLogEntry] = []
         try:
             for sh, items in by_shard.values():

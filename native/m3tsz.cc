@@ -1164,4 +1164,56 @@ void m3agg_pack(const int32_t* keys, const float* values,
   }
 }
 
+// ---------------------------------------------------------------------------
+// murmur3-32 batch shard routing (sharding/shardset.go:149 DefaultHashFn =
+// murmur3.Sum32(id) % numShards) — exact parity with utils/hash.py.
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static uint32_t murmur3_32(const uint8_t* data, int64_t n, uint32_t seed) {
+  uint32_t h = seed;
+  int64_t nblocks = n / 4;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);  // little-endian load
+    k *= 0xCC9E2D51u;
+    k = rotl32(k, 15);
+    k *= 0x1B873593u;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xE6546B64u;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k = 0;
+  switch (n & 3) {
+    case 3: k ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+    case 2: k ^= (uint32_t)tail[1] << 8; [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= 0xCC9E2D51u;
+      k = rotl32(k, 15);
+      k *= 0x1B873593u;
+      h ^= k;
+  }
+  h ^= (uint32_t)n;
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// ids concatenated; offsets[n+1]; out[i] = murmur3(id_i) % num_shards.
+void m3hash_shards(const uint8_t* ids, const int64_t* offsets, int32_t n,
+                   int32_t num_shards, int32_t* out) {
+  for (int32_t i = 0; i < n; i++) {
+    out[i] = (int32_t)(murmur3_32(ids + offsets[i],
+                                  offsets[i + 1] - offsets[i], 0) %
+                       (uint32_t)num_shards);
+  }
+}
+
 }  // extern "C"

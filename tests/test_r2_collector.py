@@ -219,3 +219,76 @@ def test_collector_end_to_end():
             srv.shutdown()
     finally:
         ingress.stop()
+
+
+def test_r2ctl_service_crud(tmp_path):
+    """Standalone r2ctl (ctl/service/r2 role): CRUD over HTTP against a
+    kvnode; edits land in the KV the matcher watches; '/' renders the UI."""
+    import json
+    import subprocess
+    import sys
+    import urllib.request
+
+    from m3_tpu.testing.proc_cluster import _spawn_listening
+
+    kv_proc, kh, kp = _spawn_listening(
+        [sys.executable, "-m", "m3_tpu.services.kvnode", "--port", "0"], "kvnode"
+    )
+    r2_proc = None
+    try:
+        r2_proc, rh, rp = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.r2ctl",
+             "--port", "0", "--kv-endpoint", f"{kh}:{kp}"],
+            "r2ctl",
+        )
+        base = f"http://{rh}:{rp}"
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                base + path, method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read()
+
+        ruleset = {
+            "namespace": "prod",
+            "version": 1,
+            "mappingRules": [{
+                "name": "cpu-rollup",
+                "filter": "__name__:cpu_*",
+                "policies": ["1m:40d"],
+                "aggregations": ["MEAN"],
+                "drop": False,
+                "cutoverNanos": 0,
+            }],
+            "rollupRules": [],
+        }
+        st, _ = call("POST", "/api/v1/rules/prod", ruleset)
+        assert st == 200
+        st, raw = call("GET", "/api/v1/rules/prod")
+        assert st == 200
+        got = json.loads(raw)
+        assert got["mappingRules"][0]["name"] == "cpu-rollup"
+        # the edit is in the SHARED KV: a direct RuleStore sees it
+        from m3_tpu.cluster.kv_service import RemoteKVStore
+        from m3_tpu.rules.r2 import RuleStore
+
+        kv = RemoteKVStore.connect(f"{kh}:{kp}")
+        assert RuleStore(kv).get("prod") is not None
+        kv.close()
+        # UI renders
+        st, page = call("GET", "/")
+        assert st == 200 and b"cpu-rollup" in page
+        # delete
+        st, _ = call("DELETE", "/api/v1/rules/prod")
+        assert st == 200
+        st = urllib.request.urlopen(base + "/api/v1/rules", timeout=10).status
+        assert st == 200
+    finally:
+        if r2_proc is not None and r2_proc.poll() is None:
+            r2_proc.kill()
+            r2_proc.wait(timeout=10)
+        if kv_proc.poll() is None:
+            kv_proc.kill()
+            kv_proc.wait(timeout=10)
