@@ -91,7 +91,11 @@ class HostQueue:
         while True:
             with self._cv:
                 if not self._buf and not self._stop:
-                    # idle: no timeout — zero wakeups until work arrives
+                    # idle: no timeout — zero wakeups until work arrives.
+                    # A flush_now that raced an in-flight _flush (nothing
+                    # left to send) must not leak its latch into the NEXT
+                    # batch's fill window
+                    self._flush_req = False
                     self._cv.wait()
                 if (
                     self._buf

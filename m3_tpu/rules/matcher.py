@@ -46,6 +46,7 @@ class Matcher:
         self.cache_hits = 0
         self.cache_misses = 0
         self.invalidations = 0
+        self.decode_errors = 0  # undecodable rulesets seen on the watch
         self._unsubs = []
         self._watch_namespaces()
 
@@ -81,7 +82,18 @@ class Matcher:
 
                 try:
                     rs = ruleset_from_dict(rs)
-                except (KeyError, ValueError, TypeError):
+                except (KeyError, ValueError, TypeError) as exc:
+                    # a ruleset this matcher can't decode (e.g. written by
+                    # a newer version) leaves it on the PREVIOUS rules —
+                    # make the divergence observable instead of silent
+                    import sys as _sys
+
+                    self.decode_errors += 1
+                    print(
+                        f"WARN matcher: undecodable ruleset for "
+                        f"{namespace!r} v{vv.version}: {exc}",
+                        file=_sys.stderr, flush=True,
+                    )
                     return
             if not isinstance(rs, RuleSet):
                 return

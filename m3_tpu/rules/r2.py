@@ -177,3 +177,17 @@ class RuleStore:
         self._edit_namespaces(lambda names: [n for n in names if n != namespace])
         self.kv.delete(ruleset_key(namespace))
         return True
+
+
+def listing_dict(store: RuleStore) -> dict:
+    """The GET /api/v1/rules response body (shared by the coordinator route
+    and the standalone r2ctl service); one namespaces() read per request."""
+    names = store.namespaces()
+    return {
+        "namespaces": names,
+        "rulesets": {
+            ns: ruleset_to_dict(rs)
+            for ns in names
+            if (rs := store.get(ns)) is not None
+        },
+    }

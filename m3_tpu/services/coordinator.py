@@ -491,19 +491,9 @@ class _Handler(BaseHTTPRequestHandler):
                     p = c.placement_svc.get()
                     self._json(p.to_dict() if p else {}, 200 if p else 404)
                 elif url.path == "/api/v1/rules":
-                    from ..rules.r2 import RuleStore, ruleset_to_dict
+                    from ..rules.r2 import RuleStore, listing_dict
 
-                    store = RuleStore(c.kv)
-                    self._json(
-                        {
-                            "namespaces": store.namespaces(),
-                            "rulesets": {
-                                ns: ruleset_to_dict(rs)
-                                for ns in store.namespaces()
-                                if (rs := store.get(ns)) is not None
-                            },
-                        }
-                    )
+                    self._json(listing_dict(RuleStore(c.kv)))
                 elif (m := re.match(r"^/api/v1/rules/([^/]+)$", url.path)) is not None:
                     from ..rules.r2 import RuleStore, ruleset_to_dict
 

@@ -45,11 +45,56 @@ def build_parser() -> argparse.ArgumentParser:
         "placement, and peers-bootstraps gained shards",
     )
     p.add_argument("--heartbeat-timeout", type=float, default=10.0)
+    # embedded seed control plane (server.go:266-324 embedded etcd role):
+    # this node ALSO runs a raft KV replica; N seed nodes form the quorum
+    p.add_argument("--embed-kv", action="store_true",
+                   help="run an embedded raft KV replica in this process")
+    p.add_argument("--embed-kv-port", type=int, default=0)
+    p.add_argument("--kv-node-id", default="",
+                   help="raft member id (default: kv-<node-id>)")
+    p.add_argument("--kv-members", default="",
+                   help="full member map id=host:port,... (else the fixture "
+                   "or operator sends raft_configure to each seed)")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    # embedded seed KV replica (server.go:266-324): starts SERVING first —
+    # the quorum only forms once a majority of seeds are up, so everything
+    # that needs the control plane is deferred until a leader exists
+    kv_server = None
+    kv_raft = None
+    if args.embed_kv:
+        import os as _os
+
+        from ..cluster.kv import KVStore
+        from ..cluster.raft import RaftKVService, RaftNode
+        from ..net.server import RpcServer
+
+        kv_raft = RaftNode(
+            args.kv_node_id or f"kv-{args.node_id}",
+            KVStore(),
+            data_dir=_os.path.join(args.base_dir, "kv"),
+        )
+        kv_server = RpcServer(RaftKVService(kv_raft), port=args.embed_kv_port)
+        kv_server.start()
+        self_kv_ep = f"{kv_server.host}:{kv_server.port}"
+        print(f"KV_LISTENING {kv_server.host} {kv_server.port}", flush=True)
+        if args.kv_members:
+            members = dict(kv.split("=", 1) for kv in args.kv_members.split(","))
+            kv_raft.configure(members, self_endpoint=self_kv_ep)
+        elif kv_raft.members:
+            # RESTART of a configured seed: rejoin the recovered membership
+            # immediately so the quorum (and the namespace registry below)
+            # is available BEFORE bootstrap
+            kv_raft.configure(kv_raft.members, self_endpoint=self_kv_ep)
+        if not args.kv_endpoint:
+            # the node's own control-plane client talks to its LOCAL seed
+            # (leader redirects route writes; watches serve locally)
+            args.kv_endpoint = self_kv_ep
+
     db = Database(args.base_dir, num_shards=args.num_shards)
     opts = NamespaceOptions(
         retention_nanos=args.retention_secs * NANOS,
@@ -61,32 +106,56 @@ def main(argv=None) -> int:
 
     # dynamic namespaces (namespace/dynamic.go): the control-plane registry
     # is applied BEFORE bootstrap so registered namespaces recover their
-    # data, and watched after so admin-created namespaces appear live
+    # data, and watched after so admin-created namespaces appear live.
+    # EMBEDDED-SEED mode defers ALL control-plane wiring until the quorum
+    # has a leader (the quorum can't form until a majority of seed
+    # processes are up) — registry namespaces then appear via the watch.
     kv = None
     ns_registry = None
-    if args.kv_endpoint:
+    state: dict = {"cluster_db": None, "hb_stop": None}
+
+    def _apply_registry(reg: dict) -> None:
+        for name, rec in reg.items():
+            if name in db.namespaces:
+                continue
+            db.create_namespace(
+                name,
+                NamespaceOptions(
+                    retention_nanos=int(rec["retention_nanos"]),
+                    block_size_nanos=int(rec["block_size_nanos"]),
+                    cold_writes_enabled=bool(
+                        rec.get("cold_writes_enabled", True)
+                    ),
+                ),
+            )
+
+    if args.kv_endpoint and not args.embed_kv:
         from ..cluster.kv_service import RemoteKVStore
         from ..cluster.namespaces import NamespaceRegistry
 
         kv = RemoteKVStore.connect(args.kv_endpoint)
         ns_registry = NamespaceRegistry(kv)
-
-        def _apply_registry(reg: dict) -> None:
-            for name, rec in reg.items():
-                if name in db.namespaces:
-                    continue
-                db.create_namespace(
-                    name,
-                    NamespaceOptions(
-                        retention_nanos=int(rec["retention_nanos"]),
-                        block_size_nanos=int(rec["block_size_nanos"]),
-                        cold_writes_enabled=bool(
-                            rec.get("cold_writes_enabled", True)
-                        ),
-                    ),
-                )
-
         _apply_registry(ns_registry.get_all())
+    elif args.embed_kv and kv_raft.members:
+        # a RECONFIGURED seed (restart or --kv-members): wait for the
+        # quorum and apply the registry BEFORE bootstrap, so
+        # registry-created namespaces recover their persisted data —
+        # create_namespace after bootstrap would leave them empty
+        import time as _t
+
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline and kv_raft.leader_id is None:
+            _t.sleep(0.05)
+        if kv_raft.leader_id is not None:
+            from ..cluster.kv_service import RemoteKVStore
+            from ..cluster.namespaces import NamespaceRegistry
+
+            kv = RemoteKVStore.connect(args.kv_endpoint)
+            ns_registry = NamespaceRegistry(kv)
+            try:
+                _apply_registry(ns_registry.get_all())
+            except Exception as exc:
+                print(f"WARN registry fetch at bootstrap failed: {exc}", flush=True)
 
     if not args.no_bootstrap:
         db.bootstrap()
@@ -100,33 +169,36 @@ def main(argv=None) -> int:
     service = NodeService(db, node_id=args.node_id, assigned_shards=shards)
     server = NodeServer(service, host=args.host, port=args.port)
 
-    # dynamic topology via the networked control plane
-    # (server.go: embedded etcd + topology watch + KV runtime reconfig)
-    cluster_db = None
-    hb_stop = None
-    if args.kv_endpoint:
+    def wire_control_plane() -> None:
+        """Dynamic topology via the networked control plane (server.go:
+        embedded etcd + topology watch + KV runtime reconfig)."""
+        nonlocal kv, ns_registry
         import threading
 
         from ..cluster.placement import PlacementService
         from ..cluster.services import ServiceInstance, Services
         from ..storage.cluster_db import ClusterDatabase
+        from ..storage.runtime import RuntimeOptionsManager
+
+        if kv is None:
+            from ..cluster.kv_service import RemoteKVStore
+            from ..cluster.namespaces import NamespaceRegistry
+
+            kv = RemoteKVStore.connect(args.kv_endpoint)
+            ns_registry = NamespaceRegistry(kv)
+            _apply_registry(ns_registry.get_all())
 
         # live namespace adds (bootstrap already applied the current set)
         ns_registry.watch(_apply_registry)
 
-        # KV-watched runtime knobs over the NETWORKED control plane
-        # (server.go:1007-1268 runtime reconfig; kvconfig keys)
-        from ..storage.runtime import RuntimeOptionsManager
-
+        # KV-watched runtime knobs (server.go:1007-1268 runtime reconfig)
         runtime_mgr = RuntimeOptionsManager(kv)
-        # watch() replays the current KV options to the new listener; with
-        # no KV value yet the defaults equal the Database's own
         runtime_mgr.watch(db.apply_runtime_options)
 
         services = Services(kv, heartbeat_timeout=args.heartbeat_timeout)
         endpoint = f"{server.host}:{server.port}"
         services.advertise("m3db", ServiceInstance(args.node_id, endpoint))
-        hb_stop = threading.Event()
+        hb_stop = state["hb_stop"] = threading.Event()
 
         def hb_loop() -> None:
             interval = max(args.heartbeat_timeout / 3.0, 0.05)
@@ -137,10 +209,33 @@ def main(argv=None) -> int:
                     pass  # KV hiccups must not kill the node
 
         threading.Thread(target=hb_loop, daemon=True, name="heartbeat").start()
-        cluster_db = ClusterDatabase(
+        cluster_db = state["cluster_db"] = ClusterDatabase(
             db, args.node_id, PlacementService(kv), node_service=service
         )
         cluster_db.start()
+
+    if args.kv_endpoint and not args.embed_kv:
+        wire_control_plane()
+    elif args.embed_kv:
+        import threading as _threading
+        import time as _time
+
+        def _wire_when_quorum() -> None:
+            deadline = _time.monotonic() + 300
+            while _time.monotonic() < deadline:
+                st = kv_raft.status()
+                if st["leader"] is not None and st["members"]:
+                    break
+                _time.sleep(0.1)
+            try:
+                wire_control_plane()
+            except Exception as exc:  # control plane down: node still serves
+                print(f"WARN embedded control-plane wiring failed: {exc}",
+                      flush=True)
+
+        _threading.Thread(
+            target=_wire_when_quorum, daemon=True, name="kv-seed-wire"
+        ).start()
 
     def shutdown(signum, frame):
         # SystemExit propagates out of serve_forever's select loop; the
@@ -154,12 +249,16 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
-        if hb_stop is not None:
-            hb_stop.set()
-        if cluster_db is not None:
-            cluster_db.stop()
+        if state["hb_stop"] is not None:
+            state["hb_stop"].set()
+        if state["cluster_db"] is not None:
+            state["cluster_db"].stop()
         if kv is not None:
             kv.close()
+        if kv_raft is not None:
+            kv_raft.stop()
+        if kv_server is not None:
+            kv_server.stop()
         if mediator is not None:
             mediator.stop()
         db.close()

@@ -280,17 +280,22 @@ def _run_coordinator(args) -> int:
     per_agent = []
     deadline = time.monotonic() + args.duration + 60
     pending = set(range(n))
+    poll_failures = [0] * n
     while pending and time.monotonic() < deadline:
         time.sleep(0.3)
         for i in sorted(pending):
             try:
                 st = clients[i]._call("lg_poll", token=tokens[i])
+                poll_failures[i] = 0
             except Exception as exc:
-                # agent died mid-run: count it and keep aggregating the
-                # survivors instead of crashing the coordinator
-                pending.discard(i)
-                per_agent.append({"error": f"agent unreachable: {exc}"})
-                agg["errors"] += 1
+                # a busy agent can time out one poll; only give up after
+                # several CONSECUTIVE failures (then count it and keep
+                # aggregating the survivors instead of crashing)
+                poll_failures[i] += 1
+                if poll_failures[i] >= 5:
+                    pending.discard(i)
+                    per_agent.append({"error": f"agent unreachable: {exc}"})
+                    agg["errors"] += 1
                 continue
             if st["done"]:
                 pending.discard(i)

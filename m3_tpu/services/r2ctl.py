@@ -30,7 +30,7 @@ import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..rules.r2 import RuleStore, ruleset_from_dict, ruleset_to_dict
+from ..rules.r2 import RuleStore, listing_dict, ruleset_from_dict, ruleset_to_dict
 
 
 def make_server(kv, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServer:
@@ -67,16 +67,7 @@ def make_server(kv, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServ
                 elif self.path == "/":
                     self._html(_render_index(store))
                 elif self.path == "/api/v1/rules":
-                    self._json(
-                        {
-                            "namespaces": store.namespaces(),
-                            "rulesets": {
-                                ns: ruleset_to_dict(rs)
-                                for ns in store.namespaces()
-                                if (rs := store.get(ns)) is not None
-                            },
-                        }
-                    )
+                    self._json(listing_dict(store))
                 elif (m := re.match(r"^/api/v1/rules/([^/]+)$", self.path)):
                     rs = store.get(m.group(1))
                     if rs is None:

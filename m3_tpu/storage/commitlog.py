@@ -227,6 +227,21 @@ class CommitLog:
             self._failed = exc
             with self._qlock:
                 self._closed = True
+            # Neutralize the file object: a dead writer's BufferedWriter
+            # must never flush/close at GC time — fd numbers get reused,
+            # and a GC-time flush was observed writing stale bytes into
+            # (then closing) an UNRELATED database's WAL. dup2(devnull)
+            # makes the object's fd harmless whether the original fd is
+            # broken-but-open (disk error) or already closed.
+            try:
+                devnull = os.open(os.devnull, os.O_WRONLY)
+                try:
+                    os.dup2(devnull, self._f.fileno())
+                finally:
+                    os.close(devnull)
+                self._f.close()
+            except (OSError, ValueError):
+                pass
 
             def release(cmd) -> None:
                 if cmd is None:
@@ -339,12 +354,17 @@ class CommitLog:
                     cmd[1].set()
         except queue.Empty:
             pass
+        # Lose the Python-buffered bytes WITHOUT leaving a zombie file
+        # object: redirect the fd to /dev/null and close normally. A bare
+        # os.close left the BufferedWriter "open" holding a dead fd number;
+        # its flush at GC time then wrote stale bytes into (and closed!)
+        # whatever unrelated file had REUSED that fd — observed as a
+        # different database's WAL writer dying with EBADF mid-test-suite.
         try:
-            os.close(self._f.fileno())  # yank the fd out from the buffer
-        except OSError:
-            pass
-        try:
-            self._f.close()  # its flush of buffered bytes now fails: lost
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, self._f.fileno())  # real file keeps only
+            os.close(devnull)  # what the OS already had (SIGKILL bytes)
+            self._f.close()  # buffer flushes harmlessly into /dev/null
         except (OSError, ValueError):
             pass
 

@@ -827,13 +827,13 @@ class Database:
                 final: dict[tuple[bytes, int], CommitLogEntry] = {}
                 replayed = 0
                 for e in wal_entries:
-                    sh = ns.shard_for(e.series_id)
+                    sh = shard_of[e.series_id]
                     if sh.id not in by_id:
                         continue  # outside this pass's shard filter
                     final[(e.series_id, e.time_nanos)] = e
                     replayed += 1
                 for e in final.values():
-                    sh = ns.shard_for(e.series_id)
+                    sh = shard_of[e.series_id]
                     fulfilled.add(sh.id, (e.time_nanos // bsz) * bsz)
                     if _covered(sh, e.series_id, e.time_nanos, e.value):
                         continue
@@ -890,6 +890,19 @@ class Database:
         snapshots: dict[int, list] = {}
         with self.lock:
             wal_entries = CommitLog.replay(self._commitlog_dir(name))
+            # replay hashes every entry's sid up to three times across the
+            # bootstrap passes: route all UNIQUE sids in one native murmur3
+            # call (python per-id fallback), then the passes dict-lookup
+            from .. import native as _native
+
+            _uniq = list({e.series_id for e in wal_entries})
+            _sb = _native.shard_batch(_uniq, ns.num_shards)
+            if _sb is not None:
+                shard_of = {
+                    sid: ns.shards[si] for sid, si in zip(_uniq, _sb.tolist())
+                }
+            else:
+                shard_of = {sid: ns.shard_for(sid) for sid in _uniq}
             for shard in shards:
                 for fid in shard.filesets():
                     target.add(shard.id, fid.block_start)
@@ -898,7 +911,7 @@ class Database:
                 for _, bs, _, _ in snap or ():
                     target.add(shard.id, bs)
             for e in wal_entries:
-                sh = ns.shard_for(e.series_id)
+                sh = shard_of[e.series_id]
                 if sh.id in by_id:
                     target.add(sh.id, (e.time_nanos // bsz) * bsz)
 

@@ -195,10 +195,16 @@ class ProcCluster:
     base_dir: str | None = None
     extra_args: list = field(default_factory=list)
     nodes: dict = field(default_factory=dict)
-    kv_replicas: int = 1  # >1: raft quorum (reference: embedded etcd seeds)
+    kv_replicas: int = 1  # >1: raft quorum of standalone kvnodes
+    # embedded seeds: every dbnode ALSO runs a raft KV replica in-process
+    # (server.go:266-324 embedded etcd) — no standalone kvnode at all
+    embedded_kv: bool = False
 
     def __post_init__(self) -> None:
         self.base_dir = self.base_dir or tempfile.mkdtemp(prefix="m3tpu-proc-")
+        if self.embedded_kv:
+            self._start_embedded()
+            return
         if self.kv_replicas > 1:
             self.kv_procs, kv_eps = spawn_kv_quorum(
                 self.kv_replicas, os.path.join(self.base_dir, "kv")
@@ -229,6 +235,71 @@ class ProcCluster:
             # a half-started cluster must not orphan its processes — the
             # fixture object never reaches the caller, so close() would
             # never run
+            self.close()
+            raise
+
+    def _start_embedded(self) -> None:
+        """Seed-node deployment: each dbnode carries an embedded raft KV
+        replica; the fixture collects every seed's KV endpoint, configures
+        the quorum, then writes the placement like an operator."""
+        from ..net.client import RpcClient
+
+        self.kv_procs = []
+        ids = [f"node{i}" for i in range(self.num_nodes)]
+        kv_members: dict[str, str] = {}
+        try:
+            for nid in ids:
+                collect: dict = {}
+                cmd = [
+                    sys.executable, "-m", "m3_tpu.services.dbnode",
+                    "--base-dir", os.path.join(self.base_dir, nid),
+                    "--port", "0", "--node-id", nid,
+                    "--num-shards", str(self.num_shards),
+                    "--block-size-secs", str(self.block_size_secs),
+                    "--heartbeat-timeout", str(self.heartbeat_timeout),
+                    "--no-mediator", "--embed-kv",
+                    *self.extra_args,
+                ]
+                proc, host, port = _spawn_listening(
+                    cmd, nid, collect=collect, expect_markers={"KV_LISTENING"}
+                )
+                kh, kp = collect["KV_LISTENING"]
+                kv_members[f"kv-{nid}"] = f"{kh}:{kp}"
+                self.nodes[nid] = ProcNode(nid, proc, RemoteNode(host, port, node_id=nid))
+            for ep in kv_members.values():
+                c = RpcClient.connect(ep)
+                c._call("raft_configure", members=kv_members)
+                c.close()
+            # wait for a single leader across the embedded quorum
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                leaders = set()
+                for ep in kv_members.values():
+                    c = RpcClient.connect(ep)
+                    try:
+                        st = c._call("raft_status")
+                        if st["role"] == "leader":
+                            leaders.add(st["id"])
+                    except Exception:
+                        pass
+                    finally:
+                        c.close()
+                if len(leaders) == 1:
+                    break
+                time.sleep(0.05)
+            else:
+                raise TimeoutError("embedded KV quorum did not elect")
+            self.kv_endpoint = ",".join(kv_members.values())
+            self.kv = RemoteKVStore.connect(self.kv_endpoint)
+            self.placement_svc = PlacementService(self.kv)
+            placement = build_initial_placement(
+                ids, self.num_shards, self.replica_factor
+            )
+            for nid in ids:
+                placement.instances[nid].endpoint = self.nodes[nid].endpoint
+            self.placement_svc.set(placement)
+            self.wait_for_shards()
+        except BaseException:
             self.close()
             raise
 

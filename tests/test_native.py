@@ -183,3 +183,21 @@ def test_decode_batch_flags_annotations():
     # annotations don't perturb (t, v) decoding
     assert list(triples[1][0]) == [t0, t0 + 10**9]
     assert list(triples[1][1]) == [1.0, 2.0]
+
+
+def test_shard_batch_matches_python_hash():
+    """Native m3hash_shards == utils/hash murmur3 shard routing for every
+    length class (block, 1-3 byte tails, empty)."""
+    from m3_tpu.native import shard_batch
+    from m3_tpu.utils.hash import shard_for
+
+    rng = np.random.default_rng(21)
+    ids = [b"s%d" % i for i in range(2000)]
+    ids += [bytes(rng.integers(0, 256, int(n))) for n in rng.integers(0, 40, 500)]
+    ids += [b"", b"a", b"ab", b"abc", b"abcd", b"\xff" * 7]
+    for num_shards in (1, 3, 64, 4096):
+        out = shard_batch(ids, num_shards)
+        if out is None:
+            pytest.skip("native lib unavailable")
+        for sid, got in zip(ids, out.tolist()):
+            assert got == shard_for(sid, num_shards), (sid, num_shards)

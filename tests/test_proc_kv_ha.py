@@ -166,3 +166,36 @@ def test_aggregator_ha_survives_kv_leader_kill(tmp_path):
                 proc.kill()
                 proc.wait(timeout=10)
         cluster.close()
+
+
+def test_embedded_seed_nodes(tmp_path):
+    """Seed-node deployment (server.go:266-324 embedded etcd role): every
+    dbnode carries an embedded raft KV replica — no standalone kvnode.
+    Killing one seed (taking both its data shards AND its KV replica) must
+    leave writes, reads, and control-plane updates working."""
+    cluster = ProcCluster(
+        num_nodes=3, num_shards=4, replica_factor=3,
+        heartbeat_timeout=2.0, base_dir=str(tmp_path), embedded_kv=True,
+    )
+    try:
+        sess = cluster.session()
+        t0 = time.time_ns()
+        tags = ((b"__name__", b"seed_metric"), (b"host", b"a"))
+        sid = sess.write_tagged(tags, t0, 42.0)
+        assert [dp.value for dp in sess.fetch(sid, t0 - 1, t0 + 10**9)] == [42.0]
+
+        # control-plane writes ride the embedded quorum
+        cluster.kv.set("ops/key", {"v": 1})
+        assert cluster.kv.get("ops/key").value == {"v": 1}
+
+        # SIGKILL one seed: its shards AND its KV replica die together
+        cluster.nodes["node2"].kill()
+
+        # data plane still reaches quorum (2/3 replicas)
+        sid2 = sess.write_tagged(((b"__name__", b"after_kill"),), t0, 7.0)
+        assert [dp.value for dp in sess.fetch(sid2, t0 - 1, t0 + 10**9)] == [7.0]
+        # control plane still serves (2/3 raft members)
+        assert cluster.kv.set("ops/key2", "ok") >= 1
+        assert cluster.kv.get("ops/key").value == {"v": 1}
+    finally:
+        cluster.close()
