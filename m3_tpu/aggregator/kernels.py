@@ -54,7 +54,11 @@ def window_keys(
     ns offsets still fit i32 after downshift)."""
     w = (times_nanos - window0_nanos) // resolution_nanos
     w = np.clip(w, 0, n_windows - 1)
-    keys = (ids.astype(np.int64) * n_windows + w).astype(np.int32)
+    keys = ids.astype(np.int64) * n_windows + w
+    # i32 keys only when they fit (grids past INT32_MAX groups keep i64 —
+    # downstream pack_dense_groups indexes in i64 either way)
+    if keys.size == 0 or int(keys.max()) <= np.iinfo(np.int32).max:
+        keys = keys.astype(np.int32)
     off = times_nanos - (window0_nanos + w * resolution_nanos)
     # shift so the order value always fits i32 regardless of resolution
     shift = 0

@@ -1,0 +1,75 @@
+"""Invalidation hooks: mutable/buffered data is never served stale.
+
+Contract (mirrors M3's immutable-fileset model): the ONLY cacheable unit
+is a sealed fileset block — buffers never enter the cache, and the read
+path always overlays live buffer data on top of cached arrays (newest
+wins). That makes the fileset entries correct by construction; these
+hooks exist to (a) keep the contract airtight when buffered state for a
+cached block changes (write/repair → conservative drop), (b) reclaim
+bytes for entries that can never hit again (cold-flush supersession —
+persist/fs/merger.go writes a NEW volume; tick expiry deletes filesets
+past retention — shard.go:663 tickAndExpire), and (c) give operators a
+full flush (clear).
+
+Every hook is a no-op without a cache, so storage wiring stays
+unconditional.
+"""
+
+from __future__ import annotations
+
+
+class CacheInvalidator:
+    """Targeted invalidation surface over one BlockCache (or None)."""
+
+    def __init__(self, cache=None) -> None:
+        self.cache = cache
+
+    def _live(self) -> bool:
+        # len() without the cache lock is a cheap hint: an empty cache
+        # (the common case on the hot write path) skips the lock
+        return self.cache is not None and len(self.cache) > 0
+
+    def on_write(self, namespace: str, shard_id: int, series_id: bytes, block_start: int) -> int:
+        """Shard.write / write_batch: a datapoint landed in (series, block).
+        The buffered point overlays cached fileset arrays at read time, so
+        entries are not stale — but drop them anyway: the contract is that
+        a written-to block is re-merged from source on next read."""
+        if not self._live():
+            return 0
+        return self.cache.invalidate_series_block(
+            namespace, shard_id, series_id, block_start
+        )
+
+    def on_flush(self, namespace: str, shard_id: int, fileset_ids) -> int:
+        """warm_flush/cold_flush: each flushed FilesetID supersedes every
+        lower volume of its block (cold flush merges into a new volume);
+        superseded entries can never hit again — reclaim their bytes."""
+        if not self._live():
+            return 0
+        dropped = 0
+        for fid in fileset_ids:
+            dropped += self.cache.invalidate_block(
+                namespace, shard_id, fid.block_start, below_volume=fid.volume
+            )
+        return dropped
+
+    def on_tick_expire(self, namespace: str, shard_id: int, block_starts) -> int:
+        """Tick retention expiry: the fileset is deleted off disk."""
+        if not self._live():
+            return 0
+        dropped = 0
+        for bs in block_starts:
+            dropped += self.cache.invalidate_block(namespace, shard_id, bs)
+        return dropped
+
+    def on_repair(self, namespace: str, shard_id: int, series_id: bytes, block_start: int) -> int:
+        """Repair streamed+merged a block from a peer: same conservative
+        drop as a write (repair points route through the write path, which
+        already fires on_write per point; this hook covers the block once
+        more so repaired blocks re-merge even when every streamed point was
+        skipped as a cold-write reject)."""
+        if not self._live():
+            return 0
+        return self.cache.invalidate_series_block(
+            namespace, shard_id, series_id, block_start
+        )

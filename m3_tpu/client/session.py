@@ -147,8 +147,14 @@ class Session:
     namespace: str = "default"
     write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
     read_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
-    # per-host async write queues, created lazily by write_batch_tagged
+    # per-host async write queues, created lazily by write_batch_tagged;
+    # creation is lock-guarded — racing writers must not each construct a
+    # HostQueue (the loser's worker thread would leak and its enqueued
+    # writes would miss future flush_now() calls)
     _queues: dict = field(default_factory=dict, repr=False)
+    _queues_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
 
     @property
     def num_shards(self) -> int:
@@ -209,12 +215,16 @@ class Session:
 
     def _host_queue(self, host: str) -> HostQueue | None:
         q = self._queues.get(host)
-        if q is None:
-            node = self.nodes.get(host)
-            if node is None:
-                return None
-            q = self._queues[host] = HostQueue(node, self.namespace)
-        return q
+        if q is not None:
+            return q
+        node = self.nodes.get(host)
+        if node is None:
+            return None
+        with self._queues_lock:
+            q = self._queues.get(host)  # racing writer won while we waited
+            if q is None:
+                q = self._queues[host] = HostQueue(node, self.namespace)
+            return q
 
     def try_write_batch_tagged(
         self, entries, timeout: float = 30.0

@@ -140,6 +140,26 @@ class SessionDatabase:
             )
         ]
 
+    def fetch_tagged_arrays(self, ns, query, start, end, limit=None):
+        """Array variant of fetch_tagged — the surface the query adapter
+        consumes (on the local Database it is served by the decoded-block
+        cache; here remote datapoints materialize into arrays once)."""
+        import numpy as np
+
+        return [
+            (
+                sid,
+                tags,
+                (
+                    np.asarray([dp.timestamp for dp in dps], np.int64),
+                    np.asarray([dp.value for dp in dps], np.float64),
+                ),
+            )
+            for sid, tags, dps in self.fetch_tagged(
+                ns, query, start, end, limit=limit
+            )
+        ]
+
     def query_ids(self, ns, query, start, end, limit=None):
         docs, exhaustive = self._session(ns).query_ids(query, start, end, limit=limit)
         return IndexQueryResult(

@@ -120,7 +120,21 @@ class RemoteKVStore:
     ("host:port,host:port,..."). Calls rotate to the next endpoint on
     connection failure, and follow NotLeaderError redirects to the raft
     leader for writes/leases — so a SIGKILLed KV replica (leader included)
-    is transparent to placement watches, elections, and heartbeats."""
+    is transparent to placement watches, elections, and heartbeats.
+
+    AT-LEAST-ONCE delivery: ``_call`` transparently re-sends an op when
+    the connection drops before the response arrives, so an op that DID
+    apply can be applied again. Idempotent ops (get/watch, check_and_set
+    — the version guard makes the retry a no-op — and the lease ops) are
+    retry-safe. The two non-idempotent writes are not: a ``set`` whose
+    response was lost applies twice (version bumps twice, watches fire
+    twice with the same value — harmless for last-writer-wins config
+    keys, observable for version-sensitive callers), and a
+    ``set_if_not_exists`` that actually succeeded retries into KeyError
+    even though this caller created the key. Callers needing
+    exactly-once semantics should route through check_and_set, or on
+    KeyError read the key back and treat "exists with my value" as
+    success."""
 
     FAILOVER_WINDOW = 20.0  # give a 3-node quorum time to elect + settle
 

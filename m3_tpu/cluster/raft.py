@@ -523,7 +523,7 @@ class RaftNode:
             prev = req["prev_index"]
             if prev > self.last_log_index:
                 return {"term": self.term, "ok": False, "hint": self.last_log_index}
-            if prev >= self.log_floor and prev > 0:
+            if prev >= self.first_index:
                 if self._term_at(prev) != req["prev_term"]:
                     # conflict: drop the tail from prev on
                     self.log = self.log[: prev - self.first_index]
@@ -533,6 +533,17 @@ class RaftNode:
                         "term": self.term, "ok": False,
                         "hint": max(self.log_floor, prev - 1),
                     }
+            elif prev == self.log_floor and prev > 0:
+                if self._term_at(prev) != req["prev_term"]:
+                    # entries at/below the floor are committed by definition
+                    # (floor <= snap_index <= last_applied): a term mismatch
+                    # here means local state is corrupt — fail loudly rather
+                    # than truncate committed entries
+                    raise RuntimeError(
+                        f"{self.node_id}: prev_term mismatch at log floor "
+                        f"{prev} (have {self._term_at(prev)}, leader says "
+                        f"{req['prev_term']}) — committed state diverged"
+                    )
             elif prev < self.log_floor:
                 # entries at/below our floor are committed by definition
                 # (floor <= snap_index <= last_applied); skip the overlap

@@ -42,6 +42,37 @@ def merge_segment_arrays(triples):
     return t_all[idx], v_all[idx], u_all[idx]
 
 
+def decode_stream_arrays(stream: bytes):
+    """Decode ONE m3tsz stream → (times, values, units) arrays, or None
+    when the stream carries annotations (the decoded-block cache stores
+    plain arrays; annotated streams fall back to the Datapoint iterator
+    so Datapoint.annotation survives). Native batch decoder when present,
+    pure-Python decoder otherwise — either way the caller gets arrays."""
+    from .. import native
+
+    if not stream:
+        return (
+            np.zeros(0, np.int64),
+            np.zeros(0, np.float64),
+            np.zeros(0, np.uint8),
+        )
+    if native.available():
+        triples, flags = native.decode_batch([stream], with_flags=True)
+        if flags[0]:
+            return None
+        return triples[0]
+    from .m3tsz import decode
+
+    dps = decode(stream)
+    if any(dp.annotation for dp in dps):
+        return None
+    return (
+        np.asarray([dp.timestamp for dp in dps], np.int64),
+        np.asarray([dp.value for dp in dps], np.float64),
+        np.asarray([int(dp.unit) for dp in dps], np.uint8),
+    )
+
+
 def read_segments_arrays(segments, start=None, end=None):
     """Decode + merge segments into (times, values, units) arrays, or None
     when any segment carries annotations (caller falls back to the

@@ -287,7 +287,11 @@ def pack_windowed_dense(
     lib = load()
     n = len(ids)
     n_groups = n_series * n_windows
-    if lib is None:
+    # the native kernel computes int32 group keys (m3tsz.cc m3agg_window_keys)
+    # and m3agg_count indexes with them: past INT32_MAX the cast wraps
+    # negative and the atomic fetch_add writes out of bounds — route
+    # oversized grids through the int64-keyed numpy path instead
+    if lib is None or n_groups > np.iinfo(np.int32).max:
         from ..aggregator.kernels import pack_dense_groups, window_keys
 
         keys, _, order = window_keys(

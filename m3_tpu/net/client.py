@@ -207,6 +207,9 @@ class RemoteNode(RpcClient):
         )
         return [(sid, bs, wire.dps_from_wire(dps)) for sid, bs, dps in out]
 
+    def cache_stats(self) -> dict:
+        return self._call("cache_stats")
+
     def owned_shards(self, cache_secs: float = 1.0) -> set[int]:
         cached = self._shards_cache
         now = time.monotonic()

@@ -125,6 +125,11 @@ class NodeService:
         """Self-observability exposition (x/instrument); Prometheus text."""
         return METRICS.expose()
 
+    def op_cache_stats(self, req):
+        """Decoded-block cache debug/status: hit/miss/eviction counters,
+        resident bytes vs budget (m3_tpu/cache/)."""
+        return self.db.cache_stats()
+
     def op_owned_shards(self, req):
         return sorted(self.assigned_shards)
 

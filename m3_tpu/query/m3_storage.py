@@ -50,10 +50,13 @@ class M3Storage:
     def fetch(self, matchers, start_nanos, end_nanos):
         q = matchers_to_index_query(matchers)
         out = []
-        for sid, tags, dps in self.db.fetch_tagged(self.namespace, q, start_nanos, end_nanos):
-            times = np.asarray([dp.timestamp for dp in dps], np.int64)
-            vals = np.asarray([dp.value for dp in dps], np.float64)
-            out.append((tags, times, vals))
+        # array surface: decoded arrays come straight from the decoded-block
+        # cache (m3_tpu/cache/) on repeat queries — no per-point Datapoint
+        # materialization on the scan-and-aggregate hot path
+        for sid, tags, (times, vals) in self.db.fetch_tagged_arrays(
+            self.namespace, q, start_nanos, end_nanos
+        ):
+            out.append((tags, np.asarray(times, np.int64), np.asarray(vals, np.float64)))
         return out
 
 

@@ -38,6 +38,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-mediator", action="store_true")
     p.add_argument("--no-bootstrap", action="store_true")
     p.add_argument(
+        "--cache-bytes",
+        type=int,
+        default=256 * 1024 * 1024,
+        help="decoded-block cache byte budget (0 disables the cache); "
+        "stats are served on the cache_stats debug op",
+    )
+    p.add_argument(
         "--kv-endpoint",
         default="",
         help="host:port of the control-plane KV server; enables dynamic "
@@ -95,7 +102,15 @@ def main(argv=None) -> int:
             # (leader redirects route writes; watches serve locally)
             args.kv_endpoint = self_kv_ep
 
-    db = Database(args.base_dir, num_shards=args.num_shards)
+    from ..cache import CacheOptions
+
+    db = Database(
+        args.base_dir,
+        num_shards=args.num_shards,
+        cache_options=CacheOptions(
+            enabled=args.cache_bytes > 0, max_bytes=max(args.cache_bytes, 0)
+        ),
+    )
     opts = NamespaceOptions(
         retention_nanos=args.retention_secs * NANOS,
         block_size_nanos=args.block_size_secs * NANOS,

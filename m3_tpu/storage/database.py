@@ -15,6 +15,9 @@ import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
+import numpy as np
+
+from ..cache import BlockCache, BlockKey, CacheInvalidator, CacheOptions, DecodedBlock
 from ..codec.m3tsz import Datapoint, decode
 from ..utils.hash import shard_for
 from ..utils.instrument import DEFAULT as METRICS
@@ -63,11 +66,24 @@ class Shard:
     materialized once and reused until a newer volume replaces it or the
     block expires, instead of re-reading data+index+side files per read."""
 
-    def __init__(self, shard_id: int, ns: str, opts: NamespaceOptions, base: str) -> None:
+    def __init__(
+        self,
+        shard_id: int,
+        ns: str,
+        opts: NamespaceOptions,
+        base: str,
+        cache: BlockCache | None = None,
+        invalidator: CacheInvalidator | None = None,
+    ) -> None:
         self.id = shard_id
         self.namespace = ns
         self.opts = opts
         self.base = base
+        # decoded-block cache (m3_tpu/cache/): sealed fileset blocks decode
+        # once; the invalidator hooks write/flush/tick so nothing stale or
+        # superseded stays resident
+        self.cache = cache
+        self.invalidator = invalidator or CacheInvalidator(cache)
         # per-shard lock (shard.go RWMutex role): hot-path reads/writes
         # contend only within a shard; lifecycle ops (flush/tick) take the
         # database lock FIRST then shard locks, writers take only this one,
@@ -126,24 +142,125 @@ class Shard:
                 buf = SeriesBuffer(sid, self.opts.block_size_nanos)
                 self.series[sid] = buf
             buf.write(t_nanos, value, unit)
+            bs = (t_nanos // self.opts.block_size_nanos) * self.opts.block_size_nanos
+            self.invalidator.on_write(self.namespace, self.id, sid, bs)
 
-    def read(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
+    def read(
+        self, sid: bytes, start: int, end: int, populate_cache: bool = True
+    ) -> list[Datapoint]:
+        """``populate_cache=False`` serves lifecycle scans (repair digests,
+        peer streaming): they read every series once and would otherwise
+        flush the hot query working set out of the byte-budget LRU —
+        cached entries are still used, but misses don't insert."""
         with self.lock:
-            return self._read_locked(sid, start, end)
+            return self._read_locked(sid, start, end, populate_cache)
 
-    def _read_locked(self, sid: bytes, start: int, end: int) -> list[Datapoint]:
+    def _read_locked(
+        self, sid: bytes, start: int, end: int, populate_cache: bool = True
+    ) -> list[Datapoint]:
         # flushed filesets first (older), then buffer segments: the
         # MultiReaderIterator's latest-segment-wins dedupe gives buffer
         # precedence over filesets (shard.go:1060 ReadEncoded ordering)
         from ..codec.iterator import MultiReaderIterator
         from ..codec.native_read import read_segments
 
+        arrs = self._read_arrays_locked(sid, start, end, populate_cache)
+        if arrs is not None:  # decoded-block cache path
+            t, v, u = arrs
+            return [
+                Datapoint(tt, vv, Unit(uu))
+                for tt, vv, uu in zip(t.tolist(), v.tolist(), u.tolist())
+            ]
         segments = self._segments_locked(sid, start, end)
         fast = read_segments(segments, start, end)  # native decoder; None
         if fast is not None:  # when annotations must survive
             return fast
         it = MultiReaderIterator(segments)
         return [dp for dp in it if start <= dp.timestamp < end]
+
+    def _read_arrays_locked(
+        self, sid: bytes, start: int, end: int, populate_cache: bool = True
+    ):
+        """(times, values, units) for [start, end) via the decoded-block
+        cache: sealed fileset blocks come from (or populate) the cache,
+        live buffer buckets overlay on top (newest wins — the same
+        precedence as the segment path). None → caller falls back (cache
+        disabled, or an annotated stream that must keep Datapoint
+        fidelity). ``populate_cache=False``: hits are served, misses
+        decode without inserting (lifecycle scans must not evict the hot
+        working set)."""
+        cache = self.cache
+        if cache is None:
+            return None
+        from ..codec.native_read import decode_stream_arrays, merge_segment_arrays
+
+        bsz = self.opts.block_size_nanos
+        triples = []
+        for fid in self.filesets():
+            if fid.block_start + bsz <= start or fid.block_start >= end:
+                continue
+            key = BlockKey(self.namespace, self.id, sid, fid.block_start, fid.volume)
+
+            def _decode(fid=fid):
+                stream = self._reader_locked(fid).stream(sid)
+                arrs = decode_stream_arrays(stream or b"")
+                return None if arrs is None else DecodedBlock(*arrs)
+
+            if populate_cache:
+                entry = cache.get_or_decode(key, _decode)
+            else:
+                entry = cache.get(key)
+                if entry is None:
+                    entry = _decode()
+            if entry is None:
+                return None  # annotated stream: segment-path fallback
+            if len(entry):
+                triples.append(entry.triple())
+        buf = self.series.get(sid)
+        if buf is not None:
+            # buffer overlay: per-bucket decoded arrays, memoized on the
+            # bucket until its next write (series.py merged_arrays keeps
+            # codec-roundtrip parity with the segment path)
+            for bs in sorted(buf.buckets):
+                if bs + bsz <= start or bs >= end:
+                    continue
+                arrs = buf.buckets[bs].merged_arrays()
+                if arrs is None:
+                    return None  # annotated: segment-path fallback
+                if len(arrs[0]):
+                    triples.append(arrs)
+        t, v, u = merge_segment_arrays(triples)
+        lo = int(np.searchsorted(t, start, side="left"))
+        hi = int(np.searchsorted(t, end, side="left"))
+        return t[lo:hi], v[lo:hi], u[lo:hi]
+
+    def read_arrays(self, sid: bytes, start: int, end: int):
+        """Array read surface: (times i64, values f64, units) decoded
+        arrays for [start, end) — cache-aware, always succeeds (annotated
+        streams decode through the iterator path and re-materialize;
+        straight to the iterator, not via _read_locked, which would retry
+        the arrays path and re-decode everything)."""
+        with self.lock:
+            arrs = self._read_arrays_locked(sid, start, end)
+            if arrs is not None:
+                return arrs
+            from ..codec.iterator import MultiReaderIterator
+            from ..codec.native_read import read_segments_arrays
+
+            segments = self._segments_locked(sid, start, end)
+            arrs = read_segments_arrays(segments, start, end)
+            if arrs is not None:
+                return arrs
+            dps = [
+                dp
+                for dp in MultiReaderIterator(segments)
+                if start <= dp.timestamp < end
+            ]
+        return (
+            np.asarray([dp.timestamp for dp in dps], np.int64),
+            np.asarray([dp.value for dp in dps], np.float64),
+            np.asarray([int(dp.unit) for dp in dps], np.uint8),
+        )
 
     def _segments_locked(self, sid: bytes, start: int, end: int) -> list[bytes]:
         """Raw encoded segments overlapping [start, end), oldest-first —
@@ -183,6 +300,7 @@ class Shard:
             flushed.append(fid)
         if flushed:
             self._invalidate_filesets()
+            self.invalidator.on_flush(self.namespace, self.id, flushed)
         # evict only what this flush made durable — cold writes into
         # previously-flushed blocks stay buffered for cold_flush
         for buf in self.series.values():
@@ -234,6 +352,9 @@ class Shard:
                 self.series[sid].evict_block(bs)
         if flushed:
             self._invalidate_filesets()
+            # a cold flush writes a NEW volume per block: every cached
+            # entry of a lower volume is superseded and can never hit
+            self.invalidator.on_flush(self.namespace, self.id, flushed)
         return flushed
 
     def tick(self, now_nanos: int) -> None:
@@ -261,14 +382,28 @@ class Shard:
             self._readers.pop(fid.block_start, None)
         if expired:
             self._invalidate_filesets()
+            self.invalidator.on_tick_expire(
+                self.namespace, self.id, {fid.block_start for fid in expired}
+            )
 
 
 class Namespace:
-    def __init__(self, name: str, opts: NamespaceOptions, num_shards: int, base: str) -> None:
+    def __init__(
+        self,
+        name: str,
+        opts: NamespaceOptions,
+        num_shards: int,
+        base: str,
+        cache: BlockCache | None = None,
+        invalidator: CacheInvalidator | None = None,
+    ) -> None:
         self.name = name
         self.opts = opts
         self.num_shards = num_shards
-        self.shards = [Shard(i, name, opts, base) for i in range(num_shards)]
+        self.shards = [
+            Shard(i, name, opts, base, cache=cache, invalidator=invalidator)
+            for i in range(num_shards)
+        ]
         self.index = None
         if opts.index_enabled:
             from ..index.ns_index import NamespaceIndex
@@ -282,11 +417,26 @@ class Namespace:
 class Database:
     """Top-level storage node object (database.go)."""
 
-    def __init__(self, base_dir: str, num_shards: int = 8, commitlog_enabled: bool = True) -> None:
+    def __init__(
+        self,
+        base_dir: str,
+        num_shards: int = 8,
+        commitlog_enabled: bool = True,
+        cache_options: CacheOptions | None = None,
+    ) -> None:
         self.base = base_dir
         self.num_shards = num_shards
         self.namespaces: dict[str, Namespace] = {}
         self.commitlog_enabled = commitlog_enabled
+        # decoded-block cache, shared across namespaces/shards (one byte
+        # budget per node, like the reference's process-wide wired list)
+        self.cache_options = cache_options or CacheOptions()
+        self.block_cache = (
+            BlockCache(self.cache_options)
+            if self.cache_options.enabled and self.cache_options.max_bytes > 0
+            else None
+        )
+        self.cache_invalidator = CacheInvalidator(self.block_cache)
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
         # self-observability (x/instrument role)
@@ -306,7 +456,14 @@ class Database:
 
     def create_namespace(self, name: str, opts: NamespaceOptions | None = None) -> Namespace:
         with self.lock:
-            ns = Namespace(name, opts or NamespaceOptions(), self.num_shards, self.base)
+            ns = Namespace(
+                name,
+                opts or NamespaceOptions(),
+                self.num_shards,
+                self.base,
+                cache=self.block_cache,
+                invalidator=self.cache_invalidator,
+            )
             self.namespaces[name] = ns
             if self.commitlog_enabled:
                 self._commitlogs[name] = CommitLog(self._commitlog_dir(name))
@@ -380,12 +537,20 @@ class Database:
                     rec = by_shard[si] = (shards[si], [])
                 rec[1].append(e)
         applied: list[CommitLogEntry] = []
+        cache = self.block_cache
+        touched: set = set()
         try:
             for sh, items in by_shard.values():
                 bsz = sh.opts.block_size_nanos
                 cold_ok = sh.opts.cold_writes_enabled
                 flushed = sh._flushed_blocks
                 with sh.lock:
+                    # decided UNDER the shard lock: entries for this
+                    # shard's keys are only created by readers holding
+                    # this lock, so an empty cache here (the common case
+                    # during ingest-heavy phases) safely skips the
+                    # per-item set insert
+                    collect = cache is not None and len(cache) > 0
                     series = sh.series
                     for sid, t, v in items:
                         bs = (t // bsz) * bsz
@@ -394,6 +559,8 @@ class Database:
                                 f"write at {t} targets flushed block {bs} and "
                                 f"namespace {sh.namespace} has cold writes disabled"
                             )
+                        if collect:
+                            touched.add((sh.id, sid, bs))
                         buf = series.get(sid)
                         if buf is None:
                             if limit_on:
@@ -411,9 +578,13 @@ class Database:
                             bucket.last_write_nanos = t
                         bucket.num_writes += 1
                         bucket._stream_cache = None
+                        bucket._arrays_cache = None
                         applied.append(CommitLogEntry(sid, t, v))
             self._m_writes.inc(len(applied))
         finally:
+            if touched:
+                for shard_id, sid, bs in touched:
+                    self.cache_invalidator.on_write(ns, shard_id, sid, bs)
             if cl is not None and applied:
                 cl.write_batch(applied)
 
@@ -453,6 +624,13 @@ class Database:
         # per-shard locking (inside Shard.read): reads don't serialize
         # against other shards or the database lifecycle lock
         return self.namespaces[ns].shard_for(sid).read(sid, start, end)
+
+    def read_arrays(self, ns: str, sid: bytes, start: int, end: int):
+        """Decoded (times i64, values f64, units) arrays for one series —
+        the cache-aware array read surface query engines consume without
+        materializing per-point Datapoint objects."""
+        self._m_reads.inc()
+        return self.namespaces[ns].shard_for(sid).read_arrays(sid, start, end)
 
     def fetch_blocks(self, ns: str, sid: bytes, start: int, end: int) -> list[bytes]:
         """Compressed read surface: raw encoded segments overlapping the
@@ -528,6 +706,24 @@ class Database:
             out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
         return out
 
+    def fetch_tagged_arrays(
+        self, ns: str, query, start: int, end: int, limit: int | None = None
+    ) -> list[tuple[bytes, tuple, tuple]]:
+        """FetchTagged on the array surface: (sid, tags, (times, values))
+        per matched series, served through the decoded-block cache."""
+        result = self.query_ids(ns, query, start, end, limit=limit)
+        out = []
+        for doc in result.docs:
+            t, v, _u = self.read_arrays(ns, doc.id, start, end)
+            out.append((doc.id, doc.fields, (t, v)))
+        return out
+
+    def cache_stats(self) -> dict:
+        """Decoded-block cache stats for debug/status endpoints."""
+        if self.block_cache is None:
+            return {"enabled": False}
+        return {"enabled": True, **self.block_cache.stats()}
+
     def stream_shard(self, ns: str, shard_id: int) -> list:
         """Peer streaming (FetchBootstrapBlocksFromPeers / repair source):
         every (sid, tags, datapoints) owned by one shard; tags come from the
@@ -550,7 +746,9 @@ class Database:
                                 docs.setdefault(d.id, d.fields)
             out = []
             for sid in sorted(sids):
-                dps = sh.read(sid, 0, 2**62)
+                # a peer-streaming sweep reads every series once — don't
+                # let it evict the hot query working set
+                dps = sh.read(sid, 0, 2**62, populate_cache=False)
                 if dps:
                     out.append((sid, docs.get(sid, ()), dps))
             return out

@@ -43,7 +43,7 @@ def _canonical_digest(sh, sid: bytes, bs: int, bsz: int):
     are the packed '<qdB' per-point records; the numpy structured layout
     below is byte-identical, so the native-array fast path and the
     Datapoint fallback produce the same checksum."""
-    dps = sh.read(sid, bs, bs + bsz)
+    dps = sh.read(sid, bs, bs + bsz, populate_cache=False)
     if not dps:
         return None
     import numpy as np
@@ -102,7 +102,7 @@ def stream_series_blocks(
                 raise ValueError(
                     f"series {sid!r} belongs to shard {sh.id}, not {shard_id}"
                 )
-            dps = sh.read(sid, bs, bs + bsz)
+            dps = sh.read(sid, bs, bs + bsz, populate_cache=False)
             out.append((sid, bs, dps))
         return out
 
@@ -161,7 +161,10 @@ def repair_shard(db, ns: str, shard_id: int, peers: list, tags_for=None) -> Repa
             sid = bytes(sid)
             res.blocks_streamed += 1
             sh = namespace.shard_for(sid)
-            have = {dp.timestamp for dp in sh.read(sid, bs, bs + bsz)}
+            have = {
+                dp.timestamp
+                for dp in sh.read(sid, bs, bs + bsz, populate_cache=False)
+            }
             for dp in dps:
                 if dp.timestamp in have:
                     continue
@@ -174,6 +177,10 @@ def repair_shard(db, ns: str, shard_id: int, peers: list, tags_for=None) -> Repa
                     res.points_merged += 1
                 except ColdWriteError:
                     res.points_skipped_cold += 1
+            # repaired block re-merges from source on next read (points
+            # route through the write path, which fires on_write per point;
+            # this covers blocks whose every point was skipped cold)
+            db.cache_invalidator.on_repair(ns, sh.id, sid, bs)
             # refresh the local digest so later peers don't re-stream what
             # this peer just repaired
             local[(bs, sid)] = _canonical_digest(sh, sid, bs, bsz)

@@ -37,6 +37,9 @@ class BufferBucket:
     last_write_nanos: int = -1
     num_writes: int = 0
     _stream_cache: bytes | None = None
+    # memoized decode of the merged stream: None = not computed,
+    # False = annotated (arrays can't represent it), tuple = arrays
+    _arrays_cache: "tuple | bool | None" = None
 
     def write(self, t_nanos: int, value: float, unit: Unit) -> None:
         self.times.append(t_nanos)
@@ -45,6 +48,7 @@ class BufferBucket:
         self.last_write_nanos = max(self.last_write_nanos, t_nanos)
         self.num_writes += 1
         self._stream_cache = None
+        self._arrays_cache = None
 
     def merged_points(self):
         """(times, values, units) time-sorted, later-write-wins — the
@@ -81,6 +85,22 @@ class BufferBucket:
             stream = enc.stream()
         self._stream_cache = stream
         return stream
+
+    def merged_arrays(self):
+        """Decoded (times, values, units) arrays of the canonical merged
+        stream, memoized until the next write — the buffered-data analog
+        of the decoded-block cache (repeated reads of an unsealed block
+        skip the re-decode, not just the re-encode). Decoding the STREAM
+        (not the raw columns) keeps codec-roundtrip parity: the codec
+        truncates timestamps to the time unit. Returns None for annotated
+        streams (memoized as False so the probe isn't repeated — the
+        caller's iterator fallback owns those)."""
+        if self._arrays_cache is None:
+            from ..codec.native_read import decode_stream_arrays
+
+            arrs = decode_stream_arrays(self.merged_stream())
+            self._arrays_cache = arrs if arrs is not None else False
+        return self._arrays_cache or None
 
 
 class SeriesBuffer:
