@@ -6,13 +6,17 @@ reductions). Baseline for vs_baseline is the north-star target of 10B
 datapoints/sec/chip (BASELINE.json); the reference itself publishes no
 comparable hard number.
 
-Prints TWO JSON lines:
+Prints THREE JSON lines:
   1. {"metric": "m3tsz_decode_aggregate_datapoints_per_sec_per_chip", ...}
      — the raw kernel scan-and-aggregate number.
   2. {"metric": "m3tsz_decode_aggregate_warm_cache_datapoints_per_sec_per_chip",
      ..., "hit_rate", "cold_value", "speedup_vs_cold"} — the repeated-query
      storage path (query/m3_storage.py fetch over sealed filesets) with the
      decoded-block cache (m3_tpu/cache/) warm, vs the same query cold.
+  3. {"metric": "process_metrics_snapshot", ...} — the benched process's own
+     m3tpu_* metrics (query latency histogram summary, per-stage latency,
+     decoded bytes, jit compile count/seconds per kernel) so BENCH_*.json
+     rounds can attribute a regression to the layer that actually moved.
 """
 
 from __future__ import annotations
@@ -33,7 +37,13 @@ def main() -> None:
         kernel_phase()
     except Exception as exc:
         print(f"WARN kernel bench phase failed: {exc}", file=sys.stderr)
-    bench_warm_cache()
+    try:
+        bench_warm_cache()
+    except Exception as exc:
+        # the metrics snapshot below is purely in-process and must still
+        # print — a lost line 2 shouldn't also cost line 3
+        print(f"WARN warm-cache bench phase failed: {exc}", file=sys.stderr)
+    metrics_snapshot_line()
 
 
 def kernel_phase() -> None:
@@ -89,8 +99,14 @@ def kernel_phase() -> None:
                 k=batch.k,
             )
         )
-    out = fn(args)  # compile + warm
-    jax.block_until_ready(out)
+    from m3_tpu.utils.instrument import JitTracker
+
+    # compile + warm; the tracker lands the compile time in
+    # m3tpu_jit_compile_seconds_total{kernel="bench_chunked_scan"} so the
+    # metrics snapshot line can separate warmup from steady-state
+    with JitTracker("bench_chunked_scan").track((platform, n_series, n_points, k)):
+        out = fn(args)
+        jax.block_until_ready(out)
     total_points = int(out.total_count)
 
     iters = 10
@@ -165,6 +181,16 @@ def bench_warm_cache() -> None:
         cold_dt = time.perf_counter() - tc0
         assert total_points == n_series * n_points, total_points
 
+        # a few PromQL passes over the same data so the snapshot line has a
+        # real query latency histogram + per-stage breakdown to report
+        from m3_tpu.query.engine import Engine
+
+        engine = Engine(storage)
+        for _ in range(3):
+            engine.query_range(
+                "sum(bench_gauge)", t0, t0 + (n_points - 1) * step, step
+            )
+
         before = db.block_cache.stats()
         tw0 = time.perf_counter()
         fetch_aggregate()  # second pass: hit-rate measurement
@@ -199,6 +225,69 @@ def bench_warm_cache() -> None:
         )
     finally:
         shutil.rmtree(base, ignore_errors=True)
+
+
+def metrics_snapshot_line() -> None:
+    """Third JSON line: the benched process's own metrics registry, reduced
+    to the families BENCH rounds attribute regressions with."""
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    snap = METRICS.collect()
+
+    def family_total(name: str) -> float:
+        fam = snap.get(name)
+        if not fam:
+            return 0.0
+        return sum(c["value"] for c in fam["children"])
+
+    def by_label(name: str, label: str) -> dict:
+        fam = snap.get(name)
+        if not fam:
+            return {}
+        return {
+            c["labels"].get(label, ""): round(c["value"], 6)
+            for c in fam["children"]
+        }
+
+    def hist_summary(name: str, label: str | None = None) -> dict | None:
+        fam = snap.get(name)
+        if not fam or not fam["children"]:
+            return None
+        if label is None:
+            count = sum(c["count"] for c in fam["children"])
+            total = sum(c["sum"] for c in fam["children"])
+            return {
+                "count": count,
+                "sum_secs": round(total, 6),
+                "avg_secs": round(total / count, 6) if count else 0.0,
+            }
+        return {
+            c["labels"].get(label, ""): {
+                "count": c["count"],
+                "sum_secs": round(c["sum"], 6),
+            }
+            for c in fam["children"]
+        }
+
+    print(
+        json.dumps(
+            {
+                "metric": "process_metrics_snapshot",
+                "query_latency": hist_summary("m3tpu_query_duration_seconds"),
+                "query_stage_latency": hist_summary(
+                    "m3tpu_query_stage_duration_seconds", label="stage"
+                ),
+                "decoded_bytes_total": family_total("m3tpu_decoded_bytes_total"),
+                "query_datapoints_scanned_total": family_total(
+                    "m3tpu_query_datapoints_scanned_total"
+                ),
+                "jit_compiles_total": by_label("m3tpu_jit_compiles_total", "kernel"),
+                "jit_compile_seconds_total": by_label(
+                    "m3tpu_jit_compile_seconds_total", "kernel"
+                ),
+            }
+        )
+    )
 
 
 if __name__ == "__main__":

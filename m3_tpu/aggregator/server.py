@@ -25,6 +25,7 @@ from ..metrics.encoding import (
 from ..metrics.types import MetricType
 from ..net.wire import FrameDecoder, pack_frame
 from ..utils.hash import shard_for
+from ..utils.instrument import DEFAULT as METRICS
 
 MAX_MSG = 64 * 1024 * 1024
 
@@ -36,6 +37,17 @@ class AggregatorIngestServer:
         self.aggregator = aggregator
         self.received = 0
         self.decode_errors = 0
+        # fleet scrape surface: the stream has no request/response channel,
+        # so ingest health rides the process registry (served by the
+        # aggregator binary's --debug-port RPC `metrics` op)
+        self._m_received = METRICS.counter(
+            "aggregator_messages_total", "ingested metric messages",
+            labels={"component": "aggregator"},
+        )
+        self._m_decode_errors = METRICS.counter(
+            "aggregator_decode_errors_total", "undecodable ingest payloads",
+            labels={"component": "aggregator"},
+        )
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -67,8 +79,10 @@ class AggregatorIngestServer:
                                 msg, _ = decode_message(payload)
                                 outer._apply(msg)
                             outer.received += 1
+                            outer._m_received.inc()
                         except Exception:
                             outer.decode_errors += 1
+                            outer._m_decode_errors.inc()
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

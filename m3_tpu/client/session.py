@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 
 from ..cluster.topology import ConsistencyLevel, TopologyMap
 from ..utils.hash import shard_for
+from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
 
 
@@ -173,15 +174,27 @@ class Session:
         ``readable_only`` gates on shard state: an INITIALIZING replica is
         still bootstrapping the shard and must not serve reads for it
         (topology readable-shard filtering; writes go to every replica so
-        the initializing one doesn't miss data)."""
+        the initializing one doesn't miss data).
+
+        Inside a traced request (an active span on this thread) the fan-out
+        gets a span per replica attempt tagged {replica, shard}, so
+        /debug/traces shows exactly which copies served a quorum op;
+        untraced writes pay nothing."""
+        traced = TRACER.active()
         success, errors, results = 0, [], []
         for host in self.topology.hosts_for_shard(shard, readable_only=readable_only):
             node = self.nodes.get(host)
             if node is None or not node.is_up:
                 errors.append(f"{host}: down")
                 continue
+            span = (
+                TRACER.span(f"client.{op_name}.replica", replica=host, shard=shard)
+                if traced
+                else NOOP_SPAN
+            )
             try:
-                results.append(call(node))
+                with span:
+                    results.append(call(node))
                 success += 1
             except Exception as exc:
                 errors.append(f"{host}: {exc}")
@@ -333,32 +346,47 @@ class Session:
         replicas (last-written value wins on equal timestamps, the
         SeriesIterator default). ``limit`` caps the merged series count."""
         required = self.read_consistency.required(self.topology.replicas)
+        traced = TRACER.active()
+        fanout_span = (
+            TRACER.span("client.fetch_tagged", namespace=self.namespace)
+            if traced
+            else NOOP_SPAN
+        )
         by_series: dict[bytes, tuple] = {}
         responded_by_shard: dict[int, int] = {}
-        for host, node in self.nodes.items():
-            if not node.is_up:
-                continue
-            try:
-                res = node.fetch_tagged(
-                    self.namespace, query, start_nanos, end_nanos, limit=limit
+        with fanout_span:
+            for host, node in self.nodes.items():
+                if not node.is_up:
+                    continue
+                span = (
+                    TRACER.span("client.fetch_tagged.replica", replica=host)
+                    if traced
+                    else NOOP_SPAN
                 )
-            except Exception:
-                continue
-            # count this replica only for shards whose copy here is READABLE
-            # per the placement — an INITIALIZING replica is still
-            # bootstrapping and must not count toward read consistency
-            owned = node.owned_shards()
-            for shard in owned:
-                if host in self.topology.hosts_for_shard(shard, readable_only=True):
-                    responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
-            for sid, tags, dps in res:
-                cur = by_series.get(sid)
-                if cur is None:
-                    by_series[sid] = (tags, {dp.timestamp: dp for dp in dps})
-                else:
-                    merged = cur[1]
-                    for dp in dps:
-                        merged.setdefault(dp.timestamp, dp)
+                try:
+                    with span:
+                        res = node.fetch_tagged(
+                            self.namespace, query, start_nanos, end_nanos,
+                            limit=limit,
+                        )
+                except Exception:
+                    continue
+                # count this replica only for shards whose copy here is
+                # READABLE per the placement — an INITIALIZING replica is
+                # still bootstrapping and must not count toward read
+                # consistency
+                owned = node.owned_shards()
+                for shard in owned:
+                    if host in self.topology.hosts_for_shard(shard, readable_only=True):
+                        responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
+                for sid, tags, dps in res:
+                    cur = by_series.get(sid)
+                    if cur is None:
+                        by_series[sid] = (tags, {dp.timestamp: dp for dp in dps})
+                    else:
+                        merged = cur[1]
+                        for dp in dps:
+                            merged.setdefault(dp.timestamp, dp)
         # consistency check over EVERY shard in the placement — a shard whose
         # replicas are all down has zero responders and must fail the read,
         # not silently return partial results (session.go:1789-1815)

@@ -143,22 +143,27 @@ class SessionDatabase:
     def fetch_tagged_arrays(self, ns, query, start, end, limit=None):
         """Array variant of fetch_tagged — the surface the query adapter
         consumes (on the local Database it is served by the decoded-block
-        cache; here remote datapoints materialize into arrays once)."""
+        cache; here remote datapoints materialize into arrays once). The
+        materialization is this mode's decode stage: the per-query stats
+        record attributes it so cluster-mode slow queries show decode cost
+        too (the remote node's own stages stay in its process)."""
         import numpy as np
 
-        return [
-            (
-                sid,
-                tags,
+        from ..query import stats as query_stats
+
+        res = self.fetch_tagged(ns, query, start, end, limit=limit)
+        with query_stats.stage("decode"):
+            return [
                 (
-                    np.asarray([dp.timestamp for dp in dps], np.int64),
-                    np.asarray([dp.value for dp in dps], np.float64),
-                ),
-            )
-            for sid, tags, dps in self.fetch_tagged(
-                ns, query, start, end, limit=limit
-            )
-        ]
+                    sid,
+                    tags,
+                    (
+                        np.asarray([dp.timestamp for dp in dps], np.int64),
+                        np.asarray([dp.value for dp in dps], np.float64),
+                    ),
+                )
+                for sid, tags, dps in res
+            ]
 
     def query_ids(self, ns, query, start, end, limit=None):
         docs, exhaustive = self._session(ns).query_ids(query, start, end, limit=limit)

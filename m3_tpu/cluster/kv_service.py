@@ -108,7 +108,7 @@ class KVServer(RpcServer):
 
     def __init__(self, store: KVStore | None = None, host: str = "127.0.0.1", port: int = 0):
         self.store = store or KVStore()
-        super().__init__(KVService(self.store), host=host, port=port)
+        super().__init__(KVService(self.store), host=host, port=port, component="kv")
 
 
 class RemoteKVStore:

@@ -16,6 +16,7 @@ import socket
 import threading
 import time
 
+from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
 from . import wire
 
@@ -78,7 +79,20 @@ class RpcClient:
             self._pool.clear()
 
     def _call(self, op: str, _retry: bool = True, _timeout: float | None = None, **args):
-        req = {"op": op, **args}
+        # trace propagation: when this RPC happens inside a traced request
+        # (a span is active on this thread), it gets its own client span and
+        # the context rides the wire so the server joins the same trace —
+        # the per-process spans stitch into one tree (Dapper propagation).
+        # Untraced calls (no active span) pay nothing.
+        if TRACER.active() and op not in wire.UNTRACED_OPS:
+            span = TRACER.span(f"rpc.client.{op}", peer=f"{self.host}:{self.port}")
+        else:
+            span = NOOP_SPAN
+        with span:
+            return self._call_traced(op, _retry, _timeout, args)
+
+    def _call_traced(self, op: str, _retry: bool, _timeout: float | None, args: dict):
+        req = wire.inject_trace({"op": op, **args}, TRACER.current_context())
         sock = self._acquire()
         try:
             if _timeout is not None:
@@ -209,6 +223,16 @@ class RemoteNode(RpcClient):
 
     def cache_stats(self) -> dict:
         return self._call("cache_stats")
+
+    def metrics(self) -> str:
+        """Prometheus text exposition of the remote process (the universal
+        scrape op every RpcServer answers via the middleware)."""
+        return self._call("metrics")
+
+    def traces(self, limit: int = 256) -> list[dict]:
+        """The remote process's recent spans — merge with other processes'
+        dumps by traceId to reassemble a cross-process trace."""
+        return self._call("traces", limit=limit)
 
     def owned_shards(self, cache_secs: float = 1.0) -> set[int]:
         cached = self._shards_cache

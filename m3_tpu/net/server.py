@@ -14,10 +14,111 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 
 from ..utils.instrument import DEFAULT as METRICS
+from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
 from . import wire
+
+
+class RpcMiddleware:
+    """Observability middleware over any ``handle(req) -> result`` service
+    (x/instrument's tally-scope-per-server role + opentracing adoption):
+
+    - per-op request/error counters, latency histograms, and an in-flight
+      gauge, all labeled {component, op} so one /metrics scrape separates
+      dbnode data-plane ops from control-plane KV traffic;
+    - trace adoption: an incoming request carrying a wire trace context
+      gets a server-side span that JOINS the client's trace (the other half
+      of net/client's injection) — a query fanning out coordinator → dbnode
+      replicas renders as one stitched tree in /debug/traces;
+    - a universal ``metrics`` op: services without their own op_metrics
+      (raft KV, loadgen agents) still answer a Prometheus scrape, so every
+      node in the fleet is scrapable over its existing RPC port.
+    """
+
+    def __init__(self, service, component: str = "rpc") -> None:
+        self.service = service
+        self.component = component
+        # per-op metric handles, resolved once: registry child resolution
+        # costs registry-lock round trips — the op set is small and fixed,
+        # so every request after the first is one dict lookup
+        self._per_op: dict = {}
+        self._per_op_lock = threading.Lock()
+
+    # op-label cardinality cap: op names come off the WIRE, and unknown ops
+    # are only rejected at dispatch — without a cap, a fuzzer sending unique
+    # bogus op strings would grow the process registry (and /metrics output)
+    # without bound. Real services have far fewer ops than this.
+    _MAX_OPS = 64
+
+    def _handles(self, op: str):
+        handles = self._per_op.get(op)
+        if handles is not None:
+            return handles
+        with self._per_op_lock:
+            handles = self._per_op.get(op)
+            if handles is not None:
+                return handles
+            if len(self._per_op) >= self._MAX_OPS:
+                op = "_overflow"
+                handles = self._per_op.get(op)
+                if handles is not None:
+                    return handles
+            labels = {"component": self.component, "op": op}
+            handles = self._per_op[op] = (
+                METRICS.counter("rpc_requests_total", labels=labels),
+                METRICS.counter("rpc_errors_total", labels=labels),
+                METRICS.gauge("rpc_inflight", labels=labels),
+                METRICS.histogram(
+                    "rpc_request_duration_seconds", labels=labels
+                ),
+            )
+            return handles
+
+    def handle(self, req: dict):
+        op = str(req.get("op"))
+        ctx = wire.extract_trace(req)
+        if op == "metrics" and not hasattr(self.service, "op_metrics"):
+            return METRICS.expose()
+        if ctx is not None and op not in wire.UNTRACED_OPS:
+            span = TRACER.span_from_context(
+                f"rpc.server.{op}", ctx, component=self.component
+            )
+        else:
+            span = NOOP_SPAN
+        requests, errors, inflight, hist = self._handles(op)
+        requests.inc()
+        inflight.add(1)
+        t0 = time.perf_counter()
+        try:
+            with span:
+                return self.service.handle(req)
+        except Exception:
+            errors.inc()
+            raise
+        finally:
+            hist.observe(time.perf_counter() - t0)
+            inflight.add(-1)
+
+
+class DebugService:
+    """Minimal RPC surface for processes with no data-plane service of
+    their own (the aggregator's rawtcp ingest is one-way): behind the
+    middleware it answers `health` and the universal `metrics` scrape, so
+    every daemon in the fleet exposes the same observability ops."""
+
+    def __init__(self, info: dict | None = None) -> None:
+        self.info = info or {}
+
+    def handle(self, req: dict):
+        op = req.get("op")
+        if op == "health":
+            return {"ok": True, **self.info}
+        if op == "traces":
+            return TRACER.dump(limit=req.get("limit") or 256)
+        raise ValueError(f"unknown op {op!r}")
 
 
 class NodeService:
@@ -33,12 +134,7 @@ class NodeService:
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             raise ValueError(f"unknown op {op!r}")
-        METRICS.counter("rpc_requests_total", labels={"op": str(op)}).inc()
-        try:
-            return fn(req)
-        except Exception:
-            METRICS.counter("rpc_errors_total", labels={"op": str(op)}).inc()
-            raise
+        return fn(req)
 
     # -- rpc.thrift surface --
 
@@ -125,6 +221,12 @@ class NodeService:
         """Self-observability exposition (x/instrument); Prometheus text."""
         return METRICS.expose()
 
+    def op_traces(self, req):
+        """This process's recent finished spans (the dbnode half of a
+        cross-process trace: merge with the coordinator's /debug/traces by
+        traceId to see the full tree)."""
+        return TRACER.dump(limit=req.get("limit") or 256)
+
     def op_cache_stats(self, req):
         """Decoded-block cache debug/status: hit/miss/eviction counters,
         resident bytes vs budget (m3_tpu/cache/)."""
@@ -146,9 +248,15 @@ class RpcServer:
     Serves the data plane (NodeService) and the control plane (cluster KV
     service) over the same framing."""
 
-    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, service, host: str = "127.0.0.1", port: int = 0,
+        component: str = "rpc",
+    ):
         self.service = service
-        svc = service
+        # every RPC server front end gets the observability middleware:
+        # per-op metrics, trace adoption, and a universal `metrics` scrape op
+        svc = RpcMiddleware(service, component=component)
+        self.middleware = svc
         # live connections, force-closed on stop() so blocked long-polls and
         # pooled client sockets see a reset (SIGKILL semantics) instead of
         # silently talking to a stopped server
@@ -222,3 +330,7 @@ class RpcServer:
 
 class NodeServer(RpcServer):
     """TCP front end for a NodeService."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 component: str = "dbnode"):
+        super().__init__(service, host=host, port=port, component=component)

@@ -184,6 +184,43 @@ def recv_frame(sock):
     return loads(_recv_exact(sock, n))
 
 
+# --- trace context propagation (Dapper-style; x/context StartSampledTraceSpan
+# carried this through TChannel headers in the reference) ---
+
+# reserved request-map key: [trace_id i64, parent span_id i64, sampled bool]
+TRACE_KEY = "_trace"
+
+# ops that pollers hammer (health checks, scrapes, shard-ownership probes):
+# spans for them would be all noise. ONE list shared by client injection and
+# server adoption — the exclusion must stay symmetric or traces end up
+# half-stitched (server spans with no client parent, or vice versa).
+UNTRACED_OPS = frozenset(
+    {"health", "metrics", "traces", "cache_stats", "owned_shards"}
+)
+
+
+def inject_trace(req: dict, ctx: dict | None) -> dict:
+    """Attach a tracer context (utils.trace.Tracer.current_context()) to an
+    RPC request map; no-op when there is no active sampled span."""
+    if ctx is not None:
+        req[TRACE_KEY] = [int(ctx["trace_id"]), int(ctx["span_id"]),
+                          bool(ctx.get("sampled", True))]
+    return req
+
+
+def extract_trace(req: dict) -> dict | None:
+    """Pop the trace context off an incoming request map (popped so op
+    handlers never see the reserved key). Malformed fields → None: a bad
+    peer must not break the request."""
+    raw = req.pop(TRACE_KEY, None)
+    if not isinstance(raw, list) or len(raw) != 3:
+        return None
+    tid, sid, sampled = raw
+    if not isinstance(tid, int) or not isinstance(sid, int):
+        return None
+    return {"trace_id": tid, "span_id": sid, "sampled": bool(sampled)}
+
+
 # --- query AST <-> wire values ---
 
 

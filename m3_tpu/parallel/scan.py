@@ -21,7 +21,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.chunked import ChunkedBatch, decode_chunked_lanes
 from ..ops.decode import decode_batched
+from ..utils.instrument import JitTracker
 from .mesh import SHARD_AXIS, series_mesh
+
+# jit compile observability for the batched decode kernel
+# (m3tpu_jit_compiles_total{kernel="m3tsz_decode"}): the first call per
+# (shape, max_points) signature blocks on XLA compilation
+_JIT_DECODE = JitTracker("m3tsz_decode")
 
 
 class ScanAggregates(NamedTuple):
@@ -78,8 +84,28 @@ def _aggregate_decoded(vals, valid, with_psum):
     )
 
 
+def _is_tracing(x) -> bool:
+    """True when ``x`` is an abstract tracer — i.e. this Python frame is
+    running under an outer jit/shard_map trace, where wall time measures
+    tracing (microseconds), not the XLA compile that happens later at the
+    outer jit boundary. Compile attribution would be wrong there."""
+    try:
+        from jax.core import Tracer
+    except ImportError:  # jax moved/renamed it: skip tracking, never break
+        return True
+    return isinstance(x, Tracer)
+
+
 def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psum):
-    res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
+    if _is_tracing(words):
+        res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
+    else:
+        # eager call: the first invocation per signature blocks on the jit
+        # compile of decode_batched, which is exactly what the tracker records
+        with _JIT_DECODE.track((tuple(words.shape), int(max_points))):
+            res = decode_batched(
+                words, num_bits, initial_unit, max_points=max_points
+            )
     return _aggregate_decoded(res.values_f32, res.valid, with_psum)
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import re as _re
 import threading
+import time
 from dataclasses import dataclass, replace
 from typing import Protocol
 
@@ -105,23 +106,40 @@ class Engine:
     def query_range(
         self, query: str, start_nanos: int, end_nanos: int, step_nanos: int
     ) -> Result:
-        ast = parse(query)
-        steps = int((end_nanos - start_nanos) // step_nanos) + 1
-        bounds = Bounds(start_nanos, step_nanos, steps)
-        # @ start()/end() bind to the TOP-LEVEL query range, even inside
-        # subqueries (prometheus PreprocessExpr)
-        _bind_at(ast, bounds)
-        if self.limits is None:
-            return self._eval(ast, bounds)
-        from .cost import Enforcer
+        # per-query accounting (stats.py): one QueryStats record rides a
+        # thread-local through engine → storage → database; sealed records
+        # feed the slow-query ring + m3tpu_query_* metrics. ``qs`` is None
+        # on nested evaluation (an outer query already owns the record).
+        from . import stats
 
-        enforcer = Enforcer(self.limits, self.global_enforcer)
-        self._enforcer.current = enforcer
+        qs = stats.start(query)
+        t_start = time.perf_counter()
+        err: str | None = None
         try:
-            return self._eval(ast, bounds)
+            with stats.stage("parse"):
+                ast = parse(query)
+            steps = int((end_nanos - start_nanos) // step_nanos) + 1
+            bounds = Bounds(start_nanos, step_nanos, steps)
+            # @ start()/end() bind to the TOP-LEVEL query range, even inside
+            # subqueries (prometheus PreprocessExpr)
+            _bind_at(ast, bounds)
+            if self.limits is None:
+                return self._eval(ast, bounds)
+            from .cost import Enforcer
+
+            enforcer = Enforcer(self.limits, self.global_enforcer)
+            self._enforcer.current = enforcer
+            try:
+                return self._eval(ast, bounds)
+            finally:
+                self._enforcer.current = None
+                enforcer.release()
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
         finally:
-            self._enforcer.current = None
-            enforcer.release()
+            if qs is not None:
+                stats.finish(qs, time.perf_counter() - t_start, error=err)
 
     def query_instant(self, query: str, time_nanos: int) -> Result:
         return self.query_range(query, time_nanos, time_nanos, NANOS)
@@ -134,7 +152,13 @@ class Engine:
         matchers = list(sel.matchers)
         if sel.name:
             matchers.append(Matcher("__name__", "=", sel.name))
-        raw = self.storage.fetch(matchers, start - self.lookback, end)
+        from . import stats
+
+        with stats.stage("fetch"):
+            raw = self.storage.fetch(matchers, start - self.lookback, end)
+        stats.add(
+            series=len(raw), datapoints=sum(len(t) for _, t, _ in raw)
+        )
         enforcer = getattr(self._enforcer, "current", None)
         if enforcer is not None:
             # charge fetched series + raw datapoints against the query's

@@ -23,7 +23,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utils.instrument import DEFAULT as METRICS
+from ...utils.instrument import JitTracker
 from . import temporal as T
+
+# jit compile observability (m3tpu_jit_compiles_total{kernel="temporal_fused"}):
+# first call per static signature blocks on Mosaic compilation — BENCH rounds
+# separate that warmup from steady-state throughput
+_JIT = JitTracker("temporal_fused")
+_M_PROCESSED = METRICS.counter(
+    "temporal_fused_input_bytes_total",
+    "bytes of range-vector input through the fused temporal kernel",
+)
 
 # name -> (fn(values, window, step_seconds) -> [S, T]) — only functions whose
 # math is pure elementwise/shift (Mosaic-lowerable); quantile_over_time's
@@ -94,7 +105,9 @@ def fused_temporal(values, window: int, step_seconds: float, funcs: tuple[str, .
     pad = (-s) % BLOCK_ROWS
     if pad:
         v = jnp.pad(v, ((0, pad), (0, 0)), constant_values=jnp.nan)
-    outs = _fused_call(v, tuple(funcs), int(window), float(step_seconds), t)
+    _M_PROCESSED.inc(int(v.size) * 4)
+    with _JIT.track((tuple(funcs), v.shape, int(window), float(step_seconds))):
+        outs = _fused_call(v, tuple(funcs), int(window), float(step_seconds), t)
     if not isinstance(outs, (list, tuple)):
         outs = (outs,)
     if pad:

@@ -48,15 +48,36 @@ class M3Storage:
     namespace: str
 
     def fetch(self, matchers, start_nanos, end_nanos):
+        from . import stats
+
         q = matchers_to_index_query(matchers)
         out = []
+        total_bytes = 0
+        # per-query cache accounting from the node-wide cache counter delta —
+        # approximate under concurrent queries (deltas interleave), exact in
+        # the common single-query case; the alternative (threading a stats
+        # handle through every Shard read) isn't worth the hot-path cost
+        cache = getattr(self.db, "block_cache", None)
+        before = cache.stats() if cache is not None else None
         # array surface: decoded arrays come straight from the decoded-block
         # cache (m3_tpu/cache/) on repeat queries — no per-point Datapoint
         # materialization on the scan-and-aggregate hot path
         for sid, tags, (times, vals) in self.db.fetch_tagged_arrays(
             self.namespace, q, start_nanos, end_nanos
         ):
-            out.append((tags, np.asarray(times, np.int64), np.asarray(vals, np.float64)))
+            times = np.asarray(times, np.int64)
+            vals = np.asarray(vals, np.float64)
+            total_bytes += times.nbytes + vals.nbytes
+            out.append((tags, times, vals))
+        if before is not None:
+            after = cache.stats()
+            stats.add(
+                bytes_=total_bytes,
+                cache_hits=after["hits"] - before["hits"],
+                cache_misses=after["misses"] - before["misses"],
+            )
+        else:
+            stats.add(bytes_=total_bytes)
         return out
 
 

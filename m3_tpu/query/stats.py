@@ -1,0 +1,202 @@
+"""Per-query cost accounting: stage timings + scan counters + slow-query ring.
+
+Reference shape: Monarch-style per-query accounting grafted onto the
+reference's query instrumentation (src/query/executor emits per-phase tally
+timers; src/x/debug serves recent state). One ``QueryStats`` record rides a
+thread-local through engine → storage adapter → database for the duration of
+a query, capturing:
+
+- per-stage wall seconds: ``parse``, ``index_resolve``, ``fetch``,
+  ``decode``, ``exec`` (fetch CONTAINS index_resolve + decode when storage
+  is local — stages are attributed, not disjoint; ``exec`` is total minus
+  fetch minus parse);
+- series / datapoints / bytes scanned, decoded-block cache hit/miss counts.
+
+Completed records land in a bounded ring served by the coordinator's
+``/debug/slow_queries`` route and feed the ``m3tpu_query_*`` histogram/
+counter families, so BENCH rounds can attribute a latency regression to the
+stage that actually moved.
+
+Configuration:
+
+    M3_TPU_SLOW_QUERY_CAPACITY   ring capacity (default 256)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..utils.instrument import DEFAULT as METRICS
+
+# buckets matched to query latencies (sub-ms cached instant queries up to
+# multi-second cold range scans)
+_QUERY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+@dataclass
+class QueryStats:
+    """One query's cost record (mutable while the query runs)."""
+
+    query: str = ""
+    start_unix_nanos: int = 0
+    duration_secs: float = 0.0
+    stages: dict = field(default_factory=dict)  # stage -> seconds
+    series_scanned: int = 0
+    datapoints_scanned: int = 0
+    bytes_scanned: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    trace_id: str | None = None  # links the record to its /debug/traces tree
+    error: str | None = None
+
+    def add_stage(self, name: str, secs: float) -> None:
+        self.stages[name] = self.stages.get(name, 0.0) + secs
+
+    def to_dict(self) -> dict:
+        return {
+            "query": self.query,
+            "startUnixNanos": self.start_unix_nanos,
+            "durationSecs": self.duration_secs,
+            "stages": dict(self.stages),
+            "seriesScanned": self.series_scanned,
+            "datapointsScanned": self.datapoints_scanned,
+            "bytesScanned": self.bytes_scanned,
+            "cacheHits": self.cache_hits,
+            "cacheMisses": self.cache_misses,
+            "traceId": self.trace_id,
+            "error": self.error,
+        }
+
+
+_local = threading.local()
+
+
+def current() -> QueryStats | None:
+    """The query record active on this thread (None outside a query)."""
+    return getattr(_local, "stats", None)
+
+
+def start(query: str) -> QueryStats | None:
+    """Begin a record for this thread's query; returns None when a record
+    is already active (nested evaluation — e.g. federation re-entry —
+    accumulates into the outer query's record instead of shadowing it)."""
+    if current() is not None:
+        return None
+    st = QueryStats(query=query, start_unix_nanos=time.time_ns())
+    from ..utils.trace import TRACER
+
+    ctx = TRACER.current_context()
+    if ctx is not None:
+        st.trace_id = f"{ctx['trace_id']:016x}"
+    _local.stats = st
+    return st
+
+
+def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> None:
+    """Seal + publish a record: ring, histograms, counters."""
+    _local.stats = None
+    st.duration_secs = duration_secs
+    st.error = error
+    fetch = st.stages.get("fetch", 0.0)
+    parse = st.stages.get("parse", 0.0)
+    st.add_stage("exec", max(duration_secs - fetch - parse, 0.0))
+    RING.record(st)
+    METRICS.counter("query_total", "completed queries").inc()
+    if error is not None:
+        METRICS.counter("query_errors_total", "failed queries").inc()
+    METRICS.histogram(
+        "query_duration_seconds", "query wall time", buckets=_QUERY_BUCKETS
+    ).observe(duration_secs)
+    for stage, secs in st.stages.items():
+        METRICS.histogram(
+            "query_stage_duration_seconds",
+            "per-stage query wall time",
+            labels={"stage": stage},
+            buckets=_QUERY_BUCKETS,
+        ).observe(secs)
+    METRICS.counter("query_series_scanned_total").inc(st.series_scanned)
+    METRICS.counter("query_datapoints_scanned_total").inc(st.datapoints_scanned)
+    METRICS.counter("query_bytes_scanned_total").inc(st.bytes_scanned)
+
+
+def add(
+    series: int = 0,
+    datapoints: int = 0,
+    bytes_: int = 0,
+    cache_hits: int = 0,
+    cache_misses: int = 0,
+) -> None:
+    """Charge scan counters against this thread's active query (no-op
+    outside a query, so storage paths call it unconditionally)."""
+    st = current()
+    if st is None:
+        return
+    st.series_scanned += series
+    st.datapoints_scanned += datapoints
+    st.bytes_scanned += bytes_
+    st.cache_hits += cache_hits
+    st.cache_misses += cache_misses
+
+
+class _Stage:
+    """``with stage("fetch"):`` — accumulates elapsed wall time onto the
+    active record; no-op (still times nothing extra) outside a query."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __enter__(self) -> "_Stage":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        st = current()
+        if st is not None:
+            st.add_stage(self.name, time.perf_counter() - self._t0)
+
+
+def stage(name: str) -> _Stage:
+    return _Stage(name)
+
+
+class SlowQueryRing:
+    """Bounded ring of completed query records, newest last (the x/debug
+    'recent expensive work' role). ``record`` is called for every completed
+    query; consumers filter/sort by duration — at debug-endpoint rates the
+    full ring is cheaper to ship than to pre-rank."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self._ring: deque[QueryStats] = deque(maxlen=max(capacity, 1))
+        self._lock = threading.Lock()
+
+    def record(self, st: QueryStats) -> None:
+        with self._lock:
+            self._ring.append(st)
+
+    def dump(self, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            records = list(self._ring)
+        if limit is not None:
+            records = records[-limit:] if limit > 0 else []
+        return [r.to_dict() for r in records]
+
+
+def _env_capacity() -> int:
+    try:
+        return int(os.environ.get("M3_TPU_SLOW_QUERY_CAPACITY", "256"))
+    except ValueError:
+        return 256
+
+
+# process-wide ring (what /debug/slow_queries serves); engines record here
+# unless constructed with their own ring
+RING = SlowQueryRing(_env_capacity())

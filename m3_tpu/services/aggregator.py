@@ -52,6 +52,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--instance-id", default="agg0")
     p.add_argument("--election-scope", default="default")
     p.add_argument("--election-lease-secs", type=float, default=10.0)
+    p.add_argument(
+        "--debug-port",
+        type=int,
+        default=-1,
+        help="serve health/metrics RPC ops on this port (0 = ephemeral, "
+        "-1 = disabled); prints DEBUG_LISTENING <host> <port> — the "
+        "aggregator's Prometheus scrape surface (the ingest stream is "
+        "one-way)",
+    )
     return p
 
 
@@ -128,6 +137,18 @@ def main(argv=None) -> int:
     )
     server = AggregatorIngestServer(agg, host=args.host, port=args.port)
 
+    debug_server = None
+    if args.debug_port >= 0:
+        from ..net.server import DebugService, RpcServer
+
+        debug_server = RpcServer(
+            DebugService({"role": "aggregator", "instance": args.instance_id}),
+            host=args.host,
+            port=args.debug_port,
+            component="aggregator",
+        )
+        debug_server.start()
+
     stop = threading.Event()
     flush_errors = [0]
 
@@ -153,6 +174,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, shutdown)
 
     print(f"LISTENING {server.host} {server.port}", flush=True)
+    if debug_server is not None:
+        print(f"DEBUG_LISTENING {debug_server.host} {debug_server.port}", flush=True)
     try:
         server.serve_forever()
     finally:
@@ -162,6 +185,8 @@ def main(argv=None) -> int:
             producer.retry_unacked()
         if forward_node is not None:
             forward_node.close()
+        if debug_server is not None:
+            debug_server.stop()
         if kv is not None:
             kv.close()
     return 0

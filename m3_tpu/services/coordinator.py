@@ -406,6 +406,11 @@ class _Handler(BaseHTTPRequestHandler):
             z.writestr("stacks.txt", "\n".join(stacks))
             z.writestr("metrics.txt", METRICS.expose())
             z.writestr("traces.json", json.dumps(TRACER.dump(limit=512), indent=1))
+            from ..query.stats import RING
+
+            z.writestr(
+                "slow_queries.json", json.dumps(RING.dump(limit=128), indent=1)
+            )
             with c.db.lock:
                 namespaces = list(c.db.namespaces.items())
             ns_info = {}
@@ -438,7 +443,10 @@ class _Handler(BaseHTTPRequestHandler):
 
             span = (
                 NOOP_SPAN
-                if url.path in ("/health", "/metrics", "/debug/traces", "/debug/dump")
+                if url.path in (
+                    "/health", "/metrics", "/debug/traces",
+                    "/debug/slow_queries", "/debug/dump",
+                )
                 else TRACER.span("http.get", path=url.path)
             )
             with span:
@@ -505,6 +513,11 @@ class _Handler(BaseHTTPRequestHandler):
                 elif url.path == "/debug/traces":
                     limit = int(q.get("limit", ["256"])[0])
                     self._json({"spans": TRACER.dump(limit=limit)})
+                elif url.path == "/debug/slow_queries":
+                    from ..query.stats import RING
+
+                    limit = int(q.get("limit", ["64"])[0])
+                    self._json({"queries": RING.dump(limit=limit)})
                 elif url.path == "/debug/dump":
                     self._send(
                         200, self._debug_dump(), ctype="application/zip"

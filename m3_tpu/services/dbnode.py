@@ -85,7 +85,9 @@ def main(argv=None) -> int:
             KVStore(),
             data_dir=_os.path.join(args.base_dir, "kv"),
         )
-        kv_server = RpcServer(RaftKVService(kv_raft), port=args.embed_kv_port)
+        kv_server = RpcServer(
+            RaftKVService(kv_raft), port=args.embed_kv_port, component="kv"
+        )
         kv_server.start()
         self_kv_ep = f"{kv_server.host}:{kv_server.port}"
         print(f"KV_LISTENING {kv_server.host} {kv_server.port}", flush=True)

@@ -54,7 +54,9 @@ def main(argv=None) -> int:
             heartbeat_interval=args.heartbeat_interval,
             election_timeout=(args.election_timeout_lo, args.election_timeout_hi),
         )
-        server = RpcServer(RaftKVService(node), host=args.host, port=args.port)
+        server = RpcServer(
+            RaftKVService(node), host=args.host, port=args.port, component="kv"
+        )
         self_ep = f"{server.host}:{server.port}"
         if args.members:
             members = dict(kv.split("=", 1) for kv in args.members.split(","))

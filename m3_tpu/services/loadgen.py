@@ -245,7 +245,7 @@ def _run_agent(args) -> int:
 
     from ..net.server import RpcServer
 
-    server = RpcServer(LoadgenAgentService(), port=args.listen)
+    server = RpcServer(LoadgenAgentService(), port=args.listen, component="loadgen")
 
     def shutdown(signum, frame):
         raise SystemExit(0)

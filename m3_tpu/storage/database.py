@@ -19,11 +19,18 @@ import numpy as np
 
 from ..cache import BlockCache, BlockKey, CacheInvalidator, CacheOptions, DecodedBlock
 from ..codec.m3tsz import Datapoint, decode
+from ..query import stats as query_stats
 from ..utils.hash import shard_for
 from ..utils.instrument import DEFAULT as METRICS
 from ..utils.serialize import decode_tags, is_tag_id
-from ..utils.trace import TRACER
+from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
+
+# decoded bytes off the compressed-stream hot path (BENCH attribution:
+# how much M3TSZ input each round actually decoded, cache hits excluded)
+_M_DECODED_BYTES = METRICS.counter(
+    "decoded_bytes_total", "compressed stream bytes decoded into arrays"
+)
 from .commitlog import CommitLog, CommitLogEntry
 from .fs import (
     CHUNK_K,
@@ -203,6 +210,7 @@ class Shard:
 
             def _decode(fid=fid):
                 stream = self._reader_locked(fid).stream(sid)
+                _M_DECODED_BYTES.inc(len(stream) if stream else 0)
                 arrs = decode_stream_arrays(stream or b"")
                 return None if arrs is None else DecodedBlock(*arrs)
 
@@ -248,6 +256,7 @@ class Shard:
             from ..codec.native_read import read_segments_arrays
 
             segments = self._segments_locked(sid, start, end)
+            _M_DECODED_BYTES.inc(sum(len(s) for s in segments))
             arrs = read_segments_arrays(segments, start, end)
             if arrs is not None:
                 return arrs
@@ -681,7 +690,8 @@ class Database:
         namespace = self.namespaces[ns]
         if namespace.index is None:
             raise RuntimeError(f"namespace {ns} has no index")
-        return namespace.index.query(query, start, end, limit=limit)
+        with query_stats.stage("index_resolve"):
+            return namespace.index.query(query, start, end, limit=limit)
 
     def aggregate_query(
         self, ns: str, query, start: int, end: int, field_filter=None
@@ -699,11 +709,23 @@ class Database:
         self, ns: str, query, start: int, end: int, limit: int | None = None
     ) -> list[tuple[bytes, tuple, list[Datapoint]]]:
         """Index query + per-series read (the FetchTagged server path,
-        tchannelthrift/node/service.go:626)."""
-        result = self.query_ids(ns, query, start, end, limit=limit)
-        out = []
-        for doc in result.docs:
-            out.append((doc.id, doc.fields, self.read(ns, doc.id, start, end)))
+        tchannelthrift/node/service.go:626). Inside a traced request (e.g.
+        a server-side RPC span) the index-resolve + decode work gets a
+        storage span so stitched traces show where node time went."""
+        span = (
+            TRACER.span("storage.fetch_tagged", namespace=ns)
+            if TRACER.active()
+            else NOOP_SPAN
+        )
+        with span:
+            result = self.query_ids(ns, query, start, end, limit=limit)
+            out = []
+            with query_stats.stage("decode"):
+                for doc in result.docs:
+                    out.append(
+                        (doc.id, doc.fields, self.read(ns, doc.id, start, end))
+                    )
+            span.set_tag("series", len(out))
         return out
 
     def fetch_tagged_arrays(
@@ -711,11 +733,19 @@ class Database:
     ) -> list[tuple[bytes, tuple, tuple]]:
         """FetchTagged on the array surface: (sid, tags, (times, values))
         per matched series, served through the decoded-block cache."""
-        result = self.query_ids(ns, query, start, end, limit=limit)
-        out = []
-        for doc in result.docs:
-            t, v, _u = self.read_arrays(ns, doc.id, start, end)
-            out.append((doc.id, doc.fields, (t, v)))
+        span = (
+            TRACER.span("storage.fetch_tagged", namespace=ns)
+            if TRACER.active()
+            else NOOP_SPAN
+        )
+        with span:
+            result = self.query_ids(ns, query, start, end, limit=limit)
+            out = []
+            with query_stats.stage("decode"):
+                for doc in result.docs:
+                    t, v, _u = self.read_arrays(ns, doc.id, start, end)
+                    out.append((doc.id, doc.fields, (t, v)))
+            span.set_tag("series", len(out))
         return out
 
     def cache_stats(self) -> dict:

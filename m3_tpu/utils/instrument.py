@@ -11,13 +11,21 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from dataclasses import dataclass, field
+
+
+def _escape_label_value(v) -> str:
+    """Prometheus text exposition label-value escaping: backslash, double
+    quote, and line feed must be escaped (exposition_formats.md) — regex
+    matchers used as label values otherwise corrupt the whole scrape."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 def _fmt_labels(labels: tuple) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -38,9 +46,16 @@ class Counter:
 class Gauge:
     def __init__(self) -> None:
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
         self._v = v
+
+    def add(self, n: float) -> None:
+        """Relative adjust (in-flight style gauges): must not lose updates
+        under concurrent RPC handler threads."""
+        with self._lock:
+            self._v += n
 
     @property
     def value(self) -> float:
@@ -66,6 +81,12 @@ class Histogram:
             self.counts[i] += 1
             self.sum += v
             self.total += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(counts, sum, total) read atomically vs concurrent observe() —
+        exposition must not report a count/sum pair from different instants."""
+        with self._lock:
+            return list(self.counts), self.sum, self.total
 
 
 @dataclass
@@ -116,6 +137,41 @@ class Registry:
             name, "histogram", help, labels, lambda: Histogram(buckets)
         )
 
+    def collect(self) -> dict:
+        """Structured snapshot of every family — the machine-readable
+        sibling of :meth:`expose` (bench.py's metrics JSON line and
+        tools/check_metrics.py consume this instead of re-parsing text).
+
+        Returns {name: {"kind", "help", "children": [{"labels", ...}]}}
+        where counter/gauge children carry {"value"} and histogram children
+        {"sum", "count", "buckets": [[le, cumulative_count], ...]}.
+        """
+        with self._lock:
+            fams = {
+                n: (f.kind, f.help, dict(f.children))
+                for n, f in sorted(self._fams.items())
+            }
+        out: dict = {}
+        for name, (kind, help_, children) in fams.items():
+            rows = []
+            for labels, m in sorted(children.items()):
+                row: dict = {"labels": dict(labels)}
+                if kind in ("counter", "gauge"):
+                    row["value"] = m.value
+                else:
+                    counts, h_sum, h_total = m.snapshot()
+                    acc, buckets = 0, []
+                    for b, c in zip(m.buckets, counts):
+                        acc += c
+                        buckets.append([float(b), acc])
+                    buckets.append([float("inf"), h_total])
+                    row.update(sum=h_sum, count=h_total, buckets=buckets)
+                rows.append(row)
+            out[f"{self.prefix}{name}"] = {
+                "kind": kind, "help": help_, "children": rows
+            }
+        return out
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         lines = []
@@ -134,17 +190,72 @@ class Registry:
                 if kind in ("counter", "gauge"):
                     lines.append(f"{full}{ls} {m.value}")
                 else:
+                    counts, h_sum, h_total = m.snapshot()
                     acc = 0
-                    for b, c in zip(m.buckets, m.counts):
+                    for b, c in zip(m.buckets, counts):
                         acc += c
                         lb = tuple(list(labels) + [("le", repr(float(b)))])
                         lines.append(f"{full}_bucket{_fmt_labels(lb)} {acc}")
                     lb = tuple(list(labels) + [("le", "+Inf")])
-                    lines.append(f"{full}_bucket{_fmt_labels(lb)} {m.total}")
-                    lines.append(f"{full}_sum{ls} {m.sum}")
-                    lines.append(f"{full}_count{ls} {m.total}")
+                    lines.append(f"{full}_bucket{_fmt_labels(lb)} {h_total}")
+                    lines.append(f"{full}_sum{ls} {h_sum}")
+                    lines.append(f"{full}_count{ls} {h_total}")
         return "\n".join(lines) + "\n"
 
 
 # the process-default registry (instrument.NewOptions default scope)
 DEFAULT = Registry(prefix="m3tpu_")
+
+
+class JitTracker:
+    """JAX hot-path compile observability: first call with an unseen static
+    signature is a jit cache miss, so its wall time ≈ compile time (jax
+    dispatch blocks on compilation; execution itself is async and cheap to
+    dispatch). Feeds m3tpu_jit_compiles_total / m3tpu_jit_compile_seconds_total
+    {kernel=...} so BENCH rounds can attribute warmup cost to the right
+    kernel without importing jax here.
+
+    Usage::
+
+        _JIT = JitTracker("temporal_fused")
+        with _JIT.track((funcs, values.shape, window)):
+            out = _fused_call(...)
+    """
+
+    def __init__(self, kernel: str, registry: Registry | None = None) -> None:
+        reg = registry or DEFAULT
+        self._compiles = reg.counter(
+            "jit_compiles_total", "jit cache misses", {"kernel": kernel}
+        )
+        self._seconds = reg.counter(
+            "jit_compile_seconds_total",
+            "wall seconds spent in first-call jit compilation",
+            {"kernel": kernel},
+        )
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def track(self, key):
+        return _JitCall(self, key)
+
+    def _observe(self, key, elapsed: float) -> None:
+        with self._lock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+        self._compiles.inc()
+        self._seconds.inc(elapsed)
+
+
+class _JitCall:
+    def __init__(self, tracker: JitTracker, key) -> None:
+        self.tracker = tracker
+        self.key = key
+
+    def __enter__(self) -> "_JitCall":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.tracker._observe(self.key, time.perf_counter() - self._t0)

@@ -1,0 +1,354 @@
+"""Observability substrate units: tracer context propagation + thread
+safety, Prometheus exposition correctness, per-query stats records, and the
+RPC middleware metrics (reference: x/instrument, x/context opentracing
+wiring, Dapper-style propagation)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from m3_tpu.query.stats import QueryStats, SlowQueryRing
+from m3_tpu.utils.instrument import Registry
+from m3_tpu.utils.trace import Tracer
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+
+# --- tracer: cross-thread + cross-process semantics ---
+
+
+def test_cross_thread_span_does_not_adopt_other_threads_stack():
+    """A span started on a worker thread must NOT silently become a child
+    of whatever span happens to be open on another thread."""
+    tr = Tracer()
+
+    def worker():
+        with tr.span("worker.child"):
+            pass
+
+    with tr.span("main.parent"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    spans = {s["name"]: s for s in tr.dump()}
+    child, parent = spans["worker.child"], spans["main.parent"]
+    assert child["parentId"] is None  # own root, not parent's child
+    assert child["traceId"] != parent["traceId"]
+
+
+def test_cross_thread_explicit_context_joins_trace():
+    """Explicit propagation (current_context -> span_from_context) is the
+    supported way to join a trace across threads/processes."""
+    tr = Tracer()
+    ctx_holder = {}
+
+    def worker(ctx):
+        with tr.span_from_context("worker.child", ctx):
+            with tr.span("worker.grandchild"):
+                pass
+
+    with tr.span("main.parent"):
+        ctx = tr.current_context()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    spans = {s["name"]: s for s in tr.dump()}
+    parent = spans["main.parent"]
+    child = spans["worker.child"]
+    grand = spans["worker.grandchild"]
+    assert child["traceId"] == parent["traceId"]
+    assert child["parentId"] == parent["spanId"]
+    assert grand["traceId"] == parent["traceId"]
+    assert grand["parentId"] == child["spanId"]
+
+
+def test_span_from_context_unsampled_is_noop():
+    """The upstream chose not to sample: downstream must not root a fresh
+    local trace (that would orphan one-span trees on every replica)."""
+    tr = Tracer()
+    with tr.span_from_context("s", {"trace_id": 1, "span_id": 2, "sampled": False}):
+        pass
+    assert tr.dump() == []
+    assert tr.started == 1
+    # a missing context still falls back to a normal local span
+    with tr.span_from_context("local", None):
+        pass
+    (span,) = tr.dump()
+    assert span["name"] == "local" and span["parentId"] is None
+
+
+def test_tracer_counters_thread_safe():
+    tr = Tracer()
+    n_threads, per_thread = 8, 200
+
+    def worker():
+        for _ in range(per_thread):
+            with tr.span("w"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.started == n_threads * per_thread
+    assert tr.sampled == n_threads * per_thread
+
+
+def test_tracer_from_env(monkeypatch):
+    monkeypatch.setenv("M3_TPU_TRACE_SAMPLE_RATE", "0.25")
+    monkeypatch.setenv("M3_TPU_TRACE_CAPACITY", "7")
+    tr = Tracer.from_env()
+    assert tr.sample_rate == 0.25
+    assert tr.finished.maxlen == 7
+    # malformed values fall back to defaults instead of raising at import
+    monkeypatch.setenv("M3_TPU_TRACE_SAMPLE_RATE", "lots")
+    monkeypatch.setenv("M3_TPU_TRACE_CAPACITY", "big")
+    tr = Tracer.from_env()
+    assert tr.sample_rate == 1.0
+    assert tr.finished.maxlen == 4096
+
+
+# --- wire-level trace context helpers ---
+
+
+def test_wire_trace_inject_extract_roundtrip():
+    from m3_tpu.net import wire
+
+    req = wire.inject_trace(
+        {"op": "fetch"}, {"trace_id": 11, "span_id": 22, "sampled": True}
+    )
+    # survives the wire codec
+    decoded = wire.loads(wire.dumps(req))
+    ctx = wire.extract_trace(decoded)
+    assert ctx == {"trace_id": 11, "span_id": 22, "sampled": True}
+    assert wire.TRACE_KEY not in decoded  # popped so op handlers never see it
+    # absent / malformed contexts read as None, not an error
+    assert wire.extract_trace({"op": "fetch"}) is None
+    assert wire.extract_trace({wire.TRACE_KEY: "bogus", "op": "x"}) is None
+    assert wire.extract_trace({wire.TRACE_KEY: [1, "x", True], "op": "x"}) is None
+
+
+# --- prometheus exposition ---
+
+
+def test_exposition_label_escaping():
+    reg = Registry(prefix="t_")
+    reg.counter(
+        "matched_total",
+        labels={"regex": 'env=~"prod.*"', "path": "a\\b", "note": "line1\nline2"},
+    ).inc()
+    text = reg.expose()
+    line = next(l for l in text.splitlines() if l.startswith("t_matched_total"))
+    assert '\\"prod.*\\"' in line  # quotes escaped
+    assert "a\\\\b" in line  # backslash escaped
+    assert "line1\\nline2" in line  # newline escaped
+    assert "\n" not in line  # the sample stays one line
+
+
+def test_exposition_histogram_cumulative_and_inf():
+    reg = Registry(prefix="t_")
+    h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.expose()
+    assert 't_lat_bucket{le="0.1"} 2' in text
+    assert 't_lat_bucket{le="1.0"} 3' in text  # cumulative, not per-bucket
+    assert 't_lat_bucket{le="10.0"} 4' in text
+    assert 't_lat_bucket{le="+Inf"} 5' in text
+    assert "t_lat_count 5" in text
+    assert "t_lat_sum 55.6" in text
+
+
+def test_registry_concurrent_registration_stress():
+    reg = Registry(prefix="t_")
+    errors = []
+
+    def worker(i):
+        try:
+            for j in range(200):
+                reg.counter("shared_total", labels={"w": str(j % 10)}).inc()
+                reg.histogram("shared_lat", labels={"w": str(j % 10)}).observe(0.01)
+                reg.gauge("shared_gauge").add(1)
+        except Exception as exc:  # registration races must not raise
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    collected = reg.collect()
+    total = sum(c["value"] for c in collected["t_shared_total"]["children"])
+    assert total == 8 * 200
+    assert collected["t_shared_gauge"]["children"][0]["value"] == 8 * 200
+    # kind conflicts still surface
+    with pytest.raises(ValueError):
+        reg.gauge("shared_total")
+
+
+def test_registry_collect_matches_expose():
+    reg = Registry(prefix="t_")
+    reg.counter("c_total").inc(2)
+    h = reg.histogram("h", buckets=(1.0,))
+    h.observe(0.5)
+    h.observe(2.0)
+    snap = reg.collect()
+    assert snap["t_c_total"]["children"][0]["value"] == 2.0
+    hrow = snap["t_h"]["children"][0]
+    assert hrow["count"] == 2 and hrow["buckets"][0] == [1.0, 1]
+    assert hrow["buckets"][-1][1] == 2  # +Inf cumulative == count
+
+
+# --- per-query stats ---
+
+
+def test_query_stats_record_and_ring(tmp_path):
+    from m3_tpu.block.core import make_tags
+    from m3_tpu.query import stats
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions())
+    for i in range(4):
+        tags = make_tags({"__name__": "qs_gauge", "i": str(i)})
+        for j in range(10):
+            db.write_tagged("default", tags, T0 + j * 10 * NANOS, float(i + j))
+    engine = Engine(M3Storage(db, "default"))
+    engine.query_range("qs_gauge", T0, T0 + 90 * NANOS, 10 * NANOS)
+    # the global ring may hold records from other tests — find ours
+    rec = next(
+        r for r in reversed(stats.RING.dump()) if r["query"] == "qs_gauge"
+    )
+    assert rec["seriesScanned"] == 4
+    assert rec["datapointsScanned"] == 40
+    assert rec["bytesScanned"] == 40 * 16  # i64 times + f64 values
+    assert rec["durationSecs"] > 0
+    for stage in ("parse", "fetch", "index_resolve", "decode", "exec"):
+        assert stage in rec["stages"], rec["stages"]
+    assert rec["stages"]["fetch"] > 0
+    assert rec["error"] is None
+    db.close()
+
+
+def test_query_stats_error_recorded(tmp_path):
+    from m3_tpu.query import stats
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=1, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions())
+    engine = Engine(M3Storage(db, "default"))
+    with pytest.raises(ValueError):
+        engine.query_range("this is not promql {{", T0, T0 + NANOS, NANOS)
+    rec = stats.RING.dump()[-1]
+    assert rec["error"] is not None
+    db.close()
+
+
+def test_slow_query_ring_bounded():
+    ring = SlowQueryRing(capacity=3)
+    for i in range(10):
+        ring.record(QueryStats(query=f"q{i}"))
+    dumped = ring.dump()
+    assert [r["query"] for r in dumped] == ["q7", "q8", "q9"]
+    assert [r["query"] for r in ring.dump(limit=2)] == ["q8", "q9"]
+
+
+# --- coordinator /debug/slow_queries route ---
+
+
+def test_debug_slow_queries_route():
+    from m3_tpu.services.coordinator import Coordinator, serve
+
+    coord = Coordinator()
+    srv, port = serve(coord)
+    try:
+        coord.db.write_tagged(
+            "default",
+            ((b"__name__", b"route_gauge"),),
+            T0,
+            1.0,
+        )
+        base = f"http://127.0.0.1:{port}"
+        urllib.request.urlopen(
+            f"{base}/api/v1/query_range?query=route_gauge"
+            f"&start={T0 // NANOS}&end={T0 // NANOS + 60}&step=15"
+        ).read()
+        out = json.loads(
+            urllib.request.urlopen(f"{base}/debug/slow_queries").read()
+        )
+        recs = [r for r in out["queries"] if r["query"] == "route_gauge"]
+        assert recs, out["queries"]
+        assert recs[-1]["seriesScanned"] == 1
+        assert recs[-1]["stages"]["fetch"] > 0
+    finally:
+        srv.shutdown()
+
+
+# --- rpc middleware: per-op metrics + universal metrics op ---
+
+
+def test_rpc_middleware_metrics_and_universal_scrape(tmp_path):
+    from m3_tpu.net.client import RpcClient
+    from m3_tpu.net.server import DebugService, RpcServer
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    server = RpcServer(DebugService({"role": "test"}), component="testsvc")
+    server.start()
+    client = RpcClient("127.0.0.1", server.port)
+    try:
+        assert client._call("health")["ok"] is True
+        # DebugService has no op_metrics: the middleware answers the scrape
+        text = client._call("metrics")
+        assert "m3tpu_rpc_requests_total" in text
+        with pytest.raises(Exception):
+            client._call("bogus_op")
+        snap = METRICS.collect()
+        reqs = {
+            tuple(sorted(c["labels"].items())): c["value"]
+            for c in snap["m3tpu_rpc_requests_total"]["children"]
+        }
+        key = (("component", "testsvc"), ("op", "health"))
+        assert reqs[key] >= 1
+        errs = {
+            tuple(sorted(c["labels"].items())): c["value"]
+            for c in snap["m3tpu_rpc_errors_total"]["children"]
+        }
+        assert errs[(("component", "testsvc"), ("op", "bogus_op"))] >= 1
+        hist = {
+            tuple(sorted(c["labels"].items())): c
+            for c in snap["m3tpu_rpc_request_duration_seconds"]["children"]
+        }
+        assert hist[key]["count"] >= 1
+        # in-flight gauge returned to zero after the calls completed
+        inflight = {
+            tuple(sorted(c["labels"].items())): c["value"]
+            for c in snap["m3tpu_rpc_inflight"]["children"]
+        }
+        assert inflight[key] == 0
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_rpc_middleware_op_label_cardinality_capped():
+    """Op names arrive off the wire: unique bogus ops must not grow the
+    metric registry without bound (they collapse to one _overflow label)."""
+    from m3_tpu.net.server import DebugService, RpcMiddleware
+
+    mw = RpcMiddleware(DebugService(), component="captest")
+    for i in range(3 * mw._MAX_OPS):
+        try:
+            mw.handle({"op": f"bogus_{i}"})
+        except ValueError:
+            pass
+    assert len(mw._per_op) <= mw._MAX_OPS + 1
+    assert "_overflow" in mw._per_op

@@ -64,15 +64,25 @@ def server():
 
 
 def test_debug_traces_route(server):
+    import time
+
     base, _ = server
     urllib.request.urlopen(f"{base}/health").read()  # pollers are NOT traced
     urllib.request.urlopen(f"{base}/api/v1/labels").read()
-    out = json.loads(urllib.request.urlopen(f"{base}/debug/traces").read())
-    spans = out["spans"]
-    assert any(
-        s["name"] == "http.get" and s["tags"].get("path") == "/api/v1/labels"
-        for s in spans
-    )
+    # the labels response can arrive a beat before the server records its
+    # span — poll briefly rather than racing the span exit
+    deadline = time.monotonic() + 5.0
+    while True:
+        out = json.loads(urllib.request.urlopen(f"{base}/debug/traces").read())
+        spans = out["spans"]
+        traced = any(
+            s["name"] == "http.get" and s["tags"].get("path") == "/api/v1/labels"
+            for s in spans
+        )
+        if traced or time.monotonic() > deadline:
+            break
+        time.sleep(0.02)
+    assert traced
     assert not any(s["tags"].get("path") == "/health" for s in spans)
 
 

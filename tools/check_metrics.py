@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Scrape a spawned dbnode + coordinator and fail on malformed Prometheus
+text exposition lines.
+
+CI guard for the fleet-wide /metrics surface: boots a real dbnode process
+(scraped over the RPC ``metrics`` op) and a real coordinator process
+(scraped over HTTP ``/metrics``), pushes a little traffic through both so
+the interesting families exist, then validates every exposition line —
+sample-line grammar, label quoting/escaping, histogram bucket monotonicity,
+and TYPE/HELP comment shape. Exit code 0 = clean, 1 = malformed lines.
+
+    JAX_PLATFORMS=cpu python tools/check_metrics.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{(.*)\}})? "
+    r"(-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\.[0-9]+)|[+-]?Inf|NaN)"
+    r"(?: -?[0-9]+)?$"
+)
+_COMMENT_RE = re.compile(rf"^# (HELP|TYPE) ({_NAME})(?: (.*))?$")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _parse_labels(raw: str) -> dict | None:
+    """Parse `k="v",k2="v2"`; None on any malformed quoting/escaping."""
+    labels: dict = {}
+    i, n = 0, len(raw)
+    while i < n:
+        m = re.match(rf"({_NAME})=\"", raw[i:])
+        if m is None:
+            return None
+        name = m.group(1)
+        i += m.end()
+        val = []
+        while i < n and raw[i] != '"':
+            if raw[i] == "\\":
+                if i + 1 >= n or raw[i + 1] not in ('\\', '"', "n"):
+                    return None  # invalid escape
+                val.append(raw[i : i + 2])
+                i += 2
+            elif raw[i] == "\n":
+                return None
+            else:
+                val.append(raw[i])
+                i += 1
+        if i >= n:
+            return None  # unterminated value
+        i += 1  # closing quote
+        labels[name] = "".join(val)
+        if i < n:
+            if raw[i] != ",":
+                return None
+            i += 1
+    return labels
+
+
+def validate_exposition(text: str) -> list[str]:
+    """All format violations in a Prometheus text exposition payload."""
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    # histogram cumulative-bucket check state: (name, frozen labels sans le)
+    buckets: dict[tuple, list[tuple[float, float]]] = {}
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _COMMENT_RE.match(line)
+            if m is None:
+                errors.append(f"line {lineno}: malformed comment: {line!r}")
+            elif m.group(1) == "TYPE" and m.group(3) not in _TYPES:
+                errors.append(f"line {lineno}: unknown TYPE {m.group(3)!r}")
+            elif m.group(1) == "TYPE":
+                types[m.group(2)] = m.group(3)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: malformed sample: {line!r}")
+            continue
+        name, rawlabels, _value = m.group(1), m.group(2), m.group(3)
+        labels = _parse_labels(rawlabels) if rawlabels else {}
+        if labels is None:
+            errors.append(f"line {lineno}: malformed labels: {line!r}")
+            continue
+        if name.endswith("_bucket") and "le" in labels:
+            base = name[: -len("_bucket")]
+            if types.get(base) == "histogram":
+                le = labels.pop("le")
+                bound = float("inf") if le == "+Inf" else float(le)
+                key = (base, tuple(sorted(labels.items())))
+                buckets.setdefault(key, []).append((bound, float(m.group(3))))
+    for (name, labels), rows in buckets.items():
+        if not rows or rows[-1][0] != float("inf"):
+            errors.append(f"{name}{dict(labels)}: histogram missing +Inf bucket")
+        for (b1, c1), (b2, c2) in zip(rows, rows[1:]):
+            if b2 < b1 or c2 < c1:
+                errors.append(
+                    f"{name}{dict(labels)}: non-cumulative buckets "
+                    f"({b1}:{c1} -> {b2}:{c2})"
+                )
+    return errors
+
+
+def _spawn(argv: list[str], marker: str = "LISTENING") -> tuple:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, *argv],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=repo,
+    )
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"{argv}: exited before {marker}")
+        if line.startswith(marker):
+            _, host, port = line.split()
+            return proc, host, int(port)
+
+
+def main() -> int:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="m3tpu-checkmetrics-") as base:
+        dbnode = coordinator = None
+        try:
+            dbnode, dh, dport = _spawn(
+                [
+                    "-m", "m3_tpu.services.dbnode",
+                    "--base-dir", os.path.join(base, "dbnode"),
+                    "--shards", "0,1,2,3", "--num-shards", "4",
+                    "--no-mediator",
+                ]
+            )
+            coordinator, ch, cport = _spawn(
+                [
+                    "-m", "m3_tpu.services.coordinator",
+                    "--base-dir", os.path.join(base, "coord"),
+                ]
+            )
+
+            # traffic through the dbnode RPC plane (including an escaping
+            # stressor: a label value with quotes/backslashes/newline must
+            # round-trip the exposition intact)
+            from m3_tpu.net.client import RemoteNode
+            from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+            METRICS.counter(
+                "checkmetrics_escape_probe_total",
+                labels={"matcher": 'env=~"prod\\d+.*"', "note": "a\nb'"},
+            ).inc()
+            node = RemoteNode(dh, dport)
+            t0 = 1_600_000_000 * 10**9
+            node.write("default", b"check_series", t0, 1.0)
+            node.health()
+            node_text = node.metrics() if hasattr(node, "metrics") else node._call("metrics")
+            node.close()
+            for err in validate_exposition(node_text):
+                failures.append(f"dbnode: {err}")
+            if "m3tpu_rpc_requests_total" not in node_text:
+                failures.append("dbnode: missing m3tpu_rpc_requests_total family")
+
+            # coordinator traffic + HTTP scrape
+            cbase = f"http://{ch}:{cport}"
+            urllib.request.urlopen(
+                f"{cbase}/api/v1/query_range?query=up&start=0&end=60&step=15"
+            ).read()
+            coord_text = urllib.request.urlopen(f"{cbase}/metrics").read().decode()
+            for err in validate_exposition(coord_text):
+                failures.append(f"coordinator: {err}")
+            for family in (
+                "m3tpu_query_duration_seconds",
+                "m3tpu_db_writes_total",
+            ):
+                if family not in coord_text:
+                    failures.append(f"coordinator: missing {family} family")
+            # the escape probe must validate ON THE WIRE (local registry —
+            # validates _fmt_labels escaping end to end)
+            local_text = METRICS.expose()
+            for err in validate_exposition(local_text):
+                failures.append(f"local-registry: {err}")
+            slow = json.loads(
+                urllib.request.urlopen(f"{cbase}/debug/slow_queries").read()
+            )
+            if "queries" not in slow:
+                failures.append("coordinator: /debug/slow_queries missing 'queries'")
+        finally:
+            for proc in (dbnode, coordinator):
+                if proc is not None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+    if failures:
+        for f in failures:
+            print(f"MALFORMED: {f}", file=sys.stderr)
+        print(f"FAIL: {len(failures)} exposition problem(s)", file=sys.stderr)
+        return 1
+    print("OK: dbnode + coordinator exposition clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
