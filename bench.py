@@ -6,14 +6,19 @@ reductions). Baseline for vs_baseline is the north-star target of 10B
 datapoints/sec/chip (BASELINE.json); the reference itself publishes no
 comparable hard number.
 
-Prints THREE JSON lines:
+Prints FOUR JSON lines:
   1. {"metric": "m3tsz_decode_aggregate_datapoints_per_sec_per_chip", ...}
      — the raw kernel scan-and-aggregate number.
   2. {"metric": "m3tsz_decode_aggregate_warm_cache_datapoints_per_sec_per_chip",
      ..., "hit_rate", "cold_value", "speedup_vs_cold"} — the repeated-query
      storage path (query/m3_storage.py fetch over sealed filesets) with the
      decoded-block cache (m3_tpu/cache/) warm, vs the same query cold.
-  3. {"metric": "process_metrics_snapshot", ...} — the benched process's own
+  3. {"metric": "m3tsz_resident_scan_datapoints_per_sec_per_chip", ...,
+     "pool_occupancy", "pool_bytes", "path"} — the compressed-residency
+     mode (m3_tpu/resident/): sealed blocks admitted to the HBM pool at
+     flush, warm scan_totals decoding from HBM with zero block-byte
+     transfer.
+  4. {"metric": "process_metrics_snapshot", ...} — the benched process's own
      m3tpu_* metrics (query latency histogram summary, per-stage latency,
      decoded bytes, jit compile count/seconds per kernel) so BENCH_*.json
      rounds can attribute a regression to the layer that actually moved.
@@ -43,6 +48,10 @@ def main() -> None:
         # the metrics snapshot below is purely in-process and must still
         # print — a lost line 2 shouldn't also cost line 3
         print(f"WARN warm-cache bench phase failed: {exc}", file=sys.stderr)
+    try:
+        bench_resident()
+    except Exception as exc:
+        print(f"WARN resident bench phase failed: {exc}", file=sys.stderr)
     metrics_snapshot_line()
 
 
@@ -227,8 +236,87 @@ def bench_warm_cache() -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def bench_resident() -> None:
+    """Compressed-residency mode: seal blocks into the HBM-resident pool
+    (admission happens at flush), then measure the warm decode-from-HBM
+    scan (query/m3_storage.py scan_totals, resident path) — zero block
+    bytes cross host->device per scan, asserted via the pool counters."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.query.promql import Matcher
+    from m3_tpu.resident import ResidentOptions
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    NANOS = 1_000_000_000
+    n_series = int(os.environ.get("BENCH_RESIDENT_SERIES", 256))
+    n_points = 720
+    t0 = 1_600_000_000 * NANOS
+    step = 10 * NANOS
+    base = tempfile.mkdtemp(prefix="m3tpu-bench-resident-")
+    try:
+        db = Database(
+            base,
+            num_shards=8,
+            commitlog_enabled=False,
+            resident_options=ResidentOptions(max_bytes=1 << 30),
+        )
+        db.create_namespace("bench", NamespaceOptions())
+        rng = np.random.default_rng(11)
+        for i in range(n_series):
+            tags = ((b"__name__", b"bench_gauge"), (b"series", b"%06d" % i))
+            sid = db.write_tagged("bench", tags, t0, float(rng.standard_normal()))
+            vals = rng.standard_normal(n_points - 1)
+            db.write_batch(
+                "bench",
+                [
+                    (sid, t0 + (j + 1) * step, float(vals[j]))
+                    for j in range(n_points - 1)
+                ],
+            )
+        db.flush("bench", t0 + 4 * 3600 * NANOS)  # seal + admit
+        storage = M3Storage(db, "bench")
+        matchers = [Matcher("__name__", "=", "bench_gauge")]
+        span = (t0, t0 + n_points * step)
+
+        first = storage.scan_totals(matchers, *span)  # compile + warm
+        assert first["count"] == n_series * n_points, first
+        before = db.resident_stats()
+        iters = 5
+        t_start = time.perf_counter()
+        for _ in range(iters):
+            out = storage.scan_totals(matchers, *span)
+        dt = (time.perf_counter() - t_start) / iters
+        after = db.resident_stats()
+        transferred = (after["upload_bytes"] - before["upload_bytes"]) + (
+            after["streamed_bytes"] - before["streamed_bytes"]
+        )
+        dps = out["count"] / dt
+        db.close()
+        print(
+            json.dumps(
+                {
+                    "metric": "m3tsz_resident_scan_datapoints_per_sec_per_chip",
+                    "value": round(dps, 1),
+                    "unit": "datapoints/s",
+                    "vs_baseline": round(dps / NORTH_STAR, 6),
+                    "path": out["path"],
+                    "series": n_series,
+                    "pool_bytes": after["bytes"],
+                    "pool_occupancy": round(after["occupancy"], 6),
+                    "warm_block_bytes_transferred": transferred,
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def metrics_snapshot_line() -> None:
-    """Third JSON line: the benched process's own metrics registry, reduced
+    """Final JSON line: the benched process's own metrics registry, reduced
     to the families BENCH rounds attribute regressions with."""
     from m3_tpu.utils.instrument import DEFAULT as METRICS
 

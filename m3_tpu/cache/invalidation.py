@@ -11,7 +11,14 @@ persist/fs/merger.go writes a NEW volume; tick expiry deletes filesets
 past retention — shard.go:663 tickAndExpire), and (c) give operators a
 full flush (clear).
 
-Every hook is a no-op without a cache, so storage wiring stays
+The SAME hooks drive every resident tier: the decoded-block cache
+(block_cache.py) and the HBM-resident compressed pool
+(m3_tpu/resident/pool.py) expose the same targeted-invalidation surface
+(invalidate_series_block / invalidate_block / clear), so one hook call
+keeps both coherent — a written-to, superseded, or expired block is
+never resident ANYWHERE.
+
+Every hook is a no-op without targets, so storage wiring stays
 unconditional.
 """
 
@@ -19,47 +26,58 @@ from __future__ import annotations
 
 
 class CacheInvalidator:
-    """Targeted invalidation surface over one BlockCache (or None)."""
+    """Targeted invalidation surface over the node's resident tiers:
+    the decoded-block cache and/or the compressed resident pool (each
+    may be None)."""
 
-    def __init__(self, cache=None) -> None:
+    def __init__(self, cache=None, pool=None) -> None:
         self.cache = cache
+        self.pool = pool
 
-    def _live(self) -> bool:
-        # len() without the cache lock is a cheap hint: an empty cache
-        # (the common case on the hot write path) skips the lock
-        return self.cache is not None and len(self.cache) > 0
+    def _targets(self):
+        # len() without the target lock is a cheap hint: an empty tier
+        # (the common case on the hot write path) skips its lock
+        out = []
+        if self.cache is not None and len(self.cache) > 0:
+            out.append(self.cache)
+        if self.pool is not None and len(self.pool) > 0:
+            out.append(self.pool)
+        return out
 
     def on_write(self, namespace: str, shard_id: int, series_id: bytes, block_start: int) -> int:
         """Shard.write / write_batch: a datapoint landed in (series, block).
         The buffered point overlays cached fileset arrays at read time, so
         entries are not stale — but drop them anyway: the contract is that
-        a written-to block is re-merged from source on next read."""
-        if not self._live():
-            return 0
-        return self.cache.invalidate_series_block(
-            namespace, shard_id, series_id, block_start
-        )
+        a written-to block is re-merged from source on next read (and the
+        resident scan must fall back to the streamed path, which sees the
+        buffer overlay)."""
+        dropped = 0
+        for t in self._targets():
+            dropped += t.invalidate_series_block(
+                namespace, shard_id, series_id, block_start
+            )
+        return dropped
 
     def on_flush(self, namespace: str, shard_id: int, fileset_ids) -> int:
         """warm_flush/cold_flush: each flushed FilesetID supersedes every
         lower volume of its block (cold flush merges into a new volume);
         superseded entries can never hit again — reclaim their bytes."""
-        if not self._live():
-            return 0
+        targets = self._targets()
         dropped = 0
         for fid in fileset_ids:
-            dropped += self.cache.invalidate_block(
-                namespace, shard_id, fid.block_start, below_volume=fid.volume
-            )
+            for t in targets:
+                dropped += t.invalidate_block(
+                    namespace, shard_id, fid.block_start, below_volume=fid.volume
+                )
         return dropped
 
     def on_tick_expire(self, namespace: str, shard_id: int, block_starts) -> int:
         """Tick retention expiry: the fileset is deleted off disk."""
-        if not self._live():
-            return 0
+        targets = self._targets()
         dropped = 0
         for bs in block_starts:
-            dropped += self.cache.invalidate_block(namespace, shard_id, bs)
+            for t in targets:
+                dropped += t.invalidate_block(namespace, shard_id, bs)
         return dropped
 
     def on_repair(self, namespace: str, shard_id: int, series_id: bytes, block_start: int) -> int:
@@ -68,8 +86,9 @@ class CacheInvalidator:
         already fires on_write per point; this hook covers the block once
         more so repaired blocks re-merge even when every streamed point was
         skipped as a cold-write reject)."""
-        if not self._live():
-            return 0
-        return self.cache.invalidate_series_block(
-            namespace, shard_id, series_id, block_start
-        )
+        dropped = 0
+        for t in self._targets():
+            dropped += t.invalidate_series_block(
+                namespace, shard_id, series_id, block_start
+            )
+        return dropped

@@ -109,6 +109,11 @@ class RaftNode:
         self.last_applied = 0
         self._last_hb = time.monotonic()
         self._timeout = random.uniform(*election_timeout)
+        # read-barrier lease: (term, monotonic stamp) of the last quorum
+        # leadership confirmation (no-op commit); reads within one
+        # heartbeat interval of it skip re-confirming
+        self._barrier_term = -1
+        self._barrier_at = 0.0
 
         # leader volatile
         self.next_index: dict[str, int] = {}
@@ -630,6 +635,50 @@ class RaftNode:
             raise waiter.error
         return waiter.result
 
+    def read_barrier(self, timeout: float = 5.0) -> None:
+        """Linearizable-read barrier (the no-op-commit flavor of etcd's
+        ReadIndex): confirm this node is STILL the quorum's leader — a
+        deposed leader in a partition minority must not serve reads from
+        its stale applied state — then wait ``last_applied >=
+        commit_index`` so the state machine reflects everything the read
+        must observe.
+
+        Confirmation commits a no-op through the log (its quorum
+        replication IS the leadership proof, and propose() returns only
+        after the entry applies, which also satisfies the apply barrier).
+        A lease bounds the cost: within one heartbeat interval of a
+        confirmation in the same term only the apply-catch-up wait runs —
+        the standard lease-read trade-off (a stale read window exists only
+        under clock malfunction within that interval).
+
+        Raises NotLeaderError when not leader, RetryableError on timeout.
+        """
+        with self._mu:
+            if self.role != "leader":
+                raise NotLeaderError(self.leader_endpoint or "")
+            commit = self.commit_index
+            single = len(self.members) <= 1
+            fresh = (
+                self._barrier_term == self.term
+                and time.monotonic() - self._barrier_at < self.heartbeat_interval
+            )
+        if not single and not fresh:
+            self.propose({"op": "noop"}, timeout=timeout)
+            with self._mu:
+                self._barrier_term = self.term
+                self._barrier_at = time.monotonic()
+            return  # the noop applied => last_applied >= its index > commit
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._mu:
+                if self.last_applied >= commit:
+                    return
+                if self.role != "leader":
+                    raise NotLeaderError(self.leader_endpoint or "")
+            if time.monotonic() >= deadline:
+                raise RetryableError("read barrier apply-wait timeout")
+            time.sleep(0.002)
+
     def _applier(self) -> None:
         # each entry is applied UNDER the raft lock so a concurrent
         # install-snapshot or conflict truncation can never interleave with
@@ -752,10 +801,14 @@ class _Waiter:
 
 
 class RaftKVService:
-    """KV service front end over a RaftNode: reads + watches from local
-    applied state (any replica), writes + leases proposed through the log
-    (leader only; followers redirect with NotLeaderError). Peer raft RPCs
-    ride the same dispatch table — one server port per kvnode."""
+    """KV service front end over a RaftNode: plain reads are
+    LINEARIZABLE — leader-only behind a read barrier (quorum leadership
+    confirmation + apply catch-up, read_barrier above) so they are never
+    stale across partitions; watches serve from any replica's applied
+    state (version-gated, eventually consistent by design); writes +
+    leases propose through the log (leader only; followers redirect with
+    NotLeaderError). Peer raft RPCs ride the same dispatch table — one
+    server port per kvnode."""
 
     def __init__(self, node: RaftNode) -> None:
         from .kv_service import KVService
@@ -764,16 +817,20 @@ class RaftKVService:
         self.store = node.store
         self._reads = KVService(node.store)
 
-    # linearizable-by-default reads (etcd's default): a follower's applied
-    # state may lag the commit point, so plain reads redirect to the leader;
-    # watches are version-gated long-polls and stay on any replica (they
-    # deliver eventually and never regress)
+    # linearizable-by-default reads (etcd's default): plain reads redirect
+    # to the leader AND pass a read barrier there (RaftNode.read_barrier:
+    # quorum leadership confirmation + last_applied catch-up) — a deposed
+    # leader in a partition minority redirects or times out instead of
+    # serving stale state. Watches are version-gated long-polls and stay
+    # on any replica (they deliver eventually and never regress).
     LEADER_READS = frozenset({"kv_get", "kv_keys", "kv_get_prefix"})
 
     def handle(self, req: dict):
         op = req.get("op")
-        if op in self.LEADER_READS and not self.node.is_leader:
-            raise NotLeaderError(self.node.leader_endpoint or "")
+        if op in self.LEADER_READS:
+            if not self.node.is_leader:
+                raise NotLeaderError(self.node.leader_endpoint or "")
+            self.node.read_barrier()
         fn = getattr(self, f"op_{op}", None)
         if fn is not None:
             return fn(req)

@@ -224,6 +224,21 @@ class RemoteNode(RpcClient):
     def cache_stats(self) -> dict:
         return self._call("cache_stats")
 
+    def resident_stats(self) -> dict:
+        """HBM-resident compressed pool stats (m3_tpu/resident/)."""
+        return self._call("resident_stats")
+
+    def flush(self, ns, flush_before) -> list:
+        """Seal buffered blocks before the cutoff (operator/CI surface)."""
+        return self._call("flush", ns=ns, flush_before=flush_before)
+
+    def scan_totals(self, ns, matchers, start, end) -> dict:
+        """Raw-sample scan-and-aggregate; ``matchers``:
+        [[name, op, value], ...] (see NodeService.op_scan_totals)."""
+        return self._call(
+            "scan_totals", ns=ns, matchers=list(matchers), start=start, end=end
+        )
+
     def metrics(self) -> str:
         """Prometheus text exposition of the remote process (the universal
         scrape op every RpcServer answers via the middleware)."""

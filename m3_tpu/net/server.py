@@ -232,6 +232,34 @@ class NodeService:
         resident bytes vs budget (m3_tpu/cache/)."""
         return self.db.cache_stats()
 
+    def op_resident_stats(self, req):
+        """HBM-resident compressed pool debug/status: admissions,
+        pages/bytes/occupancy, eviction + invalidation counters, and the
+        upload/streamed byte counters warm-scan zero-transfer checks key
+        on (m3_tpu/resident/)."""
+        return self.db.resident_stats()
+
+    def op_flush(self, req):
+        """Operator/CI flush: seal buffered blocks before the cutoff
+        (the mediator does this on its own cadence; tools/check_resident
+        drives it explicitly to make seal-time admission observable)."""
+        flushed = self.db.flush(req["ns"], req["flush_before"])
+        return [[f.namespace, f.shard, f.block_start, f.volume] for f in flushed]
+
+    def op_scan_totals(self, req):
+        """Raw-sample scan-and-aggregate over matched series (block
+        granularity): routed to the decode-from-HBM path when every
+        matched block is resident, streamed otherwise — the wire face of
+        M3Storage.scan_totals. ``matchers``: [[name, op, value], ...]."""
+        from ..query.m3_storage import M3Storage
+        from ..query.promql import Matcher
+
+        matchers = [
+            Matcher(str(n), str(op), str(v)) for n, op, v in req["matchers"]
+        ]
+        storage = M3Storage(self.db, req["ns"])
+        return storage.scan_totals(matchers, req["start"], req["end"])
+
     def op_owned_shards(self, req):
         return sorted(self.assigned_shards)
 

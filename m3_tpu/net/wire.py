@@ -195,7 +195,8 @@ TRACE_KEY = "_trace"
 # server adoption — the exclusion must stay symmetric or traces end up
 # half-stitched (server spans with no client parent, or vice versa).
 UNTRACED_OPS = frozenset(
-    {"health", "metrics", "traces", "cache_stats", "owned_shards"}
+    {"health", "metrics", "traces", "cache_stats", "resident_stats",
+     "owned_shards"}
 )
 
 

@@ -16,13 +16,28 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 top-level API
+    from jax import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental namespace, check_rep knob
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
 
 from ..ops.chunked import ChunkedBatch, decode_chunked_lanes
 from ..ops.decode import decode_batched
 from ..utils.instrument import JitTracker
 from .mesh import SHARD_AXIS, series_mesh
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable shard_map: new jax calls it check_vma, old jax
+    check_rep — semantics (skip the replication check) are the same."""
+    kw = {_SHARD_MAP_CHECK_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
 # jit compile observability for the batched decode kernel
 # (m3tpu_jit_compiles_total{kernel="m3tsz_decode"}): the first call per
@@ -390,3 +405,90 @@ def sharded_scan_aggregate(
 ) -> ScanAggregates:
     mesh = mesh if mesh is not None else series_mesh()
     return make_sharded_scan(mesh, max_points)(words, num_bits, initial_unit)
+
+
+# ---------------------------------------------------------------------------
+# Decode-from-HBM: scan over the paged resident pool (m3_tpu/resident/)
+# ---------------------------------------------------------------------------
+#
+# The residency variant of the scan path: sealed blocks' compressed words
+# already live in device memory as fixed-size pages, so a scan gathers each
+# lane's page ROWS on device (a contiguous-row gather, not a scalar one) and
+# feeds the same decode kernel — zero block bytes cross PCIe, and series
+# selection is the page-row gather instead of a host select/pack.
+
+_JIT_RESIDENT = JitTracker("resident_gather_decode")
+
+
+def gather_lane_words(pool_words, page_rows):
+    """Device gather: pool u32[P, W] + page rows i32[S, L] -> words
+    u32[S, L*W]. Lane slots past a stream's span point at the reserved
+    zero page, so the result is bit-identical to a zero-padded
+    BatchedSegments word matrix."""
+    s = page_rows.shape[0]
+    rows = jnp.asarray(page_rows, jnp.int32)
+    return jnp.take(jnp.asarray(pool_words, jnp.uint32), rows, axis=0).reshape(s, -1)
+
+
+def resident_scan_aggregate(
+    pool_words, page_rows, num_bits, initial_unit, max_points: int, with_psum=False
+) -> ScanAggregates:
+    """Single-device decode-from-HBM scan + aggregate. ``series_err``
+    carries the device decoder's bail flags (annotated streams etc.) so
+    callers stitch those lanes through the host codec
+    (stitch_host_errors) instead of silently under-counting them."""
+    words = gather_lane_words(pool_words, page_rows)
+    if _is_tracing(words):
+        res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
+    else:
+        with _JIT_RESIDENT.track((tuple(words.shape), int(max_points))):
+            res = decode_batched(
+                words, num_bits, initial_unit, max_points=max_points
+            )
+    aggs = _aggregate_decoded(res.values_f32, res.valid, with_psum)
+    return aggs._replace(series_err=res.err)
+
+
+def scan_aggregate_with_err(
+    words, num_bits, initial_unit, max_points: int
+) -> ScanAggregates:
+    """scan_aggregate plus the per-series device decode-error flags —
+    the streamed twin of resident_scan_aggregate's err surface."""
+    if _is_tracing(words):
+        res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
+    else:
+        with _JIT_DECODE.track((tuple(words.shape), int(max_points))):
+            res = decode_batched(
+                words, num_bits, initial_unit, max_points=max_points
+            )
+    aggs = _aggregate_decoded(res.values_f32, res.valid, False)
+    return aggs._replace(series_err=res.err)
+
+
+def make_sharded_resident_scan(mesh, max_points: int):
+    """Sharded decode-from-HBM scan: page rows + lane metadata shard over
+    the mesh's series axis while the page pool rides replicated (each
+    device of a real mesh holds its placement's pages; on the forced CPU
+    test mesh replication is free). The cross-series psum reduction is the
+    existing one — only the word source changed."""
+    fn = shard_map(
+        functools.partial(
+            resident_scan_aggregate, max_points=max_points, with_psum=True
+        ),
+        mesh=mesh,
+        in_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=ScanAggregates(
+            series_sum=P(SHARD_AXIS),
+            series_count=P(SHARD_AXIS),
+            series_min=P(SHARD_AXIS),
+            series_max=P(SHARD_AXIS),
+            series_last=P(SHARD_AXIS),
+            total_sum=P(),
+            total_count=P(),
+            total_min=P(),
+            total_max=P(),
+            series_err=P(SHARD_AXIS),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(fn)

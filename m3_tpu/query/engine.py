@@ -144,6 +144,43 @@ class Engine:
     def query_instant(self, query: str, time_nanos: int) -> Result:
         return self.query_range(query, time_nanos, time_nanos, NANOS)
 
+    def scan_totals(self, query: str, start_nanos: int, end_nanos: int) -> dict:
+        """Flagship raw-sample scan as an engine surface: ``query`` must
+        be a plain vector selector (e.g. ``metric{job="x"}``) — the
+        totals are whole-block reductions over the matched series'
+        compressed streams, NOT PromQL semantics (no step grid, no
+        lookback consolidation). Routing is the storage adapter's:
+        decode-from-HBM when every matched block is resident
+        (m3_tpu/resident/), streamed upload+decode otherwise; the result's
+        ``path`` field and the per-query resident_hit counters
+        (query/stats.py) record which way it went."""
+        from . import stats
+
+        storage_scan = getattr(self.storage, "scan_totals", None)
+        if storage_scan is None:
+            raise ValueError("storage does not support scan_totals")
+        qs = stats.start(f"scan_totals({query})")
+        t_start = time.perf_counter()
+        err: str | None = None
+        try:
+            with stats.stage("parse"):
+                ast = parse(query)
+            if not isinstance(ast, VectorSelector):
+                raise ValueError("scan_totals: query must be a vector selector")
+            if ast.at_nanos is not None or ast.offset_nanos:
+                raise ValueError("scan_totals: @/offset modifiers unsupported")
+            matchers = list(ast.matchers)
+            if ast.name:
+                matchers.append(Matcher("__name__", "=", ast.name))
+            with stats.stage("fetch"):
+                return storage_scan(matchers, start_nanos, end_nanos)
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            if qs is not None:
+                stats.finish(qs, time.perf_counter() - t_start, error=err)
+
     # --- evaluation ---
 
     def _fetch(self, sel: VectorSelector, bounds: Bounds, extra_steps: int = 0) -> Result:

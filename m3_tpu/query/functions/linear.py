@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import math
 
+import jax.lax as _lax
 import jax.numpy as jnp
 import numpy as np
 
@@ -160,9 +161,10 @@ def histogram_quantile(q: float, values, index, bounds):
         has = jnp.any(valid, axis=1)
         return jnp.where(has, -jnp.inf if q < 0 else jnp.inf, jnp.nan)
 
-    # ensureMonotonic over valid buckets
+    # ensureMonotonic over valid buckets (lax.cummax == maximum.accumulate,
+    # and exists on every supported jax version)
     vm = jnp.where(valid, v, -jnp.inf)
-    vm = jnp.maximum.accumulate(vm, axis=1)
+    vm = _lax.cummax(vm, axis=1)
     v = jnp.where(valid, jnp.maximum(v, vm), v)
 
     le = jnp.broadcast_to(bounds[:, :, None], (g, b, t))
@@ -183,7 +185,7 @@ def histogram_quantile(q: float, values, index, bounds):
 
     # previous valid bucket before each bucket (for start bound / count)
     prev_idx = jnp.concatenate(
-        [jnp.full((g, 1, t), -1, jnp.int32), jnp.maximum.accumulate(jnp.where(valid, bidx, -1), axis=1)[:, :-1]],
+        [jnp.full((g, 1, t), -1, jnp.int32), _lax.cummax(jnp.where(valid, bidx, -1), axis=1)[:, :-1]],
         axis=1,
     )  # [G, B, T] index of last valid bucket strictly before b
 

@@ -40,6 +40,18 @@ def matchers_to_index_query(matchers: list[Matcher]):
     return conj(*qs)
 
 
+class _EmptyTotals:
+    """ScanAggregates stand-in for a scan that matched no lanes."""
+
+    total_sum = 0.0
+    total_count = 0
+    total_min = float("nan")
+    total_max = float("nan")
+
+
+_EMPTY_TOTALS = _EmptyTotals()
+
+
 @dataclass
 class M3Storage:
     """Engine Storage over one Database namespace."""
@@ -51,20 +63,51 @@ class M3Storage:
         from . import stats
 
         q = matchers_to_index_query(matchers)
+        # decode-from-HBM fast path (m3_tpu/resident/): when every matched
+        # block is resident and no live buffer overlays the range, series
+        # selection is a device gather of page rows + ONE batched decode —
+        # replacing the per-series host select/decode loop below (the
+        # VERDICT round-5 host-bound select/pack gap). The index resolves
+        # ONCE: the resident plan and any fallback share `docs`. Cache
+        # before-stats are captured up front so the pooled fallback's
+        # decode work is accounted like the plain path's.
+        cache = getattr(self.db, "block_cache", None)
+        before = cache.stats() if cache is not None else None
+        pool = getattr(self.db, "resident_pool", None)
+        rows = None
+        if pool is not None and pool.enabled and len(pool) > 0:
+            docs = self.db.query_ids(
+                self.namespace, q, start_nanos, end_nanos
+            ).docs
+            resident = self._fetch_resident(docs, start_nanos, end_nanos)
+            if resident is not None:
+                stats.add(
+                    resident_hits=1,
+                    bytes_=sum(t.nbytes + v.nbytes for _, t, v in resident),
+                )
+                return resident
+            # fall back through the normal array surface, reusing the
+            # plan's index resolution (fetch_tagged_arrays also restores
+            # the storage.fetch_tagged span this path must keep emitting)
+            rows = self.db.fetch_tagged_arrays(
+                self.namespace, q, start_nanos, end_nanos, docs=docs
+            )
+        if pool is not None:
+            stats.add(resident_misses=1)
         out = []
         total_bytes = 0
         # per-query cache accounting from the node-wide cache counter delta —
         # approximate under concurrent queries (deltas interleave), exact in
         # the common single-query case; the alternative (threading a stats
-        # handle through every Shard read) isn't worth the hot-path cost
-        cache = getattr(self.db, "block_cache", None)
-        before = cache.stats() if cache is not None else None
-        # array surface: decoded arrays come straight from the decoded-block
+        # handle through every Shard read) isn't worth the hot-path cost.
+        # Array surface: decoded arrays come straight from the decoded-block
         # cache (m3_tpu/cache/) on repeat queries — no per-point Datapoint
-        # materialization on the scan-and-aggregate hot path
-        for sid, tags, (times, vals) in self.db.fetch_tagged_arrays(
-            self.namespace, q, start_nanos, end_nanos
-        ):
+        # materialization on the scan-and-aggregate hot path.
+        if rows is None:
+            rows = self.db.fetch_tagged_arrays(
+                self.namespace, q, start_nanos, end_nanos
+            )
+        for sid, tags, (times, vals) in rows:
             times = np.asarray(times, np.int64)
             vals = np.asarray(vals, np.float64)
             total_bytes += times.nbytes + vals.nbytes
@@ -79,6 +122,181 @@ class M3Storage:
         else:
             stats.add(bytes_=total_bytes)
         return out
+
+    # ---------- residency routing ----------
+
+    def _resident_plan(self, docs, start_nanos, end_nanos):
+        """(doc, resident BlockKeys) per matched doc when the query is
+        fully servable from the pool, else None. A series is servable when
+        every overlapping fileset block is either resident or
+        complete-admitted with the series absent, and no buffered data
+        overlaps the range. ``docs`` come from the caller's single
+        query_ids resolution (shared with the fallback path)."""
+        pool = getattr(self.db, "resident_pool", None)
+        if pool is None or not pool.enabled:
+            return None
+        ns = self.db.namespaces[self.namespace]
+        plan = []
+        for doc in docs:
+            shard = ns.shard_for(doc.id)
+            keys, buffered = shard.scan_block_keys(doc.id, start_nanos, end_nanos)
+            if buffered:
+                return None
+            doc_keys = []
+            for key in keys:
+                if key in pool:
+                    doc_keys.append(key)
+                elif pool.is_complete(
+                    key.namespace, key.shard_id, key.block_start, key.volume
+                ):
+                    continue  # fileset fully admitted: series absent from it
+                else:
+                    return None  # evicted / never admitted: stream instead
+            plan.append((doc, doc_keys))
+        return plan
+
+    def _fetch_resident(self, docs, start_nanos, end_nanos):
+        """Batched decode-from-HBM fetch: [(tags, times, values)] exact
+        (finalize_decode reconstructs bit-exact f64), or None to fall back.
+        Lanes the device decoder bails on (annotated streams) re-read
+        through the host array path per series."""
+        from ..resident.scan import resident_fetch_arrays
+        from . import stats as query_stats
+
+        from ..utils.trace import NOOP_SPAN, TRACER
+
+        plan = self._resident_plan(docs, start_nanos, end_nanos)
+        if plan is None:
+            return None
+        flat_keys = [key for _, doc_keys in plan for key in doc_keys]
+        decoded = ([], np.zeros(0, bool))
+        # this path replaces db.fetch_tagged_arrays, so it emits the same
+        # storage.fetch_tagged span — trace shape in /debug/traces must
+        # not vary with residency state
+        span = (
+            TRACER.span("storage.fetch_tagged", namespace=self.namespace)
+            if TRACER.active()
+            else NOOP_SPAN
+        )
+        with span:
+            if flat_keys:
+                decoded = resident_fetch_arrays(self.db.resident_pool, flat_keys)
+                if decoded is None:
+                    return None  # raced an eviction: streamed fallback
+            arrays, err = decoded
+            out = []
+            pos = 0
+            with query_stats.stage("decode"):
+                for doc, doc_keys in plan:
+                    lanes = arrays[pos : pos + len(doc_keys)]
+                    lane_err = err[pos : pos + len(doc_keys)]
+                    pos += len(doc_keys)
+                    if lane_err.any():
+                        # host re-read keeps Datapoint fidelity for lanes
+                        # the device can't decode; blocks are disjoint so a
+                        # full per-series host read replaces all its lanes
+                        t, v, _u = self.db.read_arrays(
+                            self.namespace, doc.id, start_nanos, end_nanos
+                        )
+                        out.append((doc.fields, np.asarray(t), np.asarray(v)))
+                        continue
+                    if lanes:
+                        times = np.concatenate([t for t, _ in lanes])
+                        vals = np.concatenate([v for _, v in lanes])
+                    else:
+                        times = np.zeros(0, np.int64)
+                        vals = np.zeros(0, np.float64)
+                    lo = int(np.searchsorted(times, start_nanos, side="left"))
+                    hi = int(np.searchsorted(times, end_nanos, side="left"))
+                    out.append((doc.fields, times[lo:hi], vals[lo:hi]))
+            span.set_tag("series", len(out))
+        return out
+
+    def scan_totals(self, matchers, start_nanos, end_nanos) -> dict:
+        """Direct scan-and-aggregate over raw samples (the paper's
+        flagship path as a query surface): index-resolve the matchers,
+        then either decode-from-HBM (all matched blocks resident) or
+        upload-and-decode (streamed fallback) — both through the same
+        kernel and reduction shapes, so the two paths agree bit for bit.
+
+        Granularity is BLOCK-aligned: totals cover every datapoint of
+        blocks overlapping [start, end) — the compressed streams decode
+        whole (that is what makes the scan one kernel launch); callers
+        needing exact range edges use fetch(). Returns {"sum", "count",
+        "min", "max", "series", "path"} with path "resident"|"streamed".
+        """
+        from ..resident.scan import resident_scan_totals, streamed_scan_totals
+        from . import stats
+
+        q = matchers_to_index_query(matchers)
+        ns = self.db.namespaces[self.namespace]
+        # ONE index resolution, shared by the resident plan and fallback
+        docs = self.db.query_ids(self.namespace, q, start_nanos, end_nanos).docs
+        n_series = len(docs)
+        plan = self._resident_plan(docs, start_nanos, end_nanos)
+        aggs = None
+        path = "streamed"
+        stream_for = None  # lane idx -> stream bytes (err-lane stitching)
+        if plan is not None:
+            flat_keys = [key for _, doc_keys in plan for key in doc_keys]
+            aggs = (
+                resident_scan_totals(self.db.resident_pool, flat_keys)
+                if flat_keys
+                else _EMPTY_TOTALS
+            )
+            if aggs is not None:
+                path = "resident"
+                stats.add(resident_hits=1)
+
+                def stream_for(i, _keys=flat_keys):
+                    from ..storage.fs import FilesetID
+
+                    key = _keys[i]
+                    shard = ns.shards[key.shard_id]
+                    reader = shard.reader(
+                        FilesetID(
+                            key.namespace, key.shard_id, key.block_start, key.volume
+                        )
+                    )
+                    return reader.stream(key.series_id) or b""
+
+        if aggs is None:
+            if getattr(self.db, "resident_pool", None) is not None:
+                stats.add(resident_misses=1)
+            segments: list[bytes] = []
+            bounds: list[int] = []
+            for doc in docs:
+                shard = ns.shard_for(doc.id)
+                for stream, bound in shard.scan_segments(
+                    doc.id, start_nanos, end_nanos
+                ):
+                    segments.append(stream)
+                    bounds.append(bound)
+            aggs = (
+                streamed_scan_totals(segments, bounds)
+                if segments
+                else _EMPTY_TOTALS
+            )
+            stream_for = lambda i, _segs=segments: _segs[i]
+        err = getattr(aggs, "series_err", None)
+        if err is not None and np.asarray(err).any():
+            # lanes the device decoder bailed on (annotated streams):
+            # recompute them through the host codec and rebuild the
+            # totals — both paths stitch identically, so silently
+            # truncated counts never leave this function
+            from ..parallel.scan import stitch_host_errors
+
+            aggs = stitch_host_errors(aggs, stream_for)
+        count = int(aggs.total_count)
+        stats.add(series=n_series, datapoints=count)
+        return {
+            "sum": float(aggs.total_sum),
+            "count": count,
+            "min": float(aggs.total_min),
+            "max": float(aggs.total_max),
+            "series": n_series,
+            "path": path,
+        }
 
 
 @dataclass

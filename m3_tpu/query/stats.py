@@ -53,6 +53,10 @@ class QueryStats:
     bytes_scanned: int = 0
     cache_hits: int = 0
     cache_misses: int = 0
+    # HBM-residency routing (m3_tpu/resident/): fetches served by the
+    # decode-from-HBM path vs streamed fallbacks while the pool was on
+    resident_hits: int = 0
+    resident_misses: int = 0
     trace_id: str | None = None  # links the record to its /debug/traces tree
     error: str | None = None
 
@@ -70,6 +74,8 @@ class QueryStats:
             "bytesScanned": self.bytes_scanned,
             "cacheHits": self.cache_hits,
             "cacheMisses": self.cache_misses,
+            "residentHits": self.resident_hits,
+            "residentMisses": self.resident_misses,
             "traceId": self.trace_id,
             "error": self.error,
         }
@@ -124,6 +130,15 @@ def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> No
     METRICS.counter("query_series_scanned_total").inc(st.series_scanned)
     METRICS.counter("query_datapoints_scanned_total").inc(st.datapoints_scanned)
     METRICS.counter("query_bytes_scanned_total").inc(st.bytes_scanned)
+    if st.resident_hits:
+        METRICS.counter(
+            "query_resident_hits_total", "fetches served from HBM residency"
+        ).inc(st.resident_hits)
+    if st.resident_misses:
+        METRICS.counter(
+            "query_resident_misses_total",
+            "fetches that fell back to the streamed path with the pool on",
+        ).inc(st.resident_misses)
 
 
 def add(
@@ -132,6 +147,8 @@ def add(
     bytes_: int = 0,
     cache_hits: int = 0,
     cache_misses: int = 0,
+    resident_hits: int = 0,
+    resident_misses: int = 0,
 ) -> None:
     """Charge scan counters against this thread's active query (no-op
     outside a query, so storage paths call it unconditionally)."""
@@ -143,6 +160,8 @@ def add(
     st.bytes_scanned += bytes_
     st.cache_hits += cache_hits
     st.cache_misses += cache_misses
+    st.resident_hits += resident_hits
+    st.resident_misses += resident_misses
 
 
 class _Stage:

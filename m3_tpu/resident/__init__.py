@@ -1,0 +1,31 @@
+"""HBM-resident compressed series store.
+
+Keeps sealed blocks' M3TSZ bytes resident in device memory (a paged pool
+under a byte budget, pool.py) and decodes them on read (scan.py): warm
+scans move zero block bytes host->device, and series selection is a
+device gather of page rows instead of a host select/pack. The design of
+the reference TSDB's in-memory tier (M3/M3TSZ after Pelkonen et al.'s
+Gorilla), restated as a paged KV-cache-style memory manager for the
+scan-and-aggregate hot path.
+"""
+
+from .pool import (
+    AdmitResult,
+    ResidentEntry,
+    ResidentOptions,
+    ResidentPool,
+    ResidentPoolError,
+    ResidentScanPlan,
+)
+from .scan import resident_fetch_arrays, resident_scan_totals
+
+__all__ = [
+    "AdmitResult",
+    "ResidentEntry",
+    "ResidentOptions",
+    "ResidentPool",
+    "ResidentPoolError",
+    "ResidentScanPlan",
+    "resident_fetch_arrays",
+    "resident_scan_totals",
+]

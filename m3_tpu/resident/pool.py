@@ -1,0 +1,628 @@
+"""HBM-resident compressed series store: a per-device paged M3TSZ pool.
+
+The memory-manager analogue of a paged KV cache in an inference stack,
+applied to the scan-and-aggregate hot path: instead of streaming sealed
+blocks' compressed bytes over PCIe on every scan (PROFILE.md's 50M×720
+row is transfer-bound at ~1.1s/chip), the m3tsz bytes stay RESIDENT in
+device memory — at compressed density (~1–2.4B/datapoint) a v5e-8 holds
+the whole 50M-series working set — and scans decode straight from HBM.
+
+Layout:
+
+- ONE flat device buffer ``uint32[num_pages, page_words]`` under a byte
+  budget (``ResidentOptions.max_bytes``). Page 0 is RESERVED and always
+  zero: gather plans pad short lanes with it, so a gathered lane's word
+  row is bit-identical to BatchedSegments' zero padding.
+- fixed-size pages handed out by a free-list allocator; a sealed block's
+  stream occupies ``ceil(bits / page_bits)`` consecutive page-table slots
+  (the pages themselves need not be contiguous — the device gather
+  reassembles them).
+- a HOST-side page table: ``BlockKey(namespace, shard, series_id,
+  block_start, volume) -> ResidentEntry(pages, num_bits, initial_unit,
+  num_points)`` — exactly the lane metadata ``ops.decode.decode_batched``
+  needs, so a scan is one row gather + the existing decode kernel.
+
+Admission is batched at flush/seal time (storage/database.py): all of a
+fileset's streams stage into one host array and land in one device scatter
+(``pool.at[idx].set(staged)``), not a device_put per series. Eviction is
+LRU under the byte budget plus explicit invalidation through the same
+hooks as the decoded-block cache (cache/invalidation.py) — a written-to,
+superseded, or retention-expired block is never resident.
+
+Updates are FUNCTIONAL (``.at[].set`` returns a new array, no donation):
+a scan that snapshotted the previous buffer keeps reading consistent
+bytes while an admission lands. The cost is one transient extra copy
+during admission; donation (true in-place) is a TPU-side follow-up that
+needs scan/admit epoch fencing.
+
+Concurrency: the page table, free list, and counters are guarded by one
+lock; ``plan_scan`` snapshots the device buffer reference under it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import numpy as np
+
+from ..cache.block_cache import BlockKey
+from ..utils.instrument import DEFAULT as METRICS
+
+
+class ResidentPoolError(ValueError):
+    """Corrupt page-table state detected (satellite contract: corrupt
+    metadata must raise, never read out-of-bounds or silently wrap)."""
+
+
+@dataclass
+class ResidentOptions:
+    """Knobs for the paged resident store (x/config-style dataclass).
+
+    ``max_bytes`` is the device byte budget for the page buffer (0
+    disables the pool). ``page_words`` is the page size in uint32 words
+    (default 512 words = 2KiB — one typical 720-point m3tsz block fits in
+    1–2 pages). ``max_lane_pages`` caps one (series, block) lane's page
+    span: the device gather width is ``max over lanes`` of the page
+    count, so one pathological stream must not widen every lane's row."""
+
+    enabled: bool = True
+    max_bytes: int = 0
+    page_words: int = 512
+    max_lane_pages: int = 64
+    namespaces: list = field(default_factory=list)
+
+    def validate(self) -> None:
+        from ..utils.config import ConfigError
+
+        if self.max_bytes < 0:
+            raise ConfigError("resident.max_bytes must be >= 0")
+        if self.page_words <= 0:
+            raise ConfigError("resident.page_words must be > 0")
+        if self.max_lane_pages <= 0:
+            raise ConfigError("resident.max_lane_pages must be > 0")
+
+    @property
+    def page_bytes(self) -> int:
+        return self.page_words * 4
+
+    @property
+    def num_pages(self) -> int:
+        # page 0 is the reserved zero page; it still costs budget
+        return self.max_bytes // self.page_bytes
+
+
+class ResidentEntry(NamedTuple):
+    """Page-table row for one resident (series, block, volume) lane."""
+
+    pages: tuple  # page indices, stream order
+    num_bits: int  # valid bits of the m3tsz stream
+    initial_unit: int  # initial time-unit code (BatchedSegments semantics)
+    num_points: int  # upper bound on datapoints (n_chunks * chunk_k)
+    nbytes: int  # stream length in bytes (occupancy accounting)
+
+
+def _initial_unit(stream: bytes, default_unit_nanos: int = 1_000_000_000) -> int:
+    """Mirror BatchedSegments.initial_units for one stream: the default
+    unit applies only when the head 64-bit timestamp divides it."""
+    if len(stream) < 8:
+        return 0
+    nt = int.from_bytes(stream[:8], "big")
+    from ..utils.xtime import Unit
+
+    return int(Unit.SECOND) if nt % default_unit_nanos == 0 else 0
+
+
+class AdmitResult(NamedTuple):
+    admitted: int
+    rejected_span: int  # lanes over the max_lane_pages span limit
+    rejected_budget: int  # lanes that could not fit even after eviction
+    complete: bool  # every non-empty stream of the group is now resident
+
+
+class ResidentPool:
+    """Paged device pool of sealed blocks' compressed streams."""
+
+    def __init__(self, options: ResidentOptions | None = None, registry=None) -> None:
+        self.options = options or ResidentOptions()
+        self._lock = threading.Lock()
+        # serializes admissions (the functional device-words chain); held
+        # across staging + upload so the TABLE lock above never is — writes
+        # and scans keep flowing while a flush's pages upload
+        self._upload_lock = threading.Lock()
+        self._od: "OrderedDict[BlockKey, ResidentEntry]" = OrderedDict()
+        # admitted-but-not-yet-uploaded entries: invisible to readers
+        # (plan_scan would otherwise serve pages the scatter hasn't
+        # written); published into _od after the upload completes, unless
+        # an invalidation dropped them mid-upload
+        self._pending: dict[BlockKey, ResidentEntry] = {}
+        self._by_series: dict[tuple, set] = {}
+        self._by_block: dict[tuple, set] = {}
+        # (namespace, shard, block_start, volume) groups whose every
+        # non-empty stream is resident: lets the query router treat a
+        # page-table miss as "series absent from that fileset" instead of
+        # "not resident" — dropped conservatively on any eviction or
+        # invalidation touching the group
+        self._complete: set[tuple] = set()
+        # free list: every page except the reserved zero page
+        self._free: list[int] = list(range(self.options.num_pages - 1, 0, -1))
+        self._words = None  # device uint32[num_pages, page_words], lazy
+        self._resident_bytes = 0  # sum of entries' stream bytes
+        self.admissions = 0
+        self.rejections = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.upload_bytes = 0
+        reg = registry or METRICS
+        self._m_admissions = reg.counter(
+            "resident_admissions_total", "blocks admitted to the resident pool"
+        )
+        self._m_rejections = reg.counter(
+            "resident_rejections_total", "blocks rejected at admission"
+        )
+        self._m_evictions = reg.counter(
+            "resident_evictions_total", "LRU/budget evictions from the pool"
+        )
+        self._m_invalidations = reg.counter(
+            "resident_invalidations_total", "entries dropped by invalidation hooks"
+        )
+        self._m_upload = reg.counter(
+            "resident_upload_bytes_total",
+            "host->device block bytes uploaded at admission (warm resident "
+            "scans move ZERO such bytes — tests assert on this counter)",
+        )
+        self._g_bytes = reg.gauge("resident_pool_bytes", "compressed bytes resident")
+        self._g_pages = reg.gauge("resident_pool_pages", "pages in use (excl. zero page)")
+        self._g_free = reg.gauge("resident_pool_free_pages", "pages on the free list")
+        self._g_entries = reg.gauge("resident_pool_entries", "page-table entries")
+
+    # ---------- device buffer ----------
+
+    @property
+    def enabled(self) -> bool:
+        o = self.options
+        return o.enabled and o.num_pages > 1
+
+    def _ensure_words(self):
+        """Allocate the device page buffer on first admission (a node with
+        the mode on but nothing sealed yet pays no device memory)."""
+        if self._words is None:
+            import jax.numpy as jnp
+
+            self._words = jnp.zeros(
+                (self.options.num_pages, self.options.page_words), jnp.uint32
+            )
+        return self._words
+
+    def device_words(self):
+        """Snapshot of the device page buffer (functional updates: the
+        reference stays internally consistent for the caller even if an
+        admission lands concurrently)."""
+        with self._lock:
+            return self._ensure_words() if self.enabled else None
+
+    # ---------- admission ----------
+
+    def admit_block(
+        self,
+        namespace: str,
+        shard_id: int,
+        block_start: int,
+        volume: int,
+        items: list,
+    ) -> AdmitResult:
+        """Admit one sealed fileset block's streams in ONE batched upload.
+
+        ``items``: ``[(series_id, stream_bytes, num_points_bound)]`` —
+        empty streams are skipped (series absent from the block). All
+        staged pages land with a single host->device transfer + scatter.
+
+        Three phases so the TABLE lock is held only for bookkeeping —
+        never across staging, the upload, or an XLA scatter compile
+        (writers invalidating and queries planning keep flowing while a
+        flush's pages upload):
+
+        1. under the table lock: allocate pages (LRU-evicting published
+           entries as needed) and park the new entries in ``_pending`` —
+           invisible to readers, whose plan would otherwise gather pages
+           the scatter hasn't written;
+        2. no table lock: build the staging array and run the device
+           scatter (serialized by the upload lock — the functional words
+           chain must not fork);
+        3. under the table lock: swap in the new words buffer and publish
+           surviving pending entries (an invalidation that raced the
+           upload drops its entry instead of publishing stale bytes).
+        """
+        if not self.enabled:
+            return AdmitResult(0, 0, 0, False)
+        o = self.options
+        if o.namespaces and namespace not in o.namespaces:
+            return AdmitResult(0, 0, 0, False)
+        page_bytes = o.page_bytes
+        plan: list[tuple[BlockKey, bytes, int, int]] = []  # key, stream, pages, points
+        rejected_span = 0
+        for sid, stream, num_points in items:
+            if not stream:
+                continue
+            n_pages = -(-len(stream) // page_bytes)
+            if n_pages > o.max_lane_pages:
+                rejected_span += 1
+                continue
+            key = BlockKey(namespace, shard_id, bytes(sid), block_start, volume)
+            plan.append((key, bytes(stream), n_pages, int(num_points)))
+        rejected_budget = 0
+        admitted = 0
+        batch_entries: list[tuple[BlockKey, ResidentEntry, bytes]] = []
+        with self._upload_lock:
+            with self._lock:
+                for key, stream, n_pages, num_points in plan:
+                    pages = self._alloc_locked(n_pages)
+                    if pages is None:
+                        rejected_budget += 1
+                        continue
+                    old = self._od.pop(key, None)
+                    if old is not None:
+                        self._unindex_locked(key, old)
+                        self._free.extend(old.pages)
+                        self._resident_bytes -= old.nbytes
+                    entry = ResidentEntry(
+                        pages=tuple(pages),
+                        num_bits=len(stream) * 8,
+                        initial_unit=_initial_unit(stream),
+                        num_points=num_points,
+                        nbytes=len(stream),
+                    )
+                    self._pending[key] = entry
+                    admitted += 1
+                    batch_entries.append((key, entry, stream))
+                words = self._ensure_words() if batch_entries else None
+            # ---- no table lock: stage + upload ----
+            # Pending pages are off the free list (never LRU-evicted), so
+            # intra-batch cannibalization is impossible: each staged page
+            # has exactly one owner and the scatter's indices are unique.
+            # A racing invalidation can still DROP a pending entry; only
+            # entries still pending at staging time get rows.
+            staged_rows: list[np.ndarray] = []
+            staged_idx: list[int] = []
+            staged_keys: set = set()
+            new_words = None
+            if batch_entries:
+                with self._lock:
+                    survivors_snapshot = [
+                        (key, entry, stream)
+                        for key, entry, stream in batch_entries
+                        if self._pending.get(key) is entry
+                    ]
+                for key, entry, stream in survivors_snapshot:
+                    staged_keys.add(key)
+                    for j, p in enumerate(entry.pages):
+                        row = np.zeros(o.page_words, np.uint32)
+                        chunk = stream[j * page_bytes : (j + 1) * page_bytes]
+                        padded = chunk + b"\x00" * (-len(chunk) % 4)
+                        row[: len(padded) // 4] = np.frombuffer(
+                            padded, ">u4"
+                        ).astype(np.uint32)
+                        staged_rows.append(row)
+                        staged_idx.append(p)
+                if staged_rows:
+                    new_words = self._upload(words, staged_rows, staged_idx)
+            # ---- publish ----
+            with self._lock:
+                if new_words is not None:
+                    self._words = new_words
+                survivors = 0
+                for key, entry, stream in batch_entries:
+                    present = self._pending.get(key) is entry
+                    if present:
+                        del self._pending[key]
+                    if present and key in staged_keys:
+                        survivors += 1
+                        self._od[key] = entry
+                        self._index_locked(key)
+                        self._resident_bytes += entry.nbytes
+                    else:
+                        # invalidated mid-upload (or dropped before
+                        # staging): never publish; the pages belong to
+                        # this batch, so reclamation happens HERE, not in
+                        # the invalidation hook
+                        self._free.extend(entry.pages)
+                complete = (
+                    admitted > 0
+                    and rejected_span == 0
+                    and rejected_budget == 0
+                    and survivors == len(plan)
+                )
+                if complete:
+                    self._complete.add((namespace, shard_id, block_start, volume))
+                self.admissions += admitted
+                self.rejections += rejected_span + rejected_budget
+                self._m_admissions.inc(admitted)
+                if rejected_span + rejected_budget:
+                    self._m_rejections.inc(rejected_span + rejected_budget)
+                self._publish_locked()
+        return AdmitResult(admitted, rejected_span, rejected_budget, complete)
+
+    def _upload(self, words, rows: list, idx: list):
+        """One host->device transfer + functional scatter for the batch —
+        runs WITHOUT the table lock (serialized by the upload lock; the
+        caller publishes the returned buffer under the table lock).
+
+        The page count is padded to a power of two (extra rows re-write
+        zeros into the reserved zero page) so the jitted scatter compiles
+        once per bucket, not once per fileset size."""
+        import jax
+
+        n = len(rows)
+        n_pad = 1 << max(n - 1, 0).bit_length() if n else 1
+        staged = np.zeros((n_pad, self.options.page_words), np.uint32)
+        staged[:n] = np.stack(rows)
+        indices = np.zeros(n_pad, np.int32)
+        indices[:n] = np.asarray(idx, np.int32)
+        self.upload_bytes += staged.nbytes
+        self._m_upload.inc(staged.nbytes)
+        return _scatter_pages(words, jax.device_put(indices), jax.device_put(staged))
+
+    def _alloc_locked(self, n_pages: int) -> list | None:
+        """Pop ``n_pages`` from the free list, LRU-evicting until they fit
+        (never evicting page 0, which is not on the free list)."""
+        while len(self._free) < n_pages:
+            if not self._evict_one_locked():
+                return None
+        return [self._free.pop() for _ in range(n_pages)]
+
+    def _evict_one_locked(self) -> bool:
+        if not self._od:
+            return False
+        key, entry = self._od.popitem(last=False)
+        self._unindex_locked(key, entry)
+        self._free.extend(entry.pages)
+        self._resident_bytes -= entry.nbytes
+        self.evictions += 1
+        self._m_evictions.inc()
+        return True
+
+    # ---------- lookup / scan planning ----------
+
+    def get(self, key: BlockKey) -> ResidentEntry | None:
+        with self._lock:
+            entry = self._od.get(key)
+            if entry is not None:
+                self._od.move_to_end(key)
+            return entry
+
+    def is_complete(self, namespace: str, shard_id: int, block_start: int, volume: int) -> bool:
+        with self._lock:
+            return (namespace, shard_id, block_start, volume) in self._complete
+
+    def __contains__(self, key: BlockKey) -> bool:
+        with self._lock:
+            return key in self._od
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def plan_scan(self, keys: list) -> "ResidentScanPlan | None":
+        """Assemble the device gather inputs for ``keys`` (one lane per
+        key, in order). Returns None if any key is not resident.
+
+        Validates every page index against the pool extent BEFORE the
+        device gather — a corrupt page table raises ResidentPoolError
+        rather than reading out-of-bounds rows (jnp indexing would clamp
+        silently, turning corruption into wrong results)."""
+        o = self.options
+        with self._lock:
+            if not self.enabled or self._words is None:
+                return None
+            entries = []
+            for key in keys:
+                e = self._od.get(key)
+                if e is None:
+                    return None
+                self._od.move_to_end(key)
+                entries.append(e)
+            words = self._words
+        num_pages = o.num_pages
+        max_lane = 1
+        for e in entries:
+            n = len(e.pages)
+            if n > o.max_lane_pages:
+                raise ResidentPoolError(
+                    f"page table entry spans {n} pages > limit {o.max_lane_pages}"
+                )
+            if n * o.page_words * 32 < e.num_bits:
+                raise ResidentPoolError(
+                    f"page table entry holds {e.num_bits} bits in {n} pages "
+                    f"of {o.page_words * 32} bits"
+                )
+            max_lane = max(max_lane, n)
+        s = len(entries)
+        # +1 trailing zero-page column: the decoder's 4-word lookahead past
+        # a lane's last stream word then reads zeros, bit-identical to
+        # BatchedSegments' pad words
+        rows = np.zeros((s, max_lane + 1), np.int32)
+        num_bits = np.zeros(s, np.int32)
+        units = np.zeros(s, np.int32)
+        num_points = 0
+        for i, e in enumerate(entries):
+            for j, p in enumerate(e.pages):
+                if not 0 < p < num_pages:
+                    raise ResidentPoolError(
+                        f"corrupt page index {p} (pool has {num_pages} pages)"
+                    )
+                rows[i, j] = p
+            num_bits[i] = e.num_bits
+            units[i] = e.initial_unit
+            num_points = max(num_points, e.num_points)
+        return ResidentScanPlan(
+            words=words,
+            page_rows=rows,
+            num_bits=num_bits,
+            initial_unit=units,
+            max_points=max(num_points, 1),
+        )
+
+    # ---------- invalidation surface (cache/invalidation.py drives this) ----------
+
+    def invalidate_series_block(
+        self, namespace: str, shard_id: int, series_id: bytes, block_start: int
+    ) -> int:
+        """Drop every volume of one (series, block) — the write hook."""
+        with self._lock:
+            self._drop_pending_locked(
+                lambda k: k.series_key
+                == (namespace, shard_id, series_id, block_start)
+            )
+            keys = self._by_series.pop(
+                (namespace, shard_id, series_id, block_start), None
+            )
+            return self._drop_locked(keys)
+
+    def invalidate_block(
+        self, namespace: str, shard_id: int, block_start: int, below_volume=None
+    ) -> int:
+        """Drop a block's entries across series; ``below_volume`` restricts
+        to superseded volumes (cold-flush supersession)."""
+        with self._lock:
+            self._drop_pending_locked(
+                lambda k: k.block_key == (namespace, shard_id, block_start)
+                and (below_volume is None or k.volume < below_volume)
+            )
+            keys = self._by_block.get((namespace, shard_id, block_start))
+            if keys is None:
+                # entries may be gone while the complete marker lingers
+                # (e.g. all evicted): still clear markers for the block
+                self._drop_complete_locked(namespace, shard_id, block_start, below_volume)
+                return 0
+            if below_volume is not None:
+                keys = {k for k in keys if k.volume < below_volume}
+            else:
+                keys = set(keys)
+            self._drop_complete_locked(namespace, shard_id, block_start, below_volume)
+            return self._drop_locked(keys)
+
+    def clear(self) -> int:
+        with self._lock:
+            self._drop_pending_locked(lambda k: True)
+            n = len(self._od)
+            for entry in self._od.values():
+                self._free.extend(entry.pages)
+            self._resident_bytes = 0
+            self._od.clear()
+            self._by_series.clear()
+            self._by_block.clear()
+            self._complete.clear()
+            self.invalidations += n
+            self._m_invalidations.inc(n)
+            self._publish_locked()
+            return n
+
+    def _drop_pending_locked(self, match) -> None:
+        """Drop matching in-flight admissions so stale data never
+        publishes. Their pages stay OFF the free list — the admitting
+        thread owns them and reclaims at publish time (the scatter may
+        still be writing them)."""
+        for key in [k for k in self._pending if match(k)]:
+            del self._pending[key]
+
+    def _drop_complete_locked(self, namespace, shard_id, block_start, below_volume) -> None:
+        for g in [
+            g
+            for g in self._complete
+            if g[0] == namespace
+            and g[1] == shard_id
+            and g[2] == block_start
+            and (below_volume is None or g[3] < below_volume)
+        ]:
+            self._complete.discard(g)
+
+    def _drop_locked(self, keys) -> int:
+        if not keys:
+            return 0
+        dropped = 0
+        for key in list(keys):
+            entry = self._od.pop(key, None)
+            if entry is None:
+                continue
+            self._unindex_locked(key, entry)
+            self._free.extend(entry.pages)
+            self._resident_bytes -= entry.nbytes
+            dropped += 1
+        self.invalidations += dropped
+        self._m_invalidations.inc(dropped)
+        self._publish_locked()
+        return dropped
+
+    # ---------- bookkeeping ----------
+
+    def _index_locked(self, key: BlockKey) -> None:
+        self._by_series.setdefault(key.series_key, set()).add(key)
+        self._by_block.setdefault(key.block_key, set()).add(key)
+
+    def _unindex_locked(self, key: BlockKey, entry: ResidentEntry) -> None:
+        for index, sub in (
+            (self._by_series, key.series_key),
+            (self._by_block, key.block_key),
+        ):
+            keys = index.get(sub)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del index[sub]
+        # any entry leaving the pool makes its fileset group incomplete
+        self._complete.discard(
+            (key.namespace, key.shard_id, key.block_start, key.volume)
+        )
+
+    def _publish_locked(self) -> None:
+        used = self.options.num_pages - 1 - len(self._free)
+        self._g_bytes.set(float(self._resident_bytes))
+        self._g_pages.set(float(used))
+        self._g_free.set(float(len(self._free)))
+        self._g_entries.set(float(len(self._od)))
+
+    def stats(self) -> dict:
+        with self._lock:
+            o = self.options
+            used_pages = o.num_pages - 1 - len(self._free)
+            resident_bytes = self._resident_bytes
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._od),
+                "bytes": resident_bytes,
+                "max_bytes": o.max_bytes,
+                "page_bytes": o.page_bytes,
+                "pages_used": used_pages,
+                "pages_total": max(o.num_pages - 1, 0),
+                "occupancy": used_pages / max(o.num_pages - 1, 1),
+                "complete_blocks": len(self._complete),
+                "admissions": self.admissions,
+                "rejections": self.rejections,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "upload_bytes": self.upload_bytes,
+            }
+
+
+class ResidentScanPlan(NamedTuple):
+    """Device gather inputs for one resident scan (pool.plan_scan)."""
+
+    words: object  # device uint32[num_pages, page_words]
+    page_rows: np.ndarray  # int32[S, L] page index per lane slot (0 = zero page)
+    num_bits: np.ndarray  # int32[S]
+    initial_unit: np.ndarray  # int32[S]
+    max_points: int
+
+
+def _scatter_pages(words, indices, staged):
+    """Functional page scatter (jitted lazily; module import stays light)."""
+    import jax
+
+    global _SCATTER_JIT
+    if _SCATTER_JIT is None:
+        _SCATTER_JIT = jax.jit(lambda w, i, s: w.at[i].set(s))
+    return _SCATTER_JIT(words, indices, staged)
+
+
+_SCATTER_JIT = None

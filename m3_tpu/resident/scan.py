@@ -1,0 +1,143 @@
+"""Decode-from-HBM scan orchestration over the paged resident pool.
+
+Bridges the host page table (pool.py) and the device scan path
+(parallel/scan.py): plans the gather, pads lanes into power-of-two jit
+buckets, runs the decode, and reconstructs exact host arrays when the
+caller needs datapoints rather than aggregates.
+
+Bit-exactness contract: ``resident_scan_totals`` and
+``streamed_scan_totals`` run the SAME decode kernel over the SAME padded
+[S, T] shape (identical reduction trees), so on identical input streams
+their float32 totals match bit for bit — the property tests assert exact
+equality, not tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils.instrument import DEFAULT as METRICS
+
+# host->device block bytes moved by the STREAMED scan path (the fallback
+# when matched blocks are not fully resident); warm resident scans leave
+# this and resident_upload_bytes_total untouched — the zero-transfer
+# acceptance test asserts on both counters
+_M_STREAMED_BYTES = METRICS.counter(
+    "scan_streamed_bytes_total",
+    "host->device block bytes uploaded by the streamed scan fallback",
+)
+
+_MIN_LANES = 8  # also the forced CPU test mesh size (conftest)
+
+
+def _pow2(n: int, lo: int = 1) -> int:
+    return max(lo, 1 << max(int(n) - 1, 0).bit_length())
+
+
+def _pad_lanes(page_rows, num_bits, units, s_pad: int):
+    s, l = page_rows.shape
+    rows = np.zeros((s_pad, l), np.int32)
+    rows[:s] = page_rows
+    nb = np.zeros(s_pad, np.int32)
+    nb[:s] = num_bits
+    un = np.zeros(s_pad, np.int32)
+    un[:s] = units
+    return rows, nb, un
+
+
+def resident_scan_totals(pool, keys: list, mesh=None):
+    """Scan-and-aggregate the resident lanes for ``keys`` (one lane per
+    (series, block) key). Returns a ScanAggregates with the series arrays
+    sliced back to ``len(keys)``, or None when any key is not resident.
+
+    ``mesh``: shard the lanes across a device mesh (parallel/scan.py
+    make_sharded_resident_scan, psum reduction unchanged); None = single
+    device."""
+    from ..parallel.scan import resident_scan_aggregate
+
+    plan = pool.plan_scan(keys)
+    if plan is None:
+        return None
+    s = len(keys)
+    s_pad = _pow2(s, _MIN_LANES)
+    if mesh is not None:
+        n_dev = mesh.devices.size
+        s_pad = _pow2(max(s_pad, n_dev), _MIN_LANES)
+    rows, nb, un = _pad_lanes(plan.page_rows, plan.num_bits, plan.initial_unit, s_pad)
+    max_points = _pow2(plan.max_points)
+    if mesh is not None:
+        aggs = _sharded_scan(mesh, max_points)(plan.words, rows, nb, un)
+    else:
+        aggs = resident_scan_aggregate(plan.words, rows, nb, un, max_points)
+    return _slice_series(aggs, s)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_scan(mesh, max_points: int):
+    from ..parallel.scan import make_sharded_resident_scan
+
+    return make_sharded_resident_scan(mesh, max_points)
+
+
+def streamed_scan_totals(segments: list, point_bounds: list):
+    """The streamed twin of resident_scan_totals: upload ``segments``
+    (one m3tsz stream per lane) and run the same decode + aggregation
+    with the same padding buckets (series_err carried the same way).
+    Charges the uploaded bytes to scan_streamed_bytes_total."""
+    import jax
+
+    from ..parallel.scan import scan_aggregate_with_err
+    from ..segment.batched import BatchedSegments
+
+    s = len(segments)
+    s_pad = _pow2(s, _MIN_LANES)
+    batch = BatchedSegments.from_streams(list(segments) + [b""] * (s_pad - s))
+    units = batch.initial_units()
+    max_points = _pow2(max(point_bounds, default=1))
+    words = jax.device_put(batch.words)
+    _M_STREAMED_BYTES.inc(batch.words.nbytes)
+    aggs = scan_aggregate_with_err(words, batch.num_bits, units, max_points)
+    return _slice_series(aggs, s)
+
+
+def _slice_series(aggs, s: int):
+    return aggs._replace(
+        series_sum=np.asarray(aggs.series_sum)[:s],
+        series_count=np.asarray(aggs.series_count)[:s],
+        series_min=np.asarray(aggs.series_min)[:s],
+        series_max=np.asarray(aggs.series_max)[:s],
+        series_last=np.asarray(aggs.series_last)[:s],
+        series_err=(
+            np.asarray(aggs.series_err)[:s] if aggs.series_err is not None else None
+        ),
+    )
+
+
+def resident_fetch_arrays(pool, keys: list):
+    """Exact datapoint reconstruction from HBM: decode the resident lanes
+    for ``keys`` and return ``([(times i64[n], values f64[n])], err bool[S])``
+    — bit-exact vs the host codec (ops/decode.finalize_decode), with
+    ``err[i]`` flagging lanes the device decoder bailed on (annotated
+    streams) so the caller can re-read those through the host path.
+
+    Returns None when any key is not resident."""
+    from ..ops.decode import decode_batched, finalize_decode
+    from ..parallel.scan import gather_lane_words
+
+    plan = pool.plan_scan(keys)
+    if plan is None:
+        return None
+    s = len(keys)
+    s_pad = _pow2(s, _MIN_LANES)
+    rows, nb, un = _pad_lanes(plan.page_rows, plan.num_bits, plan.initial_unit, s_pad)
+    words = gather_lane_words(plan.words, rows)
+    res = decode_batched(words, nb, un, max_points=_pow2(plan.max_points))
+    timestamps, values, valid = finalize_decode(res)
+    err = np.asarray(res.err, bool)[:s]
+    out = []
+    for i in range(s):
+        m = valid[i]
+        out.append((timestamps[i][m], values[i][m]))
+    return out, err

@@ -45,6 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
         "stats are served on the cache_stats debug op",
     )
     p.add_argument(
+        "--resident-bytes",
+        type=int,
+        default=0,
+        help="HBM-resident compressed pool byte budget (0 disables the "
+        "mode): sealed blocks' m3tsz bytes stay device-resident and warm "
+        "scans decode from HBM (m3_tpu/resident/); stats on the "
+        "resident_stats debug op",
+    )
+    p.add_argument(
         "--kv-endpoint",
         default="",
         help="host:port of the control-plane KV server; enables dynamic "
@@ -105,12 +114,16 @@ def main(argv=None) -> int:
             args.kv_endpoint = self_kv_ep
 
     from ..cache import CacheOptions
+    from ..resident import ResidentOptions
 
     db = Database(
         args.base_dir,
         num_shards=args.num_shards,
         cache_options=CacheOptions(
             enabled=args.cache_bytes > 0, max_bytes=max(args.cache_bytes, 0)
+        ),
+        resident_options=ResidentOptions(
+            enabled=args.resident_bytes > 0, max_bytes=max(args.resident_bytes, 0)
         ),
     )
     opts = NamespaceOptions(

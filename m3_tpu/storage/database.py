@@ -19,6 +19,7 @@ import numpy as np
 
 from ..cache import BlockCache, BlockKey, CacheInvalidator, CacheOptions, DecodedBlock
 from ..codec.m3tsz import Datapoint, decode
+from ..resident import ResidentOptions, ResidentPool
 from ..query import stats as query_stats
 from ..utils.hash import shard_for
 from ..utils.instrument import DEFAULT as METRICS
@@ -81,6 +82,7 @@ class Shard:
         base: str,
         cache: BlockCache | None = None,
         invalidator: CacheInvalidator | None = None,
+        pool: ResidentPool | None = None,
     ) -> None:
         self.id = shard_id
         self.namespace = ns
@@ -90,7 +92,10 @@ class Shard:
         # once; the invalidator hooks write/flush/tick so nothing stale or
         # superseded stays resident
         self.cache = cache
-        self.invalidator = invalidator or CacheInvalidator(cache)
+        # HBM-resident compressed pool (m3_tpu/resident/): sealed blocks'
+        # m3tsz bytes stay device-resident, admitted at flush/seal below
+        self.pool = pool
+        self.invalidator = invalidator or CacheInvalidator(cache, pool)
         # per-shard lock (shard.go RWMutex role): hot-path reads/writes
         # contend only within a shard; lifecycle ops (flush/tick) take the
         # database lock FIRST then shard locks, writers take only this one,
@@ -290,10 +295,66 @@ class Shard:
         with self.lock:
             return self._segments_locked(sid, start, end)
 
+    # --- resident-scan routing surface (m3_tpu/resident/) ---
+
+    def scan_block_keys(self, sid: bytes, start: int, end: int):
+        """(fileset BlockKeys overlapping [start, end), buffered) — the
+        residency check input: the resident path may serve this series iff
+        every key is resident (or its fileset is complete-admitted and the
+        series is simply absent) AND no live buffer overlaps the range
+        (buffer data overlays sealed blocks at read time; a resident-only
+        scan would miss it)."""
+        with self.lock:
+            bsz = self.opts.block_size_nanos
+            keys = [
+                BlockKey(self.namespace, self.id, sid, fid.block_start, fid.volume)
+                for fid in self.filesets()
+                if not (fid.block_start + bsz <= start or fid.block_start >= end)
+            ]
+            buf = self.series.get(sid)
+            buffered = buf is not None and buf.has_points(start, end)
+            return keys, buffered
+
+    def scan_segments(self, sid: bytes, start: int, end: int) -> list[tuple]:
+        """[(stream, datapoint_bound)] for the STREAMED scan path, in the
+        same lane order the resident path uses (filesets by block start,
+        then buffer buckets). Bounds come from fileset index entries
+        (n_chunks * chunk_k) / buffer write counts — an upper bound is
+        enough: extra decode steps land on done lanes and drop out of
+        every reduction."""
+        with self.lock:
+            out: list[tuple] = []
+            bsz = self.opts.block_size_nanos
+            for fid in self.filesets():
+                if fid.block_start + bsz <= start or fid.block_start >= end:
+                    continue
+                reader = self._reader_locked(fid)
+                entry = reader._lookup(sid) if reader.bloom.test(sid) else None
+                if entry is None:
+                    continue
+                stream = reader.stream(sid)
+                if not stream:
+                    continue
+                chunk_k = int(reader.info.get("chunkK", CHUNK_K))
+                out.append((stream, entry[3] * chunk_k))
+            buf = self.series.get(sid)
+            if buf is not None:
+                for bs in sorted(buf.buckets):
+                    if bs + bsz <= start or bs >= end:
+                        continue
+                    bucket = buf.buckets[bs]
+                    stream = bucket.merged_stream()
+                    if stream:
+                        out.append((stream, len(bucket.times)))
+            return out
+
     def warm_flush(self, flush_before_nanos: int) -> list[FilesetID]:
         """shard.go:2146 — write filesets for complete blocks, then evict."""
         with self.lock:
-            return self._warm_flush_locked(flush_before_nanos)
+            flushed = self._warm_flush_locked(flush_before_nanos)
+            payload = self._collect_admission_locked(flushed)
+        self._admit_payload(payload)
+        return flushed
 
     def _warm_flush_locked(self, flush_before_nanos: int) -> list[FilesetID]:
         blocks: dict[int, dict[bytes, bytes]] = {}
@@ -322,7 +383,10 @@ class Shard:
         already-flushed blocks merge with the existing fileset ONCE PER BLOCK
         (all cold series together) and go out as one new volume."""
         with self.lock:
-            return self._cold_flush_locked(flush_before_nanos)
+            flushed = self._cold_flush_locked(flush_before_nanos)
+            payload = self._collect_admission_locked(flushed)
+        self._admit_payload(payload)
+        return flushed
 
     def _cold_flush_locked(self, flush_before_nanos: int) -> list[FilesetID]:
         # gather every cold stream per block first, so each block merges once
@@ -366,6 +430,49 @@ class Shard:
             self.invalidator.on_flush(self.namespace, self.id, flushed)
         return flushed
 
+    def _collect_admission_locked(self, fids: list[FilesetID]) -> list[tuple]:
+        """Seal-time residency admission, stage 1 (under the shard lock):
+        resolve each flushed fileset's reader and FORCE its full index
+        parse — the only mutable state the off-lock stage touches.
+        Everything else (bloom probes, index lookups against the parsed
+        table, mmap'd data slices) is read-only on an immutable fileset,
+        so the O(fileset bytes) stream read-back runs lock-free in
+        stage 2."""
+        if self.pool is None or not self.pool.enabled:
+            return []
+        payload = []
+        for fid in fids:
+            reader = self._reader_locked(fid)
+            chunk_k = int(reader.info.get("chunkK", CHUNK_K))
+            payload.append(
+                (fid.block_start, fid.volume, reader, dict(reader.index), chunk_k)
+            )
+        return payload
+
+    def _admit_payload(self, payload: list[tuple]) -> None:
+        """Seal-time residency admission, stage 2 (OUTSIDE the shard
+        lock): the fileset read-back, staging-array build, host->device
+        upload, and any first-shape XLA scatter compile must not stall
+        the shard's hot read/write path. The per-lane datapoint bound is
+        the index entry's n_chunks * chunk_k — the same bound the
+        streamed scan path derives, which keeps the two paths' decode
+        shapes (and f32 reduction trees) identical. Racing mutations stay
+        correct without the lock: a write landing between collect and
+        admit leaves buffered points that force the query router's
+        streamed fallback (buffer-overlay check), and a superseding flush
+        admits a HIGHER volume the router prefers; a retention expiry
+        racing in leaves only an unreachable entry that ages out of the
+        LRU."""
+        for block_start, volume, reader, index, chunk_k in payload:
+            items = []
+            for sid, (_, _, _, n_chunks) in index.items():
+                stream = reader.stream(sid)
+                if stream:
+                    items.append((sid, stream, n_chunks * chunk_k))
+            self.pool.admit_block(
+                self.namespace, self.id, block_start, volume, items
+            )
+
     def tick(self, now_nanos: int) -> None:
         """shard.go:663 tickAndExpire: drop series/blocks past retention,
         expired filesets off disk, and stale cached readers."""
@@ -405,12 +512,13 @@ class Namespace:
         base: str,
         cache: BlockCache | None = None,
         invalidator: CacheInvalidator | None = None,
+        pool: ResidentPool | None = None,
     ) -> None:
         self.name = name
         self.opts = opts
         self.num_shards = num_shards
         self.shards = [
-            Shard(i, name, opts, base, cache=cache, invalidator=invalidator)
+            Shard(i, name, opts, base, cache=cache, invalidator=invalidator, pool=pool)
             for i in range(num_shards)
         ]
         self.index = None
@@ -432,6 +540,7 @@ class Database:
         num_shards: int = 8,
         commitlog_enabled: bool = True,
         cache_options: CacheOptions | None = None,
+        resident_options: ResidentOptions | None = None,
     ) -> None:
         self.base = base_dir
         self.num_shards = num_shards
@@ -445,7 +554,17 @@ class Database:
             if self.cache_options.enabled and self.cache_options.max_bytes > 0
             else None
         )
-        self.cache_invalidator = CacheInvalidator(self.block_cache)
+        # HBM-resident compressed pool, one device byte budget per node
+        # (m3_tpu/resident/): sealed blocks admit at flush, warm scans
+        # decode from HBM. Off by default — an opt-in mode via
+        # resident_options / dbnode --resident-bytes.
+        self.resident_options = resident_options or ResidentOptions(enabled=False)
+        self.resident_pool = (
+            ResidentPool(self.resident_options)
+            if self.resident_options.enabled and self.resident_options.max_bytes > 0
+            else None
+        )
+        self.cache_invalidator = CacheInvalidator(self.block_cache, self.resident_pool)
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
         # self-observability (x/instrument role)
@@ -472,6 +591,7 @@ class Database:
                 self.base,
                 cache=self.block_cache,
                 invalidator=self.cache_invalidator,
+                pool=self.resident_pool,
             )
             self.namespaces[name] = ns
             if self.commitlog_enabled:
@@ -547,6 +667,7 @@ class Database:
                 rec[1].append(e)
         applied: list[CommitLogEntry] = []
         cache = self.block_cache
+        pool = self.resident_pool
         touched: set = set()
         try:
             for sh, items in by_shard.values():
@@ -554,12 +675,15 @@ class Database:
                 cold_ok = sh.opts.cold_writes_enabled
                 flushed = sh._flushed_blocks
                 with sh.lock:
-                    # decided UNDER the shard lock: entries for this
-                    # shard's keys are only created by readers holding
-                    # this lock, so an empty cache here (the common case
-                    # during ingest-heavy phases) safely skips the
-                    # per-item set insert
-                    collect = cache is not None and len(cache) > 0
+                    # decided UNDER the shard lock: cache entries for this
+                    # shard's keys are only created by readers holding this
+                    # lock (pool entries by flushes, which also hold it), so
+                    # an empty cache AND pool here (the common case during
+                    # ingest-heavy phases) safely skips the per-item set
+                    # insert
+                    collect = (cache is not None and len(cache) > 0) or (
+                        pool is not None and len(pool) > 0
+                    )
                     series = sh.series
                     for sid, t, v in items:
                         bs = (t // bsz) * bsz
@@ -729,20 +853,24 @@ class Database:
         return out
 
     def fetch_tagged_arrays(
-        self, ns: str, query, start: int, end: int, limit: int | None = None
+        self, ns: str, query, start: int, end: int, limit: int | None = None,
+        docs=None,
     ) -> list[tuple[bytes, tuple, tuple]]:
         """FetchTagged on the array surface: (sid, tags, (times, values))
-        per matched series, served through the decoded-block cache."""
+        per matched series, served through the decoded-block cache.
+        ``docs``: pre-resolved index docs — callers that already ran
+        query_ids (the residency router) skip the second resolution."""
         span = (
             TRACER.span("storage.fetch_tagged", namespace=ns)
             if TRACER.active()
             else NOOP_SPAN
         )
         with span:
-            result = self.query_ids(ns, query, start, end, limit=limit)
+            if docs is None:
+                docs = self.query_ids(ns, query, start, end, limit=limit).docs
             out = []
             with query_stats.stage("decode"):
-                for doc in result.docs:
+                for doc in docs:
                     t, v, _u = self.read_arrays(ns, doc.id, start, end)
                     out.append((doc.id, doc.fields, (t, v)))
             span.set_tag("series", len(out))
@@ -753,6 +881,20 @@ class Database:
         if self.block_cache is None:
             return {"enabled": False}
         return {"enabled": True, **self.block_cache.stats()}
+
+    def resident_stats(self) -> dict:
+        """Resident-pool stats for debug/status endpoints, plus the
+        streamed-fallback byte counter so one call answers 'are warm scans
+        moving block bytes?' (tools/check_resident.py asserts the deltas
+        are zero across a warm resident scan)."""
+        if self.resident_pool is None:
+            return {"enabled": False}
+        from ..resident.scan import _M_STREAMED_BYTES
+
+        return {
+            **self.resident_pool.stats(),
+            "streamed_bytes": _M_STREAMED_BYTES.value,
+        }
 
     def stream_shard(self, ns: str, shard_id: int) -> list:
         """Peer streaming (FetchBootstrapBlocksFromPeers / repair source):
@@ -927,8 +1069,46 @@ class Database:
                     "fulfilled": dict(r.fulfilled_by_source),
                     "unfulfilled": r.unfulfilled,
                 }
+            if shard_filter is None:
+                # full (re)start: warm the resident pool from discovered
+                # filesets — gained-shard passes skip this (their data
+                # arrives through the write path and admits at flush)
+                self._readmit_resident()
             self.bootstrapped = True
             return result
+
+    def _readmit_resident(self) -> None:
+        """Restart warm-up for the residency mode: admission is a
+        flush-time event, so blocks sealed by a PREVIOUS process would
+        otherwise never re-admit and every historical query would stream
+        forever. Admit discovered filesets NEWEST-first until the pool's
+        budget pushes back (recency is the best eviction-order prior we
+        have at boot; later flushes keep rotating newer blocks in via
+        LRU). Read-through re-admission of individually evicted blocks
+        is a ROADMAP open item."""
+        pool = self.resident_pool
+        if pool is None or not pool.enabled:
+            return
+        work = []
+        for ns in self.namespaces.values():
+            for shard in ns.shards:
+                for fid in shard.filesets():
+                    work.append((fid.block_start, shard, fid))
+        work.sort(key=lambda t: -t[0])
+        for _, shard, fid in work:
+            with shard.lock:
+                payload = shard._collect_admission_locked([fid])
+            for block_start, volume, reader, index, chunk_k in payload:
+                items = []
+                for sid, (_, _, _, n_chunks) in index.items():
+                    stream = reader.stream(sid)
+                    if stream:
+                        items.append((sid, stream, n_chunks * chunk_k))
+                res = pool.admit_block(
+                    shard.namespace, shard.id, block_start, volume, items
+                )
+                if res.rejected_budget:
+                    return  # budget full: the newest blocks are resident
 
     def bootstrap_shards(
         self, shard_ids, peers_source=None, has_peer_with_shard=None

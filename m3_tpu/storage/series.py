@@ -145,6 +145,18 @@ class SeriesBuffer:
                 out.append(stream)
         return out
 
+    def has_points(self, start_nanos: int, end_nanos: int) -> bool:
+        """True when any buffered bucket overlapping [start, end) holds
+        datapoints — the resident-scan router's buffer-overlay check: live
+        buffer data overlays sealed blocks at read time, so a scan served
+        purely from residency would miss it and must fall back."""
+        for bs, bucket in self.buckets.items():
+            if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                continue
+            if bucket.times:
+                return True
+        return False
+
     def streams_before(self, flush_before_nanos: int) -> dict[int, bytes]:
         """Canonical merged streams for blocks entirely before the cutoff
         (WarmFlush input, shard.go:2146)."""

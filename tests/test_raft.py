@@ -329,3 +329,58 @@ def test_snapshot_catchup_for_lagging_follower(tmp_path):
         kv.close()
     finally:
         q.close()
+
+
+# ---------- linearizable leader reads (read barrier) ----------
+
+
+def test_leader_reads_pass_read_barrier(quorum):
+    """kv_get on the leader passes the read barrier (quorum leadership
+    confirmation via no-op commit + apply catch-up): the no-op lands in
+    the log, the lease caches the confirmation within a heartbeat, and
+    followers still redirect instead of serving possibly-stale state."""
+    from m3_tpu.cluster.raft import NotLeaderError
+
+    leader = quorum.leader_id()
+    kv = quorum.client()
+    kv.set("rb/key", {"v": 1})
+    node = quorum.nodes[leader]
+    log_before = node.last_log_index
+    assert kv.get("rb/key").value == {"v": 1}
+    # the cold barrier committed a no-op through the log
+    assert node.last_log_index > log_before
+    log_after = node.last_log_index
+    # lease: immediately-repeated reads skip the no-op re-confirmation
+    assert kv.get("rb/key").value == {"v": 1}
+    assert node.last_log_index == log_after
+    # barrier post-condition: applied state caught up to the commit point
+    assert node.last_applied >= node.commit_index
+    # followers refuse barrier reads outright
+    follower = next(n for n in quorum.nodes.values() if not n.is_leader)
+    with pytest.raises(NotLeaderError):
+        follower.read_barrier()
+    kv.close()
+
+
+def test_read_barrier_single_member(tmp_path):
+    """A single-member 'quorum' needs no confirmation round: the barrier
+    reduces to the apply-catch-up wait and reads serve immediately."""
+    node = RaftNode("solo", KVStore(), data_dir=str(tmp_path / "solo"),
+                    heartbeat_interval=0.05, election_timeout=(0.15, 0.3))
+    server = RpcServer(RaftKVService(node))
+    server.start()
+    try:
+        node.configure({"solo": f"{server.host}:{server.port}"})
+        deadline = time.time() + 5
+        while time.time() < deadline and not node.is_leader:
+            time.sleep(0.02)
+        assert node.is_leader
+        kv = RemoteKVStore.connect(f"{server.host}:{server.port}")
+        kv.set("solo/k", 7)
+        log_before = node.last_log_index
+        assert kv.get("solo/k").value == 7
+        assert node.last_log_index == log_before  # no no-op needed
+        kv.close()
+    finally:
+        server.stop()
+        node.stop()

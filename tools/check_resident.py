@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""CI guard for the compressed-residency mode (m3_tpu/resident/).
+
+Boots a real dbnode process with ``--resident-bytes``, writes and seals a
+block of series over RPC, then asserts the whole residency contract
+end-to-end:
+
+- ``resident_stats`` reports admissions after the flush (blocks admit at
+  seal time, not first read);
+- a ``scan_totals`` query routes to the resident decode-from-HBM path
+  and reports ``path == "resident"`` with the exact datapoint count;
+- a REPEATED query still reports the resident path (resident_hit) and
+  moves ZERO additional host->device block bytes (``upload_bytes`` and
+  ``streamed_bytes`` deltas are 0 between the two runs).
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_resident.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+NANOS = 1_000_000_000
+N_SERIES = 32
+N_POINTS = 64
+T0 = 1_600_000_000 * NANOS
+STEP = 10 * NANOS
+
+
+def _spawn_dbnode(base: str):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "m3_tpu.services.dbnode",
+            "--base-dir",
+            base,
+            "--port",
+            "0",
+            "--namespace",
+            "resident",
+            "--no-mediator",
+            "--resident-bytes",
+            str(64 * 1024 * 1024),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=repo,
+    )
+    for line in proc.stdout:
+        if line.startswith("LISTENING"):
+            _, host, port = line.split()
+            return proc, host, int(port)
+    raise RuntimeError("dbnode did not print LISTENING")
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from m3_tpu.net.client import RemoteNode
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    base = tempfile.mkdtemp(prefix="m3tpu-check-resident-")
+    proc = node = None
+    try:
+        proc, host, port = _spawn_dbnode(base)
+        node = RemoteNode.connect(f"{host}:{port}")
+
+        for i in range(N_SERIES):
+            tags = ((b"__name__", b"resident_gauge"), (b"series", b"%04d" % i))
+            entries = [
+                (tags, T0 + j * STEP, float(i + j), 1) for j in range(N_POINTS)
+            ]
+            node.write_tagged_batch("resident", entries)
+
+        stats = node.resident_stats()
+        check(stats.get("enabled", False), "resident pool enabled")
+        check(stats.get("admissions", 0) == 0, "no admissions before seal")
+
+        node.flush("resident", T0 + 4 * 3600 * NANOS)
+        stats = node.resident_stats()
+        check(stats.get("admissions", 0) >= N_SERIES, "flush admitted sealed blocks")
+        check(stats.get("pages_used", 0) > 0, "pool pages in use after seal")
+
+        matchers = [["__name__", "=", "resident_gauge"]]
+        span = (T0, T0 + N_POINTS * STEP)
+        first = node.scan_totals("resident", matchers, *span)
+        check(first.get("path") == "resident", f"first scan path ({first.get('path')})")
+        check(
+            first.get("count") == N_SERIES * N_POINTS,
+            f"first scan datapoint count ({first.get('count')})",
+        )
+
+        before = node.resident_stats()
+        second = node.scan_totals("resident", matchers, *span)
+        after = node.resident_stats()
+        check(second.get("path") == "resident", "repeated scan reports resident hit")
+        check(second.get("count") == first.get("count"), "repeated scan count stable")
+        check(
+            after.get("upload_bytes") == before.get("upload_bytes"),
+            "warm resident scan uploaded zero block bytes",
+        )
+        check(
+            after.get("streamed_bytes", 0) == before.get("streamed_bytes", 0),
+            "warm resident scan streamed zero block bytes",
+        )
+    finally:
+        try:
+            if node is not None:
+                node.close()
+        except Exception:
+            pass
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+
+    if failures:
+        print(f"\n{len(failures)} residency contract violation(s)")
+        return 1
+    print("\nresidency contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
