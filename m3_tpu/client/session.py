@@ -15,10 +15,15 @@ TChannel/Thrift.
 from __future__ import annotations
 
 import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import wait as _futures_wait
 from dataclasses import dataclass, field
 
 from ..cluster.topology import ConsistencyLevel, TopologyMap
+from ..net.resilience import HealthProber
 from ..utils.hash import shard_for
+from ..utils.instrument import DEFAULT as METRICS
 from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
 
@@ -30,6 +35,102 @@ class ConsistencyError(Exception):
         )
         self.achieved = achieved
         self.required = required
+
+
+class ReplicaResults(list):
+    """Per-replica results of one fan-out; ``degraded`` is True when an
+    UNSTRICT level accepted fewer than the preferred replica count."""
+
+    degraded: bool = False
+
+
+class TaggedResult(list):
+    """fetch_tagged result rows; ``exhaustive`` is False when the read
+    degraded below its preferred consistency (UNSTRICT_MAJORITY) — the
+    rows are exactly what the responding replicas serve, but a silent
+    replica may hold datapoints nobody returned."""
+
+    exhaustive: bool = True
+
+
+class SeriesResult(list):
+    """Datapoints of a single-series fetch; ``exhaustive`` carries the
+    same degraded-read marker as TaggedResult.exhaustive."""
+
+    exhaustive: bool = True
+
+
+def _session_retries(op: str):
+    return METRICS.counter(
+        "session_op_retries_total",
+        "session-level fan-out retry rounds re-attempting failed replicas",
+        labels={"op": op},
+    )
+
+
+class _DaemonPool:
+    """Persistent DAEMON worker threads behind concurrent.futures Futures.
+
+    Why not ThreadPoolExecutor: fan-outs deliberately abandon stragglers
+    (first-quorum-wins), and the executor's workers are non-daemon and
+    joined by its atexit hook — an abandoned replica call blocked in a
+    socket read would stall interpreter exit for its full timeout. Daemon
+    workers don't, and a persistent pool avoids paying a thread spawn per
+    replica attempt on the data-plane hot path. Workers spawn on demand up
+    to ``max_workers``; a worker stuck on an abandoned call simply leaves
+    one less slot until its bounded socket timeout fires."""
+
+    def __init__(self, max_workers: int) -> None:
+        import queue as _queue
+
+        self._max = max_workers
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._threads = 0
+        self._inflight = 0  # submitted, not yet finished
+
+    def submit(self, fn, *args) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            # invariant: threads >= min(max, inflight) — every
+            # concurrently-submitted task has a worker (an "is a worker
+            # idle?" heuristic undercounts when tasks are queued faster
+            # than workers park, serializing a fan-out behind one thread)
+            self._inflight += 1
+            if self._threads < min(self._max, self._inflight):
+                self._threads += 1
+                threading.Thread(
+                    target=self._run, daemon=True, name="session-fanout"
+                ).start()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:  # close() sentinel
+                with self._lock:
+                    self._threads -= 1
+                return
+            fut, fn, args = item
+            try:
+                if fut.set_running_or_notify_cancel():
+                    try:
+                        fut.set_result(fn(*args))
+                    except BaseException as exc:
+                        fut.set_exception(exc)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+    def close(self) -> None:
+        """Ask every worker to exit (one sentinel each); workers stuck on
+        an abandoned call pick theirs up when the call's timeout fires —
+        or never, harmlessly, since they are daemon threads."""
+        with self._lock:
+            n = self._threads
+        for _ in range(n):
+            self._q.put(None)
 
 
 class _PendingWrite:
@@ -156,6 +257,26 @@ class Session:
     _queues_lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
+    # resilience knobs: one wall-clock bound per fan-out (a hung replica
+    # costs at most fanout_timeout, never a serial stall), plus up to
+    # op_retries extra ROUNDS re-attempting only the replicas that failed.
+    # Session-level rounds are distinct from the RPC client's transparent
+    # retries: datapoint writes are idempotent UPSERTS at the storage layer
+    # (same series+timestamp overwrites), so deliberately re-sending a
+    # failed replica's write here is safe even though the RPC layer must
+    # never transparently re-send a write op.
+    fanout_timeout: float = 10.0
+    op_retries: int = 2
+    op_retry_backoff: float = 0.05
+    # once quorum is reached, stragglers get this much longer before the
+    # fan-out stops waiting for them (first-quorum-wins: a hung replica
+    # costs quorum-time + grace, not fanout_timeout)
+    straggler_grace: float = 0.25
+    _prober: HealthProber | None = field(default=None, repr=False)
+    _pool_obj: _DaemonPool | None = field(default=None, repr=False)
+    _pool_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
 
     @property
     def num_shards(self) -> int:
@@ -164,42 +285,149 @@ class Session:
     def _shard(self, sid: bytes) -> int:
         return shard_for(sid, self.num_shards)
 
+    def _pool(self) -> _DaemonPool:
+        with self._pool_lock:
+            if self._pool_obj is None:
+                self._pool_obj = _DaemonPool(
+                    max(8, 4 * self.topology.replicas)
+                )
+            return self._pool_obj
+
+    def _collect_first_quorum(self, futs: dict, deadline: float,
+                              quorum, on_result, on_error) -> set:
+        """ONE wait loop for every fan-out (first-quorum-wins): until
+        ``quorum()`` holds the wait runs to ``deadline``; after that,
+        stragglers get ``straggler_grace`` and are then abandoned (their
+        worker finishes — and releases its socket — in the background).
+        ``futs`` maps Future -> host; completed futures dispatch to
+        ``on_result(host, value)`` / ``on_error(host, exc)``. Returns the
+        abandoned futures."""
+        waiting = set(futs)
+        quorum_at: float | None = None
+        while waiting:
+            now = time.monotonic()
+            until = deadline
+            if quorum():
+                if quorum_at is None:
+                    quorum_at = now
+                until = min(deadline, quorum_at + self.straggler_grace)
+            if now >= until:
+                break
+            done, waiting = _futures_wait(
+                waiting, timeout=until - now, return_when="FIRST_COMPLETED"
+            )
+            for fut in done:
+                host = futs[fut]
+                try:
+                    value = fut.result()
+                except Exception as exc:
+                    on_error(host, exc)
+                else:
+                    on_result(host, value)
+        return waiting
+
+    def _next_round(self, op: str, round_no: int, deadline: float) -> bool:
+        """Shared retry-round bookkeeping for every fan-out: False when
+        the round budget or the op deadline is spent; otherwise counts the
+        retry and sleeps this round's backoff (bounded by the deadline)."""
+        if round_no > self.op_retries or time.monotonic() >= deadline:
+            return False
+        _session_retries(op).inc()
+        time.sleep(
+            min(self.op_retry_backoff * round_no,
+                max(0.0, deadline - time.monotonic()))
+        )
+        return True
+
+    def start_health_probes(self, interval: float = 0.25,
+                            probe_timeout: float = 1.0) -> HealthProber:
+        """Background prober driving open circuit breakers back closed
+        (RemoteNode fleets): a recovered host rejoins fan-outs within
+        ~interval instead of waiting for live traffic to probe it."""
+        if self._prober is None:
+            self._prober = HealthProber(
+                self.nodes, interval=interval, probe_timeout=probe_timeout
+            ).start()
+        return self._prober
+
+    def _replica_call(self, op_name: str, host: str, shard, call, node, ctx):
+        """One replica attempt, run on a fan-out worker thread; ``ctx`` is
+        the caller thread's trace context (thread-local span stacks do not
+        follow threads), so traced fan-outs still render one tree tagged
+        {replica, shard}."""
+        if ctx is not None:
+            span = TRACER.span_from_context(
+                f"client.{op_name}.replica", ctx, replica=host, shard=shard
+            )
+        else:
+            span = NOOP_SPAN
+        with span:
+            return call(node)
+
     def _fanout(self, op_name: str, shard: int, required: int, call,
-                readable_only: bool = False):
-        """Try ``call(node)`` on every replica of ``shard``; a raising
-        replica must not abort the fan-out — remaining replicas can still
-        reach quorum (session.go:1068). Returns the per-replica results;
-        raises ConsistencyError when fewer than ``required`` succeed.
+                readable_only: bool = False, unstrict: bool = False):
+        """Call ``call(node)`` on every replica of ``shard`` IN PARALLEL;
+        a raising or hanging replica must not abort (or stall) the fan-out
+        — remaining replicas can still reach quorum (session.go:1068,
+        "Tail at Scale": never serialize behind the slowest copy). Returns
+        per-replica results in placement order; raises ConsistencyError
+        when fewer than ``required`` succeed — accounting is
+        first-quorum-wins: once ``required`` replicas have succeeded the
+        op is good regardless of what stragglers do later.
+
+        Replicas that fail are re-attempted for up to ``op_retries``
+        extra rounds within the same ``fanout_timeout`` window (safe for
+        writes: datapoint writes are storage-level upserts).
 
         ``readable_only`` gates on shard state: an INITIALIZING replica is
-        still bootstrapping the shard and must not serve reads for it
-        (topology readable-shard filtering; writes go to every replica so
-        the initializing one doesn't miss data).
-
-        Inside a traced request (an active span on this thread) the fan-out
-        gets a span per replica attempt tagged {replica, shard}, so
-        /debug/traces shows exactly which copies served a quorum op;
-        untraced writes pay nothing."""
-        traced = TRACER.active()
-        success, errors, results = 0, [], []
-        for host in self.topology.hosts_for_shard(shard, readable_only=readable_only):
-            node = self.nodes.get(host)
-            if node is None or not node.is_up:
-                errors.append(f"{host}: down")
-                continue
-            span = (
-                TRACER.span(f"client.{op_name}.replica", replica=host, shard=shard)
-                if traced
-                else NOOP_SPAN
+        still bootstrapping the shard and must not serve reads for it.
+        ``unstrict`` (UNSTRICT_MAJORITY reads) degrades to the replicas
+        that DID respond — at least one — instead of raising."""
+        hosts = self.topology.hosts_for_shard(shard, readable_only=readable_only)
+        ctx = TRACER.current_context()
+        deadline = time.monotonic() + self.fanout_timeout
+        ok: dict[str, object] = {}  # host -> result
+        errors: list[str] = []
+        pending = list(hosts)
+        round_no = 0
+        while True:
+            round_no += 1
+            errors = []
+            futs = {}
+            for host in pending:
+                node = self.nodes.get(host)
+                if node is None or not node.is_up:
+                    errors.append(f"{host}: down")
+                    continue
+                futs[self._pool().submit(
+                    self._replica_call, op_name, host, shard, call, node, ctx
+                )] = host
+            abandoned = self._collect_first_quorum(
+                futs, deadline,
+                quorum=lambda: len(ok) >= required,
+                on_result=ok.__setitem__,
+                on_error=lambda host, exc: errors.append(f"{host}: {exc}"),
             )
-            try:
-                with span:
-                    results.append(call(node))
-                success += 1
-            except Exception as exc:
-                errors.append(f"{host}: {exc}")
-        if success < required:
-            raise ConsistencyError(op_name, success, required, errors)
+            for fut in abandoned:
+                errors.append(
+                    f"{futs[fut]}: no reply within the fan-out window"
+                )
+            if len(ok) >= required:
+                break
+            pending = [h for h in hosts if h not in ok]
+            if not any(
+                self.nodes.get(h) is not None and self.nodes[h].is_up
+                for h in pending
+            ):
+                break  # nothing left to retry against
+            if not self._next_round(op_name, round_no, deadline):
+                break
+        results = ReplicaResults(ok[h] for h in hosts if h in ok)
+        if len(ok) < required:
+            if unstrict and len(ok) >= 1:
+                results.degraded = True
+                return results
+            raise ConsistencyError(op_name, len(ok), required, errors)
         return results
 
     # --- writes (session.go:977-1100) ---
@@ -248,51 +476,73 @@ class Session:
         ENTRY from the returned per-element errors. ``entries``:
         (tags, t_nanos, value) or (tags, t_nanos, value, unit). Returns
         (series ids, per-entry error-or-None) — entries that achieved
-        quorum are good even when neighbors failed."""
+        quorum are good even when neighbors failed.
+
+        ``timeout`` is ONE monotonic deadline shared by the whole batch
+        (not per pending write — the old per-write wait made the worst
+        case entries × replicas × timeout). Entries still short of quorum
+        inside the deadline get up to ``op_retries`` extra rounds
+        re-enqueued ONLY to the replicas that failed (safe: datapoint
+        writes are storage-level upserts)."""
         from ..rules.rules import encode_tags_id
 
         required = self.write_consistency.required(self.topology.replicas)
+        deadline = time.monotonic() + timeout
         sids: list[bytes] = []
-        errs: list[str | None] = []
-        pendings: list[list[_PendingWrite]] = []
-        touched: set[str] = set()
+        prepared: list[tuple[tuple, list[str]]] = []  # (entry, replica hosts)
         for e in entries:
             tags, t, v = e[0], e[1], e[2]
             unit = int(e[3]) if len(e) > 3 else int(Unit.SECOND)
             sid = encode_tags_id(tags)
             sids.append(sid)
-            per_entry: list[_PendingWrite] = []
-            for host in self.topology.hosts_for_shard(self._shard(sid)):
-                node = self.nodes.get(host)
-                if node is None or not node.is_up:
-                    continue
-                q = self._host_queue(host)
-                if q is None:
-                    continue
-                pw = _PendingWrite((tags, t, v, unit))
-                q.enqueue(pw)
-                per_entry.append(pw)
-                touched.add(host)
-            errs.append(
-                None if len(per_entry) >= required
-                else f"replicas down ({len(per_entry)}/{required})"
+            prepared.append(
+                ((tags, t, v, unit),
+                 self.topology.hosts_for_shard(self._shard(sid)))
             )
-            pendings.append(per_entry)
-        for host in touched:
-            self._queues[host].flush_now()
-        for i, per_entry in enumerate(pendings):
-            if errs[i] is not None:
-                continue
-            ok = 0
-            last_err = None
-            for pw in per_entry:
-                pw.event.wait(timeout)
+        ok_hosts: list[set[str]] = [set() for _ in prepared]
+        last_err: list[str | None] = [None] * len(prepared)
+        round_no = 0
+        while True:
+            round_no += 1
+            pending: list[tuple[int, str, _PendingWrite]] = []
+            touched: set[str] = set()
+            for i, (entry, hosts) in enumerate(prepared):
+                if len(ok_hosts[i]) >= required:
+                    continue
+                for host in hosts:
+                    if host in ok_hosts[i]:
+                        continue
+                    node = self.nodes.get(host)
+                    if node is None or not node.is_up:
+                        continue
+                    q = self._host_queue(host)
+                    if q is None:
+                        continue
+                    pw = _PendingWrite(entry)
+                    q.enqueue(pw)
+                    pending.append((i, host, pw))
+                    touched.add(host)
+            for host in touched:
+                self._queues[host].flush_now()
+            for i, host, pw in pending:
+                pw.event.wait(max(0.0, deadline - time.monotonic()))
                 if pw.event.is_set() and pw.error is None:
-                    ok += 1
+                    ok_hosts[i].add(host)
                 else:
-                    last_err = pw.error or "timeout"
-            if ok < required:
-                errs[i] = f"quorum {ok}/{required}: {last_err}"
+                    last_err[i] = pw.error or "timeout"
+            short = [i for i in range(len(prepared))
+                     if len(ok_hosts[i]) < required]
+            if not short or not self._next_round("write_batch", round_no, deadline):
+                break
+        errs: list[str | None] = []
+        for i in range(len(prepared)):
+            n_ok = len(ok_hosts[i])
+            if n_ok >= required:
+                errs.append(None)
+            elif last_err[i] is None:
+                errs.append(f"replicas down ({n_ok}/{required})")
+            else:
+                errs.append(f"quorum {n_ok}/{required}: {last_err[i]}")
         return sids, errs
 
     def write_batch_tagged(self, entries, timeout: float = 30.0) -> list[bytes]:
@@ -308,9 +558,18 @@ class Session:
         return sids
 
     def close(self) -> None:
+        if self._prober is not None:
+            self._prober.stop()
+            self._prober = None
         for q in self._queues.values():
             q.stop()
         self._queues.clear()
+        with self._pool_lock:
+            if self._pool_obj is not None:
+                # daemon workers: close() just asks them to exit; abandoned
+                # stragglers can't stall this call or interpreter exit
+                self._pool_obj.close()
+                self._pool_obj = None
 
     # --- reads (session.go:1269-1530 + series_iterator replica merge) ---
 
@@ -331,6 +590,7 @@ class Session:
             self.read_consistency.required(self.topology.replicas),
             lambda node: node.fetch_blocks(self.namespace, sid, start_nanos, end_nanos),
             readable_only=True,
+            unstrict=self.read_consistency.unstrict,
         )
         it = SeriesIterator(
             sid,
@@ -338,68 +598,131 @@ class Session:
             start_nanos=start_nanos,
             end_nanos=end_nanos,
         )
-        return list(it)
+        out = SeriesResult(it)
+        out.exhaustive = not replies.degraded
+        return out
 
     def fetch_tagged(self, query, start_nanos: int, end_nanos: int,
                      limit: int | None = None):
-        """Fan out to replicas of every shard; merge + dedupe series across
-        replicas (last-written value wins on equal timestamps, the
-        SeriesIterator default). ``limit`` caps the merged series count."""
+        """Fan out to replicas of every shard IN PARALLEL (one hung host
+        costs at most ``fanout_timeout``, never a serial stall); merge +
+        dedupe series across replicas (last-written value wins on equal
+        timestamps, the SeriesIterator default). ``limit`` caps the merged
+        series count. Failed hosts are re-attempted for up to
+        ``op_retries`` rounds (reads are idempotent).
+
+        Under UNSTRICT_MAJORITY a shard short of quorum — but with at
+        least ONE responding readable replica — degrades instead of
+        raising: the result carries ``exhaustive = False`` and is exactly
+        what the responding replicas serve (bit-identical to a MAJORITY
+        read over just those replicas)."""
         required = self.read_consistency.required(self.topology.replicas)
+        unstrict = self.read_consistency.unstrict
         traced = TRACER.active()
         fanout_span = (
             TRACER.span("client.fetch_tagged", namespace=self.namespace)
             if traced
             else NOOP_SPAN
         )
-        by_series: dict[bytes, tuple] = {}
-        responded_by_shard: dict[int, int] = {}
-        with fanout_span:
-            for host, node in self.nodes.items():
-                if not node.is_up:
-                    continue
-                span = (
-                    TRACER.span("client.fetch_tagged.replica", replica=host)
-                    if traced
-                    else NOOP_SPAN
+        # captured INSIDE the span (below): replica spans must parent to
+        # client.fetch_tagged, and the span only becomes current on entry
+        ctx = None
+
+        def one(host, node):
+            if ctx is not None:
+                span = TRACER.span_from_context(
+                    "client.fetch_tagged.replica", ctx, replica=host
                 )
-                try:
-                    with span:
-                        res = node.fetch_tagged(
-                            self.namespace, query, start_nanos, end_nanos,
-                            limit=limit,
-                        )
-                except Exception:
-                    continue
-                # count this replica only for shards whose copy here is
-                # READABLE per the placement — an INITIALIZING replica is
-                # still bootstrapping and must not count toward read
-                # consistency
-                owned = node.owned_shards()
-                for shard in owned:
-                    if host in self.topology.hosts_for_shard(shard, readable_only=True):
-                        responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
-                for sid, tags, dps in res:
-                    cur = by_series.get(sid)
-                    if cur is None:
-                        by_series[sid] = (tags, {dp.timestamp: dp for dp in dps})
-                    else:
-                        merged = cur[1]
-                        for dp in dps:
-                            merged.setdefault(dp.timestamp, dp)
+            else:
+                span = NOOP_SPAN
+            with span:
+                res = node.fetch_tagged(
+                    self.namespace, query, start_nanos, end_nanos, limit=limit
+                )
+                return res, node.owned_shards()
+
+        responses: dict[str, tuple] = {}  # host -> (series rows, owned shards)
+        # per-shard quorum accounting accumulates AS responses arrive: a
+        # replica counts only for shards whose copy there is READABLE per
+        # the placement — an INITIALIZING replica is still bootstrapping
+        # and must not count toward read consistency
+        responded_by_shard: dict[int, int] = {}
+
+        def record(host: str, result: tuple) -> None:
+            responses[host] = result
+            for shard in result[1]:
+                if host in self.topology.hosts_for_shard(shard, readable_only=True):
+                    responded_by_shard[shard] = responded_by_shard.get(shard, 0) + 1
+
+        def quorum_met() -> bool:
+            return all(
+                responded_by_shard.get(s, 0) >= required
+                for s in range(self.num_shards)
+            )
+
+        with fanout_span:
+            ctx = TRACER.current_context() if traced else None
+            deadline = time.monotonic() + self.fanout_timeout
+            pending = list(self.nodes)
+            round_no = 0
+            while True:
+                round_no += 1
+                futs = {}
+                for host in pending:
+                    node = self.nodes[host]
+                    if not node.is_up:
+                        continue
+                    futs[self._pool().submit(one, host, node)] = host
+                # first-quorum-wins, like _fanout, with the per-shard
+                # responder count as the quorum predicate: one hung
+                # replica costs quorum-time + grace, not fanout_timeout
+                self._collect_first_quorum(
+                    futs, deadline, quorum=quorum_met,
+                    on_result=record, on_error=lambda host, exc: None,
+                )
+                pending = [h for h in self.nodes if h not in responses]
+                if (
+                    quorum_met()
+                    or not any(self.nodes[h].is_up for h in pending)
+                    or not self._next_round("fetch_tagged", round_no, deadline)
+                ):
+                    break
         # consistency check over EVERY shard in the placement — a shard whose
         # replicas are all down has zero responders and must fail the read,
-        # not silently return partial results (session.go:1789-1815)
+        # not silently return partial results (session.go:1789-1815).
+        # UNSTRICT_MAJORITY degrades a short-but-nonzero shard to the
+        # replicas that responded, marked non-exhaustive.
+        degraded = False
         for shard in range(self.num_shards):
             count = responded_by_shard.get(shard, 0)
             if count < required:
+                if unstrict and count >= 1:
+                    degraded = True
+                    continue
                 raise ConsistencyError("read", count, required, [f"shard {shard}"])
-        out = []
+        # merge in a FIXED host order (self.nodes iteration order), not
+        # completion order — concurrent arrival must not change which
+        # replica wins an equal-timestamp dedupe
+        by_series: dict[bytes, tuple] = {}
+        for host in self.nodes:
+            if host not in responses:
+                continue
+            res, _ = responses[host]
+            for sid, tags, dps in res:
+                cur = by_series.get(sid)
+                if cur is None:
+                    by_series[sid] = (tags, {dp.timestamp: dp for dp in dps})
+                else:
+                    merged = cur[1]
+                    for dp in dps:
+                        merged.setdefault(dp.timestamp, dp)
+        out = TaggedResult()
+        out.exhaustive = not degraded
         for sid in sorted(by_series):
             tags, merged = by_series[sid]
             out.append((sid, tags, [merged[t] for t in sorted(merged)]))
         if limit is not None and len(out) > limit:
-            out = out[:limit]
+            del out[limit:]
         return out
 
     # --- index-only reads (QueryIDs / AggregateQuery fan-out) ---

@@ -15,13 +15,17 @@ cluster heals without an operator.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..utils.instrument import DEFAULT as METRICS
 from .placement import PlacementService, replace_instance
 from .services import Services
+
+_LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -156,12 +160,29 @@ class FailureDetector:
     # --- background driver ---
 
     def start(self, interval: float = 1.0) -> None:
+        errors = METRICS.counter(
+            "failure_detector_errors_total",
+            "exceptions swallowed by the failure-detector poll loop",
+        )
+
         def loop() -> None:
+            logged = False
             while not self._stop.wait(interval):
                 try:
                     self.check()
                 except Exception:
-                    pass  # detector must never die to a transient error
+                    # the detector must never die to a transient error, but
+                    # a PERSISTENTLY failing detector silently leaves the
+                    # cluster unhealed — count every swallow and log the
+                    # first so it shows up in /metrics and the logs
+                    errors.inc()
+                    if not logged:
+                        logged = True
+                        _LOG.exception(
+                            "failure detector poll failed (suppressing "
+                            "further tracebacks; see "
+                            "m3tpu_failure_detector_errors_total)"
+                        )
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

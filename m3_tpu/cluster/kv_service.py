@@ -205,8 +205,11 @@ class RemoteKVStore:
                     last_exc = exc
                     hint = str(exc).rsplit(" ", 1)[-1]
                     self._redirect(hint if hint != "NotLeaderError:" else "")
-                elif exc.etype == "RetryableError":
-                    last_exc = exc  # e.g. no leader yet / commit timeout
+                elif exc.etype in ("RetryableError", "UnavailableError"):
+                    # no leader yet / commit timeout, or the middleware's
+                    # typed pre-dispatch rejection (expired deadline, load
+                    # shed): nothing applied, safe to try again
+                    last_exc = exc
                 else:
                     raise
             except (ConnectionError, OSError, ValueError) as exc:

@@ -15,14 +15,26 @@ from .placement import Placement, PlacementService, ShardState
 class ConsistencyLevel(enum.Enum):
     ONE = "one"
     MAJORITY = "majority"
+    # UNSTRICT_MAJORITY (consistency_level.go ReadConsistencyLevelUnstrictMajority):
+    # PREFER a majority of replicas, but degrade a read to whatever
+    # responded (at least one replica per touched shard) instead of
+    # failing it — results are marked non-exhaustive by the session so the
+    # caller knows it got the best-available view, not the quorum view.
+    UNSTRICT_MAJORITY = "unstrict_majority"
     ALL = "all"
 
     def required(self, replicas: int) -> int:
         if self is ConsistencyLevel.ONE:
             return 1
-        if self is ConsistencyLevel.MAJORITY:
+        if self in (ConsistencyLevel.MAJORITY, ConsistencyLevel.UNSTRICT_MAJORITY):
             return replicas // 2 + 1
         return replicas
+
+    @property
+    def unstrict(self) -> bool:
+        """Whether missing the required count degrades instead of failing
+        (reads only; writes under an unstrict level still gate strictly)."""
+        return self is ConsistencyLevel.UNSTRICT_MAJORITY
 
 
 class TopologyMap:

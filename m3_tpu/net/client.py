@@ -1,10 +1,16 @@
-"""RemoteNode: socket-backed node stub with a connection pool + retries.
+"""RemoteNode: socket-backed node stub with a connection pool, budgeted
+retries, and a per-host circuit breaker.
 
 Reference: /root/reference/src/dbnode/client/ — host queues and connection
-pools (session.go:505 Open, host_queue.go); here each RemoteNode keeps a
-small pool of persistent connections, retries once on a broken connection
-(idempotent ops), and surfaces remote errors as exceptions so the Session's
-consistency accounting treats them like any replica failure.
+pools (session.go:505 Open, host_queue.go) plus x/retry (backoff + jitter +
+retry budgets) and per-host connection health checking. Each RemoteNode
+keeps a small pool of persistent connections; transport failures are
+retried (with decorrelated-jitter backoff and a per-client retry budget)
+ONLY for ops in wire.IDEMPOTENT_OPS, every call carries a propagated
+deadline, and consecutive transport failures open a circuit breaker that
+backs ``is_up`` — so the Session's down-replica accounting fires for remote
+nodes instead of paying a timeout per fan-out. Remote errors surface as
+exceptions so consistency accounting treats them like any replica failure.
 
 RemoteNode implements the same surface as testing/cluster.Node, so a Session
 works identically over in-process nodes and sockets.
@@ -16,9 +22,21 @@ import socket
 import threading
 import time
 
+from ..utils.instrument import DEFAULT as METRICS
 from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
 from . import wire
+from .resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    DeadlineExceededError,
+    RetryPolicy,
+)
+
+# failures of the transport itself (vs typed errors from a living server):
+# these count against the peer's circuit breaker. ValueError covers a
+# corrupt frame — the connection is unusable either way.
+TRANSPORT_ERRORS = (ConnectionError, OSError, ValueError)
 
 
 class RemoteError(RuntimeError):
@@ -37,10 +55,18 @@ class RpcClient:
         port: int,
         pool_size: int = 4,
         timeout: float = 10.0,
+        retry_policy: RetryPolicy | None = None,
+        breaker: CircuitBreaker | None = None,
     ) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy()
+        )
+        self.breaker = (
+            breaker if breaker is not None else CircuitBreaker(peer=f"{host}:{port}")
+        )
         self._pool: list[socket.socket] = []
         self._pool_lock = threading.Lock()
         self._pool_size = pool_size
@@ -83,29 +109,91 @@ class RpcClient:
         # (a span is active on this thread), it gets its own client span and
         # the context rides the wire so the server joins the same trace —
         # the per-process spans stitch into one tree (Dapper propagation).
-        # Untraced calls (no active span) pay nothing.
+        # Untraced calls (no active span) pay nothing. Retries happen INSIDE
+        # this one span (tagged retried=N) — a retry is one logical call,
+        # not a second nested rpc.client span.
         if TRACER.active() and op not in wire.UNTRACED_OPS:
             span = TRACER.span(f"rpc.client.{op}", peer=f"{self.host}:{self.port}")
         else:
             span = NOOP_SPAN
         with span:
-            return self._call_traced(op, _retry, _timeout, args)
+            return self._call_attempts(op, _retry, _timeout, args, span)
 
-    def _call_traced(self, op: str, _retry: bool, _timeout: float | None, args: dict):
+    def _call_attempts(self, op: str, _retry: bool, _timeout: float | None,
+                       args: dict, span):
+        """Attempt loop: budgeted transparent retries for IDEMPOTENT ops
+        only (transport failures and typed retryable rejections); every
+        attempt is gated by the peer's circuit breaker and bounded by one
+        shared per-call deadline that also rides the wire."""
+        deadline = time.time() + (_timeout if _timeout is not None else self.timeout)
+        retryable = _retry and op in wire.IDEMPOTENT_OPS
+        attempt = 0
+        prev_backoff = 0.0
+        while True:
+            if not self.breaker.allow():
+                raise BreakerOpenError(
+                    f"circuit open for {self.host}:{self.port} ({op})"
+                )
+            try:
+                result = self._call_once(op, args, deadline)
+            except TRANSPORT_ERRORS as exc:
+                self.breaker.record_failure()
+                err: Exception = exc
+            except RemoteError as exc:
+                # the server is alive and answered — that is breaker-success
+                self.breaker.record_success()
+                if exc.etype not in wire.RETRYABLE_ETYPES:
+                    raise
+                err = exc
+            except BaseException:
+                # an abort that says nothing about the peer (deadline
+                # expired before sending, KeyboardInterrupt): release any
+                # half-open probe slot allow() claimed, or the breaker
+                # would stay probing forever and never admit another call
+                self.breaker.release()
+                raise
+            else:
+                self.breaker.record_success()
+                self.retry_policy.on_success()
+                return result
+            attempt += 1
+            if (
+                not retryable
+                or time.time() >= deadline
+                or not self.retry_policy.allow_retry(attempt)
+            ):
+                raise err
+            METRICS.counter(
+                "rpc_retries_total",
+                "transparent RPC-layer retries of idempotent ops",
+                labels={"op": op},
+            ).inc()
+            span.set_tag("retried", attempt)
+            prev_backoff = self.retry_policy.backoff(attempt, prev_backoff)
+            if prev_backoff > 0.0:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise err
+                time.sleep(min(prev_backoff, remaining))
+
+    def _call_once(self, op: str, args: dict, deadline: float):
+        """One wire round trip; the deadline bounds the socket wait and is
+        propagated in the frame so the server can refuse expired work."""
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            raise DeadlineExceededError(
+                f"deadline expired before sending {op!r} to {self.host}:{self.port}"
+            )
         req = wire.inject_trace({"op": op, **args}, TRACER.current_context())
+        wire.inject_deadline(req, deadline)
         sock = self._acquire()
         try:
-            if _timeout is not None:
-                sock.settimeout(_timeout)
+            sock.settimeout(remaining)
             wire.send_frame(sock, req)
             resp = wire.recv_frame(sock)
-            if _timeout is not None:
-                sock.settimeout(self.timeout)
-        except (ConnectionError, OSError, ValueError):
+            sock.settimeout(self.timeout)
+        except BaseException:
             sock.close()
-            if _retry:
-                # one retry on a fresh connection (stale pooled socket)
-                return self._call(op, _retry=False, _timeout=_timeout, **args)
             raise
         self._release(sock)
         if not resp.get("ok"):
@@ -121,8 +209,10 @@ class RemoteNode(RpcClient):
         node_id: str | None = None,
         pool_size: int = 4,
         timeout: float = 10.0,
+        **kwargs,
     ) -> None:
-        super().__init__(host, port, pool_size=pool_size, timeout=timeout)
+        super().__init__(host, port, pool_size=pool_size, timeout=timeout,
+                         **kwargs)
         self.id = node_id or f"{host}:{port}"
         self._shards_cache: tuple[float, set[int]] | None = None
 
@@ -130,8 +220,13 @@ class RemoteNode(RpcClient):
 
     @property
     def is_up(self) -> bool:
-        # optimistic: failures surface as exceptions the session counts
-        return True
+        # backed by the per-host circuit breaker: False only while the
+        # breaker is open with its recovery window still running, so the
+        # Session's down-replica accounting skips a dead host instead of
+        # paying its connect/read timeout on every fan-out. Once the
+        # window elapses (or a background HealthProber closes the breaker)
+        # traffic resumes via the half-open probe.
+        return self.breaker.available()
 
     def health(self) -> dict:
         return self._call("health")

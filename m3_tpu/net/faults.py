@@ -1,0 +1,169 @@
+"""Deterministic fault-injection plans for the RPC plane.
+
+The CORE lives here in net/ (stdlib + instrument only) because the server
+seam (net/server.py) must be able to consult a plan without importing the
+``m3_tpu.testing`` package, whose ``__init__`` forces a virtual CPU mesh
+into the process. Tests import the richer surface from
+``m3_tpu.testing.faults`` (in-process node wrappers, env helpers), which
+re-exports everything defined here.
+
+A plan is a seeded list of rules; each incoming decision point
+(client-side node-method call or server-side request dispatch) walks the
+matching rules and draws from ONE plan-owned RNG, so a fixed seed plus a
+fixed request sequence replays the exact same faults. Actions:
+
+- ``drop``: the request vanishes (server closes the connection without a
+  reply; in-process seam raises a ConnectionError) — the transport-failure
+  path clients must survive;
+- ``error``: a typed retryable ``UnavailableError`` reply;
+- ``delay``: injected latency before the request proceeds;
+- ``partition``: every matching request drops — a fully unreachable peer.
+
+Spawned servers pick a plan up from the ``M3_TPU_FAULT_PLAN`` env var
+(JSON, see :func:`plan_from_env`); nothing is installed when it is unset.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+from ..utils.instrument import DEFAULT as METRICS
+
+FAULT_PLAN_ENV = "M3_TPU_FAULT_PLAN"
+
+
+class FaultInjectedError(ConnectionError):
+    """Injected transport failure (the in-process seam's 'drop')."""
+
+
+@dataclass
+class FaultRule:
+    """One match+action row. ``op``/``peer`` of None match anything;
+    probabilities are independent draws in [0, 1].
+
+    A peer-SCOPED rule never matches a decision point that has no peer
+    (the server seam decides per op only): a fleet-wide env plan carrying
+    ``peer="node2"`` rules must not fault every node's server."""
+
+    op: str | None = None
+    peer: str | None = None
+    drop: float = 0.0
+    error: float = 0.0
+    delay: float = 0.0
+    delay_prob: float = 1.0
+    partition: bool = False
+
+    def matches(self, op: str, peer: str | None) -> bool:
+        if self.op is not None and self.op != op:
+            return False
+        if self.peer is not None and self.peer != peer:
+            return False
+        return True
+
+
+class FaultPlan:
+    """Seeded fault schedule over (op, peer) decision points.
+
+    ``exempt_ops`` are never faulted — a 'partitioned' node still answers
+    e.g. ``owned_shards`` so a fixture can converge shard state before the
+    chaos phase starts (a real switch partition would also leave the
+    management network alone).
+    """
+
+    def __init__(
+        self,
+        rules: list[FaultRule],
+        seed: int = 0,
+        exempt_ops: tuple | list = (),
+    ) -> None:
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self.exempt_ops = frozenset(exempt_ops)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._injected = {
+            kind: METRICS.counter(
+                "faults_injected_total",
+                "faults injected by the active FaultPlan",
+                labels={"kind": kind},
+            )
+            for kind in ("drop", "error", "delay", "partition")
+        }
+
+    # -- decisions --
+
+    def decide(self, op: str, peer: str | None = None) -> tuple[str, float]:
+        """One decision draw: ('pass'|'drop'|'error', delay_seconds)."""
+        if op in self.exempt_ops:
+            return "pass", 0.0
+        delay = 0.0
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(op, peer):
+                    continue
+                if rule.partition:
+                    self._injected["partition"].inc()
+                    return "drop", delay
+                if rule.delay > 0.0 and self._rng.random() < rule.delay_prob:
+                    delay += rule.delay
+                    self._injected["delay"].inc()
+                if rule.drop > 0.0 and self._rng.random() < rule.drop:
+                    self._injected["drop"].inc()
+                    return "drop", delay
+                if rule.error > 0.0 and self._rng.random() < rule.error:
+                    self._injected["error"].inc()
+                    return "error", delay
+        return "pass", delay
+
+    def apply_client(self, op: str, peer: str | None = None) -> None:
+        """In-process seam: sleep injected delay, raise injected failure.
+        'drop' surfaces as a ConnectionError (what a vanished request
+        looks like to a caller); 'error' as the typed retryable
+        RemoteError the server seam would have sent."""
+        action, delay = self.decide(op, peer)
+        if delay > 0.0:
+            time.sleep(delay)
+        if action == "drop":
+            raise FaultInjectedError(f"injected drop: {op} -> {peer or '?'}")
+        if action == "error":
+            from .client import RemoteError
+
+            raise RemoteError(
+                "UnavailableError", f"injected unavailable: {op} -> {peer or '?'}"
+            )
+
+    # -- (de)serialization for the env seam --
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "exempt_ops": sorted(self.exempt_ops),
+                "rules": [asdict(r) for r in self.rules],
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultPlan":
+        spec = json.loads(raw)
+        rules = [FaultRule(**r) for r in spec.get("rules", [])]
+        return cls(
+            rules,
+            seed=int(spec.get("seed", 0)),
+            exempt_ops=tuple(spec.get("exempt_ops", ())),
+        )
+
+
+def plan_from_env(env=None) -> FaultPlan | None:
+    """The spawned-server seam: a FaultPlan from M3_TPU_FAULT_PLAN, or
+    None when unset. Malformed JSON raises — a chaos run silently running
+    without its faults would pass vacuously."""
+    raw = (env if env is not None else os.environ).get(FAULT_PLAN_ENV, "")
+    if not raw:
+        return None
+    return FaultPlan.from_json(raw)

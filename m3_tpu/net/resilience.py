@@ -1,0 +1,282 @@
+"""Resilience primitives for the RPC plane: retry policies with budgets,
+per-host circuit breakers, deadline bookkeeping, and background health
+probing.
+
+Reference: the reference M3 ships x/retry (exponential backoff + jitter +
+retry *budgets* so a brown-out cannot amplify itself into a retry storm)
+and per-host connection health checking in the dbnode client
+(connection_pool.go health checks gating host queues). "The Tail at Scale"
+(Dean & Barroso, CACM 2013) and the Hystrix circuit-breaker literature
+motivate the rest of the toolkit: propagated deadlines so work is never
+done for a caller that stopped waiting, and fast-fail ejection of hosts
+that keep timing out so fan-outs stop paying the worst replica's tail.
+
+Everything here emits through utils/instrument's process registry:
+
+    m3tpu_rpc_retries_total{op}           transparent RPC-layer retries
+    m3tpu_rpc_retry_budget_exhausted_total retries suppressed by the budget
+    m3tpu_breaker_state{peer}             0 closed / 1 half-open / 2 open
+    m3tpu_breaker_transitions_total{peer,to}
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..utils.instrument import DEFAULT as METRICS
+
+
+class UnavailableError(RuntimeError):
+    """Typed RETRYABLE server-side rejection: the request was refused
+    before any state changed (expired deadline, load shed, injected
+    fault), so even a non-idempotent op is safe to send again."""
+
+
+class BreakerOpenError(ConnectionError):
+    """Fast-fail raised client-side while a peer's circuit is open; a
+    ConnectionError so callers' transport-failure handling (session
+    replica accounting, KV failover rotation) treats it like any other
+    unreachable-peer outcome — without paying a socket timeout."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The caller's deadline expired before (or while) the call ran."""
+
+
+class RetryBudget:
+    """Token bucket bounding the *ratio* of retries to requests
+    (x/retry's budget role, gRPC retry-throttling shape): every success
+    deposits ``token_ratio`` tokens, every retry spends one, and retries
+    are allowed only while the bucket is above half — so a total outage
+    degrades to ~token_ratio extra load instead of multiplying traffic
+    by the retry count."""
+
+    def __init__(self, max_tokens: float = 32.0, token_ratio: float = 0.2) -> None:
+        self.max_tokens = float(max_tokens)
+        self.token_ratio = float(token_ratio)
+        self._tokens = float(max_tokens)
+        self._lock = threading.Lock()
+        self._exhausted = METRICS.counter(
+            "rpc_retry_budget_exhausted_total",
+            "retries suppressed because the retry budget ran dry",
+        )
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._tokens = min(self.max_tokens, self._tokens + self.token_ratio)
+
+    def try_spend(self) -> bool:
+        """Spend one token for a retry; False (and a metric tick) when the
+        budget is below half — the caller must fail instead of retrying."""
+        with self._lock:
+            if self._tokens <= self.max_tokens / 2:
+                allowed = False
+            else:
+                self._tokens -= 1.0
+                allowed = True
+        if not allowed:
+            self._exhausted.inc()
+        return allowed
+
+
+class RetryPolicy:
+    """Exponential backoff with DECORRELATED jitter plus a retry budget.
+
+    ``backoff(attempt, prev)`` follows the "decorrelated jitter" scheme
+    (sleep = min(cap, uniform(base, prev * 3))) except that the FIRST
+    retry sleeps 0 — the overwhelmingly common transport failure is a
+    stale pooled socket whose peer restarted, and an immediate retry on a
+    fresh connection both preserves the pre-budget behavior of this
+    client and keeps the happy path fast.
+
+    ``seed`` pins the jitter RNG for deterministic tests; production
+    callers leave it None.
+    """
+
+    def __init__(
+        self,
+        max_retries: int = 3,
+        initial_backoff: float = 0.02,
+        max_backoff: float = 1.0,
+        budget: RetryBudget | None = None,
+        seed: int | None = None,
+    ) -> None:
+        self.max_retries = int(max_retries)
+        self.initial_backoff = float(initial_backoff)
+        self.max_backoff = float(max_backoff)
+        self.budget = RetryBudget() if budget is None else budget
+        self._rng = random.Random(seed)
+
+    def backoff(self, attempt: int, prev: float = 0.0) -> float:
+        """Sleep before retry number ``attempt`` (1-based), given the
+        previous sleep; bounded by [0, max_backoff]."""
+        if attempt <= 1:
+            return 0.0
+        lo = self.initial_backoff
+        hi = max(lo, min(self.max_backoff, max(prev, lo) * 3.0))
+        return min(self.max_backoff, self._rng.uniform(lo, hi))
+
+    def allow_retry(self, attempt: int) -> bool:
+        """Whether retry number ``attempt`` (1-based) may happen: bounded
+        by max_retries AND by the shared budget."""
+        if attempt > self.max_retries:
+            return False
+        return self.budget.try_spend()
+
+    def on_success(self) -> None:
+        self.budget.on_success()
+
+
+_BREAKER_STATE_VALUES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+class CircuitBreaker:
+    """Per-host circuit breaker: closed → open after
+    ``failure_threshold`` CONSECUTIVE transport failures; open → half-open
+    after ``recovery_timeout``; the single half-open probe closes it on
+    success or re-opens it on failure (Hystrix state machine).
+
+    Only transport failures count — an application error from a living
+    server is evidence the host is UP. ``clock`` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        peer: str = "",
+        failure_threshold: int = 5,
+        recovery_timeout: float = 2.0,
+        clock=time.monotonic,
+    ) -> None:
+        self.peer = peer
+        self.failure_threshold = int(failure_threshold)
+        self.recovery_timeout = float(recovery_timeout)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self._gauge = METRICS.gauge(
+            "breaker_state",
+            "per-peer circuit state: 0 closed, 1 half-open, 2 open",
+            labels={"peer": peer or "?"},
+        )
+        self._gauge.set(0.0)
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _set_state(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        self._gauge.set(_BREAKER_STATE_VALUES[state])
+        METRICS.counter(
+            "breaker_transitions_total",
+            "circuit breaker state transitions",
+            labels={"peer": self.peer or "?", "to": state},
+        ).inc()
+
+    def available(self) -> bool:
+        """Side-effect-free 'worth talking to' check (RemoteNode.is_up):
+        False only while open with the recovery window still running."""
+        with self._lock:
+            if self._state != "open":
+                return True
+            return self._clock() - self._opened_at >= self.recovery_timeout
+
+    def allow(self) -> bool:
+        """Gate one call attempt. Open→half-open transition happens here
+        once the recovery window elapses; in half-open exactly ONE probe
+        is in flight at a time."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self._clock() - self._opened_at < self.recovery_timeout:
+                    return False
+                self._set_state("half_open")
+                self._probing = True
+                return True
+            # half-open: single probe at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def release(self) -> None:
+        """Release a probe slot claimed by :meth:`allow` when the attempt
+        aborted WITHOUT learning anything about the peer (e.g. the
+        caller's deadline expired before anything was sent) — otherwise a
+        half-open breaker whose probe aborted would stay probing forever
+        and never admit another attempt."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != "closed":
+                self._set_state("closed")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            if self._state == "half_open":
+                self._opened_at = self._clock()
+                self._set_state("open")
+                return
+            self._failures += 1
+            if self._state == "closed" and self._failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                self._set_state("open")
+
+
+class HealthProber:
+    """Cheap background health probe driving per-host breakers back
+    closed (the reference client's connection health check role): probes
+    only hosts whose breaker is NOT closed, so a healthy fleet costs
+    nothing and a recovered host is readmitted within ~interval instead
+    of waiting for live traffic to half-open probe it."""
+
+    def __init__(self, nodes: dict, interval: float = 0.25,
+                 probe_timeout: float = 1.0) -> None:
+        self.nodes = nodes
+        self.interval = interval
+        self.probe_timeout = probe_timeout
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HealthProber":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="m3tpu-health-prober"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            for node in list(self.nodes.values()):
+                breaker = getattr(node, "breaker", None)
+                if breaker is None or breaker.state == "closed":
+                    continue
+                try:
+                    # success/failure lands on the breaker inside _call
+                    node._call("health", _retry=False,
+                               _timeout=self.probe_timeout)
+                except Exception:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -11,6 +11,7 @@ connection.
 
 from __future__ import annotations
 
+import os
 import socket
 import socketserver
 import threading
@@ -20,11 +21,14 @@ from ..utils.instrument import DEFAULT as METRICS
 from ..utils.trace import NOOP_SPAN, TRACER
 from ..utils.xtime import Unit
 from . import wire
+from .faults import plan_from_env
+from .resilience import UnavailableError
 
 
 class RpcMiddleware:
-    """Observability middleware over any ``handle(req) -> result`` service
-    (x/instrument's tally-scope-per-server role + opentracing adoption):
+    """Observability + admission middleware over any ``handle(req) ->
+    result`` service (x/instrument's tally-scope-per-server role +
+    opentracing adoption + the server half of the resilience plane):
 
     - per-op request/error counters, latency histograms, and an in-flight
       gauge, all labeled {component, op} so one /metrics scrape separates
@@ -33,14 +37,42 @@ class RpcMiddleware:
       gets a server-side span that JOINS the client's trace (the other half
       of net/client's injection) — a query fanning out coordinator → dbnode
       replicas renders as one stitched tree in /debug/traces;
+    - deadline enforcement: a request whose propagated ``_deadline``
+      already expired is refused with a typed retryable UnavailableError
+      BEFORE dispatch — the caller stopped waiting, so doing the work only
+      adds load exactly when the server is slow ("Tail at Scale");
+    - load shedding: past ``max_inflight`` concurrent requests the server
+      fast-fails new work with the same typed UnavailableError instead of
+      queueing into collapse ('metrics' is exempt so overload stays
+      observable);
     - a universal ``metrics`` op: services without their own op_metrics
       (raft KV, loadgen agents) still answer a Prometheus scrape, so every
       node in the fleet is scrapable over its existing RPC port.
     """
 
-    def __init__(self, service, component: str = "rpc") -> None:
+    def __init__(self, service, component: str = "rpc",
+                 max_inflight: int | None = None) -> None:
         self.service = service
         self.component = component
+        if max_inflight is None:
+            try:
+                max_inflight = int(os.environ.get("M3_TPU_RPC_MAX_INFLIGHT", "0"))
+            except ValueError:
+                max_inflight = 0
+        self.max_inflight = max(0, max_inflight)  # 0 = uncapped
+        self._inflight_total = 0
+        self._load_lock = threading.Lock()
+        labels = {"component": component}
+        self._deadline_exceeded = METRICS.counter(
+            "rpc_deadline_exceeded_total",
+            "requests refused because their propagated deadline expired",
+            labels=labels,
+        )
+        self._shed = METRICS.counter(
+            "rpc_shed_total",
+            "requests fast-failed past the in-flight cap",
+            labels=labels,
+        )
         # per-op metric handles, resolved once: registry child resolution
         # costs registry-lock round trips — the op set is small and fixed,
         # so every request after the first is one dict lookup
@@ -80,19 +112,44 @@ class RpcMiddleware:
     def handle(self, req: dict):
         op = str(req.get("op"))
         ctx = wire.extract_trace(req)
+        deadline = wire.extract_deadline(req)
         if op == "metrics" and not hasattr(self.service, "op_metrics"):
             return METRICS.expose()
+        requests, errors, inflight, hist = self._handles(op)
+        requests.inc()
+        # admission: shed past the in-flight cap before spending anything
+        # else on the request ('metrics' stays admitted so the scrape that
+        # would show the overload is never itself shed). The shared counter
+        # (and its lock) is only maintained when a cap is configured — the
+        # per-op gauges already cover observability in the default config.
+        tracked = bool(self.max_inflight) and op != "metrics"
+        if tracked:
+            with self._load_lock:
+                shed = self._inflight_total >= self.max_inflight
+                if not shed:
+                    self._inflight_total += 1
+            if shed:
+                self._shed.inc()
+                errors.inc()
+                raise UnavailableError(
+                    f"overloaded: {self.max_inflight} requests in flight, "
+                    f"shedding {op!r}"
+                )
         if ctx is not None and op not in wire.UNTRACED_OPS:
             span = TRACER.span_from_context(
                 f"rpc.server.{op}", ctx, component=self.component
             )
         else:
             span = NOOP_SPAN
-        requests, errors, inflight, hist = self._handles(op)
-        requests.inc()
         inflight.add(1)
         t0 = time.perf_counter()
         try:
+            if deadline is not None and time.time() >= deadline:
+                self._deadline_exceeded.inc()
+                raise UnavailableError(
+                    f"deadline expired {time.time() - deadline:.3f}s before "
+                    f"dispatch of {op!r}"
+                )
             with span:
                 return self.service.handle(req)
         except Exception:
@@ -101,6 +158,9 @@ class RpcMiddleware:
         finally:
             hist.observe(time.perf_counter() - t0)
             inflight.add(-1)
+            if tracked:
+                with self._load_lock:
+                    self._inflight_total -= 1
 
 
 class DebugService:
@@ -278,13 +338,20 @@ class RpcServer:
 
     def __init__(
         self, service, host: str = "127.0.0.1", port: int = 0,
-        component: str = "rpc",
+        component: str = "rpc", max_inflight: int | None = None,
+        fault_plan=None,
     ):
         self.service = service
         # every RPC server front end gets the observability middleware:
         # per-op metrics, trace adoption, and a universal `metrics` scrape op
-        svc = RpcMiddleware(service, component=component)
+        svc = RpcMiddleware(service, component=component,
+                            max_inflight=max_inflight)
         self.middleware = svc
+        # deterministic fault-injection seam: an explicit plan, or one from
+        # the M3_TPU_FAULT_PLAN env var for spawned chaos processes; None
+        # (the default) costs nothing per request
+        fault_plan = fault_plan if fault_plan is not None else plan_from_env()
+        self.fault_plan = fault_plan
         # live connections, force-closed on stop() so blocked long-polls and
         # pooled client sockets see a reset (SIGKILL semantics) instead of
         # silently talking to a stopped server
@@ -303,6 +370,25 @@ class RpcServer:
                             req = wire.recv_frame(self.request)
                         except (ConnectionError, OSError):
                             return
+                        if fault_plan is not None:
+                            action, delay = fault_plan.decide(str(req.get("op")))
+                            if delay > 0.0:
+                                time.sleep(delay)
+                            if action == "drop":
+                                # the request vanishes: close the connection
+                                # without a reply — the client sees the same
+                                # reset a crashed/partitioned server produces
+                                return
+                            if action == "error":
+                                try:
+                                    wire.send_frame(self.request, {
+                                        "ok": False,
+                                        "error": "UnavailableError: injected",
+                                        "etype": "UnavailableError",
+                                    })
+                                    continue
+                                except (ConnectionError, OSError):
+                                    return
                         try:
                             result = svc.handle(req)
                             resp = {"ok": True, "result": result}
@@ -360,5 +446,6 @@ class NodeServer(RpcServer):
     """TCP front end for a NodeService."""
 
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
-                 component: str = "dbnode"):
-        super().__init__(service, host=host, port=port, component=component)
+                 component: str = "dbnode", **kwargs):
+        super().__init__(service, host=host, port=port, component=component,
+                         **kwargs)

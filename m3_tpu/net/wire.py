@@ -199,6 +199,40 @@ UNTRACED_OPS = frozenset(
      "owned_shards"}
 )
 
+# ops the RPC client may TRANSPARENTLY retry on a transport failure or a
+# typed retryable rejection: re-executing them server-side changes nothing.
+# Everything else (writes, KV mutations, lease ops) reaches the server at
+# most once per caller-visible attempt — a broken connection mid-write is
+# ambiguous (the op may have applied), so only a layer that understands the
+# op's semantics (the Session's idempotent-upsert fan-out retry, the KV
+# store's documented at-least-once contract) may send it again. The raft
+# RPCs are idempotent by protocol — term/index consistency checks make a
+# duplicate append/vote a no-op — and keep their pre-registry stale-socket
+# retry behavior.
+IDEMPOTENT_OPS = frozenset(
+    {
+        # data-plane reads + probes
+        "health", "fetch", "fetch_blocks", "fetch_tagged", "query_ids",
+        "aggregate_query", "stream_shard", "block_metadata",
+        "stream_series_blocks", "scan_totals", "owned_shards",
+        # debug / observability
+        "metrics", "traces", "cache_stats", "resident_stats", "lg_poll",
+        # operator ops that re-apply to the same state
+        "flush", "assign_shards",
+        # raft protocol (duplicate-safe by design)
+        "raft_vote", "raft_append", "raft_snapshot", "raft_status",
+        # KV reads (mutations ride RemoteKVStore's own failover contract)
+        "kv_get", "kv_keys", "kv_get_prefix", "kv_lease_get",
+    }
+)
+
+# RemoteError etypes that are safe to retry for idempotent ops: the server
+# REFUSED the request (deadline already expired, load shed, injected fault)
+# without touching state. Raised as net.resilience.UnavailableError
+# server-side; RetryableError is the raft KV service's pre-existing
+# no-leader-yet rejection.
+RETRYABLE_ETYPES = frozenset({"UnavailableError", "RetryableError"})
+
 
 def inject_trace(req: dict, ctx: dict | None) -> dict:
     """Attach a tracer context (utils.trace.Tracer.current_context()) to an
@@ -220,6 +254,33 @@ def extract_trace(req: dict) -> dict | None:
     if not isinstance(tid, int) or not isinstance(sid, int):
         return None
     return {"trace_id": tid, "span_id": sid, "sampled": bool(sampled)}
+
+
+# --- deadline propagation (x/context deadlines over TChannel in the
+# reference; "The Tail at Scale" cancellation discipline: a server must not
+# burn cycles on a request whose caller already gave up) ---
+
+# reserved request-map key: absolute wall-clock deadline, seconds since the
+# unix epoch (wall clock, not monotonic — it must mean the same thing in
+# another process; peers are assumed clock-synced to well under typical
+# timeouts, as in the reference)
+DEADLINE_KEY = "_deadline"
+
+
+def inject_deadline(req: dict, deadline: float | None) -> dict:
+    """Attach an absolute wall-clock deadline to an RPC request map."""
+    if deadline is not None:
+        req[DEADLINE_KEY] = float(deadline)
+    return req
+
+
+def extract_deadline(req: dict) -> float | None:
+    """Pop the deadline off an incoming request map (popped so op handlers
+    never see the reserved key). Malformed → None, like extract_trace."""
+    raw = req.pop(DEADLINE_KEY, None)
+    if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+        return None
+    return float(raw)
 
 
 # --- query AST <-> wire values ---

@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
         "placement, and peers-bootstraps gained shards",
     )
     p.add_argument("--heartbeat-timeout", type=float, default=10.0)
+    p.add_argument(
+        "--max-inflight",
+        type=int,
+        default=0,
+        help="load-shedding cap on concurrent in-flight RPCs (0 = uncapped; "
+        "past the cap requests fast-fail with a typed retryable "
+        "unavailable error instead of queueing into collapse); also "
+        "settable via M3_TPU_RPC_MAX_INFLIGHT",
+    )
     # embedded seed control plane (server.go:266-324 embedded etcd role):
     # this node ALSO runs a raft KV replica; N seed nodes form the quorum
     p.add_argument("--embed-kv", action="store_true",
@@ -197,7 +206,10 @@ def main(argv=None) -> int:
 
     shards = {int(s) for s in args.shards.split(",") if s.strip()}
     service = NodeService(db, node_id=args.node_id, assigned_shards=shards)
-    server = NodeServer(service, host=args.host, port=args.port)
+    server = NodeServer(
+        service, host=args.host, port=args.port,
+        max_inflight=args.max_inflight or None,
+    )
 
     def wire_control_plane() -> None:
         """Dynamic topology via the networked control plane (server.go:

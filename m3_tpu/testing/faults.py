@@ -1,0 +1,94 @@
+"""Test-facing fault injection: seeded chaos plans for clusters.
+
+The FaultPlan core lives in :mod:`m3_tpu.net.faults` (stdlib + instrument
+only) because the server seam must consult a plan without importing this
+package — ``m3_tpu.testing.__init__`` forces a virtual CPU mesh into the
+process. This module re-exports the core and adds what only tests need:
+
+- :class:`FaultyNode`: wrap any in-process node (testing/cluster.Node or a
+  RemoteNode) so every node-method call first consults the plan — the
+  in-process equivalent of a lossy/partitioned network path to that peer;
+- :func:`wrap_nodes`: wrap a whole Session ``nodes`` dict at once;
+- :func:`env_with_plan`: an environ dict that installs the plan in spawned
+  servers (testing/proc_cluster ``node_env`` seam) via M3_TPU_FAULT_PLAN.
+
+Example chaos setup (20% request drops everywhere + node2 partitioned)::
+
+    plan = FaultPlan([FaultRule(drop=0.2)], seed=7)
+    cut = FaultPlan([FaultRule(peer="node2", partition=True)], seed=7)
+    session.nodes = wrap_nodes(session.nodes, plan)       # in-process
+    ProcCluster(node_env={"node2": env_with_plan(cut)})   # real processes
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..net.faults import (  # noqa: F401  (re-exported surface)
+    FAULT_PLAN_ENV,
+    FaultInjectedError,
+    FaultPlan,
+    FaultRule,
+    plan_from_env,
+)
+
+__all__ = [
+    "FAULT_PLAN_ENV",
+    "FaultInjectedError",
+    "FaultPlan",
+    "FaultRule",
+    "FaultyNode",
+    "env_with_plan",
+    "plan_from_env",
+    "wrap_nodes",
+]
+
+
+class FaultyNode:
+    """Transparent proxy over a node object applying a FaultPlan to every
+    method call (peer = the node's id): injected drops surface as
+    ConnectionError, injected errors as the typed retryable RemoteError —
+    exactly what the session sees from a real faulty transport."""
+
+    def __init__(self, node, plan: FaultPlan, peer: str | None = None) -> None:
+        self._node = node
+        self._plan = plan
+        self.peer = peer or getattr(node, "id", "?")
+
+    @property
+    def id(self):
+        return self._node.id
+
+    @property
+    def is_up(self):
+        return self._node.is_up
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._node, name)
+        if not callable(attr):
+            return attr
+        plan, peer = self._plan, self.peer
+
+        def faulted(*args, **kwargs):
+            plan.apply_client(name, peer)
+            return attr(*args, **kwargs)
+
+        return faulted
+
+
+def wrap_nodes(nodes: dict, plan: FaultPlan) -> dict:
+    """A copy of a Session ``nodes`` dict with every node behind the plan."""
+    return {host: FaultyNode(node, plan) for host, node in nodes.items()}
+
+
+def env_with_plan(plan: FaultPlan, base: dict | None = None) -> dict:
+    """Env-var overlay installing ``plan`` in a spawned server process."""
+    env = dict(base or {})
+    env[FAULT_PLAN_ENV] = plan.to_json()
+    return env
+
+
+def full_env_with_plan(plan: FaultPlan) -> dict:
+    """A COMPLETE environ (os.environ + the plan) for subprocess spawns
+    that replace the environment rather than overlaying it."""
+    return env_with_plan(plan, base=dict(os.environ))

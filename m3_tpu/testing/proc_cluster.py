@@ -37,7 +37,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 
 def _spawn_listening(cmd: list[str], what: str, timeout: float = 60.0,
                      collect: dict | None = None,
-                     expect_markers: set[str] | None = None):
+                     expect_markers: set[str] | None = None,
+                     env_extra: dict | None = None):
     """Start a subprocess that prints LISTENING <host> <port>; returns
     (proc, host, port). Named marker lines (``expect_markers``, e.g.
     {"MSG_LISTENING"}) printed before/after it are collected into
@@ -55,6 +56,8 @@ def _spawn_listening(cmd: list[str], what: str, timeout: float = 60.0,
             collect[parts[0]] = (parts[1], int(parts[2]))
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,
@@ -194,6 +197,11 @@ class ProcCluster:
     heartbeat_timeout: float = 2.0
     base_dir: str | None = None
     extra_args: list = field(default_factory=list)
+    # env-var overlays for spawned dbnode processes: extra_env applies to
+    # every node, node_env[node_id] to one — the seam chaos runs use to
+    # install per-node fault plans (testing/faults.env_with_plan)
+    extra_env: dict = field(default_factory=dict)
+    node_env: dict = field(default_factory=dict)
     nodes: dict = field(default_factory=dict)
     kv_replicas: int = 1  # >1: raft quorum of standalone kvnodes
     # embedded seeds: every dbnode ALSO runs a raft KV replica in-process
@@ -261,7 +269,8 @@ class ProcCluster:
                     *self.extra_args,
                 ]
                 proc, host, port = _spawn_listening(
-                    cmd, nid, collect=collect, expect_markers={"KV_LISTENING"}
+                    cmd, nid, collect=collect, expect_markers={"KV_LISTENING"},
+                    env_extra={**self.extra_env, **self.node_env.get(nid, {})},
                 )
                 kh, kp = collect["KV_LISTENING"]
                 kv_members[f"kv-{nid}"] = f"{kh}:{kp}"
@@ -329,7 +338,10 @@ class ProcCluster:
             "--no-mediator",
             *self.extra_args,
         ]
-        proc, host, port_n = _spawn_listening(cmd, node_id)
+        proc, host, port_n = _spawn_listening(
+            cmd, node_id,
+            env_extra={**self.extra_env, **self.node_env.get(node_id, {})},
+        )
         client = RemoteNode(host, port_n, node_id=node_id)
         return ProcNode(node_id, proc, client)
 

@@ -1,0 +1,758 @@
+"""Resilient RPC plane: retry budgets, circuit breakers, deadline
+propagation, load shedding, degraded (UNSTRICT_MAJORITY) reads, and seeded
+fault-injection chaos runs.
+
+Reference behaviors: x/retry (backoff + jitter + budgets),
+consistency_level.go UnstrictMajority, Hystrix breaker state machine,
+"The Tail at Scale" deadline/hedging discipline.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from m3_tpu.client.session import ConsistencyError, Session
+from m3_tpu.cluster.placement import build_initial_placement
+from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+from m3_tpu.index.query import term
+from m3_tpu.net import wire
+from m3_tpu.net.client import RemoteError, RemoteNode, RpcClient
+from m3_tpu.net.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    DeadlineExceededError,
+    HealthProber,
+    RetryBudget,
+    RetryPolicy,
+    UnavailableError,
+)
+from m3_tpu.net.server import NodeServer, NodeService, RpcServer
+from m3_tpu.testing.cluster import LocalCluster
+from m3_tpu.testing.faults import FaultInjectedError, FaultPlan, FaultRule, wrap_nodes
+from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+HOUR = 3600 * NANOS
+
+
+def _counter_total(name: str, **label_filter) -> float:
+    fam = METRICS.collect().get(f"m3tpu_{name}")
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for child in fam["children"]:
+        if all(child["labels"].get(k) == v for k, v in label_filter.items()):
+            total += child["value"]
+    return total
+
+
+# --- RetryPolicy / RetryBudget ---
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    p = RetryPolicy(max_retries=5, initial_backoff=0.01, max_backoff=0.5, seed=42)
+    # first retry is immediate (stale-pooled-socket reconnect semantics)
+    assert p.backoff(1, 0.0) == 0.0
+    prev = 0.0
+    for attempt in range(2, 12):
+        b = p.backoff(attempt, prev)
+        assert 0.01 <= b <= 0.5, (attempt, b)
+        # decorrelated jitter upper bound: uniform(base, prev*3) capped
+        assert b <= max(0.01, min(0.5, max(prev, 0.01) * 3.0)) + 1e-12
+        prev = b
+    # same seed -> same jitter sequence
+    p1 = RetryPolicy(seed=7)
+    p2 = RetryPolicy(seed=7)
+    seq1 = [p1.backoff(i, 0.02) for i in range(2, 8)]
+    seq2 = [p2.backoff(i, 0.02) for i in range(2, 8)]
+    assert seq1 == seq2
+
+
+def test_retry_budget_exhaustion_and_refill():
+    budget = RetryBudget(max_tokens=4.0, token_ratio=0.5)
+    assert budget.try_spend()  # 4 -> 3
+    assert budget.try_spend()  # 3 -> 2
+    assert not budget.try_spend()  # at half: retries suppressed
+    before = _counter_total("rpc_retry_budget_exhausted_total")
+    assert not budget.try_spend()
+    assert _counter_total("rpc_retry_budget_exhausted_total") > before
+    # successes refill the bucket and re-enable retries
+    for _ in range(3):
+        budget.on_success()
+    assert budget.tokens == pytest.approx(3.5)
+    assert budget.try_spend()
+
+
+def test_policy_allow_retry_bounded_by_max_retries():
+    p = RetryPolicy(max_retries=2, seed=0)
+    assert p.allow_retry(1) and p.allow_retry(2)
+    assert not p.allow_retry(3)
+
+
+# --- CircuitBreaker ---
+
+
+def test_breaker_open_halfopen_close_transitions():
+    clock = [0.0]
+    b = CircuitBreaker(
+        peer="t1", failure_threshold=3, recovery_timeout=5.0,
+        clock=lambda: clock[0],
+    )
+    assert b.state == "closed" and b.allow() and b.available()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"  # below threshold
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow() and not b.available()
+    # recovery window elapses -> half-open, exactly one probe admitted
+    clock[0] = 5.0
+    assert b.available()
+    assert b.allow()
+    assert b.state == "half_open"
+    assert not b.allow()  # single probe in flight
+    # failed probe -> open again, new window
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    clock[0] = 10.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow() and b.available()
+
+
+def test_breaker_probe_slot_released_on_aborted_attempt():
+    """An aborted half-open probe (nothing sent, nothing learned) must
+    release the probe slot — otherwise the breaker wedges: probing forever,
+    admitting no one."""
+    clock = [0.0]
+    b = CircuitBreaker(peer="t3", failure_threshold=1, recovery_timeout=1.0,
+                       clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] = 1.0
+    assert b.allow()  # half-open, probe slot claimed
+    assert not b.allow()
+    b.release()  # probe aborted without a verdict
+    assert b.allow()  # another probe may proceed
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_client_deadline_abort_does_not_wedge_half_open_breaker():
+    """A DeadlineExceededError raised after allow() claimed the half-open
+    probe must not blacklist the peer forever: the next call still probes
+    the socket (and fails with a transport error, not BreakerOpenError)."""
+    node = RemoteNode(
+        "127.0.0.1", _dead_port(), node_id="wedge",
+        retry_policy=RetryPolicy(max_retries=0),
+        breaker=CircuitBreaker(peer="wedge", failure_threshold=1,
+                               recovery_timeout=0.0),
+    )
+    with pytest.raises((ConnectionError, OSError)):
+        node.health()  # opens the breaker (threshold 1)
+    assert node.breaker.state == "open"
+    # recovery_timeout=0: allow() flips to half-open and claims the probe,
+    # then the pre-send deadline check aborts the attempt
+    with pytest.raises(DeadlineExceededError):
+        node._call("health", _timeout=-1.0)
+    # the probe slot was released: a real (socket) probe happens and its
+    # transport failure is recorded — NOT a BreakerOpenError wedge
+    with pytest.raises((ConnectionError, OSError)) as ei:
+        node.health()
+    assert not isinstance(ei.value, BreakerOpenError)
+    node.close()
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(peer="t2", failure_threshold=2, recovery_timeout=60.0)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == "closed"  # failures must be CONSECUTIVE
+    b.record_failure()
+    assert b.state == "open"
+
+
+# --- RPC client retry semantics over a real server ---
+
+
+class FlakyService:
+    """Fails the first ``fail_first`` requests of an op with the typed
+    retryable UnavailableError, then succeeds; counts every dispatch."""
+
+    def __init__(self):
+        self.calls = {}
+        self.lock = threading.Lock()
+
+    def handle(self, req):
+        op = req["op"]
+        with self.lock:
+            n = self.calls[op] = self.calls.get(op, 0) + 1
+        if n <= int(req.get("fail_first", 0)):
+            raise UnavailableError(f"flaky: attempt {n}")
+        return {"calls": n}
+
+
+@pytest.fixture
+def flaky_server():
+    svc = FlakyService()
+    server = RpcServer(svc, component="flaky")
+    server.start()
+    yield svc, server
+    server.stop()
+
+
+def test_idempotent_op_transparently_retried(flaky_server):
+    svc, server = flaky_server
+    c = RpcClient("127.0.0.1", server.port,
+                  retry_policy=RetryPolicy(max_retries=3, seed=1))
+    before = _counter_total("rpc_retries_total", op="fetch")
+    out = c._call("fetch", fail_first=2)
+    assert out == {"calls": 3}
+    assert svc.calls["fetch"] == 3
+    assert _counter_total("rpc_retries_total", op="fetch") - before == 2
+    c.close()
+
+
+def test_non_idempotent_op_never_transparently_retried(flaky_server):
+    svc, server = flaky_server
+    c = RpcClient("127.0.0.1", server.port,
+                  retry_policy=RetryPolicy(max_retries=3, seed=1))
+    with pytest.raises(RemoteError) as ei:
+        c._call("write", fail_first=1)
+    assert ei.value.etype == "UnavailableError"
+    assert svc.calls["write"] == 1  # exactly one dispatch, no retry
+    c.close()
+
+
+def test_retry_gives_up_past_max_retries(flaky_server):
+    svc, server = flaky_server
+    c = RpcClient("127.0.0.1", server.port,
+                  retry_policy=RetryPolicy(max_retries=2, seed=1))
+    with pytest.raises(RemoteError):
+        c._call("fetch", fail_first=99)
+    assert svc.calls["fetch"] == 3  # 1 attempt + 2 retries
+    c.close()
+
+
+def test_retry_stays_inside_one_client_span(flaky_server):
+    """Satellite: a retried call is ONE rpc.client span tagged retried=N,
+    not nested spans double-counting the op."""
+    from m3_tpu.utils.trace import TRACER
+
+    svc, server = flaky_server
+    c = RpcClient("127.0.0.1", server.port,
+                  retry_policy=RetryPolicy(max_retries=3, seed=1))
+    with TRACER.span("test.root") as root:
+        trace_id = root.span.trace_id
+        c._call("fetch", fail_first=1)
+    spans = [
+        s for s in TRACER.dump()
+        if s["name"] == "rpc.client.fetch"
+        and int(s["traceId"], 16) == trace_id
+    ]
+    assert len(spans) == 1
+    assert spans[0]["tags"].get("retried") == "1"
+    c.close()
+
+
+class CountingPlan(FaultPlan):
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.decisions = []
+
+    def decide(self, op, peer=None):
+        d = super().decide(op, peer)
+        self.decisions.append((op, d[0]))
+        return d
+
+
+def test_transport_drop_retried_only_for_idempotent_ops(tmp_path):
+    """Server-side injected drops (connection closed without a reply):
+    idempotent ops are re-sent, a write is attempted exactly once."""
+    plan = CountingPlan([FaultRule(drop=1.0)], seed=0,
+                        exempt_ops=("health",))
+    svc = FlakyService()
+    server = RpcServer(svc, component="droppy", fault_plan=plan)
+    server.start()
+    try:
+        c = RpcClient("127.0.0.1", server.port, timeout=5.0,
+                      retry_policy=RetryPolicy(max_retries=2, seed=1),
+                      breaker=CircuitBreaker(peer="droppy",
+                                             failure_threshold=100))
+        with pytest.raises((ConnectionError, OSError)):
+            c._call("fetch")
+        assert [op for op, _ in plan.decisions] == ["fetch"] * 3
+        plan.decisions.clear()
+        with pytest.raises((ConnectionError, OSError)):
+            c._call("write", fail_first=0)
+        assert [op for op, _ in plan.decisions] == ["write"]  # no retry
+        assert "write" not in svc.calls  # dropped before dispatch
+        c.close()
+    finally:
+        server.stop()
+
+
+# --- deadline propagation ---
+
+
+def test_expired_deadline_rejected_server_side(flaky_server):
+    svc, server = flaky_server
+    before = _counter_total("rpc_deadline_exceeded_total", component="flaky")
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    try:
+        wire.send_frame(
+            sock, {"op": "fetch", wire.DEADLINE_KEY: time.time() - 1.0}
+        )
+        resp = wire.recv_frame(sock)
+    finally:
+        sock.close()
+    assert resp["ok"] is False
+    assert resp["etype"] == "UnavailableError"
+    assert "deadline" in resp["error"]
+    assert "fetch" not in svc.calls  # refused BEFORE dispatch
+    after = _counter_total("rpc_deadline_exceeded_total", component="flaky")
+    assert after - before == 1
+
+
+def test_expired_deadline_rejected_client_side(flaky_server):
+    _, server = flaky_server
+    c = RpcClient("127.0.0.1", server.port)
+    with pytest.raises(DeadlineExceededError):
+        c._call("fetch", _timeout=-0.5)
+    c.close()
+
+
+def test_deadline_rides_the_wire():
+    got = {}
+
+    class Echo:
+        def handle(self, req):
+            got.update(req)
+            return True
+
+    server = RpcServer(Echo(), component="echo")
+    server.start()
+    try:
+        c = RpcClient("127.0.0.1", server.port)
+        t0 = time.time()
+        c._call("anything", _timeout=3.0)
+        # middleware pops the deadline; the handler never sees the key
+        assert wire.DEADLINE_KEY not in got
+        c.close()
+        # but the server-side middleware DID see it: send a raw frame and
+        # check an expired one is refused (covered above); here just check
+        # the client injected a sane absolute deadline
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        try:
+            wire.send_frame(sock, {"op": "x", wire.DEADLINE_KEY: t0 + 3.0})
+            assert wire.recv_frame(sock)["ok"] is True
+        finally:
+            sock.close()
+    finally:
+        server.stop()
+
+
+# --- load shedding ---
+
+
+def test_inflight_cap_sheds_with_typed_retryable_error():
+    release = threading.Event()
+
+    class Slow:
+        def handle(self, req):
+            if req["op"] == "slow":
+                release.wait(10)
+            return True
+
+    server = RpcServer(Slow(), component="shedtest", max_inflight=1)
+    server.start()
+    try:
+        c1 = RpcClient("127.0.0.1", server.port)
+        c2 = RpcClient("127.0.0.1", server.port)
+        t = threading.Thread(target=lambda: c1._call("slow"), daemon=True)
+        t.start()
+        # wait until the slow request is actually in flight
+        deadline = time.time() + 5
+        while server.middleware._inflight_total < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        before = _counter_total("rpc_shed_total", component="shedtest")
+        with pytest.raises(RemoteError) as ei:
+            c2._call("ping", _retry=False)
+        assert ei.value.etype == "UnavailableError"
+        assert "shed" in str(ei.value) or "overloaded" in str(ei.value)
+        assert _counter_total("rpc_shed_total", component="shedtest") > before
+        # the metrics scrape is exempt so overload stays observable
+        assert "m3tpu_rpc_shed_total" in c2._call("metrics", _retry=False)
+        release.set()
+        t.join(timeout=5)
+        c1.close()
+        c2.close()
+    finally:
+        release.set()
+        server.stop()
+
+
+# --- breaker + is_up over real sockets ---
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_breaker_backs_is_up_and_fast_fails():
+    node = RemoteNode(
+        "127.0.0.1", _dead_port(), node_id="dead",
+        retry_policy=RetryPolicy(max_retries=0),
+        breaker=CircuitBreaker(peer="dead", failure_threshold=2,
+                               recovery_timeout=60.0),
+    )
+    assert node.is_up  # optimistic until failures accumulate
+    for _ in range(2):
+        with pytest.raises((ConnectionError, OSError)):
+            node.health()
+    assert node.breaker.state == "open"
+    assert not node.is_up
+    with pytest.raises(BreakerOpenError):
+        node.health()  # fast-fail, no socket attempt
+    node.close()
+
+
+def test_health_prober_closes_breaker_after_recovery(tmp_path):
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    # reserve a port, fail against it, then start a real node server there
+    port = _dead_port()
+    node = RemoteNode(
+        "127.0.0.1", port, node_id="n0",
+        retry_policy=RetryPolicy(max_retries=0),
+        breaker=CircuitBreaker(peer="n0-probe", failure_threshold=2,
+                               recovery_timeout=0.1),
+    )
+    for _ in range(2):
+        with pytest.raises((ConnectionError, OSError)):
+            node.health()
+    assert node.breaker.state == "open"
+
+    db = Database(str(tmp_path), num_shards=4)
+    db.create_namespace("default", NamespaceOptions(block_size_nanos=HOUR))
+    db.bootstrap()
+    server = NodeServer(NodeService(db, node_id="n0"), port=port)
+    server.start()
+    prober = HealthProber({"n0": node}, interval=0.05, probe_timeout=2.0)
+    prober.start()
+    try:
+        deadline = time.time() + 10
+        while node.breaker.state != "closed" and time.time() < deadline:
+            time.sleep(0.02)
+        assert node.breaker.state == "closed"
+        assert node.is_up
+    finally:
+        prober.stop()
+        node.close()
+        server.stop()
+        db.close()
+
+
+# --- UNSTRICT_MAJORITY degraded reads ---
+
+
+def test_unstrict_majority_required_matches_majority():
+    assert ConsistencyLevel.UNSTRICT_MAJORITY.required(3) == 2
+    assert ConsistencyLevel.UNSTRICT_MAJORITY.unstrict
+    assert not ConsistencyLevel.MAJORITY.unstrict
+
+
+def test_unstrict_majority_degrades_and_bit_matches_survivors(tmp_path):
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    strict = cluster.session()
+    tags = [((b"__name__", b"deg"), (b"i", b"%d" % i)) for i in range(16)]
+    for i, tg in enumerate(tags):
+        strict.write_tagged(tg, T0 + i * NANOS, float(i))
+
+    # healthy cluster: unstrict behaves exactly like MAJORITY, exhaustive
+    unstrict = cluster.session(read_cl=ConsistencyLevel.UNSTRICT_MAJORITY)
+    full = unstrict.fetch_tagged(term(b"__name__", b"deg"), T0 - 1, T0 + HOUR)
+    assert full.exhaustive
+    assert full == strict.fetch_tagged(term(b"__name__", b"deg"), T0 - 1, T0 + HOUR)
+
+    # two replicas down: MAJORITY fails, UNSTRICT degrades to the survivor
+    cluster.nodes["node1"].is_up = False
+    cluster.nodes["node2"].is_up = False
+    with pytest.raises(ConsistencyError):
+        strict.fetch_tagged(term(b"__name__", b"deg"), T0 - 1, T0 + HOUR)
+    degraded = unstrict.fetch_tagged(term(b"__name__", b"deg"), T0 - 1, T0 + HOUR)
+    assert not degraded.exhaustive
+    # bit-identical to what the surviving replica serves under a read that
+    # requires only it (ONE over the same survivor set)
+    one = cluster.session(read_cl=ConsistencyLevel.ONE)
+    survivor_view = one.fetch_tagged(term(b"__name__", b"deg"), T0 - 1, T0 + HOUR)
+    assert list(degraded) == list(survivor_view)
+    # rf=3 over every shard: the one survivor holds every series
+    assert len(degraded) == len(tags)
+
+    # zero replicas for a shard (all nodes down) still fails even unstrict
+    cluster.nodes["node0"].is_up = False
+    with pytest.raises(ConsistencyError):
+        unstrict.fetch_tagged(term(b"__name__", b"deg"), T0 - 1, T0 + HOUR)
+    strict.close()
+    unstrict.close()
+    one.close()
+
+
+def test_unstrict_single_series_fetch_degrades(tmp_path):
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    s = cluster.session(read_cl=ConsistencyLevel.UNSTRICT_MAJORITY)
+    sid = s.write_tagged(((b"__name__", b"one"),), T0, 5.0)
+    healthy = s.fetch(sid, T0 - 1, T0 + HOUR)
+    assert [dp.value for dp in healthy] == [5.0] and healthy.exhaustive
+    cluster.nodes["node1"].is_up = False
+    cluster.nodes["node2"].is_up = False
+    degraded = s.fetch(sid, T0 - 1, T0 + HOUR)
+    assert [dp.value for dp in degraded] == [5.0]
+    assert not degraded.exhaustive  # the degraded read is marked
+    strict = cluster.session()
+    with pytest.raises(ConsistencyError):
+        strict.fetch(sid, T0 - 1, T0 + HOUR)
+    s.close()
+    strict.close()
+
+
+# --- parallel fan-out: hung replica no longer stalls the op ---
+
+
+def test_hung_replica_does_not_stall_quorum_read(tmp_path):
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    s = cluster.session()
+    s.straggler_grace = 0.1
+    sid = s.write_tagged(((b"__name__", b"hung"),), T0, 1.0)
+
+    hung = cluster.nodes["node1"]
+    orig = hung.fetch_blocks
+    hung.fetch_blocks = lambda *a, **k: (time.sleep(8.0), orig(*a, **k))[1]
+    t0 = time.perf_counter()
+    vals = [dp.value for dp in s.fetch(sid, T0 - 1, T0 + HOUR)]
+    elapsed = time.perf_counter() - t0
+    assert vals == [1.0]
+    # quorum (2/3) answers immediately; the sleeping replica is abandoned
+    # after the straggler grace — nowhere near its 8s nap
+    assert elapsed < 4.0, elapsed
+
+    # same for the index-read fan-out: fetch_tagged must not wait out the
+    # hung replica either once every shard has its quorum of responders
+    orig_ft = hung.fetch_tagged
+    hung.fetch_tagged = lambda *a, **k: (time.sleep(8.0), orig_ft(*a, **k))[1]
+    t0 = time.perf_counter()
+    res = s.fetch_tagged(term(b"__name__", b"hung"), T0 - 1, T0 + HOUR)
+    elapsed = time.perf_counter() - t0
+    assert [dp.value for dp in res[0][2]] == [1.0]
+    assert res.exhaustive  # quorum responded; nothing degraded
+    assert elapsed < 4.0, elapsed
+    s.close()
+
+
+def test_batch_write_waits_one_shared_deadline(tmp_path):
+    """Satellite: HostQueue batch waits share ONE monotonic deadline —
+    worst case ~timeout, not entries x replicas x timeout."""
+    cluster = LocalCluster(num_nodes=2, num_shards=4, replica_factor=2,
+                           base_dir=str(tmp_path))
+    s = cluster.session()  # MAJORITY of 2 == both replicas
+    s.op_retries = 0
+    slow = cluster.nodes["node1"]
+
+    def never_acks(ns, entries):
+        time.sleep(30.0)
+        return [None] * len(entries)
+
+    slow.write_tagged_batch = never_acks
+    entries = [(((b"__name__", b"b"), (b"i", b"%d" % i)), T0, float(i))
+               for i in range(10)]
+    t0 = time.perf_counter()
+    _, errs = s.try_write_batch_tagged(entries, timeout=1.0)
+    elapsed = time.perf_counter() - t0
+    assert all(e is not None and "timeout" in e for e in errs)
+    assert elapsed < 6.0, elapsed  # old worst case: 10 entries x 1s each
+    s.close()
+
+
+# --- seeded chaos runs ---
+
+
+def test_faultplan_seeded_determinism():
+    seq = [("write", "n0"), ("fetch", "n1"), ("write", "n2")] * 20
+    a = FaultPlan([FaultRule(drop=0.3), FaultRule(op="fetch", error=0.5)], seed=99)
+    b = FaultPlan([FaultRule(drop=0.3), FaultRule(op="fetch", error=0.5)], seed=99)
+    assert [a.decide(op, p) for op, p in seq] == [b.decide(op, p) for op, p in seq]
+
+
+def test_faultplan_partition_and_exempt():
+    plan = FaultPlan([FaultRule(peer="node2", partition=True)], seed=0,
+                     exempt_ops=("owned_shards",))
+    assert plan.decide("write", "node2") == ("drop", 0.0)
+    assert plan.decide("owned_shards", "node2") == ("pass", 0.0)
+    assert plan.decide("write", "node0") == ("pass", 0.0)
+    # a peer-scoped rule never fires at a peer-less decision point (the
+    # server seam): a fleet-wide env plan must not partition every node
+    assert plan.decide("write") == ("pass", 0.0)
+    roundtrip = FaultPlan.from_json(plan.to_json())
+    assert roundtrip.decide("write", "node2") == ("drop", 0.0)
+
+
+def test_chaos_in_process_quorum_survives_drops_and_partition(tmp_path):
+    """Seeded FaultPlan over testing/cluster nodes: 20% request drops on
+    two replicas plus one fully partitioned replica — MAJORITY writes and
+    reads still succeed with zero client-visible errors."""
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    plan = FaultPlan(
+        [
+            FaultRule(peer="node2", partition=True),
+            FaultRule(drop=0.2),
+        ],
+        seed=1234,
+    )
+    s = cluster.session()
+    s.nodes = wrap_nodes(s.nodes, plan)
+    s.op_retries = 6
+    s.op_retry_backoff = 0.005
+    retries_before = _counter_total("session_op_retries_total")
+    n = 30
+    sids = []
+    for i in range(n):
+        tags = ((b"__name__", b"chaos"), (b"i", b"%d" % i))
+        sids.append(s.write_tagged(tags, T0 + i * NANOS, float(i)))
+    res = s.fetch_tagged(term(b"__name__", b"chaos"), T0 - 1, T0 + HOUR)
+    assert res.exhaustive
+    got = {row[0]: [dp.value for dp in row[2]] for row in res}
+    assert len(got) == n
+    for i, sid in enumerate(sids):
+        assert got[sid] == [float(i)]
+    # the chaos actually exercised the retry machinery
+    assert _counter_total("session_op_retries_total") > retries_before
+    s.close()
+
+
+def test_chaos_over_sockets_retries_and_breaker(tmp_path):
+    """The full acceptance contract over real sockets (in-process servers):
+    3-node RF=3, 20% injected drops on two nodes, one partitioned node —
+    MAJORITY writes/reads succeed, m3tpu_rpc_retries_total grows, and the
+    partitioned host's breaker reports open."""
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    ids = ["node0", "node1", "node2"]
+    dbs, servers, nodes = {}, {}, {}
+    drop_plan = FaultPlan([FaultRule(drop=0.2)], seed=5)
+    cut_plan = FaultPlan([FaultRule(partition=True)], seed=5)
+    try:
+        for i, nid in enumerate(ids):
+            db = Database(str(tmp_path / nid), num_shards=4)
+            db.create_namespace("default",
+                               NamespaceOptions(block_size_nanos=HOUR))
+            db.bootstrap()
+            dbs[nid] = db
+            plan = cut_plan if nid == "node2" else drop_plan
+            server = NodeServer(
+                NodeService(db, node_id=nid, assigned_shards={0, 1, 2, 3}),
+                component=f"chaos-{nid}", fault_plan=plan,
+            )
+            server.start()
+            servers[nid] = server
+            # threshold 20: a 20%-droppy node must NOT trip its breaker
+            # (p(20 consecutive drops) ~ 1e-14) while the partitioned node
+            # still opens fast (every one of its calls fails)
+            nodes[nid] = RemoteNode(
+                "127.0.0.1", server.port, node_id=nid, timeout=5.0,
+                retry_policy=RetryPolicy(max_retries=3, seed=i),
+                breaker=CircuitBreaker(peer=f"chaos-{nid}",
+                                       failure_threshold=20,
+                                       recovery_timeout=30.0),
+            )
+        placement = build_initial_placement(ids, 4, 3)
+        session = Session(
+            topology=TopologyMap(placement), nodes=nodes,
+            write_consistency=ConsistencyLevel.MAJORITY,
+            read_consistency=ConsistencyLevel.MAJORITY,
+        )
+        session.op_retries = 6
+        session.op_retry_backoff = 0.01
+        retries_before = _counter_total("rpc_retries_total")
+        n = 25
+        sids = []
+        for i in range(n):
+            tags = ((b"__name__", b"sockchaos"), (b"i", b"%d" % i))
+            sids.append(session.write_tagged(tags, T0 + i * NANOS, float(i)))
+        res = session.fetch_tagged(term(b"__name__", b"sockchaos"),
+                                   T0 - 1, T0 + HOUR)
+        got = {row[0]: [dp.value for dp in row[2]] for row in res}
+        assert len(got) == n
+        for i, sid in enumerate(sids):
+            assert got[sid] == [float(i)]
+        # quorum single-series reads stay bit-exact too — and push enough
+        # idempotent traffic through the 20% drop that transparent RPC
+        # retries must have fired (~50 fetch_blocks requests)
+        for i, sid in enumerate(sids):
+            assert [dp.value for dp in session.fetch(sid, T0 - 1, T0 + HOUR)] \
+                == [float(i)]
+        assert _counter_total("rpc_retries_total") > retries_before
+        assert nodes["node2"].breaker.state == "open"
+        assert not nodes["node2"].is_up
+        session.close()
+    finally:
+        for node in nodes.values():
+            node.close()
+        for server in servers.values():
+            server.stop()
+        for db in dbs.values():
+            db.close()
+
+
+def test_faulty_node_wrapper_surfaces_typed_errors():
+    class Stub:
+        id = "s0"
+        is_up = True
+
+        def fetch(self, *a):
+            return "ok"
+
+    plan = FaultPlan([FaultRule(op="fetch", error=1.0)], seed=0)
+    wrapped = wrap_nodes({"s0": Stub()}, plan)["s0"]
+    with pytest.raises(RemoteError) as ei:
+        wrapped.fetch()
+    assert ei.value.etype == "UnavailableError"
+    drop = FaultPlan([FaultRule(drop=1.0)], seed=0)
+    wrapped = wrap_nodes({"s0": Stub()}, drop)["s0"]
+    with pytest.raises(FaultInjectedError):
+        wrapped.fetch()
+
+
+# --- failure detector observability satellite ---
+
+
+def test_failure_detector_counts_and_survives_poll_errors():
+    from m3_tpu.cluster.failure import FailureDetector
+
+    det = FailureDetector.__new__(FailureDetector)
+    det._stop = threading.Event()
+    det._thread = None
+
+    def boom(now=None):
+        raise RuntimeError("kv down")
+
+    det.check = boom
+    before = _counter_total("failure_detector_errors_total")
+    det.start(interval=0.01)
+    deadline = time.time() + 5
+    while _counter_total("failure_detector_errors_total") < before + 3:
+        assert time.time() < deadline, "errors not counted"
+        time.sleep(0.02)
+    det.stop()
+    assert _counter_total("failure_detector_errors_total") >= before + 3
