@@ -19,11 +19,15 @@ current version).
 
 from __future__ import annotations
 
+import logging
 import threading
 
 from ..net.client import RpcClient
 from ..net.server import RpcServer
+from ..utils.instrument import DEFAULT as METRICS
 from .kv import KVStore, VersionedValue
+
+_LOG = logging.getLogger(__name__)
 
 WATCH_POLL_TIMEOUT = 30.0
 
@@ -318,6 +322,7 @@ class RemoteKVStore:
         # unsub/close must be able to interrupt an in-flight long-poll: the
         # current poller is shared so they can close its socket from outside
         holder: list = [None]
+        cb_logged = [False]
 
         def loop() -> None:
             last = 0
@@ -329,11 +334,16 @@ class RemoteKVStore:
                         holder[0] = RpcClient(
                             host, int(port), pool_size=1, timeout=self.timeout
                         )
+                    # _retry=False: THIS loop owns failover (rotate to the
+                    # next replica below) — a transparent same-endpoint
+                    # retry would pay extra socket timeouts against a
+                    # partitioned host before the rotation can happen
                     r = holder[0]._call(
                         "kv_watch",
                         key=key,
                         after=last,
                         timeout=WATCH_POLL_TIMEOUT,
+                        _retry=False,
                         _timeout=WATCH_POLL_TIMEOUT + 5.0,
                     )
                 except Exception:
@@ -351,7 +361,21 @@ class RemoteKVStore:
                 try:
                     fn(VersionedValue(r["version"], r["value"]))
                 except Exception:
-                    pass  # a watcher callback must not kill the poll loop
+                    # a watcher callback must not kill the poll loop — but
+                    # a throwing callback is a real bug upstream, so count
+                    # it and log the first occurrence per watch (M3L007)
+                    METRICS.counter(
+                        "kv_watch_callback_errors_total",
+                        "exceptions raised by KV watch callbacks "
+                        "(swallowed to keep the poll loop alive)",
+                    ).inc()
+                    if not cb_logged[0]:
+                        cb_logged[0] = True
+                        _LOG.exception(
+                            "kv watch callback for %r failed (suppressing "
+                            "further tracebacks; see "
+                            "m3tpu_kv_watch_callback_errors_total)", key,
+                        )
             if holder[0] is not None:
                 holder[0].close()
                 holder[0] = None

@@ -125,6 +125,7 @@ class RpcClient:
         only (transport failures and typed retryable rejections); every
         attempt is gated by the peer's circuit breaker and bounded by one
         shared per-call deadline that also rides the wire."""
+        # m3lint: disable=M3L004 -- the wire _deadline frame is wall-clock by protocol (must mean the same instant in another process)
         deadline = time.time() + (_timeout if _timeout is not None else self.timeout)
         retryable = _retry and op in wire.IDEMPOTENT_OPS
         attempt = 0
@@ -159,7 +160,7 @@ class RpcClient:
             attempt += 1
             if (
                 not retryable
-                or time.time() >= deadline
+                or time.time() >= deadline  # m3lint: disable=M3L004 -- compares against the wall-clock wire deadline
                 or not self.retry_policy.allow_retry(attempt)
             ):
                 raise err
@@ -171,7 +172,7 @@ class RpcClient:
             span.set_tag("retried", attempt)
             prev_backoff = self.retry_policy.backoff(attempt, prev_backoff)
             if prev_backoff > 0.0:
-                remaining = deadline - time.time()
+                remaining = deadline - time.time()  # m3lint: disable=M3L004 -- remaining budget against the wall-clock wire deadline
                 if remaining <= 0:
                     raise err
                 time.sleep(min(prev_backoff, remaining))
@@ -179,7 +180,7 @@ class RpcClient:
     def _call_once(self, op: str, args: dict, deadline: float):
         """One wire round trip; the deadline bounds the socket wait and is
         propagated in the frame so the server can refuse expired work."""
-        remaining = deadline - time.time()
+        remaining = deadline - time.time()  # m3lint: disable=M3L004 -- remaining budget against the wall-clock wire deadline
         if remaining <= 0:
             raise DeadlineExceededError(
                 f"deadline expired before sending {op!r} to {self.host}:{self.port}"

@@ -254,6 +254,11 @@ class HealthProber:
         self.probe_timeout = probe_timeout
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._probe_failures = METRICS.counter(
+            "health_probe_failures_total",
+            "background health probes that failed (expected while a peer "
+            "is down; the breaker outcome is what matters)",
+        )
 
     def start(self) -> "HealthProber":
         self._thread = threading.Thread(
@@ -273,7 +278,9 @@ class HealthProber:
                     node._call("health", _retry=False,
                                _timeout=self.probe_timeout)
                 except Exception:
-                    pass
+                    # swallow-by-design (probing a down host), but counted
+                    # so a prober that NEVER succeeds is visible (M3L007)
+                    self._probe_failures.inc()
 
     def stop(self) -> None:
         self._stop.set()

@@ -144,9 +144,11 @@ class RpcMiddleware:
         inflight.add(1)
         t0 = time.perf_counter()
         try:
+            # m3lint: disable=M3L004 -- the propagated _deadline is wall-clock by protocol; peers are assumed clock-synced
             if deadline is not None and time.time() >= deadline:
                 self._deadline_exceeded.inc()
                 raise UnavailableError(
+                    # m3lint: disable=M3L004 -- lateness report against the wall-clock wire deadline
                     f"deadline expired {time.time() - deadline:.3f}s before "
                     f"dispatch of {op!r}"
                 )

@@ -221,8 +221,10 @@ IDEMPOTENT_OPS = frozenset(
         "flush", "assign_shards",
         # raft protocol (duplicate-safe by design)
         "raft_vote", "raft_append", "raft_snapshot", "raft_status",
-        # KV reads (mutations ride RemoteKVStore's own failover contract)
-        "kv_get", "kv_keys", "kv_get_prefix", "kv_lease_get",
+        # KV reads (mutations ride RemoteKVStore's own failover contract);
+        # kv_watch is a long-poll read — re-asking "anything newer than
+        # version V?" is duplicate-safe by construction
+        "kv_get", "kv_keys", "kv_get_prefix", "kv_lease_get", "kv_watch",
     }
 )
 

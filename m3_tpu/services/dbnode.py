@@ -242,13 +242,25 @@ def main(argv=None) -> int:
         services.advertise("m3db", ServiceInstance(args.node_id, endpoint))
         hb_stop = state["hb_stop"] = threading.Event()
 
+        from ..utils.instrument import DEFAULT as METRICS
+
+        hb_errors = METRICS.counter(
+            "heartbeat_errors_total",
+            "control-plane heartbeats swallowed by KV hiccups (a "
+            "persistently failing loop means this node looks dead to the "
+            "failure detector)",
+        )
+
         def hb_loop() -> None:
             interval = max(args.heartbeat_timeout / 3.0, 0.05)
             while not hb_stop.wait(interval):
                 try:
                     services.heartbeat("m3db", args.node_id)
                 except Exception:
-                    pass  # KV hiccups must not kill the node
+                    # KV hiccups must not kill the node — but count every
+                    # swallow so /metrics shows a heartbeat loop that is
+                    # failing persistently (M3L007)
+                    hb_errors.inc()
 
         threading.Thread(target=hb_loop, daemon=True, name="heartbeat").start()
         cluster_db = state["cluster_db"] = ClusterDatabase(

@@ -133,6 +133,7 @@ class ClusterDatabase:
                     try:
                         peer.close()
                     except Exception:
+                        # m3lint: disable=M3L007 -- best-effort close of a peer that just failed to stream; nothing to act on
                         pass
             return None  # nothing reachable held this shard
 

@@ -111,6 +111,7 @@ class DTestHarness:
                 if node.health().get("bootstrapped"):
                     return
             except Exception:
+                # m3lint: disable=M3L007 -- poll loop probing a node that is still booting; the timeout below reports failure
                 pass
             time.sleep(0.2)
         raise TimeoutError(f"{nid} did not become healthy")
@@ -156,6 +157,7 @@ class DTestHarness:
             try:
                 self.agents[nid].teardown(nid)
             except Exception:
+                # m3lint: disable=M3L007 -- best-effort teardown of a possibly already-dead test process
                 pass
         for srv in self._own_agents:
             srv.close()

@@ -1,0 +1,309 @@
+"""Runtime lock-order race harness: RacerD-shaped dynamic analysis for
+tests (Blackshear et al., OOPSLA 2018 — compositional lock-order facts,
+no whole-program execution needed).
+
+What it records, per instrumented lock:
+
+- the per-thread ACQUISITION GRAPH: an edge A→B whenever a thread
+  acquires B while holding A, with the first witness stack site for each
+  edge. A cycle in the merged graph is a potential deadlock even if the
+  interleaving that deadlocks never ran — the classic AB/BA inversion is
+  caught from two clean sequential executions.
+- BLOCKING-BOUNDARY violations: a registered blocking call (e.g.
+  ``jax.block_until_ready`` — PR 3's admission rule, or a socket RPC)
+  executed while the thread holds any instrumented lock.
+
+Usage (the injectable-factory seam)::
+
+    from m3_tpu.testing.lockcheck import LockCheck
+
+    with LockCheck.instrumented() as chk:   # patches threading.Lock/RLock
+        db = Database(...)                  # locks created here are tracked
+        ... run the concurrent workload ...
+    chk.assert_clean()                      # raises LockOrderError on a
+                                            # cycle or boundary violation
+
+or without patching, for code that accepts a lock factory::
+
+    chk = LockCheck()
+    lock_a = chk.lock("table")
+    lock_b = chk.lock("freelist")
+
+Blocking boundaries::
+
+    jax.block_until_ready = chk.wrap_blocking(
+        jax.block_until_ready, "jax.block_until_ready")
+    # or, inline at a known blocking point:
+    chk.boundary("socket send")
+
+The wrappers are full drop-in ``Lock``/``RLock`` replacements (context
+manager, ``acquire(blocking, timeout)``, ``locked()``, and the
+``_is_owned``/``_release_save``/``_acquire_restore`` trio so
+``threading.Condition``/``Event``/``queue.Queue`` built on them keep
+working). Bookkeeping never holds the checker's internal lock while
+acquiring a user lock, so the harness cannot deadlock the code under
+test.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+from contextlib import contextmanager
+
+
+class LockOrderError(AssertionError):
+    """A lock-order cycle (potential deadlock) or a blocking-boundary
+    violation witnessed by the harness."""
+
+
+_INFRA_FILES = ("threading.py", "queue.py", "contextlib.py", "socketserver.py")
+
+
+def _site() -> str:
+    """filename:lineno of the nearest application frame (cheap frame
+    walk — this runs on every instrumented acquire)."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != __file__ and not fn.endswith(_INFRA_FILES):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "?"
+
+
+class LockCheck:
+    """One harness instance = one merged acquisition graph."""
+
+    def __init__(self) -> None:
+        self._ids = itertools.count(1)
+        self._meta: dict[int, tuple[str, str]] = {}  # id -> (name, creation site)
+        # (a_id, b_id) -> (a_site, b_site): first witness of "held a,
+        # acquired b" with the stack locations of the two acquires
+        self._edges: dict[tuple[int, int], tuple[str, str]] = {}
+        self._violations: list[str] = []
+        self._tls = threading.local()
+        self._mu = threading.Lock()  # guards _edges/_violations/_meta
+
+    # -- factory seam --
+
+    def lock(self, name: str | None = None) -> "_CheckedLock":
+        return _CheckedLock(self, threading.Lock, name)
+
+    def rlock(self, name: str | None = None) -> "_CheckedRLock":
+        return _CheckedRLock(self, threading.RLock, name)
+
+    @classmethod
+    @contextmanager
+    def instrumented(cls, patch_module=threading):
+        """Patch ``threading.Lock``/``threading.RLock`` so every lock
+        created inside the block is checked (Condition/Event/Queue pick
+        the patched factories up automatically)."""
+        chk = cls()
+        orig_lock, orig_rlock = patch_module.Lock, patch_module.RLock
+        patch_module.Lock = lambda: _CheckedLock(chk, orig_lock)
+        patch_module.RLock = lambda: _CheckedRLock(chk, orig_rlock)
+        try:
+            yield chk
+        finally:
+            patch_module.Lock, patch_module.RLock = orig_lock, orig_rlock
+
+    # -- bookkeeping (called by the wrappers) --
+
+    def _register(self, wrapper, name: str | None) -> int:
+        lock_id = next(self._ids)
+        site = _site()
+        with self._mu:
+            self._meta[lock_id] = (name or f"lock@{site}", site)
+        return lock_id
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _on_acquired(self, lock_id: int, first: bool) -> None:
+        held = self._held()
+        site = _site()
+        if first and held:  # reentrant re-acquires add no edge
+            top_id, top_site = held[-1]
+            key = (top_id, lock_id)
+            if key not in self._edges:  # racy pre-check; settled under _mu
+                with self._mu:
+                    self._edges.setdefault(key, (top_site, site))
+        held.append((lock_id, site))
+
+    def _on_released(self, lock_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == lock_id:
+                del held[i]
+                return
+
+    # -- blocking boundaries --
+
+    def boundary(self, name: str) -> None:
+        """Declare 'this thread is about to block' (device sync, socket
+        wait): holding any instrumented lock here is a violation."""
+        held = self._held()
+        if not held:
+            return
+        with self._mu:
+            held_desc = ", ".join(
+                f"{self._meta[i][0]} (acquired {site})" for i, site in held
+            )
+            self._violations.append(
+                f"blocking boundary {name!r} reached at {_site()} while "
+                f"holding: {held_desc}"
+            )
+
+    def wrap_blocking(self, fn, name: str | None = None):
+        """Wrap a callable as a registered blocking boundary."""
+        label = name or getattr(fn, "__name__", repr(fn))
+
+        def wrapped(*args, **kwargs):
+            self.boundary(label)
+            return fn(*args, **kwargs)
+
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    # -- verdicts --
+
+    def cycles(self) -> list:
+        """Every elementary cycle reachable in the merged acquisition
+        graph, as lists of lock ids (deterministic order)."""
+        with self._mu:
+            edges = dict(self._edges)
+        adj: dict[int, list[int]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        for succs in adj.values():
+            succs.sort()
+        found: list = []
+        seen_cycles: set = set()
+
+        def dfs(start: int, node: int, path: list, on_path: set) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    canon = tuple(sorted(path))
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        found.append(list(path))
+                elif nxt > start and nxt not in on_path:
+                    on_path.add(nxt)
+                    dfs(start, nxt, path + [nxt], on_path)
+                    on_path.discard(nxt)
+
+        for start in sorted(adj):
+            dfs(start, start, [start], {start})
+        return found
+
+    def _describe_cycle(self, cycle: list) -> str:
+        with self._mu:
+            parts = []
+            ring = cycle + [cycle[0]]
+            for a, b in zip(ring, ring[1:]):
+                a_site, b_site = self._edges[(a, b)]
+                parts.append(
+                    f"  {self._meta[a][0]} (held at {a_site})\n"
+                    f"    -> then acquired {self._meta[b][0]} at {b_site}"
+                )
+        return "\n".join(parts)
+
+    def report(self) -> str:
+        """Human-readable verdict; empty string when clean."""
+        lines = []
+        for cycle in self.cycles():
+            names = " -> ".join(self._meta[i][0] for i in cycle + [cycle[0]])
+            lines.append(
+                f"lock-order cycle (potential deadlock): {names}\n"
+                + self._describe_cycle(cycle)
+            )
+        with self._mu:
+            lines.extend(self._violations)
+        return "\n".join(lines)
+
+    def assert_clean(self) -> None:
+        report = self.report()
+        if report:
+            raise LockOrderError(report)
+
+
+class _CheckedLock:
+    """Drop-in non-reentrant lock recording order facts on its harness."""
+
+    _reentrant = False
+
+    def __init__(self, check: LockCheck, inner_factory, name: str | None = None):
+        self._check = check
+        self._inner = inner_factory()
+        self._id = check._register(self, name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._check._on_acquired(self._id, first=self._first_acquire())
+        return got
+
+    def _first_acquire(self) -> bool:
+        return True
+
+    def release(self) -> None:
+        self._inner.release()
+        self._check._on_released(self._id)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _CheckedRLock(_CheckedLock):
+    """Reentrant variant: re-acquiring a held lock adds no edge, and the
+    Condition protocol trio keeps held-state bookkeeping truthful across
+    ``Condition.wait``'s full release/reacquire."""
+
+    _reentrant = True
+
+    def __init__(self, check: LockCheck, inner_factory, name: str | None = None):
+        super().__init__(check, inner_factory, name)
+        self._depth = 0  # owner-thread recursion depth (guarded by _inner)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._depth += 1
+            self._check._on_acquired(self._id, first=self._depth == 1)
+        return got
+
+    def release(self) -> None:
+        self._depth -= 1
+        self._inner.release()
+        self._check._on_released(self._id)
+
+    # Condition protocol (threading.Condition defers to these when the
+    # underlying lock provides them)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        depth, self._depth = self._depth, 0
+        for _ in range(depth):
+            self._check._on_released(self._id)
+        return depth, self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        depth, inner_state = state
+        self._inner._acquire_restore(inner_state)
+        self._depth = depth
+        for i in range(depth):
+            self._check._on_acquired(self._id, first=i == 0)

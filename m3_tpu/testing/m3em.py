@@ -35,7 +35,8 @@ class AgentServer:
         self._procs: dict[str, subprocess.Popen] = {}
         self._argv: dict[str, list[str]] = {}
         self._lock = threading.Lock()
-        self.started_at = time.time()
+        # monotonic: uptime is a duration, and NTP steps must not warp it
+        self.started_at = time.monotonic()
         # panicmon (x/panicmon + agent/heartbeater.go): watch spawned
         # processes for SILENT death — an exit not requested through
         # op_stop/op_teardown is recorded and surfaces in /heartbeat
@@ -73,7 +74,7 @@ class AgentServer:
                         }
                     with outer._lock:
                         exits = list(outer._exit_events)
-                    self._reply(200, {"ok": True, "uptime": time.time() - outer.started_at,
+                    self._reply(200, {"ok": True, "uptime": time.monotonic() - outer.started_at,
                                       "processes": procs, "exits": exits})
                 else:
                     self._reply(404, {"error": "not found"})

@@ -1,20 +1,38 @@
 """Concurrency stress: writers, readers, and the mediator lifecycle running
 simultaneously against one Database (per-shard locking, shard.go RWMutex
-granularity). Every acknowledged write must be readable afterwards, and no
-thread may crash."""
+granularity). Every acknowledged write must be readable afterwards, no
+thread may crash, and — under the lockcheck harness — the storage engine's
+lock acquisition graph must stay acyclic with no device sync
+(jax.block_until_ready) reached while a lock is held."""
 
 import threading
 import time
 
+import jax
+
 from m3_tpu.storage.database import Database, NamespaceOptions
 from m3_tpu.storage.mediator import Mediator, MediatorOptions
+from m3_tpu.testing.lockcheck import LockCheck
 
 NANOS = 1_000_000_000
 HOUR = 3600 * NANOS
 T0 = 1_600_000_000 * NANOS
 
 
-def test_concurrent_write_read_flush(tmp_path):
+def test_concurrent_write_read_flush(tmp_path, monkeypatch):
+    with LockCheck.instrumented() as chk:
+        # device syncs are a registered blocking boundary: holding any
+        # storage lock across one is the PR 3 admission-rule regression
+        monkeypatch.setattr(
+            jax,
+            "block_until_ready",
+            chk.wrap_blocking(jax.block_until_ready, "jax.block_until_ready"),
+        )
+        _run_write_read_flush_workload(tmp_path)
+    chk.assert_clean()
+
+
+def _run_write_read_flush_workload(tmp_path):
     db = Database(str(tmp_path), num_shards=4)
     db.create_namespace("ns", NamespaceOptions(block_size_nanos=HOUR))
     db.bootstrap()

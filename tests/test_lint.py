@@ -1,0 +1,423 @@
+"""m3lint self-tests: each checker fires on a known-bad synthetic snippet
+and stays quiet on the fixed codebase, suppressions require rationales,
+and the tools/check_lint.py gate passes on the current tree (this test IS
+the tier-1 wiring of the lint gate)."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+from tools.m3lint import REPO_ROOT, lint_paths, lint_source
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+def lint(src, rel="synthetic/mod.py", extra=None):
+    return lint_source(textwrap.dedent(src), rel=rel, extra=extra)
+
+
+# --- M3L001 device-op-under-lock ---
+
+
+def test_device_op_under_lock_fires():
+    findings = lint(
+        """
+        import jax, threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def admit(self, x):
+                with self._lock:
+                    staged = jax.device_put(x)
+                    staged.block_until_ready()
+                return staged
+        """
+    )
+    assert codes(findings) == {"M3L001"} and len(findings) == 2
+
+
+def test_device_op_outside_lock_quiet():
+    findings = lint(
+        """
+        import jax, threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def admit(self, x):
+                staged = jax.device_put(x)
+                with self._lock:
+                    self.table = staged  # bookkeeping only under the lock
+                return staged
+        """
+    )
+    assert findings == []
+
+
+def test_nested_def_under_lock_not_flagged():
+    # a function DEFINED under a lock does not RUN there
+    findings = lint(
+        """
+        import jax, threading
+
+        _lock = threading.Lock()
+
+        def make():
+            with _lock:
+                def later(x):
+                    return jax.device_put(x)
+            return later
+        """
+    )
+    assert findings == []
+
+
+# --- M3L002 jit-mutable-capture ---
+
+
+def test_jit_mutable_global_capture_fires():
+    findings = lint(
+        """
+        import jax
+
+        _SCALE = 1.0
+
+        def set_scale(v):
+            global _SCALE
+            _SCALE = v
+
+        @jax.jit
+        def apply(x):
+            return x * _SCALE
+        """
+    )
+    assert codes(findings) == {"M3L002"}
+
+
+def test_jit_self_capture_fires():
+    findings = lint(
+        """
+        import functools, jax
+
+        class K:
+            @functools.partial(jax.jit, static_argnames=())
+            def run(self, x):
+                return x + self.offset
+        """
+    )
+    assert "M3L002" in codes(findings)
+
+
+def test_jit_constant_global_quiet():
+    findings = lint(
+        """
+        import jax
+
+        _TABLE = (1, 2, 3)  # assigned once: a real constant
+
+        @jax.jit
+        def apply(x):
+            return x * _TABLE[0]
+        """
+    )
+    assert findings == []
+
+
+# --- M3L003 wire-registry-consistency ---
+
+_FAKE_WIRE = """
+IDEMPOTENT_OPS = frozenset({"fetch", "write_thing", "ghost_op"})
+UNTRACED_OPS = frozenset({"health", "phantom"})
+RETRYABLE_ETYPES = frozenset({"NopeError"})
+"""
+
+_FAKE_SERVICE = """
+class Service:
+    def handle(self, req):
+        op = req.get("op")
+        if op == "health":
+            return True
+        fn = getattr(self, f"op_{op}", None)
+        return fn(req)
+
+    def op_fetch(self, req):
+        return 1
+
+    def op_write_thing(self, req):
+        return 1
+
+    def op_mystery(self, req):
+        return 1
+
+
+def probe(client):
+    return client._call("nonexistent_op")
+"""
+
+
+def test_wire_registry_consistency_fires_on_all_shapes():
+    findings = lint(
+        _FAKE_SERVICE,
+        rel="pkg/services/svc.py",
+        extra={"pkg/net/wire.py": _FAKE_WIRE},
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert codes(findings) == {"M3L003"}
+    assert "'ghost_op' is not dispatched" in msgs  # stale registry entry
+    assert "mutating op 'write_thing'" in msgs  # write registered idempotent
+    assert "'phantom' is not dispatched" in msgs  # stale UNTRACED entry
+    assert "'NopeError'" in msgs  # undefined exception class
+    assert "'mystery' is unclassified" in msgs  # op with no classification
+    assert "'nonexistent_op'" in msgs  # client typo
+
+
+def test_wire_registry_consistency_quiet_when_in_sync():
+    findings = lint(
+        """
+        class Service:
+            def handle(self, req):
+                op = req.get("op")
+                fn = getattr(self, f"op_{op}", None)
+                return fn(req)
+
+            def op_fetch(self, req):
+                return 1
+
+            def op_write_thing(self, req):
+                return 1
+
+
+        class NopeError(RuntimeError):
+            pass
+        """,
+        rel="pkg/services/svc.py",
+        extra={
+            "pkg/net/wire.py": """
+IDEMPOTENT_OPS = frozenset({"fetch"})
+UNTRACED_OPS = frozenset({"fetch"})
+RETRYABLE_ETYPES = frozenset({"NopeError"})
+"""
+        },
+    )
+    assert findings == []
+
+
+# --- M3L004 deadline-clock-discipline ---
+
+
+def test_wall_clock_deadline_fires():
+    findings = lint(
+        """
+        import time
+
+        def wait_for(pred, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+            return False
+        """
+    )
+    assert codes(findings) == {"M3L004"} and len(findings) == 2
+
+
+def test_monotonic_deadline_and_timestamps_quiet():
+    findings = lint(
+        """
+        import time
+
+        def wait_for(pred, timeout):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return True
+            return False
+
+        def stamp():
+            return time.time()  # a wall-clock TIMESTAMP is fine
+        """
+    )
+    assert findings == []
+
+
+def test_wall_clock_suppression_needs_rationale():
+    src = """
+    import time
+
+    def deadline_frame(timeout):
+        # m3lint: disable=M3L004
+    """ + "    return time.time() + timeout\n"
+    findings = lint(src)
+    # the suppression eats the M3L004 but yields M3L000 (no rationale)
+    assert codes(findings) == {"M3L000"}
+
+    src_ok = """
+    import time
+
+    def deadline_frame(timeout):
+        # m3lint: disable=M3L004 -- wire deadline is wall-clock by protocol
+    """ + "    return time.time() + timeout\n"
+    assert lint(src_ok) == []
+
+
+def test_stale_suppression_is_reported():
+    # the flagged code was fixed but the comment stayed behind: flag it,
+    # or it would silently mask the next real finding at the same spot
+    findings = lint(
+        """
+        import time
+
+        def deadline_frame(timeout):
+            # m3lint: disable=M3L004 -- wire deadline is wall-clock by protocol
+            return time.monotonic() + timeout
+        """
+    )
+    assert codes(findings) == {"M3L000"}
+    assert "unused suppression" in findings[0].message
+
+
+# --- M3L005 metric-name-discipline ---
+
+
+def test_dynamic_metric_name_fires():
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def track(op):
+            METRICS.counter(f"requests_{op}_total").inc()
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+
+
+def test_double_prefix_and_bad_label_key_fire():
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        METRICS.counter("m3tpu_requests_total")
+        METRICS.gauge("depth", labels={"series_id": "abc"})
+        """
+    )
+    assert codes(findings) == {"M3L005"} and len(findings) == 2
+
+
+def test_clean_metric_quiet():
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        METRICS.counter("requests_total", "help", labels={"op": "fetch"})
+        """
+    )
+    assert findings == []
+
+
+# --- M3L006 thread-daemon-discipline ---
+
+
+def test_non_daemon_thread_in_rpc_plane_fires():
+    src = """
+    import threading
+
+    def fan_out(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+    """
+    assert codes(lint(src, rel="m3_tpu/net/fanout.py")) == {"M3L006"}
+    # same code outside the scoped dirs is not flagged
+    assert lint(src, rel="m3_tpu/ops/fanout.py") == []
+
+
+def test_daemon_thread_quiet():
+    findings = lint(
+        """
+        import threading
+
+        def fan_out(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """,
+        rel="m3_tpu/net/fanout.py",
+    )
+    assert findings == []
+
+
+# --- M3L007 swallowed-exception ---
+
+
+def test_bare_except_and_silent_swallow_fire():
+    findings = lint(
+        """
+        def poll(fn):
+            try:
+                fn()
+            except:
+                return None
+
+        def probe(fn):
+            try:
+                fn()
+            except Exception:
+                pass
+        """
+    )
+    assert codes(findings) == {"M3L007"} and len(findings) == 2
+
+
+def test_counted_or_narrow_swallow_quiet():
+    findings = lint(
+        """
+        def probe(fn, errors):
+            try:
+                fn()
+            except Exception:
+                errors.inc()
+
+        def close(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass  # narrow except: a deliberate, reviewable contract
+        """
+    )
+    assert findings == []
+
+
+# --- the fixed codebase stays quiet + the gate runs inside tier-1 ---
+
+
+def test_current_tree_is_clean():
+    res = lint_paths(["m3_tpu", "tools"], repo_root=REPO_ROOT)
+    assert res.errors == []
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    # every suppression that made the tree clean carries a rationale
+    assert all(why for _, why in res.suppressed)
+    assert all(why for _, why in res.baselined)
+
+
+def test_check_lint_gate_passes():
+    from tools import check_lint
+
+    assert check_lint.main([]) == 0
+
+
+def test_cli_json_smoke():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.m3lint", "m3_tpu", "tools",
+         "--format", "json"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ok"] and payload["findings"] == []
+    assert payload["files_scanned"] > 100

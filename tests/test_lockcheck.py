@@ -1,0 +1,158 @@
+"""Lock-order race harness (m3_tpu/testing/lockcheck): the contrived
+AB/BA inversion must fail with a readable cycle report even though the
+sequential execution never deadlocks, and a lock held across a
+registered blocking boundary must trip the boundary rule."""
+
+import queue
+import threading
+
+import pytest
+
+from m3_tpu.testing.lockcheck import LockCheck, LockOrderError
+
+
+def test_ab_ba_inversion_reports_cycle():
+    chk = LockCheck()
+    a = chk.lock("A")
+    b = chk.lock("B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    # SEQUENTIAL thread runs: the deadlocking interleaving never executes,
+    # the order inversion is still caught from the merged graph
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        t.join(timeout=10)
+
+    with pytest.raises(LockOrderError) as exc:
+        chk.assert_clean()
+    msg = str(exc.value)
+    assert "cycle" in msg
+    assert "A" in msg and "B" in msg
+    # the report carries acquisition sites, not just lock names
+    assert "test_lockcheck.py" in msg
+
+
+def test_consistent_order_is_clean():
+    chk = LockCheck()
+    a = chk.lock("A")
+    b = chk.lock("B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    for _ in range(2):
+        t = threading.Thread(target=ab, daemon=True)
+        t.start()
+        t.join(timeout=10)
+    chk.assert_clean()
+    assert chk.cycles() == []
+
+
+def test_rlock_reentry_adds_no_self_edge():
+    chk = LockCheck()
+    r = chk.rlock("R")
+    with r:
+        with r:
+            pass
+    chk.assert_clean()
+
+
+def test_three_lock_rotation_cycle():
+    chk = LockCheck()
+    locks = [chk.lock(n) for n in ("L0", "L1", "L2")]
+
+    def pair(i, j):
+        with locks[i]:
+            with locks[j]:
+                pass
+
+    for i, j in ((0, 1), (1, 2), (2, 0)):
+        t = threading.Thread(target=pair, args=(i, j), daemon=True)
+        t.start()
+        t.join(timeout=10)
+    cycles = chk.cycles()
+    assert len(cycles) == 1 and len(cycles[0]) == 3
+    with pytest.raises(LockOrderError):
+        chk.assert_clean()
+
+
+def test_blocking_boundary_while_holding_lock():
+    chk = LockCheck()
+    lock = chk.lock("shard")
+
+    def fake_block_until_ready(x):
+        return x
+
+    wrapped = chk.wrap_blocking(fake_block_until_ready, "jax.block_until_ready")
+    with lock:
+        assert wrapped(7) == 7  # still calls through
+    with pytest.raises(LockOrderError) as exc:
+        chk.assert_clean()
+    msg = str(exc.value)
+    assert "jax.block_until_ready" in msg and "shard" in msg
+
+
+def test_blocking_boundary_without_lock_is_clean():
+    chk = LockCheck()
+    lock = chk.lock("shard")
+    with lock:
+        pass
+    chk.boundary("socket send")  # nothing held -> fine
+    chk.assert_clean()
+
+
+def test_instrumented_patches_condition_and_queue():
+    """Locks created inside the patch window — including those inside
+    threading.Condition/Event and queue.Queue — are tracked, and
+    Condition.wait's release/reacquire keeps bookkeeping truthful."""
+    with LockCheck.instrumented() as chk:
+        cond = threading.Condition()
+        q: queue.Queue = queue.Queue()
+        done = threading.Event()
+
+        def consumer():
+            with cond:
+                cond.wait(timeout=5)
+            q.get(timeout=5)
+            done.set()
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        with cond:
+            cond.notify_all()
+        q.put(1)
+        assert done.wait(timeout=10)
+        t.join(timeout=10)
+    chk.assert_clean()
+    # the patch is rolled back
+    assert threading.Lock is not None and not hasattr(threading.Lock(), "_check")
+
+
+def test_instrumented_catches_inversion_in_patched_code():
+    with LockCheck.instrumented() as chk:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def run(first, second):
+            with first:
+                with second:
+                    pass
+
+        for pair in ((a, b), (b, a)):
+            t = threading.Thread(target=run, args=pair, daemon=True)
+            t.start()
+            t.join(timeout=10)
+    with pytest.raises(LockOrderError):
+        chk.assert_clean()

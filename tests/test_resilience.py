@@ -610,35 +610,41 @@ def test_faultplan_partition_and_exempt():
 def test_chaos_in_process_quorum_survives_drops_and_partition(tmp_path):
     """Seeded FaultPlan over testing/cluster nodes: 20% request drops on
     two replicas plus one fully partitioned replica — MAJORITY writes and
-    reads still succeed with zero client-visible errors."""
-    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
-                           base_dir=str(tmp_path))
-    plan = FaultPlan(
-        [
-            FaultRule(peer="node2", partition=True),
-            FaultRule(drop=0.2),
-        ],
-        seed=1234,
-    )
-    s = cluster.session()
-    s.nodes = wrap_nodes(s.nodes, plan)
-    s.op_retries = 6
-    s.op_retry_backoff = 0.005
-    retries_before = _counter_total("session_op_retries_total")
-    n = 30
-    sids = []
-    for i in range(n):
-        tags = ((b"__name__", b"chaos"), (b"i", b"%d" % i))
-        sids.append(s.write_tagged(tags, T0 + i * NANOS, float(i)))
-    res = s.fetch_tagged(term(b"__name__", b"chaos"), T0 - 1, T0 + HOUR)
-    assert res.exhaustive
-    got = {row[0]: [dp.value for dp in row[2]] for row in res}
-    assert len(got) == n
-    for i, sid in enumerate(sids):
-        assert got[sid] == [float(i)]
-    # the chaos actually exercised the retry machinery
-    assert _counter_total("session_op_retries_total") > retries_before
-    s.close()
+    reads still succeed with zero client-visible errors. The whole run
+    executes under the lockcheck harness: the session fan-out plus three
+    node databases must keep an acyclic lock acquisition graph."""
+    from m3_tpu.testing.lockcheck import LockCheck
+
+    with LockCheck.instrumented() as chk:
+        cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                               base_dir=str(tmp_path))
+        plan = FaultPlan(
+            [
+                FaultRule(peer="node2", partition=True),
+                FaultRule(drop=0.2),
+            ],
+            seed=1234,
+        )
+        s = cluster.session()
+        s.nodes = wrap_nodes(s.nodes, plan)
+        s.op_retries = 6
+        s.op_retry_backoff = 0.005
+        retries_before = _counter_total("session_op_retries_total")
+        n = 30
+        sids = []
+        for i in range(n):
+            tags = ((b"__name__", b"chaos"), (b"i", b"%d" % i))
+            sids.append(s.write_tagged(tags, T0 + i * NANOS, float(i)))
+        res = s.fetch_tagged(term(b"__name__", b"chaos"), T0 - 1, T0 + HOUR)
+        assert res.exhaustive
+        got = {row[0]: [dp.value for dp in row[2]] for row in res}
+        assert len(got) == n
+        for i, sid in enumerate(sids):
+            assert got[sid] == [float(i)]
+        # the chaos actually exercised the retry machinery
+        assert _counter_total("session_op_retries_total") > retries_before
+        s.close()
+    chk.assert_clean()
 
 
 def test_chaos_over_sockets_retries_and_breaker(tmp_path):

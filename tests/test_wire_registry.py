@@ -1,0 +1,118 @@
+"""Wire-registry sync guards, generated from the m3lint project model:
+the dispatch tables in net/server.py (NodeService + middleware),
+cluster/kv_service.py, cluster/raft.py, and the aggregator debug server
+must stay in sync with wire.IDEMPOTENT_OPS / UNTRACED_OPS /
+RETRYABLE_ETYPES — the AST-derived model is cross-checked against the
+RUNTIME registries so neither can drift without failing here."""
+
+import pytest
+
+from m3_tpu.net import resilience, wire
+from m3_tpu.net.server import DebugService, NodeService
+from m3_tpu.cluster import raft
+from m3_tpu.cluster.kv_service import KVService
+from tools.m3lint import REPO_ROOT, load_files
+from tools.m3lint.model import ProjectModel, is_mutating_op
+
+
+@pytest.fixture(scope="module")
+def model():
+    contexts, errors = load_files(["m3_tpu", "tools"], REPO_ROOT)
+    assert errors == []
+    return ProjectModel(contexts)
+
+
+def _op_methods(cls) -> set:
+    return {m[3:] for m in dir(cls) if m.startswith("op_")}
+
+
+def test_ast_registries_match_runtime(model):
+    """The lint model reads the same sets the process executes — if the
+    registry literal ever stops being statically parseable, this fails
+    before the checker silently goes blind."""
+    assert model.registry("IDEMPOTENT_OPS").ops == wire.IDEMPOTENT_OPS
+    assert model.registry("UNTRACED_OPS").ops == wire.UNTRACED_OPS
+    assert model.registry("RETRYABLE_ETYPES").ops == wire.RETRYABLE_ETYPES
+
+
+def test_every_idempotent_op_is_dispatched(model):
+    stale = sorted(wire.IDEMPOTENT_OPS - set(model.dispatched))
+    assert stale == [], f"IDEMPOTENT_OPS entries nothing serves: {stale}"
+
+
+def test_no_mutating_op_is_registered_idempotent():
+    bad = sorted(op for op in wire.IDEMPOTENT_OPS if is_mutating_op(op))
+    assert bad == [], f"mutating ops registered for transparent retry: {bad}"
+
+
+def test_untraced_ops_are_idempotent_reads(model):
+    """Poller ops excluded from tracing must be read/probe ops: a
+    mutating op hidden from traces would be undebuggable."""
+    assert wire.UNTRACED_OPS <= wire.IDEMPOTENT_OPS
+    assert wire.UNTRACED_OPS <= set(model.dispatched)
+
+
+def test_retryable_etypes_are_defined_exception_classes(model):
+    for name in wire.RETRYABLE_ETYPES:
+        assert name in model.classes, f"{name} not defined anywhere"
+        cls = getattr(resilience, name, None) or getattr(raft, name, None)
+        assert cls is not None and issubclass(cls, Exception), name
+
+
+def test_dbnode_dispatch_table_in_sync():
+    node_ops = _op_methods(NodeService)
+    unclassified = sorted(
+        op
+        for op in node_ops
+        if op not in wire.IDEMPOTENT_OPS and not is_mutating_op(op)
+    )
+    assert unclassified == [], (
+        f"NodeService ops with undeclared retry semantics: {unclassified}"
+    )
+    # the writes must never be transparently retried
+    writes = {op for op in node_ops if op.startswith("write")}
+    assert writes and not (writes & wire.IDEMPOTENT_OPS)
+
+
+def test_kv_dispatch_table_in_sync():
+    kv_ops = _op_methods(KVService)
+    unclassified = sorted(
+        op
+        for op in kv_ops
+        if op not in wire.IDEMPOTENT_OPS and not is_mutating_op(op)
+    )
+    assert unclassified == [], (
+        f"KVService ops with undeclared retry semantics: {unclassified}"
+    )
+    # reads are registered, mutations are not
+    assert {"kv_get", "kv_keys", "kv_get_prefix", "kv_watch"} <= wire.IDEMPOTENT_OPS
+    assert not ({"kv_set", "kv_cas", "kv_delete"} & wire.IDEMPOTENT_OPS)
+
+
+def test_raft_kv_dispatch_table_in_sync():
+    raft_ops = _op_methods(raft.RaftKVService)
+    unclassified = sorted(
+        op
+        for op in raft_ops
+        if op not in wire.IDEMPOTENT_OPS and not is_mutating_op(op)
+    )
+    assert unclassified == []
+    # the raft protocol RPCs are duplicate-safe by design and registered
+    assert {"raft_vote", "raft_append", "raft_snapshot"} <= wire.IDEMPOTENT_OPS
+    assert "raft_configure" not in wire.IDEMPOTENT_OPS
+
+
+def test_aggregator_debug_server_ops_in_sync(model):
+    """The aggregator's --debug-port RPC surface is DebugService behind
+    the middleware: health + traces string-dispatch plus the universal
+    metrics op — all registered idempotent probes."""
+    svc = DebugService()
+    assert svc.handle({"op": "health"})["ok"] is True
+    for op in ("health", "traces", "metrics"):
+        assert op in wire.IDEMPOTENT_OPS
+        assert op in model.dispatched
+
+
+def test_client_literal_ops_all_served(model):
+    unknown = sorted(set(model.client_calls) - set(model.dispatched))
+    assert unknown == [], f"client calls ops nothing dispatches: {unknown}"

@@ -186,6 +186,7 @@ def main() -> int:
                 for node in session.nodes.values():
                     node.close()
         except Exception:
+            # m3lint: disable=M3L007 -- best-effort teardown after the checks already ran
             pass
         if cluster is not None:
             cluster.close()
@@ -194,8 +195,8 @@ def main() -> int:
         shutil.rmtree(base, ignore_errors=True)
 
     if fds_before >= 0:
-        deadline = time.time() + 15
-        while _socket_fds() > fds_before and time.time() < deadline:
+        deadline = time.monotonic() + 15
+        while _socket_fds() > fds_before and time.monotonic() < deadline:
             time.sleep(0.2)
         check(
             _socket_fds() <= fds_before,

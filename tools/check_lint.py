@@ -1,0 +1,49 @@
+"""CI gate: run m3lint over the project scan roots and exit nonzero on
+any non-suppressed finding (tests/test_lint.py runs this inside tier-1;
+it is also runnable standalone):
+
+    python tools/check_lint.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable both as `python tools/check_lint.py` and via import
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCAN_ROOTS = ("m3_tpu", "tools")
+
+
+def main(argv=None) -> int:
+    from tools.m3lint import lint_paths
+
+    res = lint_paths(list(SCAN_ROOTS))
+    ok = True
+
+    def check(cond: bool, msg: str) -> None:
+        nonlocal ok
+        print(("PASS " if cond else "FAIL ") + msg, flush=True)
+        ok = ok and cond
+
+    for f in res.findings:
+        print(f"  {f.render()}", flush=True)
+    for err in res.errors:
+        print(f"  PARSE ERROR: {err}", flush=True)
+    check(res.files_scanned > 100, f"scanned the whole tree ({res.files_scanned} files)")
+    check(not res.errors, "every scanned file parses")
+    check(
+        not res.findings,
+        f"no non-suppressed findings ({len(res.findings)} found, "
+        f"{len(res.suppressed)} suppressed inline, "
+        f"{len(res.baselined)} baselined)",
+    )
+    # every suppression must carry a rationale — enforced as M3L000
+    # findings by the framework, so a clean run implies rationales exist
+    print("CHECK_LINT " + ("PASS" if ok else "FAIL"), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

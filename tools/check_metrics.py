@@ -161,6 +161,7 @@ def main() -> int:
             from m3_tpu.net.client import RemoteNode
             from m3_tpu.utils.instrument import DEFAULT as METRICS
 
+            # m3lint: disable=M3L005 -- deliberate exposition-escaping stressor; one-off probe keys in a CI validator, not the fleet exposition
             METRICS.counter(
                 "checkmetrics_escape_probe_total",
                 labels={"matcher": 'env=~"prod\\d+.*"', "note": "a\nb'"},

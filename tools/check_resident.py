@@ -123,6 +123,7 @@ def main() -> int:
             if node is not None:
                 node.close()
         except Exception:
+            # m3lint: disable=M3L007 -- best-effort teardown after the checks already ran
             pass
         if proc is not None:
             proc.terminate()

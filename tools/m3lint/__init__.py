@@ -1,0 +1,381 @@
+"""m3lint: project-invariant static analysis for the m3_tpu codebase.
+
+"Bugs as Deviant Behavior" (Engler et al., SOSP 2001) checkers for the
+conventions this repo's correctness rests on but nothing else enforces:
+device uploads staged outside locks (PR 3's admission rule), the
+transparent-retry registry staying in sync with the dispatch tables
+(PR 4), monotonic clocks for waits/backoff, daemonized fan-out threads,
+and bounded `m3tpu_*` metric name/label cardinality.
+
+Architecture:
+
+- :class:`FileContext` — one parsed source file (AST + lines + parent
+  map + inline suppressions).
+- :class:`Checker` subclasses registered via :func:`register` implement
+  ``check_file(ctx)`` (per-file AST walk) and/or ``check_project(model)``
+  (cross-file checks over :class:`~tools.m3lint.model.ProjectModel`).
+- :func:`lint_paths` walks the scan roots, runs every checker, applies
+  inline suppressions and the baseline file, and returns a
+  :class:`Result`.
+
+Suppressions (every one MUST carry a one-line rationale):
+
+- inline: ``# m3lint: disable=<CODE> -- <one-line rationale>`` on the
+  flagged line, or alone on the line above it;
+- baseline: an entry in ``tools/m3lint/baseline.json`` with
+  ``{"code", "path", "contains", "reason"}``.
+
+A suppression with no rationale is itself a finding (M3L000).
+
+CLI: ``python -m tools.m3lint m3_tpu tools [--format json|text]`` —
+exits nonzero on any non-suppressed finding (the tier-1/CI gate,
+tools/check_lint.py, wraps exactly this).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+# directories never worth scanning: caches and generated code (the
+# protobuf module is machine-written; its style is not ours to lint)
+EXCLUDE_DIRS = {"__pycache__", ".git", "gen", ".pytest_cache"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*m3lint:\s*disable=([A-Z0-9,\s]+?)\s*(?:--\s*(.*?))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    checker: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "checker": self.checker,
+        }
+
+
+@dataclass
+class Suppression:
+    line: int  # line the suppression comment sits on
+    codes: tuple
+    rationale: str
+    used: bool = False
+
+
+class FileContext:
+    """One parsed file: source, AST, lazily-built parent map, and the
+    inline suppression table."""
+
+    def __init__(self, rel: str, source: str, path: str | None = None) -> None:
+        self.rel = rel.replace(os.sep, "/")
+        self.path = path or rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.rel)
+        self._parents: dict | None = None
+        self.suppressions = self._parse_suppressions()
+
+    @classmethod
+    def from_file(cls, path: str, repo_root: str) -> "FileContext":
+        rel = os.path.relpath(path, repo_root)
+        with open(path, encoding="utf-8") as f:
+            return cls(rel, f.read(), path=path)
+
+    # -- parents --
+
+    @property
+    def parents(self) -> dict:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    # -- suppressions --
+
+    def _parse_suppressions(self) -> list:
+        out = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            codes = tuple(
+                c.strip() for c in m.group(1).split(",") if c.strip()
+            )
+            out.append(Suppression(i, codes, (m.group(2) or "").strip()))
+        return out
+
+    def suppression_for(self, finding: Finding):
+        """An inline suppression applies to its own line; a standalone
+        comment also covers the line right below it, or — when it is the
+        first line of a block (``except Exception:`` + comment + pass) —
+        the block-opener line right above it."""
+        for sup in self.suppressions:
+            if finding.code not in sup.codes:
+                continue
+            if sup.line == finding.line:
+                return sup
+            own_line = self.lines[sup.line - 1].lstrip()
+            if own_line.startswith("#") and sup.line + 1 == finding.line:
+                return sup
+            if (
+                own_line.startswith("#")
+                and sup.line == finding.line + 1
+                and 0 < finding.line <= len(self.lines)
+                and self.lines[finding.line - 1].rstrip().endswith(":")
+            ):
+                return sup
+        return None
+
+
+# -- checker registry --
+
+CHECKERS: list = []
+
+
+def register(cls):
+    CHECKERS.append(cls)
+    return cls
+
+
+class Checker:
+    """Base checker: set ``code``/``name``, implement one of the hooks.
+
+    ``check_file(ctx)`` yields Findings for one FileContext;
+    ``check_project(model)`` yields Findings over the cross-file model.
+    """
+
+    code = ""
+    name = ""
+
+    def check_file(self, ctx: FileContext):
+        return ()
+
+    def check_project(self, model):
+        return ()
+
+    def finding(self, ctx_or_rel, line: int, message: str) -> Finding:
+        rel = ctx_or_rel.rel if isinstance(ctx_or_rel, FileContext) else ctx_or_rel
+        return Finding(self.code, rel, line, message, checker=self.name)
+
+
+# -- baseline --
+
+@dataclass
+class BaselineEntry:
+    code: str
+    path: str
+    contains: str = ""
+    reason: str = ""
+    used: bool = False
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.code == self.code
+            and f.path == self.path
+            and (not self.contains or self.contains in f.message)
+        )
+
+
+def load_baseline(path: str | None):
+    if path is None or not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    return [
+        BaselineEntry(
+            code=e["code"],
+            path=e["path"],
+            contains=e.get("contains", ""),
+            reason=e.get("reason", ""),
+        )
+        for e in raw
+    ]
+
+
+@dataclass
+class Result:
+    findings: list = field(default_factory=list)  # kept (actionable)
+    suppressed: list = field(default_factory=list)  # (finding, rationale)
+    baselined: list = field(default_factory=list)  # (finding, reason)
+    errors: list = field(default_factory=list)  # unparseable files
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "rationale": r} for f, r in self.suppressed
+            ],
+            "baselined": [
+                {**f.to_dict(), "reason": r} for f, r in self.baselined
+            ],
+            "errors": self.errors,
+        }
+
+
+def iter_py_files(paths, repo_root: str):
+    for p in paths:
+        absolute = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isfile(absolute):
+            if absolute.endswith(".py"):
+                yield absolute
+            continue
+        for dirpath, dirnames, filenames in os.walk(absolute):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in EXCLUDE_DIRS
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_files(paths, repo_root: str):
+    """Parse every .py under the scan roots; returns (contexts, errors)."""
+    contexts, errors = [], []
+    for path in iter_py_files(paths, repo_root):
+        try:
+            contexts.append(FileContext.from_file(path, repo_root))
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            errors.append(f"{os.path.relpath(path, repo_root)}: {exc}")
+    return contexts, errors
+
+
+def _run_checkers(contexts):
+    from .model import ProjectModel
+
+    findings: list[Finding] = []
+    checkers = [cls() for cls in CHECKERS]
+    for ctx in contexts:
+        for checker in checkers:
+            findings.extend(checker.check_file(ctx))
+    model = ProjectModel(contexts)
+    for checker in checkers:
+        findings.extend(checker.check_project(model))
+    return findings
+
+
+def lint_contexts(contexts, baseline=None) -> Result:
+    """Run every registered checker over pre-built FileContexts (the seam
+    tests/test_lint.py uses to lint synthetic modules)."""
+    res = Result(files_scanned=len(contexts))
+    by_rel = {ctx.rel: ctx for ctx in contexts}
+    for f in sorted(
+        _run_checkers(contexts), key=lambda f: (f.path, f.line, f.code)
+    ):
+        ctx = by_rel.get(f.path)
+        sup = ctx.suppression_for(f) if ctx is not None else None
+        if sup is not None:
+            sup.used = True
+            if not sup.rationale:
+                res.findings.append(
+                    Finding(
+                        "M3L000",
+                        f.path,
+                        sup.line,
+                        f"suppression of {f.code} has no rationale "
+                        "(append '-- <why>')",
+                        checker="suppression-rationale",
+                    )
+                )
+            else:
+                res.suppressed.append((f, sup.rationale))
+            continue
+        entry = next((e for e in baseline or [] if e.matches(f)), None)
+        if entry is not None:
+            entry.used = True
+            if not entry.reason:
+                res.findings.append(
+                    Finding(
+                        "M3L000",
+                        f.path,
+                        f.line,
+                        f"baseline entry for {f.code} has no reason",
+                        checker="suppression-rationale",
+                    )
+                )
+            else:
+                res.baselined.append((f, entry.reason))
+            continue
+        res.findings.append(f)
+    # a suppression that matches nothing is stale: the flagged code was
+    # fixed or moved, and the leftover comment would silently mask the
+    # NEXT real finding of that code at the same spot
+    for ctx in contexts:
+        for sup in ctx.suppressions:
+            if not sup.used:
+                res.findings.append(
+                    Finding(
+                        "M3L000",
+                        ctx.rel,
+                        sup.line,
+                        f"unused suppression of {', '.join(sup.codes)}: "
+                        "no finding matches — delete the stale comment",
+                        checker="suppression-rationale",
+                    )
+                )
+    for entry in baseline or []:
+        if not entry.used:
+            res.findings.append(
+                Finding(
+                    "M3L000",
+                    entry.path,
+                    0,
+                    f"unused baseline entry for {entry.code}: no finding "
+                    "matches — delete the stale entry",
+                    checker="suppression-rationale",
+                )
+            )
+    res.findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return res
+
+
+def lint_paths(paths, repo_root: str | None = None, baseline_path: str | None = None) -> Result:
+    # import for side effect: checker registration
+    from . import checkers as _checkers  # noqa: F401
+
+    repo_root = repo_root or REPO_ROOT
+    contexts, errors = load_files(paths, repo_root)
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None else DEFAULT_BASELINE
+    )
+    res = lint_contexts(contexts, baseline=baseline)
+    res.errors.extend(errors)
+    return res
+
+
+def lint_source(source: str, rel: str = "synthetic/mod.py", extra: dict | None = None) -> list:
+    """Lint one in-memory module (plus optional named companions) and
+    return raw findings — the unit-test seam for individual checkers."""
+    from . import checkers as _checkers  # noqa: F401
+
+    contexts = [FileContext(rel, source)]
+    for other_rel, other_src in (extra or {}).items():
+        contexts.append(FileContext(other_rel, other_src))
+    return lint_contexts(contexts).findings

@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.m3lint [paths...] [--format text|json]``.
+
+Exits 0 when every finding is suppressed (inline with rationale) or
+baselined (tools/m3lint/baseline.json with reason); nonzero otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import CHECKERS, DEFAULT_BASELINE, lint_paths
+from . import checkers as _checkers  # noqa: F401 — registers checkers
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="m3lint", description=__doc__)
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["m3_tpu", "tools"],
+        help="scan roots, relative to the repo root (default: m3_tpu tools)",
+    )
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline suppression file (JSON list of "
+        '{"code","path","contains","reason"})',
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report findings the baseline would suppress",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print inline-suppressed and baselined findings",
+    )
+    p.add_argument("--list-checkers", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checkers:
+        for cls in CHECKERS:
+            print(f"{cls.code}  {cls.name}")
+        return 0
+    res = lint_paths(
+        args.paths or ["m3_tpu", "tools"],
+        baseline_path="" if args.no_baseline else args.baseline,
+    )
+    if args.format == "json":
+        print(json.dumps(res.to_dict(), indent=2))
+    else:
+        for f in res.findings:
+            print(f.render())
+        for err in res.errors:
+            print(f"PARSE ERROR: {err}")
+        if args.show_suppressed:
+            for f, why in res.suppressed:
+                print(f"suppressed: {f.render()}  [{why}]")
+            for f, why in res.baselined:
+                print(f"baselined:  {f.render()}  [{why}]")
+        print(
+            f"m3lint: {res.files_scanned} files, "
+            f"{len(res.findings)} finding(s), "
+            f"{len(res.suppressed)} suppressed, "
+            f"{len(res.baselined)} baselined"
+        )
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""Cross-file project model for m3lint: the wire registries, every RPC
+dispatch table, every client-side literal op, and every exception class —
+the shared substrate for the wire-registry-consistency checker (M3L003)
+and for tests/test_wire_registry.py's generated sync assertions.
+
+The model is AST-derived (never imports the code under analysis), so it
+works on broken trees and inside the lint gate without jax present.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# registry names read out of net/wire.py
+REGISTRY_NAMES = ("IDEMPOTENT_OPS", "UNTRACED_OPS", "RETRYABLE_ETYPES")
+
+# Ops that MUTATE server state: transparently retrying one re-applies it,
+# so none of these may ever appear in wire.IDEMPOTENT_OPS. Grown by
+# exact name or prefix as new mutating surfaces are added — an op the
+# model can't classify at all is ALSO a finding (the Engler "belief"
+# forcing every new op to declare its retry semantics).
+MUTATING_OP_EXACT = frozenset(
+    {
+        "kv_cas",
+        "kv_delete",
+        "kv_lease_acquire",
+        "kv_lease_keepalive",
+        "kv_lease_release",
+        "kv_lease_expire",
+        "raft_configure",
+        "lg_start",
+    }
+)
+MUTATING_OP_PREFIXES = ("write", "kv_set")
+
+
+def is_mutating_op(op: str) -> bool:
+    return op in MUTATING_OP_EXACT or op.startswith(MUTATING_OP_PREFIXES)
+
+
+@dataclass
+class RegistrySet:
+    ops: frozenset
+    line: int = 0  # line of the assignment in net/wire.py
+    entry_lines: dict = field(default_factory=dict)  # op -> line
+
+
+class ProjectModel:
+    """Built once per lint run from every scanned FileContext."""
+
+    def __init__(self, contexts) -> None:
+        self.contexts = list(contexts)
+        self.wire_rel: str | None = None
+        # name -> RegistrySet for the three wire registries
+        self.registries: dict = {}
+        # op -> [(rel, line)] for every server-side dispatch site:
+        # op_<name> methods and `op == "<name>"` compares, both only in
+        # classes that define a `handle(self, req)` RPC entry point
+        self.dispatched: dict = {}
+        # op -> [(rel, line)] for every `<expr>._call("<op>", ...)` site
+        self.client_calls: dict = {}
+        # every class name defined anywhere in the scan roots (for
+        # RETRYABLE_ETYPES resolution)
+        self.classes: dict = {}
+        for ctx in self.contexts:
+            self._scan(ctx)
+
+    # -- scanning --
+
+    def _scan(self, ctx) -> None:
+        if ctx.rel.endswith("net/wire.py"):
+            self.wire_rel = ctx.rel
+            self._scan_wire(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes.setdefault(node.name, (ctx.rel, node.lineno))
+                if self._is_rpc_service(node):
+                    self._scan_service(ctx, node)
+            elif isinstance(node, ast.Call):
+                op = self._literal_call_op(node)
+                if op is not None:
+                    self.client_calls.setdefault(op, []).append(
+                        (ctx.rel, node.lineno)
+                    )
+
+    def _scan_wire(self, ctx) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id in REGISTRY_NAMES
+                ):
+                    ops, entry_lines = _frozenset_literal(node.value)
+                    self.registries[target.id] = RegistrySet(
+                        frozenset(ops), node.lineno, entry_lines
+                    )
+
+    @staticmethod
+    def _is_rpc_service(cls: ast.ClassDef) -> bool:
+        """An RPC dispatch table: a class with a ``handle(self, req)``
+        method (every wire-facing service in this codebase — NodeService,
+        KVService, RaftKVService, DebugService, RpcMiddleware, the
+        loadgen agent — shares that entry-point shape)."""
+        for item in cls.body:
+            if (
+                isinstance(item, ast.FunctionDef)
+                and item.name == "handle"
+                and len(item.args.args) >= 2
+                and item.args.args[1].arg == "req"
+            ):
+                return True
+        return False
+
+    def _scan_service(self, ctx, cls: ast.ClassDef) -> None:
+        for item in cls.body:
+            if not isinstance(item, ast.FunctionDef):
+                continue
+            if item.name.startswith("op_"):
+                self.dispatched.setdefault(item.name[3:], []).append(
+                    (ctx.rel, item.lineno)
+                )
+            # string-compare dispatch (`if op == "health": ...`) used by
+            # DebugService / the middleware's universal `metrics` op
+            for node in ast.walk(item):
+                if not isinstance(node, ast.Compare):
+                    continue
+                if len(node.ops) != 1 or not isinstance(
+                    node.ops[0], (ast.Eq, ast.In)
+                ):
+                    continue
+                sides = [node.left] + list(node.comparators)
+                if not any(
+                    isinstance(s, ast.Name) and s.id == "op" for s in sides
+                ):
+                    continue
+                for s in sides:
+                    for lit in _string_literals(s):
+                        self.dispatched.setdefault(lit, []).append(
+                            (ctx.rel, node.lineno)
+                        )
+
+    @staticmethod
+    def _literal_call_op(node: ast.Call):
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_call"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return node.args[0].value
+        return None
+
+    # -- convenience views --
+
+    def registry(self, name: str) -> RegistrySet:
+        return self.registries.get(name, RegistrySet(frozenset()))
+
+    @property
+    def has_wire(self) -> bool:
+        return bool(self.registries)
+
+
+def _frozenset_literal(node: ast.expr):
+    """Extract string elements (and their lines) from
+    ``frozenset({...})`` / ``frozenset((...))`` / a bare set literal."""
+    ops: list = []
+    lines: dict = {}
+    inner = node
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "frozenset"
+        and node.args
+    ):
+        inner = node.args[0]
+    if isinstance(inner, (ast.Set, ast.Tuple, ast.List)):
+        for elt in inner.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                ops.append(elt.value)
+                lines[elt.value] = elt.lineno
+    return ops, lines
+
+
+def _string_literals(node: ast.expr):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.Set, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value
