@@ -6,7 +6,7 @@ reductions). Baseline for vs_baseline is the north-star target of 10B
 datapoints/sec/chip (BASELINE.json); the reference itself publishes no
 comparable hard number.
 
-Prints FOUR JSON lines:
+Prints FOUR JSON lines (FIVE with BENCH_SELFMON=1):
   1. {"metric": "m3tsz_decode_aggregate_datapoints_per_sec_per_chip", ...}
      — the raw kernel scan-and-aggregate number.
   2. {"metric": "m3tsz_decode_aggregate_warm_cache_datapoints_per_sec_per_chip",
@@ -22,6 +22,9 @@ Prints FOUR JSON lines:
      m3tpu_* metrics (query latency histogram summary, per-stage latency,
      decoded bytes, jit compile count/seconds per kernel) so BENCH_*.json
      rounds can attribute a regression to the layer that actually moved.
+  5. (BENCH_SELFMON=1 only) {"metric": "selfmon_overhead", ...} — what the
+     self-scrape collector cost while the phases ran (m3_tpu/selfmon/):
+     scrapes, datapoints written, scrape errors, sampled kernel dispatches.
 """
 
 from __future__ import annotations
@@ -35,6 +38,14 @@ NORTH_STAR = 10e9  # datapoints/sec/chip
 
 
 def main() -> None:
+    # BENCH_SELFMON=1: run the self-monitoring pipeline DURING the bench —
+    # the collector stores this process's registry into a local reserved
+    # namespace every BENCH_SELFMON_INTERVAL (default 10s) while the
+    # phases run, and a sampled KernelProfiler is enabled via
+    # M3_TPU_PROFILE_SAMPLE_RATE — so the PROFILE.md self-scrape overhead
+    # row (acceptance: decode-aggregate dp/s regresses < 2%) is one
+    # env-var A/B away
+    selfmon = maybe_start_selfmon()
     # the storage warm-cache phase is independent of the device kernel
     # phase: a kernel-phase failure (e.g. a jax version without the APIs
     # the Pallas path needs) must not cost the warm-cache metric line
@@ -53,6 +64,65 @@ def main() -> None:
     except Exception as exc:
         print(f"WARN resident bench phase failed: {exc}", file=sys.stderr)
     metrics_snapshot_line()
+    if selfmon is not None:
+        selfmon_overhead_line(selfmon)
+
+
+def maybe_start_selfmon():
+    if os.environ.get("BENCH_SELFMON", "0") != "1":
+        return None
+    import tempfile
+
+    from m3_tpu.selfmon import RESERVED_NS, DatabaseSink, SelfMonCollector
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(
+        tempfile.mkdtemp(prefix="m3tpu-bench-selfmon-"), num_shards=1
+    )
+    db.create_namespace(RESERVED_NS, NamespaceOptions())
+    db.bootstrap()
+    interval = float(os.environ.get("BENCH_SELFMON_INTERVAL", "10"))
+    return SelfMonCollector(
+        DatabaseSink(db, RESERVED_NS), interval=interval,
+        instance="bench", component="bench",
+    ).start()
+
+
+def selfmon_overhead_line(selfmon) -> None:
+    """Fifth JSON line (BENCH_SELFMON=1): what the self-scrape cost."""
+    selfmon.stop()
+    selfmon.scrape_once()  # short runs still report a real tick
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    snap = METRICS.collect()
+
+    def total(name):
+        fam = snap.get(name)
+        return sum(c["value"] for c in fam["children"]) if fam else 0.0
+
+    scrapes = total("m3tpu_selfmon_scrapes_total")
+    dps = total("m3tpu_selfmon_datapoints_total")
+    print(
+        json.dumps(
+            {
+                "metric": "selfmon_overhead",
+                "interval_secs": selfmon.interval,
+                "scrapes": scrapes,
+                "datapoints_written": dps,
+                "datapoints_per_scrape": round(dps / scrapes, 1) if scrapes else 0.0,
+                "scrape_errors": total("m3tpu_selfmon_scrape_errors_total"),
+                "profile_sample_rate": os.environ.get(
+                    "M3_TPU_PROFILE_SAMPLE_RATE", "0"
+                ),
+                "kernel_dispatches_sampled": sum(
+                    c["count"]
+                    for c in snap.get(
+                        "m3tpu_kernel_dispatch_seconds", {}
+                    ).get("children", ())
+                ),
+            }
+        )
+    )
 
 
 def kernel_phase() -> None:

@@ -67,6 +67,14 @@ class SessionDatabase:
         with self._lock:
             return self._placement is not None
 
+    def remote_nodes(self) -> dict:
+        """Snapshot of the placement-routed node stubs (instance id →
+        RemoteNode) — the coordinator's self-scrape collector pulls each
+        peer's registry over the universal ``metrics`` RPC op from here,
+        so the scrape set tracks placement changes live."""
+        with self._lock:
+            return dict(self._nodes)
+
     def _on_placement(self, p: Placement) -> None:
         from ..net.client import RemoteNode
 

@@ -232,12 +232,27 @@ class RemoteNode(RpcClient):
     def health(self) -> dict:
         return self._call("health")
 
+    @staticmethod
+    def _selfmon_args(ns) -> dict:
+        """Reserved-namespace writes carry the wire `selfmon` marker: the
+        server re-establishes the collector's writer context around
+        dispatch (a thread-local cannot cross the socket — and the
+        session's host-queue flusher threads aren't even the collector's
+        thread client-side). Only the self-scrape pipeline addresses these
+        namespaces; in-process accidental paths (downsampler output,
+        remote-write relabels) hit the bare Database surface and raise."""
+        from ..selfmon.guard import is_reserved
+
+        return {"selfmon": True} if is_reserved(ns) else {}
+
     def write(self, ns, sid, t, v, unit=Unit.SECOND):
-        return self._call("write", ns=ns, sid=sid, t=t, v=v, unit=int(unit))
+        return self._call("write", ns=ns, sid=sid, t=t, v=v, unit=int(unit),
+                          **self._selfmon_args(ns))
 
     def write_batch(self, ns, entries):
         return self._call(
-            "write_batch", ns=ns, entries=[list(e) for e in entries]
+            "write_batch", ns=ns, entries=[list(e) for e in entries],
+            **self._selfmon_args(ns),
         )
 
     def write_tagged(self, ns, tags, t, v, unit=Unit.SECOND):
@@ -248,6 +263,7 @@ class RemoteNode(RpcClient):
             t=t,
             v=v,
             unit=int(unit),
+            **self._selfmon_args(ns),
         )
 
     def write_tagged_batch(self, ns, entries):
@@ -259,6 +275,7 @@ class RemoteNode(RpcClient):
                 [[[n, v2] for n, v2 in tags], t, v, int(unit)]
                 for tags, t, v, unit in entries
             ],
+            **self._selfmon_args(ns),
         )
 
     def read(self, ns, sid, start, end):
@@ -339,6 +356,12 @@ class RemoteNode(RpcClient):
         """Prometheus text exposition of the remote process (the universal
         scrape op every RpcServer answers via the middleware)."""
         return self._call("metrics")
+
+    def metrics_snapshot(self) -> dict:
+        """Structured Registry.collect() snapshot of the remote process —
+        what the self-scrape collector converts into stored series (same
+        universal op, fmt="json")."""
+        return self._call("metrics", fmt="json")
 
     def traces(self, limit: int = 256) -> list[dict]:
         """The remote process's recent spans — merge with other processes'

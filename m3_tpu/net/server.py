@@ -114,6 +114,11 @@ class RpcMiddleware:
         ctx = wire.extract_trace(req)
         deadline = wire.extract_deadline(req)
         if op == "metrics" and not hasattr(self.service, "op_metrics"):
+            # fmt="json" serves the structured Registry.collect() snapshot
+            # (what the self-scrape collector pulls); default stays the
+            # Prometheus text exposition for scrapers
+            if req.get("fmt") == "json":
+                return METRICS.collect()
             return METRICS.expose()
         requests, errors, inflight, hist = self._handles(op)
         requests.inc()
@@ -135,10 +140,15 @@ class RpcMiddleware:
                     f"overloaded: {self.max_inflight} requests in flight, "
                     f"shedding {op!r}"
                 )
+        trace_hex = None
         if ctx is not None and op not in wire.UNTRACED_OPS:
             span = TRACER.span_from_context(
                 f"rpc.server.{op}", ctx, component=self.component
             )
+            if ctx.get("sampled", True):
+                # exemplar for the latency histogram: a slow bucket links
+                # to the stitched trace this request belongs to
+                trace_hex = f"{int(ctx['trace_id']):016x}"
         else:
             span = NOOP_SPAN
         inflight.add(1)
@@ -158,7 +168,7 @@ class RpcMiddleware:
             errors.inc()
             raise
         finally:
-            hist.observe(time.perf_counter() - t0)
+            hist.observe(time.perf_counter() - t0, trace_id=trace_hex)
             inflight.add(-1)
             if tracked:
                 with self._load_lock:
@@ -203,30 +213,49 @@ class NodeService:
     def op_health(self, req):
         return {"id": self.node_id, "bootstrapped": self.db.bootstrapped}
 
+    # write ops honor the wire `selfmon` marker: the coordinator's
+    # self-scrape collector writes the reserved `_m3tpu` namespace through
+    # the normal cluster write plane, and its thread-local writer context
+    # cannot cross the socket — the marker re-establishes it around
+    # dispatch (selfmon/guard.py invariant 1); unmarked reserved-namespace
+    # writes still raise inside storage.Database
+
     def op_write(self, req):
-        self.db.write(
-            req["ns"], req["sid"], req["t"], req["v"], Unit(req.get("unit", 1))
-        )
+        from ..selfmon.guard import wire_writer
+
+        with wire_writer(req.get("selfmon")):
+            self.db.write(
+                req["ns"], req["sid"], req["t"], req["v"], Unit(req.get("unit", 1))
+            )
         return True
 
     def op_write_batch(self, req):
-        self.db.write_batch(req["ns"], [tuple(e) for e in req["entries"]])
+        from ..selfmon.guard import wire_writer
+
+        with wire_writer(req.get("selfmon")):
+            self.db.write_batch(req["ns"], [tuple(e) for e in req["entries"]])
         return True
 
     def op_write_tagged(self, req):
+        from ..selfmon.guard import wire_writer
+
         tags = tuple((n, v) for n, v in req["tags"])
-        return self.db.write_tagged(
-            req["ns"], tags, req["t"], req["v"], Unit(req.get("unit", 1))
-        )
+        with wire_writer(req.get("selfmon")):
+            return self.db.write_tagged(
+                req["ns"], tags, req["t"], req["v"], Unit(req.get("unit", 1))
+            )
 
     def op_write_tagged_batch(self, req):
         """One RPC per host-queue flush (host_queue.go role); per-entry
         errors ride back so the session counts quorum per datapoint."""
+        from ..selfmon.guard import wire_writer
+
         entries = [
             (tuple((n, v) for n, v in tags), t, val, unit)
             for tags, t, val, unit in req["entries"]
         ]
-        return self.db.write_tagged_batch(req["ns"], entries)
+        with wire_writer(req.get("selfmon")):
+            return self.db.write_tagged_batch(req["ns"], entries)
 
     def op_fetch(self, req):
         dps = self.db.read(req["ns"], req["sid"], req["start"], req["end"])
@@ -280,7 +309,11 @@ class NodeService:
         return [[sid, bs, wire.dps_to_wire(dps)] for sid, bs, dps in out]
 
     def op_metrics(self, req):
-        """Self-observability exposition (x/instrument); Prometheus text."""
+        """Self-observability exposition (x/instrument): Prometheus text,
+        or the structured Registry.collect() snapshot with fmt="json" (the
+        form the self-scrape collector ingests)."""
+        if req.get("fmt") == "json":
+            return METRICS.collect()
         return METRICS.expose()
 
     def op_traces(self, req):

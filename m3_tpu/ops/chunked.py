@@ -29,12 +29,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codec.m3tsz import DEFAULT_INT_OPTIMIZATION, ReaderIterator, initial_time_unit
+from ..utils.instrument import KernelProfiler
 from ..utils.xtime import Unit
 from . import u64
 from .decode import DecodeResult, DecodeState, _decode_timestamp, _decode_value, _int_val_to_f32
 
 I32 = jnp.int32
 U32 = jnp.uint32
+
+# device-tier observability for the chunked decode kernel: first-call
+# compile attribution + sampled block_until_ready-bounded dispatch wall
+# time (M3_TPU_PROFILE_SAMPLE_RATE) in m3tpu_kernel_dispatch_seconds
+# {kernel="chunked_decode"}; eager callers (parallel/scan.py) dispatch
+# through this — inside an outer jit trace they must not (wall time there
+# measures tracing, and blocking on tracers is impossible)
+PROFILER = KernelProfiler("chunked_decode")
 
 # Decoder-state fields stored as (hi, lo) uint32 pairs.
 STATE_PAIR_FIELDS = ("prev_time", "prev_delta", "prev_float_bits", "prev_xor", "int_val")

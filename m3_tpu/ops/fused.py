@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.instrument import KernelProfiler
 from . import u64
 from .chunked import _fetch4_select, _window_columns
 from .decode import (
@@ -45,6 +46,13 @@ from .decode import (
 I32 = jnp.int32
 U32 = jnp.uint32
 F32 = jnp.float32
+
+# device-tier observability for the fused lane-aggregate kernels (see
+# ops/chunked.PROFILER): the dispatch key carries the backend
+# (pallas/jnp), so compile attribution separates the Mosaic kernel from
+# the lax.scan fallback while one kernel label covers the path
+PROFILER_FUSED = KernelProfiler("fused_lane_agg")
+PROFILER_PACKED = KernelProfiler("packed_lane_agg")
 
 LANE_TILE = (8, 128)  # native f32/i32 VPU tile
 TILE_LANES = LANE_TILE[0] * LANE_TILE[1]

@@ -28,8 +28,9 @@ except ImportError:  # older jax: experimental namespace, check_rep knob
     _SHARD_MAP_CHECK_KW = "check_rep"
 
 from ..ops.chunked import ChunkedBatch, decode_chunked_lanes
+from ..ops.chunked import PROFILER as CHUNKED_PROF
 from ..ops.decode import decode_batched
-from ..utils.instrument import JitTracker
+from ..utils.instrument import KernelProfiler
 from .mesh import SHARD_AXIS, series_mesh
 
 
@@ -39,10 +40,11 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
     kw = {_SHARD_MAP_CHECK_KW: check_vma}
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
-# jit compile observability for the batched decode kernel
-# (m3tpu_jit_compiles_total{kernel="m3tsz_decode"}): the first call per
-# (shape, max_points) signature blocks on XLA compilation
-_JIT_DECODE = JitTracker("m3tsz_decode")
+# device-tier observability for the batched decode kernel: first-call
+# compile attribution (m3tpu_jit_compiles_total{kernel="m3tsz_decode"})
+# plus sampled block_until_ready-bounded dispatch wall time under
+# M3_TPU_PROFILE_SAMPLE_RATE (m3tpu_kernel_dispatch_seconds)
+_JIT_DECODE = KernelProfiler("m3tsz_decode")
 
 
 class ScanAggregates(NamedTuple):
@@ -116,11 +118,12 @@ def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psu
         res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
     else:
         # eager call: the first invocation per signature blocks on the jit
-        # compile of decode_batched, which is exactly what the tracker records
-        with _JIT_DECODE.track((tuple(words.shape), int(max_points))):
-            res = decode_batched(
+        # compile of decode_batched (tracked), and sampled dispatches are
+        # block_until_ready-bounded for the dispatch histogram
+        with _JIT_DECODE.dispatch((tuple(words.shape), int(max_points))) as d:
+            res = d.done(decode_batched(
                 words, num_bits, initial_unit, max_points=max_points
-            )
+            ))
     return _aggregate_decoded(res.values_f32, res.valid, with_psum)
 
 
@@ -134,7 +137,13 @@ def scan_aggregate(words, num_bits, initial_unit, max_points: int) -> ScanAggreg
 def chunked_scan_aggregate(lane_args: dict, s: int, c: int, k: int, with_psum=False):
     """Flagship fast path: side-table chunked decode (ops/chunked.py) +
     aggregation. ``lane_args`` are ChunkedBatch fields as (device) arrays."""
-    res = decode_chunked_lanes(**lane_args, k=k)
+    if _is_tracing(lane_args["windows"]):
+        res = decode_chunked_lanes(**lane_args, k=k)
+    else:
+        with CHUNKED_PROF.dispatch(
+            (tuple(lane_args["windows"].shape), int(k))
+        ) as d:
+            res = d.done(decode_chunked_lanes(**lane_args, k=k))
     vals = res.values_f32.reshape(s, c * k)
     valid = res.valid.reshape(s, c * k)
     return _aggregate_decoded(vals, valid, with_psum)
@@ -301,7 +310,13 @@ def chunked_scan_aggregate_fused(
         # the lax.scan fallback rather than attempting a pltpu lowering.
         backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
     fn = fused.lane_aggregates_pallas if backend == "pallas" else fused.lane_aggregates_jnp
-    lane_agg = fn(**lane_args, k=k)
+    if _is_tracing(lane_args["windows"]):
+        lane_agg = fn(**lane_args, k=k)
+    else:
+        with fused.PROFILER_FUSED.dispatch(
+            (backend, tuple(lane_args["windows"].shape), int(k))
+        ) as d:
+            lane_agg = d.done(fn(**lane_args, k=k))
     return _aggregates_from_lanes(lane_agg, s, c, with_psum)
 
 
@@ -318,9 +333,17 @@ def chunked_scan_aggregate_packed(
     permuted lanes back to series order."""
     from ..ops import fused
 
-    lane_agg = fused.lane_aggregates_packed(
-        windows4, lanes4, tile_flags, n=n, k=k, interpret=interpret
-    )
+    if _is_tracing(windows4):
+        lane_agg = fused.lane_aggregates_packed(
+            windows4, lanes4, tile_flags, n=n, k=k, interpret=interpret
+        )
+    else:
+        with fused.PROFILER_PACKED.dispatch(
+            (tuple(windows4.shape), int(n), int(k))
+        ) as d:
+            lane_agg = d.done(fused.lane_aggregates_packed(
+                windows4, lanes4, tile_flags, n=n, k=k, interpret=interpret
+            ))
     return _aggregates_from_lanes(
         lane_agg, s, c, with_psum, lane_order=lane_order, inv=inv,
         precise=precise, unpermute_series=unpermute_series,
@@ -417,7 +440,7 @@ def sharded_scan_aggregate(
 # feeds the same decode kernel — zero block bytes cross PCIe, and series
 # selection is the page-row gather instead of a host select/pack.
 
-_JIT_RESIDENT = JitTracker("resident_gather_decode")
+_JIT_RESIDENT = KernelProfiler("resident_gather_decode")
 
 
 def gather_lane_words(pool_words, page_rows):
@@ -441,10 +464,10 @@ def resident_scan_aggregate(
     if _is_tracing(words):
         res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
     else:
-        with _JIT_RESIDENT.track((tuple(words.shape), int(max_points))):
-            res = decode_batched(
+        with _JIT_RESIDENT.dispatch((tuple(words.shape), int(max_points))) as d:
+            res = d.done(decode_batched(
                 words, num_bits, initial_unit, max_points=max_points
-            )
+            ))
     aggs = _aggregate_decoded(res.values_f32, res.valid, with_psum)
     return aggs._replace(series_err=res.err)
 
@@ -457,10 +480,10 @@ def scan_aggregate_with_err(
     if _is_tracing(words):
         res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
     else:
-        with _JIT_DECODE.track((tuple(words.shape), int(max_points))):
-            res = decode_batched(
+        with _JIT_DECODE.dispatch((tuple(words.shape), int(max_points))) as d:
+            res = d.done(decode_batched(
                 words, num_bits, initial_unit, max_points=max_points
-            )
+            ))
     aggs = _aggregate_decoded(res.values_f32, res.valid, False)
     return aggs._replace(series_err=res.err)
 

@@ -144,6 +144,40 @@ class Engine:
     def query_instant(self, query: str, time_nanos: int) -> Result:
         return self.query_range(query, time_nanos, time_nanos, NANOS)
 
+    def explain(
+        self, query: str, start_nanos: int, end_nanos: int, step_nanos: int
+    ) -> dict:
+        """EXPLAIN: evaluate the query while recording where its time and
+        data went — the full per-stage timing record (parse /
+        index_resolve / fetch / decode / exec), scan counters, and the
+        resident-vs-streamed routing decision PER (series, block) from the
+        storage adapter (why a block streamed: buffered overlay, evicted
+        page, pool off). Returns the sealed stats record plus a result
+        summary; the record also lands in the slow-query ring and metrics
+        like any query, prefixed ``EXPLAIN`` so dashboards can exclude it.
+        """
+        from . import stats
+
+        st = stats.start(f"EXPLAIN {query}")
+        if st is not None:
+            st.record_routing = True
+        t_start = time.perf_counter()
+        err: str | None = None
+        try:
+            r = self.query_range(query, start_nanos, end_nanos, step_nanos)
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            if st is not None:
+                stats.finish(st, time.perf_counter() - t_start, error=err)
+        out = st.to_dict() if st is not None else {"query": query}
+        out["result"] = {
+            "series": len(r.metas),
+            "steps": int(np.asarray(r.values).shape[1]) if len(r.metas) else 0,
+        }
+        return out
+
     def scan_totals(self, query: str, start_nanos: int, end_nanos: int) -> dict:
         """Flagship raw-sample scan as an engine surface: ``query`` must
         be a plain vector selector (e.g. ``metric{job="x"}``) — the

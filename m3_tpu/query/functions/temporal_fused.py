@@ -24,13 +24,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...utils.instrument import DEFAULT as METRICS
-from ...utils.instrument import JitTracker
+from ...utils.instrument import KernelProfiler
 from . import temporal as T
 
-# jit compile observability (m3tpu_jit_compiles_total{kernel="temporal_fused"}):
-# first call per static signature blocks on Mosaic compilation — BENCH rounds
-# separate that warmup from steady-state throughput
-_JIT = JitTracker("temporal_fused")
+# compile observability (m3tpu_jit_compiles_total{kernel="temporal_fused"}:
+# first call per static signature blocks on Mosaic compilation — BENCH
+# rounds separate that warmup from steady-state throughput) plus sampled
+# block_until_ready-bounded dispatch timings under
+# M3_TPU_PROFILE_SAMPLE_RATE (m3tpu_kernel_dispatch_seconds)
+_JIT = KernelProfiler("temporal_fused")
 _M_PROCESSED = METRICS.counter(
     "temporal_fused_input_bytes_total",
     "bytes of range-vector input through the fused temporal kernel",
@@ -106,8 +108,12 @@ def fused_temporal(values, window: int, step_seconds: float, funcs: tuple[str, .
     if pad:
         v = jnp.pad(v, ((0, pad), (0, 0)), constant_values=jnp.nan)
     _M_PROCESSED.inc(int(v.size) * 4)
-    with _JIT.track((tuple(funcs), v.shape, int(window), float(step_seconds))):
-        outs = _fused_call(v, tuple(funcs), int(window), float(step_seconds), t)
+    with _JIT.dispatch(
+        (tuple(funcs), v.shape, int(window), float(step_seconds))
+    ) as d:
+        outs = d.done(
+            _fused_call(v, tuple(funcs), int(window), float(step_seconds), t)
+        )
     if not isinstance(outs, (list, tuple)):
         outs = (outs,)
     if pad:

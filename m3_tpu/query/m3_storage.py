@@ -75,6 +75,10 @@ class M3Storage:
         before = cache.stats() if cache is not None else None
         pool = getattr(self.db, "resident_pool", None)
         rows = None
+        if pool is None or not pool.enabled:
+            stats.add_routing(b"*", None, "streamed", "resident pool disabled")
+        elif len(pool) == 0:
+            stats.add_routing(b"*", None, "streamed", "resident pool empty")
         if pool is not None and pool.enabled and len(pool) > 0:
             docs = self.db.query_ids(
                 self.namespace, q, start_nanos, end_nanos
@@ -132,6 +136,8 @@ class M3Storage:
         complete-admitted with the series absent, and no buffered data
         overlaps the range. ``docs`` come from the caller's single
         query_ids resolution (shared with the fallback path)."""
+        from . import stats
+
         pool = getattr(self.db, "resident_pool", None)
         if pool is None or not pool.enabled:
             return None
@@ -141,6 +147,11 @@ class M3Storage:
             shard = ns.shard_for(doc.id)
             keys, buffered = shard.scan_block_keys(doc.id, start_nanos, end_nanos)
             if buffered:
+                # EXPLAIN routing: record the decision that forced the
+                # whole query onto the streamed path (entries recorded so
+                # far would be misleading half-truths — only the cause and
+                # the final outcome are reported)
+                stats.add_routing(doc.id, None, "streamed", "buffered-overlay")
                 return None
             doc_keys = []
             for key in keys:
@@ -151,8 +162,15 @@ class M3Storage:
                 ):
                     continue  # fileset fully admitted: series absent from it
                 else:
+                    stats.add_routing(
+                        doc.id, key.block_start, "streamed",
+                        "not-resident (evicted or never admitted)",
+                    )
                     return None  # evicted / never admitted: stream instead
             plan.append((doc, doc_keys))
+        for doc, doc_keys in plan:
+            for key in doc_keys:
+                stats.add_routing(doc.id, key.block_start, "resident", "")
         return plan
 
     def _fetch_resident(self, docs, start_nanos, end_nanos):

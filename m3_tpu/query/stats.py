@@ -59,12 +59,20 @@ class QueryStats:
     resident_misses: int = 0
     trace_id: str | None = None  # links the record to its /debug/traces tree
     error: str | None = None
+    # EXPLAIN support: when record_routing is on (Engine.explain sets it),
+    # the storage adapter appends one entry per (series, block) routing
+    # decision — {"series", "block", "path", "reason"} with path
+    # "resident"|"streamed". Bounded by ROUTING_CAP; overflow is counted,
+    # never silent.
+    record_routing: bool = False
+    routing: list = field(default_factory=list)
+    routing_dropped: int = 0
 
     def add_stage(self, name: str, secs: float) -> None:
         self.stages[name] = self.stages.get(name, 0.0) + secs
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "query": self.query,
             "startUnixNanos": self.start_unix_nanos,
             "durationSecs": self.duration_secs,
@@ -79,6 +87,38 @@ class QueryStats:
             "traceId": self.trace_id,
             "error": self.error,
         }
+        if self.record_routing:
+            out["routing"] = list(self.routing)
+            out["routingDropped"] = self.routing_dropped
+        return out
+
+
+# routing entries per EXPLAIN record: enough to show every block of a
+# real dashboard query, small enough that a 10M-series selector can't
+# balloon the record (the drop count says how much is missing)
+ROUTING_CAP = 256
+
+
+def add_routing(series_id, block_start, path: str, reason: str = "") -> None:
+    """Record one resident-vs-streamed routing decision against this
+    thread's active EXPLAIN record (no-op for normal queries — one
+    attribute check — so the storage adapter calls it unconditionally)."""
+    st = current()
+    if st is None or not st.record_routing:
+        return
+    if len(st.routing) >= ROUTING_CAP:
+        st.routing_dropped += 1
+        return
+    if isinstance(series_id, bytes):
+        series_id = series_id.decode("utf-8", "replace")
+    st.routing.append(
+        {
+            "series": series_id,
+            "block": block_start,
+            "path": path,
+            "reason": reason,
+        }
+    )
 
 
 _local = threading.local()
@@ -117,16 +157,19 @@ def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> No
     METRICS.counter("query_total", "completed queries").inc()
     if error is not None:
         METRICS.counter("query_errors_total", "failed queries").inc()
+    # the trace id rides as an exemplar: a slow query_duration_seconds
+    # bucket links to its stitched tree (/debug/traces) and its
+    # /debug/slow_queries record via the shared id
     METRICS.histogram(
         "query_duration_seconds", "query wall time", buckets=_QUERY_BUCKETS
-    ).observe(duration_secs)
+    ).observe(duration_secs, trace_id=st.trace_id)
     for stage, secs in st.stages.items():
         METRICS.histogram(
             "query_stage_duration_seconds",
             "per-stage query wall time",
             labels={"stage": stage},
             buckets=_QUERY_BUCKETS,
-        ).observe(secs)
+        ).observe(secs, trace_id=st.trace_id)
     METRICS.counter("query_series_scanned_total").inc(st.series_scanned)
     METRICS.counter("query_datapoints_scanned_total").inc(st.datapoints_scanned)
     METRICS.counter("query_bytes_scanned_total").inc(st.bytes_scanned)

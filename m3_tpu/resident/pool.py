@@ -177,6 +177,12 @@ class ResidentPool:
         self._g_pages = reg.gauge("resident_pool_pages", "pages in use (excl. zero page)")
         self._g_free = reg.gauge("resident_pool_free_pages", "pages on the free list")
         self._g_entries = reg.gauge("resident_pool_entries", "page-table entries")
+        self._g_occupancy = reg.gauge(
+            "resident_pool_occupancy_ratio",
+            "pages in use / pages total — with the gauges above, the "
+            "self-scrape pipeline stores these as series, so occupancy/"
+            "admission/eviction timelines are one PromQL query",
+        )
 
     # ---------- device buffer ----------
 
@@ -581,6 +587,7 @@ class ResidentPool:
         self._g_pages.set(float(used))
         self._g_free.set(float(len(self._free)))
         self._g_entries.set(float(len(self._od)))
+        self._g_occupancy.set(used / max(self.options.num_pages - 1, 1))
 
     def stats(self) -> dict:
         with self._lock:

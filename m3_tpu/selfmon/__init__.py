@@ -1,0 +1,30 @@
+"""Self-monitoring pipeline: the fleet's own telemetry stored as
+first-class series under the reserved ``_m3tpu`` namespace, queryable by
+the existing PromQL engine (see collector.py for the full loop)."""
+
+from .collector import DatabaseSink, MsgSink, SELFMON_MARKER, SelfMonCollector
+from .convert import snapshot_to_datapoints
+from .guard import (
+    RESERVED_NS,
+    ReservedNamespaceError,
+    check_write,
+    is_reserved,
+    selfmon_writer,
+    wire_writer,
+    writer_active,
+)
+
+__all__ = [
+    "DatabaseSink",
+    "MsgSink",
+    "SELFMON_MARKER",
+    "SelfMonCollector",
+    "snapshot_to_datapoints",
+    "RESERVED_NS",
+    "ReservedNamespaceError",
+    "check_write",
+    "is_reserved",
+    "selfmon_writer",
+    "wire_writer",
+    "writer_active",
+]

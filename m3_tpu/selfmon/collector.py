@@ -1,0 +1,204 @@
+"""Self-scrape collector: the fleet's telemetry through its own write path.
+
+The M3 platform famously monitors itself; this is that loop for the
+framework. A ``SelfMonCollector`` runs in every process that opts in
+(dbnode / coordinator / aggregator service flags): on each tick it
+
+1. snapshots the process registry (``Registry.collect()`` — the lock is
+   held only for the dict copy, never across storage writes or sockets,
+   so the new periodic thread cannot invert lock order with the write
+   path it feeds);
+2. on the coordinator, additionally PULLS peers over the universal
+   ``metrics`` RPC op (``fmt="json"`` structured form) — placement-routed
+   dbnodes and any statically configured peer (e.g. an aggregator's debug
+   RPC port);
+3. converts every family to tagged datapoints (selfmon/convert.py) and
+4. writes them through the NORMAL ingest path via its sink — a
+   ``DatabaseSink`` (local Database, or the placement-routed
+   ``SessionDatabase`` → dbnode host queues), or a ``MsgSink`` (the
+   aggregator's m3msg producer → coordinator ingest, riding the same bus
+   as aggregated user metrics).
+
+Everything lands under the reserved ``_m3tpu`` namespace (selfmon/guard),
+so "what was resident-pool occupancy during yesterday's p99 spike" is one
+PromQL query over ``m3tpu_resident_pool_bytes`` — served by the existing
+query engine and ``/debug`` HTTP surface.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.instrument import DEFAULT as METRICS
+from .convert import snapshot_to_datapoints
+from .guard import RESERVED_NS, selfmon_writer
+
+# tag value marking bus-ingested self telemetry (MsgSink): the coordinator's
+# m3msg ingest strips it and routes the metric into the reserved namespace
+SELFMON_MARKER = (b"__selfmon__", b"1")
+
+
+class DatabaseSink:
+    """Writes converted datapoints through a Database-surface object
+    (``storage.Database`` or ``client.session_db.SessionDatabase``) into
+    the reserved namespace — the normal batched tagged-write path, inside
+    the selfmon writer context (guard invariant 1)."""
+
+    def __init__(self, db, namespace: str = RESERVED_NS) -> None:
+        self.db = db
+        self.namespace = namespace
+
+    def write(self, entries: list) -> int:
+        """``entries``: (tags, time_nanos, value). Returns error count."""
+        if not entries:
+            return 0
+        with selfmon_writer():
+            errs = self.db.write_tagged_batch(
+                self.namespace, [(tags, t, v, 1) for tags, t, v in entries]
+            )
+        return sum(1 for e in errs if e)
+
+
+class MsgSink:
+    """Publishes converted datapoints onto the m3msg bus as aggregated
+    metrics (the aggregator's flush transport): each entry's tags gain the
+    ``__selfmon__`` marker, and the coordinator's ingest routes marked
+    metrics into the reserved namespace. Delivery is the bus's
+    at-least-once contract (duplicate datapoint writes are storage
+    upserts)."""
+
+    def __init__(self, producer, num_shards: int, policy=None) -> None:
+        from ..metrics.policy import StoragePolicy
+
+        self.producer = producer
+        self.num_shards = num_shards
+        self.policy = policy or StoragePolicy.parse("10s:24h")
+
+    def write(self, entries: list) -> int:
+        from ..metrics.encoding import AggregatedMessage, encode_aggregated_batch
+        from ..utils.hash import shard_for
+        from ..utils.serialize import encode_tags
+
+        by_shard: dict[int, list] = {}
+        for tags, t, v in entries:
+            mid = encode_tags(tuple(tags) + (SELFMON_MARKER,))
+            by_shard.setdefault(shard_for(mid, self.num_shards), []).append(
+                AggregatedMessage(mid, t, v, self.policy)
+            )
+        for shard, msgs in by_shard.items():
+            self.producer.produce(shard, encode_aggregated_batch(msgs))
+        return 0
+
+
+class SelfMonCollector:
+    """Periodic self-scrape loop (daemon thread; ``scrape_once`` is the
+    testable seam). ``peers`` is an optional zero-arg callable returning
+    ``{instance_id: node}`` of RPC stubs exposing ``metrics_snapshot()``
+    — evaluated per tick so placement changes are picked up live."""
+
+    def __init__(
+        self,
+        sink,
+        interval: float = 10.0,
+        instance: str = "",
+        component: str = "",
+        registry=None,
+        peers=None,
+        clock=None,
+    ) -> None:
+        import time as _time
+
+        self.sink = sink
+        self.interval = float(interval)
+        self.instance = instance
+        self.component = component
+        self.registry = registry if registry is not None else METRICS
+        self.peers = peers
+        self._clock = clock or _time.time_ns
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._m_scrapes = METRICS.counter(
+            "selfmon_scrapes_total", "self-scrape ticks completed"
+        )
+        self._m_errors = METRICS.counter(
+            "selfmon_scrape_errors_total",
+            "peer pulls or sink writes that failed during a self-scrape "
+            "(a persistently growing count means the fleet's own telemetry "
+            "is going dark)",
+        )
+        self._m_datapoints = METRICS.counter(
+            "selfmon_datapoints_total", "self-telemetry datapoints written"
+        )
+        self._m_truncated = METRICS.counter(
+            "selfmon_truncated_total",
+            "datapoints dropped by the per-snapshot cardinality cap "
+            "(never silently: a nonzero value means a snapshot exceeded "
+            "convert.MAX_DATAPOINTS_PER_SNAPSHOT)",
+        )
+
+    # -- one tick (the testable unit) --
+
+    def scrape_once(self) -> tuple[int, int]:
+        """Snapshot self (+ peers), convert, write. Returns
+        (datapoints_written, errors). Never raises — the loop must outlive
+        any one bad tick, and every failure is counted."""
+        now = self._clock()
+        errors = 0
+        entries, truncated = snapshot_to_datapoints(
+            self.registry.collect(), now,
+            instance=self.instance, role=self.component,
+        )
+        if self.peers is not None:
+            try:
+                peer_map = dict(self.peers())
+            except Exception:
+                peer_map = {}
+                errors += 1
+            for pid, node in sorted(peer_map.items()):
+                try:
+                    snap = node.metrics_snapshot()
+                except Exception:
+                    # a down peer is expected fleet weather — counted, and
+                    # visible as a gap in that instance's stored series
+                    errors += 1
+                    continue
+                peer_entries, peer_trunc = snapshot_to_datapoints(
+                    snap, now, instance=pid, role="peer"
+                )
+                entries.extend(peer_entries)
+                truncated += peer_trunc
+        try:
+            sink_errors = self.sink.write(entries)
+        except Exception:
+            sink_errors = len(entries)
+        errors += sink_errors
+        # only datapoints the sink accepted count as written — during an
+        # outage the pipeline must report going dark, not full throughput
+        written = len(entries) - sink_errors
+        self._m_scrapes.inc()
+        self._m_datapoints.inc(written)
+        if truncated:
+            self._m_truncated.inc(truncated)
+        if errors:
+            self._m_errors.inc(errors)
+        return written, errors
+
+    # -- lifecycle --
+
+    def start(self) -> "SelfMonCollector":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="selfmon-collector"
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.scrape_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,94 @@
+"""Registry snapshot → tagged datapoints: the self-scrape's codec.
+
+Converts the structured output of ``utils.instrument.Registry.collect()``
+into the ``(tags, time_nanos, value)`` entries the normal tagged-write
+ingest path stores, so fleet telemetry becomes first-class series the
+PromQL engine can query:
+
+- a counter/gauge child becomes ONE series named after its family
+  (``m3tpu_rpc_requests_total``), carrying the child's labels plus the
+  scrape identity tags ``instance``/``role``;
+- a histogram child becomes the standard Prometheus series triplet:
+  ``<name>_bucket{le=...}`` per (cumulative) bucket, ``<name>_sum`` and
+  ``<name>_count`` — so ``histogram_quantile(0.99,
+  m3tpu_rpc_request_duration_seconds_bucket)`` works unmodified.
+
+Feedback-loop guard: children whose label VALUES name a reserved
+namespace (``ns="_m3tpu"`` write-path counters) are skipped — the
+collector's own storage writes never re-enter the telemetry it stores
+(selfmon/guard.py invariant 2).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..block.core import make_tags
+from .guard import RESERVED_NS
+
+# one scrape's series count is bounded by the registry (metric names and
+# label keys are m3lint-audited literals), but a misbehaving peer snapshot
+# must not be: cap datapoints per converted snapshot, loudly (the caller
+# counts truncations — no silent caps).
+MAX_DATAPOINTS_PER_SNAPSHOT = 50_000
+
+
+def format_le(bound: float) -> str:
+    """Bucket bound → ``le`` label value, matching the text exposition
+    (``repr(float)``; ``+Inf`` for the overflow bucket) so stored series
+    join against scraped ones."""
+    return "+Inf" if math.isinf(bound) else repr(float(bound))
+
+
+def snapshot_to_datapoints(
+    snapshot: dict,
+    time_nanos: int,
+    instance: str = "",
+    role: str = "",
+    skip_reserved: bool = True,
+    max_datapoints: int = MAX_DATAPOINTS_PER_SNAPSHOT,
+) -> tuple[list, int]:
+    """Convert one ``Registry.collect()`` snapshot (local or pulled over
+    the universal ``metrics`` RPC op) into tagged datapoints.
+
+    Returns ``(entries, truncated)`` where entries are
+    ``(tags, time_nanos, value)`` and ``truncated`` counts datapoints
+    dropped by the ``max_datapoints`` cap (0 in any healthy scrape).
+    """
+    out: list = []
+    truncated = 0
+    ident = {"instance": str(instance), "role": str(role)}
+
+    def emit(name: str, labels: dict, value: float) -> None:
+        nonlocal truncated
+        if len(out) >= max_datapoints:
+            truncated += 1
+            return
+        out.append(
+            (
+                make_tags({**labels, **ident, "__name__": name}),
+                time_nanos,
+                float(value),
+            )
+        )
+
+    for name, fam in snapshot.items():
+        kind = fam.get("kind")
+        for child in fam.get("children", ()):
+            labels = {str(k): str(v) for k, v in child.get("labels", {}).items()}
+            if skip_reserved and any(
+                v.startswith(RESERVED_NS) for v in labels.values()
+            ):
+                continue
+            if kind in ("counter", "gauge"):
+                emit(name, labels, child["value"])
+            elif kind == "histogram":
+                for bound, cum in child.get("buckets", ()):
+                    emit(
+                        f"{name}_bucket",
+                        {**labels, "le": format_le(bound)},
+                        cum,
+                    )
+                emit(f"{name}_sum", labels, child["sum"])
+                emit(f"{name}_count", labels, child["count"])
+    return out, truncated

@@ -1,0 +1,87 @@
+"""Reserved self-monitoring namespace rule.
+
+The self-scrape pipeline stores fleet telemetry under a RESERVED storage
+namespace (``_m3tpu``). Two invariants keep the pipeline from feeding on
+itself:
+
+1. **Only the collector writes there.** Datapoint writes into a reserved
+   namespace must come from a path that explicitly declares self-monitoring
+   intent: the collector's sink runs inside :func:`selfmon_writer`, and the
+   cluster write plane carries a ``selfmon`` marker on reserved-namespace
+   RPCs (``net/client.RemoteNode`` injects it, ``net/server.NodeService``
+   re-establishes the context around dispatch). Every OTHER ingest surface —
+   Prometheus remote write, influx, graphite/carbon, the downsampler's
+   rollup output, msg-bus ingest — reaches the bare ``storage.Database``
+   write methods, where :func:`check_write` raises. An operator relabeling
+   user metrics into ``_m3tpu`` gets a typed error, not silent pollution of
+   the fleet's own telemetry.
+
+2. **The collector never re-ingests its own write activity.** Write-path
+   counters are labeled ``{ns=...}``; the snapshot conversion
+   (``selfmon/convert.py``) skips children whose label values name a
+   reserved namespace. The self-scrape's storage writes therefore never
+   appear in the telemetry it stores — series growth stays bounded by the
+   (m3lint-bounded) registry, with no feedback term.
+
+The context is a thread-local depth counter, so nested sinks (a collector
+writing through a local Database) compose. Replication paths — peer
+bootstrap and repair — also run inside it: they MOVE telemetry a
+sanctioned writer already admitted on the source replica, which is not a
+new ingest decision.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager, nullcontext
+
+# the reserved namespace PREFIX: "_m3tpu" itself is the default namespace
+# the collector writes; any "_m3tpu*" name is covered by the rule
+RESERVED_NS = "_m3tpu"
+
+
+class ReservedNamespaceError(ValueError):
+    """A non-collector write targeted the reserved self-monitoring
+    namespace (see module docstring: only tagged collector paths may)."""
+
+
+_local = threading.local()
+
+
+def is_reserved(namespace: str) -> bool:
+    return str(namespace).startswith(RESERVED_NS)
+
+
+def writer_active() -> bool:
+    """Whether this thread is inside a selfmon writer context."""
+    return getattr(_local, "depth", 0) > 0
+
+
+@contextmanager
+def selfmon_writer():
+    """Declare self-monitoring write intent for the current thread —
+    the ONLY way through :func:`check_write` for a reserved namespace."""
+    _local.depth = getattr(_local, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _local.depth -= 1
+
+
+def wire_writer(flag) -> object:
+    """Server-side dispatch context: an RPC that carries the ``selfmon``
+    marker re-establishes the writer context in the handler thread (the
+    client's thread-local cannot cross the wire)."""
+    return selfmon_writer() if flag else nullcontext()
+
+
+def check_write(namespace: str) -> None:
+    """Runtime assertion for the reserved-namespace rule; called by the
+    ``storage.Database`` write paths on every write. Non-reserved
+    namespaces cost one string prefix check."""
+    if is_reserved(namespace) and not writer_active():
+        raise ReservedNamespaceError(
+            f"write into reserved self-monitoring namespace {namespace!r} "
+            "from a non-collector path (wrap in selfmon.guard."
+            "selfmon_writer() only if you ARE the self-scrape pipeline)"
+        )

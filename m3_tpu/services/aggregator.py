@@ -53,6 +53,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--election-scope", default="default")
     p.add_argument("--election-lease-secs", type=float, default=10.0)
     p.add_argument(
+        "--selfmon-interval",
+        type=float,
+        default=0.0,
+        help="self-scrape interval in seconds (0 disables): the "
+        "aggregator's own metrics registry rides the m3msg bus to the "
+        "coordinator (requires --msg-consumer) tagged __selfmon__, and "
+        "lands in the coordinator's reserved _m3tpu namespace — the "
+        "push-model twin of the coordinator's RPC pull (which can also "
+        "scrape this process via --debug-port + --selfmon-peer)",
+    )
+    p.add_argument(
         "--debug-port",
         type=int,
         default=-1,
@@ -149,6 +160,24 @@ def main(argv=None) -> int:
         )
         debug_server.start()
 
+    selfmon = None
+    if args.selfmon_interval > 0:
+        if producer is None:
+            print(
+                "WARN --selfmon-interval needs --msg-consumer (no bus to "
+                "push telemetry on); self-scrape disabled",
+                file=sys.stderr,
+            )
+        else:
+            from ..selfmon import MsgSink, SelfMonCollector
+
+            selfmon = SelfMonCollector(
+                MsgSink(producer, args.num_shards),
+                interval=args.selfmon_interval,
+                instance=args.instance_id,
+                component="aggregator",
+            ).start()
+
     stop = threading.Event()
     flush_errors = [0]
 
@@ -180,6 +209,8 @@ def main(argv=None) -> int:
         server.serve_forever()
     finally:
         stop.set()
+        if selfmon is not None:
+            selfmon.stop()
         agg.flush(time.time_ns() + 10**12)  # drain on shutdown
         if producer is not None:
             producer.retry_unacked()

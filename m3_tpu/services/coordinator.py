@@ -77,6 +77,92 @@ class Coordinator:
         self.kv = kv or KVStore()
         self.placement_svc = PlacementService(self.kv)
         self.topic_svc = TopicService(self.kv)
+        # per-namespace engine cache (the `namespace` query param routes
+        # PromQL to other namespaces — notably the reserved `_m3tpu`
+        # self-monitoring namespace); engines share the cost limiters
+        self._engines: dict[str, Engine] = {namespace: self.engine}
+        self._engines_lock = threading.Lock()
+        self.selfmon = None  # SelfMonCollector when start_selfmon() ran
+        self._selfmon_ns_ready = False
+
+    def engine_for(self, namespace: str | None) -> Engine:
+        if not namespace or namespace == self.namespace:
+            return self.engine
+        with self._engines_lock:
+            eng = self._engines.get(namespace)
+            if eng is not None:
+                return eng
+            eng = Engine(
+                M3Storage(self.db, namespace),
+                limits=self.engine.limits,
+                global_enforcer=self.engine.global_enforcer,
+            )
+            # cache only namespaces the store actually knows: the param
+            # comes off an unauthenticated HTTP query string, and caching
+            # arbitrary strings would grow this dict without bound (an
+            # unknown namespace still gets a transient engine — its query
+            # fails with the store's own error, uncached)
+            if namespace in self.db.namespaces:
+                self._engines[namespace] = eng
+            return eng
+
+    # --- self-monitoring (m3_tpu/selfmon/) ---
+
+    def start_selfmon(
+        self, interval: float, peers=None, instance: str = "coordinator0"
+    ):
+        """Start the self-scrape collector: this process's registry (plus
+        ``peers``: a zero-arg callable yielding {id: RemoteNode}) stored
+        as series under the reserved namespace through the normal ingest
+        path — queryable right back through this coordinator's PromQL
+        surface with ``namespace=_m3tpu``."""
+        from ..selfmon import RESERVED_NS, DatabaseSink, SelfMonCollector
+
+        self._ensure_selfmon_namespace()
+        self.selfmon = SelfMonCollector(
+            DatabaseSink(self.db, RESERVED_NS),
+            interval=interval,
+            instance=instance,
+            component="coordinator",
+            peers=peers,
+        )
+        self.selfmon.start()
+        return self.selfmon
+
+    def _ensure_selfmon_namespace(self) -> None:
+        from ..selfmon import RESERVED_NS
+
+        # memoized: this runs per ingested selfmon metric, and in cluster
+        # mode the check below would otherwise cost a control-plane KV
+        # round trip every time (SessionDatabase.namespaces is the static
+        # constructor tuple, never containing the reserved ns)
+        if self._selfmon_ns_ready:
+            return
+        if RESERVED_NS in self.db.namespaces:
+            self._selfmon_ns_ready = True
+            return
+        if hasattr(self.db, "create_namespace"):
+            # short retention: self telemetry is operational, not archival
+            self.db.create_namespace(
+                RESERVED_NS,
+                NamespaceOptions(
+                    retention_nanos=24 * 3600 * NANOS,
+                    block_size_nanos=3600 * NANOS,
+                ),
+            )
+            self._selfmon_ns_ready = True
+            return
+        # cluster mode (SessionDatabase): register in the control-plane
+        # namespace registry — every watching dbnode creates it live
+        from ..cluster.namespaces import NamespaceExistsError, NamespaceRegistry
+
+        try:
+            NamespaceRegistry(self.kv).add(
+                RESERVED_NS, 24 * 3600 * NANOS, 3600 * NANOS
+            )
+        except NamespaceExistsError:
+            pass  # another coordinator (or operator) won the race: same goal
+        self._selfmon_ns_ready = True
 
     # --- ingest (downsamplerAndWriter ingest/write.go:138) ---
 
@@ -87,6 +173,7 @@ class Coordinator:
         aggregation type as an extra label (the reference's suffix scheme,
         label-form so PromQL metric names stay valid); opaque IDs write
         untagged."""
+        from ..selfmon import RESERVED_NS, SELFMON_MARKER, selfmon_writer
         from ..utils.serialize import decode_tags, is_tag_id
 
         n = 0
@@ -96,6 +183,19 @@ class Coordinator:
                     tags = tuple(sorted(decode_tags(m.id)))
                 except ValueError:
                     tags = None
+                if tags is not None and SELFMON_MARKER in tags:
+                    # bus-ingested self telemetry (an aggregator's MsgSink):
+                    # strip the marker and route into the reserved
+                    # namespace, unsuffixed — these are registry snapshots,
+                    # not aggregated rollups
+                    tags = tuple(t for t in tags if t != SELFMON_MARKER)
+                    self._ensure_selfmon_namespace()
+                    with selfmon_writer():
+                        self.db.write_tagged(
+                            RESERVED_NS, tags, m.time_nanos, m.value
+                        )
+                    n += 1
+                    continue
                 if tags is not None:
                     tags = tuple(tags) + ((b"agg", m.agg_type.type_string.encode()),)
                     self.db.write_tagged(self.namespace, tags, m.time_nanos, m.value)
@@ -177,15 +277,25 @@ class Coordinator:
                     ts.samples.add(value=float(v), timestamp=int(t) // MS)
         return resp
 
-    def query_range(self, query: str, start_s: float, end_s: float, step_s: float) -> dict:
-        r = self.engine.query_range(
+    def query_range(self, query: str, start_s: float, end_s: float, step_s: float,
+                    namespace: str | None = None) -> dict:
+        r = self.engine_for(namespace).query_range(
             query, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
         )
         return _prom_matrix(r, int(start_s * NANOS), int(step_s * NANOS))
 
-    def query_instant(self, query: str, time_s: float) -> dict:
-        r = self.engine.query_instant(query, int(time_s * NANOS))
+    def query_instant(self, query: str, time_s: float,
+                      namespace: str | None = None) -> dict:
+        r = self.engine_for(namespace).query_instant(query, int(time_s * NANOS))
         return _prom_vector(r, time_s)
+
+    def explain(self, query: str, start_s: float, end_s: float, step_s: float,
+                namespace: str | None = None) -> dict:
+        """Query EXPLAIN (Engine.explain): per-stage timings, scan
+        counters, and the per-block resident-vs-streamed routing record."""
+        return self.engine_for(namespace).explain(
+            query, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
+        )
 
     # --- graphite (src/query/api/v1/handler/graphite/render.go + find.go) ---
 
@@ -446,6 +556,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if url.path in (
                     "/health", "/metrics", "/debug/traces",
                     "/debug/slow_queries", "/debug/dump",
+                    "/debug/exemplars",
                 )
                 else TRACER.span("http.get", path=url.path)
             )
@@ -465,10 +576,27 @@ class _Handler(BaseHTTPRequestHandler):
                             float(q["start"][0]),
                             float(q["end"][0]),
                             _parse_step(q.get("step", ["15"])[0]),
+                            namespace=q.get("namespace", [None])[0],
                         )
                     )
                 elif url.path == "/api/v1/query":
-                    self._json(c.query_instant(q["query"][0], float(q["time"][0])))
+                    self._json(
+                        c.query_instant(
+                            q["query"][0],
+                            float(q["time"][0]),
+                            namespace=q.get("namespace", [None])[0],
+                        )
+                    )
+                elif url.path == "/api/v1/explain":
+                    self._json(
+                        c.explain(
+                            q["query"][0],
+                            float(q["start"][0]),
+                            float(q.get("end", q["start"])[0]),
+                            _parse_step(q.get("step", ["15"])[0]),
+                            namespace=q.get("namespace", [None])[0],
+                        )
+                    )
                 elif url.path == "/api/v1/labels":
                     self._json(
                         {"status": "success",
@@ -518,6 +646,25 @@ class _Handler(BaseHTTPRequestHandler):
 
                     limit = int(q.get("limit", ["64"])[0])
                     self._json({"queries": RING.dump(limit=limit)})
+                elif url.path == "/debug/exemplars":
+                    # trace-ID exemplars per histogram bucket: join a slow
+                    # bucket to its stitched trace (/debug/traces) and its
+                    # /debug/slow_queries record by traceId. (Exemplars
+                    # live here, not in the 0.0.4 text exposition, which
+                    # has no grammar for them.)
+                    from ..utils.instrument import DEFAULT as METRICS
+
+                    out = {}
+                    for name, fam in METRICS.collect().items():
+                        rows = [
+                            {"labels": ch["labels"],
+                             "exemplars": ch["exemplars"]}
+                            for ch in fam["children"]
+                            if ch.get("exemplars")
+                        ]
+                        if rows:
+                            out[name] = rows
+                    self._json({"exemplars": out})
                 elif url.path == "/debug/dump":
                     self._send(
                         200, self._debug_dump(), ctype="application/zip"
@@ -745,6 +892,24 @@ def main(argv=None) -> int:
         help="serve an m3msg consumer endpoint for aggregated-metric "
         "ingest (prints MSG_LISTENING <host> <port>)",
     )
+    p.add_argument(
+        "--selfmon-interval",
+        type=float,
+        default=0.0,
+        help="self-scrape interval in seconds (0 disables): this "
+        "coordinator's registry — plus every placement dbnode in "
+        "--cluster mode and every --selfmon-peer — is stored as series "
+        "under the reserved _m3tpu namespace and queryable via "
+        "/api/v1/query*?namespace=_m3tpu",
+    )
+    p.add_argument(
+        "--selfmon-peer",
+        action="append",
+        default=[],
+        help="host:port of an extra RPC-scrapable process (dbnode port, "
+        "aggregator --debug-port) to pull into the self-scrape",
+    )
+    p.add_argument("--instance-id", default="coordinator0")
     args = p.parse_args(argv)
 
     cfg = load_config(CoordinatorConfig, args.config) if args.config else CoordinatorConfig()
@@ -778,6 +943,24 @@ def main(argv=None) -> int:
         )
     coord = Coordinator(db=db, namespace=namespace, query_limits=limits, kv=kv)
     server, bound = serve(coord, port, host=host)
+
+    static_peers = {}
+    if args.selfmon_interval > 0:
+        from ..net.client import RemoteNode
+
+        for ep in args.selfmon_peer:
+            static_peers[ep] = RemoteNode.connect(ep)
+
+        def selfmon_peers() -> dict:
+            peers = dict(static_peers)
+            if args.cluster and hasattr(coord.db, "remote_nodes"):
+                peers.update(coord.db.remote_nodes())
+            return peers
+
+        coord.start_selfmon(
+            args.selfmon_interval, peers=selfmon_peers,
+            instance=args.instance_id,
+        )
 
     detector = None
     if args.failure_detector:
@@ -816,6 +999,14 @@ def main(argv=None) -> int:
             detector.stop()
         if msg_server is not None:
             msg_server.stop()
+        if coord.selfmon is not None:
+            coord.selfmon.stop()
+        for node in static_peers.values():
+            try:
+                node.close()
+            except Exception:
+                # m3lint: disable=M3L007 -- best-effort socket teardown on shutdown; the process is exiting
+                pass
         server.shutdown()
         coord.db.close()
         if kv is not None:

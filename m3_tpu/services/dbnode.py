@@ -54,6 +54,20 @@ def build_parser() -> argparse.ArgumentParser:
         "resident_stats debug op",
     )
     p.add_argument(
+        "--selfmon-interval",
+        type=float,
+        default=0.0,
+        help="self-scrape interval in seconds (0 disables): this node's "
+        "metrics registry is stored as series in its own reserved _m3tpu "
+        "namespace through the normal write path (m3_tpu/selfmon/)",
+    )
+    p.add_argument(
+        "--selfmon-retention-secs",
+        type=int,
+        default=24 * 3600,
+        help="retention of the reserved self-monitoring namespace",
+    )
+    p.add_argument(
         "--kv-endpoint",
         default="",
         help="host:port of the control-plane KV server; enables dynamic "
@@ -142,6 +156,20 @@ def main(argv=None) -> int:
     )
     for ns in args.namespace or ["default"]:
         db.create_namespace(ns, opts)
+    if args.selfmon_interval > 0:
+        # created BEFORE bootstrap so stored self telemetry recovers across
+        # restarts like any namespace
+        from ..selfmon import RESERVED_NS
+
+        db.create_namespace(
+            RESERVED_NS,
+            NamespaceOptions(
+                retention_nanos=args.selfmon_retention_secs * NANOS,
+                block_size_nanos=min(
+                    args.block_size_secs, 3600
+                ) * NANOS,
+            ),
+        )
 
     # dynamic namespaces (namespace/dynamic.go): the control-plane registry
     # is applied BEFORE bootstrap so registered namespaces recover their
@@ -210,6 +238,17 @@ def main(argv=None) -> int:
         service, host=args.host, port=args.port,
         max_inflight=args.max_inflight or None,
     )
+
+    selfmon = None
+    if args.selfmon_interval > 0:
+        from ..selfmon import RESERVED_NS, DatabaseSink, SelfMonCollector
+
+        selfmon = SelfMonCollector(
+            DatabaseSink(db, RESERVED_NS),
+            interval=args.selfmon_interval,
+            instance=args.node_id,
+            component="dbnode",
+        ).start()
 
     def wire_control_plane() -> None:
         """Dynamic topology via the networked control plane (server.go:
@@ -303,6 +342,8 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        if selfmon is not None:
+            selfmon.stop()
         if state["hb_stop"] is not None:
             state["hb_stop"].set()
         if state["cluster_db"] is not None:

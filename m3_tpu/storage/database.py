@@ -567,10 +567,16 @@ class Database:
         self.cache_invalidator = CacheInvalidator(self.block_cache, self.resident_pool)
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
-        # self-observability (x/instrument role)
-        self._m_writes = METRICS.counter("db_writes_total", "datapoint writes")
-        self._m_reads = METRICS.counter("db_reads_total", "series reads")
-        self._m_write_errors = METRICS.counter("db_write_errors_total")
+        # self-observability (x/instrument role). Write/read counters are
+        # labeled {ns=...} (cardinality = operator-bounded namespace count)
+        # so the self-scrape pipeline can SKIP the reserved `_m3tpu`
+        # namespace's children when snapshotting — the collector's own
+        # storage writes never re-enter the telemetry it stores
+        # (selfmon/guard.py invariant 2). Children resolve once per
+        # namespace; after that a write costs one dict lookup.
+        self._m_writes: dict[str, object] = {}
+        self._m_reads: dict[str, object] = {}
+        self._m_write_errors: dict[str, object] = {}
         # new-series insert rate limit (runtime options; 0 = unlimited)
         self._new_series_limit = 0
         self._new_series_window = (0, 0)  # (second, count)
@@ -583,6 +589,12 @@ class Database:
         self.lock = threading.RLock()
 
     def create_namespace(self, name: str, opts: NamespaceOptions | None = None) -> Namespace:
+        # resolve the namespace's write/read counter children eagerly so
+        # the families exist in the exposition from boot (scrape targets
+        # and tools/check_metrics.py expect them before the first write)
+        self._writes_counter(name)
+        self._reads_counter(name)
+        self._write_errors_counter(name)
         with self.lock:
             ns = Namespace(
                 name,
@@ -601,9 +613,42 @@ class Database:
     def _commitlog_dir(self, ns: str) -> str:
         return os.path.join(self.base, "commitlogs", ns)
 
+    # per-namespace counter children resolve once; a benign race hands both
+    # writers the SAME registry child, so the dict update is lock-free
+
+    def _writes_counter(self, ns: str):
+        c = self._m_writes.get(ns)
+        if c is None:
+            c = self._m_writes[ns] = METRICS.counter(
+                "db_writes_total", "datapoint writes", labels={"ns": ns}
+            )
+        return c
+
+    def _reads_counter(self, ns: str):
+        c = self._m_reads.get(ns)
+        if c is None:
+            c = self._m_reads[ns] = METRICS.counter(
+                "db_reads_total", "series reads", labels={"ns": ns}
+            )
+        return c
+
+    def _write_errors_counter(self, ns: str):
+        c = self._m_write_errors.get(ns)
+        if c is None:
+            c = self._m_write_errors[ns] = METRICS.counter(
+                "db_write_errors_total", "rejected datapoint writes",
+                labels={"ns": ns},
+            )
+        return c
+
     def write(
         self, ns: str, sid: bytes, t_nanos: int, value: float, unit: Unit = Unit.SECOND
     ) -> None:
+        # reserved-namespace rule (selfmon/guard.py): only the tagged
+        # self-scrape pipeline may write `_m3tpu*` telemetry namespaces
+        from ..selfmon.guard import check_write
+
+        check_write(ns)
         namespace = self.namespaces[ns]
         shard = namespace.shard_for(sid)
         with shard.lock:
@@ -614,7 +659,7 @@ class Database:
             try:
                 shard.write(sid, t_nanos, value, unit)
             except Exception:
-                self._m_write_errors.inc()
+                self._write_errors_counter(ns).inc()
                 raise
             if is_new and self._new_series_limit > 0:
                 with self._limit_lock:
@@ -626,7 +671,7 @@ class Database:
             cl = self._commitlogs.get(ns)
             if cl is not None:
                 cl.write(CommitLogEntry(sid, t_nanos, value, unit))
-        self._m_writes.inc()
+        self._writes_counter(ns).inc()
 
     def write_batch(self, ns: str, entries: list[tuple[bytes, int, float]]) -> None:
         """Batched ingest, flattened to one tight loop per shard: entries
@@ -639,6 +684,9 @@ class Database:
         the error propagates, so no applied write is ever unlogged."""
         from .series import BufferBucket, SeriesBuffer
 
+        from ..selfmon.guard import check_write
+
+        check_write(ns)
         namespace = self.namespaces[ns]
         cl = self._commitlogs.get(ns)
         limit_on = self._new_series_limit > 0
@@ -713,7 +761,7 @@ class Database:
                         bucket._stream_cache = None
                         bucket._arrays_cache = None
                         applied.append(CommitLogEntry(sid, t, v))
-            self._m_writes.inc(len(applied))
+            self._writes_counter(ns).inc(len(applied))
         finally:
             if touched:
                 for shard_id, sid, bs in touched:
@@ -753,7 +801,7 @@ class Database:
         self._new_series_window = (sec, count + 1)
 
     def read(self, ns: str, sid: bytes, start: int, end: int) -> list[Datapoint]:
-        self._m_reads.inc()
+        self._reads_counter(ns).inc()
         # per-shard locking (inside Shard.read): reads don't serialize
         # against other shards or the database lifecycle lock
         return self.namespaces[ns].shard_for(sid).read(sid, start, end)
@@ -762,7 +810,7 @@ class Database:
         """Decoded (times i64, values f64, units) arrays for one series —
         the cache-aware array read surface query engines consume without
         materializing per-point Datapoint objects."""
-        self._m_reads.inc()
+        self._reads_counter(ns).inc()
         return self.namespaces[ns].shard_for(sid).read_arrays(sid, start, end)
 
     def fetch_blocks(self, ns: str, sid: bytes, start: int, end: int) -> list[bytes]:
@@ -770,7 +818,7 @@ class Database:
         range, oldest-first (rpc.thrift fetchBlocksRaw; the client session
         merges replicas' segments with the SeriesIterator stack instead of
         shipping decoded datapoints)."""
-        self._m_reads.inc()
+        self._reads_counter(ns).inc()
         return self.namespaces[ns].shard_for(sid).fetch_blocks(sid, start, end)
 
     # --- tagged write / index query path (database.go:606 WriteTagged,
@@ -1257,28 +1305,37 @@ class Database:
             fulfilled = ShardTimeRanges()
             if peers_source is None:
                 return fulfilled
+            # replication context: peer-streamed reserved-namespace
+            # telemetry was admitted by a sanctioned writer on the source
+            # replica — moving it here must not trip the selfmon guard
+            # (and its ReservedNamespaceError is a ValueError, which the
+            # skip below would otherwise silently eat)
+            from ..selfmon.guard import selfmon_writer
+
             for shard_id in remaining.shards():
                 series = peers_source(ns_name, shard_id)
                 if series is None:
                     continue  # no reachable replica holds this shard
-                for sid, tags, dps in series:
-                    for dp in dps:
-                        # full write path: WAL-logged (a restart before the
-                        # next flush must be able to replay this replica's
-                        # copy) and indexed per point (series spanning
-                        # several index blocks stay queryable in each)
-                        try:
-                            if tags:
-                                self.write_tagged(
-                                    ns_name, tags, dp.timestamp, dp.value, dp.unit
-                                )
-                            else:
-                                self.write(
-                                    ns_name, sid, dp.timestamp, dp.value, dp.unit
-                                )
-                                self._reindex(ns, sid, dp.timestamp)
-                        except (ColdWriteError, ValueError):
-                            continue
+                with selfmon_writer():
+                    for sid, tags, dps in series:
+                        for dp in dps:
+                            # full write path: WAL-logged (a restart before
+                            # the next flush must be able to replay this
+                            # replica's copy) and indexed per point (series
+                            # spanning several index blocks stay queryable
+                            # in each)
+                            try:
+                                if tags:
+                                    self.write_tagged(
+                                        ns_name, tags, dp.timestamp, dp.value, dp.unit
+                                    )
+                                else:
+                                    self.write(
+                                        ns_name, sid, dp.timestamp, dp.value, dp.unit
+                                    )
+                                    self._reindex(ns, sid, dp.timestamp)
+                            except (ColdWriteError, ValueError):
+                                continue
                 # a reachable peer hands over everything it has for the
                 # shard: the remaining ranges are fulfilled (blocks with no
                 # data are legitimately empty on the replica too)

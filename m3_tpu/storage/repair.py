@@ -165,18 +165,24 @@ def repair_shard(db, ns: str, shard_id: int, peers: list, tags_for=None) -> Repa
                 dp.timestamp
                 for dp in sh.read(sid, bs, bs + bsz, populate_cache=False)
             }
-            for dp in dps:
-                if dp.timestamp in have:
-                    continue
-                unit = dp.unit if isinstance(dp.unit, Unit) else Unit(dp.unit)
-                try:
-                    if (tags := tags_for(sid)):
-                        db.write_tagged(ns, tags, dp.timestamp, dp.value, unit)
-                    else:
-                        db.write(ns, sid, dp.timestamp, dp.value, unit)
-                    res.points_merged += 1
-                except ColdWriteError:
-                    res.points_skipped_cold += 1
+            # replication context (selfmon/guard.py): repairing a reserved
+            # self-monitoring namespace moves telemetry a sanctioned
+            # writer already admitted on the source replica
+            from ..selfmon.guard import selfmon_writer
+
+            with selfmon_writer():
+                for dp in dps:
+                    if dp.timestamp in have:
+                        continue
+                    unit = dp.unit if isinstance(dp.unit, Unit) else Unit(dp.unit)
+                    try:
+                        if (tags := tags_for(sid)):
+                            db.write_tagged(ns, tags, dp.timestamp, dp.value, unit)
+                        else:
+                            db.write(ns, sid, dp.timestamp, dp.value, unit)
+                        res.points_merged += 1
+                    except ColdWriteError:
+                        res.points_skipped_cold += 1
             # repaired block re-merges from source on next read (points
             # route through the write path, which fires on_write per point;
             # this covers blocks whose every point was skipped cold)

@@ -10,6 +10,8 @@ with label sets, rendered in the Prometheus text format by services'
 from __future__ import annotations
 
 import bisect
+import math
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -73,20 +75,41 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.total = 0
+        # bucket index -> (value, trace_id, unix_nanos): the LAST traced
+        # observation per bucket (OpenMetrics-exemplar role) — a slow
+        # bucket links straight to its stitched trace in /debug/traces and
+        # its /debug/slow_queries record. Kept out of the text exposition
+        # (the 0.0.4 format has no exemplar grammar; tools/check_metrics
+        # validates every line) — served by collect() and /debug/exemplars.
+        self.exemplars: dict[int, tuple[float, str, int]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: str | None = None) -> None:
         with self._lock:
             i = bisect.bisect_left(self.buckets, v)
             self.counts[i] += 1
             self.sum += v
             self.total += 1
+            if trace_id is not None:
+                self.exemplars[i] = (v, trace_id, time.time_ns())
 
     def snapshot(self) -> tuple[list[int], float, int]:
         """(counts, sum, total) read atomically vs concurrent observe() —
         exposition must not report a count/sum pair from different instants."""
         with self._lock:
             return list(self.counts), self.sum, self.total
+
+    def exemplar_rows(self) -> list[dict]:
+        """Exemplars as rows keyed by the bucket's ``le`` bound."""
+        with self._lock:
+            items = sorted(self.exemplars.items())
+        out = []
+        for i, (v, tid, ts) in items:
+            le = self.buckets[i] if i < len(self.buckets) else float("inf")
+            out.append(
+                {"le": le, "value": v, "traceId": tid, "timeUnixNanos": ts}
+            )
+        return out
 
 
 @dataclass
@@ -166,6 +189,9 @@ class Registry:
                         buckets.append([float(b), acc])
                     buckets.append([float("inf"), h_total])
                     row.update(sum=h_sum, count=h_total, buckets=buckets)
+                    exemplars = m.exemplar_rows()
+                    if exemplars:
+                        row["exemplars"] = exemplars
                 rows.append(row)
             out[f"{self.prefix}{name}"] = {
                 "kind": kind, "help": help_, "children": rows
@@ -238,13 +264,16 @@ class JitTracker:
     def track(self, key):
         return _JitCall(self, key)
 
-    def _observe(self, key, elapsed: float) -> None:
+    def _observe(self, key, elapsed: float) -> bool:
+        """Record a first-call compile; returns whether THIS call was the
+        first sighting of ``key`` (i.e. its wall time is compile time)."""
         with self._lock:
             if key in self._seen:
-                return
+                return False
             self._seen.add(key)
         self._compiles.inc()
         self._seconds.inc(elapsed)
+        return True
 
 
 class _JitCall:
@@ -259,3 +288,118 @@ class _JitCall:
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc_type is None:
             self.tracker._observe(self.key, time.perf_counter() - self._t0)
+
+
+# kernel dispatch latencies span ~10µs (a warm tiny batch on CPU) to whole
+# seconds (a cold 50M-series scan): finer low end than the RPC buckets
+KERNEL_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _env_sample_rate() -> float:
+    """M3_TPU_PROFILE_SAMPLE_RATE in [0, 1]; default 0 (profiling off —
+    a sampled dispatch pays a block_until_ready, so the fleet default is
+    zero-overhead and the knob is explicit)."""
+    try:
+        rate = float(os.environ.get("M3_TPU_PROFILE_SAMPLE_RATE", "0"))
+    except ValueError:
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
+
+
+class KernelProfiler(JitTracker):
+    """Device-tier dispatch observability: JitTracker's compile attribution
+    plus SAMPLED wall-time profiles of every kernel dispatch.
+
+    JAX dispatch is async — wall time around the call measures Python
+    dispatch, not device work — so a profiled sample bounds the dispatch
+    with ``jax.block_until_ready`` on the result and records the whole
+    span in ``m3tpu_kernel_dispatch_seconds{kernel=...}``. Sampling is
+    DETERMINISTIC (dispatch ``n`` is sampled iff ``floor(n·rate)`` advances
+    over ``floor((n−1)·rate)``), so profiles are reproducible run to run
+    and exactly ``rate`` of dispatches pay the sync. First-call compiles
+    are excluded from the dispatch histogram — their wall time is XLA
+    compilation and lands in the existing jit_compile counters instead.
+
+    Usage::
+
+        _PROF = KernelProfiler("m3tsz_decode")
+        with _PROF.dispatch((words.shape, max_points)) as d:
+            d.done(decode_batched(...))
+    """
+
+    def __init__(self, kernel: str, registry: Registry | None = None,
+                 sample_rate: float | None = None) -> None:
+        super().__init__(kernel, registry=registry)
+        reg = registry or DEFAULT
+        self.sample_rate = (
+            _env_sample_rate() if sample_rate is None
+            else min(max(float(sample_rate), 0.0), 1.0)
+        )
+        labels = {"kernel": kernel}
+        self._dispatches = reg.counter(
+            "kernel_dispatches_total", "kernel dispatches", labels
+        )
+        self._hist = reg.histogram(
+            "kernel_dispatch_seconds",
+            "block_until_ready-bounded wall time of SAMPLED kernel "
+            "dispatches (M3_TPU_PROFILE_SAMPLE_RATE; compiles excluded)",
+            labels,
+            buckets=KERNEL_BUCKETS,
+        )
+        self._n = 0  # dispatch sequence (guarded by JitTracker._lock)
+
+    def _next_sampled(self) -> bool:
+        rate = self.sample_rate
+        with self._lock:
+            self._n += 1
+            n = self._n
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        return math.floor(n * rate) > math.floor((n - 1) * rate)
+
+    def dispatch(self, key=None) -> "_Dispatch":
+        return _Dispatch(self, key)
+
+
+class _Dispatch:
+    """One profiled kernel dispatch; call ``done(result)`` with the device
+    output so a sampled dispatch can block on it."""
+
+    __slots__ = ("profiler", "key", "sampled", "result", "_t0")
+
+    def __init__(self, profiler: KernelProfiler, key) -> None:
+        self.profiler = profiler
+        self.key = key
+        self.sampled = profiler._next_sampled()
+        self.result = None
+
+    def done(self, result):
+        self.result = result
+        return result
+
+    def __enter__(self) -> "_Dispatch":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        prof = self.profiler
+        prof._dispatches.inc()
+        compiled = False
+        if self.key is not None:
+            compiled = prof._observe(self.key, time.perf_counter() - self._t0)
+        if self.sampled and not compiled:
+            if self.result is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(self.result)
+                except ImportError:  # host-only result: nothing to sync
+                    pass
+            prof._hist.observe(time.perf_counter() - self._t0)

@@ -41,6 +41,46 @@ def test_device_op_under_lock_fires():
     assert codes(findings) == {"M3L001"} and len(findings) == 2
 
 
+def test_send_frame_under_lock_fires():
+    # socket-blocking boundary (PR 6 satellite): a frame send inside a
+    # lock turns one slow peer into a process-wide pile-up
+    findings = lint(
+        """
+        import threading
+        from m3_tpu.net import wire
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def flush(self, sock, batch):
+                with self._lock:
+                    wire.send_frame(sock, {"entries": batch})
+        """
+    )
+    assert codes(findings) == {"M3L001"} and len(findings) == 1
+    assert "send" in findings[0].message
+
+
+def test_send_frame_outside_lock_quiet():
+    findings = lint(
+        """
+        import threading
+        from m3_tpu.net import wire
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def flush(self, sock):
+                with self._lock:
+                    batch, self._buf = self._buf, []  # snapshot under lock
+                wire.send_frame(sock, {"entries": batch})  # send lock-free
+        """
+    )
+    assert findings == []
+
+
 def test_device_op_outside_lock_quiet():
     findings = lint(
         """

@@ -54,9 +54,11 @@ def main() -> int:
     from m3_tpu.client.session import Session
     from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
     from m3_tpu.index.query import term
+    from m3_tpu.net import wire
     from m3_tpu.net.client import RemoteNode
     from m3_tpu.net.resilience import CircuitBreaker, RetryPolicy
     from m3_tpu.testing.faults import FaultPlan, FaultRule, env_with_plan
+    from m3_tpu.testing.lockcheck import LockCheck
     from m3_tpu.testing.proc_cluster import ProcCluster
     from m3_tpu.utils.instrument import DEFAULT as METRICS
 
@@ -83,6 +85,15 @@ def main() -> int:
     fds_before = _socket_fds()
     cluster = None
     session = None
+    # runtime lock-order harness over the whole client plane (PR 5
+    # follow-up): every lock created by the fixture/session machinery
+    # below is instrumented, and wire.send_frame is a registered blocking
+    # boundary — holding any lock across a socket send, or any lock-order
+    # cycle witnessed under chaos retries/fan-outs, fails this guard
+    lockcheck_cm = LockCheck.instrumented()
+    chk = lockcheck_cm.__enter__()
+    orig_send_frame = wire.send_frame
+    wire.send_frame = chk.wrap_blocking(orig_send_frame, "wire.send_frame")
     try:
         cluster = ProcCluster(
             num_nodes=3, num_shards=4, replica_factor=3,
@@ -190,9 +201,17 @@ def main() -> int:
             pass
         if cluster is not None:
             cluster.close()
+        wire.send_frame = orig_send_frame
+        lockcheck_cm.__exit__(None, None, None)
         import shutil
 
         shutil.rmtree(base, ignore_errors=True)
+
+    report = chk.report()
+    if report:
+        print(report)
+    check(not report, "lockcheck: no lock-order cycles, no lock held "
+          "across wire.send_frame under chaos")
 
     if fds_before >= 0:
         deadline = time.monotonic() + 15

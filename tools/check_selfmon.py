@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""CI guard for the self-monitoring pipeline (m3_tpu/selfmon/).
+
+Boots a mini fleet — one real dbnode process (self-scraping its own
+registry into its local reserved namespace) and one real coordinator
+process (self-scraping itself AND pulling the dbnode over the universal
+``metrics`` RPC op) — waits two scrape intervals, then asserts:
+
+- the coordinator answers a PromQL query over its own ingested
+  ``m3tpu_rpc_*`` telemetry (namespace=_m3tpu) with zero client-visible
+  errors and both scrape identities (coordinator + peer) present;
+- self-scrape error counters are zero across the fleet;
+- EXPLAIN works over the stored telemetry and reports per-stage timings;
+- the feedback-loop guard held: no ``ns="_m3tpu"`` write-path series was
+  re-ingested into the reserved namespace.
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_selfmon.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+SCRAPE_INTERVAL = 0.5
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from m3_tpu.index.query import term
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.selfmon import RESERVED_NS
+    from m3_tpu.testing.proc_cluster import _spawn_listening
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    base_dir = tempfile.mkdtemp(prefix="m3tpu-check-selfmon-")
+    dbnode = coordinator = None
+    try:
+        dbnode, dh, dport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.dbnode",
+             "--base-dir", os.path.join(base_dir, "dbnode"),
+             "--shards", "0,1", "--num-shards", "2", "--no-mediator",
+             "--selfmon-interval", str(SCRAPE_INTERVAL)],
+            "dbnode",
+        )
+        coordinator, ch, cport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.coordinator",
+             "--base-dir", os.path.join(base_dir, "coord"),
+             "--selfmon-interval", str(SCRAPE_INTERVAL),
+             "--selfmon-peer", f"{dh}:{dport}"],
+            "coordinator",
+        )
+        base = f"http://{ch}:{cport}"
+
+        # wait two scrape intervals (plus startup grace) for stored series
+        time.sleep(2 * SCRAPE_INTERVAL)
+        deadline = time.monotonic() + 30
+        result, errors = [], 0
+        while time.monotonic() < deadline and not result:
+            out = _get_json(
+                f"{base}/api/v1/query?query=m3tpu_rpc_requests_total"
+                f"&time={time.time()}&namespace={RESERVED_NS}"
+            )
+            if out.get("status") != "success":
+                errors += 1
+            result = out.get("data", {}).get("result", [])
+            if not result:
+                time.sleep(0.2)
+        check(errors == 0, "PromQL over self telemetry: zero client-visible errors")
+        check(bool(result), "m3tpu_rpc_requests_total returns non-empty series")
+        roles = {row["metric"].get("role") for row in result}
+        check("peer" in roles, f"dbnode peer telemetry ingested (roles={roles})")
+
+        out = _get_json(
+            f"{base}/api/v1/query?query=m3tpu_selfmon_scrapes_total"
+            f"&time={time.time()}&namespace={RESERVED_NS}"
+        )
+        check(bool(out["data"]["result"]), "collector's own counters stored")
+
+        out = _get_json(
+            f"{base}/api/v1/query?query=m3tpu_selfmon_scrape_errors_total"
+            f"&time={time.time()}&namespace={RESERVED_NS}"
+        )
+        bad = [row for row in out["data"]["result"]
+               if float(row["value"][1]) != 0.0]
+        check(not bad, f"zero self-scrape errors fleet-wide ({len(bad)} nonzero)")
+
+        out = _get_json(
+            f"{base}/api/v1/explain?query=m3tpu_rpc_requests_total"
+            # m3lint: disable=M3L004 -- PromQL query-range timestamps are wall-clock data, not a wait deadline
+            f"&start={time.time() - 60}&end={time.time()}&step=15"
+            f"&namespace={RESERVED_NS}"
+        )
+        check(out.get("stages", {}).get("fetch", 0) > 0,
+              "EXPLAIN reports per-stage timings over stored telemetry")
+        check(bool(out.get("routing")), "EXPLAIN carries routing decisions")
+
+        # feedback guard: the reserved namespace's own write-path counter
+        # children were skipped at conversion time on both processes
+        node = RemoteNode(dh, dport)
+        try:
+            leaked = node.fetch_tagged(
+                RESERVED_NS, term(b"ns", RESERVED_NS.encode()), 0, 2**62
+            )
+        finally:
+            node.close()
+        check(not leaked, "no reserved-ns write-path series re-ingested")
+        out = _get_json(
+            f"{base}/api/v1/query?query="
+            f'm3tpu_db_writes_total{{ns="{RESERVED_NS}"}}'
+            f"&time={time.time()}&namespace={RESERVED_NS}"
+        )
+        check(not out["data"]["result"],
+              "coordinator store also free of reserved-ns write counters")
+    finally:
+        for proc in (dbnode, coordinator):
+            if proc is not None:
+                proc.kill()
+                proc.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    if failures:
+        print(f"\nFAIL: {len(failures)} self-monitoring violation(s)")
+        return 1
+    print("\nself-monitoring contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,12 @@ class DeviceOpUnderLock(Checker):
     name = "device-op-under-lock"
 
     DEVICE_ATTRS = {"device_put", "block_until_ready", "pallas_call"}
+    # socket-blocking boundary: a frame send can stall for the peer's TCP
+    # window (or a fault-injected delay); holding any lock across it turns
+    # one slow peer into a process-wide pile-up. The runtime twin is the
+    # lockcheck harness's wrap_blocking(wire.send_frame) boundary under
+    # tools/check_chaos.py.
+    SOCKET_ATTRS = {"send_frame"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
@@ -114,6 +120,16 @@ class DeviceOpUnderLock(Checker):
                         "uploads/compiles must stage OUTSIDE the lock "
                         "(PR 3 admission rule: the hot path must never "
                         "stall behind PCIe or XLA under a shard/table lock)",
+                    )
+                elif attr in self.SOCKET_ATTRS:
+                    yield self.finding(
+                        ctx,
+                        inner.lineno,
+                        f"{attr}() inside `with {lock}:` — a socket send "
+                        "can block on the peer's TCP window; frames must "
+                        "be sent OUTSIDE locks (the collector's scrape/"
+                        "write loop and every RPC path snapshot under the "
+                        "lock, then send lock-free)",
                     )
 
 
@@ -357,8 +373,12 @@ class MetricNameDiscipline(Checker):
     RECEIVER = re.compile(r"^(METRICS|DEFAULT|reg|registry|_?metrics)$")
     NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
     # the fixed label-key allowlist: every key must be grep-able and the
-    # exposition cardinality per key must be argued when it is added here
-    LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage"}
+    # exposition cardinality per key must be argued when it is added here.
+    # "ns": bounded by the operator-configured namespace count; labeling
+    # write-path counters per namespace is what lets the self-scrape skip
+    # its own reserved-namespace activity (selfmon/convert.py)
+    LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
+                  "ns"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
