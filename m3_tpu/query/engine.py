@@ -113,6 +113,10 @@ class Engine:
         from . import stats
 
         qs = stats.start(query)
+        if qs is not None:
+            # the storage adapter knows which namespace this engine serves
+            # (M3Storage.namespace); /debug/active_queries shows it
+            qs.namespace = str(getattr(self.storage, "namespace", "") or "")
         t_start = time.perf_counter()
         err: str | None = None
         try:
@@ -161,6 +165,7 @@ class Engine:
         st = stats.start(f"EXPLAIN {query}")
         if st is not None:
             st.record_routing = True
+            st.namespace = str(getattr(self.storage, "namespace", "") or "")
         t_start = time.perf_counter()
         err: str | None = None
         try:
@@ -194,6 +199,8 @@ class Engine:
         if storage_scan is None:
             raise ValueError("storage does not support scan_totals")
         qs = stats.start(f"scan_totals({query})")
+        if qs is not None:
+            qs.namespace = str(getattr(self.storage, "namespace", "") or "")
         t_start = time.perf_counter()
         err: str | None = None
         try:

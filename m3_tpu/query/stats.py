@@ -48,6 +48,12 @@ class QueryStats:
     start_unix_nanos: int = 0
     duration_secs: float = 0.0
     stages: dict = field(default_factory=dict)  # stage -> seconds
+    # live-introspection fields (the /debug/active_queries surface): the
+    # namespace the owning engine serves, and which stage the query is in
+    # RIGHT NOW (set/restored by the ``stage()`` context; None between
+    # stages) — only meaningful while the query is in flight
+    namespace: str = ""
+    current_stage: str | None = None
     series_scanned: int = 0
     datapoints_scanned: int = 0
     bytes_scanned: int = 0
@@ -74,6 +80,7 @@ class QueryStats:
     def to_dict(self) -> dict:
         out = {
             "query": self.query,
+            "namespace": self.namespace,
             "startUnixNanos": self.start_unix_nanos,
             "durationSecs": self.duration_secs,
             "stages": dict(self.stages),
@@ -142,12 +149,15 @@ def start(query: str) -> QueryStats | None:
     if ctx is not None:
         st.trace_id = f"{ctx['trace_id']:016x}"
     _local.stats = st
+    ACTIVE.register(st)
     return st
 
 
 def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> None:
     """Seal + publish a record: ring, histograms, counters."""
     _local.stats = None
+    ACTIVE.unregister(st)
+    st.current_stage = None
     st.duration_secs = duration_secs
     st.error = error
     fetch = st.stages.get("fetch", 0.0)
@@ -209,25 +219,86 @@ def add(
 
 class _Stage:
     """``with stage("fetch"):`` — accumulates elapsed wall time onto the
-    active record; no-op (still times nothing extra) outside a query."""
+    active record and marks it as the query's CURRENT stage (what
+    /debug/active_queries shows for an in-flight query); no-op (still
+    times nothing extra) outside a query."""
 
-    __slots__ = ("name", "_t0")
+    __slots__ = ("name", "_t0", "_prev")
 
     def __init__(self, name: str) -> None:
         self.name = name
 
     def __enter__(self) -> "_Stage":
         self._t0 = time.perf_counter()
+        st = current()
+        self._prev = st.current_stage if st is not None else None
+        if st is not None:
+            st.current_stage = self.name
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         st = current()
         if st is not None:
             st.add_stage(self.name, time.perf_counter() - self._t0)
+            st.current_stage = self._prev
 
 
 def stage(name: str) -> _Stage:
     return _Stage(name)
+
+
+class ActiveQueryRegistry:
+    """Bounded registry of IN-FLIGHT queries (the live sibling of the
+    slow-query ring): every ``start()`` registers the thread's record,
+    ``finish()`` removes it, and :meth:`dump` snapshots what is running
+    RIGHT NOW — trace id, namespace, elapsed wall time, and the stage the
+    query is currently in. Joined by traceId to ``/debug/slow_queries``
+    and ``/debug/traces``, so "what is the coordinator doing" and "why was
+    that slow" are the same id space.
+
+    Bounded: past ``capacity`` concurrent queries, new registrations are
+    dropped (counted in ``overflows``, surfaced in the dump) — the debug
+    surface must not become the memory leak it exists to diagnose."""
+
+    def __init__(self, capacity: int = 512) -> None:
+        self.capacity = max(int(capacity), 1)
+        self._live: dict[int, QueryStats] = {}
+        self._lock = threading.Lock()
+        self._overflows = 0
+
+    def register(self, st: QueryStats) -> None:
+        with self._lock:
+            if len(self._live) >= self.capacity:
+                self._overflows += 1
+                return
+            self._live[id(st)] = st
+
+    def unregister(self, st: QueryStats) -> None:
+        with self._lock:
+            self._live.pop(id(st), None)
+
+    def dump(self) -> dict:
+        with self._lock:
+            records = list(self._live.values())
+            overflows = self._overflows
+        now = time.time_ns()
+        rows = [
+            {
+                "query": st.query,
+                "namespace": st.namespace,
+                "traceId": st.trace_id,
+                "stage": st.current_stage,
+                "startUnixNanos": st.start_unix_nanos,
+                "elapsedSecs": max(now - st.start_unix_nanos, 0) / 1e9,
+            }
+            for st in records
+        ]
+        rows.sort(key=lambda r: -r["elapsedSecs"])
+        return {"queries": rows, "overflows": overflows}
+
+
+# process-wide in-flight registry (what /debug/active_queries serves)
+ACTIVE = ActiveQueryRegistry()
 
 
 class SlowQueryRing:

@@ -1,0 +1,48 @@
+"""Ruler: PromQL recording & alerting rules over stored namespaces —
+the evaluation half of the self-monitoring loop (see ruler.py)."""
+
+from .notify import LogNotifier, WebhookNotifier, alert_event
+from .rules import (
+    AlertRule,
+    RecordingRule,
+    RuleGroup,
+    groups_from_spec,
+    groups_to_spec,
+    load_rules_file,
+    parse_duration,
+)
+from .ruler import RULESET_KEY, STATE_KEY_PREFIX, GroupRunner, Ruler, RulerStore
+from .state import (
+    FIRING,
+    INACTIVE,
+    PENDING,
+    ActiveAlert,
+    AlertRuleState,
+    Transition,
+    render_template,
+)
+
+__all__ = [
+    "AlertRule",
+    "RecordingRule",
+    "RuleGroup",
+    "groups_from_spec",
+    "groups_to_spec",
+    "load_rules_file",
+    "parse_duration",
+    "Ruler",
+    "RulerStore",
+    "GroupRunner",
+    "RULESET_KEY",
+    "STATE_KEY_PREFIX",
+    "LogNotifier",
+    "WebhookNotifier",
+    "alert_event",
+    "ActiveAlert",
+    "AlertRuleState",
+    "Transition",
+    "render_template",
+    "INACTIVE",
+    "PENDING",
+    "FIRING",
+]

@@ -1,0 +1,146 @@
+"""Notifier seam: where alert transitions leave the process.
+
+A notifier receives a batch of alert events — Alertmanager webhook-shaped
+dicts (``status``/``labels``/``annotations``/``startsAt``/``value``) —
+and returns whether delivery succeeded. The ruler counts outcomes; a
+failed delivery never stops evaluation (alerting must degrade to "state
+visible at /api/v1/alerts" when the notification path is down, not take
+the rule engine with it).
+
+Two built-ins:
+
+- :class:`LogNotifier` — structured lines via ``logging`` plus a bounded
+  in-memory ring (the test/debug seam: what WOULD have been delivered);
+- :class:`WebhookNotifier` — HTTP POST of the standard webhook payload,
+  wrapped in the resilience plane's :class:`~m3_tpu.net.resilience.
+  RetryPolicy` (decorrelated-jitter backoff + retry budget) under one
+  per-delivery deadline, so a flapping receiver costs a bounded slice of
+  the evaluation loop and a retry storm cannot amplify an outage.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from collections import deque
+
+from ..net.resilience import RetryPolicy
+from ..utils.instrument import DEFAULT as METRICS
+
+log = logging.getLogger("m3tpu.ruler")
+
+
+def rfc3339(nanos: int) -> str:
+    """Epoch nanos → RFC3339 UTC timestamp (what Alertmanager-ecosystem
+    receivers parse for startsAt/endsAt)."""
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(nanos / 1e9, tz=timezone.utc).isoformat()
+
+
+def alert_event(status: str, alert) -> dict:
+    """One state-transition event (ruler/state.Transition) as the
+    Alertmanager webhook alert shape. ``startsAt`` is RFC3339 (the
+    format real receivers parse); ``startsAtUnixNanos`` rides alongside
+    for consumers that want the raw clock."""
+    return {
+        "status": status,  # "firing" | "resolved"
+        "labels": dict(alert.labels),
+        "annotations": dict(alert.annotations),
+        "startsAt": rfc3339(alert.active_at_nanos),
+        "startsAtUnixNanos": alert.active_at_nanos,
+        "value": alert.value,
+    }
+
+
+class LogNotifier:
+    """Log-sink notifier; keeps the last ``capacity`` events for
+    inspection (tests and /debug surfaces read ``sent``)."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self._ring: deque = deque(maxlen=max(capacity, 1))
+        self._lock = threading.Lock()
+
+    @property
+    def sent(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def notify(self, events: list[dict]) -> bool:
+        with self._lock:
+            self._ring.extend(events)
+        for e in events:
+            log.info(
+                "alert %s %s value=%s",
+                e["status"],
+                e["labels"].get("alertname", "?"),
+                e.get("value"),
+            )
+        return True
+
+
+class WebhookNotifier:
+    """POSTs ``{"version": "4", "alerts": [...]}`` to ``url``.
+
+    One delivery gets ``timeout`` seconds TOTAL (deadline, not
+    per-attempt): each attempt's socket timeout is the remaining budget,
+    and retries follow ``policy`` (net/resilience.RetryPolicy — budgeted,
+    so a dead receiver degrades to ~token_ratio extra attempts). All
+    failures are counted, never raised."""
+
+    def __init__(
+        self,
+        url: str,
+        policy: RetryPolicy | None = None,
+        timeout: float = 5.0,
+    ) -> None:
+        self.url = str(url)
+        self.policy = policy or RetryPolicy(max_retries=2, max_backoff=0.5)
+        self.timeout = float(timeout)
+        self._m_sent = METRICS.counter(
+            "ruler_webhook_deliveries_total",
+            "alert webhook deliveries that got a 2xx",
+        )
+        self._m_failed = METRICS.counter(
+            "ruler_webhook_failures_total",
+            "alert webhook deliveries that exhausted their deadline or "
+            "retry budget",
+        )
+
+    def notify(self, events: list[dict]) -> bool:
+        body = json.dumps({"version": "4", "alerts": events}).encode()
+        deadline = time.monotonic() + self.timeout
+        attempt = 0
+        prev_sleep = 0.0
+        while True:
+            attempt += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._m_failed.inc()
+                return False
+            try:
+                req = urllib.request.Request(
+                    self.url,
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=remaining) as resp:
+                    ok = 200 <= resp.status < 300
+                if ok:
+                    self.policy.on_success()
+                    self._m_sent.inc()
+                    return True
+            except Exception as exc:
+                # failed attempt: fall through to the retry decision
+                # below, where suppressed retries are counted — this is
+                # the loop's retryable-error path, not a swallow
+                log.debug("webhook attempt %d failed: %s", attempt, exc)
+            if not self.policy.allow_retry(attempt):
+                self._m_failed.inc()
+                return False
+            prev_sleep = self.policy.backoff(attempt, prev_sleep)
+            if prev_sleep > 0:
+                time.sleep(min(prev_sleep, max(deadline - time.monotonic(), 0)))

@@ -1,0 +1,656 @@
+"""The ruler: periodic PromQL recording + alerting over stored series.
+
+The coordinator-side rule engine that closes the self-monitoring loop:
+PR 6 made the fleet's telemetry first-class stored series under
+``_m3tpu``; the ruler is what ACTS on it (and on any other namespace).
+Shape follows the Prometheus ruler paired with M3's versioned-ruleset
+discipline (``m3_tpu/rules/`` does the same for aggregation rules):
+
+- **one shared ruleset** — rule groups load from a YAML/JSON file at
+  coordinator start and are mirrored into the etcd-style KV store under
+  :data:`RULESET_KEY` (CAS-versioned, exactly the r2 RuleStore pattern),
+  so every coordinator watching the key runs the same version and the
+  ruleset survives coordinator failover;
+- **per-group fixed-rate evaluation** — each group evaluates on its own
+  schedule through the coordinator's existing per-namespace engine cache
+  (``engine_for``), with the deterministic phase jitter of
+  utils/schedule.py so group evals and fleet scrapes spread over the
+  interval instead of herding the write path;
+- **recording rules** write their derived (colon-named) series back
+  through the NORMAL write path inside ``selfmon.guard.ruler_writer()``
+  — the second sanctioned reserved-namespace writer, so derived
+  ``_m3tpu`` series land next to their inputs while every other ingest
+  surface still gets a typed error;
+- **alert rules** run the inactive→pending→firing machine
+  (ruler/state.py) with per-group firing state CHECKPOINTED to KV after
+  each state change — a coordinator restart or leader change restores
+  ``for:`` clocks and already-fired instances instead of resetting and
+  re-notifying. A dead KV degrades loudly: evaluation continues from the
+  in-memory state and every dropped checkpoint ticks
+  ``m3tpu_ruler_checkpoint_failures_total``;
+- **self-metrics** — per-group eval duration/failure/missed-tick series
+  and active/pending/firing gauges, which the PR 6 collector stores like
+  any other family: ruler health is itself alertable by a ruler rule.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..block.core import make_tags
+from ..selfmon.guard import is_reserved, ruler_writer
+from ..utils.instrument import DEFAULT as METRICS
+from ..utils.schedule import FixedRateTicker
+from .notify import LogNotifier, alert_event
+from .rules import AlertRule, RecordingRule, groups_from_spec, groups_to_spec
+from .state import AlertRuleState, FIRING, PENDING
+
+NANOS = 1_000_000_000
+
+RULESET_KEY = "_ruler/ruleset"
+STATE_KEY_PREFIX = "_ruler/state/"
+
+# eval latencies look like query latencies (the eval IS a query)
+_EVAL_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class RulerStore:
+    """CAS-versioned KV mirror of the ruleset (r2/RuleStore pattern):
+    the stored value is ``{"version": n, "groups": [...]}``."""
+
+    def __init__(self, kv) -> None:
+        self.kv = kv
+
+    def get(self) -> tuple[dict | None, int]:
+        """(spec, ruleset_version); (None, 0) when no ruleset is stored."""
+        vv = self.kv.get(RULESET_KEY)
+        if vv is None or not isinstance(vv.value, dict):
+            return None, 0
+        return vv.value, int(vv.value.get("version", 0))
+
+    def set_spec(self, spec: dict) -> int:
+        """Store ``spec`` (validated groups dict) as the next ruleset
+        version; CAS loop against concurrent coordinators."""
+        groups = spec.get("groups", [])
+        while True:
+            vv = self.kv.get(RULESET_KEY)
+            cur_ver = 0
+            if vv is not None and isinstance(vv.value, dict):
+                cur_ver = int(vv.value.get("version", 0))
+            value = {"version": cur_ver + 1, "groups": groups}
+            try:
+                self.kv.check_and_set(
+                    RULESET_KEY, vv.version if vv is not None else 0, value
+                )
+                return cur_ver + 1
+            except ValueError:
+                continue  # lost the race; retry on fresh state
+
+    def mirror(self, spec: dict) -> int:
+        """Idempotent publish: bump the stored version only when the
+        GROUPS differ (a coordinator restart with an unchanged rules file
+        must not churn every peer's watch)."""
+        cur, ver = self.get()
+        if cur is not None and cur.get("groups") == spec.get("groups"):
+            return ver
+        return self.set_spec(spec)
+
+
+class GroupRunner:
+    """One rule group's evaluation loop + alert state + health record."""
+
+    def __init__(self, group, ruler: "Ruler") -> None:
+        self.group = group
+        self.ruler = ruler
+        self.states: dict[str, AlertRuleState] = {
+            r.alert: AlertRuleState()
+            for r in group.rules
+            if isinstance(r, AlertRule)
+        }
+        # per-rule health for /api/v1/rules: name -> record
+        self.health: dict[str, dict] = {
+            self._rule_name(r): {
+                "health": "unknown", "lastError": None,
+                "lastEvaluationUnixNanos": 0, "evaluationTime": 0.0,
+            }
+            for r in group.rules
+        }
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_checkpoint: dict | None = None
+        # guards self.states' AlertRuleState contents: the eval thread
+        # mutates instance dicts while HTTP projection threads
+        # (/api/v1/rules, /api/v1/alerts, /debug/dump) iterate them
+        self._state_lock = threading.Lock()
+        labels = {"group": group.name}
+        self._m_eval = METRICS.histogram(
+            "ruler_group_eval_duration_seconds",
+            "wall time of one rule-group evaluation pass",
+            labels=labels, buckets=_EVAL_BUCKETS,
+        )
+        self._m_failures = METRICS.counter(
+            "ruler_eval_failures_total",
+            "rule evaluations that raised (bad data, engine error)",
+            labels=labels,
+        )
+        self._m_missed = METRICS.counter(
+            "ruler_iterations_missed_total",
+            "scheduled group evaluations skipped because the loop fell a "
+            "full interval behind (eval slower than the group interval)",
+            labels=labels,
+        )
+        self._m_samples = METRICS.counter(
+            "ruler_recorded_samples_total",
+            "derived datapoints written by recording rules",
+            labels=labels,
+        )
+        self._g_active = METRICS.gauge(
+            "ruler_alerts_active", "pending + firing alert instances",
+            labels=labels,
+        )
+        self._g_pending = METRICS.gauge(
+            "ruler_alerts_pending", "alert instances holding their for: clock",
+            labels=labels,
+        )
+        self._g_firing = METRICS.gauge(
+            "ruler_alerts_firing", "firing alert instances", labels=labels
+        )
+
+    @staticmethod
+    def _rule_name(rule) -> str:
+        return rule.record if isinstance(rule, RecordingRule) else rule.alert
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        # the whole start rides under the ruler lock so it cannot
+        # interleave with Ruler.stop(): a KV watch _apply racing stop()
+        # must not leave evaluators running after stop() returned
+        # (shutdown writes into a closing database). Thread creation is
+        # non-blocking, so holding the lock here is cheap.
+        with self.ruler._lock:
+            if not self.ruler._started:
+                return
+            if self._thread is None:
+                # a runner stopped by a ruler stop() keeps its state;
+                # clear the stop latch so a later start() ticks again
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"ruler-{self.group.name}",
+                )
+                self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        ticker = FixedRateTicker(
+            self.group.interval_secs,
+            phase_key=f"ruler/{self.ruler.instance}/{self.group.name}",
+            stop=self._stop,
+            jitter=self.ruler.jitter,
+        )
+        while True:
+            stopped, missed = ticker.wait_next()
+            if stopped:
+                return
+            if missed:
+                self._m_missed.inc(missed)
+            self.eval_once(self.ruler.clock())
+
+    # -- one evaluation pass (the testable seam, like scrape_once) --
+
+    def eval_once(self, now_nanos: int) -> list[dict]:
+        """Evaluate every rule in file order at ``now_nanos``; returns the
+        notification events emitted this pass. Never raises — a bad rule
+        is counted and recorded in its health entry, and the rest of the
+        group still evaluates."""
+        t0 = time.perf_counter()
+        engine = self.ruler.engine_for(self.group.namespace)
+        events: list[dict] = []
+        state_changed = False
+        for rule in self.group.rules:
+            name = self._rule_name(rule)
+            health = self.health[name]
+            r0 = time.perf_counter()
+            try:
+                rows = self._rows(engine.query_instant(rule.expr, now_nanos))
+                if isinstance(rule, RecordingRule):
+                    self._record(rule, rows, now_nanos)
+                else:
+                    st = self.states[rule.alert]
+                    with self._state_lock:
+                        before = st.to_dict()
+                        transitions = st.evaluate(rule, rows, now_nanos)
+                        changed = bool(transitions) or st.to_dict() != before
+                    events.extend(
+                        alert_event(t.status, t.alert) for t in transitions
+                    )
+                    if changed:
+                        state_changed = True
+                health["health"] = "ok"
+                health["lastError"] = None
+            except Exception as exc:
+                self._m_failures.inc()
+                health["health"] = "err"
+                health["lastError"] = f"{type(exc).__name__}: {exc}"
+            health["lastEvaluationUnixNanos"] = now_nanos
+            health["evaluationTime"] = time.perf_counter() - r0
+        pending = firing = 0
+        with self._state_lock:
+            for st in self.states.values():
+                p, f = st.counts()
+                pending += p
+                firing += f
+        self._g_pending.set(float(pending))
+        self._g_firing.set(float(firing))
+        self._g_active.set(float(pending + firing))
+        if events:
+            self.ruler.dispatch(events)
+        if state_changed or events:
+            self.checkpoint(now_nanos)
+        self._m_eval.observe(time.perf_counter() - t0)
+        return events
+
+    @staticmethod
+    def _rows(result) -> list:
+        """Engine Result → instant vector rows [(labels dict, value)];
+        NaN rows (comparison filtered, no data in lookback) drop out."""
+        import math
+
+        import numpy as np
+
+        vals = np.asarray(result.values)
+        rows = []
+        for i, meta in enumerate(result.metas):
+            v = float(vals[i, -1]) if vals.size else float("nan")
+            if math.isnan(v):
+                continue
+            labels = {
+                k.decode("utf-8", "replace"): val.decode("utf-8", "replace")
+                for k, val in meta.tags
+            }
+            rows.append((labels, v))
+        return rows
+
+    def _record(self, rule, rows: list, now_nanos: int) -> None:
+        """Write a recording rule's instant vector back through the
+        normal tagged write path as series named ``rule.record``."""
+        if not rows:
+            return
+        entries = []
+        for labels, value in rows:
+            tags = {k: v for k, v in labels.items() if k != "__name__"}
+            tags.update(rule.labels)
+            tags["__name__"] = rule.record
+            entries.append((make_tags(tags), now_nanos, value, 1))
+        self.ruler.ensure_namespace(self.group.namespace)
+        with ruler_writer():
+            errs = self.ruler.db.write_tagged_batch(
+                self.group.namespace, entries
+            )
+        failed = sum(1 for e in errs if e)
+        if failed:
+            raise RuntimeError(
+                f"recording rule {rule.record!r}: {failed}/{len(entries)} "
+                f"writes failed (first: {next(e for e in errs if e)})"
+            )
+        self._m_samples.inc(len(entries))
+
+    # -- KV checkpoint (restart/failover durability) --
+
+    def checkpoint(self, now_nanos: int) -> bool:
+        """Persist this group's alert state to KV; False (and a loud
+        counter tick) when the KV is unreachable — evaluation carries on
+        from memory either way."""
+        if self.ruler.kv is None or not self.states:
+            return True
+        with self._state_lock:
+            rules_snap = {
+                name: st.to_dict() for name, st in self.states.items()
+            }
+        snap = {"checkpointUnixNanos": now_nanos, "rules": rules_snap}
+        if snap["rules"] == self._last_checkpoint:
+            return True
+        try:
+            self.ruler.kv.set(STATE_KEY_PREFIX + self.group.name, snap)
+        except Exception:
+            self.ruler._m_checkpoint_failures.inc()
+            return False
+        self._last_checkpoint = snap["rules"]
+        return True
+
+    def restore(self, prior: "GroupRunner" = None) -> None:
+        """Adopt alert state: from the prior in-memory runner on a live
+        ruleset reload, else from the KV checkpoint (coordinator restart
+        / leader change) — either way ``for:`` clocks and already-fired
+        instances carry over, so nothing re-fires or resets."""
+        if prior is not None:
+            # deep-copy (serialize round-trip) rather than alias: the
+            # prior runner's eval thread can outlive its stop() join
+            # timeout on a slow query, and two evaluators mutating the
+            # SAME ActiveAlert objects under different locks would tear
+            # state — a lingering thread now only touches its own copy
+            with prior._state_lock:
+                carried = {
+                    name: st.to_dict() for name, st in prior.states.items()
+                }
+                self._last_checkpoint = prior._last_checkpoint
+            for name, raw in carried.items():
+                if name in self.states:
+                    self.states[name] = AlertRuleState.from_dict(raw)
+            return
+        if self.ruler.kv is None:
+            return
+        try:
+            vv = self.ruler.kv.get(STATE_KEY_PREFIX + self.group.name)
+        except Exception:
+            self.ruler._m_checkpoint_failures.inc()
+            return
+        if vv is None or not isinstance(vv.value, dict):
+            return
+        rules = vv.value.get("rules", {})
+        for name, raw in rules.items():
+            if name in self.states:
+                self.states[name] = AlertRuleState.from_dict(raw)
+        self._last_checkpoint = {
+            name: st.to_dict() for name, st in self.states.items()
+        }
+
+    # -- HTTP projections --
+
+    def rule_dicts(self) -> list[dict]:
+        out = []
+        for rule in self.group.rules:
+            name = self._rule_name(rule)
+            h = self.health[name]
+            base = {
+                "name": name,
+                "query": rule.expr,
+                "health": h["health"],
+                "lastError": h["lastError"],
+                "lastEvaluation": h["lastEvaluationUnixNanos"] / 1e9,
+                "evaluationTime": h["evaluationTime"],
+                "labels": dict(rule.labels),
+            }
+            if isinstance(rule, RecordingRule):
+                base["type"] = "recording"
+            else:
+                st = self.states[rule.alert]
+                with self._state_lock:
+                    pending, firing = st.counts()
+                    alerts = self._alert_dicts(st)
+                base.update(
+                    type="alerting",
+                    duration=rule.for_secs,
+                    annotations=dict(rule.annotations),
+                    state=(
+                        "firing" if firing else
+                        "pending" if pending else "inactive"
+                    ),
+                    alerts=alerts,
+                )
+            out.append(base)
+        return out
+
+    def alert_dicts(self) -> list[dict]:
+        """Locked snapshot of every rule's active alert instances."""
+        with self._state_lock:
+            return [
+                row
+                for st in self.states.values()
+                for row in self._alert_dicts(st)
+            ]
+
+    @staticmethod
+    def _alert_dicts(st: AlertRuleState) -> list[dict]:
+        """Caller holds ``_state_lock``."""
+        return [
+            {
+                "labels": dict(a.labels),
+                "annotations": dict(a.annotations),
+                "state": a.state,
+                "activeAt": a.active_at_nanos / 1e9,
+                "value": a.value,
+            }
+            for a in st.active.values()
+        ]
+
+
+class Ruler:
+    """The per-coordinator rule engine: owns the group runners, the KV
+    ruleset watch, and the notifier fan-out.
+
+    ``engine_for(namespace)`` and ``db`` are the coordinator's existing
+    query/write surfaces; ``kv`` may be None (standalone coordinator: no
+    shared ruleset, no durable checkpoints — still evaluates);
+    ``ensure_namespace(ns)`` is the coordinator hook that creates the
+    reserved namespace on demand; ``clock`` returns data-timestamp nanos
+    (injectable for the lifecycle tests)."""
+
+    def __init__(
+        self,
+        engine_for,
+        db,
+        kv=None,
+        notifiers=None,
+        instance: str = "",
+        default_namespace: str = "default",
+        ensure_namespace=None,
+        clock=None,
+        jitter: bool = True,
+    ) -> None:
+        self.engine_for = engine_for
+        self.db = db
+        self.kv = kv
+        self.log_notifier = LogNotifier()
+        self.notifiers = [self.log_notifier] + list(notifiers or ())
+        self.instance = instance
+        self.default_namespace = default_namespace
+        self._ensure_namespace = ensure_namespace
+        self.clock = clock or time.time_ns
+        self.jitter = jitter
+        self._lock = threading.Lock()
+        self._runners: dict[str, GroupRunner] = {}
+        self._started = False
+        self._ruleset_version = 0
+        self._unsub = None
+        self._ensured: set = set()
+        self._m_checkpoint_failures = METRICS.counter(
+            "ruler_checkpoint_failures_total",
+            "ruler KV operations (alert-state checkpoints, ruleset "
+            "mirror/watch) dropped because the KV store was unreachable "
+            "— evaluation continues from memory, loudly; a restart "
+            "during a nonzero streak may reset for: clocks",
+        )
+        self._m_reloads = METRICS.counter(
+            "ruler_ruleset_reloads_total",
+            "ruleset (re)loads applied from the KV mirror or a file",
+        )
+        self._m_reload_errors = METRICS.counter(
+            "ruler_ruleset_reload_errors_total",
+            "ruleset updates rejected by validation (the previous "
+            "ruleset keeps running)",
+        )
+        self._m_notifications = METRICS.counter(
+            "ruler_notifications_total", "alert events handed to notifiers"
+        )
+        self._m_notification_failures = METRICS.counter(
+            "ruler_notification_failures_total",
+            "notifier deliveries that failed (per notifier per batch)",
+        )
+
+    # -- namespace hook --
+
+    def ensure_namespace(self, ns: str) -> None:
+        if ns in self._ensured:
+            return
+        if self._ensure_namespace is not None and is_reserved(ns):
+            self._ensure_namespace(ns)
+        self._ensured.add(ns)
+
+    # -- ruleset management --
+
+    def publish(self, spec: dict) -> int:
+        """Validate + mirror a ruleset spec into KV (all coordinators
+        pick it up via their watch), falling back to a direct local load
+        when there is no KV. Returns the ruleset version."""
+        groups = groups_from_spec(spec, self.default_namespace)
+        if self.kv is None:
+            self._apply(groups, version=self._ruleset_version + 1)
+            return self._ruleset_version
+        try:
+            version = RulerStore(self.kv).mirror(groups_to_spec(groups))
+        except Exception:
+            # dead control plane at start: run the file's rules anyway —
+            # alerting from local state beats not alerting; counted below
+            self._m_checkpoint_failures.inc()
+            self._apply(groups, version=self._ruleset_version + 1)
+            return self._ruleset_version
+        # apply OUR spec under the version mirror() assigned it (a fresh
+        # get() here could race a concurrent publisher and pin ITS version
+        # number onto OUR groups, wedging the watch's staleness check);
+        # if someone else published a newer version meanwhile, the watch
+        # delivers it and _on_ruleset supersedes this apply
+        self._apply(groups, version=version)
+        return self._ruleset_version
+
+    def _on_ruleset(self, vv) -> None:
+        """KV watch callback: another coordinator (or our own mirror)
+        published a ruleset version."""
+        value = getattr(vv, "value", None)
+        if not isinstance(value, dict):
+            return
+        version = int(value.get("version", 0))
+        with self._lock:
+            # <= not ==: watch callbacks fire outside the KV store lock,
+            # so deliveries can arrive out of order — a late v4 after v5
+            # must not downgrade the live ruleset
+            if version <= self._ruleset_version:
+                return
+        try:
+            groups = groups_from_spec(value, self.default_namespace)
+        except Exception:
+            self._m_reload_errors.inc()
+            return
+        self._apply(groups, version=version)
+
+    def _apply(self, groups, version: int) -> None:
+        """Swap in a validated group list: stop removed/changed runners,
+        carry alert state across by group+rule name, start the rest."""
+        with self._lock:
+            if self._runners and version <= self._ruleset_version:
+                return  # stale/duplicate apply (the watch already won)
+            old = self._runners
+            new: dict[str, GroupRunner] = {}
+            for g in groups:
+                prior = old.get(g.name)
+                if prior is not None and prior.group == g:
+                    # unchanged group: keep the live runner untouched
+                    new[g.name] = prior
+                    continue
+                # changed group: carry the prior runner's in-memory state;
+                # brand-new group (restart/failover): restore falls back
+                # to the durable KV checkpoint
+                runner = GroupRunner(g, self)
+                runner.restore(prior=prior)
+                new[g.name] = runner
+            self._runners = new
+            self._ruleset_version = version
+            started = self._started
+            stale = [
+                r for name, r in old.items()
+                if new.get(name) is not r
+            ]
+        for r in stale:
+            r.stop()
+        # groups REMOVED from the ruleset take their durable checkpoint
+        # with them — a future group reusing the name must not resurrect
+        # obsolete alert state (spurious 'resolved' notifications for
+        # alerts that never fired in the new incarnation)
+        if self.kv is not None:
+            for name in set(old) - set(new):
+                try:
+                    self.kv.delete(STATE_KEY_PREFIX + name)
+                except Exception:
+                    self._m_checkpoint_failures.inc()
+        if started:
+            for r in new.values():
+                r.start()
+        self._m_reloads.inc()
+
+    # -- lifecycle --
+
+    def start(self) -> "Ruler":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            runners = list(self._runners.values())
+        if self.kv is not None and self._unsub is None:
+            try:
+                self._unsub = self.kv.watch(RULESET_KEY, self._on_ruleset)
+            except Exception:
+                # no live watch on a dead KV: the local ruleset still runs
+                self._m_checkpoint_failures.inc()
+        for r in runners:
+            r.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._started = False
+            runners = list(self._runners.values())
+            unsub, self._unsub = self._unsub, None
+        if unsub is not None:
+            try:
+                unsub()
+            except Exception:
+                # m3lint: disable=M3L007 -- best-effort watch teardown on shutdown
+                pass
+        for r in runners:
+            r.stop()
+
+    # -- notifications --
+
+    def dispatch(self, events: list[dict]) -> None:
+        self._m_notifications.inc(len(events))
+        for notifier in self.notifiers:
+            try:
+                ok = notifier.notify(list(events))
+            except Exception:
+                ok = False
+            if not ok:
+                self._m_notification_failures.inc()
+
+    # -- HTTP projections (Prometheus rules/alerts API shapes) --
+
+    def runners(self) -> list[GroupRunner]:
+        with self._lock:
+            return list(self._runners.values())
+
+    def rules_dict(self) -> dict:
+        groups = [
+            {
+                "name": r.group.name,
+                "namespace": r.group.namespace,
+                "interval": r.group.interval_secs,
+                "rules": r.rule_dicts(),
+            }
+            for r in self.runners()
+        ]
+        return {"groups": groups, "rulesetVersion": self._ruleset_version}
+
+    def alerts_dict(self) -> dict:
+        alerts = []
+        for r in self.runners():
+            alerts.extend(r.alert_dicts())
+        return {"alerts": alerts}

@@ -1,0 +1,201 @@
+"""Rule model: recording + alerting rules in named groups.
+
+Mirrors the Prometheus rule-file schema (rule_group / recording_rule /
+alerting_rule) so existing rule files translate line for line, plus one
+m3-ism: every group names the storage ``namespace`` its expressions
+evaluate over (the coordinator routes it through its per-namespace
+engine cache, so ``namespace: _m3tpu`` rules run over the fleet's own
+stored telemetry — the self-monitoring loop this subsystem closes).
+
+Validation happens at load time, loudly: a rule file with an unparsable
+PromQL expression, a non-colon recording name (the ``level:metric:op``
+convention is ENFORCED here, not suggested — selfmon/convert.py and
+m3lint M3L005 rely on colon-form names meaning "derived by the ruler"),
+or a duplicate group name never makes it into the KV mirror.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..selfmon.convert import is_recorded_name
+
+NANOS = 1_000_000_000
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d)?$")
+_DURATION_MULT = {
+    "ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0, None: 1.0
+}
+
+# alert/recording names also label the ruler's own per-group metrics and
+# the ALERTS-style output; keep them to the same grammar Prometheus does
+_ALERT_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def parse_duration(v) -> float:
+    """'30s' / '5m' / '1.5h' / bare number (seconds) → seconds."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    m = _DURATION_RE.match(str(v).strip())
+    if m is None:
+        raise ValueError(f"bad duration {v!r}")
+    return float(m.group(1)) * _DURATION_MULT[m.group(2)]
+
+
+def _str_map(d, what: str) -> dict:
+    if d is None:
+        return {}
+    if not isinstance(d, dict):
+        raise ValueError(f"{what} must be a mapping, got {type(d).__name__}")
+    return {str(k): str(v) for k, v in d.items()}
+
+
+@dataclass(frozen=True)
+class RecordingRule:
+    """``record: <level:metric:op>  expr: <promql>  labels: {...}`` —
+    each evaluation writes the expression's instant vector back through
+    the normal write path as series named ``record`` (input labels kept,
+    ``labels`` overriding), under the ruler writer context."""
+
+    record: str
+    expr: str
+    labels: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        out = {"record": self.record, "expr": self.expr}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """``alert: <Name>  expr: <promql>  for: <duration>`` — the instant
+    vector's series are the alert instances; each runs the
+    inactive→pending→firing state machine (ruler/state.py) with
+    ``for_secs`` of sustained truth required before firing. ``labels`` /
+    ``annotations`` values support ``{{ $value }}`` and
+    ``{{ $labels.x }}`` templating."""
+
+    alert: str
+    expr: str
+    for_secs: float = 0.0
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        out: dict = {"alert": self.alert, "expr": self.expr}
+        if self.for_secs:
+            out["for"] = self.for_secs
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        if self.annotations:
+            out["annotations"] = dict(self.annotations)
+        return out
+
+
+@dataclass(frozen=True)
+class RuleGroup:
+    """A named set of rules evaluated together on one fixed-rate
+    schedule, in file order. A recording rule's output reaches later
+    rules through the normal ingest path, not a same-tick overlay:
+    synchronously visible on an embedded local store, next-tick across a
+    cluster session's quorum write."""
+
+    name: str
+    interval_secs: float
+    namespace: str
+    rules: tuple = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "interval": self.interval_secs,
+            "namespace": self.namespace,
+            "rules": [r.to_dict() for r in self.rules],
+        }
+
+
+def rule_from_dict(d: dict):
+    if not isinstance(d, dict):
+        raise ValueError(f"rule must be a mapping, got {type(d).__name__}")
+    expr = d.get("expr")
+    if not expr or not isinstance(expr, str):
+        raise ValueError(f"rule {d!r} has no expr")
+    # parse at load time: a bad expression must fail the file/KV update,
+    # not every future evaluation tick
+    from ..query.promql import parse as parse_promql
+
+    parse_promql(expr)
+    if "record" in d and "alert" in d:
+        raise ValueError(f"rule {d!r} sets both record and alert")
+    if "record" in d:
+        record = str(d["record"])
+        if not is_recorded_name(record):
+            raise ValueError(
+                f"recording rule name {record!r} must follow the "
+                "level:metric:operation colon convention "
+                "(selfmon/convert.RECORDED_NAME_RE)"
+            )
+        return RecordingRule(
+            record=record, expr=expr, labels=_str_map(d.get("labels"), "labels")
+        )
+    if "alert" in d:
+        name = str(d["alert"])
+        if not _ALERT_NAME_RE.match(name):
+            raise ValueError(f"bad alert name {name!r}")
+        return AlertRule(
+            alert=name,
+            expr=expr,
+            for_secs=parse_duration(d.get("for", 0)),
+            labels=_str_map(d.get("labels"), "labels"),
+            annotations=_str_map(d.get("annotations"), "annotations"),
+        )
+    raise ValueError(f"rule {d!r} is neither a record nor an alert rule")
+
+
+def group_from_dict(d: dict, default_namespace: str = "default") -> RuleGroup:
+    name = d.get("name")
+    if not name:
+        raise ValueError(f"rule group {d!r} has no name")
+    interval = parse_duration(d.get("interval", 30))
+    if interval <= 0:
+        raise ValueError(f"group {name!r}: interval must be positive")
+    return RuleGroup(
+        name=str(name),
+        interval_secs=interval,
+        namespace=str(d.get("namespace", default_namespace)),
+        rules=tuple(rule_from_dict(r) for r in d.get("rules", ())),
+    )
+
+
+def groups_from_spec(spec: dict, default_namespace: str = "default") -> list:
+    """A parsed rules file / KV ruleset value → validated RuleGroups."""
+    if not isinstance(spec, dict):
+        raise ValueError("rules spec must be a mapping with a 'groups' list")
+    groups = [
+        group_from_dict(g, default_namespace) for g in spec.get("groups", ())
+    ]
+    seen: set = set()
+    for g in groups:
+        if g.name in seen:
+            raise ValueError(f"duplicate rule group name {g.name!r}")
+        seen.add(g.name)
+    return groups
+
+
+def groups_to_spec(groups) -> dict:
+    """Inverse of :func:`groups_from_spec` — the wire-safe dict form the
+    KV mirror stores (JSON-clean: plain dicts/lists/strings/floats)."""
+    return {"groups": [g.to_dict() for g in groups]}
+
+
+def load_rules_file(path: str, default_namespace: str = "default") -> list:
+    """Load + validate a rule file (YAML or JSON — JSON is a YAML subset,
+    so one loader covers both, same as utils/config.py)."""
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        spec = yaml.safe_load(f.read()) or {}
+    return groups_from_spec(spec, default_namespace)

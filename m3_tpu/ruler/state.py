@@ -1,0 +1,181 @@
+"""Alert state machine: inactive → pending → firing, with durable clocks.
+
+One :class:`AlertRuleState` per alert rule tracks an :class:`ActiveAlert`
+per result label set (the alert *instance*, keyed by a stable label
+fingerprint). Transitions per evaluation:
+
+- expression true, previously inactive → PENDING (``active_at`` = the
+  evaluation timestamp); rules with ``for: 0`` skip straight to FIRING;
+- PENDING and ``now - active_at >= for`` → FIRING (one ``firing``
+  notification);
+- FIRING and expression false → resolved (one ``resolved`` notification,
+  instance removed);
+- PENDING and expression false → back to inactive silently (the
+  condition never held long enough to tell anyone).
+
+Clock discipline (M3L004): the ``for:`` hold is arithmetic over
+EVALUATION timestamps — data-clock nanos handed in by the scheduler, the
+same instants the queries evaluate at — never ``time.time()`` readings
+taken here. That makes the clocks durable: checkpointed ``active_at``
+values stay meaningful across a coordinator restart or leader change
+(a monotonic reading would not), which is what lets a restored ruler
+continue a pending alert's hold instead of resetting it, and lets an
+alert that fired before the restart stay fired without re-notifying
+(notifications happen only on TRANSITIONS).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+
+NANOS = 1_000_000_000
+
+INACTIVE = "inactive"
+PENDING = "pending"
+FIRING = "firing"
+
+# {{ $value }} / {{ $labels.<name> }} templating in labels/annotations
+_TMPL_RE = re.compile(
+    r"\{\{\s*\$(?:(value)|labels\.([a-zA-Z_][a-zA-Z0-9_]*))\s*\}\}"
+)
+
+
+def render_template(tmpl: str, labels: dict, value: float) -> str:
+    """Expand ``{{ $value }}`` and ``{{ $labels.x }}`` (missing labels
+    expand empty, matching Prometheus's zero-value semantics)."""
+
+    def _sub(m: re.Match) -> str:
+        if m.group(1):
+            return format(value, "g")
+        return str(labels.get(m.group(2), ""))
+
+    return _TMPL_RE.sub(_sub, str(tmpl))
+
+
+def fingerprint(labels: dict) -> str:
+    """Stable alert-instance key: JSON of the sorted label items (JSON so
+    it round-trips as a KV checkpoint dict key)."""
+    return json.dumps(sorted(labels.items()), separators=(",", ":"))
+
+
+@dataclass
+class ActiveAlert:
+    """One live alert instance (a PENDING or FIRING label set)."""
+
+    labels: dict
+    annotations: dict
+    state: str
+    active_at_nanos: int
+    value: float = 0.0
+    fired_at_nanos: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+            "state": self.state,
+            "activeAt": self.active_at_nanos,
+            "value": self.value,
+            "firedAt": self.fired_at_nanos,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ActiveAlert":
+        return cls(
+            labels={str(k): str(v) for k, v in d.get("labels", {}).items()},
+            annotations={
+                str(k): str(v) for k, v in d.get("annotations", {}).items()
+            },
+            state=str(d.get("state", PENDING)),
+            active_at_nanos=int(d.get("activeAt", 0)),
+            value=float(d.get("value", 0.0)),
+            fired_at_nanos=int(d.get("firedAt", 0)),
+        )
+
+
+@dataclass
+class Transition:
+    """A state change the notifier should hear about."""
+
+    status: str  # "firing" | "resolved"
+    alert: ActiveAlert
+
+
+@dataclass
+class AlertRuleState:
+    """All live instances of one alert rule, keyed by fingerprint."""
+
+    active: dict = field(default_factory=dict)  # fp -> ActiveAlert
+
+    def evaluate(
+        self, rule, rows: list, now_nanos: int
+    ) -> list[Transition]:
+        """Apply one evaluation result. ``rows`` is the instant vector as
+        ``[(series_labels: dict, value: float), ...]`` (only series where
+        the expression held); ``now_nanos`` is the evaluation timestamp.
+        Returns the transitions (firing/resolved) in result order."""
+        for_nanos = int(rule.for_secs * NANOS)
+        transitions: list[Transition] = []
+        seen: set = set()
+        for series_labels, value in rows:
+            # alert identity: series labels minus __name__, plus the
+            # rule's (templated) labels, plus alertname — Prometheus's
+            # ALERTS label algebra
+            ident = {
+                k: v for k, v in series_labels.items() if k != "__name__"
+            }
+            for k, v in rule.labels.items():
+                ident[k] = render_template(v, series_labels, value)
+            ident["alertname"] = rule.alert
+            fp = fingerprint(ident)
+            seen.add(fp)
+            annotations = {
+                k: render_template(v, series_labels, value)
+                for k, v in rule.annotations.items()
+            }
+            cur = self.active.get(fp)
+            if cur is None:
+                cur = ActiveAlert(
+                    labels=ident,
+                    annotations=annotations,
+                    state=PENDING,
+                    active_at_nanos=now_nanos,
+                    value=value,
+                )
+                self.active[fp] = cur
+            else:
+                cur.value = value
+                cur.annotations = annotations
+            if (
+                cur.state == PENDING
+                and now_nanos - cur.active_at_nanos >= for_nanos
+            ):
+                cur.state = FIRING
+                cur.fired_at_nanos = now_nanos
+                transitions.append(Transition("firing", cur))
+        # instances whose condition cleared
+        for fp in [fp for fp in self.active if fp not in seen]:
+            gone = self.active.pop(fp)
+            if gone.state == FIRING:
+                transitions.append(Transition("resolved", gone))
+        return transitions
+
+    def counts(self) -> tuple[int, int]:
+        """(pending, firing) instance counts."""
+        pending = sum(1 for a in self.active.values() if a.state == PENDING)
+        firing = sum(1 for a in self.active.values() if a.state == FIRING)
+        return pending, firing
+
+    # -- KV checkpoint codec --
+
+    def to_dict(self) -> dict:
+        return {fp: a.to_dict() for fp, a in self.active.items()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AlertRuleState":
+        st = cls()
+        for fp, raw in (d or {}).items():
+            st.active[fp] = ActiveAlert.from_dict(raw)
+        return st

@@ -3,12 +3,14 @@ first-class series under the reserved ``_m3tpu`` namespace, queryable by
 the existing PromQL engine (see collector.py for the full loop)."""
 
 from .collector import DatabaseSink, MsgSink, SELFMON_MARKER, SelfMonCollector
-from .convert import snapshot_to_datapoints
+from .convert import is_recorded_name, snapshot_to_datapoints
 from .guard import (
     RESERVED_NS,
     ReservedNamespaceError,
     check_write,
     is_reserved,
+    ruler_writer,
+    ruler_writer_active,
     selfmon_writer,
     wire_writer,
     writer_active,
@@ -19,11 +21,14 @@ __all__ = [
     "MsgSink",
     "SELFMON_MARKER",
     "SelfMonCollector",
+    "is_recorded_name",
     "snapshot_to_datapoints",
     "RESERVED_NS",
     "ReservedNamespaceError",
     "check_write",
     "is_reserved",
+    "ruler_writer",
+    "ruler_writer_active",
     "selfmon_writer",
     "wire_writer",
     "writer_active",

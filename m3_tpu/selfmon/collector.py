@@ -131,9 +131,15 @@ class SelfMonCollector:
         )
         self._m_truncated = METRICS.counter(
             "selfmon_truncated_total",
-            "datapoints dropped by the per-snapshot cardinality cap "
-            "(never silently: a nonzero value means a snapshot exceeded "
-            "convert.MAX_DATAPOINTS_PER_SNAPSHOT)",
+            "datapoints dropped loudly at conversion: the per-snapshot "
+            "cardinality cap (convert.MAX_DATAPOINTS_PER_SNAPSHOT) or the "
+            "colon-name guard (recorded-form families in a peer snapshot)",
+        )
+        self._m_missed = METRICS.counter(
+            "selfmon_ticks_missed_total",
+            "scheduled scrape ticks skipped because the loop fell a full "
+            "interval behind (a stalled sink or long pause; the schedule "
+            "skips forward instead of bursting to catch up)",
         )
 
     # -- one tick (the testable unit) --
@@ -194,7 +200,23 @@ class SelfMonCollector:
         return self
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
+        # fixed-rate schedule with a deterministic per-instance phase
+        # (utils/schedule.py): scrape work no longer drifts the period,
+        # and a fleet of collectors (and ruler groups) spreads over the
+        # interval instead of hitting the write path in lockstep
+        from ..utils.schedule import FixedRateTicker
+
+        ticker = FixedRateTicker(
+            self.interval,
+            phase_key=f"selfmon/{self.instance}/{self.component}",
+            stop=self._stop,
+        )
+        while True:
+            stopped, missed = ticker.wait_next()
+            if stopped:
+                return
+            if missed:
+                self._m_missed.inc(missed)
             self.scrape_once()
 
     def stop(self) -> None:

@@ -17,11 +17,22 @@ Feedback-loop guard: children whose label VALUES name a reserved
 namespace (``ns="_m3tpu"`` write-path counters) are skipped — the
 collector's own storage writes never re-enter the telemetry it stores
 (selfmon/guard.py invariant 2).
+
+Name-discipline guard: colon-form names (the Prometheus
+``level:metric:operation`` recording-rule convention, see
+:data:`RECORDED_NAME_RE`) may enter storage ONLY from the ruler's writer
+context (selfmon/guard.ruler_writer) — they assert "this series was
+derived by a configured recording rule". The registry's own families are
+m3lint-enforced snake_case, so a colon family can only appear in a PEER
+snapshot pulled over the wire; converting it would let a buggy or
+malicious peer forge recorded series outside the ruler. Such families are
+skipped and counted in the loud drop tally.
 """
 
 from __future__ import annotations
 
 import math
+import re
 
 from ..block.core import make_tags
 from .guard import RESERVED_NS
@@ -31,6 +42,19 @@ from .guard import RESERVED_NS
 # must not be: cap datapoints per converted snapshot, loudly (the caller
 # counts truncations — no silent caps).
 MAX_DATAPOINTS_PER_SNAPSHOT = 50_000
+
+# the Prometheus recording-rule naming convention: colon-separated
+# snake_case segments, at least one colon (`level:metric:operation`).
+# Shared by the ruler (which REQUIRES recorded names to match) and this
+# module's skip-logic (which rejects them from any other ingest source);
+# m3lint M3L005 enforces the same split statically.
+RECORDED_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*(:[a-z_][a-z0-9_]*)+$")
+
+
+def is_recorded_name(name: str) -> bool:
+    """Whether ``name`` follows the recording-rule colon convention —
+    legal only for series written from the ruler writer context."""
+    return RECORDED_NAME_RE.match(name) is not None
 
 
 def format_le(bound: float) -> str:
@@ -53,7 +77,9 @@ def snapshot_to_datapoints(
 
     Returns ``(entries, truncated)`` where entries are
     ``(tags, time_nanos, value)`` and ``truncated`` counts datapoints
-    dropped by the ``max_datapoints`` cap (0 in any healthy scrape).
+    dropped loudly — by the ``max_datapoints`` cap or by the colon-name
+    guard (0 in any healthy scrape; registry families are snake_case by
+    lint, so colon families only arrive in forged/buggy peer snapshots).
     """
     out: list = []
     truncated = 0
@@ -73,6 +99,17 @@ def snapshot_to_datapoints(
         )
 
     for name, fam in snapshot.items():
+        if ":" in name:
+            # recorded-name guard: colon-form series come ONLY from the
+            # ruler writer context, never from a scraped registry. The
+            # drop tally counts what WOULD have been emitted (a histogram
+            # child is its whole bucket/sum/count expansion, not 1)
+            for child in fam.get("children", ()):
+                if fam.get("kind") == "histogram":
+                    truncated += len(child.get("buckets", ())) + 2
+                else:
+                    truncated += 1
+            continue
         kind = fam.get("kind")
         for child in fam.get("children", ()):
             labels = {str(k): str(v) for k, v in child.get("labels", {}).items()}

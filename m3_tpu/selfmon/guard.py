@@ -28,6 +28,16 @@ writing through a local Database) compose. Replication paths — peer
 bootstrap and repair — also run inside it: they MOVE telemetry a
 sanctioned writer already admitted on the source replica, which is not a
 new ingest decision.
+
+The RULER (m3_tpu/ruler/) is the second sanctioned writer: recording
+rules derive new series FROM stored telemetry and write them back through
+the normal path, including into the reserved namespace (an error-rate
+recorded over ``m3tpu_rpc_*`` belongs next to its inputs). It declares
+intent with :func:`ruler_writer` — a distinct context so name-discipline
+rules can tell the two writers apart (colon-form ``level:metric:op``
+recorded names are legal ONLY from the ruler context; the collector's
+conversion skips them — selfmon/convert.py), while :func:`check_write`
+accepts both.
 """
 
 from __future__ import annotations
@@ -60,12 +70,37 @@ def writer_active() -> bool:
 @contextmanager
 def selfmon_writer():
     """Declare self-monitoring write intent for the current thread —
-    the ONLY way through :func:`check_write` for a reserved namespace."""
+    one of the two ways through :func:`check_write` for a reserved
+    namespace (the other is the ruler's :func:`ruler_writer`)."""
     _local.depth = getattr(_local, "depth", 0) + 1
     try:
         yield
     finally:
         _local.depth -= 1
+
+
+def ruler_writer_active() -> bool:
+    """Whether this thread is inside a ruler writer context (recording
+    rules writing derived series — the only context whose series may use
+    colon-form recorded names)."""
+    return getattr(_local, "ruler_depth", 0) > 0
+
+
+@contextmanager
+def ruler_writer():
+    """Declare ruler (recording-rule) write intent for the current thread.
+
+    Nests a :func:`selfmon_writer` so every existing seam keeps working —
+    :func:`check_write` admits the write, and the cluster client's wire
+    ``selfmon`` marker rides reserved-namespace RPCs as usual — while the
+    extra thread-local flag lets name-discipline checks distinguish the
+    ruler from the collector."""
+    _local.ruler_depth = getattr(_local, "ruler_depth", 0) + 1
+    try:
+        with selfmon_writer():
+            yield
+    finally:
+        _local.ruler_depth -= 1
 
 
 def wire_writer(flag) -> object:

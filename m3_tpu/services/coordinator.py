@@ -83,6 +83,7 @@ class Coordinator:
         self._engines: dict[str, Engine] = {namespace: self.engine}
         self._engines_lock = threading.Lock()
         self.selfmon = None  # SelfMonCollector when start_selfmon() ran
+        self.ruler = None  # ruler.Ruler when start_ruler() ran
         self._selfmon_ns_ready = False
 
     def engine_for(self, namespace: str | None) -> Engine:
@@ -128,6 +129,43 @@ class Coordinator:
         )
         self.selfmon.start()
         return self.selfmon
+
+    # --- ruler (m3_tpu/ruler/): recording + alerting over stored series ---
+
+    def start_ruler(
+        self,
+        rules_path: str | None = None,
+        webhooks=(),
+        instance: str = "coordinator0",
+        jitter: bool = True,
+    ):
+        """Start the rule engine: groups from ``rules_path`` (YAML/JSON)
+        are validated, mirrored into the shared KV ruleset (all
+        coordinators converge on one version; alert state checkpoints
+        survive failover), and evaluated per group through the same
+        per-namespace engine cache the HTTP query surface uses — so
+        ``namespace: _m3tpu`` rules watch the fleet's own stored
+        telemetry. ``webhooks``: notifier URLs (each gets the resilience
+        plane's retry policy); a log notifier is always attached."""
+        from ..ruler import Ruler, WebhookNotifier, groups_to_spec
+
+        self.ruler = Ruler(
+            engine_for=self.engine_for,
+            db=self.db,
+            kv=self.kv,
+            notifiers=[WebhookNotifier(u) for u in webhooks],
+            instance=instance,
+            default_namespace=self.namespace,
+            ensure_namespace=lambda ns: self._ensure_selfmon_namespace(),
+            jitter=jitter,
+        )
+        if rules_path:
+            from ..ruler import load_rules_file
+
+            groups = load_rules_file(rules_path, self.namespace)
+            self.ruler.publish(groups_to_spec(groups))
+        self.ruler.start()
+        return self.ruler
 
     def _ensure_selfmon_namespace(self) -> None:
         from ..selfmon import RESERVED_NS
@@ -516,11 +554,23 @@ class _Handler(BaseHTTPRequestHandler):
             z.writestr("stacks.txt", "\n".join(stacks))
             z.writestr("metrics.txt", METRICS.expose())
             z.writestr("traces.json", json.dumps(TRACER.dump(limit=512), indent=1))
-            from ..query.stats import RING
+            from ..query.stats import ACTIVE, RING
 
             z.writestr(
                 "slow_queries.json", json.dumps(RING.dump(limit=128), indent=1)
             )
+            z.writestr(
+                "active_queries.json", json.dumps(ACTIVE.dump(), indent=1)
+            )
+            if c.ruler is not None:
+                z.writestr(
+                    "ruler.json",
+                    json.dumps(
+                        {"rules": c.ruler.rules_dict(),
+                         "alerts": c.ruler.alerts_dict()},
+                        indent=1,
+                    ),
+                )
             with c.db.lock:
                 namespaces = list(c.db.namespaces.items())
             ns_info = {}
@@ -556,7 +606,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if url.path in (
                     "/health", "/metrics", "/debug/traces",
                     "/debug/slow_queries", "/debug/dump",
-                    "/debug/exemplars",
+                    "/debug/exemplars", "/debug/active_queries",
                 )
                 else TRACER.span("http.get", path=url.path)
             )
@@ -627,9 +677,29 @@ class _Handler(BaseHTTPRequestHandler):
                     p = c.placement_svc.get()
                     self._json(p.to_dict() if p else {}, 200 if p else 404)
                 elif url.path == "/api/v1/rules":
+                    # one route, two rule planes: the r2 aggregation
+                    # rulesets (namespaces/rulesets keys, unchanged) plus
+                    # the Prometheus rules-API shape (status/data.groups)
+                    # for the ruler's recording/alerting groups
                     from ..rules.r2 import RuleStore, listing_dict
 
-                    self._json(listing_dict(RuleStore(c.kv)))
+                    out = listing_dict(RuleStore(c.kv))
+                    out["status"] = "success"
+                    out["data"] = (
+                        c.ruler.rules_dict() if c.ruler is not None
+                        else {"groups": []}
+                    )
+                    self._json(out)
+                elif url.path == "/api/v1/alerts":
+                    self._json(
+                        {
+                            "status": "success",
+                            "data": (
+                                c.ruler.alerts_dict() if c.ruler is not None
+                                else {"alerts": []}
+                            ),
+                        }
+                    )
                 elif (m := re.match(r"^/api/v1/rules/([^/]+)$", url.path)) is not None:
                     from ..rules.r2 import RuleStore, ruleset_to_dict
 
@@ -646,6 +716,13 @@ class _Handler(BaseHTTPRequestHandler):
 
                     limit = int(q.get("limit", ["64"])[0])
                     self._json({"queries": RING.dump(limit=limit)})
+                elif url.path == "/debug/active_queries":
+                    # what is running RIGHT NOW: trace id, namespace,
+                    # elapsed, current stage — joined by traceId to
+                    # /debug/slow_queries and /debug/traces
+                    from ..query.stats import ACTIVE
+
+                    self._json(ACTIVE.dump())
                 elif url.path == "/debug/exemplars":
                     # trace-ID exemplars per histogram bucket: join a slow
                     # bucket to its stitched trace (/debug/traces) and its
@@ -910,6 +987,22 @@ def main(argv=None) -> int:
         "aggregator --debug-port) to pull into the self-scrape",
     )
     p.add_argument("--instance-id", default="coordinator0")
+    p.add_argument(
+        "--ruler-rules",
+        default="",
+        help="path to a YAML/JSON rule file (recording + alerting "
+        "groups): starts the ruler, mirrors the ruleset into the KV "
+        "control plane when one is configured, and serves "
+        "/api/v1/rules + /api/v1/alerts",
+    )
+    p.add_argument(
+        "--ruler-webhook",
+        action="append",
+        default=[],
+        help="alert webhook receiver URL (repeatable); firing/resolved "
+        "transitions POST the Alertmanager webhook payload with "
+        "retries under the resilience plane's budget",
+    )
     args = p.parse_args(argv)
 
     cfg = load_config(CoordinatorConfig, args.config) if args.config else CoordinatorConfig()
@@ -962,6 +1055,13 @@ def main(argv=None) -> int:
             instance=args.instance_id,
         )
 
+    if args.ruler_rules:
+        coord.start_ruler(
+            rules_path=args.ruler_rules,
+            webhooks=list(args.ruler_webhook),
+            instance=args.instance_id,
+        )
+
     detector = None
     if args.failure_detector:
         if kv is None:
@@ -1001,6 +1101,8 @@ def main(argv=None) -> int:
             msg_server.stop()
         if coord.selfmon is not None:
             coord.selfmon.stop()
+        if coord.ruler is not None:
+            coord.ruler.stop()
         for node in static_peers.values():
             try:
                 node.close()

@@ -1,0 +1,98 @@
+"""Fixed-rate scheduling with deterministic phase jitter.
+
+Periodic fleet loops (the self-scrape collector, the ruler's per-group
+evaluation) must not drift and must not align: a ``stop.wait(interval)``
+loop accumulates per-iteration work time into its period (N scrapes of
+50ms work at a 10s interval lag a full tick behind after ~200 iterations),
+and every process waking at ``t0 + k*interval`` with the same t0 phase
+thundering-herds the shared write path once per interval fleet-wide.
+
+:class:`FixedRateTicker` fixes both: ticks fire at the absolute monotonic
+instants ``start + phase + k*interval`` (work time eats into the wait, not
+the period), and ``phase`` is a DETERMINISTIC per-instance fraction of the
+interval — hashed from a caller-supplied key (instance id, group name) so
+a restarted process keeps its slot and the fleet's ticks spread uniformly
+over the interval instead of stacking.
+
+A loop that falls more than a full interval behind (a long GC pause, a
+stalled sink) SKIPS the missed ticks rather than firing them back-to-back
+— catching up by bursting is exactly the herd the phase spread prevents —
+and reports how many were skipped so callers can count them loudly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .hash import murmur3_32
+
+
+def phase_fraction(key: str) -> float:
+    """Deterministic jitter fraction in [0, 1) for a scheduling key.
+
+    murmur3 (the shard hash — stable across processes and runs, unlike
+    Python's randomized ``hash``) of the key, scaled to a fraction: the
+    same instance always lands on the same phase, and distinct instances
+    spread ~uniformly."""
+    return (murmur3_32(key.encode("utf-8", "replace")) % (1 << 20)) / float(1 << 20)
+
+
+class FixedRateTicker:
+    """Absolute-schedule tick source for a periodic daemon loop.
+
+    Usage::
+
+        ticker = FixedRateTicker(interval, phase_key=instance, stop=stop_evt)
+        while True:
+            stopped, missed = ticker.wait_next()
+            if stopped:
+                break
+            if missed:
+                missed_counter.inc(missed)
+            do_work()
+
+    ``clock`` is injectable (monotonic seconds) for tests; the stop event
+    doubles as the wait primitive so ``stop.set()`` interrupts a sleeping
+    loop immediately.
+    """
+
+    def __init__(
+        self,
+        interval: float,
+        phase_key: str = "",
+        stop: threading.Event | None = None,
+        clock=time.monotonic,
+        jitter: bool = True,
+    ) -> None:
+        if interval <= 0:
+            raise ValueError(f"interval must be positive, got {interval!r}")
+        self.interval = float(interval)
+        self.stop = stop if stop is not None else threading.Event()
+        self.clock = clock
+        self.phase = (
+            phase_fraction(phase_key) * self.interval if jitter else 0.0
+        )
+        self._start = self.clock()
+        self._k = 0  # last fired tick index
+
+    def next_deadline(self) -> float:
+        """Absolute (monotonic) instant of the next scheduled tick."""
+        return self._start + self.phase + (self._k + 1) * self.interval
+
+    def wait_next(self) -> tuple[bool, int]:
+        """Block until the next scheduled tick (or stop). Returns
+        ``(stopped, missed)`` where ``missed`` counts whole intervals
+        skipped because the loop fell behind schedule."""
+        self._k += 1
+        target = self._start + self.phase + self._k * self.interval
+        now = self.clock()
+        missed = 0
+        if now > target:
+            missed = int((now - target) // self.interval)
+            if missed:
+                self._k += missed
+                target = self._start + self.phase + self._k * self.interval
+        delay = max(0.0, target - now)
+        stopped = self.stop.wait(delay) if delay > 0 else self.stop.is_set()
+        return bool(stopped), missed

@@ -349,6 +349,26 @@ def test_double_prefix_and_bad_label_key_fire():
     assert codes(findings) == {"M3L005"} and len(findings) == 2
 
 
+def test_colon_recorded_name_fires_outside_ruler():
+    src = """
+    from pkg.instrument import DEFAULT as METRICS
+
+    METRICS.counter("job:rpc_errors:rate5m")
+    """
+    findings = lint(src)
+    assert codes(findings) == {"M3L005"}
+    assert "ruler writer context" in findings[0].message
+
+
+def test_colon_recorded_name_quiet_inside_ruler():
+    src = """
+    from pkg.instrument import DEFAULT as METRICS
+
+    METRICS.counter("job:rpc_errors:rate5m")
+    """
+    assert lint(src, rel="m3_tpu/ruler/synthetic.py") == []
+
+
 def test_clean_metric_quiet():
     findings = lint(
         """

@@ -1,0 +1,689 @@
+"""Ruler tests: rule parsing/validation, the fixed-rate scheduler, the
+alert state machine at its ``for:`` boundaries, KV checkpoint restore
+across a simulated coordinator restart, dead-KV degradation, recording
+rules read back bit-exact through PromQL, reserved-namespace discipline,
+notifiers, and the HTTP rules/alerts/active-queries surfaces."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.block.core import make_tags
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.ruler import (
+    FIRING,
+    PENDING,
+    AlertRule,
+    LogNotifier,
+    Ruler,
+    RulerStore,
+    WebhookNotifier,
+    groups_from_spec,
+    groups_to_spec,
+    load_rules_file,
+    parse_duration,
+    render_template,
+)
+from m3_tpu.ruler.ruler import RULESET_KEY, STATE_KEY_PREFIX
+from m3_tpu.selfmon import (
+    RESERVED_NS,
+    ReservedNamespaceError,
+    ruler_writer,
+    snapshot_to_datapoints,
+)
+from m3_tpu.services.coordinator import Coordinator, serve
+from m3_tpu.storage.database import Database, NamespaceOptions
+from m3_tpu.utils.instrument import Registry
+from m3_tpu.utils.schedule import FixedRateTicker, phase_fraction
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+
+@pytest.fixture()
+def db(tmp_path):
+    db = Database(str(tmp_path), num_shards=2)
+    db.create_namespace("default", NamespaceOptions())
+    db.create_namespace(RESERVED_NS, NamespaceOptions())
+    db.bootstrap()
+    yield db
+    db.close()
+
+
+def write(db, ns, name, t_nanos, value, **labels):
+    db.write_tagged(
+        ns, make_tags({"__name__": name, **labels}), t_nanos, float(value)
+    )
+
+
+def make_ruler(db, kv=None, spec=None, **kwargs):
+    coord = Coordinator(db=db)
+    ruler = Ruler(
+        engine_for=coord.engine_for, db=db, kv=kv, jitter=False, **kwargs
+    )
+    if spec is not None:
+        ruler.publish(spec)
+    return ruler
+
+
+def one_group_spec(rules, interval="1s", namespace="default", name="g"):
+    return {"groups": [{
+        "name": name, "interval": interval, "namespace": namespace,
+        "rules": rules,
+    }]}
+
+
+# --- rule model: parsing + validation ---
+
+
+def test_parse_duration():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("1.5h") == 5400.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration(7) == 7.0
+    with pytest.raises(ValueError):
+        parse_duration("nope")
+
+
+def test_spec_validation_rejects_bad_rules():
+    with pytest.raises(ValueError, match="colon convention"):
+        groups_from_spec(one_group_spec(
+            [{"record": "plain_name", "expr": "up"}]
+        ))
+    with pytest.raises(ValueError):  # unparsable PromQL fails at load
+        groups_from_spec(one_group_spec(
+            [{"record": "a:b:c", "expr": "rate((("}]
+        ))
+    with pytest.raises(ValueError, match="both record and alert"):
+        groups_from_spec(one_group_spec(
+            [{"record": "a:b:c", "alert": "X", "expr": "up"}]
+        ))
+    with pytest.raises(ValueError, match="duplicate rule group"):
+        groups_from_spec({"groups": [
+            {"name": "g", "rules": []}, {"name": "g", "rules": []},
+        ]})
+    with pytest.raises(ValueError, match="interval"):
+        groups_from_spec(one_group_spec([], interval="0s"))
+
+
+def test_spec_roundtrip_and_file_load(tmp_path):
+    spec = one_group_spec(
+        [
+            {"record": "job:up:sum", "expr": "sum(up)", "labels": {"l": "j"}},
+            {"alert": "Down", "expr": "up == 0", "for": "2m",
+             "annotations": {"summary": "{{ $labels.job }} down"}},
+        ],
+        interval="30s", namespace=RESERVED_NS,
+    )
+    groups = groups_from_spec(spec)
+    again = groups_from_spec(groups_to_spec(groups))
+    assert again == groups
+    p = tmp_path / "rules.yml"
+    p.write_text(json.dumps(spec))  # JSON is a YAML subset
+    assert load_rules_file(str(p)) == groups
+
+
+def test_render_template():
+    out = render_template(
+        "v={{ $value }} op={{ $labels.op }} missing={{ $labels.nope }}",
+        {"op": "fetch"}, 2.5,
+    )
+    assert out == "v=2.5 op=fetch missing="
+
+
+# --- fixed-rate scheduling (satellite: collector drift + herd fix) ---
+
+
+def test_phase_fraction_deterministic_and_spread():
+    a = phase_fraction("node-a")
+    assert a == phase_fraction("node-a")
+    assert 0.0 <= a < 1.0
+    others = {phase_fraction(f"node-{i}") for i in range(16)}
+    assert len(others) > 8  # spread, not stacked
+
+
+def test_ticker_fixed_rate_and_missed_intervals():
+    clk = [0.0]
+    t = FixedRateTicker(
+        10.0, stop=threading.Event(), clock=lambda: clk[0], jitter=False
+    )
+    clk[0] = 10.0
+    assert t.wait_next() == (False, 0)
+    # fall 2.5 intervals behind: the schedule skips forward (no burst)
+    clk[0] = 45.0
+    stopped, missed = t.wait_next()
+    assert not stopped and missed == 2
+    # back on schedule: next tick is the absolute slot, not now+interval
+    clk[0] = 50.0
+    assert t.wait_next() == (False, 0)
+
+
+def test_ticker_stop_interrupts():
+    stop = threading.Event()
+    t = FixedRateTicker(10.0, stop=stop, jitter=False)
+    stop.set()
+    stopped, _ = t.wait_next()
+    assert stopped
+
+
+# --- alert lifecycle at for: boundaries ---
+
+
+def alert_spec(for_secs="4s", expr="m > 5"):
+    return one_group_spec([
+        {"alert": "High", "expr": expr, "for": for_secs,
+         "labels": {"severity": "page"},
+         "annotations": {"summary": "at {{ $value }}"}},
+    ])
+
+
+def test_pending_to_firing_to_resolved(db):
+    ruler = make_ruler(db, spec=alert_spec())
+    runner = ruler.runners()[0]
+    write(db, "default", "m", T0, 10, job="a")
+
+    assert runner.eval_once(T0) == []  # inactive -> pending, no event
+    st = runner.states["High"]
+    assert list(a.state for a in st.active.values()) == [PENDING]
+    active_at = next(iter(st.active.values())).active_at_nanos
+    assert active_at == T0
+
+    # one tick short of the hold: still pending
+    assert runner.eval_once(T0 + 3 * NANOS) == []
+    assert next(iter(st.active.values())).state == PENDING
+    # at the boundary: fires exactly once, with templated annotations
+    events = runner.eval_once(T0 + 4 * NANOS)
+    assert [e["status"] for e in events] == ["firing"]
+    assert events[0]["labels"] == {
+        "job": "a", "severity": "page", "alertname": "High"
+    }
+    assert events[0]["annotations"] == {"summary": "at 10"}
+    # steady state: no repeat notifications
+    assert runner.eval_once(T0 + 5 * NANOS) == []
+
+    # condition clears -> exactly one resolved event
+    write(db, "default", "m", T0 + 6 * NANOS, 0, job="a")
+    events = runner.eval_once(T0 + 7 * NANOS)
+    assert [e["status"] for e in events] == ["resolved"]
+    assert st.active == {}
+    assert runner.eval_once(T0 + 8 * NANOS) == []
+
+
+def test_pending_clears_silently(db):
+    ruler = make_ruler(db, spec=alert_spec(for_secs="60s"))
+    runner = ruler.runners()[0]
+    write(db, "default", "m", T0, 10, job="a")
+    assert runner.eval_once(T0) == []
+    write(db, "default", "m", T0 + NANOS, 0, job="a")
+    assert runner.eval_once(T0 + 2 * NANOS) == []  # never fired: no event
+    assert runner.states["High"].active == {}
+
+
+def test_for_zero_fires_immediately(db):
+    ruler = make_ruler(db, spec=alert_spec(for_secs=0))
+    runner = ruler.runners()[0]
+    write(db, "default", "m", T0, 10, job="a")
+    events = runner.eval_once(T0)
+    assert [e["status"] for e in events] == ["firing"]
+
+
+def test_log_notifier_receives_transitions(db):
+    ruler = make_ruler(db, spec=alert_spec(for_secs=0))
+    write(db, "default", "m", T0, 10, job="a")
+    ruler.runners()[0].eval_once(T0)
+    sent = ruler.log_notifier.sent
+    assert len(sent) == 1 and sent[0]["status"] == "firing"
+
+
+# --- recording rules ---
+
+
+def test_recording_rule_readback_bit_exact(db):
+    vals = [0.1 + 0.2, 1.0 / 3.0, 2.0 ** -40, 12345.6789]
+    for i, v in enumerate(vals):
+        write(db, "default", "m", T0, v, op=f"op{i}")
+    spec = one_group_spec([
+        {"record": "job:m:sum", "expr": "sum(m)"},
+        {"record": "op:m:copy", "expr": "m", "labels": {"src": "rule"}},
+    ])
+    ruler = make_ruler(db, spec=spec)
+    eng = ruler.engine_for("default")
+    # bit-exactness contract: what the engine computed at eval time is
+    # what reads back — the ruler's write leg adds ZERO perturbation on
+    # top of the storage codec (m3tsz's scaled-decimal convention already
+    # canonicalizes e.g. 0.1+0.2 -> 0.3 on the SOURCE read, by design)
+    expected_sum = float(
+        np.asarray(eng.query_instant("sum(m)", T0 + NANOS).values)[0, -1]
+    )
+    src = eng.query_instant("m", T0 + NANOS)
+    expected_copy = {
+        dict(m.tags)[b"op"].decode(): float(np.asarray(src.values)[i, -1])
+        for i, m in enumerate(src.metas)
+    }
+    ruler.runners()[0].eval_once(T0 + NANOS)
+
+    r = eng.query_instant("job:m:sum", T0 + 2 * NANOS)
+    assert len(r.metas) == 1
+    assert float(np.asarray(r.values)[0, -1]) == expected_sum
+
+    r = eng.query_instant('op:m:copy{src="rule"}', T0 + 2 * NANOS)
+    got = {
+        dict(m.tags)[b"op"].decode(): float(np.asarray(r.values)[i, -1])
+        for i, m in enumerate(r.metas)
+    }
+    assert got == expected_copy
+    # and the codec-stable members of the input DID survive untouched
+    assert got["op1"] == 1.0 / 3.0 and got["op3"] == 12345.6789
+
+
+def test_recording_rule_output_visible_to_alert_rule(db):
+    """A group's recorded series feed its own alert rules on later
+    evaluations — the derive-then-alert chain the CI gate exercises."""
+    write(db, "default", "m", T0, 42, job="a")
+    spec = one_group_spec([
+        {"record": "job:m:last", "expr": "m"},
+        {"alert": "DerivedHigh", "expr": "job:m:last > 40", "for": 0},
+    ])
+    ruler = make_ruler(db, spec=spec)
+    runner = ruler.runners()[0]
+    # rules run in file order and local writes are synchronously visible,
+    # so the recorded series feeds the alert in the SAME pass
+    events = runner.eval_once(T0 + NANOS)
+    assert [e["labels"]["alertname"] for e in events] == ["DerivedHigh"]
+    assert events[0]["value"] == 42.0
+
+
+def test_ruler_may_write_reserved_namespace_others_may_not(db):
+    from m3_tpu.selfmon import selfmon_writer
+
+    with selfmon_writer():  # seed telemetry as the collector would
+        write(db, RESERVED_NS, "m3tpu_x_total", T0, 7, instance="i0")
+    spec = one_group_spec(
+        [{"record": "fleet:x:sum", "expr": "sum(m3tpu_x_total)"}],
+        namespace=RESERVED_NS,
+    )
+    ruler = make_ruler(db, spec=spec)
+    ruler.runners()[0].eval_once(T0 + NANOS)
+    eng = ruler.engine_for(RESERVED_NS)
+    r = eng.query_instant("fleet:x:sum", T0 + 2 * NANOS)
+    assert float(np.asarray(r.values)[0, -1]) == 7.0
+    # the same write OUTSIDE the ruler context still raises
+    with pytest.raises(ReservedNamespaceError):
+        write(db, RESERVED_NS, "fleet:y:sum", T0, 1)
+
+
+def test_recording_failure_counts_and_keeps_group_alive(db):
+    """A rule whose writes fail is counted + surfaced in health; the
+    remaining rules still evaluate."""
+    write(db, "default", "m", T0, 1, job="a")
+    spec = one_group_spec([
+        {"record": "a:bad:rule", "expr": "m"},
+        {"record": "a:good:rule", "expr": "m"},
+    ])
+    ruler = make_ruler(db, spec=spec)
+    runner = ruler.runners()[0]
+    real = db.write_tagged_batch
+
+    def flaky(ns, entries):
+        names = {dict(t).get(b"__name__") for t, *_ in entries}
+        if b"a:bad:rule" in names:
+            return ["boom" for _ in entries]
+        return real(ns, entries)
+
+    db.write_tagged_batch = flaky
+    before = runner._m_failures.value
+    runner.eval_once(T0 + NANOS)
+    assert runner._m_failures.value == before + 1
+    assert runner.health["a:bad:rule"]["health"] == "err"
+    assert runner.health["a:good:rule"]["health"] == "ok"
+
+
+# --- KV: shared ruleset + checkpoint durability ---
+
+
+def test_ruleset_mirror_versioning():
+    kv = KVStore()
+    store = RulerStore(kv)
+    spec = groups_to_spec(groups_from_spec(alert_spec()))
+    v1 = store.set_spec(spec)
+    assert v1 == 1
+    # unchanged groups: mirror is idempotent
+    assert store.mirror(spec) == 1
+    spec2 = groups_to_spec(groups_from_spec(alert_spec(for_secs="9s")))
+    assert store.mirror(spec2) == 2
+    stored, ver = store.get()
+    assert ver == 2 and stored["groups"] == spec2["groups"]
+
+
+def test_publish_propagates_to_watching_ruler(db):
+    kv = KVStore()
+    a = make_ruler(db, kv=kv)
+    b = make_ruler(db, kv=kv)
+    a.start()
+    b.start()
+    try:
+        a.publish(alert_spec())
+        names = [r.group.name for r in b.runners()]
+        assert names == ["g"]  # b picked the ruleset up via its watch
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_checkpoint_restore_across_restart(db):
+    """Simulated coordinator restart mid-``for:`` hold AND mid-firing:
+    the restored ruler continues the clocks — no reset, no re-fire."""
+    kv = KVStore()
+    spec = alert_spec(for_secs="10s")
+    write(db, "default", "m", T0, 10, job="a")
+
+    ruler_a = make_ruler(db, kv=kv, spec=spec)
+    runner_a = ruler_a.runners()[0]
+    assert runner_a.eval_once(T0) == []  # pending, checkpointed
+    assert kv.get(STATE_KEY_PREFIX + "g") is not None
+    ruler_a.stop()
+
+    # "restart": a fresh process (new Ruler) on the same KV
+    ruler_b = make_ruler(db, kv=kv, spec=spec)
+    runner_b = ruler_b.runners()[0]
+    st = runner_b.states["High"]
+    assert next(iter(st.active.values())).active_at_nanos == T0  # no reset
+    assert runner_b.eval_once(T0 + 5 * NANOS) == []  # hold continues
+    events = runner_b.eval_once(T0 + 10 * NANOS)  # fires at the ORIGINAL
+    assert [e["status"] for e in events] == ["firing"]  # boundary
+
+    # second restart while FIRING: no duplicate firing notification
+    ruler_b.stop()
+    ruler_c = make_ruler(db, kv=kv, spec=spec)
+    runner_c = ruler_c.runners()[0]
+    assert next(iter(runner_c.states["High"].active.values())).state == FIRING
+    assert runner_c.eval_once(T0 + 12 * NANOS) == []
+    assert ruler_c.log_notifier.sent == []
+    # and the resolve still notifies exactly once
+    write(db, "default", "m", T0 + 13 * NANOS, 0, job="a")
+    events = runner_c.eval_once(T0 + 14 * NANOS)
+    assert [e["status"] for e in events] == ["resolved"]
+
+
+def test_dead_kv_degrades_loudly(db):
+    """KV down: evaluation and alerting continue from memory; every
+    dropped checkpoint ticks the failure counter."""
+
+    class DeadKV:
+        def get(self, key):
+            raise ConnectionError("kv down")
+
+        def set(self, key, value, **kw):
+            raise ConnectionError("kv down")
+
+        def check_and_set(self, *a, **kw):
+            raise ConnectionError("kv down")
+
+        def watch(self, key, fn):
+            raise ConnectionError("kv down")
+
+    ruler = make_ruler(db, kv=DeadKV())
+    before = ruler._m_checkpoint_failures.value
+    ruler.publish(alert_spec(for_secs=0))  # mirror fails -> local apply
+    ruler.start()  # watch fails -> counted, still runs
+    try:
+        assert ruler._m_checkpoint_failures.value > before
+        write(db, "default", "m", T0, 10, job="a")
+        runner = ruler.runners()[0]
+        mid = ruler._m_checkpoint_failures.value
+        events = runner.eval_once(T0)
+        assert [e["status"] for e in events] == ["firing"]  # still alerting
+        assert ruler._m_checkpoint_failures.value > mid  # dropped, loudly
+    finally:
+        ruler.stop()
+
+
+def test_reload_carries_state_for_unchanged_rules(db):
+    """A live ruleset edit (new version, same alert rule) must not reset
+    running for: clocks."""
+    kv = KVStore()
+    write(db, "default", "m", T0, 10, job="a")
+    ruler = make_ruler(db, kv=kv, spec=alert_spec(for_secs="60s"))
+    ruler.runners()[0].eval_once(T0)
+    spec2 = one_group_spec([
+        {"alert": "High", "expr": "m > 5", "for": "60s",
+         "labels": {"severity": "page"},
+         "annotations": {"summary": "at {{ $value }}"}},
+        {"record": "new:rule:added", "expr": "m"},
+    ])
+    ruler.publish(spec2)
+    runner = ruler.runners()[0]
+    assert len(runner.group.rules) == 2
+    st = runner.states["High"]
+    assert next(iter(st.active.values())).active_at_nanos == T0
+
+
+def test_stale_ruleset_version_never_downgrades(db):
+    """Out-of-order watch deliveries (callbacks fire outside the KV
+    store lock) must not swap an older ruleset back in."""
+    from m3_tpu.cluster.kv import VersionedValue
+
+    kv = KVStore()
+    ruler = make_ruler(db, kv=kv, spec=alert_spec())  # version 1
+    stale = {"version": 0, "groups": []}
+    ruler._on_ruleset(VersionedValue(99, stale))
+    assert [r.group.name for r in ruler.runners()] == ["g"]
+    # duplicate delivery of the SAME version is a no-op too
+    cur, ver = RulerStore(kv).get()
+    ruler._on_ruleset(VersionedValue(99, cur))
+    assert [r.group.name for r in ruler.runners()] == ["g"]
+
+
+def test_removed_group_takes_checkpoint_with_it(db):
+    """Deleting a group from the ruleset deletes its durable state — a
+    future group reusing the name must not resurrect obsolete alerts."""
+    kv = KVStore()
+    write(db, "default", "m", T0, 10, job="a")
+    ruler = make_ruler(db, kv=kv, spec=alert_spec(for_secs=0))
+    ruler.runners()[0].eval_once(T0)
+    assert kv.get(STATE_KEY_PREFIX + "g") is not None
+    ruler.publish({"groups": []})
+    assert ruler.runners() == []
+    assert kv.get(STATE_KEY_PREFIX + "g") is None
+
+
+def test_ruler_restart_after_stop(db):
+    """stop() then start() must tick again (the per-runner stop latch
+    clears), and start() after stop() must not race a watch apply."""
+    import time as _time
+
+    write(db, "default", "m", _time.time_ns(), 10, job="a")
+    ruler = make_ruler(db, spec=one_group_spec(
+        [{"alert": "High", "expr": "m > 5", "for": 0}], interval="0.05s"
+    ))
+    ruler.start()
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and not ruler.log_notifier.sent:
+        _time.sleep(0.02)
+    assert ruler.log_notifier.sent
+    ruler.stop()
+    # condition resolves while stopped, then re-fires after restart
+    write(db, "default", "m", _time.time_ns(), 0, job="a")
+    ruler.start()
+    try:
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and not any(
+            e["status"] == "resolved" for e in ruler.log_notifier.sent
+        ):
+            _time.sleep(0.02)
+        assert any(
+            e["status"] == "resolved" for e in ruler.log_notifier.sent
+        ), "restarted ruler never evaluated"
+    finally:
+        ruler.stop()
+
+
+# --- notifiers ---
+
+
+def test_webhook_notifier_delivers_and_counts_failures():
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    got = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            got.append(json.loads(
+                self.rfile.read(int(self.headers["Content-Length"]))
+            ))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        hook = WebhookNotifier(f"http://127.0.0.1:{srv.server_address[1]}/")
+        ok = hook.notify([{"status": "firing", "labels": {"alertname": "X"},
+                           "annotations": {}, "startsAt": 1.0, "value": 2.0}])
+        assert ok and got[0]["alerts"][0]["labels"]["alertname"] == "X"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # dead receiver: bounded failure, counted, never raises
+    from m3_tpu.net.resilience import RetryPolicy
+
+    dead = WebhookNotifier(
+        "http://127.0.0.1:1/", timeout=0.5,
+        policy=RetryPolicy(max_retries=1, initial_backoff=0.01,
+                           max_backoff=0.02),
+    )
+    before = dead._m_failed.value
+    assert dead.notify([{"status": "firing", "labels": {},
+                         "annotations": {}, "startsAt": 0, "value": 0}]) is False
+    assert dead._m_failed.value == before + 1
+
+
+# --- convert skip-logic: colon names only from the ruler context ---
+
+
+def test_conversion_skips_colon_form_families():
+    reg = Registry(prefix="")
+    snap = reg.collect()
+    snap["job:forged:rate"] = {
+        "kind": "counter", "help": "",
+        "children": [{"labels": {}, "value": 1.0}],
+    }
+    snap["honest_total"] = {
+        "kind": "counter", "help": "",
+        "children": [{"labels": {}, "value": 2.0}],
+    }
+    entries, truncated = snapshot_to_datapoints(snap, T0, instance="peer1")
+    names = {dict(t)[b"__name__"] for t, _, _ in entries}
+    assert names == {b"honest_total"} and truncated == 1
+
+
+# --- active-query registry (/debug/active_queries satellite) ---
+
+
+def test_active_query_registry_tracks_stage_and_unregisters():
+    from m3_tpu.query import stats
+
+    st = stats.start("sum(m)")
+    assert st is not None
+    st.namespace = "default"
+    try:
+        with stats.stage("fetch"):
+            dump = stats.ACTIVE.dump()
+            row = next(r for r in dump["queries"] if r["query"] == "sum(m)")
+            assert row["stage"] == "fetch"
+            assert row["namespace"] == "default"
+            assert row["elapsedSecs"] >= 0.0
+        assert st.current_stage is None
+    finally:
+        stats.finish(st, 0.01)
+    assert all(
+        r["query"] != "sum(m)" for r in stats.ACTIVE.dump()["queries"]
+    )
+
+
+def test_active_query_registry_bounded():
+    from m3_tpu.query.stats import ActiveQueryRegistry, QueryStats
+
+    reg = ActiveQueryRegistry(capacity=2)
+    records = [QueryStats(query=f"q{i}") for i in range(4)]
+    for st in records:
+        reg.register(st)
+    dump = reg.dump()
+    assert len(dump["queries"]) == 2 and dump["overflows"] == 2
+
+
+# --- HTTP surface ---
+
+
+def test_http_rules_alerts_active_queries(db, tmp_path):
+    write(db, "default", "m", T0, 10, job="a")
+    rules = one_group_spec([
+        {"record": "job:m:last", "expr": "m"},
+        {"alert": "High", "expr": "m > 5", "for": 0,
+         "annotations": {"summary": "at {{ $value }}"}},
+    ])
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(rules))
+    coord = Coordinator(db=db)
+    coord.start_ruler(rules_path=str(p), jitter=False)
+    coord.ruler.runners()[0].eval_once(T0)
+    srv, port = serve(coord)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        out = json.loads(urllib.request.urlopen(f"{base}/api/v1/rules").read())
+        # merged response: the r2 aggregation listing keys survive...
+        assert "namespaces" in out and "rulesets" in out
+        # ...and the Prometheus rules-API shape rides alongside
+        assert out["status"] == "success"
+        groups = out["data"]["groups"]
+        assert [g["name"] for g in groups] == ["g"]
+        by_type = {r["type"]: r for r in groups[0]["rules"]}
+        assert by_type["recording"]["name"] == "job:m:last"
+        assert by_type["alerting"]["state"] == "firing"
+
+        out = json.loads(
+            urllib.request.urlopen(f"{base}/api/v1/alerts").read()
+        )
+        alerts = out["data"]["alerts"]
+        assert len(alerts) == 1
+        assert alerts[0]["labels"]["alertname"] == "High"
+        assert alerts[0]["state"] == "firing"
+        assert alerts[0]["annotations"] == {"summary": "at 10"}
+
+        out = json.loads(
+            urllib.request.urlopen(f"{base}/debug/active_queries").read()
+        )
+        # nothing in flight from THIS test (the registry is process-wide,
+        # so assert shape + absence of our queries, not global emptiness)
+        assert "overflows" in out
+        assert all("job:m:last" not in r["query"] for r in out["queries"])
+    finally:
+        coord.ruler.stop()
+        srv.shutdown()
+
+
+def test_group_runner_thread_evaluates(db):
+    """The real eval loop (threaded, fixed-rate) fires on its own."""
+    import time as _time
+
+    write(db, "default", "m", _time.time_ns(), 10, job="a")
+    ruler = make_ruler(db, spec=one_group_spec(
+        [{"alert": "High", "expr": "m > 5", "for": 0}], interval="0.05s"
+    ))
+    ruler.start()
+    try:
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and not ruler.log_notifier.sent:
+            _time.sleep(0.02)
+        assert ruler.log_notifier.sent, "alert never fired from the loop"
+    finally:
+        ruler.stop()

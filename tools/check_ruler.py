@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""CI guard for the ruler (m3_tpu/ruler/): end-to-end self-alerting.
+
+Boots a mini fleet wired so the system alerts on ITSELF:
+
+- a kvnode (the control plane the ruleset mirror + alert-state
+  checkpoints live in),
+- a dbnode with a SEEDED FAULT PLAN (net/faults.py) injecting typed
+  retryable errors on its ``metrics`` RPC op,
+- a coordinator self-scraping its own registry and pulling the faulty
+  dbnode — every faulted pull drives the coordinator's REAL
+  ``m3tpu_rpc_retries_total`` counters, which its collector stores into
+  ``_m3tpu`` like any other telemetry,
+
+then runs a ruleset over ``namespace: _m3tpu`` and asserts the loop
+closes: the recording rule materializes a derived error-rate series
+(``job:rpc_retries:rate1m``) queryable via PromQL; the paired alert
+transitions inactive→pending→firing from the fleet's own stored
+telemetry with templated annotations; ``/api/v1/alerts`` and the webhook
+sink agree on the firing alert; zero reserved-namespace guard violations
+occur; and — after SIGKILLing and respawning the coordinator — the
+``for``/firing state of a checkpointed alert survives via the KV
+checkpoint (same activeAt, no duplicate firing notification).
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_ruler.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+# comfortably above 1s: stored timestamps ride the m3tsz SECOND-unit
+# delta encoding, so consecutive samples closer than 1s collapse onto one
+# timestamp and flatten every rate() over the stored telemetry. At 1s
+# nominal spacing, ~1s of scheduling jitter on a loaded CI machine still
+# produces sub-second deltas; 2s keeps the series well-formed under load.
+SCRAPE_INTERVAL = 2.0
+EVAL_INTERVAL = 3.0
+
+RULES = {
+    "groups": [
+        {
+            "name": "selfmon",
+            "interval": EVAL_INTERVAL,
+            "namespace": "_m3tpu",
+            "rules": [
+                {
+                    "record": "job:rpc_retries:rate1m",
+                    "expr": "sum(rate(m3tpu_rpc_retries_total[60s]))",
+                },
+                {
+                    "alert": "SelfRpcRetries",
+                    "expr": "job:rpc_retries:rate1m > 0",
+                    # longer than one eval interval so the pending phase
+                    # spans at least two evaluations and a poller can't
+                    # miss it between state transitions
+                    "for": str(EVAL_INTERVAL + 1.0),
+                    "labels": {"severity": "page"},
+                    "annotations": {
+                        "summary": "fleet RPC retry rate at {{ $value }}/s"
+                    },
+                },
+                # storage-independent canary for the restart-durability
+                # leg: always true, so the ONLY thing that can change its
+                # activeAt across a restart is a lost KV checkpoint
+                {
+                    "alert": "AlwaysOn",
+                    "expr": "vector(1) > 0",
+                    "for": "1s",
+                },
+            ],
+        }
+    ]
+}
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class WebhookReceiver:
+    """Tiny HTTP sink recording every delivered alert event."""
+
+    def __init__(self) -> None:
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        events = self.events = []
+        lock = self._lock = threading.Lock()
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                with lock:
+                    events.extend(json.loads(body).get("alerts", []))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = HTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}/"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def firing(self, alertname: str) -> list:
+        with self._lock:
+            return [
+                e for e in self.events
+                if e["status"] == "firing"
+                and e["labels"].get("alertname") == alertname
+            ]
+
+    def close(self) -> None:
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from m3_tpu.net.faults import FaultPlan, FaultRule
+    from m3_tpu.testing.faults import env_with_plan
+    from m3_tpu.testing.proc_cluster import _spawn_listening
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    base_dir = tempfile.mkdtemp(prefix="m3tpu-check-ruler-")
+    rules_path = os.path.join(base_dir, "rules.json")
+    with open(rules_path, "w") as f:
+        json.dump(RULES, f)
+
+    # seeded fault plan: typed retryable errors on the dbnode's `metrics`
+    # op — the coordinator's peer pulls hit them and transparently retry,
+    # driving real m3tpu_rpc_retries_total counters fleet-side. 0.3 keeps
+    # the client's retry BUDGET from exhausting inside the check window
+    # (success deposits must outpace retry spends or retries stop and the
+    # counter plateaus out of the rate window)
+    plan = FaultPlan([FaultRule(op="metrics", error=0.3)], seed=7)
+
+    hook = WebhookReceiver()
+    kvnode = dbnode = coordinator = None
+
+    def spawn_coordinator(kv_endpoint: str, db_host: str, db_port: int):
+        return _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.coordinator",
+             "--base-dir", os.path.join(base_dir, "coord"),
+             "--kv-endpoint", kv_endpoint,
+             "--selfmon-interval", str(SCRAPE_INTERVAL),
+             "--selfmon-peer", f"{db_host}:{db_port}",
+             "--ruler-rules", rules_path,
+             "--ruler-webhook", hook.url],
+            "coordinator",
+        )
+
+    try:
+        kvnode, kv_host, kv_port = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.kvnode", "--port", "0"],
+            "kvnode",
+        )
+        dbnode, dh, dport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.dbnode",
+             "--base-dir", os.path.join(base_dir, "dbnode"),
+             "--shards", "0,1", "--num-shards", "2", "--no-mediator"],
+            "dbnode",
+            env_extra=env_with_plan(plan),
+        )
+        coordinator, ch, cport = spawn_coordinator(
+            f"{kv_host}:{kv_port}", dh, dport
+        )
+        base = f"http://{ch}:{cport}"
+
+        # 1+2) ONE observation loop from fleet start (polling the
+        # recording first and the alert second would let the alert walk
+        # pending->firing unobserved while the recording poll waits):
+        # the recording rule materializes the derived error-rate series
+        # and turns positive (the first recorded sample may legitimately
+        # be 0 — rate() needs two stored samples), and the paired alert
+        # walks inactive -> pending -> firing off the stored telemetry
+        deadline = time.monotonic() + 90
+        recorded, positive = [], False
+        states_seen: list[str] = []
+        firing_alert = None
+        while time.monotonic() < deadline and not (positive and firing_alert):
+            if not positive:
+                out = _get_json(
+                    f"{base}/api/v1/query?query=job:rpc_retries:rate1m"
+                    f"&time={time.time()}&namespace=_m3tpu"
+                )
+                recorded = out.get("data", {}).get("result", []) or recorded
+                positive = bool(recorded) and any(
+                    float(r["value"][1]) > 0 for r in recorded
+                )
+            for a in _get_json(f"{base}/api/v1/alerts")["data"]["alerts"]:
+                if a["labels"].get("alertname") != "SelfRpcRetries":
+                    continue
+                if not states_seen or states_seen[-1] != a["state"]:
+                    states_seen.append(a["state"])
+                if a["state"] == "firing" and firing_alert is None:
+                    firing_alert = a
+            time.sleep(0.2)
+        check(bool(recorded),
+              "recording rule materializes job:rpc_retries:rate1m in _m3tpu")
+        check(positive, "derived error-rate turns positive under the fault plan")
+        check(firing_alert is not None,
+              f"SelfRpcRetries reached firing (states seen: {states_seen})")
+        check("pending" in states_seen,
+              f"pending state observed before firing ({states_seen})")
+        if firing_alert is not None:
+            check(firing_alert["labels"].get("severity") == "page",
+                  "rule labels merged onto the alert instance")
+            summary = firing_alert["annotations"].get("summary", "")
+            check(summary.startswith("fleet RPC retry rate at ")
+                  and summary.endswith("/s") and "{{" not in summary,
+                  f"annotation templated with $value ({summary!r})")
+
+        # 3) webhook sink agrees with /api/v1/alerts
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not hook.firing("SelfRpcRetries"):
+            time.sleep(0.2)
+        delivered = hook.firing("SelfRpcRetries")
+        check(bool(delivered), "webhook received the firing notification")
+        if delivered and firing_alert is not None:
+            check(delivered[0]["labels"] == firing_alert["labels"],
+                  "webhook and /api/v1/alerts agree on the alert labels")
+
+        # the restart canary must be firing (and checkpointed) before the
+        # kill for the durability leg to mean anything
+        deadline = time.monotonic() + 30
+        canary = None
+        while time.monotonic() < deadline and canary is None:
+            for a in _get_json(f"{base}/api/v1/alerts")["data"]["alerts"]:
+                if (a["labels"].get("alertname") == "AlwaysOn"
+                        and a["state"] == "firing"):
+                    canary = a
+            time.sleep(0.2)
+        check(canary is not None, "AlwaysOn canary firing before restart")
+        canary_firing_before = len(hook.firing("AlwaysOn"))
+        check(canary_firing_before == 1,
+              "exactly one firing notification for the canary pre-restart")
+
+        # 4) zero reserved-namespace guard violations: the ruler wrote
+        # derived _m3tpu series through its sanctioned context, nothing
+        # tripped the guard into the ns-labeled write-error counter
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            exposition = r.read().decode()
+        bad = [
+            line for line in exposition.splitlines()
+            if line.startswith("m3tpu_db_write_errors_total")
+            and 'ns="_m3tpu"' in line and not line.rstrip().endswith(" 0.0")
+        ]
+        check(not bad, f"zero reserved-namespace write errors ({bad[:2]})")
+
+        # 5) `for`/firing state survives a coordinator restart via the KV
+        # checkpoint: SIGKILL (no graceful checkpoint flush) + respawn
+        coordinator.kill()
+        coordinator.wait(timeout=10)
+        coordinator, ch, cport = spawn_coordinator(
+            f"{kv_host}:{kv_port}", dh, dport
+        )
+        base = f"http://{ch}:{cport}"
+        deadline = time.monotonic() + 60
+        restored = None
+        while time.monotonic() < deadline and restored is None:
+            for a in _get_json(f"{base}/api/v1/alerts")["data"]["alerts"]:
+                if a["labels"].get("alertname") == "AlwaysOn":
+                    restored = a
+            time.sleep(0.2)
+        check(restored is not None and restored["state"] == "firing",
+              "canary alert firing after coordinator restart")
+        if restored is not None and canary is not None:
+            check(restored["activeAt"] == canary["activeAt"],
+                  "for-clock/activeAt preserved across restart "
+                  f"({restored['activeAt']} == {canary['activeAt']})")
+        # give a few eval intervals a chance to mis-fire, then assert the
+        # restored FIRING state produced NO duplicate notification
+        time.sleep(2 * EVAL_INTERVAL)
+        check(len(hook.firing("AlwaysOn")) == canary_firing_before,
+              "no duplicate firing notification after restart")
+    finally:
+        for proc in (dbnode, coordinator, kvnode):
+            if proc is not None:
+                proc.kill()
+                proc.wait(timeout=10)
+        hook.close()
+        import shutil
+
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    if failures:
+        print(f"\nFAIL: {len(failures)} ruler violation(s)")
+        return 1
+    print("\nself-alerting loop closes: ruler contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

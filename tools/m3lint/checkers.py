@@ -372,13 +372,24 @@ class MetricNameDiscipline(Checker):
     METRIC_METHODS = {"counter", "gauge", "histogram"}
     RECEIVER = re.compile(r"^(METRICS|DEFAULT|reg|registry|_?metrics)$")
     NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+    # Prometheus recording-rule convention (level:metric:operation) —
+    # colon-form names are legal ONLY in the ruler writer context
+    # (m3_tpu/ruler/), which derives them from configured rules; anywhere
+    # else a colon name would masquerade as a recorded series
+    # (selfmon/convert.py skips them from scraped snapshots for the same
+    # reason). Kept in sync with convert.RECORDED_NAME_RE.
+    RECORDED_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*(:[a-z_][a-z0-9_]*)+$")
+    RULER_PATH_PREFIX = "m3_tpu/ruler/"
     # the fixed label-key allowlist: every key must be grep-able and the
     # exposition cardinality per key must be argued when it is added here.
     # "ns": bounded by the operator-configured namespace count; labeling
     # write-path counters per namespace is what lets the self-scrape skip
-    # its own reserved-namespace activity (selfmon/convert.py)
+    # its own reserved-namespace activity (selfmon/convert.py).
+    # "group": bounded by the operator-configured ruleset (rule groups in
+    # the ruler's KV-mirrored rules file) — per-group eval health is the
+    # signal that makes the ruler itself alertable
     LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
-                  "ns"}
+                  "ns", "group"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
@@ -408,12 +419,26 @@ class MetricNameDiscipline(Checker):
         else:
             name = name_arg.value
             if not self.NAME_RE.match(name):
-                yield self.finding(
-                    ctx,
-                    node.lineno,
-                    f"metric name {name!r} is not snake_case "
-                    "([a-z][a-z0-9_]*)",
-                )
+                if self.RECORDED_NAME_RE.match(name) and ctx.rel.startswith(
+                    self.RULER_PATH_PREFIX
+                ):
+                    pass  # colon-form recorded names, ruler context only
+                elif self.RECORDED_NAME_RE.match(name):
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        f"colon-form recorded name {name!r} outside the "
+                        f"ruler writer context ({self.RULER_PATH_PREFIX}) "
+                        "— only recording rules may mint "
+                        "level:metric:operation names",
+                    )
+                else:
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        f"metric name {name!r} is not snake_case "
+                        "([a-z][a-z0-9_]*)",
+                    )
             if name.startswith("m3tpu_"):
                 yield self.finding(
                     ctx,
