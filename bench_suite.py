@@ -434,6 +434,51 @@ def bench_config5(n_series, on_tpu):
     )
 
 
+def bench_multitenant(rate=400.0, duration=5.0):
+    """Mixed multi-tenant read+write bench (ROADMAP open item 3's success
+    metric): an in-process coordinator behind its real HTTP surface, a
+    two-tenant open-loop fixed-rate workload (services/loadgen.py
+    --tenants mode; ticks the loop can't take are counted, not absorbed —
+    no coordinated omission), reporting sustained QPS and per-tenant
+    p50/p95/p99."""
+    import argparse
+
+    from m3_tpu.services import loadgen
+    from m3_tpu.services.coordinator import Coordinator, serve
+
+    coord = Coordinator()
+    srv, port = serve(coord, 0)
+    try:
+        args = argparse.Namespace(
+            node="", coordinator=f"127.0.0.1:{port}", aggregator="",
+            namespace="default", series=200, rate=rate, duration=duration,
+            workers=8, batch=10, read_fraction=0.3, series_offset=0,
+            listen=None, agents="", tenants="alpha:3,beta:1",
+        )
+        out = loadgen.run_multitenant(
+            args, loadgen.make_tenant_client_factory(args)
+        )
+    finally:
+        srv.shutdown()
+        coord.db.close()
+    return _rec(
+        "multitenant_sustained_qps",
+        out["sustained_ops_per_sec"],
+        "ops/s",
+        target_ops_per_sec=out["target_ops_per_sec"],
+        missed_ticks=out["missed_ticks"],
+        errors=out["errors"],
+        rejected=out["rejected"],
+        per_tenant={
+            name: {
+                k: t[k]
+                for k in ("ops_per_sec", "p50_ms", "p95_ms", "p99_ms")
+            }
+            for name, t in out["tenants"].items()
+        },
+    )
+
+
 def bench_compression(n_series=2000, n_points=720):
     """bytes/datapoint on a PRODUCTION-LIKE trace, next to the reference's
     1.45 bytes/dp production claim (docs/m3db/architecture/engine.md:11).
@@ -566,7 +611,9 @@ def main() -> None:
     import jax
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,mixed,scan,index,compression")
+    ap.add_argument(
+        "--configs", default="1,2,3,4,5,mixed,scan,index,compression,tenants"
+    )
     ap.add_argument("--series", type=int, default=0, help="override config-2 series")
     ap.add_argument("--out", default="PERF_r05.json")
     args = ap.parse_args()
@@ -599,6 +646,8 @@ def main() -> None:
         records.append(bench_index(5_000_000 if big else 100_000))
     if "compression" in want:
         records.append(bench_compression())
+    if "tenants" in want:
+        records.append(bench_multitenant())
 
     # merge into an existing results file: re-running a subset of configs
     # replaces those records and keeps the rest

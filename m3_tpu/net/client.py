@@ -187,6 +187,14 @@ class RpcClient:
             )
         req = wire.inject_trace({"op": op, **args}, TRACER.current_context())
         wire.inject_deadline(req, deadline)
+        # tenant propagation (query/tenants.py): a call made under a
+        # tenant context carries the identity so the server attributes
+        # its work (decode device-seconds, per-tenant rpc counters) to
+        # the same caller. query/__init__ is empty, so this import pulls
+        # no jax-adjacent weight into the net layer.
+        from ..query.tenants import current as current_tenant
+
+        wire.inject_tenant(req, current_tenant())
         sock = self._acquire()
         try:
             sock.settimeout(remaining)

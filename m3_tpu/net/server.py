@@ -113,6 +113,14 @@ class RpcMiddleware:
         op = str(req.get("op"))
         ctx = wire.extract_trace(req)
         deadline = wire.extract_deadline(req)
+        tenant = wire.extract_tenant(req)
+        if tenant is not None:
+            # normalize BEFORE any accounting: tenant ids come off the
+            # wire, and junk/flood ids must collapse into the capped
+            # overflow tenant, not mint ledger accounts or label values
+            from ..query.tenants import normalize
+
+            tenant = normalize(tenant)
         if op == "metrics" and not hasattr(self.service, "op_metrics"):
             # fmt="json" serves the structured Registry.collect() snapshot
             # (what the self-scrape collector pulls); default stays the
@@ -136,6 +144,13 @@ class RpcMiddleware:
             if shed:
                 self._shed.inc()
                 errors.inc()
+                if tenant is not None:
+                    # the shed is attributed: per-tenant shed counters are
+                    # what admission-control rules (tenant:shed:rate5m)
+                    # key off
+                    from ..query.tenants import LEDGER
+
+                    LEDGER.charge(tenant, sheds=1)
                 raise UnavailableError(
                     f"overloaded: {self.max_inflight} requests in flight, "
                     f"shedding {op!r}"
@@ -163,7 +178,19 @@ class RpcMiddleware:
                     f"dispatch of {op!r}"
                 )
             with span:
-                return self.service.handle(req)
+                if tenant is None:
+                    return self.service.handle(req)
+                # re-establish the caller's tenant context around dispatch
+                # (a thread-local cannot cross the socket — the same seam
+                # shape as the selfmon wire marker): storage/decode work
+                # under this handler, including the KernelProfiler's
+                # sampled device-seconds, is attributed to the tenant
+                from ..query.tenants import LEDGER, tenant_context
+
+                LEDGER.charge(tenant, rpcs=1)
+                span.set_tag("tenant", tenant)
+                with tenant_context(tenant):
+                    return self.service.handle(req)
         except Exception:
             errors.inc()
             raise
@@ -220,34 +247,46 @@ class NodeService:
     # dispatch (selfmon/guard.py invariant 1); unmarked reserved-namespace
     # writes still raise inside storage.Database
 
+    # write ops also attribute ingested datapoint counts to the caller's
+    # wire-carried tenant context (query/tenants.charge_writes — a no-op
+    # for unattributed intra-fleet traffic)
+
     def op_write(self, req):
+        from ..query.tenants import charge_writes
         from ..selfmon.guard import wire_writer
 
         with wire_writer(req.get("selfmon")):
             self.db.write(
                 req["ns"], req["sid"], req["t"], req["v"], Unit(req.get("unit", 1))
             )
+        charge_writes(1)
         return True
 
     def op_write_batch(self, req):
+        from ..query.tenants import charge_writes
         from ..selfmon.guard import wire_writer
 
         with wire_writer(req.get("selfmon")):
             self.db.write_batch(req["ns"], [tuple(e) for e in req["entries"]])
+        charge_writes(len(req["entries"]))
         return True
 
     def op_write_tagged(self, req):
+        from ..query.tenants import charge_writes
         from ..selfmon.guard import wire_writer
 
         tags = tuple((n, v) for n, v in req["tags"])
         with wire_writer(req.get("selfmon")):
-            return self.db.write_tagged(
+            result = self.db.write_tagged(
                 req["ns"], tags, req["t"], req["v"], Unit(req.get("unit", 1))
             )
+        charge_writes(1)
+        return result
 
     def op_write_tagged_batch(self, req):
         """One RPC per host-queue flush (host_queue.go role); per-entry
         errors ride back so the session counts quorum per datapoint."""
+        from ..query.tenants import charge_writes
         from ..selfmon.guard import wire_writer
 
         entries = [
@@ -255,7 +294,9 @@ class NodeService:
             for tags, t, val, unit in req["entries"]
         ]
         with wire_writer(req.get("selfmon")):
-            return self.db.write_tagged_batch(req["ns"], entries)
+            errs = self.db.write_tagged_batch(req["ns"], entries)
+        charge_writes(sum(1 for e in errs if not e) if errs else len(entries))
+        return errs
 
     def op_fetch(self, req):
         dps = self.db.read(req["ns"], req["sid"], req["start"], req["end"])

@@ -258,6 +258,36 @@ def extract_trace(req: dict) -> dict | None:
     return {"trace_id": tid, "span_id": sid, "sampled": bool(sampled)}
 
 
+# --- tenant propagation (the identity half of per-tenant cost
+# attribution, query/tenants.py: the coordinator's HTTP layer sets a
+# thread-local tenant context, and it must survive the socket hop so
+# dbnode-side decode work is attributed to the same caller) ---
+
+# reserved request-map key: the caller's tenant id (str)
+TENANT_KEY = "_tenant"
+
+
+def inject_tenant(req: dict, tenant: str | None) -> dict:
+    """Attach the active tenant identity to an RPC request map; no-op
+    when no tenant context is active (intra-fleet traffic stays
+    unattributed rather than paying a frame field per call)."""
+    if tenant is not None:
+        req[TENANT_KEY] = str(tenant)
+    return req
+
+
+def extract_tenant(req: dict) -> str | None:
+    """Pop the tenant off an incoming request map (popped so op handlers
+    never see the reserved key). Malformed → None, like extract_trace;
+    VALIDATION (charset/length/cardinality) is the receiver's job —
+    query/tenants.normalize collapses junk into the capped overflow
+    tenant."""
+    raw = req.pop(TENANT_KEY, None)
+    if not isinstance(raw, str) or not raw:
+        return None
+    return raw
+
+
 # --- deadline propagation (x/context deadlines over TChannel in the
 # reference; "The Tail at Scale" cancellation discipline: a server must not
 # burn cycles on a request whose caller already gave up) ---

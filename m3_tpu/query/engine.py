@@ -95,12 +95,17 @@ class Engine:
         lookback_nanos: int = DEFAULT_LOOKBACK,
         limits=None,
         global_enforcer=None,
+        tenant_enforcers=None,
     ) -> None:
         self.storage = storage
         self.lookback = lookback_nanos
         # per-query cost limits (query/cost.py); None = unlimited
         self.limits = limits
         self.global_enforcer = global_enforcer
+        # per-tenant middle scopes (query/tenants.TenantEnforcers): when
+        # set, the enforcer chain is query → tenant → global and each
+        # query's parent scope resolves from the thread's tenant context
+        self.tenant_enforcers = tenant_enforcers
         self._enforcer = threading.local()
 
     def query_range(
@@ -127,11 +132,22 @@ class Engine:
             # @ start()/end() bind to the TOP-LEVEL query range, even inside
             # subqueries (prometheus PreprocessExpr)
             _bind_at(ast, bounds)
-            if self.limits is None:
-                return self._eval(ast, bounds)
-            from .cost import Enforcer
+            parent = self.global_enforcer
+            if self.tenant_enforcers is not None:
+                # the per-tenant middle scope: charges flow query →
+                # tenant → global, so a runaway tenant trips its own
+                # ceiling before it can exhaust the fleet's
+                from . import tenants
 
-            enforcer = Enforcer(self.limits, self.global_enforcer)
+                parent = self.tenant_enforcers.scope_for(tenants.current())
+            if self.limits is None and parent is None:
+                return self._eval(ast, bounds)
+            from .cost import Enforcer, QueryLimits
+
+            enforcer = Enforcer(
+                self.limits if self.limits is not None else QueryLimits(),
+                parent,
+            )
             self._enforcer.current = enforcer
             try:
                 return self._eval(ast, bounds)
@@ -140,6 +156,15 @@ class Engine:
                 enforcer.release()
         except Exception as exc:
             err = f"{type(exc).__name__}: {exc}"
+            from .cost import QueryLimitError
+
+            if isinstance(exc, QueryLimitError):
+                # the slow-query ring must show WHICH chain scope 422'd
+                # the query — stamped on the thread's active record (the
+                # outer record when this frame is a nested evaluation)
+                cur = stats.current()
+                if cur is not None:
+                    cur.limit_exceeded = exc.scope
             raise
         finally:
             if qs is not None:

@@ -85,10 +85,10 @@ class M3Storage:
             ).docs
             resident = self._fetch_resident(docs, start_nanos, end_nanos)
             if resident is not None:
-                stats.add(
-                    resident_hits=1,
-                    bytes_=sum(t.nbytes + v.nbytes for _, t, v in resident),
-                )
+                nb = sum(t.nbytes + v.nbytes for _, t, v in resident)
+                # resident_bytes feeds the tenant ledger's streamed-vs-
+                # resident split (bytes_scanned - resident_bytes = streamed)
+                stats.add(resident_hits=1, bytes_=nb, resident_bytes=nb)
                 return resident
             # fall back through the normal array surface, reusing the
             # plan's index resolution (fetch_tagged_arrays also restores

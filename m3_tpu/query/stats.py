@@ -54,9 +54,19 @@ class QueryStats:
     # stages) — only meaningful while the query is in flight
     namespace: str = ""
     current_stage: str | None = None
+    # who is charged for this query (query/tenants.py): stamped from the
+    # thread's tenant context at start(); "" renders as anonymous
+    tenant: str = ""
+    # the enforcer-chain scope that 422'd the query (query/tenant/global),
+    # None when no cost limit tripped — a rejection must leave a record
+    # trail, not just an HTTP status
+    limit_exceeded: str | None = None
     series_scanned: int = 0
     datapoints_scanned: int = 0
     bytes_scanned: int = 0
+    # the subset of bytes_scanned served from HBM residency (the rest
+    # streamed) — the ledger's streamed-vs-resident split
+    resident_bytes: int = 0
     cache_hits: int = 0
     cache_misses: int = 0
     # HBM-residency routing (m3_tpu/resident/): fetches served by the
@@ -81,6 +91,8 @@ class QueryStats:
         out = {
             "query": self.query,
             "namespace": self.namespace,
+            "tenant": self.tenant,
+            "limitExceeded": self.limit_exceeded,
             "startUnixNanos": self.start_unix_nanos,
             "durationSecs": self.duration_secs,
             "stages": dict(self.stages),
@@ -144,10 +156,12 @@ def start(query: str) -> QueryStats | None:
         return None
     st = QueryStats(query=query, start_unix_nanos=time.time_ns())
     from ..utils.trace import TRACER
+    from . import tenants
 
     ctx = TRACER.current_context()
     if ctx is not None:
         st.trace_id = f"{ctx['trace_id']:016x}"
+    st.tenant = tenants.current() or tenants.DEFAULT_TENANT
     _local.stats = st
     ACTIVE.register(st)
     return st
@@ -172,7 +186,7 @@ def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> No
     # /debug/slow_queries record via the shared id
     METRICS.histogram(
         "query_duration_seconds", "query wall time", buckets=_QUERY_BUCKETS
-    ).observe(duration_secs, trace_id=st.trace_id)
+    ).observe(duration_secs, trace_id=st.trace_id, tenant=st.tenant or None)
     for stage, secs in st.stages.items():
         METRICS.histogram(
             "query_stage_duration_seconds",
@@ -192,6 +206,24 @@ def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> No
             "query_resident_misses_total",
             "fetches that fell back to the streamed path with the pool on",
         ).inc(st.resident_misses)
+    # per-tenant attribution (query/tenants.py): every completed query
+    # charges its scan work — and any cost-limit rejection — against the
+    # tenant stamped at start(); decode device-seconds are charged
+    # separately by the KernelProfiler attribution hook (sampled)
+    from . import tenants
+
+    tenants.LEDGER.charge(
+        st.tenant or tenants.DEFAULT_TENANT,
+        queries=1,
+        series=st.series_scanned,
+        datapoints=st.datapoints_scanned,
+        bytes_streamed=max(st.bytes_scanned - st.resident_bytes, 0),
+        bytes_resident=st.resident_bytes,
+        cache_hits=st.cache_hits,
+        cache_misses=st.cache_misses,
+        limit_rejections=1 if st.limit_exceeded else 0,
+        errors=1 if error is not None else 0,
+    )
 
 
 def add(
@@ -202,6 +234,7 @@ def add(
     cache_misses: int = 0,
     resident_hits: int = 0,
     resident_misses: int = 0,
+    resident_bytes: int = 0,
 ) -> None:
     """Charge scan counters against this thread's active query (no-op
     outside a query, so storage paths call it unconditionally)."""
@@ -215,6 +248,7 @@ def add(
     st.cache_misses += cache_misses
     st.resident_hits += resident_hits
     st.resident_misses += resident_misses
+    st.resident_bytes += resident_bytes
 
 
 class _Stage:
@@ -286,6 +320,7 @@ class ActiveQueryRegistry:
             {
                 "query": st.query,
                 "namespace": st.namespace,
+                "tenant": st.tenant,
                 "traceId": st.trace_id,
                 "stage": st.current_stage,
                 "startUnixNanos": st.start_unix_nanos,

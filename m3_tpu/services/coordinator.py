@@ -49,6 +49,7 @@ class Coordinator:
         kv: KVStore | None = None,
         base_dir: str | None = None,
         query_limits=None,
+        tenant_limits=None,
     ) -> None:
         import tempfile
 
@@ -68,10 +69,21 @@ class Coordinator:
                     max_datapoints=query_limits.max_datapoints * 10,
                 )
             )
+        tenant_enforcers = None
+        if tenant_limits is not None:
+            # the per-tenant middle scope of the enforcer chain
+            # (query → tenant → global): tenant_limits is a
+            # tenants.TenantLimitSet (load_tenant_limits file format)
+            from ..query.tenants import TenantEnforcers
+
+            tenant_enforcers = TenantEnforcers.from_limit_set(
+                tenant_limits, global_enforcer=global_enforcer
+            )
         self.engine = Engine(
             M3Storage(db, namespace),
             limits=query_limits,
             global_enforcer=global_enforcer,
+            tenant_enforcers=tenant_enforcers,
         )
         self.downsampler = downsampler
         self.kv = kv or KVStore()
@@ -97,6 +109,7 @@ class Coordinator:
                 M3Storage(self.db, namespace),
                 limits=self.engine.limits,
                 global_enforcer=self.engine.global_enforcer,
+                tenant_enforcers=self.engine.tenant_enforcers,
             )
             # cache only namespaces the store actually knows: the param
             # comes off an unauthenticated HTTP query string, and caching
@@ -294,6 +307,9 @@ class Coordinator:
             else:
                 for tags, t_nanos, v, unit in batch:
                     self.db.write_tagged(self.namespace, tags, t_nanos, v)
+        from ..query.tenants import charge_writes
+
+        charge_writes(count)
         return count
 
     def read_prom(self, req: prompb.ReadRequest) -> prompb.ReadResponse:
@@ -335,6 +351,16 @@ class Coordinator:
             query, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
         )
 
+    def _cost_parent(self):
+        """The parent scope a fresh per-query Enforcer chains to: the
+        active tenant's middle scope when tenant limits are configured,
+        else the global ceiling (None when neither is)."""
+        if self.engine.tenant_enforcers is not None:
+            from ..query.tenants import current as current_tenant
+
+            return self.engine.tenant_enforcers.scope_for(current_tenant())
+        return self.engine.global_enforcer
+
     # --- graphite (src/query/api/v1/handler/graphite/render.go + find.go) ---
 
     def _graphite_engine(self, enforcer=None):
@@ -354,20 +380,34 @@ class Coordinator:
             raise ValueError("step must be positive")
         steps = max(int((end_s - start_s) // step_s), 1)
         # the graphite path honors the same cost limits as PromQL: bound the
-        # step grid up front, charge fetched output per target
-        limits = self.engine.limits
-        enforcer = None
-        if limits is not None:
-            from ..query.cost import Enforcer, QueryLimitError
+        # step grid up front, charge fetched output per target — through
+        # the same query → tenant → global chain. The graphite engine has
+        # no QueryStats record (stats.finish is the PromQL path's ledger
+        # seam), so this surface charges the tenant ledger itself — every
+        # query surface must attribute, or /debug/tenants lies for it.
+        from ..query import tenants as _tenants
+        from ..query.cost import QueryLimitError
 
-            if 0 < limits.max_datapoints < steps:
-                raise QueryLimitError("datapoints", steps, limits.max_datapoints)
-            enforcer = Enforcer(limits, self.engine.global_enforcer)
-        # the enforcer rides inside the engine's fetch, so oversized globs
-        # abort at fetch depth (like the PromQL path), not after rendering
-        engine = self._graphite_engine(enforcer=enforcer)
-        out = []
+        limits = self.engine.limits
+        parent = self._cost_parent()
+        enforcer = None
+        rejected = errored = False
         try:
+            if limits is not None or parent is not None:
+                from ..query.cost import Enforcer, QueryLimits, limit_error
+
+                if limits is not None and 0 < limits.max_datapoints < steps:
+                    raise limit_error(
+                        "query", "datapoints", steps, limits.max_datapoints
+                    )
+                enforcer = Enforcer(
+                    limits if limits is not None else QueryLimits(), parent
+                )
+            # the enforcer rides inside the engine's fetch, so oversized
+            # globs abort at fetch depth (like the PromQL path), not after
+            # rendering
+            engine = self._graphite_engine(enforcer=enforcer)
+            out = []
             for target in q.get("target", []):
                 series = engine.render(
                     target, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
@@ -378,10 +418,22 @@ class Coordinator:
                         for i, v in enumerate(s.values)
                     ]
                     out.append({"target": s.name, "datapoints": pts})
+            return out
+        except Exception as exc:
+            errored = True
+            rejected = isinstance(exc, QueryLimitError)
+            raise
         finally:
             if enforcer is not None:
                 enforcer.release()
-        return out
+            _tenants.LEDGER.charge(
+                _tenants.current() or _tenants.DEFAULT_TENANT,
+                queries=1,
+                series=enforcer.series if enforcer is not None else 0,
+                datapoints=enforcer.datapoints if enforcer is not None else 0,
+                limit_rejections=1 if rejected else 0,
+                errors=1 if errored else 0,
+            )
 
     def graphite_find(self, pattern: str) -> list[dict]:
         return self._graphite_engine().find(pattern)
@@ -460,6 +512,9 @@ class Coordinator:
                 )
             if keep:
                 self.db.write_tagged(self.namespace, tag_pairs, t_nanos, value)
+        from ..query.tenants import charge_writes
+
+        charge_writes(len(points))
         return len(points)
 
     def labels(self, match_exprs: list[str] | None = None,
@@ -533,6 +588,16 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(n)
 
+    def _tenant(self, q: dict) -> str:
+        """The caller's tenant identity: ``M3-Tenant`` header first, then
+        the ``tenant=`` query param, default anonymous — normalized so
+        junk ids collapse into the capped overflow tenant."""
+        from ..query.tenants import normalize
+
+        return normalize(
+            self.headers.get("M3-Tenant") or q.get("tenant", [None])[0]
+        )
+
     def _debug_dump(self) -> bytes:
         """x/debug/debug.go zip dump: thread stacks, metrics, namespaces,
         placement, recent traces."""
@@ -562,6 +627,9 @@ class _Handler(BaseHTTPRequestHandler):
             z.writestr(
                 "active_queries.json", json.dumps(ACTIVE.dump(), indent=1)
             )
+            from ..query.tenants import LEDGER
+
+            z.writestr("tenants.json", json.dumps(LEDGER.dump(), indent=1))
             if c.ruler is not None:
                 z.writestr(
                     "ruler.json",
@@ -607,10 +675,19 @@ class _Handler(BaseHTTPRequestHandler):
                     "/health", "/metrics", "/debug/traces",
                     "/debug/slow_queries", "/debug/dump",
                     "/debug/exemplars", "/debug/active_queries",
+                    "/debug/tenants",
                 )
                 else TRACER.span("http.get", path=url.path)
             )
-            with span:
+            # tenant identity (M3-Tenant header / tenant= param) rides a
+            # thread-local for the whole request: QueryStats, the cost
+            # chain's tenant scope, the ledger, and outbound RPC frames
+            # all read it from here
+            from ..query.tenants import tenant_context
+
+            tenant = self._tenant(q)
+            span.set_tag("tenant", tenant)
+            with tenant_context(tenant), span:
                 if url.path == "/health":
                     self._json({"ok": True})
                 elif url.path == "/metrics":
@@ -723,6 +800,13 @@ class _Handler(BaseHTTPRequestHandler):
                     from ..query.stats import ACTIVE
 
                     self._json(ACTIVE.dump())
+                elif url.path == "/debug/tenants":
+                    # who is spending what: per-tenant rolling-window +
+                    # cumulative ledger columns (query/tenants.py), the
+                    # live sibling of the stored m3tpu_tenant_* series
+                    from ..query.tenants import LEDGER
+
+                    self._json(LEDGER.dump())
                 elif url.path == "/debug/exemplars":
                     # trace-ID exemplars per histogram bucket: join a slow
                     # bucket to its stitched trace (/debug/traces) and its
@@ -764,7 +848,12 @@ class _Handler(BaseHTTPRequestHandler):
         c = self.coordinator
         url = urlparse(self.path)
         try:
-            with TRACER.span("http.post", path=url.path):
+            from ..query.tenants import tenant_context
+
+            tenant = self._tenant(parse_qs(url.query))
+            span = TRACER.span("http.post", path=url.path)
+            span.set_tag("tenant", tenant)
+            with tenant_context(tenant), span:
                 if url.path in (
                     "/api/v1/graphite/render",
                     "/render",
@@ -774,10 +863,24 @@ class _Handler(BaseHTTPRequestHandler):
                     # Grafana's graphite datasource POSTs form-encoded bodies
                     form = parse_qs(self._body().decode())
                     form.update(parse_qs(url.query))
-                    if url.path.endswith("find"):
-                        self._json(c.graphite_find(form.get("query", ["*"])[0]))
-                    else:
-                        self._json(c.graphite_render(form))
+                    # header/query-param identity wins; a tenant supplied
+                    # only in the form body (the Grafana POST shape) must
+                    # still attribute — nested context, restored on exit
+                    from ..query.tenants import DEFAULT_TENANT, normalize
+
+                    form_tenant = form.get("tenant", [None])[0]
+                    inner = (
+                        tenant_context(normalize(form_tenant))
+                        if tenant == DEFAULT_TENANT and form_tenant
+                        else tenant_context(None)
+                    )
+                    with inner:
+                        if url.path.endswith("find"):
+                            self._json(
+                                c.graphite_find(form.get("query", ["*"])[0])
+                            )
+                        else:
+                            self._json(c.graphite_render(form))
                 elif url.path == "/api/v1/prom/remote/write":
                     raw = decompress(self._body())
                     req = prompb.WriteRequest()
@@ -807,6 +910,9 @@ class _Handler(BaseHTTPRequestHandler):
                     c.db.write_tagged(
                         c.namespace, tags, int(body["timestamp"] * NANOS), float(body["value"])
                     )
+                    from ..query.tenants import charge_writes
+
+                    charge_writes(1)
                     self._json({"ok": True})
                 elif url.path == "/api/v1/services/m3db/database/create":
                     body = json.loads(self._body())
@@ -864,7 +970,10 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._json({"error": "not found"}, 404)
         except Exception as exc:
-            self._json({"status": "error", "error": str(exc)}, 400)
+            from ..query.cost import QueryLimitError
+
+            code = 422 if isinstance(exc, QueryLimitError) else 400
+            self._json({"status": "error", "error": str(exc)}, code)
 
 
 def _prom_range(q: dict) -> tuple[int, int]:
@@ -918,6 +1027,9 @@ class CoordinatorConfig:
     base_dir: str = ""
     num_shards: int = 4
     limits: LimitsConfig = _dc_field(default_factory=LimitsConfig)
+    # path to a per-tenant limits file (query/tenants.load_tenant_limits
+    # format): enables the tenant middle scope of the cost chain
+    tenant_limits: str = ""
 
 
 def main(argv=None) -> int:
@@ -943,6 +1055,14 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--base-dir", default=None)
     p.add_argument("--namespace", default=None)
+    p.add_argument(
+        "--tenant-limits",
+        default=None,
+        help="path to a per-tenant limits YAML/JSON file "
+        "(query/tenants.load_tenant_limits format): adds the per-tenant "
+        "middle scope to the cost-enforcer chain so one tenant's "
+        "runaway scan 422s without starving the fleet",
+    )
     p.add_argument(
         "--kv-endpoint",
         default="",
@@ -1034,7 +1154,19 @@ def main(argv=None) -> int:
             max_series=cfg.limits.max_series,
             max_datapoints=cfg.limits.max_datapoints,
         )
-    coord = Coordinator(db=db, namespace=namespace, query_limits=limits, kv=kv)
+    tenant_limits = None
+    tenant_limits_path = (
+        args.tenant_limits if args.tenant_limits is not None
+        else cfg.tenant_limits
+    )
+    if tenant_limits_path:
+        from ..query.tenants import load_tenant_limits
+
+        tenant_limits = load_tenant_limits(tenant_limits_path)
+    coord = Coordinator(
+        db=db, namespace=namespace, query_limits=limits, kv=kv,
+        tenant_limits=tenant_limits,
+    )
     server, bound = serve(coord, port, host=host)
 
     static_peers = {}

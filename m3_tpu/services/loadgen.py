@@ -21,6 +21,23 @@ distributed (one agent per host, then a coordinator invocation):
 The coordinator splits rate + DISJOINT series ranges across agents,
 polls them, and prints the aggregated stats line. Prints one JSON line of
 achieved stats at the end.
+
+MULTI-TENANT mode (``--tenants "alpha:3,beta:1"``): a mixed read+write
+workload attributed per tenant (``M3-Tenant`` header on the coordinator
+HTTP surface; the ``_tenant`` wire frame against a dbnode), driven
+OPEN-LOOP at a fixed rate (utils/schedule.FixedRateTicker — ticks fire on
+the absolute schedule whether or not the previous op finished, and ticks
+the loop could not take are counted as ``missed_ticks`` instead of
+silently stretching the period) so latency percentiles do not suffer
+coordinated omission. One op = one write (a ``--batch``-sized batch
+against a node, one sample against a coordinator) or one read
+(``--read-fraction``); the JSON line reports sustained ops/sec plus
+per-tenant p50/p95/p99 SERVICED-op latency (422s and errors are counted
+apart, never mixed into the percentiles) and rejection counts:
+
+    python -m m3_tpu.services.loadgen --coordinator 127.0.0.1:7201 \
+        --tenants "alpha:3,beta:1" --rate 200 --read-fraction 0.3 \
+        --series 100 --duration 10
 """
 
 from __future__ import annotations
@@ -57,7 +74,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="AGENT mode: serve the loadgen RPC on this port (0=auto)")
     p.add_argument("--agents", default="",
                    help="COORDINATOR mode: comma-separated agent host:port list")
+    p.add_argument(
+        "--tenants", default="",
+        help='MULTI-TENANT mode: "name:weight,..." mix (weight optional, '
+        "default 1). Ops carry the tenant identity (M3-Tenant header / "
+        "_tenant wire field); --rate becomes OPS/sec driven open-loop, "
+        "and the stats line grows per-tenant p50/p95/p99",
+    )
     return p
+
+
+def parse_tenant_spec(spec: str) -> list[tuple[str, int]]:
+    """``"alpha:3,beta"`` → [("alpha", 3), ("beta", 1)]."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        weight = int(w) if w else 1
+        if weight < 1:
+            raise ValueError(f"tenant weight must be >= 1: {part!r}")
+        out.append((name, weight))
+    if not out:
+        raise ValueError(f"empty tenant spec {spec!r}")
+    return out
 
 
 class Stats:
@@ -200,6 +241,265 @@ def make_client_factory(args):
     return make_client
 
 
+# --- multi-tenant open-loop mode ------------------------------------------
+
+
+class Rejected(Exception):
+    """The target refused the op on a cost limit (HTTP 422 /
+    QueryLimitError over the wire) — counted apart from errors: a capped
+    tenant being 422'd is the SYSTEM working, not the bench failing."""
+
+
+def make_tenant_client_factory(args):
+    """Tenant-attributed client factory: ops carry the tenant identity
+    the way a real caller would (M3-Tenant header on the coordinator
+    HTTP surface, the thread-local tenant context → ``_tenant`` wire
+    frame against a dbnode)."""
+    if args.coordinator:
+        import urllib.error
+        import urllib.request
+        from urllib.parse import urlencode
+
+        base = f"http://{args.coordinator}"
+
+        class HttpTenantClient:
+            def _open(self, req):
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()
+                except urllib.error.HTTPError as exc:
+                    exc.close()
+                    if exc.code == 422:
+                        raise Rejected(str(exc)) from exc
+                    raise
+
+            def write(self, tenant: str, series_idx: int) -> None:
+                body = json.dumps(
+                    {
+                        "tags": {
+                            "__name__": f"load_{tenant}_{series_idx}",
+                            "tenant": tenant,
+                        },
+                        "timestamp": time.time(),
+                        "value": float(series_idx),
+                    }
+                ).encode()
+                self._open(
+                    urllib.request.Request(
+                        f"{base}/api/v1/json/write",
+                        data=body,
+                        headers={"M3-Tenant": tenant},
+                    )
+                )
+
+            def read(self, tenant: str) -> None:
+                # a range read over EVERYTHING the tenant wrote: the scan
+                # that trips per-tenant datapoint limits when capped
+                now = time.time()
+                q = urlencode(
+                    {
+                        "query": f'{{__name__=~"load_{tenant}_.*"}}',
+                        "start": now - 60,
+                        "end": now,
+                        "step": 5,
+                    }
+                )
+                self._open(
+                    urllib.request.Request(
+                        f"{base}/api/v1/query_range?{q}",
+                        headers={"M3-Tenant": tenant},
+                    )
+                )
+
+        return HttpTenantClient
+
+    if args.node:
+        from ..net.client import RemoteError, RemoteNode
+        from ..query.tenants import tenant_context
+
+        host, port = args.node.rsplit(":", 1)
+        ns = args.namespace
+        batch_n = args.batch
+
+        class NodeTenantClient:
+            def __init__(self) -> None:
+                self._node = RemoteNode(host, int(port))
+
+            def write(self, tenant: str, series_idx: int) -> None:
+                now_nanos = time.time_ns()
+                batch = [
+                    (
+                        f"load.{tenant}.{(series_idx + i) % args.series}".encode(),
+                        now_nanos + i,
+                        float(i),
+                    )
+                    for i in range(batch_n)
+                ]
+                with tenant_context(tenant):
+                    self._node.write_batch(ns, batch)
+
+            def read(self, tenant: str) -> None:
+                sid = f"load.{tenant}.0".encode()
+                try:
+                    with tenant_context(tenant):
+                        self._node.read(ns, sid, 0, 2**62)
+                except RemoteError as exc:
+                    if exc.etype == "QueryLimitError":
+                        raise Rejected(str(exc)) from exc
+                    raise
+
+        return NodeTenantClient
+
+    return None
+
+
+def _percentile_ms(lats: list[float], q: float) -> float:
+    if not lats:
+        return 0.0
+    lats = sorted(lats)
+    idx = min(int(q * len(lats)), len(lats) - 1)
+    return round(lats[idx] * 1e3, 3)
+
+
+class _TenantStats:
+    __slots__ = ("writes", "reads", "errors", "rejected", "ok", "lats")
+    # enough samples for a stable p99 at bench rates; past the cap new
+    # latencies overwrite a rotating slot so the reservoir stays recent
+    MAX_LATS = 200_000
+
+    def __init__(self) -> None:
+        self.writes = 0
+        self.reads = 0
+        self.errors = 0
+        self.rejected = 0
+        self.ok = 0
+        # SERVICED-op latencies only: a capped tenant's p99 must measure
+        # what the system did for it, not the 422 fast-path round trip
+        # (and a flapping backend's connect errors must not inflate a
+        # healthy tenant's tail)
+        self.lats: list[float] = []
+
+
+def run_multitenant(args, client_cls) -> dict:
+    """Open-loop fixed-rate mixed read+write load across the --tenants
+    mix; returns the stats record (per-tenant latency percentiles +
+    sustained ops/sec). ``--rate`` is OPS per second across all workers;
+    a tick the loop could not take (previous op still running) is
+    COUNTED in missed_ticks, never silently absorbed into the period —
+    the open-loop discipline that keeps percentiles honest."""
+    from ..utils.schedule import FixedRateTicker
+
+    mix = parse_tenant_spec(args.tenants)
+    # deterministic weighted rotation (no RNG: runs are reproducible and
+    # agents need no seed plumbing)
+    cycle = [name for name, w in mix for _ in range(w)]
+    per_tenant = {name: _TenantStats() for name, _ in mix}
+    lock = threading.Lock()
+    stop_evt = threading.Event()
+    workers = max(args.workers, 1)
+    per_worker_rate = args.rate / workers
+    if per_worker_rate <= 0:
+        raise ValueError("--rate must be positive")
+    missed_total = [0]
+    read_pct = int(args.read_fraction * 100)
+
+    def worker(widx: int) -> None:
+        client = client_cls()
+        ticker = FixedRateTicker(
+            1.0 / per_worker_rate,
+            phase_key=f"loadgen-{widx}",
+            stop=stop_evt,
+        )
+        k = widx
+        missed = 0
+        while True:
+            stopped, skipped = ticker.wait_next()
+            missed += skipped
+            if stopped:
+                break
+            tenant = cycle[k % len(cycle)]
+            is_read = (k % 100) < read_pct
+            k += workers
+            t0 = time.perf_counter()
+            outcome = "ok"
+            try:
+                if is_read:
+                    client.read(tenant)
+                else:
+                    client.write(tenant, k % args.series)
+            except Rejected:
+                outcome = "rejected"
+            except Exception:
+                outcome = "error"
+            lat = time.perf_counter() - t0
+            st = per_tenant[tenant]
+            with lock:
+                if outcome == "rejected":
+                    st.rejected += 1
+                elif outcome == "error":
+                    st.errors += 1
+                if is_read:
+                    st.reads += 1
+                else:
+                    st.writes += 1
+                if outcome == "ok":
+                    st.ok += 1
+                    if len(st.lats) < st.MAX_LATS:
+                        st.lats.append(lat)
+                    else:
+                        st.lats[st.ok % st.MAX_LATS] = lat
+        with lock:
+            missed_total[0] += missed
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop_evt.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = max(time.monotonic() - t0, 1e-9)
+
+    tenants_out = {}
+    total_ops = total_errors = total_rejected = 0
+    for name, st in per_tenant.items():
+        ops = st.writes + st.reads
+        total_ops += ops
+        total_errors += st.errors
+        total_rejected += st.rejected
+        tenants_out[name] = {
+            "ops": ops,
+            "writes": st.writes,
+            "reads": st.reads,
+            "errors": st.errors,
+            "rejected": st.rejected,
+            "ops_per_sec": round(ops / elapsed, 1),
+            "p50_ms": _percentile_ms(st.lats, 0.50),
+            "p95_ms": _percentile_ms(st.lats, 0.95),
+            "p99_ms": _percentile_ms(st.lats, 0.99),
+        }
+    return {
+        "mode": "multitenant",
+        "elapsed_secs": round(elapsed, 3),
+        "target_ops_per_sec": args.rate,
+        "sustained_ops_per_sec": round(total_ops / elapsed, 1),
+        "missed_ticks": missed_total[0],
+        "tenants": tenants_out,
+        # scalar keys the distributed coordinator's aggregation sums
+        "writes": sum(s.writes for s in per_tenant.values()),
+        "reads": sum(s.reads for s in per_tenant.values()),
+        "errors": total_errors,
+        "rejected": total_rejected,
+        "achieved_writes_per_sec": round(
+            sum(s.writes for s in per_tenant.values()) / elapsed, 1
+        ),
+    }
+
+
 class LoadgenAgentService:
     """Agent side of the m3nsch split: lg_start launches a run with the
     coordinator-supplied workload slice; lg_poll reports progress/result."""
@@ -215,7 +515,11 @@ class LoadgenAgentService:
             return {"role": "loadgen-agent"}
         if op == "lg_start":
             ns = argparse.Namespace(**req["args"])
-            make_client = make_client_factory(ns)
+            multitenant = bool(getattr(ns, "tenants", ""))
+            make_client = (
+                make_tenant_client_factory(ns) if multitenant
+                else make_client_factory(ns)
+            )
             if make_client is None:
                 raise ValueError("agent: no target in args")
             with self._lock:
@@ -225,7 +529,10 @@ class LoadgenAgentService:
 
             def _go():
                 try:
-                    rec["result"] = run(ns, make_client)
+                    rec["result"] = (
+                        run_multitenant(ns, make_client) if multitenant
+                        else run(ns, make_client)
+                    )
                 except Exception as exc:
                     rec["result"] = {"error": f"{type(exc).__name__}: {exc}"}
                 rec["done"] = True
@@ -323,8 +630,51 @@ def _run_coordinator(args) -> int:
             r.get("achieved_writes_per_sec") for r in per_agent
         ],
     }
+    if args.tenants:
+        out.update(merge_multitenant_results(per_agent, elapsed))
+        out.update(target_ops_per_sec=args.rate, per_agent=per_agent)
     print(json.dumps(out), flush=True)
     return 0
+
+
+def merge_multitenant_results(per_agent: list[dict], elapsed: float) -> dict:
+    """Merge multitenant agent records into the coordinator's output
+    line: per-tenant counts (ops/writes/reads/errors/rejected) SUM, and
+    percentiles — which can't be re-derived from percentiles — take the
+    WORST agent's value (conservative: a hidden slow agent must widen the
+    headline p99, never vanish into an average); missed_ticks and
+    rejected must survive aggregation or a heavily rejected tenant looks
+    like a clean run."""
+    merged: dict[str, dict] = {}
+    missed = rejected = total_ops = 0
+    for r in per_agent:
+        if "error" in r:
+            continue
+        missed += r.get("missed_ticks", 0)
+        rejected += r.get("rejected", 0)
+        for name, t in (r.get("tenants") or {}).items():
+            m = merged.setdefault(
+                name,
+                {
+                    "ops": 0, "writes": 0, "reads": 0, "errors": 0,
+                    "rejected": 0,
+                    "p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
+                },
+            )
+            for k in ("ops", "writes", "reads", "errors", "rejected"):
+                m[k] += t[k]
+            for k in ("p50_ms", "p95_ms", "p99_ms"):
+                m[k] = max(m[k], t[k])
+    for m in merged.values():
+        m["ops_per_sec"] = round(m["ops"] / elapsed, 1)
+        total_ops += m["ops"]
+    return {
+        "mode": "multitenant",
+        "tenants": merged,
+        "missed_ticks": missed,
+        "rejected": rejected,
+        "sustained_ops_per_sec": round(total_ops / elapsed, 1),
+    }
 
 
 def main(argv=None) -> int:
@@ -333,6 +683,14 @@ def main(argv=None) -> int:
         return _run_agent(args)
     if args.agents:
         return _run_coordinator(args)
+    if args.tenants:
+        client_cls = make_tenant_client_factory(args)
+        if client_cls is None:
+            print("loadgen: --tenants needs --node or --coordinator",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(run_multitenant(args, client_cls)), flush=True)
+        return 0
     make_client = make_client_factory(args)
     if make_client is None:
         print("loadgen: need --node, --coordinator or --aggregator", file=sys.stderr)

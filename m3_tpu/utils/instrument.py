@@ -75,23 +75,26 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.total = 0
-        # bucket index -> (value, trace_id, unix_nanos): the LAST traced
-        # observation per bucket (OpenMetrics-exemplar role) — a slow
-        # bucket links straight to its stitched trace in /debug/traces and
-        # its /debug/slow_queries record. Kept out of the text exposition
-        # (the 0.0.4 format has no exemplar grammar; tools/check_metrics
-        # validates every line) — served by collect() and /debug/exemplars.
-        self.exemplars: dict[int, tuple[float, str, int]] = {}
+        # bucket index -> (value, trace_id, unix_nanos, tenant): the LAST
+        # traced observation per bucket (OpenMetrics-exemplar role) — a
+        # slow bucket links straight to its stitched trace in
+        # /debug/traces and its /debug/slow_queries record, and carries
+        # the tenant the observation was attributed to. Kept out of the
+        # text exposition (the 0.0.4 format has no exemplar grammar;
+        # tools/check_metrics validates every line) — served by collect()
+        # and /debug/exemplars.
+        self.exemplars: dict[int, tuple[float, str, int, str | None]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float, trace_id: str | None = None) -> None:
+    def observe(self, v: float, trace_id: str | None = None,
+                tenant: str | None = None) -> None:
         with self._lock:
             i = bisect.bisect_left(self.buckets, v)
             self.counts[i] += 1
             self.sum += v
             self.total += 1
             if trace_id is not None:
-                self.exemplars[i] = (v, trace_id, time.time_ns())
+                self.exemplars[i] = (v, trace_id, time.time_ns(), tenant)
 
     def snapshot(self) -> tuple[list[int], float, int]:
         """(counts, sum, total) read atomically vs concurrent observe() —
@@ -104,11 +107,12 @@ class Histogram:
         with self._lock:
             items = sorted(self.exemplars.items())
         out = []
-        for i, (v, tid, ts) in items:
+        for i, (v, tid, ts, tenant) in items:
             le = self.buckets[i] if i < len(self.buckets) else float("inf")
-            out.append(
-                {"le": le, "value": v, "traceId": tid, "timeUnixNanos": ts}
-            )
+            row = {"le": le, "value": v, "traceId": tid, "timeUnixNanos": ts}
+            if tenant is not None:
+                row["tenant"] = tenant
+            out.append(row)
         return out
 
 
@@ -250,6 +254,7 @@ class JitTracker:
 
     def __init__(self, kernel: str, registry: Registry | None = None) -> None:
         reg = registry or DEFAULT
+        self.kernel = kernel
         self._compiles = reg.counter(
             "jit_compiles_total", "jit cache misses", {"kernel": kernel}
         )
@@ -288,6 +293,19 @@ class _JitCall:
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc_type is None:
             self.tracker._observe(self.key, time.perf_counter() - self._t0)
+
+
+# device-seconds attribution hook: query/tenants.py installs a callable
+# ``(kernel, seconds)`` invoked for every SAMPLED, non-compile profiled
+# dispatch, charging device time to the tenant context active on the
+# dispatching thread. A settable seam (not an import) because this module
+# sits below the query layer — utils must not import m3_tpu.query.
+_KERNEL_ATTRIBUTION = None
+
+
+def set_kernel_attribution(fn) -> None:
+    global _KERNEL_ATTRIBUTION
+    _KERNEL_ATTRIBUTION = fn
 
 
 # kernel dispatch latencies span ~10µs (a warm tiny batch on CPU) to whole
@@ -402,4 +420,8 @@ class _Dispatch:
                     jax.block_until_ready(self.result)
                 except ImportError:  # host-only result: nothing to sync
                     pass
-            prof._hist.observe(time.perf_counter() - self._t0)
+            elapsed = time.perf_counter() - self._t0
+            prof._hist.observe(elapsed)
+            hook = _KERNEL_ATTRIBUTION
+            if hook is not None:
+                hook(prof.kernel, elapsed)

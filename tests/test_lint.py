@@ -380,6 +380,37 @@ def test_clean_metric_quiet():
     assert findings == []
 
 
+def test_tenant_and_scope_label_keys_quiet():
+    # per-tenant attribution labels: "tenant" (ledger-capped values) and
+    # "scope" (the fixed enforcer-chain links) are allowlisted
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def charge(tenant, scope):
+            METRICS.counter("tenant_shed_total", labels={"tenant": tenant})
+            METRICS.counter(
+                "query_limit_exceeded_total", labels={"scope": scope}
+            )
+        """
+    )
+    assert findings == []
+
+
+def test_uncapped_tenant_like_label_key_fires():
+    # near-miss keys stay banned: an uncapped identity key ("tenant_id",
+    # "user") would be unbounded exposition cardinality
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def charge(tid):
+            METRICS.counter("tenant_shed_total", labels={"tenant_id": tid})
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+
+
 # --- M3L006 thread-daemon-discipline ---
 
 

@@ -387,9 +387,14 @@ class MetricNameDiscipline(Checker):
     # its own reserved-namespace activity (selfmon/convert.py).
     # "group": bounded by the operator-configured ruleset (rule groups in
     # the ruler's KV-mirrored rules file) — per-group eval health is the
-    # signal that makes the ruler itself alertable
+    # signal that makes the ruler itself alertable.
+    # "tenant": values come off unauthenticated HTTP headers and wire
+    # frames, but the TenantLedger caps distinct ids (M3_TPU_TENANT_CAP,
+    # default 64; the rest collapse into __overflow__, counted loudly) —
+    # per-tenant spend is exactly what open item 3's scheduler keys off.
+    # "scope": the fixed cost-enforcer chain links (query|tenant|global).
     LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
-                  "ns", "group"}
+                  "ns", "group", "tenant", "scope"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
