@@ -25,6 +25,12 @@ Prints FOUR JSON lines (FIVE with BENCH_SELFMON=1):
   5. (BENCH_SELFMON=1 only) {"metric": "selfmon_overhead", ...} — what the
      self-scrape collector cost while the phases ran (m3_tpu/selfmon/):
      scrapes, datapoints written, scrape errors, sampled kernel dispatches.
+  6. (BENCH_PROFILE=1 only) {"metric": "profile_overhead", ...} — the
+     continuous wall-clock stack sampler (m3_tpu/profiling/) running at
+     its default hz DURING the phases: samples taken, distinct stacks,
+     measured sampler seconds and overhead ratio — the PROFILE.md
+     continuous-profiling acceptance row (<2% median regression) is one
+     env-var A/B away.
 """
 
 from __future__ import annotations
@@ -46,6 +52,7 @@ def main() -> None:
     # row (acceptance: decode-aggregate dp/s regresses < 2%) is one
     # env-var A/B away
     selfmon = maybe_start_selfmon()
+    profiler = maybe_start_profiler()
     # the storage warm-cache phase is independent of the device kernel
     # phase: a kernel-phase failure (e.g. a jax version without the APIs
     # the Pallas path needs) must not cost the warm-cache metric line
@@ -66,6 +73,8 @@ def main() -> None:
     metrics_snapshot_line()
     if selfmon is not None:
         selfmon_overhead_line(selfmon)
+    if profiler is not None:
+        profile_overhead_line(profiler)
 
 
 def maybe_start_selfmon():
@@ -88,6 +97,12 @@ def maybe_start_selfmon():
     ).start()
 
 
+def _snap_total(snap: dict, name: str) -> float:
+    """Sum of a counter/gauge family's children in a collect() snapshot."""
+    fam = snap.get(name)
+    return sum(c["value"] for c in fam["children"]) if fam else 0.0
+
+
 def selfmon_overhead_line(selfmon) -> None:
     """Fifth JSON line (BENCH_SELFMON=1): what the self-scrape cost."""
     selfmon.stop()
@@ -97,8 +112,7 @@ def selfmon_overhead_line(selfmon) -> None:
     snap = METRICS.collect()
 
     def total(name):
-        fam = snap.get(name)
-        return sum(c["value"] for c in fam["children"]) if fam else 0.0
+        return _snap_total(snap, name)
 
     scrapes = total("m3tpu_selfmon_scrapes_total")
     dps = total("m3tpu_selfmon_datapoints_total")
@@ -120,6 +134,51 @@ def selfmon_overhead_line(selfmon) -> None:
                         "m3tpu_kernel_dispatch_seconds", {}
                     ).get("children", ())
                 ),
+            }
+        )
+    )
+
+
+def maybe_start_profiler():
+    """BENCH_PROFILE=1: run the always-on stack sampler during the bench
+    at its default rate (M3_TPU_PROFILE_HZ to override) — the A/B for the
+    PROFILE.md continuous-profiling overhead row."""
+    if os.environ.get("BENCH_PROFILE", "0") != "1":
+        return None
+    from m3_tpu.profiling import start_sampler
+
+    return start_sampler(instance="bench")
+
+
+def profile_overhead_line(profiler) -> None:
+    """Sixth JSON line (BENCH_PROFILE=1): what the sampler saw and cost."""
+    profiler.stop()
+    prof = profiler.profile()
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    snap = METRICS.collect()
+
+    def total(name):
+        return _snap_total(snap, name)
+
+    def gauge(name):
+        fam = snap.get(name)
+        return fam["children"][0]["value"] if fam and fam["children"] else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "profile_overhead",
+                "hz": profiler.hz,
+                "samples": total("m3tpu_profile_samples_total"),
+                "distinct_stacks": len(prof["folded"]),
+                "sampler_seconds": round(
+                    total("m3tpu_profile_overhead_seconds_total"), 6
+                ),
+                "overhead_ratio": round(gauge("m3tpu_profile_overhead_ratio"), 6),
+                "frames_truncated": total("m3tpu_profile_frames_truncated_total"),
+                "stacks_truncated": total("m3tpu_profile_stacks_truncated_total"),
+                "errors": total("m3tpu_profile_errors_total"),
             }
         )
     )

@@ -376,6 +376,13 @@ class RemoteNode(RpcClient):
         dumps by traceId to reassemble a cross-process trace."""
         return self._call("traces", limit=limit)
 
+    def profile(self, seconds: float | None = None) -> dict:
+        """The remote process's wall-clock folded-stack profile over the
+        last ``seconds`` (m3_tpu/profiling/): {"folded": {stack: count},
+        "samples", "hz", ...} — the fleet profile merge pulls this from
+        every peer."""
+        return self._call("profile", seconds=seconds)
+
     def owned_shards(self, cache_secs: float = 1.0) -> set[int]:
         cached = self._shards_cache
         now = time.monotonic()

@@ -217,6 +217,14 @@ class DebugService:
             return {"ok": True, **self.info}
         if op == "traces":
             return TRACER.dump(limit=req.get("limit") or 256)
+        if op == "profile":
+            # wall-clock folded-stack profile of this process (the
+            # continuous profiler's wire face; m3_tpu/profiling/) — the
+            # aggregator's --debug-port surface answers it too, so the
+            # coordinator's fleet merge covers every role
+            from ..profiling import process_profile
+
+            return process_profile(seconds=req.get("seconds"))
         raise ValueError(f"unknown op {op!r}")
 
 
@@ -370,10 +378,19 @@ class NodeService:
 
     def op_resident_stats(self, req):
         """HBM-resident compressed pool debug/status: admissions,
-        pages/bytes/occupancy, eviction + invalidation counters, and the
+        pages/bytes/occupancy, eviction + invalidation counters, the
         upload/streamed byte counters warm-scan zero-transfer checks key
-        on (m3_tpu/resident/)."""
+        on, and the per-shard heat split (m3_tpu/resident/)."""
         return self.db.resident_stats()
+
+    def op_profile(self, req):
+        """Continuous-profiling surface (m3_tpu/profiling/): this
+        process's wall-clock folded-stack profile over the last
+        ``seconds`` — what the coordinator's /debug/pprof/fleet merge
+        pulls from every placement node."""
+        from ..profiling import process_profile
+
+        return process_profile(seconds=req.get("seconds"))
 
     def op_flush(self, req):
         """Operator/CI flush: seal buffered blocks before the cutoff

@@ -215,8 +215,10 @@ IDEMPOTENT_OPS = frozenset(
         "health", "fetch", "fetch_blocks", "fetch_tagged", "query_ids",
         "aggregate_query", "stream_shard", "block_metadata",
         "stream_series_blocks", "scan_totals", "owned_shards",
-        # debug / observability
+        # debug / observability ('profile' reads the process's folded
+        # stack table — sampling continues regardless, duplicate-safe)
         "metrics", "traces", "cache_stats", "resident_stats", "lg_poll",
+        "profile",
         # operator ops that re-apply to the same state
         "flush", "assign_shards",
         # raft protocol (duplicate-safe by design)

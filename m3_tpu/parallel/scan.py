@@ -119,8 +119,14 @@ def _local_scan_aggregate(words, num_bits, initial_unit, *, max_points, with_psu
     else:
         # eager call: the first invocation per signature blocks on the jit
         # compile of decode_batched (tracked), and sampled dispatches are
-        # block_until_ready-bounded for the dispatch histogram
-        with _JIT_DECODE.dispatch((tuple(words.shape), int(max_points))) as d:
+        # block_until_ready-bounded for the dispatch histogram; cost=
+        # captures the compiled HLO's flops/bytes once per signature when
+        # profiling is on (m3tpu_kernel_flops / _bytes_accessed)
+        with _JIT_DECODE.dispatch(
+            (tuple(words.shape), int(max_points)),
+            cost=(decode_batched, (words, num_bits, initial_unit),
+                  {"max_points": max_points}),
+        ) as d:
             res = d.done(decode_batched(
                 words, num_bits, initial_unit, max_points=max_points
             ))
@@ -141,7 +147,8 @@ def chunked_scan_aggregate(lane_args: dict, s: int, c: int, k: int, with_psum=Fa
         res = decode_chunked_lanes(**lane_args, k=k)
     else:
         with CHUNKED_PROF.dispatch(
-            (tuple(lane_args["windows"].shape), int(k))
+            (tuple(lane_args["windows"].shape), int(k)),
+            cost=(decode_chunked_lanes, (), {**lane_args, "k": k}),
         ) as d:
             res = d.done(decode_chunked_lanes(**lane_args, k=k))
     vals = res.values_f32.reshape(s, c * k)
@@ -464,7 +471,13 @@ def resident_scan_aggregate(
     if _is_tracing(words):
         res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
     else:
-        with _JIT_RESIDENT.dispatch((tuple(words.shape), int(max_points))) as d:
+        # cost= covers the decode only — the page gather above already
+        # ran eagerly, so its flops aren't in this kernel's analysis
+        with _JIT_RESIDENT.dispatch(
+            (tuple(words.shape), int(max_points)),
+            cost=(decode_batched, (words, num_bits, initial_unit),
+                  {"max_points": max_points}),
+        ) as d:
             res = d.done(decode_batched(
                 words, num_bits, initial_unit, max_points=max_points
             ))
@@ -480,7 +493,11 @@ def scan_aggregate_with_err(
     if _is_tracing(words):
         res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
     else:
-        with _JIT_DECODE.dispatch((tuple(words.shape), int(max_points))) as d:
+        with _JIT_DECODE.dispatch(
+            (tuple(words.shape), int(max_points)),
+            cost=(decode_batched, (words, num_bits, initial_unit),
+                  {"max_points": max_points}),
+        ) as d:
             res = d.done(decode_batched(
                 words, num_bits, initial_unit, max_points=max_points
             ))

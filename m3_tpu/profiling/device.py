@@ -1,0 +1,88 @@
+"""Device-memory accounting: the live-buffer half of the device tier.
+
+Answers "what is holding device memory RIGHT NOW" with the same split
+the storage layers think in:
+
+- ``resident_pool`` — the paged HBM pool's flat page buffer
+  (m3_tpu/resident/: the compressed working set);
+- ``decoded_cache`` — the decoded-block cache's arrays
+  (m3_tpu/cache/: the byte-budget LRU of decoded lanes);
+- ``other`` — every other live jax buffer (staging arrays, kernel
+  outputs still referenced, query intermediates).
+
+Published as ``m3tpu_device_memory_bytes{kind}`` gauges so the selfmon
+pipeline stores the split as series (an OOM-adjacent incident becomes
+one PromQL query over ``_m3tpu``), refreshed on the stack sampler's
+schedule and on demand by the ``/debug/dump`` ``device_memory.json``
+snapshot.
+
+``jax.live_arrays()`` walks the client's live-buffer list — cheap at
+the fleet's array counts (the pool and cache keep FEW large arrays by
+design), but not free, which is why refresh rides the sampler's slow
+``memory_interval`` rather than every sample tick.
+"""
+
+from __future__ import annotations
+
+from ..utils.instrument import DEFAULT as METRICS
+
+KINDS = ("resident_pool", "decoded_cache", "other")
+
+_HELP = (
+    "live device/process memory by holder: resident_pool = the paged "
+    "compressed HBM pool, decoded_cache = decoded-block cache arrays, "
+    "other = remaining live jax buffers"
+)
+
+
+def _gauge(kind: str):
+    return METRICS.gauge("device_memory_bytes", _HELP, labels={"kind": kind})
+
+
+def collect_device_memory(db=None) -> dict:
+    """Snapshot the split, set the gauges, return the dict (the
+    ``device_memory.json`` shape). ``db`` is any Database-surface object;
+    None (or a cluster SessionDatabase with no local pool/cache) still
+    accounts ``other``. Never raises — a jax-less or mid-teardown
+    process reports what it can."""
+    resident = 0
+    cache = 0
+    pool = getattr(db, "resident_pool", None) if db is not None else None
+    if pool is not None:
+        resident = pool.device_bytes()
+    block_cache = getattr(db, "block_cache", None) if db is not None else None
+    if block_cache is not None:
+        try:
+            cache = int(block_cache.stats().get("bytes", 0))
+        except Exception:
+            cache = 0
+    total_live = 0
+    try:
+        # NEVER initiate the jax import from here: this runs on the
+        # sampler's daemon thread, and racing the main thread's first
+        # `import jax` leaves jax.numpy partially initialized for the
+        # request path (observed as AttributeError in RPC handlers). A
+        # process that hasn't imported jax has no live buffers to count.
+        import sys as _sys
+
+        jax = _sys.modules.get("jax")
+        if jax is not None:
+            total_live = sum(int(a.nbytes) for a in jax.live_arrays())
+        else:
+            total_live = resident
+    except Exception:
+        # partially initialized / backend torn down: report what we can
+        total_live = resident
+    # the decoded cache may hold HOST arrays (numpy) on some paths — it
+    # is accounted from its own byte budget, not subtracted from the
+    # live-buffer total (which only sees device arrays)
+    other = max(total_live - resident, 0)
+    out = {
+        "resident_pool": resident,
+        "decoded_cache": cache,
+        "other": other,
+        "total_live_jax_bytes": total_live,
+    }
+    for kind in KINDS:
+        _gauge(kind).set(float(out[kind]))
+    return out

@@ -1,0 +1,59 @@
+"""Fleet profile merge: the coordinator's whole-fleet flamegraph.
+
+One ``/debug/pprof/fleet`` request pulls every peer's folded-stack
+profile over the ``profile`` wire op and merges them by stack, tagging
+each stack's counts per instance — so a single response renders a
+flamegraph of the whole fleet with an instance split at every hot
+frame. A dead peer is expected fleet weather: counted, reported in the
+response, never fatal (the same contract as the selfmon peer pull).
+"""
+
+from __future__ import annotations
+
+from ..utils.instrument import DEFAULT as METRICS
+
+_M_PEER_ERRORS = METRICS.counter(
+    "profile_fleet_peer_errors_total",
+    "peer profile pulls that failed during a fleet profile merge",
+)
+
+
+def merge_profiles(profiles: list) -> dict:
+    """``profiles``: [(instance_id, profile_dict)] (the StackSampler
+    profile shape). Returns the merged folded table — stacks merged by
+    identical frame sequence, each carrying its per-instance counts."""
+    folded: dict[str, int] = {}
+    by_instance: dict[str, dict] = {}
+    for instance, prof in profiles:
+        for stack, count in (prof or {}).get("folded", {}).items():
+            folded[stack] = folded.get(stack, 0) + int(count)
+            per = by_instance.setdefault(stack, {})
+            per[instance] = per.get(instance, 0) + int(count)
+    return {"folded": folded, "byInstance": by_instance}
+
+
+def collect_fleet_profile(
+    local_instance: str, local_profile: dict, peers: dict, seconds: float
+) -> dict:
+    """Pull + merge: the coordinator's own profile plus every peer's
+    ``profile`` op result. ``peers``: {instance_id: node} where node
+    exposes ``profile(seconds=...)`` (RemoteNode or any stub). The
+    response is the ``/debug/pprof/fleet`` JSON shape."""
+    profiles = [(local_instance, local_profile)]
+    errors: dict[str, str] = {}
+    for pid, node in sorted(peers.items()):
+        try:
+            profiles.append((pid, node.profile(seconds=seconds)))
+        except Exception as exc:
+            # a down peer must not cost the rest of the fleet's profile
+            errors[pid] = f"{type(exc).__name__}: {exc}"
+            _M_PEER_ERRORS.inc()
+    merged = merge_profiles(profiles)
+    return {
+        "seconds": seconds,
+        "instances": [inst for inst, _ in profiles],
+        "errors": errors,
+        "samples": sum(merged["folded"].values()),
+        "folded": merged["folded"],
+        "byInstance": merged["byInstance"],
+    }

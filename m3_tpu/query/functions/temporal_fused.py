@@ -109,7 +109,9 @@ def fused_temporal(values, window: int, step_seconds: float, funcs: tuple[str, .
         v = jnp.pad(v, ((0, pad), (0, 0)), constant_values=jnp.nan)
     _M_PROCESSED.inc(int(v.size) * 4)
     with _JIT.dispatch(
-        (tuple(funcs), v.shape, int(window), float(step_seconds))
+        (tuple(funcs), v.shape, int(window), float(step_seconds)),
+        cost=(_fused_call,
+              (v, tuple(funcs), int(window), float(step_seconds), t), {}),
     ) as d:
         outs = d.done(
             _fused_call(v, tuple(funcs), int(window), float(step_seconds), t)

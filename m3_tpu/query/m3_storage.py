@@ -152,6 +152,7 @@ class M3Storage:
                 # far would be misleading half-truths — only the cause and
                 # the final outcome are reported)
                 stats.add_routing(doc.id, None, "streamed", "buffered-overlay")
+                pool.heat.charge(shard.id, misses=1)
                 return None
             doc_keys = []
             for key in keys:
@@ -166,11 +167,21 @@ class M3Storage:
                         doc.id, key.block_start, "streamed",
                         "not-resident (evicted or never admitted)",
                     )
+                    pool.heat.charge(key.shard_id, misses=1)
                     return None  # evicted / never admitted: stream instead
             plan.append((doc, doc_keys))
+        # per-shard heat (resident/heat.py): lanes about to be served
+        # resident, aggregated per shard so the hot path charges once per
+        # shard, not once per lane
+        lanes_per_shard: dict[int, int] = {}
         for doc, doc_keys in plan:
             for key in doc_keys:
                 stats.add_routing(doc.id, key.block_start, "resident", "")
+                lanes_per_shard[key.shard_id] = (
+                    lanes_per_shard.get(key.shard_id, 0) + 1
+                )
+        for shard_id, lanes in lanes_per_shard.items():
+            pool.heat.charge(shard_id, hits=lanes)
         return plan
 
     def _fetch_resident(self, docs, start_nanos, end_nanos):
@@ -279,10 +290,12 @@ class M3Storage:
                     return reader.stream(key.series_id) or b""
 
         if aggs is None:
-            if getattr(self.db, "resident_pool", None) is not None:
+            pool = getattr(self.db, "resident_pool", None)
+            if pool is not None:
                 stats.add(resident_misses=1)
             segments: list[bytes] = []
             bounds: list[int] = []
+            streamed_per_shard: dict[int, int] = {}
             for doc in docs:
                 shard = ns.shard_for(doc.id)
                 for stream, bound in shard.scan_segments(
@@ -290,6 +303,15 @@ class M3Storage:
                 ):
                     segments.append(stream)
                     bounds.append(bound)
+                    streamed_per_shard[shard.id] = (
+                        streamed_per_shard.get(shard.id, 0) + len(stream)
+                    )
+            if pool is not None:
+                # per-shard streamed-fallback bytes: the transfer cost
+                # residency would have removed, attributed to the shard
+                # whose blocks weren't resident (resident/heat.py)
+                for shard_id, nbytes in streamed_per_shard.items():
+                    pool.heat.charge(shard_id, streamed_bytes=nbytes)
             aggs = (
                 streamed_scan_totals(segments, bounds)
                 if segments

@@ -9,6 +9,7 @@ Gorilla), restated as a paged KV-cache-style memory manager for the
 scan-and-aggregate hot path.
 """
 
+from .heat import ShardHeat
 from .pool import (
     AdmitResult,
     ResidentEntry,
@@ -26,6 +27,7 @@ __all__ = [
     "ResidentPool",
     "ResidentPoolError",
     "ResidentScanPlan",
+    "ShardHeat",
     "resident_fetch_arrays",
     "resident_scan_totals",
 ]

@@ -50,6 +50,7 @@ import numpy as np
 
 from ..cache.block_cache import BlockKey
 from ..utils.instrument import DEFAULT as METRICS
+from .heat import ShardHeat
 
 
 class ResidentPoolError(ValueError):
@@ -183,6 +184,11 @@ class ResidentPool:
             "self-scrape pipeline stores these as series, so occupancy/"
             "admission/eviction timelines are one PromQL query",
         )
+        # per-shard residency heat (heat.py): charged by the query
+        # router's resident-vs-streamed decisions, exposed in stats()
+        # and as m3tpu_resident_shard_* counters — the measured signal
+        # ROADMAP item 5's shard rebalance keys off
+        self.heat = ShardHeat(registry=reg)
 
     # ---------- device buffer ----------
 
@@ -208,6 +214,13 @@ class ResidentPool:
         admission lands concurrently)."""
         with self._lock:
             return self._ensure_words() if self.enabled else None
+
+    def device_bytes(self) -> int:
+        """Bytes the page buffer actually holds on device RIGHT NOW —
+        0 until first admission (unlike device_words, this never forces
+        the lazy allocation: memory accounting must observe, not cause)."""
+        with self._lock:
+            return int(self._words.nbytes) if self._words is not None else 0
 
     # ---------- admission ----------
 
@@ -609,6 +622,7 @@ class ResidentPool:
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
                 "upload_bytes": self.upload_bytes,
+                "shard_heat": self.heat.dump(),
             }
 
 

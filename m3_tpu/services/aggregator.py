@@ -67,10 +67,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--debug-port",
         type=int,
         default=-1,
-        help="serve health/metrics RPC ops on this port (0 = ephemeral, "
-        "-1 = disabled); prints DEBUG_LISTENING <host> <port> — the "
-        "aggregator's Prometheus scrape surface (the ingest stream is "
-        "one-way)",
+        help="serve health/metrics/profile RPC ops on this port (0 = "
+        "ephemeral, -1 = disabled); prints DEBUG_LISTENING <host> <port> "
+        "— the aggregator's Prometheus scrape + continuous-profiling "
+        "surface (the ingest stream is one-way)",
+    )
+    p.add_argument(
+        "--profile-hz",
+        type=float,
+        default=None,
+        help="wall-clock stack-sampler rate (m3_tpu/profiling/), served "
+        "on the debug port's `profile` op; default M3_TPU_PROFILE_HZ "
+        "(19), 0 disables",
     )
     return p
 
@@ -178,6 +186,12 @@ def main(argv=None) -> int:
                 component="aggregator",
             ).start()
 
+    # always-on continuous profiler: the aggregator has no storage, so
+    # the device-memory accountant only tracks live jax buffers
+    from ..profiling import start_sampler
+
+    profiler = start_sampler(hz=args.profile_hz, instance=args.instance_id)
+
     stop = threading.Event()
     flush_errors = [0]
 
@@ -209,6 +223,8 @@ def main(argv=None) -> int:
         server.serve_forever()
     finally:
         stop.set()
+        if profiler is not None:
+            profiler.stop()
         if selfmon is not None:
             selfmon.stop()
         agg.flush(time.time_ns() + 10**12)  # drain on shutdown

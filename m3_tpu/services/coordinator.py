@@ -97,6 +97,12 @@ class Coordinator:
         self.selfmon = None  # SelfMonCollector when start_selfmon() ran
         self.ruler = None  # ruler.Ruler when start_ruler() ran
         self._selfmon_ns_ready = False
+        # fleet-profile peer source (m3_tpu/profiling/): a zero-arg
+        # callable yielding {instance_id: node} of `profile`-op-capable
+        # stubs — main() wires the placement + static peers in; None
+        # means /debug/pprof/fleet serves only this process
+        self.peer_source = None
+        self.instance_id = "coordinator0"
 
     def engine_for(self, namespace: str | None) -> Engine:
         if not namespace or namespace == self.namespace:
@@ -179,6 +185,31 @@ class Coordinator:
             self.ruler.publish(groups_to_spec(groups))
         self.ruler.start()
         return self.ruler
+
+    # --- continuous profiling (m3_tpu/profiling/) ---
+
+    def fleet_profile(self, seconds: float = 30.0) -> dict:
+        """One whole-fleet folded-stack profile: this coordinator's own
+        sampler plus every peer's ``profile`` wire op, merged by stack
+        with per-instance counts (/debug/pprof/fleet). Dead peers are
+        reported in ``errors``, never fatal."""
+        from ..profiling import collect_fleet_profile, process_profile
+
+        peers = {}
+        source_error = None
+        if self.peer_source is not None:
+            try:
+                peers = dict(self.peer_source())
+            except Exception as exc:
+                # a broken topology source must not make a local-only
+                # profile look like a healthy single-node fleet
+                source_error = f"{type(exc).__name__}: {exc}"
+        out = collect_fleet_profile(
+            self.instance_id, process_profile(seconds=seconds), peers, seconds
+        )
+        if source_error is not None:
+            out["errors"]["peer_source"] = source_error
+        return out
 
     def _ensure_selfmon_namespace(self) -> None:
         from ..selfmon import RESERVED_NS
@@ -630,6 +661,25 @@ class _Handler(BaseHTTPRequestHandler):
             from ..query.tenants import LEDGER
 
             z.writestr("tenants.json", json.dumps(LEDGER.dump(), indent=1))
+            # incident snapshot: the current folded-stack profile and the
+            # device-memory split ride along, so one dump answers "where
+            # was the time and the memory" next to slow_queries/tenants
+            from ..profiling import collect_device_memory, process_profile
+
+            z.writestr(
+                "profile.json", json.dumps(process_profile(), indent=1)
+            )
+            z.writestr(
+                "device_memory.json",
+                json.dumps(collect_device_memory(c.db), indent=1),
+            )
+            if getattr(c.db, "resident_pool", None) is not None:
+                # per-shard residency heat (resident/heat.py) + pool
+                # stats: the rebalance signal next to the incident data
+                z.writestr(
+                    "resident.json",
+                    json.dumps(c.db.resident_stats(), indent=1),
+                )
             if c.ruler is not None:
                 z.writestr(
                     "ruler.json",
@@ -675,7 +725,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "/health", "/metrics", "/debug/traces",
                     "/debug/slow_queries", "/debug/dump",
                     "/debug/exemplars", "/debug/active_queries",
-                    "/debug/tenants",
+                    "/debug/tenants", "/debug/pprof/profile",
+                    "/debug/pprof/fleet",
                 )
                 else TRACER.span("http.get", path=url.path)
             )
@@ -826,6 +877,40 @@ class _Handler(BaseHTTPRequestHandler):
                         if rows:
                             out[name] = rows
                     self._json({"exemplars": out})
+                elif url.path == "/debug/pprof/profile":
+                    # this process's wall-clock folded-stack profile
+                    # (m3_tpu/profiling/): flamegraph-ready folded text
+                    # by default, the structured table with format=json
+                    from ..profiling import folded_text, process_profile
+
+                    prof = process_profile(
+                        seconds=float(q.get("seconds", ["30"])[0])
+                    )
+                    if q.get("format", ["text"])[0] == "json":
+                        self._json(prof)
+                    else:
+                        self._send(
+                            200,
+                            folded_text(prof["folded"]).encode(),
+                            ctype="text/plain",
+                        )
+                elif url.path == "/debug/pprof/fleet":
+                    # whole-fleet profile: own sampler + every peer's
+                    # `profile` op over the placement, merged by stack
+                    # with per-instance counts
+                    from ..profiling import folded_text
+
+                    prof = c.fleet_profile(
+                        seconds=float(q.get("seconds", ["30"])[0])
+                    )
+                    if q.get("format", ["json"])[0] == "text":
+                        self._send(
+                            200,
+                            folded_text(prof["folded"]).encode(),
+                            ctype="text/plain",
+                        )
+                    else:
+                        self._json(prof)
                 elif url.path == "/debug/dump":
                     self._send(
                         200, self._debug_dump(), ctype="application/zip"
@@ -1108,6 +1193,14 @@ def main(argv=None) -> int:
     )
     p.add_argument("--instance-id", default="coordinator0")
     p.add_argument(
+        "--profile-hz",
+        type=float,
+        default=None,
+        help="wall-clock stack-sampler rate (m3_tpu/profiling/): serves "
+        "/debug/pprof/profile and the whole-fleet /debug/pprof/fleet "
+        "merge; default M3_TPU_PROFILE_HZ (19), 0 disables",
+    )
+    p.add_argument(
         "--ruler-rules",
         default="",
         help="path to a YAML/JSON rule file (recording + alerting "
@@ -1167,25 +1260,38 @@ def main(argv=None) -> int:
         db=db, namespace=namespace, query_limits=limits, kv=kv,
         tenant_limits=tenant_limits,
     )
+    coord.instance_id = args.instance_id
     server, bound = serve(coord, port, host=host)
 
+    # ONE peer source shared by the self-scrape pull and the fleet
+    # profile merge: static --selfmon-peer endpoints plus (in --cluster
+    # mode) every placement dbnode, re-evaluated per use so topology
+    # changes are picked up live
     static_peers = {}
-    if args.selfmon_interval > 0:
+    if args.selfmon_peer:
         from ..net.client import RemoteNode
 
         for ep in args.selfmon_peer:
             static_peers[ep] = RemoteNode.connect(ep)
 
-        def selfmon_peers() -> dict:
-            peers = dict(static_peers)
-            if args.cluster and hasattr(coord.db, "remote_nodes"):
-                peers.update(coord.db.remote_nodes())
-            return peers
+    def fleet_peers() -> dict:
+        peers = dict(static_peers)
+        if args.cluster and hasattr(coord.db, "remote_nodes"):
+            peers.update(coord.db.remote_nodes())
+        return peers
 
+    coord.peer_source = fleet_peers
+    if args.selfmon_interval > 0:
         coord.start_selfmon(
-            args.selfmon_interval, peers=selfmon_peers,
+            args.selfmon_interval, peers=fleet_peers,
             instance=args.instance_id,
         )
+
+    from ..profiling import start_sampler
+
+    profiler = start_sampler(
+        hz=args.profile_hz, instance=args.instance_id, db=coord.db
+    )
 
     if args.ruler_rules:
         coord.start_ruler(
@@ -1231,6 +1337,8 @@ def main(argv=None) -> int:
             detector.stop()
         if msg_server is not None:
             msg_server.stop()
+        if profiler is not None:
+            profiler.stop()
         if coord.selfmon is not None:
             coord.selfmon.stop()
         if coord.ruler is not None:

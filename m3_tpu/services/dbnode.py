@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="retention of the reserved self-monitoring namespace",
     )
     p.add_argument(
+        "--profile-hz",
+        type=float,
+        default=None,
+        help="wall-clock stack-sampler rate (m3_tpu/profiling/): the "
+        "always-on continuous profiler served on the `profile` debug op; "
+        "default M3_TPU_PROFILE_HZ (19), 0 disables",
+    )
+    p.add_argument(
         "--kv-endpoint",
         default="",
         help="host:port of the control-plane KV server; enables dynamic "
@@ -250,6 +258,13 @@ def main(argv=None) -> int:
             component="dbnode",
         ).start()
 
+    # always-on continuous profiler (m3_tpu/profiling/): folded stacks on
+    # the `profile` op, device-memory split gauges refreshed on its
+    # schedule; m3tpu_profile_* health rides the selfmon pipeline above
+    from ..profiling import start_sampler
+
+    profiler = start_sampler(hz=args.profile_hz, instance=args.node_id, db=db)
+
     def wire_control_plane() -> None:
         """Dynamic topology via the networked control plane (server.go:
         embedded etcd + topology watch + KV runtime reconfig)."""
@@ -342,6 +357,8 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        if profiler is not None:
+            profiler.stop()
         if selfmon is not None:
             selfmon.stop()
         if state["hb_stop"] is not None:

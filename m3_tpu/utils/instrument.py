@@ -327,6 +327,19 @@ def _env_sample_rate() -> float:
     return min(max(rate, 0.0), 1.0)
 
 
+def _env_cost_flag() -> bool | None:
+    """M3_TPU_PROFILE_COST: force HLO cost capture on ("1") or off ("0")
+    regardless of the sampling rate; unset (None) defers to 'capture iff
+    the profiler samples' (cost capture pays one extra AOT lower+compile
+    per signature, so it follows the same explicit-opt-in as sampling)."""
+    raw = os.environ.get("M3_TPU_PROFILE_COST", "")
+    if raw == "1":
+        return True
+    if raw == "0":
+        return False
+    return None
+
+
 class KernelProfiler(JitTracker):
     """Device-tier dispatch observability: JitTracker's compile attribution
     plus SAMPLED wall-time profiles of every kernel dispatch.
@@ -349,13 +362,24 @@ class KernelProfiler(JitTracker):
     """
 
     def __init__(self, kernel: str, registry: Registry | None = None,
-                 sample_rate: float | None = None) -> None:
+                 sample_rate: float | None = None,
+                 capture_costs: bool | None = None) -> None:
         super().__init__(kernel, registry=registry)
         reg = registry or DEFAULT
         self.sample_rate = (
             _env_sample_rate() if sample_rate is None
             else min(max(float(sample_rate), 0.0), 1.0)
         )
+        # HLO cost capture (continuous profiling's device tier): on when
+        # the profiler samples, force-on/off via M3_TPU_PROFILE_COST=1/0
+        # — decided ONCE at construction so tests that poke sample_rate
+        # at runtime don't surprise-pay the extra AOT compile
+        if capture_costs is None:
+            env_flag = _env_cost_flag()
+            capture_costs = (
+                env_flag if env_flag is not None else self.sample_rate > 0.0
+            )
+        self.capture_costs = bool(capture_costs)
         labels = {"kernel": kernel}
         self._dispatches = reg.counter(
             "kernel_dispatches_total", "kernel dispatches", labels
@@ -367,7 +391,34 @@ class KernelProfiler(JitTracker):
             labels,
             buckets=KERNEL_BUCKETS,
         )
+        self._g_flops = reg.gauge(
+            "kernel_flops",
+            "XLA cost-analysis FLOPs of this kernel's most recent "
+            "compilation (Compiled.cost_analysis; with dispatch-seconds "
+            "and bytes this turns device time into work done)",
+            labels,
+        )
+        self._g_bytes_accessed = reg.gauge(
+            "kernel_bytes_accessed",
+            "XLA cost-analysis bytes accessed of this kernel's most "
+            "recent compilation",
+            labels,
+        )
+        self._m_cost_captures = reg.counter(
+            "kernel_cost_captures_total",
+            "HLO cost analyses captured (once per compilation signature)",
+            labels,
+        )
+        self._m_cost_errors = reg.counter(
+            "kernel_cost_errors_total",
+            "cost-analysis captures that failed (backend without cost "
+            "analysis, AOT path unavailable) — capture is best-effort "
+            "and never breaks a dispatch",
+            labels,
+        )
         self._n = 0  # dispatch sequence (guarded by JitTracker._lock)
+        self._costs: dict = {}  # compilation key -> {"flops", "bytes_accessed"}
+        self._cost_seen: set = set()
 
     def _next_sampled(self) -> bool:
         rate = self.sample_rate
@@ -380,19 +431,65 @@ class KernelProfiler(JitTracker):
             return True
         return math.floor(n * rate) > math.floor((n - 1) * rate)
 
-    def dispatch(self, key=None) -> "_Dispatch":
-        return _Dispatch(self, key)
+    def dispatch(self, key=None, cost=None) -> "_Dispatch":
+        """``cost``: optional ``(jitted_fn, args, kwargs)`` — when this
+        dispatch turns out to be the first-call compile of ``key`` and
+        cost capture is on, the compiled executable's HLO cost analysis
+        is recorded via :meth:`capture_cost`."""
+        return _Dispatch(self, key, cost)
+
+    def capture_cost(self, key, fn, *args, **kwargs):
+        """Record ``fn``'s compiled HLO cost analysis ONCE per
+        compilation ``key``: ``fn.lower(*args).compile().cost_analysis()``
+        (the jax AOT path — one extra trace+compile per signature, which
+        is why capture follows the profiling opt-in). Tolerant of
+        backends without cost analysis (errors counted, never raised).
+        Returns the ``{"flops", "bytes_accessed"}`` dict or None."""
+        if not self.capture_costs:
+            return None
+        with self._lock:
+            if key in self._cost_seen:
+                return self._costs.get(key)
+            self._cost_seen.add(key)
+        # the AOT lower/compile runs OUTSIDE the lock (M3L001 discipline:
+        # an XLA compile under a lock stalls every concurrent dispatch)
+        try:
+            analysis = fn.lower(*args, **kwargs).compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else {}
+            if analysis is None:
+                analysis = {}
+            cost = {
+                "flops": float(analysis.get("flops", 0.0)),
+                "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
+            }
+        except Exception:
+            self._m_cost_errors.inc()
+            return None
+        with self._lock:
+            self._costs[key] = cost
+        self._g_flops.set(cost["flops"])
+        self._g_bytes_accessed.set(cost["bytes_accessed"])
+        self._m_cost_captures.inc()
+        return cost
+
+    def cost_analysis(self) -> dict:
+        """Captured per-compilation costs, keyed by the dispatch key's
+        string form (the debug-surface shape)."""
+        with self._lock:
+            return {str(k): dict(v) for k, v in self._costs.items()}
 
 
 class _Dispatch:
     """One profiled kernel dispatch; call ``done(result)`` with the device
     output so a sampled dispatch can block on it."""
 
-    __slots__ = ("profiler", "key", "sampled", "result", "_t0")
+    __slots__ = ("profiler", "key", "cost", "sampled", "result", "_t0")
 
-    def __init__(self, profiler: KernelProfiler, key) -> None:
+    def __init__(self, profiler: KernelProfiler, key, cost=None) -> None:
         self.profiler = profiler
         self.key = key
+        self.cost = cost  # (jitted_fn, args, kwargs) for HLO cost capture
         self.sampled = profiler._next_sampled()
         self.result = None
 
@@ -412,6 +509,12 @@ class _Dispatch:
         compiled = False
         if self.key is not None:
             compiled = prof._observe(self.key, time.perf_counter() - self._t0)
+        if compiled and self.cost is not None:
+            # first sighting of this signature = the compile just
+            # happened: capture its HLO cost analysis once (no-op when
+            # cost capture is off)
+            fn, args, kwargs = self.cost
+            prof.capture_cost(self.key, fn, *args, **(kwargs or {}))
         if self.sampled and not compiled:
             if self.result is not None:
                 try:

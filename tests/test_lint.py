@@ -397,6 +397,37 @@ def test_tenant_and_scope_label_keys_quiet():
     assert findings == []
 
 
+def test_shard_label_key_quiet():
+    # per-shard heat attribution (resident/heat.py): "shard" values are
+    # configured shard ids, hard-capped by ShardHeat — allowlisted
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def charge(shard):
+            METRICS.counter(
+                "resident_shard_hits_total", labels={"shard": shard}
+            )
+        """
+    )
+    assert findings == []
+
+
+def test_frame_label_key_fires():
+    # frame/stack discipline (m3_tpu/profiling/): profile stacks are
+    # unbounded runtime strings — they belong in the folded-stack table,
+    # NEVER in metric labels, so "frame" stays off the allowlist
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def record(frame):
+            METRICS.counter("profile_hits_total", labels={"frame": frame})
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+
+
 def test_uncapped_tenant_like_label_key_fires():
     # near-miss keys stay banned: an uncapped identity key ("tenant_id",
     # "user") would be unbounded exposition cardinality

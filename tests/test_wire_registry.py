@@ -104,13 +104,36 @@ def test_raft_kv_dispatch_table_in_sync():
 
 def test_aggregator_debug_server_ops_in_sync(model):
     """The aggregator's --debug-port RPC surface is DebugService behind
-    the middleware: health + traces string-dispatch plus the universal
-    metrics op — all registered idempotent probes."""
+    the middleware: health + traces + profile string-dispatch plus the
+    universal metrics op — all registered idempotent probes."""
     svc = DebugService()
     assert svc.handle({"op": "health"})["ok"] is True
-    for op in ("health", "traces", "metrics"):
+    for op in ("health", "traces", "metrics", "profile"):
         assert op in wire.IDEMPOTENT_OPS
         assert op in model.dispatched
+
+
+def test_profile_op_registered_everywhere(model):
+    """The continuous-profiling wire op (m3_tpu/profiling/): dispatched
+    by the dbnode NodeService AND the DebugService (aggregator debug
+    port), registered idempotent (reading the folded table is
+    duplicate-safe; sampling continues regardless), and never mutating."""
+    assert "profile" in wire.IDEMPOTENT_OPS
+    assert not is_mutating_op("profile")
+    assert "profile" in _op_methods(NodeService)
+    sites = {rel for rel, _ in model.dispatched["profile"]}
+    assert any(rel.endswith("net/server.py") for rel in sites)
+    # a process with no sampler installed answers an explicit empty
+    # profile — the fleet merge must see "nothing here", not an error
+    from m3_tpu import profiling
+
+    installed = profiling.installed()
+    profiling.install(None)
+    try:
+        out = DebugService().handle({"op": "profile", "seconds": 5})
+        assert out["enabled"] is False and out["folded"] == {}
+    finally:
+        profiling.install(installed)
 
 
 def test_client_literal_ops_all_served(model):

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""CI guard for continuous profiling (m3_tpu/profiling/).
+
+Boots a real dbnode (resident pool on, sampler at a test-friendly rate,
+kernel profiler sampling every dispatch) and a real coordinator pulling
+it as a peer, seeds + seals a block of series, drives loadgen write+read
+traffic alongside a scan loop, then asserts the whole profiling contract
+end-to-end:
+
+- the dbnode's ``profile`` op returns a folded-stack profile containing
+  a decode-path frame (the scan/decode work was actually sampled);
+- ``/debug/pprof/profile`` serves folded text on the coordinator and
+  ``/debug/pprof/fleet`` merges BOTH instances into one profile;
+- per-kernel HLO cost (flops / bytes accessed) was captured for at
+  least one profiled kernel (``m3tpu_kernel_cost_captures_total`` > 0
+  with the flops gauge present, OR — on a backend without cost
+  analysis — the error counter explains why);
+- ``m3tpu_device_memory_bytes{kind="resident_pool"}`` is nonzero while
+  the pool is populated;
+- zero profiler errors in either process's exposition, and
+  ``m3tpu_profile_*`` is queryable from ``_m3tpu`` via PromQL.
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_profile.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+NANOS = 1_000_000_000
+N_SERIES = 24
+N_POINTS = 64
+T0 = 1_600_000_000 * NANOS
+STEP = 10 * NANOS
+PROFILE_HZ = "97"  # fast sampling so a short gate still sees hot frames
+SCRAPE_INTERVAL = 0.5
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def _get_json(url: str):
+    return json.loads(_get(url))
+
+
+def _counter_total(exposition: str, name: str, label_filter: str = "") -> float:
+    total = 0.0
+    for line in exposition.splitlines():
+        if line.startswith(name) and (not label_filter or label_filter in line):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.selfmon import RESERVED_NS
+    from m3_tpu.testing.proc_cluster import _spawn_listening
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    base_dir = tempfile.mkdtemp(prefix="m3tpu-check-profile-")
+    dbnode = coordinator = loadgen = node = None
+    profile_env = {
+        "M3_TPU_PROFILE_HZ": PROFILE_HZ,
+        # every kernel dispatch sampled -> dispatch seconds recorded AND
+        # HLO cost capture enabled (the device tier under test)
+        "M3_TPU_PROFILE_SAMPLE_RATE": "1.0",
+    }
+    try:
+        dbnode, dh, dport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.dbnode",
+             "--base-dir", os.path.join(base_dir, "dbnode"),
+             "--namespace", "profile", "--no-mediator",
+             "--resident-bytes", str(64 * 1024 * 1024),
+             "--selfmon-interval", str(SCRAPE_INTERVAL)],
+            "dbnode", env_extra=profile_env,
+        )
+        coordinator, ch, cport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.coordinator",
+             "--base-dir", os.path.join(base_dir, "coord"),
+             "--selfmon-interval", str(SCRAPE_INTERVAL),
+             "--selfmon-peer", f"{dh}:{dport}"],
+            "coordinator", env_extra=profile_env,
+        )
+        base = f"http://{ch}:{cport}"
+        # generous RPC timeout: the first scan pays the decode kernel's
+        # jit compile PLUS (with cost capture on) one AOT lower+compile
+        node = RemoteNode.connect(f"{dh}:{dport}", timeout=180.0)
+
+        # seed + seal a block so the resident pool is populated
+        for i in range(N_SERIES):
+            tags = ((b"__name__", b"profile_gauge"), (b"series", b"%04d" % i))
+            node.write_tagged_batch(
+                "profile",
+                [(tags, T0 + j * STEP, float(i + j), 1) for j in range(N_POINTS)],
+            )
+        node.flush("profile", T0 + 4 * 3600 * NANOS)
+        stats = node.resident_stats()
+        check(stats.get("admissions", 0) >= N_SERIES, "resident pool populated")
+
+        # loadgen write+read traffic in the background (the gate's
+        # "under load" clause) while a scan loop exercises the decode path
+        loadgen = subprocess.Popen(
+            [sys.executable, "-m", "m3_tpu.services.loadgen",
+             "--node", f"{dh}:{dport}", "--namespace", "profile",
+             "--series", "64", "--rate", "300", "--duration", "6",
+             "--workers", "2", "--read-fraction", "0.3"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+        )
+        matchers = [["__name__", "=", "profile_gauge"]]
+        span = (T0, T0 + N_POINTS * STEP)
+        deadline = time.monotonic() + 6
+        scans = 0
+        while time.monotonic() < deadline:
+            out = node.scan_totals("profile", matchers, *span)
+            scans += 1
+        check(scans > 0 and out.get("count") == N_SERIES * N_POINTS,
+              f"scan loop ran under load ({scans} scans)")
+
+        # host tier: the dbnode's profile contains a decode-path frame
+        prof = node.profile(seconds=60)
+        check(prof.get("enabled") and prof.get("samples", 0) > 0,
+              f"dbnode sampler collected samples ({prof.get('samples')})")
+        decode_re = re.compile(r"scan_totals|decode|resident")
+        hot = [s for s in prof.get("folded", {}) if decode_re.search(s)]
+        check(bool(hot), f"dbnode profile contains a decode-path frame "
+              f"({len(prof.get('folded', {}))} stacks)")
+
+        # coordinator pprof surface: folded text + whole-fleet merge
+        text = _get(f"{base}/debug/pprof/profile?seconds=60").decode()
+        check(bool(text.strip()), "/debug/pprof/profile serves folded text")
+        fleet = _get_json(f"{base}/debug/pprof/fleet?seconds=60")
+        insts = set(fleet.get("instances", []))
+        check(len(insts) >= 2 and f"{dh}:{dport}" in insts,
+              f"/debug/pprof/fleet merges both instances ({sorted(insts)})")
+        check(not fleet.get("errors"), f"fleet merge saw no dead peers "
+              f"({fleet.get('errors')})")
+        check(any(decode_re.search(s) for s in fleet.get("folded", {})),
+              "fleet profile carries the dbnode's decode-path stacks")
+
+        # device tier: memory gauges + HLO cost on the dbnode exposition
+        expo = ""
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            expo = node.metrics()
+            if _counter_total(
+                expo, "m3tpu_device_memory_bytes", 'kind="resident_pool"'
+            ) > 0:
+                break
+            time.sleep(0.5)
+        check(
+            _counter_total(
+                expo, "m3tpu_device_memory_bytes", 'kind="resident_pool"'
+            ) > 0,
+            "device-memory gauge nonzero while the pool is populated",
+        )
+        captures = _counter_total(expo, "m3tpu_kernel_cost_captures_total")
+        cost_errors = _counter_total(expo, "m3tpu_kernel_cost_errors_total")
+        check(captures > 0 or cost_errors > 0,
+              f"HLO cost capture ran (captures={captures}, errors={cost_errors})")
+        if captures > 0:
+            check(_counter_total(expo, "m3tpu_kernel_flops") > 0,
+                  "per-kernel flops gauge populated")
+
+        # profiler health: zero errors fleet-wide, self-metrics stored
+        for what, text_expo in (
+            ("dbnode", expo),
+            ("coordinator", _get(f"{base}/metrics").decode()),
+        ):
+            check(
+                _counter_total(text_expo, "m3tpu_profile_errors_total") == 0,
+                f"zero profiler errors on the {what}",
+            )
+        result = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not result:
+            out = _get_json(
+                f"{base}/api/v1/query?query=m3tpu_profile_samples_total"
+                f"&time={time.time()}&namespace={RESERVED_NS}"
+            )
+            result = out.get("data", {}).get("result", [])
+            if not result:
+                time.sleep(0.5)
+        check(bool(result), "m3tpu_profile_* queryable from _m3tpu via PromQL")
+        if loadgen is not None:
+            check(loadgen.wait(timeout=30) == 0, "loadgen completed cleanly")
+            loadgen = None
+    finally:
+        try:
+            if node is not None:
+                node.close()
+        except Exception:
+            # m3lint: disable=M3L007 -- best-effort teardown after the checks already ran
+            pass
+        for proc in (loadgen, dbnode, coordinator):
+            if proc is not None:
+                proc.kill()
+                proc.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    if failures:
+        print(f"\nFAIL: {len(failures)} continuous-profiling violation(s)")
+        return 1
+    print("\ncontinuous-profiling contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

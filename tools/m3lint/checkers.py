@@ -393,8 +393,14 @@ class MetricNameDiscipline(Checker):
     # default 64; the rest collapse into __overflow__, counted loudly) —
     # per-tenant spend is exactly what open item 3's scheduler keys off.
     # "scope": the fixed cost-enforcer chain links (query|tenant|global).
+    # "shard": configured shard ids (bounded by --num-shards), hard-capped
+    # by resident/heat.ShardHeat (M3_TPU_SHARD_HEAT_CAP, overflow
+    # collapsed loudly) — the per-shard heat signal rebalancing keys off.
+    # Deliberately ABSENT: "frame"/"stack" — profile stacks are
+    # unbounded runtime data and live in the profiling table
+    # (m3_tpu/profiling/), never in metric labels.
     LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
-                  "ns", "group", "tenant", "scope"}
+                  "ns", "group", "tenant", "scope", "shard"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
