@@ -607,6 +607,97 @@ def bench_index(n_series, tmpdir="/tmp/m3tpu-index-bench"):
     )
 
 
+def bench_index_device(series_counts, tmpdir="/tmp/m3tpu-index-device-bench"):
+    """Device-vs-host index_resolve sweep (ISSUE 10's flatness claim,
+    measured): for each series count build a namespace index with the
+    device tier on, seal (admitting the segment into HBM), and report
+    p50 resolve latency for a regexp + a conjunction query through the
+    device executor vs the SAME index host-forced — plus matched
+    docs/sec through the device path. ``index_resolve`` staying flat as
+    the series count grows is the success metric; the sweep makes it a
+    number instead of an assertion."""
+    import shutil
+
+    from m3_tpu.index.device import DeviceIndexStore, IndexDeviceOptions
+    from m3_tpu.index.ns_index import NamespaceIndex
+    from m3_tpu.index.query import conj, regexp as regexp_q, term as term_q
+
+    HOUR = 3600 * NANOS
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    queries = [
+        ("regexp", regexp_q(b"name", b"metric_1[0-9]")),
+        ("conj", conj(term_q(b"dc", b"dc1"), regexp_q(b"name", b"metric_.*"))),
+    ]
+    sweep = []
+    last_docs_per_s = 0.0
+    for n_series in series_counts:
+        store = DeviceIndexStore(IndexDeviceOptions(max_bytes=1 << 30))
+        ix = NamespaceIndex(block_size_nanos=HOUR, device_store=store)
+        ix.write_batch(
+            [
+                (
+                    f"s{i}".encode(),
+                    (
+                        (b"dc", b"dc%d" % (i % 4)),
+                        (b"host", b"h%d" % (i % 50021)),
+                        (b"name", b"metric_%d" % (i % 100)),
+                    ),
+                    T0,
+                )
+                for i in range(n_series)
+            ]
+        )
+        ix.seal_before(T0 + 2 * HOUR)
+        assert store.stats()["admissions"] == 1, store.stats()
+        row = {"series": n_series}
+        for qname, q in queries:
+            # ids() materializes doc ids only — the executor's own cost,
+            # not per-doc tag decode
+            def run(force_host, iters=7):
+                lats = []
+                matched = 0
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    r = ix.query(q, T0 - HOUR, T0 + HOUR, force_host=force_host)
+                    matched = len(r.docs.ids())
+                    lats.append(time.perf_counter() - t0)
+                return float(np.median(lats)), matched
+
+            run(False, iters=2)  # device warmup: jit compiles excluded
+            dev_p50, matched = run(False)
+            host_p50, matched_h = run(True)
+            assert matched == matched_h, (qname, matched, matched_h)
+            row[f"{qname}_device_p50_ms"] = round(dev_p50 * 1e3, 3)
+            row[f"{qname}_host_p50_ms"] = round(host_p50 * 1e3, 3)
+            row[f"{qname}_matched"] = matched
+            if qname == "regexp":
+                last_docs_per_s = matched / max(dev_p50, 1e-9)
+                row["matched_docs_per_s"] = round(last_docs_per_s)
+        sweep.append(row)
+        assert store.stats()["errors"] == 0
+    # growth factors across the sweep, normalized to the series growth:
+    # 1.0 = perfectly linear, < host = the device path flattens the curve
+    # (CPU runs are sanity only — the kernels are built for TPU vector
+    # units, where the host python/numpy walk is the one that can't keep
+    # up; see BASELINE.md's platform note)
+    growth = {}
+    if len(sweep) >= 2:
+        s_growth = sweep[-1]["series"] / sweep[0]["series"]
+        for qname, _ in queries:
+            for side in ("device", "host"):
+                k = f"{qname}_{side}_p50_ms"
+                growth[f"{qname}_{side}_growth"] = round(
+                    (sweep[-1][k] / max(sweep[0][k], 1e-9)) / s_growth, 3
+                )
+    return _rec(
+        "index_device_resolve",
+        last_docs_per_s,
+        "matched_docs/s",
+        sweep=sweep,
+        **growth,
+    )
+
+
 def main() -> None:
     import jax
 
@@ -644,6 +735,11 @@ def main() -> None:
         records.append(bench_config5(s5, on_tpu))
     if "index" in want:
         records.append(bench_index(5_000_000 if big else 100_000))
+        records.append(
+            bench_index_device(
+                [65536, 262144, 1048576] if big else [65536, 262144]
+            )
+        )
     if "compression" in want:
         records.append(bench_compression())
     if "tenants" in want:

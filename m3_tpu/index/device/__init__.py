@@ -1,0 +1,23 @@
+"""Device-resident inverted index tier (the reference's m3ninx L2 layer
+— segment/fst term dictionaries + roaring postings — as HBM arrays
+queried by batched kernels).
+
+- kernels.py — batched term binary search, postings-union bitmaps,
+  and the shared fixed-width key ordering definition;
+- segment.py — DeviceSegment: SealedSegment-surface wrapper evaluating
+  whole query ASTs on device, bit-identical to the host executor;
+- store.py — DeviceIndexStore: seal-time admission, one staged upload
+  per segment, LRU eviction under ``--index-device-bytes``.
+"""
+
+from .kernels import bitmap_to_docids
+from .segment import DeviceSegment, classify_regexp
+from .store import DeviceIndexStore, IndexDeviceOptions
+
+__all__ = [
+    "DeviceIndexStore",
+    "DeviceSegment",
+    "IndexDeviceOptions",
+    "bitmap_to_docids",
+    "classify_regexp",
+]

@@ -1,0 +1,248 @@
+"""Batched device kernels for the HBM-resident inverted index.
+
+The reference resolves a query by walking FSTs and merging roaring
+bitmaps term by term (m3ninx segment/fst + postings.List); here the
+same work is three array kernels over a sealed segment's device tier
+(segment.py builds the arrays, store.py owns their budget):
+
+- ``match_terms`` — one lower-bound binary search over the sorted
+  fixed-width term-key matrix for B query terms AT ONCE (the batched
+  FST lookup): per-row [lo, hi) bounds let one launch mix fields.
+- ``bitmap_from_terms`` / ``bitmap_from_term_range`` — union the
+  postings of the selected terms into a packed doc bitmap
+  (uint32[n_docs/32]) via a difference-array + cumsum mask over the
+  flat postings data (O(postings + terms), no ragged gathers).
+- bitwise AND/OR/ANDNOT over those words (plain jnp ops in
+  segment.py) replace the host executor's sorted-array set algebra.
+
+Term ordering contract: a term is keyed as its bytes zero-padded to a
+fixed width and viewed as BIG-endian uint32 words, with the byte
+LENGTH as the tiebreak. (words, length) compares exactly like raw
+bytes: padding only collides when one term is a NUL-extension of the
+other, and the length tiebreak resolves precisely that case the way
+bytes ordering does (shorter first). The host-side mirror of this
+compare (term key building + lower bound, used for literal-prefix
+narrowing) lives here too so both sides share one definition.
+
+jit compilation is keyed on array shapes: per segment the term/postings
+shapes are fixed, and per query the batch axis pads to a power of two
+(``pad_pow2``), so each segment costs a handful of compiles total.
+
+All jax imports are deferred (module import stays light; lint and
+jax-less tools can import the package).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# ---------- host-side key building / compare (shared definition) ----------
+
+
+def key_width_words(max_term_len: int) -> int:
+    """uint32 words per term key covering ``max_term_len`` bytes."""
+    return max(-(-int(max_term_len) // 4), 1)
+
+
+def build_term_keys(terms: list, k_words: int):
+    """(uint32[n, k_words] big-endian-packed keys, int32[n] lengths) for a
+    list of term byte strings, each at most ``4 * k_words`` bytes."""
+    n = len(terms)
+    width = 4 * k_words
+    buf = bytearray(n * width)
+    lens = np.zeros(n, np.int32)
+    for i, t in enumerate(terms):
+        buf[i * width : i * width + len(t)] = t
+        lens[i] = len(t)
+    keys = np.frombuffer(bytes(buf), ">u4").reshape(n, k_words).astype(np.uint32)
+    return keys, lens
+
+
+def build_query_keys(values: list, k_words: int):
+    """Key rows for query-side values. Values LONGER than the segment's
+    key width cannot exist in its dictionary: their row is zeroed and the
+    caller marks it unmatchable (lo == hi) instead of truncating — a
+    truncated compare could false-match."""
+    width = 4 * k_words
+    clipped = [v if len(v) <= width else b"" for v in values]
+    keys, lens = build_term_keys(clipped, k_words)
+    for i, v in enumerate(values):
+        if len(v) > width:
+            lens[i] = -1  # sentinel: caller zeroes the search range
+    return keys, lens
+
+
+def host_key_lt(a_key, a_len: int, b_key, b_len: int) -> bool:
+    """The (words, length) compare, host side — must order exactly like
+    ``bytes(a) < bytes(b)`` (property-tested)."""
+    neq = a_key != b_key
+    if neq.any():
+        i = int(np.argmax(neq))
+        return int(a_key[i]) < int(b_key[i])
+    return a_len < b_len
+
+
+def host_lower_bound(keys, lens, lo: int, hi: int, q_key, q_len: int) -> int:
+    """First index in [lo, hi) whose term is >= the query key — the
+    host mirror of the device search, used for literal-prefix range
+    narrowing (log n iterations of an O(K) compare)."""
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if host_key_lt(keys[mid], int(lens[mid]), q_key, q_len):
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+def pad_pow2(n: int, lo: int = 1) -> int:
+    return max(lo, 1 << max(int(n) - 1, 0).bit_length())
+
+
+def bitmap_to_docids(words: np.ndarray) -> np.ndarray:
+    """Packed uint32 doc bitmap -> ascending int32 doc ids (host side).
+    Bit j of word w is doc ``32*w + j``; on a little-endian host the
+    byte view + little bit order reads exactly that sequence."""
+    words = np.ascontiguousarray(np.asarray(words, np.uint32))
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.int32)
+
+
+# ---------- jitted device kernels (built lazily, cached by shape) ----------
+
+_JITS: dict = {}
+
+
+def _get_jit(name: str, builder):
+    fn = _JITS.get(name)
+    if fn is None:
+        fn = _JITS[name] = builder()
+    return fn
+
+
+def match_terms(keys, lens, lo, hi, q_keys, q_lens):
+    """Batched term lookup: for each query row b, the GLOBAL term index
+    of q_keys[b] within the sorted range [lo[b], hi[b]), or -1.
+
+    ``keys``/``lens`` are the segment's device key matrix; ``lo``/``hi``
+    int32[B] per-row bounds (a conjunction mixing fields resolves in ONE
+    launch); q_lens < 0 marks an unmatchable row (over-width value)."""
+    import jax
+
+    def build():
+        def _fn(keys, lens, lo, hi, q_keys, q_lens):
+            import jax.numpy as jnp
+
+            n = keys.shape[0]
+            n_iter = max(int(n).bit_length(), 1)
+            lo_v = jnp.where(q_lens < 0, 0, lo).astype(jnp.int32)
+            hi_v = jnp.where(q_lens < 0, 0, hi).astype(jnp.int32)
+            hi_orig = hi_v
+
+            def _lt(ak, al, bk, bl):
+                neq = ak != bk
+                any_neq = jnp.any(neq, axis=1)
+                idx = jnp.argmax(neq, axis=1)
+                aw = jnp.take_along_axis(ak, idx[:, None], axis=1)[:, 0]
+                bw = jnp.take_along_axis(bk, idx[:, None], axis=1)[:, 0]
+                return jnp.where(any_neq, aw < bw, al < bl)
+
+            for _ in range(n_iter):
+                active = lo_v < hi_v
+                mid = (lo_v + hi_v) // 2
+                midc = jnp.clip(mid, 0, n - 1)
+                go_right = _lt(keys[midc], lens[midc], q_keys, q_lens)
+                lo_v = jnp.where(active & go_right, mid + 1, lo_v)
+                hi_v = jnp.where(active & ~go_right, mid, hi_v)
+            pos = jnp.clip(lo_v, 0, n - 1)
+            eq = jnp.all(keys[pos] == q_keys, axis=1) & (lens[pos] == q_lens)
+            found = (lo_v < hi_orig) & eq
+            return jnp.where(found, lo_v, -1).astype(jnp.int32)
+
+        return jax.jit(_fn)
+
+    return _get_jit("match", build)(keys, lens, lo, hi, q_keys, q_lens)
+
+
+def bitmap_from_terms(post_idx, post_data, gis, n_words: int):
+    """OR of the postings lists of the selected global term indices
+    (``gis`` int32[B], -1 entries skipped) as a packed uint32[n_words]
+    doc bitmap. Duplicate gis are harmless (difference-array counts)."""
+    import jax
+
+    def build():
+        def _fn(post_idx, post_data, gis, n_words):
+            import jax.numpy as jnp
+
+            valid = (gis >= 0).astype(jnp.int32)
+            gic = jnp.clip(gis, 0, max(post_idx.shape[0] - 1, 0))
+            starts = jnp.where(valid > 0, post_idx[gic, 0], 0)
+            ends = jnp.where(valid > 0, post_idx[gic, 1], 0)
+            return _mask_to_bitmap(post_data, starts, ends, valid, n_words)
+
+        return jax.jit(_fn, static_argnums=(3,))
+
+    if post_idx.shape[0] == 0:
+        return zero_bitmap(n_words)
+    return _get_jit("bm_terms", build)(post_idx, post_data, gis, n_words)
+
+
+def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int):
+    """OR of the postings of every term in the global range [lo, hi) —
+    the whole-field and prefix-matches-everything cases, without
+    shipping an index vector per query."""
+    import jax
+
+    def build():
+        def _fn(post_idx, post_data, lo, hi, n_words):
+            import jax.numpy as jnp
+
+            n = post_idx.shape[0]
+            sel = (jnp.arange(n, dtype=jnp.int32) >= lo) & (
+                jnp.arange(n, dtype=jnp.int32) < hi
+            )
+            valid = sel.astype(jnp.int32)
+            starts = jnp.where(sel, post_idx[:, 0], 0)
+            ends = jnp.where(sel, post_idx[:, 1], 0)
+            return _mask_to_bitmap(post_data, starts, ends, valid, n_words)
+
+        return jax.jit(_fn, static_argnums=(4,))
+
+    if post_idx.shape[0] == 0:
+        return zero_bitmap(n_words)
+    return _get_jit("bm_range", build)(post_idx, post_data, lo, hi, n_words)
+
+
+def _mask_to_bitmap(post_data, starts, ends, valid, n_words: int):
+    """Difference array over flat postings positions -> covered-position
+    mask -> packed doc bitmap (traced helper shared by both builders)."""
+    import jax.numpy as jnp
+
+    total = post_data.shape[0]
+    delta = jnp.zeros(total + 1, jnp.int32)
+    delta = delta.at[starts].add(valid)
+    delta = delta.at[ends].add(-valid)
+    covered = jnp.cumsum(delta)[:total] > 0
+    n_pad = n_words * 32
+    # uncovered positions scatter into a discard slot past the bitmap
+    docs = jnp.where(covered, post_data, n_pad)
+    present = jnp.zeros(n_pad + 1, jnp.uint32).at[docs].set(1)[:n_pad]
+    shifted = present.reshape(n_words, 32) << jnp.arange(32, dtype=jnp.uint32)
+    # each column holds a distinct bit, so the sum IS the bitwise OR
+    return shifted.sum(axis=1, dtype=jnp.uint32)
+
+
+def zero_bitmap(n_words: int):
+    import jax.numpy as jnp
+
+    return jnp.zeros(n_words, jnp.uint32)
+
+
+def all_docs_words(n_docs: int) -> np.ndarray:
+    """Host-built all-docs bitmap with the tail bits past n_docs zeroed
+    (uploaded once per segment; negation ANDs against it so phantom
+    tail docs can never appear)."""
+    n_words = -(-n_docs // 32)
+    bits = np.zeros(n_words * 32, np.uint8)
+    bits[:n_docs] = 1
+    return np.packbits(bits, bitorder="little").view(np.uint32).copy()

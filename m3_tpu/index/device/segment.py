@@ -1,0 +1,409 @@
+"""Device-resident sealed index segment.
+
+``DeviceSegment`` wraps a host sealed segment (SealedSegment or
+DiskSegment) and — while its device tier is resident — answers WHOLE
+query ASTs on device: batched binary-search term match over the packed
+term-key matrix, postings-union bitmaps, and bitwise AND/OR/ANDNOT for
+conjunction/disjunction/negation (the roaring-bitmap algebra of the
+reference's m3ninx executor, as uint32 word kernels). The wrapper also
+implements the full SealedSegment surface by delegation, so every host
+consumer (aggregate queries, segment merge/persist, peer streaming,
+the host executor fallback) runs on it unchanged.
+
+Routing contract (the gating bit-identity property): ``search_ast``
+either returns EXACTLY the doc-id array the host executor would
+produce, or returns None — evicted / not-admitted / device error —
+and the executor transparently re-plans the segment onto the host
+path. General regexps keep their term MATCHING host-side (an
+automaton cannot become a fixed-width compare) after the literal-prefix
+narrow, but their postings union and all surrounding set algebra still
+run on device; the routing reason records ``regexp-host-fallback`` so
+EXPLAIN shows the hybrid.
+
+Regexp classes resolved fully on device:
+- pure literal patterns (a degenerate regexp): batched exact match;
+- ``literal.*`` prefixes: the narrowed dictionary range IS the match;
+- top-level alternations of literals (``a|b|c``): batched exact match
+  of every branch in the same launch.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..query import (
+    AllQuery,
+    ConjunctionQuery,
+    DisjunctionQuery,
+    FieldQuery,
+    NegationQuery,
+    Query,
+    RegexpQuery,
+    TermQuery,
+)
+from ..segment import REGEXP_SPECIALS as _SPECIALS
+from ..segment import literal_prefix, prefix_upper
+from . import kernels
+
+
+class _Unsupported(Exception):
+    """AST node the device evaluator does not model — host fallback."""
+
+
+def classify_regexp(pattern: bytes):
+    """("literal", value) | ("prefix", prefix) | ("alternation",
+    [literals]) | ("general", None) — the classes the device can match
+    without a host automaton walk. Conservative: anything unclear is
+    general."""
+    p = pattern[1:] if pattern.startswith(b"^") else pattern
+    if p.endswith(b"$"):
+        p = p[:-1]
+    if not any(c in p for c in _SPECIALS):
+        return "literal", p
+    if p.endswith(b".*") and not any(c in p[:-2] for c in _SPECIALS):
+        return "prefix", p[:-2]
+    alt = _literal_alternation(p)
+    if alt is not None:
+        return "alternation", alt
+    return "general", None
+
+
+def _literal_alternation(p: bytes):
+    """Branches of a top-level alternation of plain literals (one
+    optional wrapping group allowed), or None."""
+    if p.startswith(b"(") and p.endswith(b")"):
+        inner = p[1:-1]
+        if b"(" not in inner and b")" not in inner:
+            p = inner
+    if b"|" not in p:
+        return None
+    branches = p.split(b"|")
+    for b in branches:
+        if not b or any(c in b for c in _SPECIALS):
+            return None
+    return branches
+
+
+class DeviceArrays:
+    """The device tier of one sealed segment (built by store.admit as
+    ONE staging upload, sliced/cast on device)."""
+
+    __slots__ = (
+        "term_keys", "term_lens", "post_idx", "post_data", "all_words",
+        "fields", "k_words", "n_terms", "n_docs", "n_words", "nbytes",
+        "host_keys", "host_lens", "dot_safe",
+    )
+
+    def __init__(self, term_keys, term_lens, post_idx, post_data, all_words,
+                 fields, k_words, n_docs, n_words, nbytes,
+                 host_keys, host_lens, dot_safe=True) -> None:
+        self.term_keys = term_keys
+        self.term_lens = term_lens
+        self.post_idx = post_idx
+        self.post_data = post_data
+        self.all_words = all_words
+        self.fields = fields  # name -> (global term start, count)
+        self.k_words = k_words
+        self.n_terms = int(term_keys.shape[0])
+        self.n_docs = n_docs
+        self.n_words = n_words
+        self.nbytes = nbytes
+        # host mirror of the key matrix: literal-prefix range narrowing
+        # and general-regexp candidate walks never touch the device
+        self.host_keys = host_keys
+        self.host_lens = host_lens
+        # the `lit.*` fast class treats the narrowed range as the match,
+        # but host `.` does NOT match \n — if any term contains one, the
+        # class must downgrade to the host-matched general path or the
+        # two executors would disagree on exactly that term
+        self.dot_safe = dot_safe
+
+
+class DeviceSegment:
+    """SealedSegment-surface wrapper owning a segment's device tier."""
+
+    def __init__(self, host, store, block_start: int | None = None,
+                 label: str = "") -> None:
+        self.host = host
+        self.store = store
+        self.block_start = block_start
+        self.label = label or f"segment:{id(host):x}"
+        # written by the store under ITS lock; read racily on the query
+        # path (worst case: one extra fallback or one search against a
+        # just-evicted tier, both correct)
+        self._arrays: DeviceArrays | None = None
+        self._state = "pending"
+        self._reserved = 0  # budget bytes the store charged for this tier
+
+    # ---- residency / routing ----
+
+    @property
+    def resident(self) -> bool:
+        return self._arrays is not None
+
+    def status(self) -> str:
+        return self._state
+
+    # ---- SealedSegment surface (host delegation) ----
+
+    @property
+    def docs(self):
+        return self.host.docs
+
+    def __len__(self) -> int:
+        return len(self.host)
+
+    def fields(self):
+        return self.host.fields()
+
+    def terms(self, name: bytes):
+        return self.host.terms(name)
+
+    def postings(self, name: bytes, value: bytes):
+        return self.host.postings(name, value)
+
+    def postings_regexp(self, name: bytes, pattern: bytes):
+        return self.host.postings_regexp(name, pattern)
+
+    _DELEGATED = frozenset(
+        {"doc_ids", "postings_for_terms", "iter_term_postings", "iter_terms",
+         "doc", "path", "version"}
+    )
+
+    def __getattr__(self, name: str):
+        # hasattr-gated optional surface (MatchedDocs probes doc_ids,
+        # the executor probes postings_for_terms): present exactly when
+        # the host has it
+        if name in DeviceSegment._DELEGATED:
+            return getattr(self.host, name)
+        raise AttributeError(name)
+
+    # ---- device AST evaluation ----
+
+    def search_ast(self, query: Query) -> np.ndarray | None:
+        """Doc ids for the whole AST via device bitmaps — bit-identical
+        to the host executor — or None to fall back (evicted / not
+        admitted / unsupported node / device error). Never raises: a
+        device fault must degrade to the host path, not fail the query."""
+        from ...query import stats
+
+        arrays = self._arrays
+        if arrays is None:
+            stats.add(index_device_misses=1)
+            stats.add_routing(self.label, self.block_start, "index-host",
+                              self._state)
+            self.store.count_search(hit=False)
+            return None
+        try:
+            note = {"host_regexp": False}
+            gis, classes = self._match_leaves(arrays, query)
+            bitmap = self._eval(arrays, query, gis, classes, note)
+            words = np.asarray(bitmap)
+        except _Unsupported:
+            stats.add(index_device_misses=1)
+            stats.add_routing(self.label, self.block_start, "index-host",
+                              "unsupported-node")
+            self.store.count_search(hit=False)
+            return None
+        except Exception:
+            # count loudly, never raise: the host path is always correct.
+            # This is ALSO a fallback, so the miss counter covers it —
+            # hits + misses must always sum to total searches
+            self.store.count_error()
+            self.store.count_search(hit=False)
+            stats.add(index_device_misses=1)
+            stats.add_routing(self.label, self.block_start, "index-host",
+                              "device-error")
+            return None
+        self.store.touch(self)
+        self.store.count_search(hit=True)
+        stats.add(index_device_hits=1)
+        stats.add_routing(
+            self.label, self.block_start, "index-device",
+            "regexp-host-fallback" if note["host_regexp"] else "",
+        )
+        return kernels.bitmap_to_docids(words)
+
+    # -- phase 1: batch every exact-match leaf into ONE search launch --
+
+    def _match_leaves(self, arrays: DeviceArrays, query: Query):
+        """(id(leaf) -> int32 global term indices, id(regexp leaf) ->
+        classification) for every term / literal-regexp / alternation
+        leaf, resolved by one batched binary search. Patterns classify
+        ONCE here; phase 2 reads the cached class."""
+        leaves: list[tuple[int, bytes, bytes]] = []  # (slot, field, value)
+        order: list[tuple[Query, int, int]] = []  # (leaf, start_slot, n)
+        classes: dict = {}
+
+        def walk(q: Query) -> None:
+            if isinstance(q, TermQuery):
+                order.append((q, len(leaves), 1))
+                leaves.append((len(leaves), q.field, q.value))
+            elif isinstance(q, RegexpQuery):
+                kind, val = classes[id(q)] = classify_regexp(q.pattern)
+                if kind == "literal":
+                    order.append((q, len(leaves), 1))
+                    leaves.append((len(leaves), q.field, val))
+                elif kind == "alternation":
+                    order.append((q, len(leaves), len(val)))
+                    for branch in val:
+                        leaves.append((len(leaves), q.field, branch))
+            elif isinstance(q, (ConjunctionQuery, DisjunctionQuery)):
+                for s in q.queries:
+                    walk(s)
+            elif isinstance(q, NegationQuery):
+                walk(q.query)
+
+        walk(query)
+        if not leaves:
+            return {}, classes
+        import jax.numpy as jnp
+
+        b = len(leaves)
+        b_pad = kernels.pad_pow2(b)
+        values = [v for _, _, v in leaves] + [b""] * (b_pad - b)
+        q_keys, q_lens = kernels.build_query_keys(values, arrays.k_words)
+        lo = np.zeros(b_pad, np.int32)
+        hi = np.zeros(b_pad, np.int32)
+        for i, (_, field, _v) in enumerate(leaves):
+            start, count = arrays.fields.get(field, (0, 0))
+            lo[i], hi[i] = start, start + count
+        gis = np.asarray(
+            kernels.match_terms(
+                arrays.term_keys, arrays.term_lens,
+                jnp.asarray(lo), jnp.asarray(hi),
+                jnp.asarray(q_keys), jnp.asarray(q_lens),
+            )
+        )
+        out: dict = {}
+        for leaf, start, n in order:
+            out[id(leaf)] = gis[start : start + n]
+        return out, classes
+
+    # -- phase 2: bitmap algebra over the resolved leaves --
+
+    def _eval(self, arrays: DeviceArrays, q: Query, gis: dict,
+              classes: dict, note: dict):
+        import jax.numpy as jnp
+
+        nw = arrays.n_words
+        if isinstance(q, TermQuery):
+            return self._leaf_bitmap(arrays, gis[id(q)])
+        if isinstance(q, RegexpQuery):
+            return self._regexp_bitmap(arrays, q, gis, classes, note)
+        if isinstance(q, FieldQuery):
+            start, count = arrays.fields.get(q.field, (0, 0))
+            return kernels.bitmap_from_term_range(
+                arrays.post_idx, arrays.post_data,
+                jnp.int32(start), jnp.int32(start + count), nw,
+            )
+        if isinstance(q, AllQuery):
+            return arrays.all_words
+        if isinstance(q, ConjunctionQuery):
+            if not q.queries:
+                return kernels.zero_bitmap(nw)
+            pos = [s for s in q.queries if not isinstance(s, NegationQuery)]
+            negs = [s for s in q.queries if isinstance(s, NegationQuery)]
+            if pos:
+                acc = self._eval(arrays, pos[0], gis, classes, note)
+                for s in pos[1:]:
+                    acc = acc & self._eval(arrays, s, gis, classes, note)
+            else:
+                acc = arrays.all_words
+            for s in negs:
+                acc = acc & ~self._eval(arrays, s.query, gis, classes, note)
+            return acc
+        if isinstance(q, DisjunctionQuery):
+            acc = kernels.zero_bitmap(nw)
+            for s in q.queries:
+                acc = acc | self._eval(arrays, s, gis, classes, note)
+            return acc
+        if isinstance(q, NegationQuery):
+            return arrays.all_words & ~self._eval(
+                arrays, q.query, gis, classes, note
+            )
+        raise _Unsupported(type(q).__name__)
+
+    def _leaf_bitmap(self, arrays: DeviceArrays, leaf_gis: np.ndarray):
+        import jax.numpy as jnp
+
+        b_pad = kernels.pad_pow2(len(leaf_gis))
+        padded = np.full(b_pad, -1, np.int32)
+        padded[: len(leaf_gis)] = leaf_gis
+        return kernels.bitmap_from_terms(
+            arrays.post_idx, arrays.post_data, jnp.asarray(padded),
+            arrays.n_words,
+        )
+
+    def _regexp_bitmap(self, arrays: DeviceArrays, q: RegexpQuery,
+                       gis: dict, classes: dict, note: dict):
+        import jax.numpy as jnp
+
+        kind, _val = classes[id(q)]
+        if kind in ("literal", "alternation"):
+            return self._leaf_bitmap(arrays, gis[id(q)])
+        start, count = arrays.fields.get(q.field, (0, 0))
+        if not count:
+            return kernels.zero_bitmap(arrays.n_words)
+        lo, hi = self._prefix_range(arrays, q.pattern, start, count)
+        if kind == "prefix" and not arrays.dot_safe:
+            kind = "general"  # a \n-bearing term breaks range == match
+        if kind == "prefix":
+            # the narrowed range IS the match: every term in it carries
+            # the literal prefix and `.*` accepts any suffix
+            return kernels.bitmap_from_term_range(
+                arrays.post_idx, arrays.post_data,
+                jnp.int32(lo), jnp.int32(hi), arrays.n_words,
+            )
+        # general pattern: the automaton walk stays host-side over the
+        # narrowed candidate slab (reason `regexp-host-fallback` — the
+        # postings union below still runs on device)
+        note["host_regexp"] = True
+        rx = re.compile(b"^(?:" + q.pattern + b")$")
+        matched = [
+            gi for gi in range(lo, hi) if rx.match(self._host_term(arrays, gi))
+        ]
+        return self._leaf_bitmap(arrays, np.asarray(matched, np.int32))
+
+    def _prefix_range(self, arrays: DeviceArrays, pattern: bytes,
+                      start: int, count: int) -> tuple[int, int]:
+        """[lo, hi) global candidate range from the literal prefix —
+        host binary search over the key-matrix mirror (segment.py's
+        prefix-prune, shared compare definition in kernels.py)."""
+        lo, hi = start, start + count
+        pre = literal_prefix(pattern)
+        if not pre:
+            return lo, hi
+        width = 4 * arrays.k_words
+        if len(pre) > width:
+            # every term is <= width bytes: nothing can carry this prefix
+            return start, start
+        pk, pl = kernels.build_term_keys([pre], arrays.k_words)
+        lo = kernels.host_lower_bound(
+            arrays.host_keys, arrays.host_lens, lo, hi, pk[0], int(pl[0])
+        )
+        up = prefix_upper(pre)
+        if up is not None and len(up) <= width:
+            uk, ul = kernels.build_term_keys([up], arrays.k_words)
+            hi = kernels.host_lower_bound(
+                arrays.host_keys, arrays.host_lens, lo, hi, uk[0], int(ul[0])
+            )
+        return lo, hi
+
+    def _host_term(self, arrays: DeviceArrays, gi: int) -> bytes:
+        """Term bytes for a global index, read from the HOST segment
+        (DiskSegment addresses globally; SealedSegment via its per-field
+        sorted list). ``arrays`` is the caller's snapshot — re-reading
+        self._arrays here would race a concurrent eviction into a
+        spurious device-error."""
+        host = self.host
+        term = getattr(host, "_term", None)
+        if term is not None:  # DiskSegment: zero-copy global lookup
+            return term(gi)
+        for name in sorted(arrays.fields):
+            start, count = arrays.fields[name]
+            if start <= gi < start + count:
+                return host.terms(name)[gi - start]
+        raise IndexError(gi)

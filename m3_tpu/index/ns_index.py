@@ -56,18 +56,46 @@ class QueryResult:
 class NamespaceIndex:
     """nsIndex: block-partitioned reverse index."""
 
-    def __init__(self, block_size_nanos: int, retention_nanos: int | None = None) -> None:
+    def __init__(self, block_size_nanos: int, retention_nanos: int | None = None,
+                 device_store=None) -> None:
         self.block_size = block_size_nanos
         self.retention = retention_nanos
         self.blocks: dict[int, IndexBlock] = {}
         # the index has its own lock (storage/index.go insert queue +
         # RWMutex role); hot write/query paths no longer ride the db lock
         self.lock = threading.RLock()
+        # device-resident tier (index/device/): sealed segments admit
+        # into HBM at seal time and queries plan onto batched kernels;
+        # None keeps the index fully host-side
+        self.device_store = device_store
         # computed postings for regexp/field scans over immutable segments
         # (postings_list_cache.go:59)
         from .postings_cache import PostingsListCache
 
         self.postings_cache = PostingsListCache()
+
+    # ---- device-tier admission (index/device/store.py) ----
+
+    def _admit_segment(self, seg, block_start: int):
+        """Wrap + admit one sealed segment into the device store.
+        MUST be called with NO index lock held: admission stages and
+        uploads device arrays (the PR 3 pattern — uploads never stall
+        writers or queries on this index). Returns the wrapper, or the
+        segment unchanged when there is no device tier."""
+        if self.device_store is None or hasattr(seg, "search_ast"):
+            return seg
+        return self.device_store.admit(
+            seg, block_start=block_start, label=f"block:{block_start}"
+        )
+
+    def _drop_segments(self, segments) -> None:
+        """A segment left the index (compacted away, superseded, or
+        expired): release its device tier and its postings-cache
+        entries so neither outlives it."""
+        for seg in segments:
+            if self.device_store is not None:
+                self.device_store.invalidate(seg)
+            self.postings_cache.invalidate_segment(seg)
 
     def _block_for(self, t_nanos: int) -> IndexBlock:
         bs = (t_nanos // self.block_size) * self.block_size
@@ -91,15 +119,21 @@ class NamespaceIndex:
                 blk.dirty = True
 
     def query(
-        self, q: Query, start_nanos: int, end_nanos: int, limit: int | None = None
+        self, q: Query, start_nanos: int, end_nanos: int, limit: int | None = None,
+        force_host: bool = False,
     ) -> QueryResult:
-        """storage/index.go:1182 — union across overlapping blocks, dedupe."""
+        """storage/index.go:1182 — union across overlapping blocks, dedupe.
+        ``force_host`` unwraps device-resident segments so the whole query
+        runs on the host executor — the parity surface the property suite
+        and tools/check_index.py diff the device path against."""
         with self.lock:
             segs = []
             for bs in sorted(self.blocks):
                 if bs + self.block_size <= start_nanos or bs >= end_nanos:
                     continue
                 segs.extend(self.blocks[bs].segments)
+        if force_host:
+            segs = [getattr(s, "host", s) for s in segs]
         docs = execute(segs, q, limit=limit, cache=self.postings_cache)
         exhaustive = limit is None or len(docs) < limit
         return QueryResult(docs=docs, exhaustive=exhaustive)
@@ -133,11 +167,45 @@ class NamespaceIndex:
                 out.setdefault(name, set()).add(value)
         return out
 
-    def seal_before(self, t_nanos: int) -> None:
+    def seal_before(self, t_nanos: int, admit: bool = True) -> None:
+        """Seal eligible blocks' mutable segments, then admit the new
+        immutable segments into the device tier. Admission runs OUTSIDE
+        the index lock (uploads must never stall the hot path); the
+        wrapper swaps in by identity afterwards, so a concurrent persist
+        or eviction that already replaced the segment simply wins.
+        ``admit=False`` skips the device tier — persist_before seals
+        through here and admits the compacted DiskSegment instead (one
+        upload per flush, not two)."""
+        sealed_new: list[tuple[IndexBlock, object]] = []
         with self.lock:
             for bs, blk in list(self.blocks.items()):
                 if bs + self.block_size <= t_nanos:
+                    before = len(blk.sealed)
                     blk.seal()
+                    if len(blk.sealed) > before:
+                        sealed_new.append((blk, blk.sealed[-1]))
+        if self.device_store is None or not admit:
+            return
+        for blk, seg in sealed_new:
+            wrapper = self._admit_segment(seg, blk.block_start)
+            if wrapper is seg:
+                continue
+            with self.lock:
+                replaced = False
+                # the block itself must still be SERVED (retention
+                # expiry pops it from self.blocks without touching its
+                # sealed list) — publishing into an orphaned block would
+                # pin device budget no query can ever reach
+                if self.blocks.get(blk.block_start) is blk:
+                    for i, cur in enumerate(blk.sealed):
+                        if cur is seg:
+                            blk.sealed[i] = wrapper
+                            replaced = True
+                            break
+            if not replaced:
+                # the segment is already gone (persist compaction or
+                # retention raced us): don't leak its device tier
+                self._drop_segments([wrapper])
 
     def evict_before(
         self, t_nanos: int, base: str | None = None, ns_name: str | None = None
@@ -146,9 +214,14 @@ class NamespaceIndex:
         directory is given, also unlink their persisted segment files so
         expired blocks neither survive on disk nor resurrect at bootstrap
         (storage/index.go block expiry + its file cleanup)."""
+        dropped_segments = []
         with self.lock:
             for bs in [b for b in self.blocks if b + self.block_size <= t_nanos]:
-                del self.blocks[bs]
+                blk = self.blocks.pop(bs)
+                dropped_segments.extend(blk.sealed)
+        # expired segments release their device tier and postings-cache
+        # entries immediately (not on eventual LRU churn)
+        self._drop_segments(dropped_segments)
         if base is None or ns_name is None:
             return
         d = self._seg_dir(base, ns_name)
@@ -182,7 +255,7 @@ class NamespaceIndex:
         from .disk_segment import DiskSegment, write_disk_segment
         from .segment import merge_segments
 
-        self.seal_before(t_nanos)
+        self.seal_before(t_nanos, admit=False)
         out = []
         d = self._seg_dir(base, ns_name)
         with self.lock:
@@ -200,9 +273,22 @@ class NamespaceIndex:
                 else merge_segments(blk.sealed)
             )
             write_disk_segment(path, seg)
+            # the persisted zero-copy segment replaces the in-memory
+            # sealed list; its device tier admits OUTSIDE the index lock
+            # (upload staging must not stall writers), then the swap is
+            # bookkeeping-only and the replaced segments drop their
+            # device tiers + postings-cache entries
+            disk = self._admit_segment(DiskSegment(path), bs)
             with self.lock:
-                blk.sealed = [DiskSegment(path)]
-                blk.dirty = False
+                if self.blocks.get(bs) is blk:
+                    replaced = blk.sealed
+                    blk.sealed = [disk]
+                    blk.dirty = False
+                else:
+                    # retention expired the block mid-persist: the new
+                    # segment joins the replaced ones in the drop below
+                    replaced = blk.sealed + [disk]
+            self._drop_segments(replaced)
             legacy = os.path.join(d, f"segments-{bs}.db")
             if os.path.exists(legacy):
                 os.remove(legacy)
@@ -253,7 +339,10 @@ class NamespaceIndex:
                 except (struct.error, ValueError):
                     continue
             blk = self._block_for(bs)
-            blk.sealed = segs
+            # bootstrap re-admission: restored segments go device-resident
+            # like freshly sealed ones (no lock is contended at bootstrap,
+            # and admission takes none of ours)
+            blk.sealed = [self._admit_segment(s, bs) for s in segs]
             blk.dirty = False
             loaded.add(bs)
         return loaded

@@ -82,9 +82,22 @@ def neg(q: Query) -> NegationQuery:
 def search_segment(seg, query: Query, cache=None) -> np.ndarray:
     """Postings for one segment (search/searcher dispatch); sorted unique.
 
+    A device-resident segment (index/device/segment.py DeviceSegment)
+    evaluates the WHOLE AST on device — bitmap algebra instead of the
+    sorted merges below — with bit-identical results; when its tier is
+    evicted / was never admitted, it answers None and the segment falls
+    through to this host path transparently (the wrapper implements the
+    full sealed surface by delegation).
+
     ``cache`` is a PostingsListCache: regexp/field scans over IMMUTABLE
     segments are O(total terms) to compute, so repeated queries serve from
-    the LRU (postings_list_cache.go:59)."""
+    the LRU (postings_list_cache.go:59). The device path skips it — a
+    bitmap recompute is cheaper than uploading a cached array back."""
+    if hasattr(seg, "search_ast"):
+        out = seg.search_ast(query)
+        if out is not None:
+            return out
+        seg = seg.host  # transparent host fallback
     if isinstance(query, TermQuery):
         return np.asarray(seg.postings(query.field, query.value), np.int32)
     if isinstance(query, RegexpQuery):

@@ -56,6 +56,14 @@ def _top_level_alternation(pattern: bytes) -> bool:
     return False
 
 
+# regexp metacharacters — ONE definition shared by the host prefix prune
+# below and the device executor's pattern classification
+# (index/device/segment.py): if the sets diverged, the device literal/
+# prefix classes would silently disagree with the host prune the
+# bit-identity contract depends on
+REGEXP_SPECIALS = b".^$*+?{}[]|()\\"
+
+
 def literal_prefix(pattern: bytes) -> bytes:
     """Longest literal prefix of a regexp — the prune the reference gets
     from intersecting the compiled automaton with the term FST
@@ -69,7 +77,7 @@ def literal_prefix(pattern: bytes) -> bytes:
     i = 0
     while i < len(pattern):
         c = pattern[i : i + 1]
-        if c in b".^$*+?{}[]|()\\":
+        if c in REGEXP_SPECIALS:
             break
         out += c
         i += 1

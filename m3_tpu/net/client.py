@@ -306,7 +306,8 @@ class RemoteNode(RpcClient):
             )
         )
 
-    def query_ids(self, ns, query, start, end, limit=None):
+    def query_ids(self, ns, query, start, end, limit=None, force_host=False):
+        extra = {"force_host": True} if force_host else {}
         return self._call(
             "query_ids",
             ns=ns,
@@ -314,6 +315,7 @@ class RemoteNode(RpcClient):
             start=start,
             end=end,
             limit=limit,
+            **extra,
         )
 
     def aggregate_query(self, ns, query, start, end, field_filter=None):
@@ -348,6 +350,10 @@ class RemoteNode(RpcClient):
     def resident_stats(self) -> dict:
         """HBM-resident compressed pool stats (m3_tpu/resident/)."""
         return self._call("resident_stats")
+
+    def index_stats(self) -> dict:
+        """Device index tier + postings cache stats (m3_tpu/index/)."""
+        return self._call("index_stats")
 
     def flush(self, ns, flush_before) -> list:
         """Seal buffered blocks before the cutoff (operator/CI surface)."""

@@ -322,9 +322,13 @@ class NodeService:
         return wire.series_to_wire(res)
 
     def op_query_ids(self, req):
+        # force_host bypasses the device index tier (read-only knob):
+        # the doc-id parity half of tools/check_index.py diffs a normal
+        # resolve against a host-forced one on the same node
         q = wire.query_from_wire(req["query"])
         result = self.db.query_ids(
-            req["ns"], q, req["start"], req["end"], limit=req.get("limit")
+            req["ns"], q, req["start"], req["end"], limit=req.get("limit"),
+            force_host=bool(req.get("force_host")),
         )
         return {
             "docs": [[d.id, [[k, v] for k, v in d.fields]] for d in result.docs],
@@ -382,6 +386,19 @@ class NodeService:
         upload/streamed byte counters warm-scan zero-transfer checks key
         on, and the per-shard heat split (m3_tpu/resident/)."""
         return self.db.resident_stats()
+
+    def op_index_stats(self, req):
+        """Device-index-tier debug/status (m3_tpu/index/device/):
+        admissions/evictions/search routing counters, device bytes vs
+        budget, per-namespace segment counts, postings-cache
+        effectiveness. Also refreshes the device-memory split gauges so
+        ``m3tpu_device_memory_bytes{kind="index"}`` is current in the
+        next scrape (the profiling sampler refreshes them on its own
+        slower cadence)."""
+        from ..profiling import collect_device_memory
+
+        collect_device_memory(self.db)
+        return self.db.index_stats()
 
     def op_profile(self, req):
         """Continuous-profiling surface (m3_tpu/profiling/): this

@@ -196,7 +196,7 @@ TRACE_KEY = "_trace"
 # half-stitched (server spans with no client parent, or vice versa).
 UNTRACED_OPS = frozenset(
     {"health", "metrics", "traces", "cache_stats", "resident_stats",
-     "owned_shards"}
+     "index_stats", "owned_shards"}
 )
 
 # ops the RPC client may TRANSPARENTLY retry on a transport failure or a
@@ -217,8 +217,8 @@ IDEMPOTENT_OPS = frozenset(
         "stream_series_blocks", "scan_totals", "owned_shards",
         # debug / observability ('profile' reads the process's folded
         # stack table — sampling continues regardless, duplicate-safe)
-        "metrics", "traces", "cache_stats", "resident_stats", "lg_poll",
-        "profile",
+        "metrics", "traces", "cache_stats", "resident_stats", "index_stats",
+        "lg_poll", "profile",
         # operator ops that re-apply to the same state
         "flush", "assign_shards",
         # raft protocol (duplicate-safe by design)

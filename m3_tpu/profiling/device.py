@@ -7,6 +7,8 @@ the storage layers think in:
   (m3_tpu/resident/: the compressed working set);
 - ``decoded_cache`` — the decoded-block cache's arrays
   (m3_tpu/cache/: the byte-budget LRU of decoded lanes);
+- ``index`` — the device-resident inverted index tier
+  (m3_tpu/index/device/: term-key matrices + postings arrays);
 - ``other`` — every other live jax buffer (staging arrays, kernel
   outputs still referenced, query intermediates).
 
@@ -26,11 +28,12 @@ from __future__ import annotations
 
 from ..utils.instrument import DEFAULT as METRICS
 
-KINDS = ("resident_pool", "decoded_cache", "other")
+KINDS = ("resident_pool", "decoded_cache", "index", "other")
 
 _HELP = (
     "live device/process memory by holder: resident_pool = the paged "
     "compressed HBM pool, decoded_cache = decoded-block cache arrays, "
+    "index = device-resident inverted index segments, "
     "other = remaining live jax buffers"
 )
 
@@ -47,9 +50,13 @@ def collect_device_memory(db=None) -> dict:
     process reports what it can."""
     resident = 0
     cache = 0
+    index_bytes = 0
     pool = getattr(db, "resident_pool", None) if db is not None else None
     if pool is not None:
         resident = pool.device_bytes()
+    index_store = getattr(db, "index_device_store", None) if db is not None else None
+    if index_store is not None:
+        index_bytes = index_store.device_bytes()
     block_cache = getattr(db, "block_cache", None) if db is not None else None
     if block_cache is not None:
         try:
@@ -69,17 +76,18 @@ def collect_device_memory(db=None) -> dict:
         if jax is not None:
             total_live = sum(int(a.nbytes) for a in jax.live_arrays())
         else:
-            total_live = resident
+            total_live = resident + index_bytes
     except Exception:
         # partially initialized / backend torn down: report what we can
-        total_live = resident
+        total_live = resident + index_bytes
     # the decoded cache may hold HOST arrays (numpy) on some paths — it
     # is accounted from its own byte budget, not subtracted from the
     # live-buffer total (which only sees device arrays)
-    other = max(total_live - resident, 0)
+    other = max(total_live - resident - index_bytes, 0)
     out = {
         "resident_pool": resident,
         "decoded_cache": cache,
+        "index": index_bytes,
         "other": other,
         "total_live_jax_bytes": total_live,
     }

@@ -73,6 +73,11 @@ class QueryStats:
     # decode-from-HBM path vs streamed fallbacks while the pool was on
     resident_hits: int = 0
     resident_misses: int = 0
+    # device index routing (m3_tpu/index/device/): per-SEGMENT counts —
+    # hits answered by the device executor, misses that fell back to the
+    # host executor (evicted / not admitted / device error)
+    index_device_hits: int = 0
+    index_device_misses: int = 0
     trace_id: str | None = None  # links the record to its /debug/traces tree
     error: str | None = None
     # EXPLAIN support: when record_routing is on (Engine.explain sets it),
@@ -103,6 +108,8 @@ class QueryStats:
             "cacheMisses": self.cache_misses,
             "residentHits": self.resident_hits,
             "residentMisses": self.resident_misses,
+            "indexDeviceHits": self.index_device_hits,
+            "indexDeviceMisses": self.index_device_misses,
             "traceId": self.trace_id,
             "error": self.error,
         }
@@ -206,6 +213,17 @@ def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> No
             "query_resident_misses_total",
             "fetches that fell back to the streamed path with the pool on",
         ).inc(st.resident_misses)
+    if st.index_device_hits:
+        METRICS.counter(
+            "query_index_device_hits_total",
+            "index segments resolved by the device executor",
+        ).inc(st.index_device_hits)
+    if st.index_device_misses:
+        METRICS.counter(
+            "query_index_device_misses_total",
+            "index segments that fell back to the host executor with the "
+            "device tier on",
+        ).inc(st.index_device_misses)
     # per-tenant attribution (query/tenants.py): every completed query
     # charges its scan work — and any cost-limit rejection — against the
     # tenant stamped at start(); decode device-seconds are charged
@@ -235,6 +253,8 @@ def add(
     resident_hits: int = 0,
     resident_misses: int = 0,
     resident_bytes: int = 0,
+    index_device_hits: int = 0,
+    index_device_misses: int = 0,
 ) -> None:
     """Charge scan counters against this thread's active query (no-op
     outside a query, so storage paths call it unconditionally)."""
@@ -249,6 +269,8 @@ def add(
     st.resident_hits += resident_hits
     st.resident_misses += resident_misses
     st.resident_bytes += resident_bytes
+    st.index_device_hits += index_device_hits
+    st.index_device_misses += index_device_misses
 
 
 class _Stage:

@@ -680,6 +680,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "resident.json",
                     json.dumps(c.db.resident_stats(), indent=1),
                 )
+            if hasattr(c.db, "index_stats"):
+                # device index tier + postings cache: segment counts,
+                # device bytes vs budget, eviction/routing counters
+                # (m3_tpu/index/device/)
+                z.writestr(
+                    "index.json",
+                    json.dumps(c.db.index_stats(), indent=1),
+                )
             if c.ruler is not None:
                 z.writestr(
                     "ruler.json",

@@ -54,6 +54,16 @@ def build_parser() -> argparse.ArgumentParser:
         "resident_stats debug op",
     )
     p.add_argument(
+        "--index-device-bytes",
+        type=int,
+        default=0,
+        help="device byte budget for the HBM-resident inverted index "
+        "(0 disables the tier): sealed index segments' term dictionaries "
+        "and postings admit at seal time and term/regexp/set-algebra "
+        "resolution runs as batched kernels (m3_tpu/index/device/); "
+        "stats on the index_stats debug op",
+    )
+    p.add_argument(
         "--selfmon-interval",
         type=float,
         default=0.0,
@@ -145,6 +155,7 @@ def main(argv=None) -> int:
             args.kv_endpoint = self_kv_ep
 
     from ..cache import CacheOptions
+    from ..index.device import IndexDeviceOptions
     from ..resident import ResidentOptions
 
     db = Database(
@@ -155,6 +166,10 @@ def main(argv=None) -> int:
         ),
         resident_options=ResidentOptions(
             enabled=args.resident_bytes > 0, max_bytes=max(args.resident_bytes, 0)
+        ),
+        index_device_options=IndexDeviceOptions(
+            enabled=args.index_device_bytes > 0,
+            max_bytes=max(args.index_device_bytes, 0),
         ),
     )
     opts = NamespaceOptions(

@@ -513,6 +513,7 @@ class Namespace:
         cache: BlockCache | None = None,
         invalidator: CacheInvalidator | None = None,
         pool: ResidentPool | None = None,
+        index_store=None,
     ) -> None:
         self.name = name
         self.opts = opts
@@ -525,7 +526,10 @@ class Namespace:
         if opts.index_enabled:
             from ..index.ns_index import NamespaceIndex
 
-            self.index = NamespaceIndex(opts.block_size_nanos, opts.retention_nanos)
+            self.index = NamespaceIndex(
+                opts.block_size_nanos, opts.retention_nanos,
+                device_store=index_store,
+            )
 
     def shard_for(self, sid: bytes) -> Shard:
         return self.shards[shard_for(sid, self.num_shards)]
@@ -541,6 +545,7 @@ class Database:
         commitlog_enabled: bool = True,
         cache_options: CacheOptions | None = None,
         resident_options: ResidentOptions | None = None,
+        index_device_options=None,
     ) -> None:
         self.base = base_dir
         self.num_shards = num_shards
@@ -564,6 +569,23 @@ class Database:
             if self.resident_options.enabled and self.resident_options.max_bytes > 0
             else None
         )
+        # device-resident inverted index (m3_tpu/index/device/): one
+        # byte budget per node like the pool above; sealed index
+        # segments admit at seal and queries plan onto batched kernels.
+        # Off by default — opt-in via dbnode --index-device-bytes.
+        from ..index.device import IndexDeviceOptions
+
+        self.index_device_options = index_device_options or IndexDeviceOptions(
+            enabled=False
+        )
+        self.index_device_store = None
+        if (
+            self.index_device_options.enabled
+            and self.index_device_options.max_bytes > 0
+        ):
+            from ..index.device import DeviceIndexStore
+
+            self.index_device_store = DeviceIndexStore(self.index_device_options)
         self.cache_invalidator = CacheInvalidator(self.block_cache, self.resident_pool)
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
@@ -604,6 +626,7 @@ class Database:
                 cache=self.block_cache,
                 invalidator=self.cache_invalidator,
                 pool=self.resident_pool,
+                index_store=self.index_device_store,
             )
             self.namespaces[name] = ns
             if self.commitlog_enabled:
@@ -858,12 +881,18 @@ class Database:
                 errs.append(f"{type(exc).__name__}: {exc}")
         return errs
 
-    def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None):
+    def query_ids(self, ns: str, query, start: int, end: int, limit: int | None = None,
+                  force_host: bool = False):
+        """Index resolution (QueryIDs). ``force_host`` bypasses the
+        device index tier — the parity surface check_index and the
+        property suite diff the device executor against."""
         namespace = self.namespaces[ns]
         if namespace.index is None:
             raise RuntimeError(f"namespace {ns} has no index")
         with query_stats.stage("index_resolve"):
-            return namespace.index.query(query, start, end, limit=limit)
+            return namespace.index.query(
+                query, start, end, limit=limit, force_host=force_host
+            )
 
     def aggregate_query(
         self, ns: str, query, start: int, end: int, field_filter=None
@@ -943,6 +972,40 @@ class Database:
             **self.resident_pool.stats(),
             "streamed_bytes": _M_STREAMED_BYTES.value,
         }
+
+    def index_stats(self) -> dict:
+        """Device-index-tier + postings-cache stats for debug/status
+        endpoints (the `index_stats` wire op and /debug/dump's
+        index.json): store budget/occupancy/eviction counters plus
+        per-namespace block/segment counts and cache effectiveness."""
+        out: dict = {
+            "enabled": self.index_device_store is not None,
+            "namespaces": {},
+        }
+        if self.index_device_store is not None:
+            out.update(self.index_device_store.stats())
+        with self.lock:
+            namespaces = list(self.namespaces.items())
+        for name, ns in namespaces:
+            ix = ns.index
+            if ix is None:
+                continue
+            with ix.lock:
+                blocks = list(ix.blocks.values())
+            sealed = sum(len(b.sealed) for b in blocks)
+            device_resident = sum(
+                1
+                for b in blocks
+                for s in b.sealed
+                if getattr(s, "resident", False)
+            )
+            out["namespaces"][name] = {
+                "blocks": len(blocks),
+                "sealed_segments": sealed,
+                "device_resident_segments": device_resident,
+                "postings_cache": ix.postings_cache.stats(),
+            }
+        return out
 
     def stream_shard(self, ns: str, shard_id: int) -> list:
         """Peer streaming (FetchBootstrapBlocksFromPeers / repair source):

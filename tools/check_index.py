@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""CI guard for the device-resident inverted index (m3_tpu/index/device/).
+
+Boots a real dbnode process with ``--index-device-bytes``, seeds a tagged
+corpus while loadgen write traffic runs against the node, seals the index
+block over RPC, then asserts the whole device-index contract end-to-end:
+
+- ``index_stats`` reports segments admitted AT SEAL (not first query) with
+  nonzero device bytes;
+- a regexp query resolves through the device executor
+  (``m3tpu_index_device_search_hits_total`` > 0 in the exposition);
+- doc-id PARITY: the same query re-resolved with ``force_host`` returns
+  the identical id sequence (the bit-identity gate);
+- ``m3tpu_device_memory_bytes{kind="index"}`` is nonzero;
+- zero index errors (``m3tpu_index_device_errors_total`` == 0) and a
+  clean exposition (check_metrics.validate_exposition).
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_index.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+NANOS = 1_000_000_000
+N_SERIES = 256
+N_POINTS = 8
+T0 = 1_600_000_000 * NANOS
+STEP = 10 * NANOS
+
+
+def _metric_total(text: str, name: str, label_filter: str = "") -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and (not label_filter or label_filter in line):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from m3_tpu.index.query import regexp
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.testing.proc_cluster import _spawn_listening
+    from tools.check_metrics import validate_exposition
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    base = tempfile.mkdtemp(prefix="m3tpu-check-index-")
+    proc = node = loadgen = None
+    try:
+        proc, host, port = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.dbnode",
+             "--base-dir", base, "--namespace", "idx", "--no-mediator",
+             "--index-device-bytes", str(64 * 1024 * 1024)],
+            "dbnode",
+        )
+        node = RemoteNode.connect(f"{host}:{port}", timeout=120.0)
+
+        # loadgen write traffic in the background: admission staging must
+        # coexist with a live ingest stream (the satellite's "under
+        # loadgen writes" clause)
+        loadgen = subprocess.Popen(
+            [sys.executable, "-m", "m3_tpu.services.loadgen",
+             "--node", f"{host}:{port}", "--namespace", "idx",
+             "--series", "64", "--rate", "200", "--duration", "8",
+             "--workers", "2"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+        )
+
+        for i in range(N_SERIES):
+            tags = ((b"__name__", b"idx_gauge"), (b"series", b"%04d" % i),
+                    (b"dc", b"dc%d" % (i % 3)), (b"host", b"host-%02d" % (i % 17)))
+            node.write_tagged_batch(
+                "idx",
+                [(tags, T0 + j * STEP, float(i + j), 1) for j in range(N_POINTS)],
+            )
+
+        st = node.index_stats()
+        check(st.get("enabled", False), "device index tier enabled")
+        check(st.get("admissions", 0) == 0, "no admissions before seal")
+
+        node.flush("idx", T0 + 4 * 3600 * NANOS)
+        st = node.index_stats()
+        check(st.get("admissions", 0) >= 1, "segments admitted at seal")
+        check(st.get("bytes", 0) > 0, "device bytes held after seal")
+        ns = st.get("namespaces", {}).get("idx", {})
+        check(ns.get("device_resident_segments", 0) >= 1,
+              "namespace reports device-resident segments")
+
+        # regexp query resolves through the device executor, and the
+        # host-forced resolution of the SAME query returns identical ids
+        q = regexp(b"series", b"00[0-9][0-9]")
+        span = (T0 - NANOS, T0 + 3600 * NANOS)
+        dev = node.query_ids("idx", q, *span)
+        host_forced = node.query_ids("idx", q, *span, force_host=True)
+        dev_ids = [d[0] for d in dev["docs"]]
+        host_ids = [d[0] for d in host_forced["docs"]]
+        check(len(dev_ids) == 100, f"regexp matched ({len(dev_ids)})")
+        check(dev_ids == host_ids, "device/host doc-id parity (bit-identical)")
+
+        # a second, structurally different query through fetch_tagged
+        res = node.fetch_tagged("idx", regexp(b"host", b"host-0.*"), *span)
+        check(len(res) > 0, f"fetch_tagged via device index ({len(res)} series)")
+
+        text = node.metrics()
+        check(_metric_total(text, "m3tpu_index_device_search_hits_total") > 0,
+              "index_device_hits > 0 in exposition")
+        check(_metric_total(text, "m3tpu_index_device_errors_total") == 0,
+              "zero index device errors")
+        check(_metric_total(text, "m3tpu_index_device_admissions_total") >= 1,
+              "admission counter exposed")
+        check(
+            _metric_total(text, "m3tpu_device_memory_bytes", 'kind="index"') > 0,
+            'm3tpu_device_memory_bytes{kind="index"} nonzero',
+        )
+        bad = validate_exposition(text)
+        check(not bad, f"dbnode exposition validates ({len(bad)} bad lines)")
+
+        if loadgen is not None:
+            check(loadgen.wait(timeout=30) == 0, "loadgen completed cleanly")
+            loadgen = None
+
+        # stats must survive the load run with zero errors
+        st = node.index_stats()
+        check(st.get("errors", 0) == 0, "index_stats reports zero errors")
+    finally:
+        if loadgen is not None:
+            loadgen.kill()
+        if node is not None:
+            node.close()
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    if failures:
+        print(f"check_index: {len(failures)} failure(s)")
+        return 1
+    print("check_index: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
