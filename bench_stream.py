@@ -49,7 +49,10 @@ def main() -> None:
     batch_series = int(os.environ.get("BENCH_BATCH", 65536))
     platform = jax.devices()[0].platform
     if platform == "cpu":
-        n_series = min(n_series, 8192)
+        # enough batches that the median interval is a real steady-state
+        # statistic: with only 2, the single drain interval lands in the
+        # pipeline-fill phase and over-reports throughput ~25% (measured)
+        n_series = min(n_series, 32768)
         batch_series = min(batch_series, 4096)
 
     import numpy as np
@@ -86,7 +89,7 @@ def main() -> None:
     # device page gather + decode — the transfer term drops out entirely.
     resident = {}
     try:
-        resident = _resident_side(n_points, platform)
+        resident = _resident_side(n_points, platform, k=k)
     except Exception as exc:  # never cost the streamed line
         import sys
 
@@ -119,29 +122,32 @@ def main() -> None:
     )
 
 
-def _resident_side(n_points: int, platform: str) -> dict:
-    """Warm decode-from-HBM scan over pool-resident synthetic streams."""
-    import time as _time
+def _resident_side(n_points: int, platform: str, k: int = 24) -> dict:
+    """Warm decode-from-HBM scan over pool-resident synthetic streams.
 
-    import numpy as np
+    EQUAL SETTINGS with the streamed line above: same chunk size ``k``,
+    same per-scan series count as one streamed batch, and the SAME packed
+    fused kernel — the side planes paged in at admission let the resident
+    scan assemble PackedLanes by device gather, so the only difference
+    left is assembly-from-HBM vs host-pack + upload. Also reports the
+    zero-transfer contract: warm scans move no block bytes host->device
+    (upload/streamed counters flat across the timed iterations)."""
+    import time as _time
 
     from m3_tpu.cache.block_cache import BlockKey
     from m3_tpu.resident import ResidentOptions, ResidentPool, resident_scan_totals
     from m3_tpu.utils.synthetic import synthetic_streams
 
-    # the whole-stream resident decoder is a T-step scan (no chunk
-    # parallelism yet — ROADMAP open item pages the side tables too), so
-    # CPU runs use a smaller series count than the packed streamed path.
     # Deliberately NOT bench.py's BENCH_RESIDENT_SERIES: sizing one bench
     # must not silently resize the other's recorded metric.
     n_resident = int(
         os.environ.get(
-            "BENCH_STREAM_RESIDENT_SERIES", 65536 if platform == "tpu" else 1024
+            "BENCH_STREAM_RESIDENT_SERIES", 65536 if platform == "tpu" else 4096
         )
     )
     uniq = synthetic_streams(64, n_points, seed=3)
     pool = ResidentPool(
-        ResidentOptions(max_bytes=max(64 << 20, n_resident * 4096 * 2))
+        ResidentOptions(max_bytes=max(64 << 20, n_resident * 4096 * 4))
     )
     bound = n_points + 8
     t0 = 0
@@ -153,6 +159,7 @@ def _resident_side(n_points: int, platform: str) -> dict:
             t0,
             start,  # one synthetic "volume" per admission batch
             [(b"s%07d" % (start + i), uniq[i % len(uniq)], bound) for i in range(n)],
+            chunk_k=k,
         )
     keys = [
         BlockKey("bench", 0, b"s%07d" % i, t0, (i // 4096) * 4096)
@@ -160,16 +167,35 @@ def _resident_side(n_points: int, platform: str) -> dict:
     ]
     warm = resident_scan_totals(pool, keys)  # compile + warm
     total = int(warm.total_count)
-    iters = 5
-    t_start = _time.perf_counter()
+    before = pool.stats()["upload_bytes"]
+    # SAME steady-state methodology as the streamed line: an inflight
+    # window of 2 scans with a hard scalar-fetch drain per result, timed
+    # by drain intervals — dispatch of scan N+1 overlaps compute of scan
+    # N exactly as stream_aggregate pipelines its batches.
+    import collections
+
+    import numpy as _np
+
+    iters = 6
+    inflight: collections.deque = collections.deque()
+    times: list[float] = []
     for _ in range(iters):
-        out = resident_scan_totals(pool, keys)
-    dt = (_time.perf_counter() - t_start) / iters
+        inflight.append(resident_scan_totals(pool, keys, device_out=True))
+        if len(inflight) > 2:
+            _np.asarray(inflight.popleft().total_count)
+            times.append(_time.perf_counter())
+    while inflight:
+        _np.asarray(inflight.popleft().total_count)
+        times.append(_time.perf_counter())
+    diffs = _np.diff(_np.asarray(times))
+    dt = float(_np.median(diffs)) if len(diffs) else float("nan")
     return {
         "dps": round(total / dt, 1),
         "series": n_resident,
         "scan_s": round(dt, 4),
         "pool_occupancy": round(pool.stats()["occupancy"], 6),
+        # zero-transfer contract: warm scans admit/upload nothing
+        "warm_block_bytes_transferred": pool.stats()["upload_bytes"] - before,
     }
 
 

@@ -164,37 +164,54 @@ def match_terms(keys, lens, lo, hi, q_keys, q_lens):
     return _get_jit("match", build)(keys, lens, lo, hi, q_keys, q_lens)
 
 
-def bitmap_from_terms(post_idx, post_data, gis, n_words: int):
+def bitmap_from_terms(post_idx, post_data, gis, n_words: int,
+                      data_start=0, slab: int | None = None):
     """OR of the postings lists of the selected global term indices
     (``gis`` int32[B], -1 entries skipped) as a packed uint32[n_words]
-    doc bitmap. Duplicate gis are harmless (difference-array counts)."""
+    doc bitmap. Duplicate gis are harmless (difference-array counts).
+
+    ``data_start``/``slab``: the FIELD's contiguous postings slice (every
+    leaf matches within one field) — the difference-array/cumsum then
+    runs over O(field postings), not O(total postings). ``slab`` is
+    pow2-rounded by the caller so jit signatures stay bounded; None
+    falls back to the whole-buffer build."""
     import jax
 
     def build():
-        def _fn(post_idx, post_data, gis, n_words):
+        def _fn(post_idx, post_data, gis, data_start, n_words, slab):
             import jax.numpy as jnp
 
             valid = (gis >= 0).astype(jnp.int32)
             gic = jnp.clip(gis, 0, max(post_idx.shape[0] - 1, 0))
             starts = jnp.where(valid > 0, post_idx[gic, 0], 0)
             ends = jnp.where(valid > 0, post_idx[gic, 1], 0)
-            return _mask_to_bitmap(post_data, starts, ends, valid, n_words)
+            return _mask_to_bitmap(
+                post_data, starts, ends, valid, n_words, data_start, slab
+            )
 
-        return jax.jit(_fn, static_argnums=(3,))
+        return jax.jit(_fn, static_argnums=(4, 5))
 
     if post_idx.shape[0] == 0:
         return zero_bitmap(n_words)
-    return _get_jit("bm_terms", build)(post_idx, post_data, gis, n_words)
+    if slab is None:
+        data_start, slab = 0, int(post_data.shape[0])
+    import jax.numpy as jnp
+
+    return _get_jit("bm_terms", build)(
+        post_idx, post_data, gis, jnp.int32(data_start), n_words, slab
+    )
 
 
-def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int):
+def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int,
+                           data_start=0, slab: int | None = None):
     """OR of the postings of every term in the global range [lo, hi) —
     the whole-field and prefix-matches-everything cases, without
-    shipping an index vector per query."""
+    shipping an index vector per query. ``data_start``/``slab`` as in
+    bitmap_from_terms (ranges never cross a field boundary)."""
     import jax
 
     def build():
-        def _fn(post_idx, post_data, lo, hi, n_words):
+        def _fn(post_idx, post_data, lo, hi, data_start, n_words, slab):
             import jax.numpy as jnp
 
             n = post_idx.shape[0]
@@ -204,28 +221,44 @@ def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int):
             valid = sel.astype(jnp.int32)
             starts = jnp.where(sel, post_idx[:, 0], 0)
             ends = jnp.where(sel, post_idx[:, 1], 0)
-            return _mask_to_bitmap(post_data, starts, ends, valid, n_words)
+            return _mask_to_bitmap(
+                post_data, starts, ends, valid, n_words, data_start, slab
+            )
 
-        return jax.jit(_fn, static_argnums=(4,))
+        return jax.jit(_fn, static_argnums=(5, 6))
 
     if post_idx.shape[0] == 0:
         return zero_bitmap(n_words)
-    return _get_jit("bm_range", build)(post_idx, post_data, lo, hi, n_words)
-
-
-def _mask_to_bitmap(post_data, starts, ends, valid, n_words: int):
-    """Difference array over flat postings positions -> covered-position
-    mask -> packed doc bitmap (traced helper shared by both builders)."""
+    if slab is None:
+        data_start, slab = 0, int(post_data.shape[0])
     import jax.numpy as jnp
 
-    total = post_data.shape[0]
-    delta = jnp.zeros(total + 1, jnp.int32)
+    return _get_jit("bm_range", build)(
+        post_idx, post_data, lo, hi, jnp.int32(data_start), n_words, slab
+    )
+
+
+def _mask_to_bitmap(post_data, starts, ends, valid, n_words: int,
+                    data_start, slab: int):
+    """Difference array over the field's postings slice -> covered-
+    position mask -> packed doc bitmap (traced helper shared by both
+    builders). ``starts``/``ends`` are GLOBAL flat offsets; the slice
+    [data_start, data_start + slab) is pulled with a static-size
+    dynamic_slice (the store pads post_data so it never clamps) and the
+    offsets rebase into it — invalid rows rebase to empty [0, 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    sl = jax.lax.dynamic_slice(post_data, (data_start,), (slab,))
+    starts = jnp.clip(starts - data_start, 0, slab)
+    ends = jnp.clip(ends - data_start, 0, slab)
+    delta = jnp.zeros(slab + 1, jnp.int32)
     delta = delta.at[starts].add(valid)
     delta = delta.at[ends].add(-valid)
-    covered = jnp.cumsum(delta)[:total] > 0
+    covered = jnp.cumsum(delta)[:slab] > 0
     n_pad = n_words * 32
     # uncovered positions scatter into a discard slot past the bitmap
-    docs = jnp.where(covered, post_data, n_pad)
+    docs = jnp.where(covered, sl, n_pad)
     present = jnp.zeros(n_pad + 1, jnp.uint32).at[docs].set(1)[:n_pad]
     shifted = present.reshape(n_words, 32) << jnp.arange(32, dtype=jnp.uint32)
     # each column holds a distinct bit, so the sum IS the bitwise OR

@@ -104,7 +104,10 @@ class DeviceArrays:
         self.post_idx = post_idx
         self.post_data = post_data
         self.all_words = all_words
-        self.fields = fields  # name -> (global term start, count)
+        # name -> (global term start, term count, postings data start,
+        # postings data end): the data slice bounds each leaf's bitmap
+        # build to O(field postings) — kernels.bitmap_from_terms
+        self.fields = fields
         self.k_words = k_words
         self.n_terms = int(term_keys.shape[0])
         self.n_docs = n_docs
@@ -268,7 +271,7 @@ class DeviceSegment:
         lo = np.zeros(b_pad, np.int32)
         hi = np.zeros(b_pad, np.int32)
         for i, (_, field, _v) in enumerate(leaves):
-            start, count = arrays.fields.get(field, (0, 0))
+            start, count = arrays.fields.get(field, (0, 0, 0, 0))[:2]
             lo[i], hi[i] = start, start + count
         gis = np.asarray(
             kernels.match_terms(
@@ -290,14 +293,15 @@ class DeviceSegment:
 
         nw = arrays.n_words
         if isinstance(q, TermQuery):
-            return self._leaf_bitmap(arrays, gis[id(q)])
+            return self._leaf_bitmap(arrays, gis[id(q)], q.field)
         if isinstance(q, RegexpQuery):
             return self._regexp_bitmap(arrays, q, gis, classes, note)
         if isinstance(q, FieldQuery):
-            start, count = arrays.fields.get(q.field, (0, 0))
+            start, count, ds, de = arrays.fields.get(q.field, (0, 0, 0, 0))
             return kernels.bitmap_from_term_range(
                 arrays.post_idx, arrays.post_data,
                 jnp.int32(start), jnp.int32(start + count), nw,
+                data_start=ds, slab=kernels.pad_pow2(de - ds),
             )
         if isinstance(q, AllQuery):
             return arrays.all_words
@@ -326,15 +330,17 @@ class DeviceSegment:
             )
         raise _Unsupported(type(q).__name__)
 
-    def _leaf_bitmap(self, arrays: DeviceArrays, leaf_gis: np.ndarray):
+    def _leaf_bitmap(self, arrays: DeviceArrays, leaf_gis: np.ndarray,
+                     field: bytes):
         import jax.numpy as jnp
 
+        _, _, ds, de = arrays.fields.get(field, (0, 0, 0, 0))
         b_pad = kernels.pad_pow2(len(leaf_gis))
         padded = np.full(b_pad, -1, np.int32)
         padded[: len(leaf_gis)] = leaf_gis
         return kernels.bitmap_from_terms(
             arrays.post_idx, arrays.post_data, jnp.asarray(padded),
-            arrays.n_words,
+            arrays.n_words, data_start=ds, slab=kernels.pad_pow2(de - ds),
         )
 
     def _regexp_bitmap(self, arrays: DeviceArrays, q: RegexpQuery,
@@ -343,8 +349,8 @@ class DeviceSegment:
 
         kind, _val = classes[id(q)]
         if kind in ("literal", "alternation"):
-            return self._leaf_bitmap(arrays, gis[id(q)])
-        start, count = arrays.fields.get(q.field, (0, 0))
+            return self._leaf_bitmap(arrays, gis[id(q)], q.field)
+        start, count, ds, de = arrays.fields.get(q.field, (0, 0, 0, 0))
         if not count:
             return kernels.zero_bitmap(arrays.n_words)
         lo, hi = self._prefix_range(arrays, q.pattern, start, count)
@@ -356,6 +362,7 @@ class DeviceSegment:
             return kernels.bitmap_from_term_range(
                 arrays.post_idx, arrays.post_data,
                 jnp.int32(lo), jnp.int32(hi), arrays.n_words,
+                data_start=ds, slab=kernels.pad_pow2(de - ds),
             )
         # general pattern: the automaton walk stays host-side over the
         # narrowed candidate slab (reason `regexp-host-fallback` — the
@@ -365,7 +372,7 @@ class DeviceSegment:
         matched = [
             gi for gi in range(lo, hi) if rx.match(self._host_term(arrays, gi))
         ]
-        return self._leaf_bitmap(arrays, np.asarray(matched, np.int32))
+        return self._leaf_bitmap(arrays, np.asarray(matched, np.int32), q.field)
 
     def _prefix_range(self, arrays: DeviceArrays, pattern: bytes,
                       start: int, count: int) -> tuple[int, int]:
@@ -403,7 +410,7 @@ class DeviceSegment:
         if term is not None:  # DiskSegment: zero-copy global lookup
             return term(gi)
         for name in sorted(arrays.fields):
-            start, count = arrays.fields[name]
+            start, count = arrays.fields[name][:2]
             if start <= gi < start + count:
                 return host.terms(name)[gi - start]
         raise IndexError(gi)

@@ -262,8 +262,10 @@ class DeviceIndexStore:
         max_len = 1
         offset = 0
         dot_safe = True  # no term contains \n (see DeviceArrays.dot_safe)
+        max_slab = 1
         for name in host_seg.fields():
             start = len(terms_all)
+            data_start = offset
             for t, p in _iter_term_postings(host_seg, name):
                 t = bytes(t)
                 if len(t) > self.options.max_term_bytes:
@@ -276,7 +278,14 @@ class DeviceIndexStore:
                 chunks.append(p)
                 idx_rows.append((offset, offset + len(p)))
                 offset += len(p)
-            fields[bytes(name)] = (start, len(terms_all) - start)
+            # per-FIELD postings slice: terms append field by field, so a
+            # field's postings are one contiguous [data_start, offset)
+            # run of post_data — leaf bitmap builds work over THIS slice
+            # (O(field postings)), not the whole buffer
+            fields[bytes(name)] = (
+                start, len(terms_all) - start, data_start, offset
+            )
+            max_slab = max(max_slab, kernels.pad_pow2(offset - data_start))
         if not terms_all:
             return "empty"
         k_words = kernels.key_width_words(max_len)
@@ -290,6 +299,10 @@ class DeviceIndexStore:
             lens.astype(np.uint32),
             post_idx.ravel(),
             post_data,
+            # slack so every field's pow2-rounded slab slice stays in
+            # bounds (lax.dynamic_slice would silently CLAMP the start
+            # otherwise, shifting positions and corrupting the bitmap)
+            np.zeros(max_slab, np.uint32),
         ])
         parts = {
             "fields": fields,

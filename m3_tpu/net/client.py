@@ -351,6 +351,10 @@ class RemoteNode(RpcClient):
         """HBM-resident compressed pool stats (m3_tpu/resident/)."""
         return self._call("resident_stats")
 
+    def resident_clear(self) -> dict:
+        """Drop every resident-pool entry (operator/CI surface)."""
+        return self._call("resident_clear")
+
     def index_stats(self) -> dict:
         """Device index tier + postings cache stats (m3_tpu/index/)."""
         return self._call("index_stats")
@@ -359,11 +363,13 @@ class RemoteNode(RpcClient):
         """Seal buffered blocks before the cutoff (operator/CI surface)."""
         return self._call("flush", ns=ns, flush_before=flush_before)
 
-    def scan_totals(self, ns, matchers, start, end) -> dict:
+    def scan_totals(self, ns, matchers, start, end, explain: bool = False) -> dict:
         """Raw-sample scan-and-aggregate; ``matchers``:
-        [[name, op, value], ...] (see NodeService.op_scan_totals)."""
+        [[name, op, value], ...] (see NodeService.op_scan_totals).
+        ``explain`` adds the per-(series, block) routing record."""
         return self._call(
-            "scan_totals", ns=ns, matchers=list(matchers), start=start, end=end
+            "scan_totals", ns=ns, matchers=list(matchers), start=start,
+            end=end, explain=explain,
         )
 
     def metrics(self) -> str:

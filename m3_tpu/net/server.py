@@ -387,6 +387,13 @@ class NodeService:
         on, and the per-shard heat split (m3_tpu/resident/)."""
         return self.db.resident_stats()
 
+    def op_resident_clear(self, req):
+        """Drop every resident-pool entry (operator/debug surface):
+        lets tools/check_resident.py exercise eviction churn and the
+        read-through re-admission path against a live node. Duplicate-
+        safe — clearing an empty pool clears nothing."""
+        return {"dropped": self.db.resident_clear()}
+
     def op_index_stats(self, req):
         """Device-index-tier debug/status (m3_tpu/index/device/):
         admissions/evictions/search routing counters, device bytes vs
@@ -420,7 +427,13 @@ class NodeService:
         """Raw-sample scan-and-aggregate over matched series (block
         granularity): routed to the decode-from-HBM path when every
         matched block is resident, streamed otherwise — the wire face of
-        M3Storage.scan_totals. ``matchers``: [[name, op, value], ...]."""
+        M3Storage.scan_totals. ``matchers``: [[name, op, value], ...].
+        ``explain``: also record and return the per-(series, block)
+        routing decisions (query/stats.py add_routing) so CI can assert
+        WHICH decoder served the scan, not just the path."""
+        import time as _time
+
+        from ..query import stats
         from ..query.m3_storage import M3Storage
         from ..query.promql import Matcher
 
@@ -428,7 +441,21 @@ class NodeService:
             Matcher(str(n), str(op), str(v)) for n, op, v in req["matchers"]
         ]
         storage = M3Storage(self.db, req["ns"])
-        return storage.scan_totals(matchers, req["start"], req["end"])
+        if not req.get("explain"):
+            return storage.scan_totals(matchers, req["start"], req["end"])
+        st = stats.start("EXPLAIN scan_totals")
+        if st is not None:
+            st.record_routing = True
+            st.namespace = str(req["ns"])
+        t0 = _time.perf_counter()
+        try:
+            out = storage.scan_totals(matchers, req["start"], req["end"])
+        finally:
+            if st is not None:
+                stats.finish(st, _time.perf_counter() - t0)
+        if st is not None:
+            out["routing"] = list(st.routing)
+        return out
 
     def op_owned_shards(self, req):
         return sorted(self.assigned_shards)

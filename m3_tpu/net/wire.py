@@ -220,7 +220,7 @@ IDEMPOTENT_OPS = frozenset(
         "metrics", "traces", "cache_stats", "resident_stats", "index_stats",
         "lg_poll", "profile",
         # operator ops that re-apply to the same state
-        "flush", "assign_shards",
+        "flush", "assign_shards", "resident_clear",
         # raft protocol (duplicate-safe by design)
         "raft_vote", "raft_append", "raft_snapshot", "raft_status",
         # KV reads (mutations ride RemoteKVStore's own failover contract);

@@ -215,6 +215,16 @@ def snapshot_stream(
     return per
 
 
+def window_words(max_span_bits: int, min_window_words: int = 0) -> int:
+    """Window width (uint32 words) covering the widest chunk span plus 4
+    lookahead words and up to 31 bits of alignment slack. ONE shared
+    definition: the streamed assembler below and the resident pool's
+    device-side assembly (m3_tpu/resident/) must agree on cw or their
+    window arrays — and therefore their f32 reduction trees — diverge."""
+    cw = (31 + max_span_bits + 31) // 32 + 4
+    return max(cw, min_window_words, 6)
+
+
 def assemble_chunked(
     streams: list[bytes], snaps: list[list[dict]], k: int, min_window_words: int = 0
 ) -> ChunkedBatch:
@@ -223,11 +233,8 @@ def assemble_chunked(
     c = max((len(p) for p in snaps), default=1)
     c = max(c, 1)
     n = s * c
-    # window size: cover max span + 4 lookahead words + up to 31 bits of
-    # alignment slack
     max_span = max((p["span"] for per in snaps for p in per), default=0)
-    cw = (31 + max_span + 31) // 32 + 4
-    cw = max(cw, min_window_words, 6)
+    cw = window_words(max_span, min_window_words)
 
     windows = np.zeros((n, cw), np.uint32)
     rel = np.zeros(n, np.int32)

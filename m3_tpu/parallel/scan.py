@@ -438,85 +438,260 @@ def sharded_scan_aggregate(
 
 
 # ---------------------------------------------------------------------------
-# Decode-from-HBM: scan over the paged resident pool (m3_tpu/resident/)
+# Decode-from-HBM, chunk-parallel: lane assembly by device gather over the
+# resident pool's page buffer + side planes (m3_tpu/resident/pool.py)
 # ---------------------------------------------------------------------------
 #
-# The residency variant of the scan path: sealed blocks' compressed words
-# already live in device memory as fixed-size pages, so a scan gathers each
-# lane's page ROWS on device (a contiguous-row gather, not a scalar one) and
-# feeds the same decode kernel — zero block bytes cross PCIe, and series
-# selection is the page-row gather instead of a host select/pack.
+# The whole-stream resident scan below decodes with a T-step lax.scan and
+# measured 0.17x the chunked kernel even on CPU (PROFILE.md). Here the
+# per-chunk side tables are ALREADY device-resident (paged in at
+# admission), so a scan assembles the ChunkedBatch/PackedLanes lane view —
+# windows, rel_pos/num_bits, decoder-state carries, classification flags —
+# by pure device gathers from O(series)-sized host int vectors and
+# dispatches the same chunked/packed kernels the streamed path uses.
 
-_JIT_RESIDENT = KernelProfiler("resident_gather_decode")
+RESIDENT_CHUNKED_PROF = KernelProfiler("resident_chunked_assemble")
 
 
-def gather_lane_words(pool_words, page_rows):
-    """Device gather: pool u32[P, W] + page rows i32[S, L] -> words
-    u32[S, L*W]. Lane slots past a stream's span point at the reserved
-    zero page, so the result is bit-identical to a zero-padded
-    BatchedSegments word matrix."""
+def _resident_gather(pool_words, side_words, page_rows, side_rows,
+                     n_chunks, total_bits, si, ci, cw: int, w: int, spc: int):
+    """Shared gather core for both lane layouts: (si, ci) lane->chunk
+    coordinate vectors -> (col, side [N, P], windows [N, CW], rel, nbits,
+    valid). Every array is built to be BIT-IDENTICAL to what
+    ops/chunked.assemble_chunked produces for the same streams (windows
+    zeroed on invalid lanes, zero side rows for padding) so the shared
+    decode programs yield bit-identical results."""
+    from ..resident.pool import SIDE_PLANES
+
+    col = {name: i for i, name in enumerate(SIDE_PLANES)}
+    page_rows = jnp.asarray(page_rows, jnp.int32)
+    side_rows = jnp.asarray(side_rows, jnp.int32)
+    lp = page_rows.shape[1]
+    sl = side_rows.shape[1]
+    valid = ci < jnp.asarray(n_chunks, jnp.int32)[si]
+    # side slot: page-granular indirection (chunk ci sits at slot ci%spc
+    # of side page ci//spc); invalid lanes hit reserved zero page 0
+    sp = jnp.take(side_rows.reshape(-1), si * sl + jnp.where(valid, ci, 0) // spc)
+    slot = jnp.where(valid, sp * spc + ci % spc, 0)
+    side = jnp.take(
+        jnp.asarray(side_words, jnp.uint32).reshape(-1, len(SIDE_PLANES)),
+        slot, axis=0,
+    )  # [N, N_SIDE_PLANES]
+    off = side[:, col["off"]].astype(jnp.int32)
+    w0 = off >> 5
+    rel = off & 31
+    tb = jnp.asarray(total_bits, jnp.int32)[si]
+    nbits = jnp.where(valid, jnp.clip(tb - (w0 << 5), 0, cw * 32), 0)
+    # windows: two gathers — word position -> page (tiny int table), then
+    # page*W + word%W into the flat pool. Trailing zero-page columns in
+    # page_rows guarantee w0 + cw - 1 stays in range and reads zeros.
+    j = jnp.arange(cw, dtype=jnp.int32)[None, :]
+    wabs = w0[:, None] + j  # [N, CW] absolute word index within the lane
+    page = jnp.take(page_rows.reshape(-1), si[:, None] * lp + wabs // w)
+    words = jnp.take(
+        jnp.asarray(pool_words, jnp.uint32).reshape(-1), page * w + wabs % w
+    )
+    windows = jnp.where(valid[:, None], words, jnp.uint32(0))
+    return col, side, windows, rel, nbits, valid
+
+
+def _assemble_resident_lanes_traced(pool_words, side_words, page_rows,
+                                    side_rows, n_chunks, total_bits,
+                                    c: int, cw: int, w: int, spc: int) -> dict:
+    """Traced body: resident plan arrays -> decode_chunked_lanes kwargs
+    (series-major lane order, ChunkedBatch layout)."""
     s = page_rows.shape[0]
-    rows = jnp.asarray(page_rows, jnp.int32)
-    return jnp.take(jnp.asarray(pool_words, jnp.uint32), rows, axis=0).reshape(s, -1)
+    n = s * c
+    lane = jnp.arange(n, dtype=jnp.int32)
+    si = lane // c
+    ci = lane % c
+    col, side, windows, rel, nbits, valid = _resident_gather(
+        pool_words, side_words, page_rows, side_rows, n_chunks, total_bits,
+        si, ci, cw, w, spc,
+    )
+    pair = lambda name: (side[:, col[name + "_hi"]], side[:, col[name + "_lo"]])
+    return dict(
+        windows=windows,
+        rel_pos=rel,
+        num_bits=nbits,
+        first=valid & (ci == 0),
+        prev_time=pair("prev_time"),
+        prev_delta=pair("prev_delta"),
+        prev_float_bits=pair("prev_float_bits"),
+        prev_xor=pair("prev_xor"),
+        int_val=pair("int_val"),
+        time_unit=side[:, col["time_unit"]].astype(jnp.int32),
+        sig=side[:, col["sig"]].astype(jnp.int32),
+        mult=side[:, col["mult"]].astype(jnp.int32),
+        is_float=side[:, col["is_float"]] != 0,
+    )
 
 
-def resident_scan_aggregate(
-    pool_words, page_rows, num_bits, initial_unit, max_points: int, with_psum=False
-) -> ScanAggregates:
-    """Single-device decode-from-HBM scan + aggregate. ``series_err``
-    carries the device decoder's bail flags (annotated streams etc.) so
-    callers stitch those lanes through the host codec
-    (stitch_host_errors) instead of silently under-counting them."""
-    words = gather_lane_words(pool_words, page_rows)
-    if _is_tracing(words):
-        res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
-    else:
-        # cost= covers the decode only — the page gather above already
-        # ran eagerly, so its flops aren't in this kernel's analysis
-        with _JIT_RESIDENT.dispatch(
-            (tuple(words.shape), int(max_points)),
-            cost=(decode_batched, (words, num_bits, initial_unit),
-                  {"max_points": max_points}),
-        ) as d:
-            res = d.done(decode_batched(
-                words, num_bits, initial_unit, max_points=max_points
-            ))
-    aggs = _aggregate_decoded(res.values_f32, res.valid, with_psum)
-    return aggs._replace(series_err=res.err)
+_assemble_resident_lanes_jit = jax.jit(
+    _assemble_resident_lanes_traced, static_argnames=("c", "cw", "w", "spc")
+)
 
 
-def scan_aggregate_with_err(
-    words, num_bits, initial_unit, max_points: int
-) -> ScanAggregates:
-    """scan_aggregate plus the per-series device decode-error flags —
-    the streamed twin of resident_scan_aggregate's err surface."""
-    if _is_tracing(words):
-        res = decode_batched(words, num_bits, initial_unit, max_points=max_points)
-    else:
-        with _JIT_DECODE.dispatch(
-            (tuple(words.shape), int(max_points)),
-            cost=(decode_batched, (words, num_bits, initial_unit),
-                  {"max_points": max_points}),
-        ) as d:
-            res = d.done(decode_batched(
-                words, num_bits, initial_unit, max_points=max_points
-            ))
-    aggs = _aggregate_decoded(res.values_f32, res.valid, False)
-    return aggs._replace(series_err=res.err)
+def assemble_resident_lanes(plan, s_pad: int | None = None) -> tuple[dict, int]:
+    """Eager entry: a ResidentChunkedPlan -> (decode_chunked_lanes lane
+    kwargs on device, padded series count). ``s_pad`` pads the series
+    axis with empty lanes (page row 0 / side page 0 -> zero windows,
+    nbits 0) exactly like the streamed path's b"" padding streams."""
+    s = plan.page_rows.shape[0]
+    s_pad = s if s_pad is None else max(s_pad, s)
+    page_rows, side_rows, n_chunks, total_bits = pad_chunked_plan(plan, s_pad)
+    key = (s_pad, plan.num_chunks, plan.window_words)
+    with RESIDENT_CHUNKED_PROF.dispatch(key) as d:
+        lane_args = d.done(_assemble_resident_lanes_jit(
+            plan.words, plan.side, page_rows, side_rows, n_chunks, total_bits,
+            c=plan.num_chunks, cw=plan.window_words, w=plan.page_words,
+            spc=plan.side_page_chunks,
+        ))
+    return lane_args, s_pad
 
 
-def make_sharded_resident_scan(mesh, max_points: int):
-    """Sharded decode-from-HBM scan: page rows + lane metadata shard over
-    the mesh's series axis while the page pool rides replicated (each
-    device of a real mesh holds its placement's pages; on the forced CPU
-    test mesh replication is free). The cross-series psum reduction is the
-    existing one — only the word source changed."""
+def _assemble_resident_packed_traced(pool_words, side_words, page_rows,
+                                     side_rows, n_chunks, total_bits,
+                                     c: int, cw: int, w: int, spc: int,
+                                     rows: int):
+    """Traced body: resident plan arrays -> the packed kernel's layout
+    (ops/fused.pack_lane_inputs, chunk-major "c" order): windows4
+    u32[tiles, CW, R, 128], lanes4 u32[tiles, NLANE, R, 128], tile_flags
+    i32[tiles]. Mirrors the host packer EXACTLY — chunk-major lane j maps
+    to (series j%S, chunk j//S), tile-padding lanes are zero/wildcard-fast,
+    first chunks are never fast — so on the same streams both packings are
+    bit-identical and the kernel's specialization decisions agree."""
+    from ..ops.fused import NLANE, PACKED_LANE_PLANES
+
+    s = page_rows.shape[0]
+    n = s * c
+    tile_lanes = rows * 128
+    tiles = -(-n // tile_lanes)
+    npad = tiles * tile_lanes
+    j = jnp.arange(npad, dtype=jnp.int32)
+    inb = j < n
+    si = jnp.where(inb, j % s, 0)
+    ci = jnp.where(inb, j // s, c)  # padding lanes: ci==c is never valid
+    col, side, windows, rel, nbits, valid = _resident_gather(
+        pool_words, side_words, page_rows, side_rows, n_chunks, total_bits,
+        si, ci, cw, w, spc,
+    )
+    first = valid & (ci == 0)
+
+    def u32_plane(name):
+        if name == "rel_pos":
+            return rel.astype(jnp.uint32)
+        if name == "num_bits":
+            return nbits.astype(jnp.uint32)
+        if name == "first":
+            return first.astype(jnp.uint32)
+        return side[:, col[name]]  # stored as uint32 already
+
+    lanes4 = jnp.stack([u32_plane(name) for name in PACKED_LANE_PLANES])
+    lanes4 = lanes4.reshape(NLANE, tiles, rows, 128).transpose(1, 0, 2, 3)
+    windows4 = windows.reshape(tiles, rows, 128, cw).transpose(0, 3, 1, 2)
+    # tile class from the v2 fast-chunk flags byte (side plane "flags"):
+    # 1 = every lane int-fast, 2 = every lane float-fast, 0 = general.
+    # First chunks decode the stream head the fast bodies don't implement;
+    # invalid/padding lanes are wildcard-fast — both exactly as the host
+    # packer classifies.
+    flags = side[:, col["flags"]]
+    fast_i = jnp.where(valid, ((flags & 1) != 0) & (ci != 0), True)
+    fast_f = jnp.where(valid, ((flags & 2) != 0) & (ci != 0), True)
+    int_tiles = jnp.all(fast_i.reshape(tiles, tile_lanes), axis=1)
+    flt_tiles = jnp.all(fast_f.reshape(tiles, tile_lanes), axis=1)
+    tile_flags = jnp.where(int_tiles, 1, jnp.where(flt_tiles, 2, 0)).astype(jnp.int32)
+    return windows4, lanes4, tile_flags
+
+
+_assemble_resident_packed_jit = jax.jit(
+    _assemble_resident_packed_traced,
+    static_argnames=("c", "cw", "w", "spc", "rows"),
+)
+
+
+def assemble_resident_packed(plan, s_pad: int | None = None):
+    """Eager entry: a ResidentChunkedPlan -> ((windows4, lanes4,
+    tile_flags) on device, padded series count). The packed twin of
+    assemble_resident_lanes — feeds chunked_scan_aggregate_packed, the
+    same flagship kernel the streamed pipeline (parallel/stream.py)
+    dispatches."""
+    from ..ops.fused import ROWS_DEFAULT
+
+    s = plan.page_rows.shape[0]
+    s_pad = s if s_pad is None else max(s_pad, s)
+    page_rows, side_rows, n_chunks, total_bits = pad_chunked_plan(plan, s_pad)
+    key = ("packed", s_pad, plan.num_chunks, plan.window_words)
+    with RESIDENT_CHUNKED_PROF.dispatch(key) as d:
+        packed = d.done(_assemble_resident_packed_jit(
+            plan.words, plan.side, page_rows, side_rows, n_chunks, total_bits,
+            c=plan.num_chunks, cw=plan.window_words, w=plan.page_words,
+            spc=plan.side_page_chunks, rows=ROWS_DEFAULT,
+        ))
+    return packed, s_pad
+
+
+def pad_chunked_plan(plan, s_pad: int):
+    """Zero-pad a ResidentChunkedPlan's host vectors to ``s_pad`` series."""
+    import numpy as _np
+
+    s = plan.page_rows.shape[0]
+    if s_pad == s:
+        return plan.page_rows, plan.side_rows, plan.n_chunks, plan.total_bits
+    pr = _np.zeros((s_pad, plan.page_rows.shape[1]), _np.int32)
+    pr[:s] = plan.page_rows
+    sr = _np.zeros((s_pad, plan.side_rows.shape[1]), _np.int32)
+    sr[:s] = plan.side_rows
+    nc = _np.zeros(s_pad, _np.int32)
+    nc[:s] = plan.n_chunks
+    tb = _np.zeros(s_pad, _np.int32)
+    tb[:s] = plan.total_bits
+    return pr, sr, nc, tb
+
+
+def resident_chunked_local_fn(c: int, k: int, cw: int, w: int, spc: int,
+                              with_psum: bool = False):
+    """The assemble-from-residency + packed-decode body: device gathers
+    over the pool + side planes build the PackedLanes view, fused with
+    the flagship packed kernel. ONE definition shared by the
+    single-device resident scan (resident/scan._packed_scan_fn) and the
+    shard_map local of make_sharded_resident_chunked_scan — the two
+    dispatch paths must never diverge on assembly semantics."""
+
+    from ..ops.fused import ROWS_DEFAULT
+
+    interpret = jax.default_backend() != "tpu"
+
+    def local(pool_words, side_words, page_rows, side_rows, n_chunks, total_bits):
+        windows4, lanes4, tile_flags = _assemble_resident_packed_traced(
+            pool_words, side_words, page_rows, side_rows, n_chunks,
+            total_bits, c=c, cw=cw, w=w, spc=spc, rows=ROWS_DEFAULT,
+        )
+        s_local = page_rows.shape[0]
+        return chunked_scan_aggregate_packed(
+            windows4, lanes4, tile_flags, n=s_local * c, s=s_local, c=c,
+            k=k, with_psum=with_psum, interpret=interpret,
+        )
+
+    return local
+
+
+def make_sharded_resident_chunked_scan(mesh, c: int, k: int, cw: int, w: int,
+                                       spc: int):
+    """Sharded decode-from-HBM CHUNKED scan: the page pool + side planes
+    ride replicated (each device of a real mesh holds its placement's
+    pages; on the forced CPU test mesh replication is free) while the
+    per-series plan vectors shard over the mesh's series axis. Lane
+    assembly AND decode run inside the shard_map, psum reduction
+    unchanged."""
+
+    local = resident_chunked_local_fn(c, k, cw, w, spc, with_psum=True)
+
     fn = shard_map(
-        functools.partial(
-            resident_scan_aggregate, max_points=max_points, with_psum=True
-        ),
+        local,
         mesh=mesh,
-        in_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                  P(SHARD_AXIS)),
         out_specs=ScanAggregates(
             series_sum=P(SHARD_AXIS),
             series_count=P(SHARD_AXIS),

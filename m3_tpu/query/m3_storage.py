@@ -14,7 +14,17 @@ import numpy as np
 
 from ..index.query import AllQuery, conj, neg, regexp, term
 from ..storage.database import Database
+from ..utils.instrument import DEFAULT as METRICS
 from .promql import Matcher
+
+# read-through re-admission is opportunistic: the streamed result is
+# already in hand when it runs, so an admission failure (device OOM near
+# the pool budget, fileset torn down underfoot) must never fail the query
+_M_READMIT_FAILURES = METRICS.counter(
+    "resident_readmission_failures_total",
+    "read-through re-admissions that failed (query still served by the "
+    "streamed result already computed)",
+)
 
 
 def matchers_to_index_query(matchers: list[Matcher]):
@@ -79,7 +89,10 @@ class M3Storage:
             stats.add_routing(b"*", None, "streamed", "resident pool disabled")
         elif len(pool) == 0:
             stats.add_routing(b"*", None, "streamed", "resident pool empty")
-        if pool is not None and pool.enabled and len(pool) > 0:
+        if pool is not None and pool.enabled:
+            # an EMPTY pool still takes this branch: the streamed fallback
+            # below re-admits sealed complete blocks (read-through), which
+            # is exactly how a fully-evicted pool refills under demand
             docs = self.db.query_ids(
                 self.namespace, q, start_nanos, end_nanos
             ).docs
@@ -96,6 +109,10 @@ class M3Storage:
             rows = self.db.fetch_tagged_arrays(
                 self.namespace, q, start_nanos, end_nanos, docs=docs
             )
+            # read-through re-admission: a streamed hit on sealed,
+            # complete blocks pulls them back into the pool so the hot
+            # set stays resident under eviction churn
+            self._maybe_readmit(docs, start_nanos, end_nanos)
         if pool is not None:
             stats.add(resident_misses=1)
         out = []
@@ -170,19 +187,102 @@ class M3Storage:
                     pool.heat.charge(key.shard_id, misses=1)
                     return None  # evicted / never admitted: stream instead
             plan.append((doc, doc_keys))
-        # per-shard heat (resident/heat.py): lanes about to be served
-        # resident, aggregated per shard so the hot path charges once per
-        # shard, not once per lane
+        # routing + hit heat are recorded by _record_resident_routing
+        # AFTER the resident scan succeeds — the chunked plan can still
+        # fail (raced eviction, side-plane mismatch), and EXPLAIN must
+        # never claim "resident-chunked" for a query the streamed
+        # fallback actually served
+        return plan
+
+    def _record_resident_routing(self, plan) -> None:
+        """EXPLAIN + per-shard heat for a resident scan that SUCCEEDED:
+        the resident decoder is the chunk-parallel kernel reading side
+        planes straight from the pool — EXPLAIN shows which decode path
+        served every (series, block), aggregated per shard so the hot
+        path charges heat once per shard, not once per lane."""
+        from . import stats
+
+        pool = self.db.resident_pool
         lanes_per_shard: dict[int, int] = {}
         for doc, doc_keys in plan:
             for key in doc_keys:
-                stats.add_routing(doc.id, key.block_start, "resident", "")
+                stats.add_routing(doc.id, key.block_start, "resident",
+                                  "resident-chunked")
                 lanes_per_shard[key.shard_id] = (
                     lanes_per_shard.get(key.shard_id, 0) + 1
                 )
         for shard_id, lanes in lanes_per_shard.items():
             pool.heat.charge(shard_id, hits=lanes)
-        return plan
+
+    def _maybe_readmit(self, docs, start_nanos, end_nanos) -> int:
+        """Read-through re-admission (carried from PR 3): when a scan
+        fell back to the streamed path because sealed, complete blocks
+        were NOT resident (evicted, or sealed by a previous process past
+        the bootstrap budget), pull exactly those filesets back into the
+        pool so the hot set tracks demand under eviction churn.
+        "Budget permitting" is literal: re-admissions fill FREE space
+        only and never evict published entries — a working set larger
+        than the budget would otherwise LRU-ping-pong, each scan's
+        re-admissions evicting the previous scan's. Buffered series are
+        skipped: their blocks would stream again regardless
+        (buffer-overlay rule). Counted in
+        m3tpu_resident_readmissions_total."""
+        pool = getattr(self.db, "resident_pool", None)
+        if pool is None or not pool.enabled:
+            return 0
+        if not pool.has_free_capacity():
+            # re-admissions never evict published entries, so a full
+            # pool can't take anything — skip the block walk AND the
+            # fileset disk re-reads (a working set larger than the
+            # budget would otherwise pay both on every streamed query)
+            return 0
+        from ..storage.fs import FilesetID
+
+        ns = self.db.namespaces[self.namespace]
+        todo: dict[tuple, object] = {}
+        for doc in docs:
+            shard = ns.shard_for(doc.id)
+            keys, buffered = shard.scan_block_keys(doc.id, start_nanos, end_nanos)
+            if buffered:
+                continue
+            for key in keys:
+                if key in pool or pool.is_complete(
+                    key.namespace, key.shard_id, key.block_start, key.volume
+                ):
+                    continue
+                if pool.never_completable(
+                    key.namespace, key.shard_id, key.block_start, key.volume
+                ):
+                    # a lane over the pool's page-span limit makes this
+                    # fileset permanently un-completable: re-admitting it
+                    # on every streamed query would re-upload the whole
+                    # fileset for nothing
+                    continue
+                if pool.budget_deferred(
+                    key.namespace, key.shard_id, key.block_start, key.volume
+                ):
+                    # a past re-admission of this fileset was rejected
+                    # for budget and no pages have freed since — the
+                    # retry is a guaranteed rejection, skip the disk
+                    # re-read until eviction/invalidation makes room
+                    continue
+                todo[(key.shard_id, key.block_start, key.volume)] = shard
+        admitted = 0
+        for (shard_id, block_start, volume), shard in todo.items():
+            try:
+                admitted += shard.readmit_fileset(
+                    FilesetID(self.namespace, shard_id, block_start, volume)
+                )
+            except Exception:
+                # the streamed result this query will serve is already
+                # computed — a failed opportunistic re-admission (device
+                # OOM near the pool budget is the likely case, and on the
+                # donated-scatter path admit_block resets the pool) must
+                # not turn it into a query error; remaining filesets are
+                # skipped rather than hammering a struggling device
+                _M_READMIT_FAILURES.inc()
+                break
+        return admitted
 
     def _fetch_resident(self, docs, start_nanos, end_nanos):
         """Batched decode-from-HBM fetch: [(tags, times, values)] exact
@@ -211,7 +311,15 @@ class M3Storage:
             if flat_keys:
                 decoded = resident_fetch_arrays(self.db.resident_pool, flat_keys)
                 if decoded is None:
-                    return None  # raced an eviction: streamed fallback
+                    # raced an eviction (or side-plane/chunk-shape
+                    # mismatch): streamed fallback serves the query, and
+                    # EXPLAIN says so
+                    query_stats.add_routing(
+                        b"*", None, "streamed",
+                        "resident-plan-failed (raced eviction)",
+                    )
+                    return None
+            self._record_resident_routing(plan)
             arrays, err = decoded
             out = []
             pos = 0
@@ -255,6 +363,7 @@ class M3Storage:
         "min", "max", "series", "path"} with path "resident"|"streamed".
         """
         from ..resident.scan import resident_scan_totals, streamed_scan_totals
+        from ..storage.fs import CHUNK_K
         from . import stats
 
         q = matchers_to_index_query(matchers)
@@ -273,9 +382,15 @@ class M3Storage:
                 if flat_keys
                 else _EMPTY_TOTALS
             )
-            if aggs is not None:
+            if aggs is None:
+                stats.add_routing(
+                    b"*", None, "streamed",
+                    "resident-plan-failed (raced eviction)",
+                )
+            else:
                 path = "resident"
                 stats.add(resident_hits=1)
+                self._record_resident_routing(plan)
 
                 def stream_for(i, _keys=flat_keys):
                     from ..storage.fs import FilesetID
@@ -294,15 +409,15 @@ class M3Storage:
             if pool is not None:
                 stats.add(resident_misses=1)
             segments: list[bytes] = []
-            bounds: list[int] = []
+            chunk_ks: set[int] = set()
             streamed_per_shard: dict[int, int] = {}
             for doc in docs:
                 shard = ns.shard_for(doc.id)
-                for stream, bound in shard.scan_segments(
+                for stream, _bound, chunk_k in shard.scan_segments(
                     doc.id, start_nanos, end_nanos
                 ):
                     segments.append(stream)
-                    bounds.append(bound)
+                    chunk_ks.add(chunk_k)
                     streamed_per_shard[shard.id] = (
                         streamed_per_shard.get(shard.id, 0) + len(stream)
                     )
@@ -312,12 +427,19 @@ class M3Storage:
                 # whose blocks weren't resident (resident/heat.py)
                 for shard_id, nbytes in streamed_per_shard.items():
                     pool.heat.charge(shard_id, streamed_bytes=nbytes)
+            # decode with the filesets' chunk size so the streamed twin's
+            # chunk decomposition (and hence f32 reduction order) matches
+            # the resident path bit for bit; mixed chunk sizes can't have
+            # a resident counterpart anyway (plan_chunked refuses them),
+            # so any k decodes them correctly — use the default
+            k = chunk_ks.pop() if len(chunk_ks) == 1 else CHUNK_K
             aggs = (
-                streamed_scan_totals(segments, bounds)
+                streamed_scan_totals(segments, k=k)
                 if segments
                 else _EMPTY_TOTALS
             )
             stream_for = lambda i, _segs=segments: _segs[i]
+            self._maybe_readmit(docs, start_nanos, end_nanos)
         err = getattr(aggs, "series_err", None)
         if err is not None and np.asarray(err).any():
             # lanes the device decoder bailed on (annotated streams):
@@ -336,6 +458,10 @@ class M3Storage:
             "max": float(aggs.total_max),
             "series": n_series,
             "path": path,
+            # both paths now decode through the chunk-parallel kernels
+            # (side planes paged into the pool; streamed twin prescans) —
+            # tools/check_resident.py asserts the resident scan reports it
+            "decoder": "chunked",
         }
 
 

@@ -12,21 +12,21 @@ scan-and-aggregate hot path.
 from .heat import ShardHeat
 from .pool import (
     AdmitResult,
+    ResidentChunkedPlan,
     ResidentEntry,
     ResidentOptions,
     ResidentPool,
     ResidentPoolError,
-    ResidentScanPlan,
 )
 from .scan import resident_fetch_arrays, resident_scan_totals
 
 __all__ = [
     "AdmitResult",
+    "ResidentChunkedPlan",
     "ResidentEntry",
     "ResidentOptions",
     "ResidentPool",
     "ResidentPoolError",
-    "ResidentScanPlan",
     "ShardHeat",
     "resident_fetch_arrays",
     "resident_scan_totals",

@@ -17,38 +17,59 @@ Layout:
   stream occupies ``ceil(bits / page_bits)`` consecutive page-table slots
   (the pages themselves need not be contiguous — the device gather
   reassembles them).
+- SIDE PLANES: a second paged device buffer
+  ``uint32[num_side_pages, side_page_chunks, N_SIDE_PLANES]`` holding the
+  per-CHUNK decoder-state side table (ops/chunked.py snapshot_stream:
+  byte offset, prev_time/prev_delta/prev_float_bits/prev_xor/int_val
+  carries, time unit, sig/mult, is_float, and the v2 fast-chunk
+  classification flags) for every resident lane. Side pages live and die
+  with their data pages, so the CHUNK-parallel kernels
+  (ops/chunked.decode_chunked_lanes) read both stream bytes and chunk
+  metadata straight from residency — no host rebuild of chunk tables, no
+  T-step whole-stream scan.
 - a HOST-side page table: ``BlockKey(namespace, shard, series_id,
-  block_start, volume) -> ResidentEntry(pages, num_bits, initial_unit,
-  num_points)`` — exactly the lane metadata ``ops.decode.decode_batched``
-  needs, so a scan is one row gather + the existing decode kernel.
+  block_start, volume) -> ResidentEntry(pages, side_pages, num_bits,
+  n_chunks, chunk_k, max_span_bits, ...)`` — everything plan assembly
+  needs as small int vectors; the ~40B/chunk metadata itself never
+  leaves the device after admission.
 
 Admission is batched at flush/seal time (storage/database.py): all of a
 fileset's streams stage into one host array and land in one device scatter
-(``pool.at[idx].set(staged)``), not a device_put per series. Eviction is
-LRU under the byte budget plus explicit invalidation through the same
-hooks as the decoded-block cache (cache/invalidation.py) — a written-to,
-superseded, or retention-expired block is never resident.
+(``pool.at[idx].set(staged)``), not a device_put per series. Side tables
+ride the fileset's persisted ``side`` file when the caller has one, and
+are prescanned AT ADMISSION (native/m3tsz.cc batch prescan when built)
+otherwise. Eviction is LRU under the byte budget plus explicit
+invalidation through the same hooks as the decoded-block cache
+(cache/invalidation.py) — a written-to, superseded, or retention-expired
+block is never resident.
 
-Updates are FUNCTIONAL (``.at[].set`` returns a new array, no donation):
-a scan that snapshotted the previous buffer keeps reading consistent
-bytes while an admission lands. The cost is one transient extra copy
-during admission; donation (true in-place) is a TPU-side follow-up that
-needs scan/admit epoch fencing.
+Updates are in-place WHEN SAFE, functional otherwise: scans take a read
+LEASE (``read_lease()``) around plan+decode; an admission that finds no
+active lease donates the page buffers into the scatter (XLA aliases
+input to output — true in-place, no transient copy), briefly fencing new
+leases; an admission racing an active scan falls back to the functional
+``.at[].set`` copy so the scan's snapshot stays bit-stable. Either way a
+scan sees the old epoch or the fully-published one, never a
+half-scattered page (``inplace_admissions`` / ``copy_admissions`` count
+which path ran).
 
-Concurrency: the page table, free list, and counters are guarded by one
-lock; ``plan_scan`` snapshots the device buffer reference under it.
+Concurrency: the page table, free lists, and counters are guarded by one
+lock; ``plan_chunked`` snapshots the device buffer
+references under it (callers hold a read lease across use).
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import NamedTuple
 
 import numpy as np
 
 from ..cache.block_cache import BlockKey
+from ..storage.fs import CHUNK_K
 from ..utils.instrument import DEFAULT as METRICS
 from .heat import ShardHeat
 
@@ -56,6 +77,49 @@ from .heat import ShardHeat
 class ResidentPoolError(ValueError):
     """Corrupt page-table state detected (satellite contract: corrupt
     metadata must raise, never read out-of-bounds or silently wrap)."""
+
+
+# Order of the uint32 side planes per chunk (device layout; the resident
+# chunked scan's assembly indexes these columns — parallel/scan.py).
+SIDE_PLANES = (
+    "off",  # bit offset of the chunk start within the stream
+    "prev_time_hi", "prev_time_lo",
+    "prev_delta_hi", "prev_delta_lo",
+    "prev_float_bits_hi", "prev_float_bits_lo",
+    "prev_xor_hi", "prev_xor_lo",
+    "int_val_hi", "int_val_lo",
+    "time_unit", "sig", "mult", "is_float",
+    "flags",  # bit 0: int-fast chunk, bit 1: float-fast chunk
+)
+N_SIDE_PLANES = len(SIDE_PLANES)
+
+_M64 = (1 << 64) - 1
+
+
+def side_rows_from_snaps(snaps: list) -> np.ndarray:
+    """Per-chunk snapshot dicts (ops/chunked.snapshot_stream or
+    storage/fs.FilesetReader.side_table) -> uint32[n_chunks, N_SIDE_PLANES]
+    device side-plane rows."""
+    n = len(snaps)
+    rows = np.zeros((n, N_SIDE_PLANES), np.uint32)
+    for j, p in enumerate(snaps):
+        pt = int(p["prev_time"]) & _M64
+        pd = int(p["prev_delta"]) & _M64
+        pfb = int(p["prev_float_bits"]) & _M64
+        pxr = int(p["prev_xor"]) & _M64
+        iv = int(p["int_val"]) & _M64
+        rows[j] = (
+            p["off"],
+            pt >> 32, pt & 0xFFFFFFFF,
+            pd >> 32, pd & 0xFFFFFFFF,
+            pfb >> 32, pfb & 0xFFFFFFFF,
+            pxr >> 32, pxr & 0xFFFFFFFF,
+            iv >> 32, iv & 0xFFFFFFFF,
+            int(p["time_unit"]), int(p["sig"]), int(p["mult"]),
+            int(bool(p["is_float"])),
+            (1 if p.get("fast") else 0) | (2 if p.get("fast_float") else 0),
+        )
+    return rows
 
 
 @dataclass
@@ -67,12 +131,18 @@ class ResidentOptions:
     (default 512 words = 2KiB — one typical 720-point m3tsz block fits in
     1–2 pages). ``max_lane_pages`` caps one (series, block) lane's page
     span: the device gather width is ``max over lanes`` of the page
-    count, so one pathological stream must not widen every lane's row."""
+    count, so one pathological stream must not widen every lane's row.
+    ``side_bytes`` budgets the per-chunk side planes (0 = same as
+    ``max_bytes``: an m3tsz chunk of K=32 records is ~48B of stream vs
+    64B of snapshot, so metadata-for-chunk-parallelism is roughly 1:1);
+    ``side_page_chunks`` is the side-page granularity in chunks."""
 
     enabled: bool = True
     max_bytes: int = 0
     page_words: int = 512
     max_lane_pages: int = 64
+    side_bytes: int = 0  # 0 = derive from max_bytes
+    side_page_chunks: int = 16
     namespaces: list = field(default_factory=list)
 
     def validate(self) -> None:
@@ -84,6 +154,24 @@ class ResidentOptions:
             raise ConfigError("resident.page_words must be > 0")
         if self.max_lane_pages <= 0:
             raise ConfigError("resident.max_lane_pages must be > 0")
+        if self.side_bytes < 0:
+            raise ConfigError("resident.side_bytes must be >= 0")
+        if self.side_page_chunks <= 0:
+            raise ConfigError("resident.side_page_chunks must be > 0")
+        # ``enabled`` needs >1 page in BOTH planes (page 0 is reserved):
+        # a small positive budget would otherwise pass validation and
+        # silently disable the whole pool — reject it loudly instead
+        if 0 < self.max_bytes < 2 * self.page_bytes:
+            raise ConfigError(
+                f"resident.max_bytes {self.max_bytes} is under two pages "
+                f"({2 * self.page_bytes}B) — 0 disables the pool explicitly"
+            )
+        if 0 < self.side_bytes < 2 * self.side_page_bytes:
+            raise ConfigError(
+                f"resident.side_bytes {self.side_bytes} is under two side "
+                f"pages ({2 * self.side_page_bytes}B) — 0 derives from "
+                "max_bytes"
+            )
 
     @property
     def page_bytes(self) -> int:
@@ -94,26 +182,28 @@ class ResidentOptions:
         # page 0 is the reserved zero page; it still costs budget
         return self.max_bytes // self.page_bytes
 
+    @property
+    def side_page_bytes(self) -> int:
+        return self.side_page_chunks * N_SIDE_PLANES * 4
+
+    @property
+    def num_side_pages(self) -> int:
+        # side page 0 is the reserved zero page (padding lanes' chunk
+        # slots resolve to it, yielding all-zero side rows = done lanes)
+        budget = self.side_bytes or self.max_bytes
+        return budget // self.side_page_bytes
+
 
 class ResidentEntry(NamedTuple):
     """Page-table row for one resident (series, block, volume) lane."""
 
     pages: tuple  # page indices, stream order
     num_bits: int  # valid bits of the m3tsz stream
-    initial_unit: int  # initial time-unit code (BatchedSegments semantics)
-    num_points: int  # upper bound on datapoints (n_chunks * chunk_k)
     nbytes: int  # stream length in bytes (occupancy accounting)
-
-
-def _initial_unit(stream: bytes, default_unit_nanos: int = 1_000_000_000) -> int:
-    """Mirror BatchedSegments.initial_units for one stream: the default
-    unit applies only when the head 64-bit timestamp divides it."""
-    if len(stream) < 8:
-        return 0
-    nt = int.from_bytes(stream[:8], "big")
-    from ..utils.xtime import Unit
-
-    return int(Unit.SECOND) if nt % default_unit_nanos == 0 else 0
+    side_pages: tuple = ()  # side-plane page indices, chunk order
+    n_chunks: int = 0  # chunks in the side table (0 = no side planes)
+    chunk_k: int = 0  # records per chunk the side table was built with
+    max_span_bits: int = 0  # widest chunk span (window sizing)
 
 
 class AdmitResult(NamedTuple):
@@ -124,18 +214,20 @@ class AdmitResult(NamedTuple):
 
 
 class ResidentPool:
-    """Paged device pool of sealed blocks' compressed streams."""
+    """Paged device pool of sealed blocks' compressed streams + chunk
+    side planes."""
 
     def __init__(self, options: ResidentOptions | None = None, registry=None) -> None:
         self.options = options or ResidentOptions()
         self._lock = threading.Lock()
-        # serializes admissions (the functional device-words chain); held
-        # across staging + upload so the TABLE lock above never is — writes
-        # and scans keep flowing while a flush's pages upload
+        # serializes admissions (the device-words chain, functional OR
+        # donated); held across staging + upload so the TABLE lock above
+        # never is — writes and scans keep flowing while a flush's pages
+        # upload
         self._upload_lock = threading.Lock()
         self._od: "OrderedDict[BlockKey, ResidentEntry]" = OrderedDict()
         # admitted-but-not-yet-uploaded entries: invisible to readers
-        # (plan_scan would otherwise serve pages the scatter hasn't
+        # (plan_chunked would otherwise serve pages the scatter hasn't
         # written); published into _od after the upload completes, unless
         # an invalidation dropped them mid-upload
         self._pending: dict[BlockKey, ResidentEntry] = {}
@@ -147,15 +239,46 @@ class ResidentPool:
         # "not resident" — dropped conservatively on any eviction or
         # invalidation touching the group
         self._complete: set[tuple] = set()
-        # free list: every page except the reserved zero page
+        # filesets whose admission rejected a lane for page span: they
+        # can NEVER become complete at this max_lane_pages, so
+        # read-through re-admission skips them instead of re-uploading
+        # the fileset on every streamed query (a volume bump is a new
+        # tuple and gets retried)
+        self._span_incomplete: set[tuple] = set()
+        # filesets a READ-THROUGH re-admission rejected for budget,
+        # mapped to (data, side) free-list sizes at that failure:
+        # retrying is a guaranteed rejection (re-admissions never evict)
+        # until pages free up past a watermark in whichever plane was
+        # binding, so _maybe_readmit skips the disk re-read until then —
+        # self-healing, no invalidation hook
+        self._budget_deferred: dict[tuple, tuple[int, int]] = {}
+        # bumps on _reset_locked so an in-flight admission knows its
+        # pages were already reclaimed by the reset
+        self._generation = 0
+        # free lists: every page except the reserved zero pages
         self._free: list[int] = list(range(self.options.num_pages - 1, 0, -1))
+        self._free_side: list[int] = list(
+            range(self.options.num_side_pages - 1, 0, -1)
+        )
         self._words = None  # device uint32[num_pages, page_words], lazy
+        self._side = None  # device uint32[side_pages, spc, N_SIDE_PLANES], lazy
         self._resident_bytes = 0  # sum of entries' stream bytes
+        # scan/admit epoch fence: scans hold a read lease across
+        # plan+decode; an admission donates the buffers (true in-place)
+        # only when no lease is active, fencing new leases for the
+        # duration of the scatter
+        self._leases = 0
+        self._donating = False
+        self._fence = threading.Condition(self._lock)
+        self.epoch = 0  # bumps on every buffer publish
         self.admissions = 0
         self.rejections = 0
         self.evictions = 0
         self.invalidations = 0
         self.upload_bytes = 0
+        self.readmissions = 0
+        self.inplace_admissions = 0
+        self.copy_admissions = 0
         reg = registry or METRICS
         self._m_admissions = reg.counter(
             "resident_admissions_total", "blocks admitted to the resident pool"
@@ -174,10 +297,28 @@ class ResidentPool:
             "host->device block bytes uploaded at admission (warm resident "
             "scans move ZERO such bytes — tests assert on this counter)",
         )
+        self._m_readmissions = reg.counter(
+            "resident_readmissions_total",
+            "read-through re-admissions: streamed-fallback hits on sealed "
+            "complete blocks pulled back into the pool",
+        )
+        self._m_inplace = reg.counter(
+            "resident_inplace_admissions_total",
+            "admissions whose scatter donated the page buffers (true "
+            "in-place, no transient copy)",
+        )
+        self._m_copy = reg.counter(
+            "resident_copy_admissions_total",
+            "admissions that fell back to the functional copy because a "
+            "scan lease was active",
+        )
         self._g_bytes = reg.gauge("resident_pool_bytes", "compressed bytes resident")
         self._g_pages = reg.gauge("resident_pool_pages", "pages in use (excl. zero page)")
         self._g_free = reg.gauge("resident_pool_free_pages", "pages on the free list")
         self._g_entries = reg.gauge("resident_pool_entries", "page-table entries")
+        self._g_side_pages = reg.gauge(
+            "resident_side_pages", "side-plane pages in use (excl. zero page)"
+        )
         self._g_occupancy = reg.gauge(
             "resident_pool_occupancy_ratio",
             "pages in use / pages total — with the gauges above, the "
@@ -190,12 +331,12 @@ class ResidentPool:
         # ROADMAP item 5's shard rebalance keys off
         self.heat = ShardHeat(registry=reg)
 
-    # ---------- device buffer ----------
+    # ---------- device buffers ----------
 
     @property
     def enabled(self) -> bool:
         o = self.options
-        return o.enabled and o.num_pages > 1
+        return o.enabled and o.num_pages > 1 and o.num_side_pages > 1
 
     def _ensure_words(self):
         """Allocate the device page buffer on first admission (a node with
@@ -208,19 +349,47 @@ class ResidentPool:
             )
         return self._words
 
-    def device_words(self):
-        """Snapshot of the device page buffer (functional updates: the
-        reference stays internally consistent for the caller even if an
-        admission lands concurrently)."""
-        with self._lock:
-            return self._ensure_words() if self.enabled else None
+    def _ensure_side(self):
+        if self._side is None:
+            import jax.numpy as jnp
+
+            o = self.options
+            self._side = jnp.zeros(
+                (o.num_side_pages, o.side_page_chunks, N_SIDE_PLANES), jnp.uint32
+            )
+        return self._side
 
     def device_bytes(self) -> int:
-        """Bytes the page buffer actually holds on device RIGHT NOW —
-        0 until first admission (unlike device_words, this never forces
-        the lazy allocation: memory accounting must observe, not cause)."""
+        """Bytes the page + side buffers actually hold on device RIGHT
+        NOW — 0 until first admission (never forces the lazy allocation:
+        memory accounting must observe, not cause). Buffer snapshots for
+        scans go through plan_chunked under a read_lease() — an in-place
+        admission donates (deletes) un-leased buffers."""
         with self._lock:
-            return int(self._words.nbytes) if self._words is not None else 0
+            n = int(self._words.nbytes) if self._words is not None else 0
+            n += int(self._side.nbytes) if self._side is not None else 0
+            return n
+
+    # ---------- scan/admit epoch fencing ----------
+
+    @contextmanager
+    def read_lease(self):
+        """Scan-side fence: while any lease is held, admissions take the
+        functional-copy path so the lease holder's buffer snapshots stay
+        valid; while a donated scatter is in flight, new leases wait (the
+        scatter is brief) so they observe either the old epoch or the
+        fully-published one — never a half-scattered page."""
+        with self._lock:
+            while self._donating:
+                self._fence.wait()
+            self._leases += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._leases -= 1
+                if self._leases == 0:
+                    self._fence.notify_all()
 
     # ---------- admission ----------
 
@@ -231,26 +400,34 @@ class ResidentPool:
         block_start: int,
         volume: int,
         items: list,
+        chunk_k: int = CHUNK_K,
+        readmission: bool = False,
     ) -> AdmitResult:
         """Admit one sealed fileset block's streams in ONE batched upload.
 
-        ``items``: ``[(series_id, stream_bytes, num_points_bound)]`` —
-        empty streams are skipped (series absent from the block). All
-        staged pages land with a single host->device transfer + scatter.
+        ``items``: ``[(series_id, stream_bytes, num_points_bound)]`` or
+        ``[(series_id, stream_bytes, num_points_bound, side_snaps)]`` —
+        empty streams are skipped (series absent from the block). When
+        ``side_snaps`` (the per-chunk snapshot dicts of
+        ops/chunked.snapshot_stream / storage/fs side tables) is absent,
+        the chunk prescan + fast-chunk classification runs HERE, at
+        admission time, so every resident lane carries device side planes
+        and scans dispatch the chunk-parallel kernels. All staged pages
+        land with a single host->device transfer + scatter per buffer.
 
         Three phases so the TABLE lock is held only for bookkeeping —
         never across staging, the upload, or an XLA scatter compile
         (writers invalidating and queries planning keep flowing while a
         flush's pages upload):
 
-        1. under the table lock: allocate pages (LRU-evicting published
-           entries as needed) and park the new entries in ``_pending`` —
-           invisible to readers, whose plan would otherwise gather pages
-           the scatter hasn't written;
-        2. no table lock: build the staging array and run the device
-           scatter (serialized by the upload lock — the functional words
-           chain must not fork);
-        3. under the table lock: swap in the new words buffer and publish
+        1. under the table lock: allocate data + side pages (LRU-evicting
+           published entries as needed) and park the new entries in
+           ``_pending`` — invisible to readers, whose plan would
+           otherwise gather pages the scatter hasn't written;
+        2. no table lock: build the staging arrays and run the device
+           scatters (serialized by the upload lock; donated in-place when
+           no scan lease is active, functional copy otherwise);
+        3. under the table lock: swap in the new buffers and publish
            surviving pending entries (an invalidation that raced the
            upload drops its entry instead of publishing stale bytes).
         """
@@ -260,79 +437,141 @@ class ResidentPool:
         if o.namespaces and namespace not in o.namespaces:
             return AdmitResult(0, 0, 0, False)
         page_bytes = o.page_bytes
-        plan: list[tuple[BlockKey, bytes, int, int]] = []  # key, stream, pages, points
+        spc = o.side_page_chunks
+        norm = [
+            (it[0], it[1], it[2], it[3] if len(it) > 3 else None) for it in items
+        ]
+        # chunk prescan for items that arrived without a side table — the
+        # pure host walk runs BEFORE any lock (native batch prescan when
+        # built, ~50x the Python walk)
+        missing = [i for i, it in enumerate(norm) if it[3] is None and it[1]]
+        if missing:
+            snaps_all = self._prescan([norm[i][1] for i in missing], chunk_k)
+            for i, snaps in zip(missing, snaps_all):
+                sid, stream, bound, _ = norm[i]
+                norm[i] = (sid, stream, bound, snaps)
+        # key, stream, pages, side_pages, points, snaps
+        plan: list[tuple] = []
         rejected_span = 0
-        for sid, stream, num_points in items:
+        for sid, stream, num_points, snaps in norm:
             if not stream:
                 continue
             n_pages = -(-len(stream) // page_bytes)
             if n_pages > o.max_lane_pages:
                 rejected_span += 1
                 continue
+            snaps = snaps or []
+            n_side = -(-len(snaps) // spc) if snaps else 0
             key = BlockKey(namespace, shard_id, bytes(sid), block_start, volume)
-            plan.append((key, bytes(stream), n_pages, int(num_points)))
+            plan.append((key, bytes(stream), n_pages, n_side, snaps))
         rejected_budget = 0
         admitted = 0
-        batch_entries: list[tuple[BlockKey, ResidentEntry, bytes]] = []
+        already_resident = 0
+        batch_entries: list[tuple[BlockKey, ResidentEntry, bytes, list]] = []
         with self._upload_lock:
             with self._lock:
-                for key, stream, n_pages, num_points in plan:
-                    pages = self._alloc_locked(n_pages)
-                    if pages is None:
+                for key, stream, n_pages, n_side, snaps in plan:
+                    if readmission:
+                        cur = self._od.get(key)
+                        if cur is not None:
+                            # lane already resident at this exact key —
+                            # one evicted shard-mate must not re-stage
+                            # and re-upload the whole fileset's bytes;
+                            # the lane was just streamed, so touch its
+                            # LRU slot and count it toward completeness
+                            self._od.move_to_end(key)
+                            already_resident += 1
+                            continue
+                    # re-admissions fill FREE space only ("budget
+                    # permitting"): evicting published entries for them
+                    # would ping-pong a working set larger than the pool
+                    alloc = self._alloc_locked(
+                        n_pages, n_side, evict_ok=not readmission
+                    )
+                    if alloc is None:
                         rejected_budget += 1
                         continue
+                    pages, side_pages = alloc
                     old = self._od.pop(key, None)
                     if old is not None:
                         self._unindex_locked(key, old)
                         self._free.extend(old.pages)
+                        self._free_side.extend(old.side_pages)
                         self._resident_bytes -= old.nbytes
                     entry = ResidentEntry(
                         pages=tuple(pages),
                         num_bits=len(stream) * 8,
-                        initial_unit=_initial_unit(stream),
-                        num_points=num_points,
                         nbytes=len(stream),
+                        side_pages=tuple(side_pages),
+                        n_chunks=len(snaps),
+                        chunk_k=chunk_k if snaps else 0,
+                        max_span_bits=max((p["span"] for p in snaps), default=0),
                     )
                     self._pending[key] = entry
                     admitted += 1
-                    batch_entries.append((key, entry, stream))
-                words = self._ensure_words() if batch_entries else None
+                    batch_entries.append((key, entry, stream, snaps))
             # ---- no table lock: stage + upload ----
-            # Pending pages are off the free list (never LRU-evicted), so
+            # Pending pages are off the free lists (never LRU-evicted), so
             # intra-batch cannibalization is impossible: each staged page
             # has exactly one owner and the scatter's indices are unique.
             # A racing invalidation can still DROP a pending entry; only
             # entries still pending at staging time get rows.
             staged_rows: list[np.ndarray] = []
             staged_idx: list[int] = []
+            side_rows: list[np.ndarray] = []
+            side_idx: list[int] = []
             staged_keys: set = set()
-            new_words = None
-            if batch_entries:
+            with self._lock:
+                generation = self._generation
+            try:
+                if batch_entries:
+                    with self._lock:
+                        survivors_snapshot = [
+                            tup
+                            for tup in batch_entries
+                            if self._pending.get(tup[0]) is tup[1]
+                        ]
+                    for key, entry, stream, snaps in survivors_snapshot:
+                        staged_keys.add(key)
+                        for j, p in enumerate(entry.pages):
+                            row = np.zeros(o.page_words, np.uint32)
+                            chunk = stream[j * page_bytes : (j + 1) * page_bytes]
+                            padded = chunk + b"\x00" * (-len(chunk) % 4)
+                            row[: len(padded) // 4] = np.frombuffer(
+                                padded, ">u4"
+                            ).astype(np.uint32)
+                            staged_rows.append(row)
+                            staged_idx.append(p)
+                        if snaps:
+                            rows = side_rows_from_snaps(snaps)
+                            for j, sp in enumerate(entry.side_pages):
+                                page = np.zeros((spc, N_SIDE_PLANES), np.uint32)
+                                seg = rows[j * spc : (j + 1) * spc]
+                                page[: len(seg)] = seg
+                                side_rows.append(page)
+                                side_idx.append(sp)
+                    if staged_rows or side_rows:
+                        # publishes the new buffers itself (under the same
+                        # lock acquisition that lifts the donation fence)
+                        self._upload(staged_rows, staged_idx, side_rows, side_idx)
+            except BaseException:
+                # staging/upload failed: this batch's pages are off the
+                # free lists with nothing published — reclaim them here
+                # (unless a donated-scatter failure already reset the
+                # whole pool, rebuilding the free lists)
                 with self._lock:
-                    survivors_snapshot = [
-                        (key, entry, stream)
-                        for key, entry, stream in batch_entries
-                        if self._pending.get(key) is entry
-                    ]
-                for key, entry, stream in survivors_snapshot:
-                    staged_keys.add(key)
-                    for j, p in enumerate(entry.pages):
-                        row = np.zeros(o.page_words, np.uint32)
-                        chunk = stream[j * page_bytes : (j + 1) * page_bytes]
-                        padded = chunk + b"\x00" * (-len(chunk) % 4)
-                        row[: len(padded) // 4] = np.frombuffer(
-                            padded, ">u4"
-                        ).astype(np.uint32)
-                        staged_rows.append(row)
-                        staged_idx.append(p)
-                if staged_rows:
-                    new_words = self._upload(words, staged_rows, staged_idx)
+                    if self._generation == generation:
+                        for key, entry, _stream, _snaps in batch_entries:
+                            if self._pending.get(key) is entry:
+                                del self._pending[key]
+                            self._free.extend(entry.pages)
+                            self._free_side.extend(entry.side_pages)
+                        self._publish_locked()
+                raise
             # ---- publish ----
             with self._lock:
-                if new_words is not None:
-                    self._words = new_words
                 survivors = 0
-                for key, entry, stream in batch_entries:
+                for key, entry, stream, _snaps in batch_entries:
                     present = self._pending.get(key) is entry
                     if present:
                         del self._pending[key]
@@ -347,49 +586,152 @@ class ResidentPool:
                         # this batch, so reclamation happens HERE, not in
                         # the invalidation hook
                         self._free.extend(entry.pages)
+                        self._free_side.extend(entry.side_pages)
                 complete = (
-                    admitted > 0
+                    admitted + already_resident > 0
                     and rejected_span == 0
                     and rejected_budget == 0
-                    and survivors == len(plan)
+                    and survivors + already_resident == len(plan)
                 )
+                group = (namespace, shard_id, block_start, volume)
                 if complete:
-                    self._complete.add((namespace, shard_id, block_start, volume))
+                    self._complete.add(group)
+                if rejected_span:
+                    self._span_incomplete.add(group)
+                if readmission:
+                    if rejected_budget:
+                        # cooldown watermark: retrying this fileset is a
+                        # guaranteed rejection until EITHER free list
+                        # grows past its size at THIS failure (whichever
+                        # plane was binding; self-healing — no
+                        # invalidation hook required)
+                        self._budget_deferred[group] = (
+                            len(self._free), len(self._free_side)
+                        )
+                    else:
+                        self._budget_deferred.pop(group, None)
                 self.admissions += admitted
                 self.rejections += rejected_span + rejected_budget
                 self._m_admissions.inc(admitted)
+                if readmission and admitted:
+                    self.readmissions += admitted
+                    self._m_readmissions.inc(admitted)
                 if rejected_span + rejected_budget:
                     self._m_rejections.inc(rejected_span + rejected_budget)
                 self._publish_locked()
         return AdmitResult(admitted, rejected_span, rejected_budget, complete)
 
-    def _upload(self, words, rows: list, idx: list):
-        """One host->device transfer + functional scatter for the batch —
-        runs WITHOUT the table lock (serialized by the upload lock; the
-        caller publishes the returned buffer under the table lock).
+    @staticmethod
+    def _prescan(streams: list, chunk_k: int) -> list:
+        from .. import native
+
+        if native.available():
+            return native.prescan_batch(streams, k=chunk_k)
+        from ..ops.chunked import snapshot_stream
+
+        return [snapshot_stream(s, chunk_k) for s in streams]
+
+    def _upload(self, rows: list, idx: list, side_rows: list, side_idx: list):
+        """One host->device transfer + scatter per buffer for the batch —
+        runs WITHOUT the table lock (serialized by the upload lock) and
+        PUBLISHES the new buffers itself, under the SAME lock acquisition
+        that lifts the donation fence: a lease waking on the fence must
+        already see the published buffers, never the donated (deleted)
+        old ones.
+
+        When no scan lease is active the current buffers are DONATED to
+        the scatter: XLA aliases input to output and writes the pages in
+        place — the PR-3 transient copy is gone. While the donated
+        scatter is in flight new leases wait on the fence (the old buffer
+        no longer exists); an active lease instead downgrades this
+        admission to the functional copy.
+
+        If a scatter fails AFTER a donation consumed a buffer, every
+        entry (published and pending) points into a deleted array — the
+        pool resets (table dropped, buffers lazily re-zeroed) rather
+        than bricking; read-through re-admission repopulates the hot
+        set. The functional path keeps the old buffers on failure.
 
         The page count is padded to a power of two (extra rows re-write
         zeros into the reserved zero page) so the jitted scatter compiles
         once per bucket, not once per fileset size."""
         import jax
 
+        with self._lock:
+            words = self._ensure_words()
+            side = self._ensure_side()
+            donate = self._leases == 0
+            if donate:
+                self._donating = True
+        try:
+            new_words = new_side = None
+            if rows:
+                staged, indices = self._stage(rows, idx, (self.options.page_words,))
+                self.upload_bytes += staged.nbytes
+                self._m_upload.inc(staged.nbytes)
+                new_words = _scatter(words, jax.device_put(indices),
+                                     jax.device_put(staged), donate)
+            if side_rows:
+                staged, indices = self._stage(
+                    side_rows, side_idx,
+                    (self.options.side_page_chunks, N_SIDE_PLANES),
+                )
+                # side-plane staging is host->device transfer like the
+                # data pages (~1:1 with stream bytes) — count it, or the
+                # upload accounting under-reports admission cost ~2x and
+                # the zero-transfer contract can't see side re-uploads
+                self.upload_bytes += staged.nbytes
+                self._m_upload.inc(staged.nbytes)
+                new_side = _scatter(side, jax.device_put(indices),
+                                    jax.device_put(staged), donate)
+        except BaseException:
+            with self._lock:
+                if donate:
+                    self._reset_locked()
+                    self._donating = False
+                    self._fence.notify_all()
+            raise
+        with self._lock:
+            if new_words is not None:
+                self._words = new_words
+            if new_side is not None:
+                self._side = new_side
+            if new_words is not None or new_side is not None:
+                self.epoch += 1
+            if donate:
+                self._donating = False
+                self._fence.notify_all()
+        if donate:
+            self.inplace_admissions += 1
+            self._m_inplace.inc()
+        else:
+            self.copy_admissions += 1
+            self._m_copy.inc()
+
+    @staticmethod
+    def _stage(rows: list, idx: list, row_shape: tuple):
         n = len(rows)
         n_pad = 1 << max(n - 1, 0).bit_length() if n else 1
-        staged = np.zeros((n_pad, self.options.page_words), np.uint32)
+        staged = np.zeros((n_pad,) + row_shape, np.uint32)
         staged[:n] = np.stack(rows)
         indices = np.zeros(n_pad, np.int32)
         indices[:n] = np.asarray(idx, np.int32)
-        self.upload_bytes += staged.nbytes
-        self._m_upload.inc(staged.nbytes)
-        return _scatter_pages(words, jax.device_put(indices), jax.device_put(staged))
+        return staged, indices
 
-    def _alloc_locked(self, n_pages: int) -> list | None:
-        """Pop ``n_pages`` from the free list, LRU-evicting until they fit
-        (never evicting page 0, which is not on the free list)."""
-        while len(self._free) < n_pages:
-            if not self._evict_one_locked():
+    def _alloc_locked(self, n_pages: int, n_side: int, evict_ok: bool = True):
+        """Pop pages from both free lists, LRU-evicting until they fit
+        (never evicting the reserved zero pages, which are not on the
+        free lists). ``evict_ok=False`` admits only into free space —
+        read-through re-admissions use it so a working set larger than
+        the budget can't LRU-ping-pong (each scan evicting the previous
+        scan's re-admissions). Returns (pages, side_pages) or None."""
+        while len(self._free) < n_pages or len(self._free_side) < n_side:
+            if not evict_ok or not self._evict_one_locked():
                 return None
-        return [self._free.pop() for _ in range(n_pages)]
+        return (
+            [self._free.pop() for _ in range(n_pages)],
+            [self._free_side.pop() for _ in range(n_side)],
+        )
 
     def _evict_one_locked(self) -> bool:
         if not self._od:
@@ -397,6 +739,7 @@ class ResidentPool:
         key, entry = self._od.popitem(last=False)
         self._unindex_locked(key, entry)
         self._free.extend(entry.pages)
+        self._free_side.extend(entry.side_pages)
         self._resident_bytes -= entry.nbytes
         self.evictions += 1
         self._m_evictions.inc()
@@ -415,6 +758,46 @@ class ResidentPool:
         with self._lock:
             return (namespace, shard_id, block_start, volume) in self._complete
 
+    def has_free_capacity(self) -> bool:
+        """Cheap gate for read-through re-admission: free pages exist in
+        BOTH planes. Re-admissions never evict (see _alloc_locked), so a
+        full pool makes any attempt pointless — callers skip the fileset
+        re-read entirely instead of paying disk I/O for a guaranteed
+        budget rejection."""
+        with self._lock:
+            return bool(self._free) and bool(self._free_side)
+
+    def never_completable(
+        self, namespace: str, shard_id: int, block_start: int, volume: int
+    ) -> bool:
+        """True when a past admission of this fileset rejected a lane for
+        page span — it can never reach the complete marker, so
+        read-through re-admission would re-upload it on every streamed
+        query for nothing."""
+        with self._lock:
+            return (namespace, shard_id, block_start, volume) in self._span_incomplete
+
+    def budget_deferred(
+        self, namespace: str, shard_id: int, block_start: int, volume: int
+    ) -> bool:
+        """True when a past read-through re-admission of this fileset was
+        rejected for budget and NEITHER free list (data or side plane —
+        either can be the binding constraint) has grown since: retrying
+        would pay the whole-fileset disk re-read for another guaranteed
+        rejection (re-admissions never evict). Any eviction or
+        invalidation that frees pages in either plane past its recorded
+        watermark lets the next streamed query retry (which refreshes
+        the marker if it fails again)."""
+        with self._lock:
+            rec = self._budget_deferred.get(
+                (namespace, shard_id, block_start, volume)
+            )
+            return (
+                rec is not None
+                and len(self._free) <= rec[0]
+                and len(self._free_side) <= rec[1]
+            )
+
     def __contains__(self, key: BlockKey) -> bool:
         with self._lock:
             return key in self._od
@@ -422,64 +805,111 @@ class ResidentPool:
     def __len__(self) -> int:
         return len(self._od)
 
-    def plan_scan(self, keys: list) -> "ResidentScanPlan | None":
-        """Assemble the device gather inputs for ``keys`` (one lane per
-        key, in order). Returns None if any key is not resident.
+    def _entries_locked(self, keys: list):
+        entries = []
+        for key in keys:
+            e = self._od.get(key)
+            if e is None:
+                return None
+            self._od.move_to_end(key)
+            entries.append(e)
+        return entries
 
-        Validates every page index against the pool extent BEFORE the
-        device gather — a corrupt page table raises ResidentPoolError
-        rather than reading out-of-bounds rows (jnp indexing would clamp
-        silently, turning corruption into wrong results)."""
+    def _check_entry(self, e: ResidentEntry) -> None:
+        # entries are immutable NamedTuples and options never change, so
+        # validation needs no lock — plan_chunked runs this O(lanes ×
+        # pages) walk AFTER releasing the table lock (a 64k-lane bench
+        # scan must not block writers/invalidations for its duration)
+        o = self.options
+        n = len(e.pages)
+        if n > o.max_lane_pages:
+            raise ResidentPoolError(
+                f"page table entry spans {n} pages > limit {o.max_lane_pages}"
+            )
+        if n * o.page_words * 32 < e.num_bits:
+            raise ResidentPoolError(
+                f"page table entry holds {e.num_bits} bits in {n} pages "
+                f"of {o.page_words * 32} bits"
+            )
+        for p in e.pages:
+            if not 0 < p < o.num_pages:
+                raise ResidentPoolError(
+                    f"corrupt page index {p} (pool has {o.num_pages} pages)"
+                )
+        for p in e.side_pages:
+            if not 0 < p < o.num_side_pages:
+                raise ResidentPoolError(
+                    f"corrupt side page index {p} "
+                    f"(pool has {o.num_side_pages} side pages)"
+                )
+        if e.n_chunks > len(e.side_pages) * o.side_page_chunks:
+            raise ResidentPoolError(
+                f"side table holds {e.n_chunks} chunks in "
+                f"{len(e.side_pages)} side pages"
+            )
+
+    def plan_chunked(self, keys: list) -> "ResidentChunkedPlan | None":
+        """Assemble the CHUNK-parallel device gather inputs for ``keys``:
+        page rows + side-page rows + per-series chunk counts, everything
+        the device-side lane assembly (parallel/scan.py
+        assemble_resident_lanes) needs to build a ChunkedBatch-shaped
+        view by gather — O(series) host ints, no chunk table rebuild.
+
+        Returns None when any key is not resident, lacks side planes, or
+        the entries mix chunk sizes (the caller falls back to the
+        streamed path). Callers hold read_lease() across plan + use."""
+        from ..ops.chunked import window_words
+
         o = self.options
         with self._lock:
-            if not self.enabled or self._words is None:
+            if not self.enabled or self._words is None or self._side is None:
                 return None
-            entries = []
-            for key in keys:
-                e = self._od.get(key)
-                if e is None:
-                    return None
-                self._od.move_to_end(key)
-                entries.append(e)
+            entries = self._entries_locked(keys)
+            if entries is None:
+                return None
             words = self._words
-        num_pages = o.num_pages
-        max_lane = 1
+            side = self._side
         for e in entries:
-            n = len(e.pages)
-            if n > o.max_lane_pages:
-                raise ResidentPoolError(
-                    f"page table entry spans {n} pages > limit {o.max_lane_pages}"
-                )
-            if n * o.page_words * 32 < e.num_bits:
-                raise ResidentPoolError(
-                    f"page table entry holds {e.num_bits} bits in {n} pages "
-                    f"of {o.page_words * 32} bits"
-                )
-            max_lane = max(max_lane, n)
+            self._check_entry(e)
+        k = 0
+        for e in entries:
+            if e.n_chunks <= 0 or not e.side_pages:
+                return None  # admitted without side planes
+            if k == 0:
+                k = e.chunk_k
+            elif e.chunk_k != k:
+                return None  # mixed chunk sizes: shapes would disagree
+        if k <= 0:
+            return None
         s = len(entries)
-        # +1 trailing zero-page column: the decoder's 4-word lookahead past
-        # a lane's last stream word then reads zeros, bit-identical to
-        # BatchedSegments' pad words
-        rows = np.zeros((s, max_lane + 1), np.int32)
-        num_bits = np.zeros(s, np.int32)
-        units = np.zeros(s, np.int32)
-        num_points = 0
+        c = max(e.n_chunks for e in entries)
+        cw = window_words(max(e.max_span_bits for e in entries))
+        # trailing zero-page columns so a window starting in the last
+        # stream word can read its full cw span + alignment from zeros
+        extra = -(-cw // o.page_words) + 1
+        lp = max(len(e.pages) for e in entries) + extra
+        sl = max(len(e.side_pages) for e in entries)
+        page_rows = np.zeros((s, lp), np.int32)
+        side_rows = np.zeros((s, sl), np.int32)
+        n_chunks = np.zeros(s, np.int32)
+        total_bits = np.zeros(s, np.int32)
         for i, e in enumerate(entries):
-            for j, p in enumerate(e.pages):
-                if not 0 < p < num_pages:
-                    raise ResidentPoolError(
-                        f"corrupt page index {p} (pool has {num_pages} pages)"
-                    )
-                rows[i, j] = p
-            num_bits[i] = e.num_bits
-            units[i] = e.initial_unit
-            num_points = max(num_points, e.num_points)
-        return ResidentScanPlan(
+            page_rows[i, : len(e.pages)] = e.pages
+            side_rows[i, : len(e.side_pages)] = e.side_pages
+            n_chunks[i] = e.n_chunks
+            total_bits[i] = e.num_bits
+        return ResidentChunkedPlan(
             words=words,
-            page_rows=rows,
-            num_bits=num_bits,
-            initial_unit=units,
-            max_points=max(num_points, 1),
+            side=side,
+            page_rows=page_rows,
+            side_rows=side_rows,
+            n_chunks=n_chunks,
+            total_bits=total_bits,
+            chunk_k=k,
+            num_chunks=c,
+            window_words=cw,
+            page_words=o.page_words,
+            side_page_chunks=o.side_page_chunks,
         )
 
     # ---------- invalidation surface (cache/invalidation.py drives this) ----------
@@ -527,34 +957,73 @@ class ResidentPool:
             n = len(self._od)
             for entry in self._od.values():
                 self._free.extend(entry.pages)
+                self._free_side.extend(entry.side_pages)
             self._resident_bytes = 0
             self._od.clear()
             self._by_series.clear()
             self._by_block.clear()
             self._complete.clear()
+            self._span_incomplete.clear()
+            self._budget_deferred.clear()
             self.invalidations += n
             self._m_invalidations.inc(n)
             self._publish_locked()
             return n
 
+    def _reset_locked(self) -> None:
+        """Last-resort recovery for a failed DONATED scatter: the old
+        buffer may already be deleted, so every entry — published and
+        pending — points into an unusable array. Drop the whole table,
+        rebuild the free lists, and null the buffers (lazily re-zeroed
+        on next use); read-through re-admission repopulates the hot set.
+        Counted as invalidations, never silent."""
+        n = len(self._od)
+        self._od.clear()
+        self._pending.clear()
+        self._by_series.clear()
+        self._by_block.clear()
+        self._complete.clear()
+        self._span_incomplete.clear()
+        self._budget_deferred.clear()
+        self._free = list(range(self.options.num_pages - 1, 0, -1))
+        self._free_side = list(range(self.options.num_side_pages - 1, 0, -1))
+        self._resident_bytes = 0
+        self._words = None
+        self._side = None
+        self.epoch += 1
+        self._generation += 1
+        self.invalidations += n
+        self._m_invalidations.inc(n)
+        self._publish_locked()
+
     def _drop_pending_locked(self, match) -> None:
         """Drop matching in-flight admissions so stale data never
-        publishes. Their pages stay OFF the free list — the admitting
+        publishes. Their pages stay OFF the free lists — the admitting
         thread owns them and reclaims at publish time (the scatter may
         still be writing them)."""
         for key in [k for k in self._pending if match(k)]:
             del self._pending[key]
 
     def _drop_complete_locked(self, namespace, shard_id, block_start, below_volume) -> None:
+        for markers in (self._complete, self._span_incomplete):
+            for g in [
+                g
+                for g in markers
+                if g[0] == namespace
+                and g[1] == shard_id
+                and g[2] == block_start
+                and (below_volume is None or g[3] < below_volume)
+            ]:
+                markers.discard(g)
         for g in [
             g
-            for g in self._complete
+            for g in self._budget_deferred
             if g[0] == namespace
             and g[1] == shard_id
             and g[2] == block_start
             and (below_volume is None or g[3] < below_volume)
         ]:
-            self._complete.discard(g)
+            del self._budget_deferred[g]
 
     def _drop_locked(self, keys) -> int:
         if not keys:
@@ -566,6 +1035,7 @@ class ResidentPool:
                 continue
             self._unindex_locked(key, entry)
             self._free.extend(entry.pages)
+            self._free_side.extend(entry.side_pages)
             self._resident_bytes -= entry.nbytes
             dropped += 1
         self.invalidations += dropped
@@ -596,16 +1066,19 @@ class ResidentPool:
 
     def _publish_locked(self) -> None:
         used = self.options.num_pages - 1 - len(self._free)
+        side_used = self.options.num_side_pages - 1 - len(self._free_side)
         self._g_bytes.set(float(self._resident_bytes))
         self._g_pages.set(float(used))
         self._g_free.set(float(len(self._free)))
         self._g_entries.set(float(len(self._od)))
+        self._g_side_pages.set(float(side_used))
         self._g_occupancy.set(used / max(self.options.num_pages - 1, 1))
 
     def stats(self) -> dict:
         with self._lock:
             o = self.options
             used_pages = o.num_pages - 1 - len(self._free)
+            side_used = o.num_side_pages - 1 - len(self._free_side)
             resident_bytes = self._resident_bytes
             return {
                 "enabled": self.enabled,
@@ -616,34 +1089,58 @@ class ResidentPool:
                 "pages_used": used_pages,
                 "pages_total": max(o.num_pages - 1, 0),
                 "occupancy": used_pages / max(o.num_pages - 1, 1),
+                "side_pages_used": side_used,
+                "side_pages_total": max(o.num_side_pages - 1, 0),
+                "side_page_bytes": o.side_page_bytes,
                 "complete_blocks": len(self._complete),
                 "admissions": self.admissions,
                 "rejections": self.rejections,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
                 "upload_bytes": self.upload_bytes,
+                "readmissions": self.readmissions,
+                "inplace_admissions": self.inplace_admissions,
+                "copy_admissions": self.copy_admissions,
+                "epoch": self.epoch,
                 "shard_heat": self.heat.dump(),
             }
 
 
-class ResidentScanPlan(NamedTuple):
-    """Device gather inputs for one resident scan (pool.plan_scan)."""
+class ResidentChunkedPlan(NamedTuple):
+    """Chunk-parallel device gather inputs (pool.plan_chunked): the
+    host-side part is O(series) int vectors; windows and per-chunk lane
+    metadata assemble ON DEVICE from ``words`` + ``side``."""
 
     words: object  # device uint32[num_pages, page_words]
-    page_rows: np.ndarray  # int32[S, L] page index per lane slot (0 = zero page)
-    num_bits: np.ndarray  # int32[S]
-    initial_unit: np.ndarray  # int32[S]
-    max_points: int
+    side: object  # device uint32[num_side_pages, spc, N_SIDE_PLANES]
+    page_rows: np.ndarray  # int32[S, LP] incl. trailing zero-page columns
+    side_rows: np.ndarray  # int32[S, SL] side-page index per slot
+    n_chunks: np.ndarray  # int32[S]
+    total_bits: np.ndarray  # int32[S]
+    chunk_k: int  # records per chunk (uniform across the plan)
+    num_chunks: int  # C = max chunks per series
+    window_words: int  # cw (ops/chunked.window_words over max spans)
+    page_words: int
+    side_page_chunks: int
 
 
-def _scatter_pages(words, indices, staged):
-    """Functional page scatter (jitted lazily; module import stays light)."""
+def _scatter(buf, indices, staged, donate: bool):
+    """Page scatter (jitted lazily; module import stays light). The
+    donated variant aliases input to output — true in-place on backends
+    that support donation; jax silently falls back to a copy elsewhere."""
     import jax
 
-    global _SCATTER_JIT
+    global _SCATTER_JIT, _SCATTER_DONATE_JIT
+    if donate:
+        if _SCATTER_DONATE_JIT is None:
+            _SCATTER_DONATE_JIT = jax.jit(
+                lambda w, i, s: w.at[i].set(s), donate_argnums=(0,)
+            )
+        return _SCATTER_DONATE_JIT(buf, indices, staged)
     if _SCATTER_JIT is None:
         _SCATTER_JIT = jax.jit(lambda w, i, s: w.at[i].set(s))
-    return _SCATTER_JIT(words, indices, staged)
+    return _SCATTER_JIT(buf, indices, staged)
 
 
 _SCATTER_JIT = None
+_SCATTER_DONATE_JIT = None

@@ -54,6 +54,15 @@ def build_parser() -> argparse.ArgumentParser:
         "resident_stats debug op",
     )
     p.add_argument(
+        "--resident-side-bytes",
+        type=int,
+        default=0,
+        help="byte budget for the pool's per-chunk side planes (the "
+        "chunk-parallel decoder's device-resident metadata). Default 0 "
+        "sizes them to --resident-bytes — i.e. total pool HBM is up to "
+        "2x --resident-bytes; set this explicitly to cap it",
+    )
+    p.add_argument(
         "--index-device-bytes",
         type=int,
         default=0,
@@ -165,7 +174,9 @@ def main(argv=None) -> int:
             enabled=args.cache_bytes > 0, max_bytes=max(args.cache_bytes, 0)
         ),
         resident_options=ResidentOptions(
-            enabled=args.resident_bytes > 0, max_bytes=max(args.resident_bytes, 0)
+            enabled=args.resident_bytes > 0,
+            max_bytes=max(args.resident_bytes, 0),
+            side_bytes=max(args.resident_side_bytes, 0),
         ),
         index_device_options=IndexDeviceOptions(
             enabled=args.index_device_bytes > 0,

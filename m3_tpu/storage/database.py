@@ -316,12 +316,16 @@ class Shard:
             return keys, buffered
 
     def scan_segments(self, sid: bytes, start: int, end: int) -> list[tuple]:
-        """[(stream, datapoint_bound)] for the STREAMED scan path, in the
-        same lane order the resident path uses (filesets by block start,
-        then buffer buckets). Bounds come from fileset index entries
-        (n_chunks * chunk_k) / buffer write counts — an upper bound is
-        enough: extra decode steps land on done lanes and drop out of
-        every reduction."""
+        """[(stream, datapoint_bound, chunk_k)] for the STREAMED scan
+        path, in the same lane order the resident path uses (filesets by
+        block start, then buffer buckets). Bounds come from fileset index
+        entries (n_chunks * chunk_k) / buffer write counts — an upper
+        bound is enough: extra decode steps land on done lanes and drop
+        out of every reduction. chunk_k is the fileset's persisted chunkK
+        (the resident path decodes with it via the admitted side planes,
+        so the streamed twin must prescan with the SAME chunk size for
+        the bit-for-bit parity contract to hold); buffer buckets have no
+        fileset and report the default."""
         with self.lock:
             out: list[tuple] = []
             bsz = self.opts.block_size_nanos
@@ -336,7 +340,7 @@ class Shard:
                 if not stream:
                     continue
                 chunk_k = int(reader.info.get("chunkK", CHUNK_K))
-                out.append((stream, entry[3] * chunk_k))
+                out.append((stream, entry[3] * chunk_k, chunk_k))
             buf = self.series.get(sid)
             if buf is not None:
                 for bs in sorted(buf.buckets):
@@ -345,7 +349,7 @@ class Shard:
                     bucket = buf.buckets[bs]
                     stream = bucket.merged_stream()
                     if stream:
-                        out.append((stream, len(bucket.times)))
+                        out.append((stream, len(bucket.times), CHUNK_K))
             return out
 
     def warm_flush(self, flush_before_nanos: int) -> list[FilesetID]:
@@ -449,29 +453,53 @@ class Shard:
             )
         return payload
 
-    def _admit_payload(self, payload: list[tuple]) -> None:
+    def _admit_payload(self, payload: list[tuple], readmission: bool = False) -> int:
         """Seal-time residency admission, stage 2 (OUTSIDE the shard
         lock): the fileset read-back, staging-array build, host->device
         upload, and any first-shape XLA scatter compile must not stall
-        the shard's hot read/write path. The per-lane datapoint bound is
-        the index entry's n_chunks * chunk_k — the same bound the
-        streamed scan path derives, which keeps the two paths' decode
-        shapes (and f32 reduction trees) identical. Racing mutations stay
-        correct without the lock: a write landing between collect and
-        admit leaves buffered points that force the query router's
-        streamed fallback (buffer-overlay check), and a superseding flush
-        admits a HIGHER volume the router prefers; a retention expiry
-        racing in leaves only an unreachable entry that ages out of the
-        LRU."""
+        the shard's hot read/write path. Each lane rides with the
+        fileset's PERSISTED per-chunk side table (fs.side_table) so the
+        pool pages the chunk metadata into its device side planes without
+        re-running the prescan — the chunk-parallel resident decoder's
+        shapes then match the streamed path's exactly (same snapshots,
+        same chunk_k), which keeps the two paths' decode programs (and
+        f32 reduction trees) identical. Racing mutations stay correct
+        without the lock: a write landing between collect and admit
+        leaves buffered points that force the query router's streamed
+        fallback (buffer-overlay check), and a superseding flush admits a
+        HIGHER volume the router prefers; a retention expiry racing in
+        leaves only an unreachable entry that ages out of the LRU.
+        Returns the number of admitted lanes."""
+        admitted = 0
         for block_start, volume, reader, index, chunk_k in payload:
             items = []
             for sid, (_, _, _, n_chunks) in index.items():
                 stream = reader.stream(sid)
                 if stream:
-                    items.append((sid, stream, n_chunks * chunk_k))
-            self.pool.admit_block(
-                self.namespace, self.id, block_start, volume, items
+                    items.append(
+                        (sid, stream, n_chunks * chunk_k, reader.side_table(sid))
+                    )
+            res = self.pool.admit_block(
+                self.namespace, self.id, block_start, volume, items,
+                chunk_k=chunk_k, readmission=readmission,
             )
+            admitted += res.admitted
+        return admitted
+
+    def readmit_fileset(self, fid: FilesetID) -> int:
+        """Read-through re-admission: re-read one sealed fileset and
+        admit it into the resident pool, keeping the two-phase admission
+        discipline (collect under the shard lock, admit outside it) in
+        THIS layer — callers (query routing) never touch the shard's
+        lock or admission internals. Returns admitted lanes; 0 when
+        retention raced the fileset away (in EITHER phase: the admit
+        phase re-reads stream/side bytes off the fileset too)."""
+        try:
+            with self.lock:
+                payload = self._collect_admission_locked([fid])
+            return self._admit_payload(payload, readmission=True)
+        except FileNotFoundError:
+            return 0
 
     def tick(self, now_nanos: int) -> None:
         """shard.go:663 tickAndExpire: drop series/blocks past retention,
@@ -973,6 +1001,16 @@ class Database:
             "streamed_bytes": _M_STREAMED_BYTES.value,
         }
 
+    def resident_clear(self) -> int:
+        """Drop every resident entry (operator/debug surface — the wire
+        face lets tools/check_resident.py exercise eviction churn + the
+        read-through re-admission path against a live node). Returns the
+        number of entries dropped; duplicate-safe (clearing an empty pool
+        clears nothing)."""
+        if self.resident_pool is None:
+            return 0
+        return self.resident_pool.clear()
+
     def index_stats(self) -> dict:
         """Device-index-tier + postings-cache stats for debug/status
         endpoints (the `index_stats` wire op and /debug/dump's
@@ -1195,8 +1233,8 @@ class Database:
         forever. Admit discovered filesets NEWEST-first until the pool's
         budget pushes back (recency is the best eviction-order prior we
         have at boot; later flushes keep rotating newer blocks in via
-        LRU). Read-through re-admission of individually evicted blocks
-        is a ROADMAP open item."""
+        LRU); read-through re-admission (query/m3_storage.py) pulls back
+        anything demand proves hot after that."""
         pool = self.resident_pool
         if pool is None or not pool.enabled:
             return
@@ -1214,9 +1252,13 @@ class Database:
                 for sid, (_, _, _, n_chunks) in index.items():
                     stream = reader.stream(sid)
                     if stream:
-                        items.append((sid, stream, n_chunks * chunk_k))
+                        items.append(
+                            (sid, stream, n_chunks * chunk_k,
+                             reader.side_table(sid))
+                        )
                 res = pool.admit_block(
-                    shard.namespace, shard.id, block_start, volume, items
+                    shard.namespace, shard.id, block_start, volume, items,
+                    chunk_k=chunk_k,
                 )
                 if res.rejected_budget:
                     return  # budget full: the newest blocks are resident

@@ -64,6 +64,11 @@ def _random_series(rng, n_series, max_points=50):
 
 
 def _pool(max_bytes=1 << 20, page_words=16, **kw):
+    # tiny data budgets drive the eviction/accounting tests; give the
+    # side planes their own ample budget (with small side pages) so the
+    # DATA pages stay the binding constraint, as before PR 11
+    kw.setdefault("side_bytes", 1 << 20)
+    kw.setdefault("side_page_chunks", 4)
     return ResidentPool(ResidentOptions(max_bytes=max_bytes, page_words=page_words, **kw))
 
 
@@ -151,17 +156,17 @@ def test_corrupt_page_table_raises_not_out_of_bounds():
     # out-of-extent page index must raise, never clamp/wrap into a gather
     pool._od[key] = entry._replace(pages=(10**6,))
     with pytest.raises(ResidentPoolError):
-        pool.plan_scan([key])
+        pool.plan_chunked([key])
     # num_bits exceeding the page span is equally corrupt
     pool._od[key] = entry._replace(num_bits=10**9)
     with pytest.raises(ResidentPoolError):
-        pool.plan_scan([key])
+        pool.plan_chunked([key])
 
 
-def test_plan_scan_misses_return_none():
+def test_plan_chunked_misses_return_none():
     pool = _pool()
     pool.admit_block("ns", 0, T0, 0, [(b"s", _stream([1]), 32)])
-    assert pool.plan_scan([BlockKey("ns", 0, b"other", T0, 0)]) is None
+    assert pool.plan_chunked([BlockKey("ns", 0, b"other", T0, 0)]) is None
 
 
 # ---------- decode-from-HBM vs streamed: bit-exactness ----------
@@ -177,7 +182,7 @@ def test_scan_totals_bit_exact_vs_streamed_property():
         pool.admit_block("ns", 0, T0, 0, [(sid, s, b)])
         keys.append(BlockKey("ns", 0, sid, T0, 0))
     got = resident_scan_totals(pool, keys)
-    want = streamed_scan_totals(streams, bounds)
+    want = streamed_scan_totals(streams)
     # identical kernel + identical padded reduction shapes => bit equality
     assert np.array_equal(got.series_sum, want.series_sum)
     assert np.array_equal(got.series_count, want.series_count)
@@ -236,7 +241,7 @@ def test_scan_totals_err_lanes_stitch_to_host_codec():
         pool.admit_block("ns", 3, T0, 0, [(sid, s, b)])
         keys.append(BlockKey("ns", 3, sid, T0, 0))
     agg_r = resident_scan_totals(pool, keys)
-    agg_s = streamed_scan_totals(streams, bounds)
+    agg_s = streamed_scan_totals(streams)
     assert agg_r.series_err is not None and agg_r.series_err[1]
     assert agg_s.series_err is not None and agg_s.series_err[1]
     fixed_r = stitch_host_errors(agg_r, lambda i: streams[i])
@@ -286,6 +291,63 @@ def test_db_scan_totals_counts_annotated_fileset(resident_db):
     assert tot_streamed == {**tot_resident, "path": "streamed"}
 
 
+def test_db_scan_totals_parity_with_nondefault_chunk_k(resident_db):
+    """Bit-for-bit parity must survive a fileset persisted with a
+    non-default chunkK: the streamed fallback prescans with the
+    FILESET's chunk size (scan_segments reports it alongside each
+    stream), so its chunk decomposition — and hence the f32
+    partial-sum reduction order behind the totals — matches the
+    resident path's side-plane decode exactly. Regression: the default
+    CHUNK_K here would group the 40 points into 2 chunks instead of 3
+    and drift the sum's low bits (verified to discriminate for this
+    value pattern)."""
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.query.promql import Matcher
+    from m3_tpu.rules.rules import encode_tags_id
+    from m3_tpu.storage.fs import FilesetID, write_fileset
+
+    db = resident_db
+    tags = ((b"__name__", b"g"), (b"s", b"000"))
+    sid = encode_tags_id(tags)
+    rng = np.random.default_rng(1)  # seed chosen: k=16 vs k=32 sums differ
+    db.write_tagged("ns", tags, T0, 1.0)
+    db.write_batch(
+        "ns",
+        [
+            # magnitudes spanning 1e-3..1e7 with sign flips: any change
+            # in the chunk grouping shows in the f32 sum's bit pattern
+            (sid, T0 + (j + 1) * NANOS, (-1.0) ** j * float(10.0 ** rng.integers(-3, 8)))
+            for j in range(39)
+        ],
+    )
+    db.flush("ns", T0 + 4 * 3600 * NANOS)
+    ns = db.namespaces["ns"]
+    bsz = ns.opts.block_size_nanos
+    bs = (T0 // bsz) * bsz
+    shard = ns.shard_for(sid)
+    # supersede the sealed chunkK=32 volume with a bit-identical stream
+    # persisted at chunkK=16 (the cold-flush volume-bump shape)
+    fid0 = next(f for f in shard.filesets() if f.block_start == bs)
+    stream = shard.reader(fid0).stream(sid)
+    fid1 = FilesetID("ns", shard.id, bs, fid0.volume + 1)
+    with shard.lock:
+        write_fileset(db.base, fid1, {sid: stream}, bsz, 16)
+        shard._invalidate_filesets()
+        shard.invalidator.on_flush("ns", shard.id, [fid1])
+        payload = shard._collect_admission_locked([fid1])
+    shard._admit_payload(payload)
+    st = M3Storage(db, "ns")
+    m = [Matcher("__name__", "=", "g")]
+    span = (bs, bs + bsz)
+    tot_resident = st.scan_totals(m, *span)
+    assert tot_resident["path"] == "resident"
+    assert tot_resident["count"] == 40
+    db.resident_pool.clear()
+    tot_streamed = st.scan_totals(m, *span)
+    assert tot_streamed["path"] == "streamed"
+    assert tot_streamed == {**tot_resident, "path": "streamed"}
+
+
 def test_sharded_resident_scan_matches_single_device():
     from m3_tpu.parallel.mesh import series_mesh
 
@@ -308,6 +370,243 @@ def test_sharded_resident_scan_matches_single_device():
     assert np.isclose(float(single.total_sum), float(sharded.total_sum), rtol=1e-5)
     assert float(single.total_min) == float(sharded.total_min)
     assert float(single.total_max) == float(sharded.total_max)
+
+
+def test_scan_totals_bit_exact_per_lane_class_property():
+    """Seeded per-class property sweep: the resident-chunked scan must be
+    bit-exact vs the streamed twin for EVERY lane class the classifier
+    emits — int-fast, float-fast, mixed, and annotated/err — not just the
+    mixed aggregate of the suite above (a specialization bug that flips
+    one class's kernel body would hide in a mixed batch)."""
+    from m3_tpu.codec.m3tsz import Encoder as Enc
+
+    rng = np.random.default_rng(1234)
+
+    def int_fast(n):  # steady int gauge: int-fast chunks
+        return _stream(rng.integers(0, 100, n).astype(np.float64))
+
+    def float_fast(n):  # true float series: float-fast chunks
+        return _stream(rng.standard_normal(n))
+
+    def annotated(n):
+        enc = Enc(T0)
+        t = T0
+        for j in range(n):
+            t += NANOS
+            enc.encode(t, float(j), annotation=b"a" if j == 1 else None)
+        return enc.stream()
+
+    for name, mk in (("int", int_fast), ("float", float_fast), ("ann", annotated)):
+        streams = [mk(int(rng.integers(2, 80))) for _ in range(9)]
+        bounds = [-(-len(decode(s)) // 32) * 32 for s in streams]
+        pool = _pool(max_bytes=4 << 20)
+        keys = []
+        for i, (s, b) in enumerate(zip(streams, bounds)):
+            sid = b"%s%03d" % (name.encode(), i)
+            pool.admit_block("ns", 0, T0, 0, [(sid, s, b)])
+            keys.append(BlockKey("ns", 0, sid, T0, 0))
+        got = resident_scan_totals(pool, keys)
+        want = streamed_scan_totals(streams)
+        assert np.array_equal(got.series_sum, want.series_sum), name
+        assert np.array_equal(got.series_count, want.series_count), name
+        assert np.array_equal(got.series_err, want.series_err), name
+        assert float(got.total_sum) == float(want.total_sum), name
+
+
+def test_eviction_mid_plan_scan_stays_consistent():
+    """A key evicted between two scans must flip the SECOND plan to None
+    (streamed fallback) while the first scan's lease-held snapshot stays
+    valid — never a half-resident result."""
+    pool = _pool(max_bytes=4 << 20)
+    streams = [_stream([1.0, 2.0]), _stream([3.0, 4.0])]
+    keys = []
+    for i, s in enumerate(streams):
+        sid = b"v%d" % i
+        pool.admit_block("ns", 0, T0, 0, [(sid, s, 32)])
+        keys.append(BlockKey("ns", 0, sid, T0, 0))
+    with pool.read_lease():
+        plan = pool.plan_chunked(keys)
+        assert plan is not None
+        # eviction lands while the scan's lease is active: the planned
+        # arrays (host int vectors + device buffer refs) stay usable
+        pool.invalidate_series_block("ns", 0, b"v1", T0)
+        from m3_tpu.parallel.scan import assemble_resident_packed
+
+        (w4, l4, tf), s_pad = assemble_resident_packed(plan, 8)
+        assert w4.shape[0] >= 1  # assembly from the snapshot still works
+    assert pool.plan_chunked(keys) is None  # next scan must re-route
+    got = resident_scan_totals(pool, keys)
+    assert got is None
+
+
+def test_side_planes_live_and_die_with_pages():
+    """Side-plane lifecycle: admission allocates side pages, every drop
+    path (evict, invalidate, clear) frees them with the data pages, and
+    the allocator balances back to zero."""
+    pool = _pool(max_bytes=1 << 20)
+    st0 = pool.stats()
+    assert st0["side_pages_used"] == 0 and st0["pages_used"] == 0
+    for i in range(6):
+        pool.admit_block("ns", 0, T0 + i, 0, [(b"s", _stream(range(40)), 64)])
+    st = pool.stats()
+    assert st["side_pages_used"] > 0 and st["pages_used"] > 0
+    entry = pool.get(BlockKey("ns", 0, b"s", T0 + 0, 0))
+    assert entry.side_pages and entry.n_chunks > 0
+    # invalidation drops side planes with the entry
+    pool.invalidate_series_block("ns", 0, b"s", T0 + 0)
+    st2 = pool.stats()
+    assert st2["side_pages_used"] < st["side_pages_used"]
+    # clear() balances the allocator to zero — pages AND side pages
+    pool.clear()
+    st3 = pool.stats()
+    assert st3["pages_used"] == 0
+    assert st3["side_pages_used"] == 0
+    assert st3["bytes"] == 0
+    assert len(pool._free) == pool.options.num_pages - 1
+    assert len(pool._free_side) == pool.options.num_side_pages - 1
+
+
+def test_admission_donates_inplace_unless_scan_lease_active():
+    """Scan/admit epoch fencing (carried from PR 3): an admission with no
+    active scan lease donates the buffers into the scatter (true
+    in-place); one racing an active lease falls back to the functional
+    copy so the lease holder's snapshot stays bit-stable."""
+    pool = _pool(max_bytes=1 << 20)
+    pool.admit_block("ns", 0, T0, 0, [(b"a", _stream([1.0]), 32)])
+    base = pool.stats()
+    assert base["inplace_admissions"] >= 1
+    assert base["copy_admissions"] == 0
+    key_a = BlockKey("ns", 0, b"a", T0, 0)
+    with pool.read_lease():
+        plan = pool.plan_chunked([key_a])
+        # admission racing the scan: must take the copy path
+        pool.admit_block("ns", 0, T0 + 1, 0, [(b"b", _stream([2.0]), 32)])
+        st = pool.stats()
+        assert st["copy_admissions"] == 1
+        assert st["inplace_admissions"] == base["inplace_admissions"]
+        # the leased snapshot still decodes scan-consistent totals
+        from m3_tpu.parallel.scan import assemble_resident_packed
+
+        assert plan is not None
+        assemble_resident_packed(plan, 8)
+    # lease released: admissions donate again
+    pool.admit_block("ns", 0, T0 + 2, 0, [(b"c", _stream([3.0]), 32)])
+    st2 = pool.stats()
+    assert st2["inplace_admissions"] == base["inplace_admissions"] + 1
+    # epoch bumps on every publish, fenced or copied
+    assert st2["epoch"] >= 3
+    # every path produced a readable entry
+    for sid in (b"a", b"b", b"c"):
+        ts_vs, err = resident_fetch_arrays(
+            pool, [BlockKey("ns", 0, sid, T0 + (sid[0] - ord("a")), 0)]
+        )
+        assert not err.any()
+
+
+def test_failed_upload_reclaims_pages_and_recovers(monkeypatch):
+    """A scatter that throws must not strand the batch's pages off the
+    free lists (functional path) nor leave entries pointing at a
+    donated, possibly-deleted buffer (donate path resets the pool
+    loudly). Either way the pool keeps working afterwards."""
+    import m3_tpu.resident.pool as pool_mod
+
+    real_scatter = pool_mod._scatter
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected scatter failure")
+
+    # functional-copy path (lease active): batch pages reclaimed,
+    # published entries survive
+    pool = _pool(max_bytes=1 << 20)
+    pool.admit_block("ns", 0, T0, 0, [(b"a", _stream([1.0]), 32)])
+    st0 = pool.stats()
+    with pool.read_lease():
+        monkeypatch.setattr(pool_mod, "_scatter", boom)
+        with pytest.raises(RuntimeError):
+            pool.admit_block("ns", 0, T0 + 1, 0, [(b"b", _stream([2.0]), 32)])
+        monkeypatch.setattr(pool_mod, "_scatter", real_scatter)
+    st = pool.stats()
+    assert len(pool) == 1  # prior entry intact
+    assert st["pages_used"] == st0["pages_used"]  # batch pages reclaimed
+    assert st["side_pages_used"] == st0["side_pages_used"]
+    assert BlockKey("ns", 0, b"b", T0 + 1, 0) not in pool
+    pool.admit_block("ns", 0, T0 + 2, 0, [(b"c", _stream([3.0]), 32)])
+    _ts_vs, err = resident_fetch_arrays(pool, [BlockKey("ns", 0, b"c", T0 + 2, 0)])
+    assert not err.any()
+
+    # donated path (no lease): the old buffer may already be deleted by
+    # the failed scatter — the pool resets (allocator rebuilt, table
+    # dropped) instead of bricking, and re-admission repopulates
+    monkeypatch.setattr(pool_mod, "_scatter", boom)
+    with pytest.raises(RuntimeError):
+        pool.admit_block("ns", 0, T0 + 3, 0, [(b"d", _stream([4.0]), 32)])
+    monkeypatch.setattr(pool_mod, "_scatter", real_scatter)
+    st2 = pool.stats()
+    assert len(pool) == 0
+    assert st2["pages_used"] == 0 and st2["side_pages_used"] == 0
+    assert len(pool._free) == pool.options.num_pages - 1
+    assert len(pool._free_side) == pool.options.num_side_pages - 1
+    res = pool.admit_block("ns", 0, T0 + 4, 0, [(b"e", _stream([5.0]), 32)])
+    assert res.admitted == 1 and res.complete
+    _ts_vs, err = resident_fetch_arrays(pool, [BlockKey("ns", 0, b"e", T0 + 4, 0)])
+    assert not err.any()
+
+
+def test_span_rejected_fileset_marked_never_completable():
+    """Read-through re-admission consults never_completable: a fileset
+    with a lane over max_lane_pages can never reach the complete marker,
+    so re-admitting it would re-upload the whole fileset on every
+    streamed query. A volume bump (new tuple) retries; invalidation
+    clears the marker."""
+    pool = _pool(max_bytes=1 << 20, page_words=16, max_lane_pages=2)
+    big = _stream(np.random.default_rng(0).standard_normal(500))
+    res = pool.admit_block(
+        "ns", 0, T0, 0, [(b"big", big, 512), (b"ok", _stream([1]), 32)]
+    )
+    assert res.rejected_span == 1
+    assert pool.never_completable("ns", 0, T0, 0)
+    assert not pool.never_completable("ns", 0, T0, 1)  # other volume
+    pool.invalidate_block("ns", 0, T0)
+    assert not pool.never_completable("ns", 0, T0, 0)
+
+
+def test_streamed_scan_bytes_counts_block_bytes():
+    """scan_streamed_bytes_total promises BLOCK bytes (the transfer the
+    resident path eliminates) — not the packed lane expansion, which
+    duplicates window words across chunks and would silently rescale
+    dashboards and heat comparisons several-fold."""
+    from m3_tpu.resident.scan import _M_STREAMED_BYTES, streamed_scan_totals
+
+    streams, _bounds, _ = _random_series(np.random.default_rng(5), 6)
+    before = _M_STREAMED_BYTES.value
+    streamed_scan_totals(streams)
+    assert _M_STREAMED_BYTES.value - before == sum(len(s) for s in streams)
+
+
+def test_explain_never_claims_resident_when_chunked_plan_fails(resident_db, monkeypatch):
+    """EXPLAIN routing must describe the path that actually served the
+    query: if the chunked plan fails AFTER the resident plan was built
+    (raced eviction / side-plane mismatch), the streamed fallback runs
+    and no 'resident-chunked' record may survive."""
+    import m3_tpu.resident.scan as rscan
+    from m3_tpu.query import stats as query_stats
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.query.promql import Matcher
+
+    db = resident_db
+    _ingest(db, seed=9)
+    db.flush("ns", T0 + 4 * 3600 * NANOS)
+    st = M3Storage(db, "ns")
+    m = [Matcher("__name__", "=", "g")]
+    monkeypatch.setattr(rscan, "resident_scan_totals", lambda *a, **kw: None)
+    qs = query_stats.start("explain-fallback-test")
+    qs.record_routing = True
+    tot = st.scan_totals(m, T0, T0 + 3600 * NANOS)
+    routing = [dict(r) for r in qs.routing]
+    query_stats.finish(qs, 0.0)
+    assert tot["path"] == "streamed"
+    assert all(r["path"] != "resident" for r in routing)
+    assert any("resident-plan-failed" in r["reason"] for r in routing)
 
 
 # ---------- storage integration: admit on seal, invalidation ----------
@@ -620,3 +919,152 @@ def test_eviction_forces_streamed_fallback_with_correct_results(tmp_path):
     assert resident["path"] == "resident" and streamed["path"] == "streamed"
     assert streamed == {**resident, "path": "streamed"}
     db.close()
+
+
+def test_streamed_fallback_readmits_sealed_blocks(resident_db):
+    """Read-through re-admission (carried from PR 3): a streamed-fallback
+    hit on sealed, complete blocks pulls them back into the pool —
+    counted in resident_readmissions_total — so the NEXT scan of the hot
+    set is resident again; buffered series stay out (their blocks would
+    stream regardless)."""
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.query.promql import Matcher
+
+    db = resident_db
+    sids = _ingest(db)
+    db.flush("ns", T0 + 4 * 3600 * NANOS)
+    pool = db.resident_pool
+    st = M3Storage(db, "ns")
+    m = [Matcher("__name__", "=", "g")]
+    span = (T0, T0 + 3600 * NANOS)
+    assert st.scan_totals(m, *span)["path"] == "resident"
+    # eviction churn: the whole hot set falls out of the pool
+    pool.clear()
+    assert pool.stats()["readmissions"] == 0
+    tot = st.scan_totals(m, *span)  # cold: streams, then re-admits
+    assert tot["path"] == "streamed"
+    assert pool.stats()["readmissions"] == len(sids)
+    # the hot set is resident again: next scan decodes from HBM, and
+    # repeated scans do not re-admit (already resident = no churn)
+    tot2 = st.scan_totals(m, *span)
+    assert tot2["path"] == "resident"
+    assert tot2 == {**tot, "path": "resident"}
+    assert pool.stats()["readmissions"] == len(sids)
+    # fetch-path fallback re-admits too
+    pool.clear()
+    st.fetch(m, *span)
+    assert pool.stats()["readmissions"] == 2 * len(sids)
+    # a buffered series does NOT trigger re-admission (its blocks would
+    # stream again regardless — the buffer-overlay rule); query ONLY the
+    # buffered series so no shard-mate doc re-admits its fileset
+    pool.clear()
+    db.write("ns", sids[0], T0 + 13 * NANOS, 7.0)
+    only = [Matcher("__name__", "=", "g"), Matcher("s", "=", "000")]
+    assert st.scan_totals(only, *span)["path"] == "streamed"
+    assert pool.stats()["readmissions"] == 2 * len(sids)
+    db.close()
+
+
+def test_readmission_skips_already_resident_lanes():
+    """Re-admission is fileset-granular (the complete marker needs the
+    whole group), but one evicted lane must NOT re-stage and re-upload
+    its still-resident shard-mates' bytes — those lanes are skipped in
+    place (LRU-touched, counted toward completeness)."""
+    pool = _pool(max_bytes=4 << 20)
+    items = [(b"r%d" % i, _stream([float(i), 2.0, 3.0]), 32) for i in range(3)]
+    res = pool.admit_block("ns", 0, T0, 0, items)
+    assert res.admitted == 3 and res.complete
+    up0 = pool.stats()["upload_bytes"]
+    # all three resident: a re-admission uploads NOTHING and still
+    # reports the group complete
+    res2 = pool.admit_block("ns", 0, T0, 0, items, readmission=True)
+    assert res2.admitted == 0 and res2.complete
+    assert pool.stats()["upload_bytes"] == up0
+    assert pool.stats()["readmissions"] == 0
+    # one lane evicted: only ITS bytes go back up
+    pool.invalidate_series_block("ns", 0, b"r1", T0)
+    res3 = pool.admit_block("ns", 0, T0, 0, items, readmission=True)
+    assert res3.admitted == 1 and res3.complete
+    delta = pool.stats()["upload_bytes"] - up0
+    assert 0 < delta < up0  # strictly less than re-uploading the fileset
+    assert pool.stats()["readmissions"] == 1
+    assert pool.is_complete("ns", 0, T0, 0)
+
+
+def test_budget_deferred_readmission_cooldown():
+    """A budget-rejected re-admission marks the fileset deferred until
+    pages free up: _maybe_readmit callers skip the whole-fileset disk
+    re-read while a retry is a guaranteed rejection, and the marker
+    self-heals on eviction (free list grows) or full re-admission."""
+    # random floats defeat the XOR compressor, so the lane spans several
+    # 64-byte pages; budget = page 0 (reserved) + one lane + ONE spare
+    # page, so a second identical lane can never fit without eviction
+    big = _stream(np.random.default_rng(0).standard_normal(40))
+    n_pages = -(-len(big) // 64)
+    assert n_pages >= 2
+    pool = _pool(max_bytes=(n_pages + 2) * 64, page_words=16)
+    ok = pool.admit_block("ns", 0, T0, 0, [(b"a", big, 64)])
+    assert ok.admitted == 1
+    # free list now too small for another 2-page lane; a re-admission
+    # rejects for budget and records the watermark
+    rej = pool.admit_block("ns", 0, T0 + 1, 0, [(b"b", big, 64)], readmission=True)
+    assert rej.rejected_budget == 1
+    assert pool.budget_deferred("ns", 0, T0 + 1, 0)
+    assert not pool.budget_deferred("ns", 0, T0, 0)  # only the rejected one
+    # eviction frees pages past the watermark: the cooldown lifts
+    pool.invalidate_block("ns", 0, T0)
+    assert not pool.budget_deferred("ns", 0, T0 + 1, 0)
+    # retry now succeeds and drops the marker for good
+    ok2 = pool.admit_block("ns", 0, T0 + 1, 0, [(b"b", big, 64)], readmission=True)
+    assert ok2.admitted == 1
+    assert not pool.budget_deferred("ns", 0, T0 + 1, 0)
+
+
+def test_resident_options_rejects_sub_page_budgets():
+    """A small positive budget in EITHER plane would pass a >=0 check
+    but leave the pool silently disabled (enabled needs >1 page per
+    plane, page 0 being reserved) — validate() must reject it loudly;
+    0 stays the explicit disable/derive convention."""
+    from m3_tpu.utils.config import ConfigError
+
+    ResidentOptions(max_bytes=1 << 20).validate()  # side 0 = derived: fine
+    with pytest.raises(ConfigError):
+        ResidentOptions(max_bytes=100).validate()
+    with pytest.raises(ConfigError):
+        ResidentOptions(max_bytes=1 << 20, side_bytes=100).validate()
+
+
+def test_readmission_failure_never_fails_the_query(resident_db, monkeypatch):
+    """Read-through re-admission is opportunistic: by the time it runs,
+    the streamed result is already computed. An admission failure (device
+    OOM near the pool budget is the realistic case, and on the
+    donated-scatter path it also resets the pool) must be counted — not
+    raised into a query whose answer is in hand."""
+    from m3_tpu.query import m3_storage as m3s
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.query.promql import Matcher
+    from m3_tpu.storage.database import Shard
+
+    db = resident_db
+    sids = _ingest(db)
+    db.flush("ns", T0 + 4 * 3600 * NANOS)
+    pool = db.resident_pool
+    st = M3Storage(db, "ns")
+    m = [Matcher("__name__", "=", "g")]
+    span = (T0, T0 + 3600 * NANOS)
+    pool.clear()
+
+    def boom(self, fid):
+        raise RuntimeError("RESOURCE_EXHAUSTED: device OOM")
+
+    monkeypatch.setattr(Shard, "readmit_fileset", boom)
+    before = m3s._M_READMIT_FAILURES.value
+    tot = st.scan_totals(m, *span)  # must serve, not raise
+    assert tot["path"] == "streamed"
+    assert tot["count"] == 8 * 40
+    assert m3s._M_READMIT_FAILURES.value == before + 1
+    assert pool.stats()["readmissions"] == 0
+    # fetch-path fallback takes the same guard
+    rows = st.fetch(m, *span)
+    assert len(rows) == len(sids)
+    assert m3s._M_READMIT_FAILURES.value == before + 2

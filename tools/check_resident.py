@@ -11,7 +11,16 @@ end-to-end:
   and reports ``path == "resident"`` with the exact datapoint count;
 - a REPEATED query still reports the resident path (resident_hit) and
   moves ZERO additional host->device block bytes (``upload_bytes`` and
-  ``streamed_bytes`` deltas are 0 between the two runs).
+  ``streamed_bytes`` deltas are 0 between the two runs);
+- the warm scan is served by the CHUNK-PARALLEL resident decoder: the
+  EXPLAIN routing record says ``resident-chunked`` for every (series,
+  block) — the routing reason is written by the code path that actually
+  ran (the totals' ``decoder`` field is a declared API constant and is
+  deliberately NOT asserted);
+- after ``resident_clear`` (operator eviction-churn surface) the next
+  scan streams ONCE and read-through re-admission pulls the hot set
+  back (``readmissions`` counter advances), after which repeated scans
+  hold ``streamed_bytes`` flat again.
 
 Exit code 0 = contract holds, 1 = violation.
 
@@ -117,6 +126,47 @@ def main() -> int:
         check(
             after.get("streamed_bytes", 0) == before.get("streamed_bytes", 0),
             "warm resident scan streamed zero block bytes",
+        )
+
+        # ---- chunked-path assertion: WHICH decoder served the warm scan ----
+        # the per-(series, block) routing REASON is the verification here:
+        # scan_totals' "decoder" field is a declared API constant (both
+        # paths dispatch the chunk-parallel kernels), so asserting on it
+        # would be false assurance — the routing records are written by
+        # the code path that actually ran
+        explained = node.scan_totals("resident", matchers, *span, explain=True)
+        routing = explained.get("routing") or []
+        check(len(routing) > 0, "EXPLAIN routing record present")
+        check(
+            all(
+                r["path"] == "resident" and r["reason"] == "resident-chunked"
+                for r in routing
+            ),
+            "every routed block served by the resident-chunked decoder",
+        )
+
+        # ---- eviction churn + read-through re-admission ----
+        dropped = node.resident_clear()
+        check(dropped.get("dropped", 0) >= N_SERIES, "resident_clear dropped entries")
+        cold = node.scan_totals("resident", matchers, *span)
+        check(cold.get("path") == "streamed", "post-clear scan streams")
+        check(cold.get("count") == first.get("count"), "post-clear count stable")
+        stats2 = node.resident_stats()
+        check(
+            stats2.get("readmissions", 0) >= N_SERIES,
+            f"streamed fallback re-admitted the hot set "
+            f"({stats2.get('readmissions')})",
+        )
+        rewarm = node.scan_totals("resident", matchers, *span)
+        check(rewarm.get("path") == "resident", "re-admitted scan is resident again")
+        before2 = node.resident_stats()
+        for _ in range(2):
+            again = node.scan_totals("resident", matchers, *span)
+            check(again.get("path") == "resident", "repeated post-readmission scan resident")
+        after2 = node.resident_stats()
+        check(
+            after2.get("streamed_bytes", 0) == before2.get("streamed_bytes", 0),
+            "streamed bytes flat across repeated scans after re-admission warmup",
         )
     finally:
         try:
