@@ -479,6 +479,110 @@ def bench_multitenant(rate=400.0, duration=5.0):
     )
 
 
+def bench_pipeline(n_series=None, on_tpu=False):
+    """Staged-vs-fused device-query-plan sweep (query/plan.py): an
+    in-process Database (resident pool + device index) seeded with the
+    dispatch-bound temporal shape — MANY short series, the monitoring
+    fleet profile where per-stage host overhead dominates device compute
+    — then the SAME ``rate(metric{job=~...}[w])`` query timed warm
+    through the fused one-dispatch plan and the staged executor
+    (plan.force_staged). Plan-compile/build time is excluded from the
+    steady-state percentiles and reported separately
+    (``plan_warmup_ms``). Acceptance: fused p50 <= 0.5x staged p50 on
+    CPU, with per-query profiled dispatch counts reported for both."""
+    import statistics
+    import tempfile
+    import time as _time
+
+    import numpy as _np
+
+    from m3_tpu.index.device.store import IndexDeviceOptions
+    from m3_tpu.query import plan as qplan
+    from m3_tpu.query import stats as qstats
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.resident.pool import ResidentOptions
+    from m3_tpu.rules.rules import encode_tags_id
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    n_series = n_series or (65536 if on_tpu else 8192)
+    n_points = 16
+    NANOS_ = 1_000_000_000
+    t0 = 1_600_000_000 * NANOS_
+    step = 10 * NANOS_
+    db = Database(
+        tempfile.mkdtemp(prefix="m3tpu-bench-pipe-"), num_shards=4,
+        commitlog_enabled=False,
+        resident_options=ResidentOptions(max_bytes=256 << 20),
+        index_device_options=IndexDeviceOptions(max_bytes=256 << 20),
+    )
+    db.create_namespace("bench", NamespaceOptions(block_size_nanos=3600 * NANOS_))
+    rng = _np.random.default_rng(0)
+    for i in range(n_series):
+        tags = ((b"__name__", b"bp"), (b"job", b"app%d" % (i % 4)),
+                (b"s", b"%06d" % i))
+        sid = encode_tags_id(tags)
+        db.write_tagged("bench", tags, t0, float(i % 7))
+        db.write_batch(
+            "bench",
+            [(sid, t0 + (j + 1) * step,
+              float(rng.integers(0, 50)) / 4.0) for j in range(n_points - 1)],
+        )
+    db.flush("bench", t0 + 4 * 3600 * NANOS_)
+    eng = Engine(M3Storage(db, "bench"))
+    query = 'rate(bp{job=~"app.*"}[2m])'
+    span = (t0 + 30 * NANOS_, t0 + (n_points - 1) * step, 30 * NANOS_)
+
+    def run(staged: bool):
+        st = qstats.start("bench")
+        try:
+            if staged:
+                with qplan.force_staged():
+                    eng.query_range(query, *span)
+            else:
+                eng.query_range(query, *span)
+        finally:
+            qstats.finish(st, 0.0)
+        return st
+
+    # warmup: plan build + every jit compile on BOTH paths, reported
+    # apart from steady state
+    w0 = _time.perf_counter()
+    run(staged=False)
+    plan_warmup_s = _time.perf_counter() - w0
+    w0 = _time.perf_counter()
+    run(staged=True)
+    staged_warmup_s = _time.perf_counter() - w0
+
+    def p50(staged: bool, iters=9):
+        ts = []
+        st = None
+        for _ in range(iters):
+            a = _time.perf_counter()
+            st = run(staged)
+            ts.append(_time.perf_counter() - a)
+        return statistics.median(ts), st
+
+    fused_p50, fused_st = p50(staged=False)
+    staged_p50, staged_st = p50(staged=True)
+    db.close()
+    return _rec(
+        "pipeline_fused_vs_staged",
+        staged_p50 / max(fused_p50, 1e-12),
+        "speedup",
+        series=n_series,
+        points=n_points,
+        fused_p50_ms=round(fused_p50 * 1e3, 3),
+        staged_p50_ms=round(staged_p50 * 1e3, 3),
+        ratio=round(fused_p50 / staged_p50, 4),
+        fused_dispatches=fused_st.device_dispatches,
+        staged_dispatches=staged_st.device_dispatches,
+        plan_hits=fused_st.plan_hits,
+        plan_warmup_ms=round(plan_warmup_s * 1e3, 1),
+        staged_warmup_ms=round(staged_warmup_s * 1e3, 1),
+    )
+
+
 def bench_compression(n_series=2000, n_points=720):
     """bytes/datapoint on a PRODUCTION-LIKE trace, next to the reference's
     1.45 bytes/dp production claim (docs/m3db/architecture/engine.md:11).
@@ -703,7 +807,8 @@ def main() -> None:
 
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--configs", default="1,2,3,4,5,mixed,scan,index,compression,tenants"
+        "--configs",
+        default="1,2,3,4,5,mixed,scan,index,compression,tenants,pipeline",
     )
     ap.add_argument("--series", type=int, default=0, help="override config-2 series")
     ap.add_argument("--out", default="PERF_r05.json")
@@ -744,6 +849,8 @@ def main() -> None:
         records.append(bench_compression())
     if "tenants" in want:
         records.append(bench_multitenant())
+    if "pipeline" in want:
+        records.append(bench_pipeline(on_tpu=on_tpu))
 
     # merge into an existing results file: re-running a subset of configs
     # replaces those records and keeps the rest

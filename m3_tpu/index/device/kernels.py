@@ -36,6 +36,15 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...utils.instrument import KernelProfiler
+
+# dispatch observability for the eager index kernels: compile attribution
+# plus the per-query device-dispatch count (query/stats.py seam) — the
+# staged index path pays one profiled launch per kernel here, while the
+# fused query plan (query/plan.py) inlines the traced bodies into its
+# single program
+PROFILER = KernelProfiler("index_device")
+
 # ---------- host-side key building / compare (shared definition) ----------
 
 
@@ -120,6 +129,39 @@ def _get_jit(name: str, builder):
     return fn
 
 
+def match_terms_traced(keys, lens, lo, hi, q_keys, q_lens):
+    """Traced batched-term-lookup body (shared by the eager
+    :func:`match_terms` wrapper and the fused query-plan program,
+    query/plan.py, which inlines it into ONE jit)."""
+    import jax.numpy as jnp
+
+    n = keys.shape[0]
+    n_iter = max(int(n).bit_length(), 1)
+    lo_v = jnp.where(q_lens < 0, 0, lo).astype(jnp.int32)
+    hi_v = jnp.where(q_lens < 0, 0, hi).astype(jnp.int32)
+    hi_orig = hi_v
+
+    def _lt(ak, al, bk, bl):
+        neq = ak != bk
+        any_neq = jnp.any(neq, axis=1)
+        idx = jnp.argmax(neq, axis=1)
+        aw = jnp.take_along_axis(ak, idx[:, None], axis=1)[:, 0]
+        bw = jnp.take_along_axis(bk, idx[:, None], axis=1)[:, 0]
+        return jnp.where(any_neq, aw < bw, al < bl)
+
+    for _ in range(n_iter):
+        active = lo_v < hi_v
+        mid = (lo_v + hi_v) // 2
+        midc = jnp.clip(mid, 0, n - 1)
+        go_right = _lt(keys[midc], lens[midc], q_keys, q_lens)
+        lo_v = jnp.where(active & go_right, mid + 1, lo_v)
+        hi_v = jnp.where(active & ~go_right, mid, hi_v)
+    pos = jnp.clip(lo_v, 0, n - 1)
+    eq = jnp.all(keys[pos] == q_keys, axis=1) & (lens[pos] == q_lens)
+    found = (lo_v < hi_orig) & eq
+    return jnp.where(found, lo_v, -1).astype(jnp.int32)
+
+
 def match_terms(keys, lens, lo, hi, q_keys, q_lens):
     """Batched term lookup: for each query row b, the GLOBAL term index
     of q_keys[b] within the sorted range [lo[b], hi[b]), or -1.
@@ -130,38 +172,11 @@ def match_terms(keys, lens, lo, hi, q_keys, q_lens):
     import jax
 
     def build():
-        def _fn(keys, lens, lo, hi, q_keys, q_lens):
-            import jax.numpy as jnp
+        return jax.jit(match_terms_traced)
 
-            n = keys.shape[0]
-            n_iter = max(int(n).bit_length(), 1)
-            lo_v = jnp.where(q_lens < 0, 0, lo).astype(jnp.int32)
-            hi_v = jnp.where(q_lens < 0, 0, hi).astype(jnp.int32)
-            hi_orig = hi_v
-
-            def _lt(ak, al, bk, bl):
-                neq = ak != bk
-                any_neq = jnp.any(neq, axis=1)
-                idx = jnp.argmax(neq, axis=1)
-                aw = jnp.take_along_axis(ak, idx[:, None], axis=1)[:, 0]
-                bw = jnp.take_along_axis(bk, idx[:, None], axis=1)[:, 0]
-                return jnp.where(any_neq, aw < bw, al < bl)
-
-            for _ in range(n_iter):
-                active = lo_v < hi_v
-                mid = (lo_v + hi_v) // 2
-                midc = jnp.clip(mid, 0, n - 1)
-                go_right = _lt(keys[midc], lens[midc], q_keys, q_lens)
-                lo_v = jnp.where(active & go_right, mid + 1, lo_v)
-                hi_v = jnp.where(active & ~go_right, mid, hi_v)
-            pos = jnp.clip(lo_v, 0, n - 1)
-            eq = jnp.all(keys[pos] == q_keys, axis=1) & (lens[pos] == q_lens)
-            found = (lo_v < hi_orig) & eq
-            return jnp.where(found, lo_v, -1).astype(jnp.int32)
-
-        return jax.jit(_fn)
-
-    return _get_jit("match", build)(keys, lens, lo, hi, q_keys, q_lens)
+    fn = _get_jit("match", build)
+    with PROFILER.dispatch(("match", tuple(q_keys.shape))) as d:
+        return d.done(fn(keys, lens, lo, hi, q_keys, q_lens))
 
 
 def bitmap_from_terms(post_idx, post_data, gis, n_words: int,
@@ -178,18 +193,7 @@ def bitmap_from_terms(post_idx, post_data, gis, n_words: int,
     import jax
 
     def build():
-        def _fn(post_idx, post_data, gis, data_start, n_words, slab):
-            import jax.numpy as jnp
-
-            valid = (gis >= 0).astype(jnp.int32)
-            gic = jnp.clip(gis, 0, max(post_idx.shape[0] - 1, 0))
-            starts = jnp.where(valid > 0, post_idx[gic, 0], 0)
-            ends = jnp.where(valid > 0, post_idx[gic, 1], 0)
-            return _mask_to_bitmap(
-                post_data, starts, ends, valid, n_words, data_start, slab
-            )
-
-        return jax.jit(_fn, static_argnums=(4, 5))
+        return jax.jit(bitmap_from_terms_traced, static_argnums=(4, 5))
 
     if post_idx.shape[0] == 0:
         return zero_bitmap(n_words)
@@ -197,9 +201,11 @@ def bitmap_from_terms(post_idx, post_data, gis, n_words: int,
         data_start, slab = 0, int(post_data.shape[0])
     import jax.numpy as jnp
 
-    return _get_jit("bm_terms", build)(
-        post_idx, post_data, gis, jnp.int32(data_start), n_words, slab
-    )
+    fn = _get_jit("bm_terms", build)
+    with PROFILER.dispatch(("bm_terms", tuple(gis.shape), n_words, slab)) as d:
+        return d.done(
+            fn(post_idx, post_data, gis, jnp.int32(data_start), n_words, slab)
+        )
 
 
 def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int,
@@ -211,21 +217,7 @@ def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int,
     import jax
 
     def build():
-        def _fn(post_idx, post_data, lo, hi, data_start, n_words, slab):
-            import jax.numpy as jnp
-
-            n = post_idx.shape[0]
-            sel = (jnp.arange(n, dtype=jnp.int32) >= lo) & (
-                jnp.arange(n, dtype=jnp.int32) < hi
-            )
-            valid = sel.astype(jnp.int32)
-            starts = jnp.where(sel, post_idx[:, 0], 0)
-            ends = jnp.where(sel, post_idx[:, 1], 0)
-            return _mask_to_bitmap(
-                post_data, starts, ends, valid, n_words, data_start, slab
-            )
-
-        return jax.jit(_fn, static_argnums=(5, 6))
+        return jax.jit(bitmap_from_term_range_traced, static_argnums=(5, 6))
 
     if post_idx.shape[0] == 0:
         return zero_bitmap(n_words)
@@ -233,8 +225,43 @@ def bitmap_from_term_range(post_idx, post_data, lo, hi, n_words: int,
         data_start, slab = 0, int(post_data.shape[0])
     import jax.numpy as jnp
 
-    return _get_jit("bm_range", build)(
-        post_idx, post_data, lo, hi, jnp.int32(data_start), n_words, slab
+    fn = _get_jit("bm_range", build)
+    with PROFILER.dispatch(("bm_range", n_words, slab)) as d:
+        return d.done(
+            fn(post_idx, post_data, lo, hi, jnp.int32(data_start), n_words, slab)
+        )
+
+
+def bitmap_from_terms_traced(post_idx, post_data, gis, data_start,
+                             n_words: int, slab: int):
+    """Traced body of :func:`bitmap_from_terms` (also inlined by the
+    fused query-plan program)."""
+    import jax.numpy as jnp
+
+    valid = (gis >= 0).astype(jnp.int32)
+    gic = jnp.clip(gis, 0, max(post_idx.shape[0] - 1, 0))
+    starts = jnp.where(valid > 0, post_idx[gic, 0], 0)
+    ends = jnp.where(valid > 0, post_idx[gic, 1], 0)
+    return _mask_to_bitmap(
+        post_data, starts, ends, valid, n_words, data_start, slab
+    )
+
+
+def bitmap_from_term_range_traced(post_idx, post_data, lo, hi, data_start,
+                                  n_words: int, slab: int):
+    """Traced body of :func:`bitmap_from_term_range` (also inlined by
+    the fused query-plan program)."""
+    import jax.numpy as jnp
+
+    n = post_idx.shape[0]
+    sel = (jnp.arange(n, dtype=jnp.int32) >= lo) & (
+        jnp.arange(n, dtype=jnp.int32) < hi
+    )
+    valid = sel.astype(jnp.int32)
+    starts = jnp.where(sel, post_idx[:, 0], 0)
+    ends = jnp.where(sel, post_idx[:, 1], 0)
+    return _mask_to_bitmap(
+        post_data, starts, ends, valid, n_words, data_start, slab
     )
 
 

@@ -94,6 +94,9 @@ class DeviceArrays:
         "term_keys", "term_lens", "post_idx", "post_data", "all_words",
         "fields", "k_words", "n_terms", "n_docs", "n_words", "nbytes",
         "host_keys", "host_lens", "dot_safe",
+        # weak-referenceable: the cross-segment match cache (batch.py)
+        # keys entries by arrays identity WITHOUT pinning the tier alive
+        "__weakref__",
     )
 
     def __init__(self, term_keys, term_lens, post_idx, post_data, all_words,
@@ -122,6 +125,40 @@ class DeviceArrays:
         # class must downgrade to the host-matched general path or the
         # two executors would disagree on exactly that term
         self.dot_safe = dot_safe
+
+
+def collect_leaves(query: Query):
+    """(leaves [(field, value)], order [(leaf, start_slot, n)], classes
+    {id(regexp leaf) -> classification}) for every term / literal-regexp
+    / alternation leaf of ``query`` — the batched-binary-search input.
+    Shared by the per-segment match below and the CROSS-segment batcher
+    (index/device/batch.py), which resolves all of a query's exact
+    leaves over every device-resident segment in ONE launch."""
+    leaves: list[tuple[bytes, bytes]] = []  # (field, value)
+    order: list[tuple[Query, int, int]] = []  # (leaf, start_slot, n)
+    classes: dict = {}
+
+    def walk(q: Query) -> None:
+        if isinstance(q, TermQuery):
+            order.append((q, len(leaves), 1))
+            leaves.append((q.field, q.value))
+        elif isinstance(q, RegexpQuery):
+            kind, val = classes[id(q)] = classify_regexp(q.pattern)
+            if kind == "literal":
+                order.append((q, len(leaves), 1))
+                leaves.append((q.field, val))
+            elif kind == "alternation":
+                order.append((q, len(leaves), len(val)))
+                for branch in val:
+                    leaves.append((q.field, branch))
+        elif isinstance(q, (ConjunctionQuery, DisjunctionQuery)):
+            for s in q.queries:
+                walk(s)
+        elif isinstance(q, NegationQuery):
+            walk(q.query)
+
+    walk(query)
+    return leaves, order, classes
 
 
 class DeviceSegment:
@@ -185,11 +222,17 @@ class DeviceSegment:
 
     # ---- device AST evaluation ----
 
-    def search_ast(self, query: Query) -> np.ndarray | None:
+    def search_ast(self, query: Query, prematched=None) -> np.ndarray | None:
         """Doc ids for the whole AST via device bitmaps — bit-identical
         to the host executor — or None to fall back (evicted / not
         admitted / unsupported node / device error). Never raises: a
-        device fault must degrade to the host path, not fail the query."""
+        device fault must degrade to the host path, not fail the query.
+
+        ``prematched``: (arrays, gis_map, classes) from the
+        cross-segment leaf batcher (index/device/batch.py) — used only
+        when its arrays snapshot is still THIS segment's tier (an
+        eviction/re-admission between batch and search falls back to a
+        private match, never to stale indices)."""
         from ...query import stats
 
         arrays = self._arrays
@@ -201,7 +244,10 @@ class DeviceSegment:
             return None
         try:
             note = {"host_regexp": False}
-            gis, classes = self._match_leaves(arrays, query)
+            if prematched is not None and prematched[0] is arrays:
+                gis, classes = prematched[1], prematched[2]
+            else:
+                gis, classes = self._match_leaves(arrays, query)
             bitmap = self._eval(arrays, query, gis, classes, note)
             words = np.asarray(bitmap)
         except _Unsupported:
@@ -236,41 +282,18 @@ class DeviceSegment:
         classification) for every term / literal-regexp / alternation
         leaf, resolved by one batched binary search. Patterns classify
         ONCE here; phase 2 reads the cached class."""
-        leaves: list[tuple[int, bytes, bytes]] = []  # (slot, field, value)
-        order: list[tuple[Query, int, int]] = []  # (leaf, start_slot, n)
-        classes: dict = {}
-
-        def walk(q: Query) -> None:
-            if isinstance(q, TermQuery):
-                order.append((q, len(leaves), 1))
-                leaves.append((len(leaves), q.field, q.value))
-            elif isinstance(q, RegexpQuery):
-                kind, val = classes[id(q)] = classify_regexp(q.pattern)
-                if kind == "literal":
-                    order.append((q, len(leaves), 1))
-                    leaves.append((len(leaves), q.field, val))
-                elif kind == "alternation":
-                    order.append((q, len(leaves), len(val)))
-                    for branch in val:
-                        leaves.append((len(leaves), q.field, branch))
-            elif isinstance(q, (ConjunctionQuery, DisjunctionQuery)):
-                for s in q.queries:
-                    walk(s)
-            elif isinstance(q, NegationQuery):
-                walk(q.query)
-
-        walk(query)
+        leaves, order, classes = collect_leaves(query)
         if not leaves:
             return {}, classes
         import jax.numpy as jnp
 
         b = len(leaves)
         b_pad = kernels.pad_pow2(b)
-        values = [v for _, _, v in leaves] + [b""] * (b_pad - b)
+        values = [v for _, v in leaves] + [b""] * (b_pad - b)
         q_keys, q_lens = kernels.build_query_keys(values, arrays.k_words)
         lo = np.zeros(b_pad, np.int32)
         hi = np.zeros(b_pad, np.int32)
-        for i, (_, field, _v) in enumerate(leaves):
+        for i, (field, _v) in enumerate(leaves):
             start, count = arrays.fields.get(field, (0, 0, 0, 0))[:2]
             lo[i], hi[i] = start, start + count
         gis = np.asarray(

@@ -134,7 +134,19 @@ class NamespaceIndex:
                 segs.extend(self.blocks[bs].segments)
         if force_host:
             segs = [getattr(s, "host", s) for s in segs]
-        docs = execute(segs, q, limit=limit, cache=self.postings_cache)
+        prematched = None
+        if not force_host:
+            # cross-segment batched leaf match: >1 device-resident
+            # segment in range resolves ALL exact leaves in ONE binary-
+            # search launch instead of one per segment (device/batch.py;
+            # best-effort — None falls back to per-segment launches)
+            device_segs = [s for s in segs if getattr(s, "resident", False)]
+            if len(device_segs) > 1:
+                from .device import batch
+
+                prematched = batch.prematch(device_segs, q)
+        docs = execute(segs, q, limit=limit, cache=self.postings_cache,
+                       prematched=prematched)
         exhaustive = limit is None or len(docs) < limit
         return QueryResult(docs=docs, exhaustive=exhaustive)
 

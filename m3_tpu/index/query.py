@@ -79,7 +79,7 @@ def neg(q: Query) -> NegationQuery:
     return NegationQuery(q)
 
 
-def search_segment(seg, query: Query, cache=None) -> np.ndarray:
+def search_segment(seg, query: Query, cache=None, prematched=None) -> np.ndarray:
     """Postings for one segment (search/searcher dispatch); sorted unique.
 
     A device-resident segment (index/device/segment.py DeviceSegment)
@@ -94,7 +94,10 @@ def search_segment(seg, query: Query, cache=None) -> np.ndarray:
     the LRU (postings_list_cache.go:59). The device path skips it — a
     bitmap recompute is cheaper than uploading a cached array back."""
     if hasattr(seg, "search_ast"):
-        out = seg.search_ast(query)
+        # ``prematched``: this segment's slice of the cross-segment
+        # batched leaf match (index/device/batch.py) — all exact leaves
+        # of the query resolved in ONE launch across segments
+        out = seg.search_ast(query, prematched=prematched)
         if out is not None:
             return out
         seg = seg.host  # transparent host fallback
@@ -275,12 +278,18 @@ class MatchedDocs:
                 yield docs[int(i)]
 
 
-def execute(segments, query: Query, limit: int | None = None, cache=None) -> MatchedDocs:
+def execute(segments, query: Query, limit: int | None = None, cache=None,
+            prematched=None) -> MatchedDocs:
     """search/executor: matched docs across segments as a LAZY sequence
     (docs dedupe by id — later segments don't re-emit ids already seen).
     Segments are searched lazily: once ``limit`` is reached, remaining
-    segments are never scanned."""
+    segments are never scanned. ``prematched`` maps id(segment) to its
+    slice of a cross-segment batched leaf match (device/batch.py)."""
+    pm = prematched or {}
     return MatchedDocs(
-        ((seg, search_segment(seg, query, cache)) for seg in segments),
+        (
+            (seg, search_segment(seg, query, cache, prematched=pm.get(id(seg))))
+            for seg in segments
+        ),
         limit=limit,
     )

@@ -372,6 +372,17 @@ class RemoteNode(RpcClient):
             end=end, explain=explain,
         )
 
+    def query_range(self, ns, query: str, start: int, end: int, step: int,
+                    force_staged: bool = False, explain: bool = False) -> dict:
+        """PromQL range evaluation on the node's LOCAL engine — the wire
+        face of the fused device query pipeline. Returns {"values",
+        "metas", "stats"}; ``force_staged`` is the bit-identity parity
+        probe, ``explain`` adds per-series routing to the stats record."""
+        return self._call(
+            "query_range", ns=ns, query=query, start=start, end=end,
+            step=step, force_staged=force_staged, explain=explain,
+        )
+
     def metrics(self) -> str:
         """Prometheus text exposition of the remote process (the universal
         scrape op every RpcServer answers via the middleware)."""

@@ -457,6 +457,73 @@ class NodeService:
             out["routing"] = list(st.routing)
         return out
 
+    def op_query_range(self, req):
+        """Local PromQL evaluation over this node's database — the wire
+        face of the one-dispatch fused query pipeline (query/plan.py).
+        The per-namespace engine is CACHED so the plan cache warms across
+        requests. ``force_staged`` runs the parity probe (device plans
+        disabled for this evaluation); the response carries the full
+        QueryStats record — deviceDispatches, plan hit/miss/fallback
+        counts, and (with ``explain``) per-series routing reasons — so
+        CI can assert a warm eligible query is exactly ONE dispatch and
+        bit-identical to the staged path."""
+        import time as _time
+
+        from ..query import plan as query_plan
+        from ..query import stats
+
+        eng = self._query_engine(req["ns"])
+        st = stats.start(f"wire:{req['query']}")
+        if st is not None:
+            st.namespace = str(req["ns"])
+            if req.get("explain"):
+                st.record_routing = True
+        t0 = _time.perf_counter()
+        err = None
+        try:
+            if req.get("force_staged"):
+                with query_plan.force_staged():
+                    r = eng.query_range(
+                        req["query"], req["start"], req["end"], req["step"]
+                    )
+            else:
+                r = eng.query_range(
+                    req["query"], req["start"], req["end"], req["step"]
+                )
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            if st is not None:
+                stats.finish(st, _time.perf_counter() - t0, error=err)
+        import numpy as np
+
+        values = np.asarray(r.values, np.float64)
+        return {
+            "values": [list(map(float, row)) for row in values],
+            "metas": [
+                [[bytes(k), bytes(v)] for k, v in m.tags] for m in r.metas
+            ],
+            "stats": st.to_dict() if st is not None else {},
+        }
+
+    def _query_engine(self, ns: str):
+        """Cached per-namespace Engine over the LOCAL database (bounded
+        by the namespaces the database actually serves, so wire input
+        can't grow the dict)."""
+        engines = getattr(self, "_query_engines", None)
+        if engines is None:
+            engines = self._query_engines = {}
+        eng = engines.get(ns)
+        if eng is None:
+            if ns not in self.db.namespaces:
+                raise ValueError(f"unknown namespace {ns!r}")
+            from ..query.engine import Engine
+            from ..query.m3_storage import M3Storage
+
+            eng = engines[ns] = Engine(M3Storage(self.db, ns))
+        return eng
+
     def op_owned_shards(self, req):
         return sorted(self.assigned_shards)
 

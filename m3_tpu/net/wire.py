@@ -214,7 +214,7 @@ IDEMPOTENT_OPS = frozenset(
         # data-plane reads + probes
         "health", "fetch", "fetch_blocks", "fetch_tagged", "query_ids",
         "aggregate_query", "stream_shard", "block_metadata",
-        "stream_series_blocks", "scan_totals", "owned_shards",
+        "stream_series_blocks", "scan_totals", "query_range", "owned_shards",
         # debug / observability ('profile' reads the process's folded
         # stack table — sampling continues regardless, duplicate-safe)
         "metrics", "traces", "cache_stats", "resident_stats", "index_stats",

@@ -1,0 +1,191 @@
+"""Packed per-chunk side-plane layout: 10 uint32 words per chunk.
+
+One chunk's decoder-state snapshot (ops/chunked.snapshot_stream) used to
+occupy 16 one-field-per-word uint32 planes in the resident pool's side
+buffer. The five 64-bit carries need their 10 words, but everything else
+is small: the bit offset fits 21 bits, ``prev_time`` is block-relative
+(a chunk's carry timestamp lies inside its block, so 44 bits of
+block-relative nanos cover any block up to ~4.8h), ``prev_delta`` is an
+inter-sample gap (45 bits ≈ 9.7h), and the mode/unit/classification
+fields fit a byte and change between them. Packing those into two words
+cuts the side-plane HBM footprint 37.5% at constant information — the
+ROADMAP item 1 residual — and the same layout rides the fileset ``side``
+file (v3) so admission stages rows without re-walking streams.
+
+Layout (word index -> contents, bit ranges high:low):
+
+====  =======================================================
+w0-1  ``prev_float_bits`` hi, lo
+w2-3  ``prev_xor`` hi, lo
+w4-5  ``int_val`` hi, lo
+w6    ``rel_prev_time`` bits 31:0  (prev_time - block_start)
+w7    ``prev_delta`` bits 31:0
+w8    ``off``[31:11] | ``time_unit``[10:8] | ``sig``[7:2] | ``flags``[1:0]
+w9    ``rel_prev_time`` bits 43:32 [31:20] | ``prev_delta`` bits
+      44:32 [19:7] | ``pt_zero``[6] | ``mult``[5:1] | ``is_float``[0]
+====  =======================================================
+
+``pt_zero`` disambiguates the first chunk's pristine carry
+(``prev_time == 0``, which block-relative storage cannot express) from a
+sample exactly at block start. ``flags`` keeps the v2 fast-chunk
+classification bits (1 = int-fast, 2 = float-fast).
+
+A snapshot any field of which overflows the packed ranges cannot be
+represented — :func:`pack_side_rows` returns ``None`` and the caller
+degrades that lane to the streamed decode path (admission counts it).
+The ranges hold for every stream the encoder emits at default settings;
+overflow needs a pathological block size or sample gap.
+
+All-zero rows (the reserved zero side page, padding lanes) unpack to the
+all-zero decoder state the streamed packer uses for padding lanes, so
+zero-page indirection keeps meaning "empty lane".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SIDE_WORDS = 10
+
+# packed field capacities (exclusive upper bounds)
+OFF_BITS = 21
+RT_BITS = 44  # block-relative prev_time
+PD_BITS = 45  # prev_delta
+TU_BITS, SIG_BITS, MULT_BITS = 3, 6, 5
+
+_M64 = (1 << 64) - 1
+_M32 = 0xFFFFFFFF
+
+
+def pack_side_row(p: dict, block_start: int):
+    """One snapshot dict -> tuple of 10 uint32 words, or None when any
+    field overflows the packed ranges (the lane then has no side planes
+    and decodes streamed)."""
+    off = int(p["off"])
+    tu = int(p["time_unit"])
+    sig = int(p["sig"])
+    mult = int(p["mult"])
+    pt = int(p["prev_time"]) & _M64
+    pd = int(p["prev_delta"]) & _M64
+    if (
+        off >= 1 << OFF_BITS
+        or tu >= 1 << TU_BITS
+        or sig >= 1 << SIG_BITS
+        or mult >= 1 << MULT_BITS
+        or pd >= 1 << PD_BITS
+    ):
+        return None
+    if pt == 0:
+        rel, ptz = 0, 1
+    else:
+        rel = pt - (int(block_start) & _M64)
+        ptz = 0
+        if rel < 0 or rel >= 1 << RT_BITS:
+            return None
+    pfb = int(p["prev_float_bits"]) & _M64
+    pxr = int(p["prev_xor"]) & _M64
+    iv = int(p["int_val"]) & _M64
+    flags = (1 if p.get("fast") else 0) | (2 if p.get("fast_float") else 0)
+    w8 = (off << 11) | (tu << 8) | (sig << 2) | flags
+    w9 = (
+        ((rel >> 32) << 20)
+        | ((pd >> 32) << 7)
+        | (ptz << 6)
+        | (mult << 1)
+        | int(bool(p["is_float"]))
+    )
+    return (
+        pfb >> 32, pfb & _M32,
+        pxr >> 32, pxr & _M32,
+        iv >> 32, iv & _M32,
+        rel & _M32,
+        pd & _M32,
+        w8, w9,
+    )
+
+
+def pack_side_rows(snaps: list, block_start: int) -> np.ndarray | None:
+    """Snapshot dicts -> uint32[n_chunks, SIDE_WORDS], or None when ANY
+    chunk overflows (side planes are all-or-nothing per lane: a partial
+    side table cannot seed the chunk-parallel decode)."""
+    rows = np.zeros((len(snaps), SIDE_WORDS), np.uint32)
+    for j, p in enumerate(snaps):
+        packed = pack_side_row(p, block_start)
+        if packed is None:
+            return None
+        rows[j] = packed
+    return rows
+
+
+def unpack_side_rows(rows: np.ndarray, block_start: int) -> list[dict]:
+    """Host inverse of :func:`pack_side_rows` (the fileset side-file v3
+    read path): packed rows -> snapshot dicts, bit-exact for every row
+    the packer accepted. ``span``/``total_bits`` are offset bookkeeping
+    the caller adds (storage/fs.side_table)."""
+    rows = np.asarray(rows, np.uint64)
+    out = []
+    for r in rows:
+        w8 = int(r[8])
+        w9 = int(r[9])
+        rel = ((w9 >> 20) << 32) | int(r[6])
+        ptz = (w9 >> 6) & 1
+        out.append(
+            dict(
+                off=w8 >> 11,
+                prev_time=0 if ptz else (int(block_start) + rel) & _M64,
+                prev_delta=(((w9 >> 7) & 0x1FFF) << 32) | int(r[7]),
+                prev_float_bits=(int(r[0]) << 32) | int(r[1]),
+                prev_xor=(int(r[2]) << 32) | int(r[3]),
+                int_val=(int(r[4]) << 32) | int(r[5]),
+                time_unit=(w8 >> 8) & 7,
+                sig=(w8 >> 2) & 0x3F,
+                mult=(w9 >> 1) & 0x1F,
+                is_float=bool(w9 & 1),
+                fast=bool(w8 & 1),
+                fast_float=bool(w8 & 2),
+            )
+        )
+    return out
+
+
+def unpack_side_planes(side, block, valid):
+    """Device-side unpack: packed side rows -> the decoder-state lane
+    planes (ops/chunked.LANE_FIELDS names plus ``off``/``flags``).
+
+    ``side`` u32[N, SIDE_WORDS] gathered rows; ``block`` (hi, lo)
+    u32[N] per-lane block_start pair; ``valid`` bool[N]. Invalid lanes
+    zero every plane — bit-identical to the streamed packer's padding
+    lanes (all-zero state), whatever garbage the zero-page gather or the
+    block_start base would otherwise contribute.
+    """
+    import jax.numpy as jnp
+
+    from . import u64
+
+    U32 = jnp.uint32
+    z = jnp.zeros_like(side[:, 0])
+
+    def gate(x):
+        return jnp.where(valid, x, z.astype(x.dtype))
+
+    w8 = side[:, 8]
+    w9 = side[:, 9]
+    rel = (w9 >> U32(20), side[:, 6])
+    ptz = (w9 >> U32(6)) & U32(1)
+    pt = u64.add(rel, (gate(block[0]), gate(block[1])))
+    pt = u64.select(ptz != 0, (z, z), pt)
+    pd = ((w9 >> U32(7)) & U32(0x1FFF), side[:, 7])
+    planes = {
+        "off": gate(w8 >> U32(11)),
+        "prev_time": (gate(pt[0]), gate(pt[1])),
+        "prev_delta": (gate(pd[0]), gate(pd[1])),
+        "prev_float_bits": (gate(side[:, 0]), gate(side[:, 1])),
+        "prev_xor": (gate(side[:, 2]), gate(side[:, 3])),
+        "int_val": (gate(side[:, 4]), gate(side[:, 5])),
+        "time_unit": gate((w8 >> U32(8)) & U32(7)),
+        "sig": gate((w8 >> U32(2)) & U32(0x3F)),
+        "mult": gate((w9 >> U32(1)) & U32(0x1F)),
+        "is_float": gate(w9 & U32(1)),
+        "flags": gate(w8 & U32(3)),
+    }
+    return planes

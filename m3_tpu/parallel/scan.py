@@ -454,16 +454,18 @@ RESIDENT_CHUNKED_PROF = KernelProfiler("resident_chunked_assemble")
 
 
 def _resident_gather(pool_words, side_words, page_rows, side_rows,
-                     n_chunks, total_bits, si, ci, cw: int, w: int, spc: int):
+                     n_chunks, total_bits, block_hi, block_lo,
+                     si, ci, cw: int, w: int, spc: int):
     """Shared gather core for both lane layouts: (si, ci) lane->chunk
-    coordinate vectors -> (col, side [N, P], windows [N, CW], rel, nbits,
-    valid). Every array is built to be BIT-IDENTICAL to what
-    ops/chunked.assemble_chunked produces for the same streams (windows
-    zeroed on invalid lanes, zero side rows for padding) so the shared
-    decode programs yield bit-identical results."""
-    from ..resident.pool import SIDE_PLANES
+    coordinate vectors -> (planes dict, windows [N, CW], rel, nbits,
+    valid). ``planes`` are the decoder-state lane planes unpacked from
+    the packed 10-word side rows (ops/sideplane.py; prev_time re-based
+    off the per-series block_start pair). Every array is built to be
+    BIT-IDENTICAL to what ops/chunked.assemble_chunked produces for the
+    same streams (windows zeroed on invalid lanes, all-zero state for
+    padding) so the shared decode programs yield bit-identical results."""
+    from ..ops.sideplane import SIDE_WORDS, unpack_side_planes
 
-    col = {name: i for i, name in enumerate(SIDE_PLANES)}
     page_rows = jnp.asarray(page_rows, jnp.int32)
     side_rows = jnp.asarray(side_rows, jnp.int32)
     lp = page_rows.shape[1]
@@ -474,10 +476,15 @@ def _resident_gather(pool_words, side_words, page_rows, side_rows,
     sp = jnp.take(side_rows.reshape(-1), si * sl + jnp.where(valid, ci, 0) // spc)
     slot = jnp.where(valid, sp * spc + ci % spc, 0)
     side = jnp.take(
-        jnp.asarray(side_words, jnp.uint32).reshape(-1, len(SIDE_PLANES)),
+        jnp.asarray(side_words, jnp.uint32).reshape(-1, SIDE_WORDS),
         slot, axis=0,
-    )  # [N, N_SIDE_PLANES]
-    off = side[:, col["off"]].astype(jnp.int32)
+    )  # [N, SIDE_WORDS] packed rows
+    bs = (
+        jnp.asarray(block_hi, jnp.uint32)[si],
+        jnp.asarray(block_lo, jnp.uint32)[si],
+    )
+    planes = unpack_side_planes(side, bs, valid)
+    off = planes["off"].astype(jnp.int32)
     w0 = off >> 5
     rel = off & 31
     tb = jnp.asarray(total_bits, jnp.int32)[si]
@@ -492,11 +499,12 @@ def _resident_gather(pool_words, side_words, page_rows, side_rows,
         jnp.asarray(pool_words, jnp.uint32).reshape(-1), page * w + wabs % w
     )
     windows = jnp.where(valid[:, None], words, jnp.uint32(0))
-    return col, side, windows, rel, nbits, valid
+    return planes, windows, rel, nbits, valid
 
 
 def _assemble_resident_lanes_traced(pool_words, side_words, page_rows,
                                     side_rows, n_chunks, total_bits,
+                                    block_hi, block_lo,
                                     c: int, cw: int, w: int, spc: int) -> dict:
     """Traced body: resident plan arrays -> decode_chunked_lanes kwargs
     (series-major lane order, ChunkedBatch layout)."""
@@ -505,25 +513,24 @@ def _assemble_resident_lanes_traced(pool_words, side_words, page_rows,
     lane = jnp.arange(n, dtype=jnp.int32)
     si = lane // c
     ci = lane % c
-    col, side, windows, rel, nbits, valid = _resident_gather(
+    planes, windows, rel, nbits, valid = _resident_gather(
         pool_words, side_words, page_rows, side_rows, n_chunks, total_bits,
-        si, ci, cw, w, spc,
+        block_hi, block_lo, si, ci, cw, w, spc,
     )
-    pair = lambda name: (side[:, col[name + "_hi"]], side[:, col[name + "_lo"]])
     return dict(
         windows=windows,
         rel_pos=rel,
         num_bits=nbits,
         first=valid & (ci == 0),
-        prev_time=pair("prev_time"),
-        prev_delta=pair("prev_delta"),
-        prev_float_bits=pair("prev_float_bits"),
-        prev_xor=pair("prev_xor"),
-        int_val=pair("int_val"),
-        time_unit=side[:, col["time_unit"]].astype(jnp.int32),
-        sig=side[:, col["sig"]].astype(jnp.int32),
-        mult=side[:, col["mult"]].astype(jnp.int32),
-        is_float=side[:, col["is_float"]] != 0,
+        prev_time=planes["prev_time"],
+        prev_delta=planes["prev_delta"],
+        prev_float_bits=planes["prev_float_bits"],
+        prev_xor=planes["prev_xor"],
+        int_val=planes["int_val"],
+        time_unit=planes["time_unit"].astype(jnp.int32),
+        sig=planes["sig"].astype(jnp.int32),
+        mult=planes["mult"].astype(jnp.int32),
+        is_float=planes["is_float"] != 0,
     )
 
 
@@ -539,11 +546,11 @@ def assemble_resident_lanes(plan, s_pad: int | None = None) -> tuple[dict, int]:
     nbits 0) exactly like the streamed path's b"" padding streams."""
     s = plan.page_rows.shape[0]
     s_pad = s if s_pad is None else max(s_pad, s)
-    page_rows, side_rows, n_chunks, total_bits = pad_chunked_plan(plan, s_pad)
+    vecs = pad_chunked_plan(plan, s_pad)
     key = (s_pad, plan.num_chunks, plan.window_words)
     with RESIDENT_CHUNKED_PROF.dispatch(key) as d:
         lane_args = d.done(_assemble_resident_lanes_jit(
-            plan.words, plan.side, page_rows, side_rows, n_chunks, total_bits,
+            plan.words, plan.side, *vecs,
             c=plan.num_chunks, cw=plan.window_words, w=plan.page_words,
             spc=plan.side_page_chunks,
         ))
@@ -552,6 +559,7 @@ def assemble_resident_lanes(plan, s_pad: int | None = None) -> tuple[dict, int]:
 
 def _assemble_resident_packed_traced(pool_words, side_words, page_rows,
                                      side_rows, n_chunks, total_bits,
+                                     block_hi, block_lo,
                                      c: int, cw: int, w: int, spc: int,
                                      rows: int):
     """Traced body: resident plan arrays -> the packed kernel's layout
@@ -572,9 +580,9 @@ def _assemble_resident_packed_traced(pool_words, side_words, page_rows,
     inb = j < n
     si = jnp.where(inb, j % s, 0)
     ci = jnp.where(inb, j // s, c)  # padding lanes: ci==c is never valid
-    col, side, windows, rel, nbits, valid = _resident_gather(
+    planes, windows, rel, nbits, valid = _resident_gather(
         pool_words, side_words, page_rows, side_rows, n_chunks, total_bits,
-        si, ci, cw, w, spc,
+        block_hi, block_lo, si, ci, cw, w, spc,
     )
     first = valid & (ci == 0)
 
@@ -585,17 +593,21 @@ def _assemble_resident_packed_traced(pool_words, side_words, page_rows,
             return nbits.astype(jnp.uint32)
         if name == "first":
             return first.astype(jnp.uint32)
-        return side[:, col[name]]  # stored as uint32 already
+        if name.endswith("_hi"):
+            return planes[name[:-3]][0]
+        if name.endswith("_lo"):
+            return planes[name[:-3]][1]
+        return planes[name]  # unpacked as uint32 already
 
     lanes4 = jnp.stack([u32_plane(name) for name in PACKED_LANE_PLANES])
     lanes4 = lanes4.reshape(NLANE, tiles, rows, 128).transpose(1, 0, 2, 3)
     windows4 = windows.reshape(tiles, rows, 128, cw).transpose(0, 3, 1, 2)
-    # tile class from the v2 fast-chunk flags byte (side plane "flags"):
+    # tile class from the v2 fast-chunk flags bits (packed side word 8):
     # 1 = every lane int-fast, 2 = every lane float-fast, 0 = general.
     # First chunks decode the stream head the fast bodies don't implement;
     # invalid/padding lanes are wildcard-fast — both exactly as the host
     # packer classifies.
-    flags = side[:, col["flags"]]
+    flags = planes["flags"]
     fast_i = jnp.where(valid, ((flags & 1) != 0) & (ci != 0), True)
     fast_f = jnp.where(valid, ((flags & 2) != 0) & (ci != 0), True)
     int_tiles = jnp.all(fast_i.reshape(tiles, tile_lanes), axis=1)
@@ -620,11 +632,11 @@ def assemble_resident_packed(plan, s_pad: int | None = None):
 
     s = plan.page_rows.shape[0]
     s_pad = s if s_pad is None else max(s_pad, s)
-    page_rows, side_rows, n_chunks, total_bits = pad_chunked_plan(plan, s_pad)
+    vecs = pad_chunked_plan(plan, s_pad)
     key = ("packed", s_pad, plan.num_chunks, plan.window_words)
     with RESIDENT_CHUNKED_PROF.dispatch(key) as d:
         packed = d.done(_assemble_resident_packed_jit(
-            plan.words, plan.side, page_rows, side_rows, n_chunks, total_bits,
+            plan.words, plan.side, *vecs,
             c=plan.num_chunks, cw=plan.window_words, w=plan.page_words,
             spc=plan.side_page_chunks, rows=ROWS_DEFAULT,
         ))
@@ -632,12 +644,15 @@ def assemble_resident_packed(plan, s_pad: int | None = None):
 
 
 def pad_chunked_plan(plan, s_pad: int):
-    """Zero-pad a ResidentChunkedPlan's host vectors to ``s_pad`` series."""
+    """Zero-pad a ResidentChunkedPlan's host vectors to ``s_pad`` series.
+    Returns (page_rows, side_rows, n_chunks, total_bits, block_hi,
+    block_lo) — the positional array args of the assembly bodies."""
     import numpy as _np
 
     s = plan.page_rows.shape[0]
     if s_pad == s:
-        return plan.page_rows, plan.side_rows, plan.n_chunks, plan.total_bits
+        return (plan.page_rows, plan.side_rows, plan.n_chunks,
+                plan.total_bits, plan.block_hi, plan.block_lo)
     pr = _np.zeros((s_pad, plan.page_rows.shape[1]), _np.int32)
     pr[:s] = plan.page_rows
     sr = _np.zeros((s_pad, plan.side_rows.shape[1]), _np.int32)
@@ -646,7 +661,11 @@ def pad_chunked_plan(plan, s_pad: int):
     nc[:s] = plan.n_chunks
     tb = _np.zeros(s_pad, _np.int32)
     tb[:s] = plan.total_bits
-    return pr, sr, nc, tb
+    bh = _np.zeros(s_pad, _np.uint32)
+    bh[:s] = plan.block_hi
+    bl = _np.zeros(s_pad, _np.uint32)
+    bl[:s] = plan.block_lo
+    return pr, sr, nc, tb, bh, bl
 
 
 def resident_chunked_local_fn(c: int, k: int, cw: int, w: int, spc: int,
@@ -662,10 +681,12 @@ def resident_chunked_local_fn(c: int, k: int, cw: int, w: int, spc: int,
 
     interpret = jax.default_backend() != "tpu"
 
-    def local(pool_words, side_words, page_rows, side_rows, n_chunks, total_bits):
+    def local(pool_words, side_words, page_rows, side_rows, n_chunks,
+              total_bits, block_hi, block_lo):
         windows4, lanes4, tile_flags = _assemble_resident_packed_traced(
             pool_words, side_words, page_rows, side_rows, n_chunks,
-            total_bits, c=c, cw=cw, w=w, spc=spc, rows=ROWS_DEFAULT,
+            total_bits, block_hi, block_lo, c=c, cw=cw, w=w, spc=spc,
+            rows=ROWS_DEFAULT,
         )
         s_local = page_rows.shape[0]
         return chunked_scan_aggregate_packed(
@@ -691,7 +712,7 @@ def make_sharded_resident_chunked_scan(mesh, c: int, k: int, cw: int, w: int,
         local,
         mesh=mesh,
         in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                  P(SHARD_AXIS)),
+                  P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=ScanAggregates(
             series_sum=P(SHARD_AXIS),
             series_count=P(SHARD_AXIS),

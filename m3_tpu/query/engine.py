@@ -63,6 +63,24 @@ class Storage(Protocol):
         ...
 
 
+def consolidate_row(
+    times: np.ndarray, vals: np.ndarray, grid: np.ndarray,
+    lookback_nanos: int,
+) -> np.ndarray:
+    """ONE series' samples onto the step grid: value at step = last
+    sample in (t-lookback, t]. This is THE 'last' consolidation rule —
+    the fused device plan (query/plan.py) replicates it in-program and
+    the err-lane host stitch (m3_storage) reuses it directly, so the
+    bit-identity contract has exactly one host definition."""
+    if len(times) == 0:
+        return np.full(len(grid), np.nan)
+    idx = np.searchsorted(times, grid, side="right") - 1
+    ok = idx >= 0
+    sample_t = times[np.maximum(idx, 0)]
+    ok &= grid - sample_t < lookback_nanos
+    return np.where(ok, vals[np.maximum(idx, 0)], np.nan)
+
+
 def consolidate(
     series: list[tuple[Tags, np.ndarray, np.ndarray]],
     bounds: Bounds,
@@ -78,11 +96,7 @@ def consolidate(
         metas.append(SeriesMeta(tags=tags))
         if len(times) == 0:
             continue
-        idx = np.searchsorted(times, grid, side="right") - 1
-        ok = idx >= 0
-        sample_t = times[np.maximum(idx, 0)]
-        ok &= grid - sample_t < lookback_nanos
-        out[i] = np.where(ok, vals[np.maximum(idx, 0)], np.nan)
+        out[i] = consolidate_row(times, vals, grid, lookback_nanos)
     return Result(values=out, metas=metas)
 
 
@@ -257,6 +271,29 @@ class Engine:
             matchers.append(Matcher("__name__", "=", sel.name))
         from . import stats
 
+        b = Bounds(start, bounds.step_nanos, bounds.steps + extra_steps)
+        # one-dispatch fused pipeline (query/plan.py): when the storage
+        # adapter can serve fetch+consolidate as ONE device program it
+        # returns the finished step grid — bit-identical to the staged
+        # consolidate below — and the per-series host loops disappear.
+        # None = ineligible (reason recorded in EXPLAIN routing): run
+        # the staged path unchanged.
+        grid_fetch = getattr(self.storage, "fetch_grid", None)
+        if grid_fetch is not None:
+            with stats.stage("fetch"):
+                fused = grid_fetch(
+                    matchers, start - self.lookback, end, b.timestamps(),
+                    self.lookback,
+                )
+            if fused is not None:
+                # metas arrive as ready SeriesMeta (cached on the plan
+                # entry — matched set is invariant while the plan holds)
+                metas, values, datapoints = fused
+                stats.add(series=len(metas), datapoints=datapoints)
+                enforcer = getattr(self._enforcer, "current", None)
+                if enforcer is not None:
+                    enforcer.charge(len(metas), datapoints)
+                return Result(values, list(metas))
         with stats.stage("fetch"):
             raw = self.storage.fetch(matchers, start - self.lookback, end)
         stats.add(
@@ -267,7 +304,6 @@ class Engine:
             # charge fetched series + raw datapoints against the query's
             # cost limits (query/cost.go block accounting)
             enforcer.charge(len(raw), sum(len(t) for _, t, _ in raw))
-        b = Bounds(start, bounds.step_nanos, bounds.steps + extra_steps)
         return consolidate(raw, b, self.lookback)
 
     def _eval(self, e: Expr, bounds: Bounds) -> Result:

@@ -69,6 +69,164 @@ class M3Storage:
     db: Database
     namespace: str
 
+    @property
+    def planner(self):
+        """Lazy device query planner (query/plan.py) — one per adapter,
+        owning the LRU plan cache for this namespace."""
+        p = self.__dict__.get("_planner")
+        if p is None:
+            from .plan import Planner
+
+            p = self.__dict__["_planner"] = Planner(self.db, self.namespace)
+        return p
+
+    def fetch_grid(self, matchers, start_nanos, end_nanos, grid, lookback_nanos):
+        """One-dispatch fused fetch+consolidate (query/plan.py): matchers
+        resolve, decode, and consolidate onto the engine's step grid
+        inside ONE device program; the host only reconstructs f64 values
+        (the same finalize arithmetic as the staged path — bit-identical
+        results) and attaches tags. Returns a consolidated
+        ``(metas, values f64[S, T])`` or None to run the staged path —
+        every ineligibility cause lands in EXPLAIN routing.
+
+        ``grid`` is the engine's consolidation timestamp vector (i64
+        nanos); ``[start_nanos, end_nanos)`` the raw fetch window
+        (lookback included by the caller)."""
+        from . import stats
+        from .plan import Ineligible
+
+        try:
+            matched, values, datapoints, err_rows = self.planner.run(
+                matchers, start_nanos, end_nanos, grid, lookback_nanos
+            )
+        except Ineligible as e:
+            stats.add_routing(b"*", None, "staged", f"plan:{e.reason}")
+            if e.reason in ("force-staged", "plan-disabled"):
+                # deliberate bypasses (the parity probe, the kill
+                # switch) are not degradations: they must not pollute
+                # the fallback counters an operator alerts on
+                return None
+            self.planner.fallbacks += 1
+            from .plan import _M_FALLBACKS
+
+            _M_FALLBACKS.inc()
+            stats.add(plan_fallbacks=1)
+            # release plans stamped against state that has since moved
+            # (their pinned device tables + index arrays would otherwise
+            # linger until LRU displacement)
+            self.planner.evict_stale()
+            return None
+        except Exception:
+            # the staged path is always correct: a device-plan fault
+            # degrades, loudly, never fails the query
+            from .plan import _M_ERRORS, _M_FALLBACKS
+
+            _M_ERRORS.inc()
+            _M_FALLBACKS.inc()
+            self.planner.fallbacks += 1
+            stats.add(plan_fallbacks=1)
+            stats.add_routing(b"*", None, "staged", "plan:device-error")
+            return None
+        matched, metas = matched
+        if len(err_rows):
+            # lanes the device decoder bailed on (annotated streams):
+            # batched host re-read per block, consolidated with the same
+            # rule — EXPLAIN shows the hybrid per series
+            values = self._stitch_grid_rows(
+                matched, err_rows, values, start_nanos, end_nanos, grid,
+                lookback_nanos,
+            )
+        st = stats.current()
+        if st is not None and st.record_routing:
+            err_set = set(int(i) for i in err_rows)
+            for i, doc in enumerate(matched):
+                stats.add_routing(
+                    doc.id, None, "fused",
+                    "annotated-err-lane (host stitch)" if i in err_set
+                    else "device-plan",
+                )
+        nb = int(values.size) * 16  # times+values equivalent of the staged read
+        stats.add(resident_hits=1, bytes_=nb, resident_bytes=nb)
+        return metas, values, datapoints
+
+    def _stitch_grid_rows(self, matched, err_rows, values, start_nanos,
+                          end_nanos, grid, lookback_nanos):
+        """Host-consolidate the err rows from batched codec re-reads —
+        through the ONE shared 'last' consolidation rule
+        (engine.consolidate_row), so the hybrid rows cannot drift from
+        the staged path's."""
+        from .engine import consolidate_row
+
+        err_docs = [matched[int(i)] for i in err_rows]
+        arrays = self.host_stitch_arrays(err_docs, start_nanos, end_nanos)
+        values = np.array(values, copy=True)
+        for i, doc in zip(err_rows, err_docs):
+            t, v = arrays[doc.id]
+            values[int(i)] = consolidate_row(t, v, grid, lookback_nanos)
+        return values
+
+    def host_stitch_arrays(self, docs, start_nanos, end_nanos) -> dict:
+        """Batched host-codec re-read for lanes the device decoder bailed
+        on: ``doc.id -> (times i64, values f64)`` sliced to [start, end).
+
+        Streams are collected with ONE FilesetReader pass per fileset —
+        grouped by block, not one series at a time — so a handful of
+        annotated lanes can't serialize the fallback into per-series
+        reader/lock round trips. Decode then runs the same array path
+        Shard.read_arrays uses (native read, iterator fallback); callers
+        use this only where no buffer overlays the range (the residency
+        and plan gates exclude overlays), so fileset streams are the
+        whole truth."""
+        from ..codec.iterator import MultiReaderIterator
+        from ..codec.native_read import read_segments_arrays
+        from ..storage.fs import FilesetID
+
+        ns = self.db.namespaces[self.namespace]
+        bsz = ns.opts.block_size_nanos
+        per_series: dict[bytes, list] = {}
+        by_shard: dict[int, list] = {}
+        for doc in docs:
+            per_series[doc.id] = []
+            by_shard.setdefault(ns.shard_for(doc.id).id, []).append(doc.id)
+        for shard_id, sids in by_shard.items():
+            shard = ns.shards[shard_id]
+            # fileset order mirrors Shard._segments_locked (oldest-first
+            # listing order) so per-series segment order — and therefore
+            # decoded output — is identical to read_arrays
+            for fid in shard.filesets():
+                if (
+                    fid.block_start + bsz <= start_nanos
+                    or fid.block_start >= end_nanos
+                ):
+                    continue
+                reader = shard.reader(FilesetID(
+                    self.namespace, shard_id, fid.block_start, fid.volume
+                ))
+                for sid in sids:
+                    stream = reader.stream(sid)
+                    if stream:
+                        per_series[sid].append(stream)
+        out = {}
+        for doc in docs:
+            segs = per_series[doc.id]
+            arrs = read_segments_arrays(segs, start_nanos, end_nanos)
+            if arrs is not None:
+                out[doc.id] = (
+                    np.asarray(arrs[0], np.int64),
+                    np.asarray(arrs[1], np.float64),
+                )
+                continue
+            dps = [
+                dp
+                for dp in MultiReaderIterator(segs)
+                if start_nanos <= dp.timestamp < end_nanos
+            ]
+            out[doc.id] = (
+                np.asarray([dp.timestamp for dp in dps], np.int64),
+                np.asarray([dp.value for dp in dps], np.float64),
+            )
+        return out
+
     def fetch(self, matchers, start_nanos, end_nanos):
         from . import stats
 
@@ -323,6 +481,8 @@ class M3Storage:
             arrays, err = decoded
             out = []
             pos = 0
+            err_docs = []
+            err_slots: list[int] = []
             with query_stats.stage("decode"):
                 for doc, doc_keys in plan:
                     lanes = arrays[pos : pos + len(doc_keys)]
@@ -330,12 +490,14 @@ class M3Storage:
                     pos += len(doc_keys)
                     if lane_err.any():
                         # host re-read keeps Datapoint fidelity for lanes
-                        # the device can't decode; blocks are disjoint so a
-                        # full per-series host read replaces all its lanes
-                        t, v, _u = self.db.read_arrays(
-                            self.namespace, doc.id, start_nanos, end_nanos
-                        )
-                        out.append((doc.fields, np.asarray(t), np.asarray(v)))
+                        # the device can't decode; blocks are disjoint so
+                        # a full per-series host read replaces all its
+                        # lanes — collected here, read BATCHED per block
+                        # below so one bad lane doesn't serialize the
+                        # fallback into per-series reader round trips
+                        err_docs.append(doc)
+                        err_slots.append(len(out))
+                        out.append(None)
                         continue
                     if lanes:
                         times = np.concatenate([t for t, _ in lanes])
@@ -346,6 +508,13 @@ class M3Storage:
                     lo = int(np.searchsorted(times, start_nanos, side="left"))
                     hi = int(np.searchsorted(times, end_nanos, side="left"))
                     out.append((doc.fields, times[lo:hi], vals[lo:hi]))
+                if err_docs:
+                    stitched = self.host_stitch_arrays(
+                        err_docs, start_nanos, end_nanos
+                    )
+                    for slot, doc in zip(err_slots, err_docs):
+                        t, v = stitched[doc.id]
+                        out[slot] = (doc.fields, t, v)
             span.set_tag("series", len(out))
         return out
 

@@ -1,0 +1,836 @@
+"""Device query plans: a query is ONE XLA program.
+
+PROFILE.md's recurring villain is the host round trip — 8–15 ms of
+dispatch RTT dominating every sub-ms kernel — and the staged executor
+pays it 4–6 times per query because `query/m3_storage.py` stitches the
+stages with host-side types: the device index resolves doc ids to the
+host, the host walks per-doc block keys, the resident pool plans a
+gather, the decode dispatches, and consolidation runs a per-series
+Python loop. Every piece already lives on device; this module composes
+them inside ONE jit program per query *shape*:
+
+    term binary-search match  (index/device/kernels.match_terms_traced)
+      → postings bitmaps + bitwise AST set algebra (same kernels)
+      → matched-doc compaction (cumsum over the doc bitmap)
+      → per-lane page-table gather  (plan tables uploaded once per
+        (segment, block set) and cached)
+      → resident chunked decode  (parallel/scan assembly +
+        ops/chunked.decode_chunked_lanes, straight from the pool's
+        pages + packed side planes)
+      → step-grid consolidation  (vectorized binary search over the
+        decoded timestamps, u64-pair compares)
+
+The program returns the CONSOLIDATED grid as raw (hi, lo) value pairs
+plus validity masks; the host then runs the exact same float64
+reconstruction the staged path uses (ops/decode.finalize_decode math)
+and hands the grid to the unchanged engine pipeline (temporal
+functions, aggregations). Bit-identity with the staged path is
+therefore structural: both paths reconstruct values with the same f64
+arithmetic and pick grid samples with the same upper-bound rule — the
+property suite asserts exact equality, not tolerance.
+
+Plan cache: an LRU keyed by (namespace, matchers, block set, grid
+shape). Entries carry the uploaded plan-vector tables and revalidate
+per execution against pool eviction/invalidation counters, shard
+fileset epochs, and index-segment identity — a segment swap, volume
+bump, or resident eviction invalidates the plan (regression-tested).
+Ineligible queries fall back to the staged executor transparently with
+an EXPLAIN routing reason per cause (host-regexp leaf, non-resident
+block, buffer overlay, multi-segment index, ...).
+
+Knobs:
+
+    M3_TPU_QUERY_PLAN          "0" disables planning entirely
+    M3_TPU_QUERY_PLAN_CACHE    LRU entries (default 64)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+
+import numpy as np
+
+from ..index.device.kernels import pad_pow2
+from ..utils.instrument import DEFAULT as METRICS
+from ..utils.instrument import KernelProfiler
+
+_M_HITS = METRICS.counter(
+    "query_plan_hits_total",
+    "fetches served by a cached device query plan (one fused dispatch)",
+)
+_M_MISSES = METRICS.counter(
+    "query_plan_misses_total",
+    "device query plans built (cache miss: first sighting, or a stamp "
+    "mismatch after segment swap / volume bump / eviction)",
+)
+_M_FALLBACKS = METRICS.counter(
+    "query_plan_fallbacks_total",
+    "fetches that degraded to the staged executor (EXPLAIN records the "
+    "routing reason per cause)",
+)
+_M_COMPILES = METRICS.counter(
+    "query_plan_compiles_total",
+    "fused plan programs compiled (one per distinct query/plan shape)",
+)
+_M_ERRORS = METRICS.counter(
+    "query_plan_errors_total",
+    "device plan executions that raised and fell back staged (the "
+    "staged path is always correct; errors are counted, never surfaced)",
+)
+
+# the fused program's dispatch seam: compile attribution + sampled
+# wall-time under the SAME profiler contract as every other kernel, and
+# the per-query device_dispatches counter ticks here — exactly once per
+# plan-served fetch
+PROF = KernelProfiler("query_plan")
+
+_SENTINEL_GRID = 8  # minimum padded grid length
+
+
+def plan_enabled() -> bool:
+    return os.environ.get("M3_TPU_QUERY_PLAN", "1") != "0"
+
+
+def _cache_cap() -> int:
+    try:
+        return max(int(os.environ.get("M3_TPU_QUERY_PLAN_CACHE", "64")), 1)
+    except ValueError:
+        return 64
+
+
+# ---------------------------------------------------------------------------
+# force-staged probe (the bit-identity surface CI diffs against)
+# ---------------------------------------------------------------------------
+
+_FORCE = threading.local()
+
+
+@contextmanager
+def force_staged():
+    """Disable device plans for this thread's queries (the parity probe:
+    tools/check_pipeline.py runs every query twice, fused and
+    force-staged, and asserts bit-identical results)."""
+    prev = getattr(_FORCE, "on", False)
+    _FORCE.on = True
+    try:
+        yield
+    finally:
+        _FORCE.on = prev
+
+
+def staged_forced() -> bool:
+    return getattr(_FORCE, "on", False)
+
+
+class Ineligible(Exception):
+    """Query/plan state the fused pipeline does not cover — the caller
+    records ``reason`` in EXPLAIN routing and runs the staged path."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# AST shape extraction
+# ---------------------------------------------------------------------------
+
+
+def _ast_shape(q, arrays, leaves: list, ranges: list):
+    """Index query AST -> a hashable shape tree whose leaves reference
+    slots in ``leaves`` (exact-match values, one row each) and
+    ``ranges`` ((lo, hi) global term ranges, host-narrowed). Static
+    per-leaf postings-slab bounds ride the tree so the program builder
+    can bake them. Raises Ineligible for nodes the device cannot model
+    (general regexps keep their automaton on the host)."""
+    from ..index.device.segment import classify_regexp
+    from ..index.query import (
+        AllQuery,
+        ConjunctionQuery,
+        DisjunctionQuery,
+        FieldQuery,
+        NegationQuery,
+        RegexpQuery,
+        TermQuery,
+    )
+
+    def field_slab(field: bytes):
+        _, _, ds, de = arrays.fields.get(field, (0, 0, 0, 0))
+        from ..index.device import kernels
+
+        return ds, kernels.pad_pow2(de - ds)
+
+    def leaf(field: bytes, values: list):
+        slot = len(leaves)
+        leaves.extend((field, v) for v in values)
+        ds, slab = field_slab(field)
+        return ("terms", slot, len(values), ds, slab)
+
+    def rng(field: bytes, lo: int, hi: int):
+        ridx = len(ranges)
+        ranges.append((lo, hi))
+        ds, slab = field_slab(field)
+        return ("range", ridx, ds, slab)
+
+    def walk(node):
+        if isinstance(node, TermQuery):
+            return leaf(node.field, [node.value])
+        if isinstance(node, RegexpQuery):
+            kind, val = classify_regexp(node.pattern)
+            if kind == "literal":
+                return leaf(node.field, [val])
+            if kind == "alternation":
+                return leaf(node.field, list(val))
+            if kind == "prefix" and arrays.dot_safe:
+                start, count = arrays.fields.get(node.field, (0, 0, 0, 0))[:2]
+                lo, hi = _prefix_bounds(arrays, val, start, start + count)
+                return rng(node.field, lo, hi)
+            raise Ineligible("host-regexp-leaf")
+        if isinstance(node, FieldQuery):
+            start, count = arrays.fields.get(node.field, (0, 0, 0, 0))[:2]
+            return rng(node.field, start, start + count)
+        if isinstance(node, AllQuery):
+            return ("all",)
+        if isinstance(node, ConjunctionQuery):
+            pos = [walk(s) for s in node.queries
+                   if not isinstance(s, NegationQuery)]
+            negs = [walk(s.query) for s in node.queries
+                    if isinstance(s, NegationQuery)]
+            return ("and", tuple(pos), tuple(negs))
+        if isinstance(node, DisjunctionQuery):
+            return ("or", tuple(walk(s) for s in node.queries))
+        if isinstance(node, NegationQuery):
+            return ("not", walk(node.query))
+        raise Ineligible(f"unsupported-node:{type(node).__name__}")
+
+    return walk(q)
+
+
+def _prefix_bounds(arrays, prefix: bytes, lo: int, hi: int):
+    """Host prefix narrow over the key-matrix mirror — identical to
+    DeviceSegment._prefix_range (shared compare in kernels.py)."""
+    from ..index.device import kernels
+    from ..index.segment import prefix_upper
+
+    width = 4 * arrays.k_words
+    if len(prefix) > width:
+        return lo, lo
+    pk, pl = kernels.build_term_keys([prefix], arrays.k_words)
+    lo = kernels.host_lower_bound(
+        arrays.host_keys, arrays.host_lens, lo, hi, pk[0], int(pl[0])
+    )
+    up = prefix_upper(prefix)
+    if up is not None and len(up) <= width:
+        uk, ul = kernels.build_term_keys([up], arrays.k_words)
+        hi = kernels.host_lower_bound(
+            arrays.host_keys, arrays.host_lens, lo, hi, uk[0], int(ul[0])
+        )
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# the fused program (built once per shape, cached)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _build_program(ast, dims):
+    """ONE jitted program for a (query shape, plan shapes) class. ``ast``
+    is the hashable shape tree (leaf slots + static slab bounds baked
+    in); ``dims`` the static dimension tuple. Runtime VALUES (query
+    keys, range bounds, pool buffers, plan tables, grid) are inputs, so
+    one compilation serves every query of the same shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..index.device.kernels import (
+        bitmap_from_term_range_traced,
+        bitmap_from_terms_traced,
+        match_terms_traced,
+    )
+    from ..ops import u64
+    from ..ops.chunked import decode_chunked_lanes
+    from ..parallel.scan import _assemble_resident_lanes_traced
+
+    (n_words, n_docs_pad, cap, n_blocks, c, k, cw, lp, sl,
+     page_words, spc, t_grid) = dims
+    t_pts = n_blocks * c * k
+
+    def program(term_keys, term_lens, post_idx, post_data, all_words,
+                q_keys, q_lens, q_lo, q_hi, r_lo, r_hi,
+                pool_words, side_words,
+                t_pages, t_sides, t_chunks, t_bits, t_bhi, t_blo,
+                g_hi, g_lo, flo, fhi, lb):
+        i32 = jnp.int32
+
+        # ---- stage 1: batched term match (every exact leaf, one search)
+        if q_keys.shape[0]:
+            gis = match_terms_traced(
+                term_keys, term_lens, q_lo, q_hi, q_keys, q_lens
+            )
+        else:
+            gis = jnp.zeros(0, i32)
+
+        # ---- stage 2: bitmap algebra compiled from the AST shape
+        def eval_node(node):
+            tag = node[0]
+            if tag == "terms":
+                _, slot, n, ds, slab = node
+                rows = gis[slot : slot + n]
+                b_pad = pad_pow2(n)
+                if b_pad != n:
+                    rows = jnp.concatenate(
+                        [rows, jnp.full(b_pad - n, -1, i32)]
+                    )
+                return bitmap_from_terms_traced(
+                    post_idx, post_data, rows, jnp.int32(ds), n_words, slab
+                )
+            if tag == "range":
+                _, ridx, ds, slab = node
+                return bitmap_from_term_range_traced(
+                    post_idx, post_data, r_lo[ridx], r_hi[ridx],
+                    jnp.int32(ds), n_words, slab,
+                )
+            if tag == "all":
+                return all_words
+            if tag == "and":
+                _, pos, negs = node
+                if pos:
+                    acc = eval_node(pos[0])
+                    for s in pos[1:]:
+                        acc = acc & eval_node(s)
+                else:
+                    acc = all_words
+                for s in negs:
+                    acc = acc & ~eval_node(s)
+                return acc
+            if tag == "or":
+                acc = jnp.zeros(n_words, jnp.uint32)
+                for s in node[1]:
+                    acc = acc | eval_node(s)
+                return acc
+            if tag == "not":
+                return all_words & ~eval_node(node[1])
+            raise AssertionError(node)
+
+        bitmap = eval_node(ast)
+
+        # ---- stage 3: matched-doc compaction (doc bitmap -> dense slots)
+        bits = (
+            (bitmap[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        ).reshape(-1)[:n_docs_pad] != 0
+        ncum = jnp.cumsum(bits.astype(i32))
+        n_matched = ncum[-1] if n_docs_pad else jnp.int32(0)
+        slot = ncum - 1
+        sent = n_docs_pad  # sentinel row: the all-zero lane block
+        sel = (
+            jnp.full(cap + 1, sent, i32)
+            .at[jnp.where(bits, slot, cap)]
+            .set(jnp.arange(n_docs_pad, dtype=i32), mode="drop")[:cap]
+        )
+
+        # ---- stage 4: per-lane plan gather + resident assembly + decode
+        lane_rows = (
+            sel[:, None] * n_blocks + jnp.arange(n_blocks, dtype=i32)[None, :]
+        ).reshape(-1)
+        kw = _assemble_resident_lanes_traced(
+            pool_words, side_words,
+            t_pages[lane_rows], t_sides[lane_rows], t_chunks[lane_rows],
+            t_bits[lane_rows], t_bhi[lane_rows], t_blo[lane_rows],
+            c=c, cw=cw, w=page_words, spc=spc,
+        )
+        res = decode_chunked_lanes(**kw, k=k)
+
+        rs = lambda x: x.reshape(cap, t_pts)
+        ts = (rs(res.ts_hi), rs(res.ts_lo))
+        vhi, vlo = rs(res.val_hi), rs(res.val_lo)
+        pif, mlt = rs(res.point_is_float), rs(res.mult)
+        valid = rs(res.valid)
+        err = jnp.any(res.err.reshape(cap, n_blocks * c), axis=1)
+
+        # ---- stage 5: consolidation onto the step grid
+        # range mask mirrors the staged fetch window [fetch_lo, fetch_hi)
+        valid = valid & ~u64.lt_u(ts, flo) & u64.lt_u(ts, fhi)
+        counts = jnp.sum(valid.astype(i32), axis=1)
+        # forward-fill valid points over invalid slots (log-time select
+        # chain; NO scatter — XLA CPU lowers 2D scatters to scalar
+        # loops). Timestamps are ascending over each row's valid points,
+        # so the filled row is monotone non-decreasing end to end:
+        # leading invalid slots carry (0, has=False), later invalid
+        # slots duplicate their predecessor — exactly what an upper
+        # bound needs (it lands after the duplicate run and the gather
+        # reads the run's fill value, i.e. the last valid point).
+        # fill only the search keys + a source-index plane; values gather
+        # once at the end through the filled index (3 filled arrays
+        # instead of 6)
+        src = jnp.broadcast_to(
+            jnp.arange(t_pts, dtype=i32)[None, :], (cap, t_pts)
+        )
+        have = valid
+        fill = [
+            jnp.where(valid, x, jnp.zeros_like(x))
+            for x in (ts[0], ts[1], src)
+        ]
+        sh = 1
+        while sh < t_pts:
+            prev_have = jnp.pad(have, ((0, 0), (sh, 0)))[:, :t_pts]
+            take = ~have & prev_have
+            fill = [
+                jnp.where(take, jnp.pad(x, ((0, 0), (sh, 0)))[:, :t_pts], x)
+                for x in fill
+            ]
+            have = have | prev_have
+            sh *= 2
+        fth, ftl, fsrc = fill
+        # vectorized upper bound per (series, grid step): first index
+        # with filled-ts > t_j — np.searchsorted(times, grid, "right")
+        gh = jnp.broadcast_to(g_hi[None, :], (cap, t_grid))
+        gl = jnp.broadcast_to(g_lo[None, :], (cap, t_grid))
+        lo_i = jnp.zeros((cap, t_grid), i32)
+        hi_i = jnp.full((cap, t_grid), t_pts, i32)
+        for _ in range(max(int(t_pts).bit_length(), 1)):
+            active = lo_i < hi_i
+            mid = (lo_i + hi_i) // 2
+            midc = jnp.clip(mid, 0, max(t_pts - 1, 0))
+            tm = (
+                jnp.take_along_axis(fth, midc, axis=1),
+                jnp.take_along_axis(ftl, midc, axis=1),
+            )
+            gt = u64.lt_u((gh, gl), tm)  # ts[mid] > t_j
+            hi_i = jnp.where(active & gt, mid, hi_i)
+            lo_i = jnp.where(active & ~gt, mid + 1, lo_i)
+        idx = lo_i - 1
+        idc = jnp.clip(idx, 0, max(t_pts - 1, 0))
+        ok = (idx >= 0) & jnp.take_along_axis(have, idc, axis=1)
+        st = (
+            jnp.take_along_axis(fth, idc, axis=1),
+            jnp.take_along_axis(ftl, idc, axis=1),
+        )
+        age = u64.sub((gh, gl), st)
+        ok = ok & u64.lt_u(age, lb)
+        pick = jnp.take_along_axis(fsrc, idc, axis=1)
+        g_vh = jnp.take_along_axis(vhi, pick, axis=1)
+        g_vl = jnp.take_along_axis(vlo, pick, axis=1)
+        g_pf = jnp.take_along_axis(pif.astype(i32), pick, axis=1)
+        g_ml = jnp.take_along_axis(mlt, pick, axis=1)
+        return (bitmap, n_matched, counts, err, g_vh, g_vl, g_pf, g_ml, ok)
+
+    _M_COMPILES.inc()
+    return jax.jit(program)
+
+
+def _finalize_grid(vhi, vlo, pif, mult, ok) -> np.ndarray:
+    """Consolidated pair grid -> float64 values, with the EXACT
+    reconstruction arithmetic of ops/decode.finalize_decode (f64 bit
+    view for float-mode points, int64/10^mult for int-mode) so the fused
+    grid matches the staged consolidate output bit for bit."""
+    raw = (np.asarray(vhi, np.uint64) << np.uint64(32)) | np.asarray(
+        vlo, np.uint64
+    )
+    float_vals = raw.view(np.float64)
+    int_vals = raw.astype(np.int64).astype(np.float64)
+    scale = np.power(10.0, np.asarray(mult, np.int64))
+    values = np.where(np.asarray(pif, bool) != 0, float_vals, int_vals / scale)
+    return np.where(np.asarray(ok, bool), values, np.nan)
+
+
+# ---------------------------------------------------------------------------
+# plan entries + planner
+# ---------------------------------------------------------------------------
+
+
+class _PlanEntry:
+    """One cached plan: the compiled program, the uploaded plan-vector
+    tables for its (segment, block set), pre-built query-key inputs, and
+    the validity stamp it revalidates against per execution."""
+
+    __slots__ = (
+        "ast", "dims", "fn", "seg", "arrays", "inputs", "tables",
+        "cap", "stamp", "chunk_k", "matched",
+    )
+
+
+class Planner:
+    """Per-storage device query planner with an LRU plan cache."""
+
+    def __init__(self, db, namespace: str) -> None:
+        self.db = db
+        self.namespace = namespace
+        self._cache: "OrderedDict[tuple, _PlanEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        # cache stats for /debug surfaces
+        self.hits = 0
+        self.misses = 0
+        self.fallbacks = 0
+
+    def evict_stale(self) -> int:
+        """Drop cached plans whose pool/fileset stamp no longer holds —
+        called by the fallback path so entries built against evicted or
+        invalidated state release their pinned device tables (and the
+        index-segment arrays they keep alive) instead of lingering until
+        LRU displacement. Segment-identity staleness is covered too: a
+        swapped segment's plan was stamped with pool/epoch state that
+        moved with the swap's invalidations. O(cache), cache is small."""
+        pool = getattr(self.db, "resident_pool", None)
+        namespaces = getattr(self.db, "namespaces", None)
+        if pool is None or namespaces is None or self.namespace not in namespaces:
+            return 0
+        ns = namespaces[self.namespace]
+        live = (
+            pool.evictions, pool.invalidations,
+            tuple(sh.fileset_epoch for sh in ns.shards),
+        )
+        with self._lock:
+            stale = [
+                k for k, e in self._cache.items() if e.stamp[2:] != live
+            ]
+            for k in stale:
+                del self._cache[k]
+        return len(stale)
+
+    def run(self, matchers, fetch_lo: int, fetch_hi: int, grid: np.ndarray,
+            lookback_nanos: int):
+        """Serve one fetch through a device plan. Returns
+        (metas, values_f64 [S, T], datapoints) or raises Ineligible with
+        the routing reason (the caller records it and runs staged).
+        ``grid`` is the engine's consolidation timestamp vector."""
+        if not plan_enabled():
+            raise Ineligible("plan-disabled")
+        if staged_forced():
+            raise Ineligible("force-staged")
+        db = self.db
+        namespaces = getattr(db, "namespaces", None)
+        if namespaces is None or self.namespace not in namespaces:
+            raise Ineligible("remote-storage")
+        pool = getattr(db, "resident_pool", None)
+        if pool is None or not pool.enabled:
+            raise Ineligible("resident-pool-disabled")
+        ns = namespaces[self.namespace]
+        if ns.index is None:
+            raise Ineligible("no-index")
+        seg, arrays = self._single_device_segment(ns.index, fetch_lo, fetch_hi)
+        blocks = self._block_set(ns, pool, fetch_lo, fetch_hi)
+        if not blocks:
+            raise Ineligible("no-sealed-blocks")
+        for shard in ns.shards:
+            if shard.has_buffered_overlap(fetch_lo, fetch_hi):
+                raise Ineligible("buffer-overlay")
+
+        from .m3_storage import matchers_to_index_query
+
+        q = matchers_to_index_query(matchers)
+        t_grid = pad_pow2(len(grid), _SENTINEL_GRID)
+        key = (
+            self.namespace,
+            tuple((m.name, m.op, m.value) for m in matchers),
+            tuple(blocks),
+            t_grid,
+        )
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+        from . import stats
+
+        if entry is not None and self._valid(entry, seg, arrays, ns, pool):
+            self.hits += 1
+            _M_HITS.inc()
+            stats.add(plan_hits=1)
+            return self._execute(
+                entry, ns, fetch_lo, fetch_hi, grid, lookback_nanos
+            )
+        entry = self._build(q, seg, arrays, ns, pool, blocks, t_grid)
+        with self._lock:
+            self._cache[key] = entry
+            self._cache.move_to_end(key)
+            while len(self._cache) > _cache_cap():
+                self._cache.popitem(last=False)
+        self.misses += 1
+        _M_MISSES.inc()
+        stats.add(plan_misses=1)
+        return self._execute(entry, ns, fetch_lo, fetch_hi, grid,
+                             lookback_nanos)
+
+    # -- eligibility pieces ------------------------------------------------
+
+    @staticmethod
+    def _single_device_segment(index, fetch_lo: int, fetch_hi: int):
+        """The range's ONE sealed, device-resident index segment (the v1
+        plan scope; more segments or mutable docs degrade staged)."""
+        with index.lock:
+            segs = []
+            mutable_docs = 0
+            for bs in sorted(index.blocks):
+                if bs + index.block_size <= fetch_lo or bs >= fetch_hi:
+                    continue
+                blk = index.blocks[bs]
+                mutable_docs += len(blk.mutable)
+                segs.extend(blk.sealed)
+        if mutable_docs:
+            raise Ineligible("mutable-index-block")
+        if not segs:
+            raise Ineligible("no-index-segment")
+        if len(segs) > 1:
+            raise Ineligible("multi-segment")
+        seg = segs[0]
+        arrays = getattr(seg, "_arrays", None)
+        if arrays is None:
+            raise Ineligible("index-not-resident")
+        return seg, arrays
+
+    def _block_set(self, ns, pool, fetch_lo: int, fetch_hi: int):
+        """Sorted ((shard, block_start, volume)) of every sealed fileset
+        overlapping the range — each must be complete-admitted so a
+        page-table miss means 'series absent', never 'not resident'."""
+        out = []
+        bsz = ns.opts.block_size_nanos
+        for shard in ns.shards:
+            newest: dict[int, int] = {}
+            for fid in shard.filesets():
+                if fid.block_start + bsz <= fetch_lo or fid.block_start >= fetch_hi:
+                    continue
+                cur = newest.get(fid.block_start)
+                if cur is None or fid.volume > cur:
+                    newest[fid.block_start] = fid.volume
+            for bs, vol in newest.items():
+                if not pool.is_complete(self.namespace, shard.id, bs, vol):
+                    raise Ineligible("non-resident-block")
+                out.append((shard.id, bs, vol))
+        return sorted(out, key=lambda t: (t[1], t[0]))
+
+    def _stamp(self, seg, arrays, ns, pool):
+        return (
+            id(seg), id(arrays),
+            pool.evictions, pool.invalidations,
+            tuple(sh.fileset_epoch for sh in ns.shards),
+        )
+
+    def _valid(self, entry, seg, arrays, ns, pool) -> bool:
+        return entry.stamp == self._stamp(seg, arrays, ns, pool)
+
+    # -- build -------------------------------------------------------------
+
+    def _build(self, q, seg, arrays, ns, pool, blocks, t_grid) -> _PlanEntry:
+        import jax.numpy as jnp
+
+        from ..cache.block_cache import BlockKey
+        from ..index.device import kernels
+        from ..ops.chunked import window_words
+
+        # stamp BEFORE the page-table walk: an eviction racing the walk
+        # would otherwise free (and let a re-admission reuse) pages this
+        # plan just copied into its tables while the stamp still matched
+        # current counters — the in-lease re-check in _execute must see
+        # a stamp OLDER than any such churn and refuse to serve
+        stamp = self._stamp(seg, arrays, ns, pool)
+        leaves: list = []
+        ranges: list = []
+        ast = _ast_shape(q, arrays, leaves, ranges)
+
+        docs = list(seg.docs)
+        n_docs = len(docs)
+        if n_docs == 0:
+            raise Ineligible("empty-segment")
+        block_starts = sorted({bs for _, bs, _ in blocks})
+        vols = {(sh, bs): vol for sh, bs, vol in blocks}
+        n_blocks = len(block_starts)
+
+        # per-(doc, block) lane plan vectors; one trailing all-zero doc
+        # row block is the compaction sentinel (padding slots decode
+        # nothing). The doc axis pads to the bitmap's natural 32-aligned
+        # width so the bit unpack and the compaction agree on capacity.
+        n_docs_pad = arrays.n_words * 32
+        rows = (n_docs_pad + 1) * n_blocks
+        chunk_k = 0
+        max_span = 0
+        max_pages = 1
+        max_side = 1
+        lane_entries: list = [None] * rows
+        for d, doc in enumerate(docs):
+            shard = ns.shard_for(doc.id)
+            for b, bs in enumerate(block_starts):
+                vol = vols.get((shard.id, bs))
+                if vol is None:
+                    continue  # this shard has no fileset for the block
+                e = pool.get(
+                    BlockKey(self.namespace, shard.id, bytes(doc.id), bs, vol)
+                )
+                if e is None:
+                    # complete-admitted fileset without the series: the
+                    # series is absent from the block — empty lane
+                    continue
+                if e.n_chunks <= 0 or not e.side_pages:
+                    raise Ineligible("missing-side-planes")
+                if chunk_k == 0:
+                    chunk_k = e.chunk_k
+                elif e.chunk_k != chunk_k:
+                    raise Ineligible("mixed-chunk-k")
+                lane_entries[d * n_blocks + b] = (e, bs)
+                max_span = max(max_span, e.max_span_bits)
+                max_pages = max(max_pages, len(e.pages))
+                max_side = max(max_side, len(e.side_pages))
+        if chunk_k == 0:
+            raise Ineligible("no-resident-lanes")
+
+        o = pool.options
+        cw = window_words(max_span)
+        extra = -(-cw // o.page_words) + 1
+        lp = max_pages + extra
+        sl = max_side
+        c = max(
+            (e.n_chunks for e, _ in filter(None, lane_entries)), default=1
+        )
+        t_pages = np.zeros((rows, lp), np.int32)
+        t_sides = np.zeros((rows, sl), np.int32)
+        t_chunks = np.zeros(rows, np.int32)
+        t_bits = np.zeros(rows, np.int32)
+        t_bhi = np.zeros(rows, np.uint32)
+        t_blo = np.zeros(rows, np.uint32)
+        for i, le in enumerate(lane_entries):
+            if le is None:
+                continue
+            e, bs = le
+            pool._check_entry(e)
+            t_pages[i, : len(e.pages)] = e.pages
+            t_sides[i, : len(e.side_pages)] = e.side_pages
+            t_chunks[i] = e.n_chunks
+            t_bits[i] = e.num_bits
+            t_bhi[i] = (int(bs) >> 32) & 0xFFFFFFFF
+            t_blo[i] = int(bs) & 0xFFFFFFFF
+
+        # query-key inputs (values are fixed per entry: matchers carry
+        # them, and the entry is keyed by matchers)
+        bq = len(leaves)
+        bq_pad = kernels.pad_pow2(bq) if bq else 0
+        values = [v for _, v in leaves] + [b""] * (bq_pad - bq)
+        if bq:
+            q_keys, q_lens = kernels.build_query_keys(values, arrays.k_words)
+        else:
+            q_keys = np.zeros((0, arrays.k_words), np.uint32)
+            q_lens = np.zeros(0, np.int32)
+        q_lo = np.zeros(bq_pad, np.int32)
+        q_hi = np.zeros_like(q_lo)
+        for i, (field, _v) in enumerate(leaves):
+            start, count = arrays.fields.get(field, (0, 0, 0, 0))[:2]
+            q_lo[i], q_hi[i] = start, start + count
+        r_lo = np.asarray([lo for lo, _ in ranges] or [0], np.int32)
+        r_hi = np.asarray([hi for _, hi in ranges] or [0], np.int32)
+
+        entry = _PlanEntry()
+        entry.ast = ast
+        entry.seg = seg
+        entry.arrays = arrays
+        # today cap == n_docs_pad (decode capacity = bitmap width); cap
+        # is the seam an adaptive-capacity policy would shrink for
+        # persistently sparse matches
+        entry.cap = n_docs_pad
+        entry.chunk_k = chunk_k
+        entry.stamp = stamp
+        entry.dims = (
+            arrays.n_words, n_docs_pad, entry.cap, n_blocks, c, chunk_k,
+            cw, lp, sl, o.page_words, o.side_page_chunks, t_grid,
+        )
+        entry.inputs = (
+            jnp.asarray(q_keys), jnp.asarray(q_lens),
+            jnp.asarray(q_lo), jnp.asarray(q_hi),
+            jnp.asarray(r_lo), jnp.asarray(r_hi),
+        )
+        entry.tables = (
+            jnp.asarray(t_pages), jnp.asarray(t_sides),
+            jnp.asarray(t_chunks), jnp.asarray(t_bits),
+            jnp.asarray(t_bhi), jnp.asarray(t_blo),
+        )
+        entry.fn = _build_program(ast, entry.dims)
+        # matched-doc cache: the matched set is a pure function of the
+        # segment arrays and the matcher values, both frozen while the
+        # stamp holds — so the per-doc tag materialization (the cost that
+        # dominated large fan-outs host-side) is paid ONCE per plan, not
+        # per query
+        entry.matched = None
+        return entry
+
+    # -- execute -----------------------------------------------------------
+
+    def _execute(self, entry, ns, fetch_lo: int, fetch_hi: int,
+                 grid: np.ndarray, lookback_nanos: int):
+        from ..index.device import kernels
+
+        pool = self.db.resident_pool
+        t_grid = entry.dims[-1]
+        g = np.zeros(t_grid, np.int64)
+        g[: len(grid)] = grid
+        if len(grid):
+            g[len(grid):] = grid[-1]  # padded steps discarded below
+        gu = g.astype(np.uint64)
+        g_hi = (gu >> np.uint64(32)).astype(np.uint32)
+        g_lo = (gu & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+        def pair(v: int):
+            v = int(v) & ((1 << 64) - 1)
+            return (
+                np.uint32(v >> 32),
+                np.uint32(v & 0xFFFFFFFF),
+            )
+
+        with pool.read_lease():
+            # buffer snapshots under the lease (same discipline as the
+            # staged resident scan); the plan tables reference page
+            # indices, so the validity stamp re-checks INSIDE the lease:
+            # an eviction + re-admission racing between run()'s check and
+            # this snapshot could otherwise hand reused pages to stale
+            # table rows. Under the lease the snapshot is immutable
+            # (admissions take the functional-copy path), so a stamp that
+            # holds here holds for the whole dispatch.
+            with pool._lock:
+                if pool._words is None or pool._side is None:
+                    raise Ineligible("resident-pool-empty")
+                words, side = pool._words, pool._side
+            if entry.stamp != self._stamp(
+                entry.seg, entry.arrays, ns, pool
+            ):
+                raise Ineligible("raced-invalidation")
+            with PROF.dispatch((entry.ast, entry.dims)) as d:
+                outs = d.done(entry.fn(
+                    entry.arrays.term_keys, entry.arrays.term_lens,
+                    entry.arrays.post_idx, entry.arrays.post_data,
+                    entry.arrays.all_words,
+                    *entry.inputs,
+                    words, side,
+                    *entry.tables,
+                    g_hi, g_lo, pair(fetch_lo), pair(fetch_hi),
+                    pair(lookback_nanos),
+                ))
+        (bitmap, n_matched, counts, err, g_vh, g_vl, g_pf, g_ml, ok) = (
+            np.asarray(x) for x in outs
+        )
+        n = int(n_matched)
+        if n > entry.cap:
+            # more matches than the compiled capacity (a doc-count jump
+            # since build): fall back for THIS query; the stamp check
+            # rebuilds at the larger size next time
+            raise Ineligible("plan-capacity")
+        if entry.matched is not None and len(entry.matched[0]) == n:
+            matched = entry.matched
+        else:
+            from ..block.core import SeriesMeta
+
+            doc_ids = kernels.bitmap_to_docids(bitmap)[:n]
+            docs = entry.seg.docs
+            matched_docs = [docs[int(i)] for i in doc_ids]
+            matched = (
+                matched_docs,
+                [SeriesMeta(tags=d.fields) for d in matched_docs],
+            )
+            entry.matched = matched
+        t = len(grid)
+        values = _finalize_grid(
+            g_vh[:n, :t], g_vl[:n, :t], g_pf[:n, :t], g_ml[:n, :t],
+            ok[:n, :t],
+        )
+        datapoints = int(counts[:n].sum())
+        err_rows = np.nonzero(err[:n])[0]
+        return matched, values, datapoints, err_rows

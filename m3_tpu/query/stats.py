@@ -78,6 +78,18 @@ class QueryStats:
     # host executor (evicted / not admitted / device error)
     index_device_hits: int = 0
     index_device_misses: int = 0
+    # one-dispatch fused query pipeline (query/plan.py): fetches served
+    # by a cached device plan (hits), plans (re)built this query
+    # (misses), and fetches that degraded to the staged path (fallbacks,
+    # EXPLAIN records the reason per cause)
+    plan_hits: int = 0
+    plan_misses: int = 0
+    plan_fallbacks: int = 0
+    # profiled device-kernel dispatches charged to this query (the
+    # KernelProfiler seam, utils/instrument.set_dispatch_counter): the
+    # fused pipeline's acceptance metric — a warm plan-served query is
+    # exactly ONE dispatch
+    device_dispatches: int = 0
     trace_id: str | None = None  # links the record to its /debug/traces tree
     error: str | None = None
     # EXPLAIN support: when record_routing is on (Engine.explain sets it),
@@ -110,6 +122,10 @@ class QueryStats:
             "residentMisses": self.resident_misses,
             "indexDeviceHits": self.index_device_hits,
             "indexDeviceMisses": self.index_device_misses,
+            "planHits": self.plan_hits,
+            "planMisses": self.plan_misses,
+            "planFallbacks": self.plan_fallbacks,
+            "deviceDispatches": self.device_dispatches,
             "traceId": self.trace_id,
             "error": self.error,
         }
@@ -255,6 +271,9 @@ def add(
     resident_bytes: int = 0,
     index_device_hits: int = 0,
     index_device_misses: int = 0,
+    plan_hits: int = 0,
+    plan_misses: int = 0,
+    plan_fallbacks: int = 0,
 ) -> None:
     """Charge scan counters against this thread's active query (no-op
     outside a query, so storage paths call it unconditionally)."""
@@ -271,6 +290,24 @@ def add(
     st.resident_bytes += resident_bytes
     st.index_device_hits += index_device_hits
     st.index_device_misses += index_device_misses
+    st.plan_hits += plan_hits
+    st.plan_misses += plan_misses
+    st.plan_fallbacks += plan_fallbacks
+
+
+def _count_dispatch(_kernel: str) -> None:
+    """KernelProfiler seam (utils/instrument.set_dispatch_counter):
+    every profiled device-kernel dispatch charges the query record
+    active on the dispatching thread — the fused pipeline's ONE-dispatch
+    acceptance metric. No-op between queries (current() is None)."""
+    st = current()
+    if st is not None:
+        st.device_dispatches += 1
+
+
+from ..utils.instrument import set_dispatch_counter as _set_dispatch_counter
+
+_set_dispatch_counter(_count_dispatch)
 
 
 class _Stage:

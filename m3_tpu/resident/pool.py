@@ -79,47 +79,23 @@ class ResidentPoolError(ValueError):
     metadata must raise, never read out-of-bounds or silently wrap)."""
 
 
-# Order of the uint32 side planes per chunk (device layout; the resident
-# chunked scan's assembly indexes these columns — parallel/scan.py).
-SIDE_PLANES = (
-    "off",  # bit offset of the chunk start within the stream
-    "prev_time_hi", "prev_time_lo",
-    "prev_delta_hi", "prev_delta_lo",
-    "prev_float_bits_hi", "prev_float_bits_lo",
-    "prev_xor_hi", "prev_xor_lo",
-    "int_val_hi", "int_val_lo",
-    "time_unit", "sig", "mult", "is_float",
-    "flags",  # bit 0: int-fast chunk, bit 1: float-fast chunk
-)
-N_SIDE_PLANES = len(SIDE_PLANES)
+# Packed per-chunk side-plane layout (ops/sideplane.py): 10 uint32 words
+# per chunk instead of the original 16 one-field-per-word planes — the
+# ROADMAP item 1 residual, -37.5% side-plane HBM at constant information.
+# The resident chunked scan's device assembly unpacks these columns
+# (parallel/scan.py via sideplane.unpack_side_planes).
+from ..ops.sideplane import SIDE_WORDS as N_SIDE_PLANES
+from ..ops.sideplane import pack_side_rows
 
 _M64 = (1 << 64) - 1
 
 
-def side_rows_from_snaps(snaps: list) -> np.ndarray:
+def side_rows_from_snaps(snaps: list, block_start: int) -> np.ndarray | None:
     """Per-chunk snapshot dicts (ops/chunked.snapshot_stream or
-    storage/fs.FilesetReader.side_table) -> uint32[n_chunks, N_SIDE_PLANES]
-    device side-plane rows."""
-    n = len(snaps)
-    rows = np.zeros((n, N_SIDE_PLANES), np.uint32)
-    for j, p in enumerate(snaps):
-        pt = int(p["prev_time"]) & _M64
-        pd = int(p["prev_delta"]) & _M64
-        pfb = int(p["prev_float_bits"]) & _M64
-        pxr = int(p["prev_xor"]) & _M64
-        iv = int(p["int_val"]) & _M64
-        rows[j] = (
-            p["off"],
-            pt >> 32, pt & 0xFFFFFFFF,
-            pd >> 32, pd & 0xFFFFFFFF,
-            pfb >> 32, pfb & 0xFFFFFFFF,
-            pxr >> 32, pxr & 0xFFFFFFFF,
-            iv >> 32, iv & 0xFFFFFFFF,
-            int(p["time_unit"]), int(p["sig"]), int(p["mult"]),
-            int(bool(p["is_float"])),
-            (1 if p.get("fast") else 0) | (2 if p.get("fast_float") else 0),
-        )
-    return rows
+    storage/fs.FilesetReader.side_table) -> packed uint32[n_chunks,
+    N_SIDE_PLANES] device side-plane rows, or None when a chunk's state
+    overflows the packed ranges (the lane then decodes streamed)."""
+    return pack_side_rows(snaps, block_start)
 
 
 @dataclass
@@ -279,6 +255,7 @@ class ResidentPool:
         self.readmissions = 0
         self.inplace_admissions = 0
         self.copy_admissions = 0
+        self.side_pack_overflows = 0
         reg = registry or METRICS
         self._m_admissions = reg.counter(
             "resident_admissions_total", "blocks admitted to the resident pool"
@@ -311,6 +288,12 @@ class ResidentPool:
             "resident_copy_admissions_total",
             "admissions that fell back to the functional copy because a "
             "scan lease was active",
+        )
+        self._m_side_overflow = reg.counter(
+            "resident_side_pack_overflows_total",
+            "lanes admitted WITHOUT side planes because a chunk snapshot "
+            "overflowed the packed 10-word layout (the lane decodes "
+            "streamed; pathological block span or sample gap)",
         )
         self._g_bytes = reg.gauge("resident_pool_bytes", "compressed bytes resident")
         self._g_pages = reg.gauge("resident_pool_pages", "pages in use (excl. zero page)")
@@ -450,9 +433,10 @@ class ResidentPool:
             for i, snaps in zip(missing, snaps_all):
                 sid, stream, bound, _ = norm[i]
                 norm[i] = (sid, stream, bound, snaps)
-        # key, stream, pages, side_pages, points, snaps
+        # key, stream, pages, side_pages, packed side rows, chunk/span meta
         plan: list[tuple] = []
         rejected_span = 0
+        side_overflows = 0
         for sid, stream, num_points, snaps in norm:
             if not stream:
                 continue
@@ -461,16 +445,29 @@ class ResidentPool:
                 rejected_span += 1
                 continue
             snaps = snaps or []
-            n_side = -(-len(snaps) // spc) if snaps else 0
+            rows = side_rows_from_snaps(snaps, block_start) if snaps else None
+            if snaps and rows is None:
+                # a chunk's decoder state overflows the packed 10-word
+                # layout (pathological block span / sample gap): the lane
+                # admits WITHOUT side planes and scans fall back streamed
+                # for it — counted, never silent
+                side_overflows += 1
+                snaps = []
+            n_chunks = len(snaps)
+            max_span = max((p["span"] for p in snaps), default=0)
+            n_side = -(-n_chunks // spc) if n_chunks else 0
             key = BlockKey(namespace, shard_id, bytes(sid), block_start, volume)
-            plan.append((key, bytes(stream), n_pages, n_side, snaps))
+            plan.append((key, bytes(stream), n_pages, n_side, rows, n_chunks, max_span))
+        if side_overflows:
+            self.side_pack_overflows += side_overflows
+            self._m_side_overflow.inc(side_overflows)
         rejected_budget = 0
         admitted = 0
         already_resident = 0
         batch_entries: list[tuple[BlockKey, ResidentEntry, bytes, list]] = []
         with self._upload_lock:
             with self._lock:
-                for key, stream, n_pages, n_side, snaps in plan:
+                for key, stream, n_pages, n_side, rows, n_chunks, max_span in plan:
                     if readmission:
                         cur = self._od.get(key)
                         if cur is not None:
@@ -503,13 +500,13 @@ class ResidentPool:
                         num_bits=len(stream) * 8,
                         nbytes=len(stream),
                         side_pages=tuple(side_pages),
-                        n_chunks=len(snaps),
-                        chunk_k=chunk_k if snaps else 0,
-                        max_span_bits=max((p["span"] for p in snaps), default=0),
+                        n_chunks=n_chunks,
+                        chunk_k=chunk_k if n_chunks else 0,
+                        max_span_bits=max_span,
                     )
                     self._pending[key] = entry
                     admitted += 1
-                    batch_entries.append((key, entry, stream, snaps))
+                    batch_entries.append((key, entry, stream, rows))
             # ---- no table lock: stage + upload ----
             # Pending pages are off the free lists (never LRU-evicted), so
             # intra-batch cannibalization is impossible: each staged page
@@ -531,7 +528,7 @@ class ResidentPool:
                             for tup in batch_entries
                             if self._pending.get(tup[0]) is tup[1]
                         ]
-                    for key, entry, stream, snaps in survivors_snapshot:
+                    for key, entry, stream, packed in survivors_snapshot:
                         staged_keys.add(key)
                         for j, p in enumerate(entry.pages):
                             row = np.zeros(o.page_words, np.uint32)
@@ -542,11 +539,10 @@ class ResidentPool:
                             ).astype(np.uint32)
                             staged_rows.append(row)
                             staged_idx.append(p)
-                        if snaps:
-                            rows = side_rows_from_snaps(snaps)
+                        if packed is not None and len(packed):
                             for j, sp in enumerate(entry.side_pages):
                                 page = np.zeros((spc, N_SIDE_PLANES), np.uint32)
-                                seg = rows[j * spc : (j + 1) * spc]
+                                seg = packed[j * spc : (j + 1) * spc]
                                 page[: len(seg)] = seg
                                 side_rows.append(page)
                                 side_idx.append(sp)
@@ -893,11 +889,18 @@ class ResidentPool:
         side_rows = np.zeros((s, sl), np.int32)
         n_chunks = np.zeros(s, np.int32)
         total_bits = np.zeros(s, np.int32)
-        for i, e in enumerate(entries):
+        # per-series block_start as a u32 pair: the packed side planes
+        # store prev_time block-relative, so the device unpack re-bases
+        block_hi = np.zeros(s, np.uint32)
+        block_lo = np.zeros(s, np.uint32)
+        for i, (key, e) in enumerate(zip(keys, entries)):
             page_rows[i, : len(e.pages)] = e.pages
             side_rows[i, : len(e.side_pages)] = e.side_pages
             n_chunks[i] = e.n_chunks
             total_bits[i] = e.num_bits
+            bs = int(key.block_start) & ((1 << 64) - 1)
+            block_hi[i] = bs >> 32
+            block_lo[i] = bs & 0xFFFFFFFF
         return ResidentChunkedPlan(
             words=words,
             side=side,
@@ -905,6 +908,8 @@ class ResidentPool:
             side_rows=side_rows,
             n_chunks=n_chunks,
             total_bits=total_bits,
+            block_hi=block_hi,
+            block_lo=block_lo,
             chunk_k=k,
             num_chunks=c,
             window_words=cw,
@@ -1101,6 +1106,7 @@ class ResidentPool:
                 "readmissions": self.readmissions,
                 "inplace_admissions": self.inplace_admissions,
                 "copy_admissions": self.copy_admissions,
+                "side_pack_overflows": self.side_pack_overflows,
                 "epoch": self.epoch,
                 "shard_heat": self.heat.dump(),
             }
@@ -1117,6 +1123,8 @@ class ResidentChunkedPlan(NamedTuple):
     side_rows: np.ndarray  # int32[S, SL] side-page index per slot
     n_chunks: np.ndarray  # int32[S]
     total_bits: np.ndarray  # int32[S]
+    block_hi: np.ndarray  # uint32[S] block_start >> 32 (side-plane re-base)
+    block_lo: np.ndarray  # uint32[S] block_start & 0xFFFFFFFF
     chunk_k: int  # records per chunk (uniform across the plan)
     num_chunks: int  # C = max chunks per series
     window_words: int  # cw (ops/chunked.window_words over max spans)

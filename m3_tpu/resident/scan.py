@@ -66,9 +66,7 @@ def resident_scan_totals(pool, keys: list, mesh=None, device_out: bool = False):
         if mesh is not None:
             n_dev = mesh.devices.size
             s_pad = _pow2(max(s_pad, n_dev), _MIN_LANES)
-        page_rows, side_rows, n_chunks, total_bits = pad_chunked_plan(
-            plan, s_pad
-        )
+        vecs = pad_chunked_plan(plan, s_pad)
         shape_key = (plan.num_chunks, plan.chunk_k, plan.window_words,
                      plan.page_words, plan.side_page_chunks)
         if mesh is not None:
@@ -78,10 +76,7 @@ def resident_scan_totals(pool, keys: list, mesh=None, device_out: bool = False):
         with RESIDENT_CHUNKED_PROF.dispatch(
             ("scan", s_pad, *shape_key, mesh is not None)
         ) as d:
-            aggs = d.done(fn(
-                plan.words, plan.side, page_rows, side_rows, n_chunks,
-                total_bits,
-            ))
+            aggs = d.done(fn(plan.words, plan.side, *vecs))
         return aggs if device_out else _slice_series(aggs, s)
 
 

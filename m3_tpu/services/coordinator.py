@@ -363,10 +363,21 @@ class Coordinator:
         return resp
 
     def query_range(self, query: str, start_s: float, end_s: float, step_s: float,
-                    namespace: str | None = None) -> dict:
-        r = self.engine_for(namespace).query_range(
-            query, int(start_s * NANOS), int(end_s * NANOS), int(step_s * NANOS)
-        )
+                    namespace: str | None = None,
+                    force_staged: bool = False) -> dict:
+        # force_staged: the fused-pipeline parity probe (query/plan.py) —
+        # device query plans are disabled for this evaluation so callers
+        # can diff fused vs staged results bit for bit
+        from ..query import plan as query_plan
+
+        eng = self.engine_for(namespace)
+        args = (query, int(start_s * NANOS), int(end_s * NANOS),
+                int(step_s * NANOS))
+        if force_staged:
+            with query_plan.force_staged():
+                r = eng.query_range(*args)
+        else:
+            r = eng.query_range(*args)
         return _prom_matrix(r, int(start_s * NANOS), int(step_s * NANOS))
 
     def query_instant(self, query: str, time_s: float,
@@ -763,6 +774,8 @@ class _Handler(BaseHTTPRequestHandler):
                             float(q["end"][0]),
                             _parse_step(q.get("step", ["15"])[0]),
                             namespace=q.get("namespace", [None])[0],
+                            force_staged=q.get("force_staged", ["0"])[0]
+                            in ("1", "true"),
                         )
                     )
                 elif url.path == "/api/v1/query":

@@ -104,6 +104,7 @@ class Shard:
         self.series: dict[bytes, SeriesBuffer] = {}
         self._flushed_blocks: set[int] = set()
         self._filesets: list[FilesetID] | None = None  # listdir cache
+        self.fileset_epoch = 0  # bumps whenever the fileset set changes
         # block_start -> reader, LRU-bounded (wired_list.go:77 role: a cap on
         # resident block resources with least-recently-used eviction)
         self._readers: "OrderedDict[int, FilesetReader]" = OrderedDict()
@@ -118,6 +119,12 @@ class Shard:
 
     def _invalidate_filesets(self) -> None:
         self._filesets = None
+        # monotone stamp of the shard's sealed-fileset topology: bumps on
+        # every flush/retention/repair that changes the fileset set, so
+        # the device query planner (query/plan.py) can revalidate a
+        # cached plan's block set with one integer compare instead of a
+        # per-query fileset listing
+        self.fileset_epoch += 1
 
     def reader(self, fid: FilesetID) -> FilesetReader:
         with self.lock:
@@ -315,6 +322,18 @@ class Shard:
             buffered = buf is not None and buf.has_points(start, end)
             return keys, buffered
 
+    def has_buffered_overlap(self, start: int, end: int) -> bool:
+        """True when ANY live series buffer holds points in [start, end)
+        — the shard-level buffer-overlay gate the device query planner
+        checks per execution (a fused plan reads sealed residency only,
+        so one buffered point in range degrades the whole query to the
+        staged path, which applies the per-series overlay rule). Cost is
+        O(series with live buffers); zero for sealed-only workloads."""
+        with self.lock:
+            return any(
+                buf.has_points(start, end) for buf in self.series.values()
+            )
+
     def scan_segments(self, sid: bytes, start: int, end: int) -> list[tuple]:
         """[(stream, datapoint_bound, chunk_k)] for the STREAMED scan
         path, in the same lane order the resident path uses (filesets by
@@ -380,6 +399,11 @@ class Shard:
         for buf in self.series.values():
             for fid in flushed:
                 buf.evict_block(fid.block_start)
+        # drop buffers the flush emptied (tick would anyway): keeps the
+        # sealed-only fast path O(1) for has_buffered_overlap instead of
+        # walking thousands of empty buckets per query
+        for sid in [s for s, buf in self.series.items() if not buf.buckets]:
+            del self.series[sid]
         return flushed
 
     def cold_flush(self, flush_before_nanos: int) -> list[FilesetID]:

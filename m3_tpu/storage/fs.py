@@ -48,7 +48,13 @@ SIDE_DTYPE_V1 = np.dtype(
     ]
 )
 SIDE_DTYPE = np.dtype(SIDE_DTYPE_V1.descr + [("flags", "<u1")])
-SIDE_VERSION = 2
+# v3: the packed 10-word-per-chunk layout (ops/sideplane.py) — the SAME
+# rows the resident pool's side planes hold, so admission stages without
+# re-walking streams, and the record shrinks 45 -> 40 bytes. Falls back
+# to the v2 struct for a whole fileset when any chunk's state overflows
+# the packed ranges; readers accept v1/v2/v3.
+SIDE_VERSION = 3
+SIDE_REC_V3 = 40  # SIDE_WORDS * 4
 
 SUFFIXES = ("info", "index", "summaries", "bloomfilter", "data", "side", "digest", "checkpoint")
 
@@ -122,8 +128,19 @@ def write_fileset(
         from ..ops.chunked import snapshot_stream
 
         all_snaps = [snapshot_stream(series[sid], chunk_k) for sid in ids]
-    for i, sid in enumerate(ids):
-        stream = series[sid]
+    from ..ops.sideplane import pack_side_rows
+
+    # side-file version for THIS fileset: v3 packed rows when every
+    # chunk's state fits the packed ranges, else the v2 struct for the
+    # whole file (records are fixed-width; the version is per file)
+    side_version = SIDE_VERSION
+    packed_all = [pack_side_rows(snaps, fid.block_start) for snaps in all_snaps]
+    if any(p is None for p in packed_all):
+        side_version = 2
+
+    def _side_bytes(i: int) -> bytes:
+        if side_version >= 3:
+            return packed_all[i].astype("<u4").tobytes()
         snaps = all_snaps[i]
         side = np.zeros(len(snaps), SIDE_DTYPE)
         for j, p in enumerate(snaps):
@@ -141,7 +158,12 @@ def write_fileset(
                 # flags: bit 0 int-fast chunk, bit 1 float-fast chunk
                 (1 if p.get("fast") else 0) | (2 if p.get("fast_float") else 0),
             )
-        side_bytes = side.tobytes()
+        return side.tobytes()
+
+    for i, sid in enumerate(ids):
+        stream = series[sid]
+        snaps = all_snaps[i]
+        side_bytes = _side_bytes(i)
         index_entries.append(
             struct.pack("<IIQI", len(sid), len(stream), offset, len(snaps)) + sid
         )
@@ -167,7 +189,7 @@ def write_fileset(
                 "bloomBits": bloom.m,
                 "bloomK": bloom.k,
                 "summariesIndexOffsets": True,
-                "sideVersion": SIDE_VERSION,
+                "sideVersion": side_version,
             }
         ).encode(),
         "index": b"".join(index_entries),
@@ -281,8 +303,15 @@ class FilesetReader:
         )
         self._data = self._mmap(base, "data")
         self._side = self._mmap(base, "side")
+        self._side_version = int(self.info.get("sideVersion", 1))
         self._side_dtype = (
-            SIDE_DTYPE if self.info.get("sideVersion", 1) >= 2 else SIDE_DTYPE_V1
+            SIDE_DTYPE if self._side_version >= 2 else SIDE_DTYPE_V1
+        )
+        # per-chunk record size drives the side-cursor walk; v3 stores
+        # packed 10-word rows, v1/v2 the struct dtype
+        self._side_rec = (
+            SIDE_REC_V3 if self._side_version >= 3
+            else self._side_dtype.itemsize
         )
         self._index_mm = self._mmap(base, "index")
         self._entries: dict[bytes, tuple[int, int, int, int] | None] = {}
@@ -341,7 +370,7 @@ class FilesetReader:
             while pos < n:
                 sid, (offset, length, _, n_chunks), pos = self._parse_entry(pos)
                 out[sid] = (offset, length, side_off, n_chunks)
-                side_off += n_chunks * self._side_dtype.itemsize
+                side_off += n_chunks * self._side_rec
             self._full_index = out
         return self._full_index
 
@@ -378,7 +407,7 @@ class FilesetReader:
                 break
             if entry_sid > sid:
                 break
-            side_off += n_chunks * self._side_dtype.itemsize
+            side_off += n_chunks * self._side_rec
             count += 1
         self._entries[sid] = found
         return found
@@ -397,7 +426,7 @@ class FilesetReader:
             side_off = bases[known]
             while pos < stop:
                 _, (_, _, _, n_chunks), pos = self._parse_entry(pos)
-                side_off += n_chunks * self._side_dtype.itemsize
+                side_off += n_chunks * self._side_rec
             known += 1
             bases[known] = side_off
         return bases[sample_i]
@@ -426,6 +455,19 @@ class FilesetReader:
         if entry is None:
             return None
         offset, length, side_off, n_chunks = entry
+        if self._side_version >= 3:
+            from ..ops.sideplane import unpack_side_rows
+
+            rows = np.frombuffer(
+                self._side, "<u4", count=n_chunks * (SIDE_REC_V3 // 4),
+                offset=side_off,
+            ).reshape(n_chunks, SIDE_REC_V3 // 4)
+            snaps = unpack_side_rows(rows, self.info["blockStart"])
+            offs = [p["off"] for p in snaps] + [length * 8]
+            for j, p in enumerate(snaps):
+                p["span"] = int(offs[j + 1]) - int(p["off"])
+                p["total_bits"] = length * 8
+            return snaps
         raw = np.frombuffer(
             self._side, self._side_dtype, count=n_chunks, offset=side_off
         )

@@ -308,6 +308,20 @@ def set_kernel_attribution(fn) -> None:
     _KERNEL_ATTRIBUTION = fn
 
 
+# per-query device-dispatch counter hook: query/stats.py installs a
+# callable ``(kernel)`` invoked for EVERY profiled kernel dispatch
+# (sampled or not), charging it to the query record active on the
+# dispatching thread — the seam the one-dispatch fused query pipeline's
+# acceptance check counts through. Same settable-seam shape as
+# _KERNEL_ATTRIBUTION: utils must not import m3_tpu.query.
+_DISPATCH_COUNTER = None
+
+
+def set_dispatch_counter(fn) -> None:
+    global _DISPATCH_COUNTER
+    _DISPATCH_COUNTER = fn
+
+
 # kernel dispatch latencies span ~10µs (a warm tiny batch on CPU) to whole
 # seconds (a cold 50M-series scan): finer low end than the RPC buckets
 KERNEL_BUCKETS = (
@@ -506,6 +520,9 @@ class _Dispatch:
             return
         prof = self.profiler
         prof._dispatches.inc()
+        counter = _DISPATCH_COUNTER
+        if counter is not None:
+            counter(prof.kernel)
         compiled = False
         if self.key is not None:
             compiled = prof._observe(self.key, time.perf_counter() - self._t0)

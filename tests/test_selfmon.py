@@ -304,10 +304,13 @@ def test_explain_reports_stages_and_routing(db):
         assert out["stages"].get(stage, 0) > 0
     assert out["seriesScanned"] == 1
     assert out["result"]["series"] == 1
-    # no resident pool on this db: the routing record says exactly that
+    # no resident pool on this db: both the device-plan gate (PR 12) and
+    # the residency router record exactly that cause, in decision order
     assert out["routing"] == [
+        {"series": "*", "block": None, "path": "staged",
+         "reason": "plan:resident-pool-disabled"},
         {"series": "*", "block": None, "path": "streamed",
-         "reason": "resident pool disabled"}
+         "reason": "resident pool disabled"},
     ]
     assert out["routingDropped"] == 0
     # a plain query does NOT pay routing recording

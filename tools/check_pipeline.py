@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""CI guard for the one-dispatch fused query pipeline (query/plan.py).
+
+Boots a real dbnode (resident pool + device index) and a coordinator,
+runs a short loadgen burst against the coordinator (the fleet keeps
+serving while the plan contract is asserted on the node), seeds and
+seals a block of series on the dbnode over RPC, then asserts the whole
+pipeline contract end to end via the ``query_range`` wire op:
+
+- an eligible regexp -> decode -> rate() query is served by a device
+  plan (planMisses >= 1 on first sight, planHits >= 1 warm) and the
+  WARM query reports exactly ONE profiled device dispatch
+  (``deviceDispatches == 1`` in QueryStats, counted at the
+  KernelProfiler seam);
+- the ``force_staged`` probe returns BIT-IDENTICAL values and metas
+  (the staged path pays > 1 dispatch for the same result);
+- ``m3tpu_query_plan_hits_total`` > 0 and
+  ``m3tpu_query_plan_errors_total`` == 0 in the node's exposition
+  (zero plan-cache errors), and the exposition validates;
+- an ineligible query (general-regexp leaf) falls back transparently
+  with the EXPLAIN routing reason recorded — same results as staged;
+- the coordinator keeps answering ``/api/v1/query_range`` under the
+  same ``force_staged`` parameter with matching JSON (fleet surfaces
+  degrade transparently whatever the node's plan state).
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_pipeline.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+NANOS = 1_000_000_000
+N_SERIES = 128
+N_POINTS = 24
+T0 = 1_600_000_000 * NANOS
+STEP = 10 * NANOS
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _values_equal(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if math.isnan(x) and math.isnan(y):
+                continue
+            if x != y:
+                return False
+    return True
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from tools.check_metrics import validate_exposition
+
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.testing.proc_cluster import _spawn_listening
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    base = tempfile.mkdtemp(prefix="m3tpu-check-pipeline-")
+    dbnode = coordinator = None
+    try:
+        dbnode, dh, dport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.dbnode",
+             "--base-dir", os.path.join(base, "dbnode"),
+             "--namespace", "pipeline", "--no-mediator",
+             "--resident-bytes", str(64 << 20),
+             "--index-device-bytes", str(64 << 20)],
+            "dbnode",
+        )
+        coordinator, ch, cport = _spawn_listening(
+            [sys.executable, "-m", "m3_tpu.services.coordinator",
+             "--base-dir", os.path.join(base, "coord")],
+            "coordinator",
+        )
+        http = f"http://{ch}:{cport}"
+        # generous RPC timeout: the FIRST query_range pays the plan build
+        # plus every jit compile in the fused program (CPU XLA is slow to
+        # compile; warm queries are the thing under test)
+        node = RemoteNode.connect(f"{dh}:{dport}", timeout=300.0)
+
+        # fleet under load: a short mixed burst against the coordinator
+        # while the node-side contract is asserted below
+        load = subprocess.run(
+            [sys.executable, "-m", "m3_tpu.services.loadgen",
+             "--coordinator", f"{ch}:{cport}",
+             "--rate", "40", "--duration", "4", "--series", "16"],
+            capture_output=True, text=True, timeout=120,
+        )
+        check(load.returncode == 0, "loadgen burst against the coordinator")
+
+        # seed + seal an eligible block on the dbnode
+        for i in range(N_SERIES):
+            tags = ((b"__name__", b"pipe_requests"),
+                    (b"job", b"app%d" % (i % 4)),
+                    (b"s", b"%04d" % i))
+            node.write_tagged_batch(
+                "pipeline",
+                [(tags, T0 + j * STEP, float((i + j) % 11), 1)
+                 for j in range(N_POINTS)],
+            )
+        node.flush("pipeline", T0 + 4 * 3600 * NANOS)
+        rstats = node.resident_stats()
+        check(rstats.get("admissions", 0) >= N_SERIES, "flush admitted blocks")
+        check(node.index_stats().get("admissions", 0) >= 1,
+              "flush admitted index segment")
+
+        q = 'rate(pipe_requests{job=~"app.*"}[2m])'
+        span = dict(start=T0 + 30 * NANOS, end=T0 + (N_POINTS - 1) * STEP,
+                    step=30 * NANOS)
+
+        # 1) cold: plan builds (miss), result served
+        first = node.query_range("pipeline", q, **span)
+        st = first["stats"]
+        check(st.get("planMisses", 0) >= 1 and st.get("planFallbacks") == 0,
+              f"cold query built a device plan ({st.get('planMisses')} miss)")
+        check(len(first["values"]) == N_SERIES, "cold query matched all series")
+
+        # 2) warm: cache hit, exactly ONE profiled device dispatch
+        warm = node.query_range("pipeline", q, **span)
+        st = warm["stats"]
+        check(st.get("planHits", 0) >= 1, "warm query hit the plan cache")
+        check(st.get("deviceDispatches") == 1,
+              f"warm eligible query is ONE device dispatch "
+              f"(got {st.get('deviceDispatches')})")
+
+        # 3) force_staged probe: bit-identical values AND metas
+        probe = node.query_range("pipeline", q, **span, force_staged=True)
+        check(probe["stats"].get("planHits", 0) == 0
+              and probe["stats"].get("planMisses", 0) == 0,
+              "force_staged probe bypassed the planner")
+        check(probe["stats"].get("deviceDispatches", 0) > 1,
+              "staged path pays >1 dispatch for the same query")
+        check(probe["metas"] == warm["metas"], "fused metas == staged metas")
+        check(_values_equal(probe["values"], warm["values"]),
+              "fused values BIT-IDENTICAL to staged")
+
+        # 4) ineligible query: transparent fallback with EXPLAIN reason
+        hard = node.query_range(
+            "pipeline", 'rate(pipe_requests{job=~"app.*[13]"}[2m])', **span,
+            explain=True,
+        )
+        st = hard["stats"]
+        check(st.get("planFallbacks", 0) >= 1, "general regexp fell back")
+        reasons = {r.get("reason") for r in st.get("routing", [])}
+        check("plan:host-regexp-leaf" in reasons,
+              f"fallback reason recorded ({sorted(reasons)})")
+        hard_staged = node.query_range(
+            "pipeline", 'rate(pipe_requests{job=~"app.*[13]"}[2m])', **span,
+            force_staged=True,
+        )
+        check(_values_equal(hard["values"], hard_staged["values"]),
+              "ineligible query identical to staged")
+
+        # 5) metrics: plan hits counted, ZERO plan-cache errors, clean
+        # exposition
+        expo = node.metrics()
+        errs = validate_exposition(expo)
+        check(not errs, f"dbnode exposition validates ({errs[:2]})")
+
+        def counter(name: str) -> float:
+            # sum the family across labeled children
+            total = 0.0
+            for line in expo.splitlines():
+                if line.startswith(name + " ") or line.startswith(name + "{"):
+                    total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        check(counter("m3tpu_query_plan_hits_total") > 0,
+              "m3tpu_query_plan_hits_total > 0")
+        check(counter("m3tpu_query_plan_errors_total") == 0,
+              "zero plan-cache errors")
+        check(counter("m3tpu_kernel_dispatches_total") > 0,
+              "profiled dispatch seam active")
+
+        # 6) the coordinator's HTTP surface honors force_staged and
+        # degrades transparently (its local engine has no device tier)
+        cq = urllib.request.quote("vector(1)")
+        u = (f"{http}/api/v1/query_range?query={cq}"
+             f"&start={T0 // NANOS}&end={T0 // NANOS + 60}&step=15")
+        a = _get_json(u)
+        b = _get_json(u + "&force_staged=1")
+        check(a.get("status") == "success" and b.get("status") == "success",
+              "coordinator serves with and without force_staged")
+        check(a.get("data") == b.get("data"),
+              "coordinator force_staged result identical")
+    finally:
+        for proc in (dbnode, coordinator):
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+    if failures:
+        print(f"\n{len(failures)} check(s) FAILED")
+        return 1
+    print("\nall pipeline checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
