@@ -479,6 +479,83 @@ def bench_multitenant(rate=400.0, duration=5.0):
     )
 
 
+def bench_hedging(reads=150, delay=0.4, delay_prob=0.12):
+    """Hedging column for the tenants row (PR 14): the SAME 3-replica
+    tagged-read workload against a cluster whose node1 read path
+    straggles (seeded jittered lognormal delay on fetch_tagged),
+    measured closed-loop with hedged backup requests OFF then ON. An
+    unhedged read that draws the straggler pays the full
+    ``straggler_grace`` wait; a hedged one gets a backup twin at the
+    p95 trigger and returns as soon as every host is settled. The
+    headline is p99_ratio (hedged/unhedged); hedge counters prove the
+    backup path actually carried the wins."""
+    from m3_tpu.index.query import term
+    from m3_tpu.net.faults import FaultPlan, FaultRule
+    from m3_tpu.testing.cluster import LocalCluster
+    from m3_tpu.testing.faults import wrap_nodes
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    def hedge_counter(kind):
+        fam = METRICS.collect().get(f"m3tpu_session_hedges_{kind}_total")
+        return sum(c["value"] for c in fam["children"]) if fam else 0.0
+
+    nanos = 1_000_000_000
+    t0 = 1_600_000_000 * nanos
+    plan = FaultPlan(
+        [FaultRule(op="fetch_tagged", peer="node1", delay=delay,
+                   delay_prob=delay_prob, jitter=0.1,
+                   delay_dist="lognormal")],
+        seed=11,
+    )
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3)
+    modes = {}
+    issued = won = 0.0
+    try:
+        seed_session = cluster.session()
+        for i in range(16):
+            tags = ((b"__name__", b"bench_hedge"), (b"i", b"%d" % i))
+            seed_session.write_tagged(tags, t0 + i * nanos, float(i))
+        seed_session.close()
+        q = term(b"__name__", b"bench_hedge")
+        for mode, hedged in (("unhedged", False), ("hedged", True)):
+            s = cluster.session()
+            s.nodes = wrap_nodes(s.nodes, plan)
+            s.hedge_enabled = hedged
+            i0, w0 = hedge_counter("issued"), hedge_counter("won")
+            lats = []
+            bench_t0 = time.perf_counter()
+            for _ in range(reads):
+                r0 = time.perf_counter()
+                res = s.fetch_tagged(q, t0 - 1, t0 + 3600 * nanos)
+                lats.append(time.perf_counter() - r0)
+                assert len(list(res)) == 16
+            elapsed = time.perf_counter() - bench_t0
+            lats.sort()
+            modes[mode] = {
+                "reads_per_sec": round(reads / elapsed, 1),
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                "p99_ms": round(lats[int(len(lats) * 0.99) - 1] * 1e3, 2),
+            }
+            if hedged:
+                issued = hedge_counter("issued") - i0
+                won = hedge_counter("won") - w0
+            s.close()
+    finally:
+        import shutil
+
+        shutil.rmtree(cluster.base_dir, ignore_errors=True)
+    return _rec(
+        "hedged_read_tail_latency",
+        round(modes["hedged"]["p99_ms"] / max(modes["unhedged"]["p99_ms"], 1e-9), 3),
+        "p99 ratio (hedged/unhedged)",
+        straggler={"peer": "node1", "delay_s": delay,
+                   "delay_prob": delay_prob, "dist": "lognormal"},
+        hedges_issued=issued,
+        hedges_won=won,
+        **modes,
+    )
+
+
 def bench_pipeline(n_series=None, on_tpu=False):
     """Staged-vs-fused device-query-plan sweep (query/plan.py): an
     in-process Database (resident pool + device index) seeded with the
@@ -849,6 +926,7 @@ def main() -> None:
         records.append(bench_compression())
     if "tenants" in want:
         records.append(bench_multitenant())
+        records.append(bench_hedging())
     if "pipeline" in want:
         records.append(bench_pipeline(on_tpu=on_tpu))
 

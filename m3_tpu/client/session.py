@@ -14,6 +14,7 @@ TChannel/Thrift.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -21,7 +22,8 @@ from concurrent.futures import wait as _futures_wait
 from dataclasses import dataclass, field
 
 from ..cluster.topology import ConsistencyLevel, TopologyMap
-from ..net.resilience import HealthProber
+from ..net.resilience import HealthProber, HedgeBudget, LatencyEstimator
+from ..net.wire import IDEMPOTENT_OPS
 from ..utils.hash import shard_for
 from ..utils.instrument import DEFAULT as METRICS
 from ..utils.trace import NOOP_SPAN, TRACER
@@ -66,6 +68,150 @@ def _session_retries(op: str):
         "session-level fan-out retry rounds re-attempting failed replicas",
         labels={"op": op},
     )
+
+
+_HEDGE_HELP = {
+    "issued": "hedged backup replica requests issued for stragglers",
+    "won": "hedged backup requests whose response arrived first",
+    "wasted": "hedged backup requests beaten by (or abandoned with) the "
+              "primary leg",
+}
+
+
+def _session_hedges(kind: str, op: str):
+    # m3lint: disable=M3L005 -- kind is a _HEDGE_HELP literal key (issued/won/wasted): a closed three-name set
+    return METRICS.counter(
+        f"session_hedges_{kind}_total", _HEDGE_HELP[kind], labels={"op": op}
+    )
+
+
+class _Hedger:
+    """Per-fan-out hedged-request state ("The Tail at Scale" backup
+    requests, idempotent read ops only).
+
+    Once the fan-out is one response short of quorum (``near_quorum``) and
+    a pending replica has been in flight longer than its own per-(peer,
+    op) p95 estimate, ONE backup request is issued to the next-best
+    straggler (lowest p95 first) — first response per host wins, the loser
+    leg is abandoned, and a loser's late error is never surfaced as a
+    replica error. Issue volume is capped by the session's
+    :class:`HedgeBudget` (≤ token_ratio extra load).
+
+    All methods run on the fan-out's caller thread (the wait loop), so the
+    per-host bookkeeping needs no locking.
+    """
+
+    def __init__(self, session: "Session", op_name: str, spawn, near_quorum):
+        self.session = session
+        self.op = op_name
+        self.spawn = spawn              # host -> Future (one backup twin)
+        self.near_quorum = near_quorum
+        self.started: dict[str, float] = {}   # primary-leg submit time
+        self.legs: dict[str, int] = {}        # outstanding legs per host
+        self.resolved: set[str] = set()       # hosts with a delivered result
+        self.attempted: set[str] = set()      # hosts we already tried to hedge
+        self.hedge_futs: dict = {}            # Future -> host (backup legs)
+        self.unresolved: set[str] = set()     # issued hedges with no outcome yet
+
+    def note_submit(self, host: str) -> None:
+        self.started[host] = time.monotonic()
+        self.legs[host] = self.legs.get(host, 0) + 1
+
+    def _threshold(self, host: str) -> float | None:
+        """Elapsed time past which ``host`` counts as straggling: its own
+        p95, floored by ``hedge_min_delay`` so ordinary sub-millisecond
+        jitter can never burn the hedge budget."""
+        p95 = self.session.latency.p95(host, self.op)
+        if p95 is None:
+            return None
+        return max(p95, self.session.hedge_min_delay)
+
+    def _candidates(self, pending_hosts, now: float) -> list[str]:
+        out = []
+        for host in pending_hosts:
+            if host in self.attempted or host in self.resolved:
+                continue
+            thr = self._threshold(host)
+            if thr is not None and now - self.started.get(host, now) > thr:
+                out.append(host)
+        return out
+
+    def next_event(self, pending_hosts, now: float) -> float | None:
+        """Earliest moment a pending host crosses its straggler threshold
+        (so the wait loop can wake exactly then instead of sleeping to the
+        deadline)."""
+        fire = None
+        for host in pending_hosts:
+            if host in self.attempted or host in self.resolved:
+                continue
+            thr = self._threshold(host)
+            if thr is None:
+                continue
+            at = self.started.get(host, now) + thr
+            if fire is None or at < fire:
+                fire = at
+        return fire
+
+    def maybe_hedge(self, pending_hosts, now: float) -> dict:
+        """Issue at most ONE budget-gated backup per wake, to the
+        best-ranked straggler; returns {Future: host} to join the wait."""
+        for host in self.session.latency.rank(
+            self._candidates(pending_hosts, now), self.op
+        ):
+            self.attempted.add(host)
+            if not self.session.hedge_budget.try_spend():
+                return {}
+            fut = self.spawn(host)
+            self.legs[host] = self.legs.get(host, 0) + 1
+            self.hedge_futs[fut] = host
+            self.unresolved.add(host)
+            _session_hedges("issued", self.op).inc()
+            # the wait loop runs on the query's own thread, so the active
+            # QueryStats record (if any) is this thread's — surface the
+            # hedge on /debug/active_queries
+            from ..query import stats as query_stats
+
+            st = query_stats.current()
+            if st is not None and st.queue_state == "running":
+                st.queue_state = "hedged"
+            return {fut: host}
+        return {}
+
+    def on_success(self, fut, host: str) -> bool:
+        """First success per host is delivered; a loser twin's late result
+        is dropped (never double-merged). Returns whether to deliver."""
+        if host in self.resolved:
+            return False
+        self.resolved.add(host)
+        started = self.started.get(host)
+        if started is not None:
+            self.session.latency.record(
+                host, self.op, time.monotonic() - started
+            )
+        self.session.hedge_budget.on_success()
+        if host in self.unresolved:
+            self.unresolved.discard(host)
+            kind = "won" if fut in self.hedge_futs else "wasted"
+            _session_hedges(kind, self.op).inc()
+        return True
+
+    def on_error(self, fut, host: str) -> bool:
+        """A leg's error surfaces only when the host has no other live leg
+        and no delivered result. Returns whether to deliver the error."""
+        self.legs[host] = self.legs.get(host, 1) - 1
+        if fut in self.hedge_futs and host in self.unresolved:
+            self.unresolved.discard(host)
+            _session_hedges("wasted", self.op).inc()
+        if host in self.resolved:
+            return False
+        return self.legs.get(host, 0) <= 0
+
+    def finish(self) -> None:
+        """Fan-out over: hedges that never produced an outcome (both legs
+        abandoned) were pure extra load."""
+        for _ in range(len(self.unresolved)):
+            _session_hedges("wasted", self.op).inc()
+        self.unresolved.clear()
 
 
 class _DaemonPool:
@@ -272,6 +418,20 @@ class Session:
     # fan-out stops waiting for them (first-quorum-wins: a hung replica
     # costs quorum-time + grace, not fanout_timeout)
     straggler_grace: float = 0.25
+    # hedged backup requests for straggling replicas of IDEMPOTENT read
+    # ops ("Tail at Scale"): None → the M3_TPU_HEDGE env decides (set 0 to
+    # force-disable, e.g. for an unhedged baseline probe). The budget caps
+    # hedges at ~token_ratio (5%) of served responses; the estimator holds
+    # the per-(peer, op) p95 that defines "straggling".
+    hedge_enabled: bool | None = None
+    # floor under the per-peer p95 trigger: a replica is never hedged
+    # before this much elapsed time, so healthy sub-millisecond fan-outs
+    # don't spend budget on scheduler jitter
+    hedge_min_delay: float = 0.01
+    hedge_budget: HedgeBudget = field(default_factory=HedgeBudget, repr=False)
+    latency: LatencyEstimator = field(
+        default_factory=LatencyEstimator, repr=False
+    )
     _prober: HealthProber | None = field(default=None, repr=False)
     _pool_obj: _DaemonPool | None = field(default=None, repr=False)
     _pool_lock: threading.Lock = field(
@@ -293,24 +453,73 @@ class Session:
                 )
             return self._pool_obj
 
+    def _hedging_enabled(self) -> bool:
+        if self.hedge_enabled is None:
+            self.hedge_enabled = os.environ.get("M3_TPU_HEDGE", "1") != "0"
+        return self.hedge_enabled
+
+    def _make_hedger(self, op_name: str, spawn, near_quorum) -> _Hedger | None:
+        """A hedger for this fan-out, or None when hedging is disabled or
+        the op is not provably idempotent (a backup request that might be
+        applied twice is only safe for reads — writes already have their
+        own upsert-based session retry rounds)."""
+        if op_name not in IDEMPOTENT_OPS or not self._hedging_enabled():
+            return None
+        return _Hedger(self, op_name, spawn, near_quorum)
+
     def _collect_first_quorum(self, futs: dict, deadline: float,
-                              quorum, on_result, on_error) -> set:
+                              quorum, on_result, on_error,
+                              hedger: _Hedger | None = None) -> set:
         """ONE wait loop for every fan-out (first-quorum-wins): until
         ``quorum()`` holds the wait runs to ``deadline``; after that,
         stragglers get ``straggler_grace`` and are then abandoned (their
         worker finishes — and releases its socket — in the background).
         ``futs`` maps Future -> host; completed futures dispatch to
         ``on_result(host, value)`` / ``on_error(host, exc)``. Returns the
-        abandoned futures."""
+        abandoned futures.
+
+        With a ``hedger``, a pending replica past its p95 estimate gets a
+        backup request instead of being passively waited out — both one
+        short of quorum (the straggler is blocking the result) AND during
+        the post-quorum ``straggler_grace`` window (the straggler is
+        stalling the merge); the backup future joins the wait and the
+        first response per host wins (the hedger suppresses the loser
+        leg's result/error)."""
         waiting = set(futs)
+        abandoned: set = set()
         quorum_at: float | None = None
         while waiting:
+            if hedger is not None and hedger.resolved:
+                # a resolved host's other legs (the hedge race's loser —
+                # an abandoned twin, or a primary the twin out-ran) have
+                # nothing left to deliver: on_success/on_error would
+                # suppress them anyway. Drop them so the post-quorum
+                # grace wait ends when every HOST is settled instead of
+                # blocking on a leg whose result is already discarded.
+                stale = {f for f in waiting if futs[f] in hedger.resolved}
+                if stale:
+                    abandoned |= stale
+                    waiting -= stale
+                    continue
             now = time.monotonic()
             until = deadline
             if quorum():
                 if quorum_at is None:
                     quorum_at = now
                 until = min(deadline, quorum_at + self.straggler_grace)
+            if hedger is not None and hedger.near_quorum():
+                pending_hosts = {futs[f] for f in waiting}
+                for fut, host in hedger.maybe_hedge(pending_hosts, now).items():
+                    futs[fut] = host
+                    waiting.add(fut)
+                nxt = hedger.next_event(
+                    {futs[f] for f in waiting}, now
+                )
+                if nxt is not None:
+                    # wake when the earliest straggler crosses its
+                    # threshold (a small floor so a just-crossed
+                    # threshold cannot spin)
+                    until = min(until, max(nxt, now + 0.001))
             if now >= until:
                 break
             done, waiting = _futures_wait(
@@ -321,10 +530,12 @@ class Session:
                 try:
                     value = fut.result()
                 except Exception as exc:
-                    on_error(host, exc)
+                    if hedger is None or hedger.on_error(fut, host):
+                        on_error(host, exc)
                 else:
-                    on_result(host, value)
-        return waiting
+                    if hedger is None or hedger.on_success(fut, host):
+                        on_result(host, value)
+        return waiting | abandoned
 
     def _next_round(self, op: str, round_no: int, deadline: float) -> bool:
         """Shared retry-round bookkeeping for every fan-out: False when
@@ -350,14 +561,19 @@ class Session:
             ).start()
         return self._prober
 
-    def _replica_call(self, op_name: str, host: str, shard, call, node, ctx):
+    def _replica_call(self, op_name: str, host: str, shard, call, node, ctx,
+                      hedge: bool = False):
         """One replica attempt, run on a fan-out worker thread; ``ctx`` is
         the caller thread's trace context (thread-local span stacks do not
         follow threads), so traced fan-outs still render one tree tagged
-        {replica, shard}."""
+        {replica, shard} — a hedged backup leg joins the same stitched
+        trace tagged ``hedge=1``."""
         if ctx is not None:
+            attrs = {"replica": host, "shard": shard}
+            if hedge:
+                attrs["hedge"] = "1"
             span = TRACER.span_from_context(
-                f"client.{op_name}.replica", ctx, replica=host, shard=shard
+                f"client.{op_name}.replica", ctx, **attrs
             )
         else:
             span = NOOP_SPAN
@@ -388,6 +604,14 @@ class Session:
         deadline = time.monotonic() + self.fanout_timeout
         ok: dict[str, object] = {}  # host -> result
         errors: list[str] = []
+        hedger = self._make_hedger(
+            op_name,
+            spawn=lambda host: self._pool().submit(
+                self._replica_call, op_name, host, shard, call,
+                self.nodes[host], ctx, True,
+            ),
+            near_quorum=lambda: len(ok) >= required - 1,
+        )
         pending = list(hosts)
         round_no = 0
         while True:
@@ -402,16 +626,24 @@ class Session:
                 futs[self._pool().submit(
                     self._replica_call, op_name, host, shard, call, node, ctx
                 )] = host
+                if hedger is not None:
+                    hedger.note_submit(host)
             abandoned = self._collect_first_quorum(
                 futs, deadline,
                 quorum=lambda: len(ok) >= required,
                 on_result=ok.__setitem__,
                 on_error=lambda host, exc: errors.append(f"{host}: {exc}"),
+                hedger=hedger,
             )
+            timed_out: set[str] = set()
             for fut in abandoned:
-                errors.append(
-                    f"{futs[fut]}: no reply within the fan-out window"
-                )
+                host = futs[fut]
+                # a host whose OTHER leg already delivered (hedge winner's
+                # abandoned twin) is not an error; twins dedupe to one line
+                if host in ok or host in timed_out:
+                    continue
+                timed_out.add(host)
+                errors.append(f"{host}: no reply within the fan-out window")
             if len(ok) >= required:
                 break
             pending = [h for h in hosts if h not in ok]
@@ -422,6 +654,8 @@ class Session:
                 break  # nothing left to retry against
             if not self._next_round(op_name, round_no, deadline):
                 break
+        if hedger is not None:
+            hedger.finish()
         results = ReplicaResults(ok[h] for h in hosts if h in ok)
         if len(ok) < required:
             if unstrict and len(ok) >= 1:
@@ -628,10 +862,13 @@ class Session:
         # client.fetch_tagged, and the span only becomes current on entry
         ctx = None
 
-        def one(host, node):
+        def one(host, node, hedge=False):
             if ctx is not None:
+                attrs = {"replica": host}
+                if hedge:
+                    attrs["hedge"] = "1"
                 span = TRACER.span_from_context(
-                    "client.fetch_tagged.replica", ctx, replica=host
+                    "client.fetch_tagged.replica", ctx, **attrs
                 )
             else:
                 span = NOOP_SPAN
@@ -660,6 +897,23 @@ class Session:
                 for s in range(self.num_shards)
             )
 
+        def near_quorum() -> bool:
+            # one response short everywhere: any single pending host's
+            # reply could complete the read, so a straggler is worth a
+            # hedged backup leg
+            return all(
+                responded_by_shard.get(s, 0) >= required - 1
+                for s in range(self.num_shards)
+            )
+
+        hedger = self._make_hedger(
+            "fetch_tagged",
+            spawn=lambda host: self._pool().submit(
+                one, host, self.nodes[host], True
+            ),
+            near_quorum=near_quorum,
+        )
+
         with fanout_span:
             ctx = TRACER.current_context() if traced else None
             deadline = time.monotonic() + self.fanout_timeout
@@ -673,12 +927,15 @@ class Session:
                     if not node.is_up:
                         continue
                     futs[self._pool().submit(one, host, node)] = host
+                    if hedger is not None:
+                        hedger.note_submit(host)
                 # first-quorum-wins, like _fanout, with the per-shard
                 # responder count as the quorum predicate: one hung
                 # replica costs quorum-time + grace, not fanout_timeout
                 self._collect_first_quorum(
                     futs, deadline, quorum=quorum_met,
                     on_result=record, on_error=lambda host, exc: None,
+                    hedger=hedger,
                 )
                 pending = [h for h in self.nodes if h not in responses]
                 if (
@@ -687,6 +944,8 @@ class Session:
                     or not self._next_round("fetch_tagged", round_no, deadline)
                 ):
                     break
+        if hedger is not None:
+            hedger.finish()
         # consistency check over EVERY shard in the placement — a shard whose
         # replicas are all down has zero responders and must fail the read,
         # not silently return partial results (session.go:1789-1815).

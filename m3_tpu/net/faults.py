@@ -48,7 +48,15 @@ class FaultRule:
 
     A peer-SCOPED rule never matches a decision point that has no peer
     (the server seam decides per op only): a fleet-wide env plan carrying
-    ``peer="node2"`` rules must not fault every node's server."""
+    ``peer="node2"`` rules must not fault every node's server.
+
+    Injected delays can be JITTERED so a faulted straggler resembles a
+    real latency tail instead of a fixed sleep: ``jitter`` spreads each
+    draw around ``delay`` per ``delay_dist`` — "uniform" (the default;
+    delay ± jitter, clamped at 0) or "lognormal" (median ``delay``,
+    log-scale sigma ``jitter/delay`` — the heavy right tail real
+    stragglers have). Draws come from the PLAN's seeded RNG, so a fixed
+    seed plus a fixed request sequence replays the exact same delays."""
 
     op: str | None = None
     peer: str | None = None
@@ -56,6 +64,8 @@ class FaultRule:
     error: float = 0.0
     delay: float = 0.0
     delay_prob: float = 1.0
+    jitter: float = 0.0
+    delay_dist: str = "uniform"
     partition: bool = False
 
     def matches(self, op: str, peer: str | None) -> bool:
@@ -64,6 +74,19 @@ class FaultRule:
         if self.peer is not None and self.peer != peer:
             return False
         return True
+
+    def draw_delay(self, rng: random.Random) -> float:
+        """One delay draw in seconds (``rng`` is the plan's seeded RNG,
+        called under the plan lock — determinism rides the plan's single
+        draw sequence)."""
+        if self.jitter <= 0.0 or self.delay <= 0.0:
+            return self.delay
+        if self.delay_dist == "lognormal":
+            import math
+
+            sigma = self.jitter / self.delay
+            return self.delay * math.exp(rng.gauss(0.0, sigma))
+        return max(0.0, self.delay + rng.uniform(-self.jitter, self.jitter))
 
 
 class FaultPlan:
@@ -110,7 +133,7 @@ class FaultPlan:
                     self._injected["partition"].inc()
                     return "drop", delay
                 if rule.delay > 0.0 and self._rng.random() < rule.delay_prob:
-                    delay += rule.delay
+                    delay += rule.draw_delay(self._rng)
                     self._injected["delay"].inc()
                 if rule.drop > 0.0 and self._rng.random() < rule.drop:
                     self._injected["drop"].inc()

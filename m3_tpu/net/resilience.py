@@ -15,6 +15,7 @@ Everything here emits through utils/instrument's process registry:
 
     m3tpu_rpc_retries_total{op}           transparent RPC-layer retries
     m3tpu_rpc_retry_budget_exhausted_total retries suppressed by the budget
+    m3tpu_session_hedge_budget_exhausted_total hedges suppressed by the budget
     m3tpu_breaker_state{peer}             0 closed / 1 half-open / 2 open
     m3tpu_breaker_transitions_total{peer,to}
 """
@@ -53,15 +54,19 @@ class RetryBudget:
     degrades to ~token_ratio extra load instead of multiplying traffic
     by the retry count."""
 
-    def __init__(self, max_tokens: float = 32.0, token_ratio: float = 0.2) -> None:
+    def __init__(
+        self,
+        max_tokens: float = 32.0,
+        token_ratio: float = 0.2,
+        exhausted_counter: str = "rpc_retry_budget_exhausted_total",
+        exhausted_help: str = "retries suppressed because the retry budget ran dry",
+    ) -> None:
         self.max_tokens = float(max_tokens)
         self.token_ratio = float(token_ratio)
         self._tokens = float(max_tokens)
         self._lock = threading.Lock()
-        self._exhausted = METRICS.counter(
-            "rpc_retry_budget_exhausted_total",
-            "retries suppressed because the retry budget ran dry",
-        )
+        # m3lint: disable=M3L005 -- every constructor call site passes a static literal (rpc_retry_budget / session_hedge_budget): a closed two-name set
+        self._exhausted = METRICS.counter(exhausted_counter, exhausted_help)
 
     @property
     def tokens(self) -> float:
@@ -131,6 +136,68 @@ class RetryPolicy:
 
     def on_success(self) -> None:
         self.budget.on_success()
+
+
+class HedgeBudget(RetryBudget):
+    """Token bucket bounding hedged (backup) replica requests to a small
+    ratio of served traffic — "The Tail at Scale"'s 'a few percent extra
+    load' bound. Every successful primary response deposits
+    ``token_ratio`` (default 5%) tokens; every hedge spends one and is
+    allowed only while the bucket is above half, so a cluster-wide
+    brown-out cannot turn hedging into a traffic doubler."""
+
+    def __init__(self, max_tokens: float = 8.0, token_ratio: float = 0.05) -> None:
+        super().__init__(
+            max_tokens=max_tokens,
+            token_ratio=token_ratio,
+            exhausted_counter="session_hedge_budget_exhausted_total",
+            exhausted_help="hedged backup requests suppressed because the "
+                           "hedge budget ran dry",
+        )
+
+
+class LatencyEstimator:
+    """Per-(peer, op) response-latency p95 estimate over a sliding sample
+    window (old samples fall out, so the estimate decays toward current
+    behavior after a regime change). The hedging layer compares a pending
+    replica's elapsed time against ITS OWN p95 to decide the request is a
+    straggler, and ranks candidate peers by p95 to pick the next-best
+    replica for the backup ("Tail at Scale" hedged requests keyed off the
+    class's expected latency, not a fixed grace)."""
+
+    def __init__(self, window: int = 64, min_samples: int = 8) -> None:
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self._samples: dict[tuple[str, str], list[float]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, peer: str, op: str, seconds: float) -> None:
+        key = (peer, op)
+        with self._lock:
+            buf = self._samples.get(key)
+            if buf is None:
+                buf = self._samples[key] = []
+            buf.append(float(seconds))
+            if len(buf) > self.window:
+                del buf[: len(buf) - self.window]
+
+    def p95(self, peer: str, op: str) -> float | None:
+        """The current p95 estimate, or None until ``min_samples`` have
+        been observed (an unmeasured peer must not be hedged against a
+        made-up threshold)."""
+        with self._lock:
+            buf = self._samples.get((peer, op))
+            if buf is None or len(buf) < self.min_samples:
+                return None
+            ordered = sorted(buf)
+        return ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+
+    def rank(self, peers, op: str) -> list[str]:
+        """Peers ordered fastest-first by p95 estimate; unmeasured peers
+        sort last (a peer we know nothing about is a worse hedge target
+        than one we know to be fast)."""
+        est = {p: self.p95(p, op) for p in peers}
+        return sorted(peers, key=lambda p: (est[p] is None, est[p] or 0.0))
 
 
 _BREAKER_STATE_VALUES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}

@@ -110,6 +110,7 @@ class Engine:
         limits=None,
         global_enforcer=None,
         tenant_enforcers=None,
+        scheduler=None,
     ) -> None:
         self.storage = storage
         self.lookback = lookback_nanos
@@ -120,6 +121,11 @@ class Engine:
         # set, the enforcer chain is query → tenant → global and each
         # query's parent scope resolves from the thread's tenant context
         self.tenant_enforcers = tenant_enforcers
+        # admission scheduler (query/scheduler.QueryScheduler): when set,
+        # every TOP-LEVEL query passes cost-aware admission before eval
+        # and may be shed with a typed QueryShedError; nested evaluation
+        # rides the outer query's slot
+        self.scheduler = scheduler
         self._enforcer = threading.local()
 
     def query_range(
@@ -138,6 +144,7 @@ class Engine:
             qs.namespace = str(getattr(self.storage, "namespace", "") or "")
         t_start = time.perf_counter()
         err: str | None = None
+        admitted = False
         try:
             with stats.stage("parse"):
                 ast = parse(query)
@@ -146,6 +153,13 @@ class Engine:
             # @ start()/end() bind to the TOP-LEVEL query range, even inside
             # subqueries (prometheus PreprocessExpr)
             _bind_at(ast, bounds)
+            if qs is not None and self.scheduler is not None:
+                # cost-aware admission: may block briefly, may shed with
+                # a typed QueryShedError (coordinator → HTTP 503); only
+                # top-level queries admit — nested evaluation rides the
+                # outer query's slot
+                self.scheduler.admit(query, steps, record=qs)
+                admitted = True
             parent = self.global_enforcer
             if self.tenant_enforcers is not None:
                 # the per-tenant middle scope: charges flow query →
@@ -181,6 +195,13 @@ class Engine:
                     cur.limit_exceeded = exc.scope
             raise
         finally:
+            if admitted:
+                self.scheduler.release()
+                if err is None and qs is not None:
+                    # feed the matched-series observation back into the
+                    # cost memo so the NEXT run of this query is priced
+                    # from evidence instead of the optimistic default
+                    self.scheduler.observe(query, qs.series_scanned)
             if qs is not None:
                 stats.finish(qs, time.perf_counter() - t_start, error=err)
 

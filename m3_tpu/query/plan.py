@@ -81,6 +81,11 @@ _M_ERRORS = METRICS.counter(
     "device plan executions that raised and fell back staged (the "
     "staged path is always correct; errors are counted, never surfaced)",
 )
+_M_COALESCED = METRICS.counter(
+    "query_plan_coalesced_total",
+    "fetches served by joining another concurrent query's in-flight "
+    "device scan (N concurrent identical fetches -> 1 dispatch)",
+)
 
 # the fused program's dispatch seam: compile attribution + sampled
 # wall-time under the SAME profiler contract as every other kernel, and
@@ -454,6 +459,20 @@ class _PlanEntry:
     )
 
 
+class _Flight:
+    """One in-flight coalesced device scan: the leader executes, every
+    follower that arrives while it runs blocks on ``event`` and shares
+    the result (or the exception — an Ineligible leader means every
+    follower is ineligible the same way and runs staged itself)."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
 class Planner:
     """Per-storage device query planner with an LRU plan cache."""
 
@@ -462,10 +481,14 @@ class Planner:
         self.namespace = namespace
         self._cache: "OrderedDict[tuple, _PlanEntry]" = OrderedDict()
         self._lock = threading.Lock()
+        # scan coalescing (singleflight): identical concurrent fetches
+        # keyed by (plan key, window, grid) share ONE gathered dispatch
+        self._flights: dict[tuple, _Flight] = {}
         # cache stats for /debug surfaces
         self.hits = 0
         self.misses = 0
         self.fallbacks = 0
+        self.coalesced = 0
 
     def evict_stale(self) -> int:
         """Drop cached plans whose pool/fileset stamp no longer holds —
@@ -497,7 +520,14 @@ class Planner:
         """Serve one fetch through a device plan. Returns
         (metas, values_f64 [S, T], datapoints) or raises Ineligible with
         the routing reason (the caller records it and runs staged).
-        ``grid`` is the engine's consolidation timestamp vector."""
+        ``grid`` is the engine's consolidation timestamp vector.
+
+        Concurrent identical fetches COALESCE: while one thread's scan is
+        in flight, any other thread arriving with the same (plan key,
+        window, grid) joins it instead of dispatching its own — N
+        concurrent queries over the same resident blocks cost ONE device
+        dispatch (the in-flight execution is the batching window; a
+        joiner records plan_coalesced and zero deviceDispatches)."""
         if not plan_enabled():
             raise Ineligible("plan-disabled")
         if staged_forced():
@@ -530,12 +560,55 @@ class Planner:
             tuple(blocks),
             t_grid,
         )
+        from . import stats
+
+        fkey = key + (fetch_lo, fetch_hi, grid.tobytes(), lookback_nanos)
+        with self._lock:
+            fl = self._flights.get(fkey)
+            leader = fl is None
+            if leader:
+                fl = self._flights[fkey] = _Flight()
+        if not leader:
+            # join the in-flight identical scan: this query dispatches
+            # nothing (device_dispatches ticks on the leader's thread)
+            fl.event.wait()
+            if fl.error is not None:
+                if isinstance(fl.error, Ineligible):
+                    # a fresh instance per thread: the reason is shared,
+                    # the traceback must not be
+                    raise Ineligible(fl.error.reason)
+                raise fl.error
+            self.coalesced += 1
+            _M_COALESCED.inc()
+            stats.add(plan_coalesced=1)
+            matched, values, datapoints, err_rows = fl.result
+            # own values array per follower: the err-lane stitch and
+            # downstream transforms may write rows
+            return matched, np.array(values, copy=True), datapoints, err_rows
+        try:
+            result = self._run_leader(
+                key, q, seg, arrays, ns, pool, blocks, t_grid,
+                fetch_lo, fetch_hi, grid, lookback_nanos,
+            )
+            fl.result = result
+            return result
+        except BaseException as exc:
+            fl.error = exc
+            raise
+        finally:
+            with self._lock:
+                self._flights.pop(fkey, None)
+            fl.event.set()
+
+    def _run_leader(self, key, q, seg, arrays, ns, pool, blocks, t_grid,
+                    fetch_lo: int, fetch_hi: int, grid: np.ndarray,
+                    lookback_nanos: int):
+        from . import stats
+
         with self._lock:
             entry = self._cache.get(key)
             if entry is not None:
                 self._cache.move_to_end(key)
-        from . import stats
-
         if entry is not None and self._valid(entry, seg, arrays, ns, pool):
             self.hits += 1
             _M_HITS.inc()

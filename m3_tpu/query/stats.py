@@ -57,6 +57,14 @@ class QueryStats:
     # who is charged for this query (query/tenants.py): stamped from the
     # thread's tenant context at start(); "" renders as anonymous
     tenant: str = ""
+    # admission-scheduler surface (query/scheduler.py): where the query is
+    # in its lifecycle — "queued" (waiting for an admission slot),
+    # "running", "hedged" (running, and the client fan-out issued a hedged
+    # backup replica request for it), or "shed" (rejected by the
+    # scheduler) — plus the priority score the scheduler computed for it
+    # (higher = shed sooner)
+    queue_state: str = "running"
+    priority: float = 0.0
     # the enforcer-chain scope that 422'd the query (query/tenant/global),
     # None when no cost limit tripped — a rejection must leave a record
     # trail, not just an HTTP status
@@ -85,6 +93,10 @@ class QueryStats:
     plan_hits: int = 0
     plan_misses: int = 0
     plan_fallbacks: int = 0
+    # scan coalescing (query/plan.py singleflight): fetches served by
+    # JOINING another concurrent query's in-flight device scan — this
+    # query paid zero dispatches for them
+    plan_coalesced: int = 0
     # profiled device-kernel dispatches charged to this query (the
     # KernelProfiler seam, utils/instrument.set_dispatch_counter): the
     # fused pipeline's acceptance metric — a warm plan-served query is
@@ -109,6 +121,8 @@ class QueryStats:
             "query": self.query,
             "namespace": self.namespace,
             "tenant": self.tenant,
+            "queueState": self.queue_state,
+            "priority": self.priority,
             "limitExceeded": self.limit_exceeded,
             "startUnixNanos": self.start_unix_nanos,
             "durationSecs": self.duration_secs,
@@ -125,6 +139,7 @@ class QueryStats:
             "planHits": self.plan_hits,
             "planMisses": self.plan_misses,
             "planFallbacks": self.plan_fallbacks,
+            "planCoalesced": self.plan_coalesced,
             "deviceDispatches": self.device_dispatches,
             "traceId": self.trace_id,
             "error": self.error,
@@ -274,6 +289,7 @@ def add(
     plan_hits: int = 0,
     plan_misses: int = 0,
     plan_fallbacks: int = 0,
+    plan_coalesced: int = 0,
 ) -> None:
     """Charge scan counters against this thread's active query (no-op
     outside a query, so storage paths call it unconditionally)."""
@@ -293,6 +309,7 @@ def add(
     st.plan_hits += plan_hits
     st.plan_misses += plan_misses
     st.plan_fallbacks += plan_fallbacks
+    st.plan_coalesced += plan_coalesced
 
 
 def _count_dispatch(_kernel: str) -> None:
@@ -380,6 +397,8 @@ class ActiveQueryRegistry:
                 "query": st.query,
                 "namespace": st.namespace,
                 "tenant": st.tenant,
+                "queueState": st.queue_state,
+                "priority": st.priority,
                 "traceId": st.trace_id,
                 "stage": st.current_stage,
                 "startUnixNanos": st.start_unix_nanos,

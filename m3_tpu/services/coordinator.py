@@ -50,6 +50,7 @@ class Coordinator:
         base_dir: str | None = None,
         query_limits=None,
         tenant_limits=None,
+        scheduler=None,
     ) -> None:
         import tempfile
 
@@ -84,6 +85,7 @@ class Coordinator:
             limits=query_limits,
             global_enforcer=global_enforcer,
             tenant_enforcers=tenant_enforcers,
+            scheduler=scheduler,
         )
         self.downsampler = downsampler
         self.kv = kv or KVStore()
@@ -116,6 +118,9 @@ class Coordinator:
                 limits=self.engine.limits,
                 global_enforcer=self.engine.global_enforcer,
                 tenant_enforcers=self.engine.tenant_enforcers,
+                # ONE admission scheduler across namespaces: the slots
+                # bound the process, not each namespace separately
+                scheduler=self.engine.scheduler,
             )
             # cache only namespaces the store actually knows: the param
             # comes off an unauthenticated HTTP query string, and caching
@@ -942,11 +947,34 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(c.graphite_find(q.get("query", ["*"])[0]))
                 else:
                     self._json({"error": "not found"}, 404)
-        except Exception as exc:  # surface handler errors as 4xx
-            from ..query.cost import QueryLimitError
+        except Exception as exc:  # surface handler errors as 4xx/5xx
+            self._handler_error(exc)
 
-            code = 422 if isinstance(exc, QueryLimitError) else 400
-            self._json({"status": "error", "error": str(exc)}, code)
+    def _handler_error(self, exc: Exception) -> None:
+        """Typed error mapping shared by GET/POST: a scheduler shed is
+        503 (retry later, with errorType=shed + Retry-After), a cost
+        limit is 422 (your query is too expensive), anything else 400."""
+        from ..query.cost import QueryLimitError
+        from ..query.scheduler import QueryShedError
+
+        if isinstance(exc, QueryShedError):
+            body = json.dumps(
+                {
+                    "status": "error",
+                    "errorType": "shed",
+                    "reason": exc.reason,
+                    "error": str(exc),
+                }
+            ).encode()
+            self.send_response(503)
+            self.send_header("Retry-After", "1")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        code = 422 if isinstance(exc, QueryLimitError) else 400
+        self._json({"status": "error", "error": str(exc)}, code)
 
     def do_POST(self) -> None:
         from ..utils.trace import TRACER
@@ -1076,10 +1104,7 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._json({"error": "not found"}, 404)
         except Exception as exc:
-            from ..query.cost import QueryLimitError
-
-            code = 422 if isinstance(exc, QueryLimitError) else 400
-            self._json({"status": "error", "error": str(exc)}, code)
+            self._handler_error(exc)
 
 
 def _prom_range(q: dict) -> tuple[int, int]:
@@ -1212,6 +1237,30 @@ def main(argv=None) -> int:
         help="host:port of an extra RPC-scrapable process (dbnode port, "
         "aggregator --debug-port) to pull into the self-scrape",
     )
+    p.add_argument(
+        "--sched-max-inflight",
+        type=int,
+        default=0,
+        help="cost-aware query admission (query/scheduler.py): at most "
+        "this many PromQL queries evaluate concurrently; excess queries "
+        "queue by shed-priority (tenant pressure + estimated cost − age) "
+        "and the worst are shed with typed 503s "
+        "(m3tpu_query_shed_total{tenant,reason}). 0 disables admission",
+    )
+    p.add_argument(
+        "--sched-max-queue",
+        type=int,
+        default=64,
+        help="admission queue capacity (with --sched-max-inflight): past "
+        "it the worst-priority entry is shed with reason=queue_full",
+    )
+    p.add_argument(
+        "--sched-max-wait",
+        type=float,
+        default=5.0,
+        help="max seconds a query may wait queued before a "
+        "reason=deadline shed (with --sched-max-inflight)",
+    )
     p.add_argument("--instance-id", default="coordinator0")
     p.add_argument(
         "--profile-hz",
@@ -1277,9 +1326,18 @@ def main(argv=None) -> int:
         from ..query.tenants import load_tenant_limits
 
         tenant_limits = load_tenant_limits(tenant_limits_path)
+    scheduler = None
+    if args.sched_max_inflight > 0:
+        from ..query.scheduler import QueryScheduler
+
+        scheduler = QueryScheduler(
+            max_inflight=args.sched_max_inflight,
+            max_queue=args.sched_max_queue,
+            max_queue_wait=args.sched_max_wait,
+        )
     coord = Coordinator(
         db=db, namespace=namespace, query_limits=limits, kv=kv,
-        tenant_limits=tenant_limits,
+        tenant_limits=tenant_limits, scheduler=scheduler,
     )
     coord.instance_id = args.instance_id
     server, bound = serve(coord, port, host=host)

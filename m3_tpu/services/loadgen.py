@@ -250,6 +250,13 @@ class Rejected(Exception):
     tenant being 422'd is the SYSTEM working, not the bench failing."""
 
 
+class Shed(Exception):
+    """The target load-shed the op (HTTP 503 / QueryShedError from the
+    admission scheduler) — like Rejected, a typed outcome counted apart
+    from hard errors: under deliberate overload, sheds landing on the
+    over-limit tenant are the scheduler working as designed."""
+
+
 def make_tenant_client_factory(args):
     """Tenant-attributed client factory: ops carry the tenant identity
     the way a real caller would (M3-Tenant header on the coordinator
@@ -271,6 +278,8 @@ def make_tenant_client_factory(args):
                     exc.close()
                     if exc.code == 422:
                         raise Rejected(str(exc)) from exc
+                    if exc.code == 503:
+                        raise Shed(str(exc)) from exc
                     raise
 
             def write(self, tenant: str, series_idx: int) -> None:
@@ -362,7 +371,7 @@ def _percentile_ms(lats: list[float], q: float) -> float:
 
 
 class _TenantStats:
-    __slots__ = ("writes", "reads", "errors", "rejected", "ok", "lats")
+    __slots__ = ("writes", "reads", "errors", "rejected", "shed", "ok", "lats")
     # enough samples for a stable p99 at bench rates; past the cap new
     # latencies overwrite a rotating slot so the reservoir stays recent
     MAX_LATS = 200_000
@@ -372,6 +381,7 @@ class _TenantStats:
         self.reads = 0
         self.errors = 0
         self.rejected = 0
+        self.shed = 0
         self.ok = 0
         # SERVICED-op latencies only: a capped tenant's p99 must measure
         # what the system did for it, not the 422 fast-path round trip
@@ -429,6 +439,8 @@ def run_multitenant(args, client_cls) -> dict:
                     client.write(tenant, k % args.series)
             except Rejected:
                 outcome = "rejected"
+            except Shed:
+                outcome = "shed"
             except Exception:
                 outcome = "error"
             lat = time.perf_counter() - t0
@@ -436,6 +448,8 @@ def run_multitenant(args, client_cls) -> dict:
             with lock:
                 if outcome == "rejected":
                     st.rejected += 1
+                elif outcome == "shed":
+                    st.shed += 1
                 elif outcome == "error":
                     st.errors += 1
                 if is_read:
@@ -465,18 +479,20 @@ def run_multitenant(args, client_cls) -> dict:
     elapsed = max(time.monotonic() - t0, 1e-9)
 
     tenants_out = {}
-    total_ops = total_errors = total_rejected = 0
+    total_ops = total_errors = total_rejected = total_shed = 0
     for name, st in per_tenant.items():
         ops = st.writes + st.reads
         total_ops += ops
         total_errors += st.errors
         total_rejected += st.rejected
+        total_shed += st.shed
         tenants_out[name] = {
             "ops": ops,
             "writes": st.writes,
             "reads": st.reads,
             "errors": st.errors,
             "rejected": st.rejected,
+            "shed": st.shed,
             "ops_per_sec": round(ops / elapsed, 1),
             "p50_ms": _percentile_ms(st.lats, 0.50),
             "p95_ms": _percentile_ms(st.lats, 0.95),
@@ -494,6 +510,7 @@ def run_multitenant(args, client_cls) -> dict:
         "reads": sum(s.reads for s in per_tenant.values()),
         "errors": total_errors,
         "rejected": total_rejected,
+        "shed": total_shed,
         "achieved_writes_per_sec": round(
             sum(s.writes for s in per_tenant.values()) / elapsed, 1
         ),
@@ -646,23 +663,25 @@ def merge_multitenant_results(per_agent: list[dict], elapsed: float) -> dict:
     rejected must survive aggregation or a heavily rejected tenant looks
     like a clean run."""
     merged: dict[str, dict] = {}
-    missed = rejected = total_ops = 0
+    missed = rejected = shed = total_ops = 0
     for r in per_agent:
         if "error" in r:
             continue
         missed += r.get("missed_ticks", 0)
         rejected += r.get("rejected", 0)
+        shed += r.get("shed", 0)
         for name, t in (r.get("tenants") or {}).items():
             m = merged.setdefault(
                 name,
                 {
                     "ops": 0, "writes": 0, "reads": 0, "errors": 0,
-                    "rejected": 0,
+                    "rejected": 0, "shed": 0,
                     "p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
                 },
             )
             for k in ("ops", "writes", "reads", "errors", "rejected"):
                 m[k] += t[k]
+            m["shed"] += t.get("shed", 0)
             for k in ("p50_ms", "p95_ms", "p99_ms"):
                 m[k] = max(m[k], t[k])
     for m in merged.values():
@@ -673,6 +692,7 @@ def merge_multitenant_results(per_agent: list[dict], elapsed: float) -> dict:
         "tenants": merged,
         "missed_ticks": missed,
         "rejected": rejected,
+        "shed": shed,
         "sustained_ops_per_sec": round(total_ops / elapsed, 1),
     }
 

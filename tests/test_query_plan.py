@@ -389,6 +389,77 @@ def test_plan_invalidates_on_new_sealed_block(plan_db):
     assert 777.0 in vf[mf.index(tuple(sorted(tags)))]
 
 
+def test_concurrent_identical_queries_coalesce_to_one_scan(plan_db):
+    """Scan coalescing (singleflight in Planner.run): N identical
+    eligible queries arriving together execute as FEWER device scans
+    than queries — followers share the leader's arrays (copied, so
+    callers can't alias each other) and the answers stay bit-identical
+    to a solo run."""
+    import threading
+
+    _seed(plan_db)
+    storage = M3Storage(plan_db, "ns")
+    eng = Engine(storage)
+    q = 'rate(pm{job=~"app.*"}[2m])'
+    baseline, base_metas, _ = _run(eng, q, SPAN)  # compile + build
+    n = 8
+    barrier = threading.Barrier(n)
+    rows = [None] * n
+    recs = [None] * n
+    errs = []
+
+    def worker(i):
+        st = stats.start(q)
+        try:
+            barrier.wait()
+            r = eng.query_range(q, *SPAN)
+            rows[i] = (np.asarray(r.values), [m.tags for m in r.metas])
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errs.append(exc)
+        finally:
+            stats.finish(st, 0.0)
+            recs[i] = st
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive()
+    assert not errs, errs
+    dispatches = sum(st.device_dispatches for st in recs)
+    coalesced = sum(st.plan_coalesced for st in recs)
+    assert dispatches < n, [st.device_dispatches for st in recs]
+    assert coalesced >= 1 and coalesced == storage.planner.coalesced
+    # every follower (no dispatch of its own) still got the exact answer
+    for vals, metas in rows:
+        assert metas == base_metas
+        eq = (vals == baseline) | (np.isnan(vals) & np.isnan(baseline))
+        assert eq.all()
+    # followers got COPIES of the leader's value grid, never views of
+    # the same buffer — one caller's result can't alias another's
+    for i in range(1, n):
+        assert not np.shares_memory(rows[0][0], rows[i][0])
+
+
+def test_coalesce_key_distinguishes_spans(plan_db):
+    """Different fetch windows must NOT coalesce — the singleflight key
+    carries the span and grid, not just the plan identity."""
+    _seed(plan_db)
+    storage = M3Storage(plan_db, "ns")
+    eng = Engine(storage)
+    q = 'rate(pm{job=~"app.*"}[2m])'
+    _run(eng, q, SPAN)
+    before = storage.planner.coalesced
+    other = (T0 + 80 * NANOS, T0 + 480 * NANOS, 20 * NANOS)
+    _run(eng, q, other)  # sequential AND different span: no coalesce
+    _run(eng, q, SPAN)
+    assert storage.planner.coalesced == before
+
+
 # ---------------------------------------------------------------------------
 # packed side planes (ops/sideplane.py)
 # ---------------------------------------------------------------------------

@@ -762,3 +762,272 @@ def test_failure_detector_counts_and_survives_poll_errors():
         time.sleep(0.02)
     det.stop()
     assert _counter_total("failure_detector_errors_total") >= before + 3
+
+# --- jittered delay distributions (net/faults) ---
+
+
+def test_faultrule_jitter_roundtrip_and_determinism():
+    """Jitter fields survive the JSON env seam, and a fixed seed plus a
+    fixed request sequence replays the exact same jittered delays."""
+    rules = [FaultRule(op="fetch", delay=0.1, delay_prob=0.5, jitter=0.05),
+             FaultRule(op="write", delay=0.2, jitter=0.1,
+                       delay_dist="lognormal")]
+    a = FaultPlan(rules, seed=123)
+    b = FaultPlan.from_json(a.to_json())
+    assert b.rules[0].jitter == 0.05
+    assert b.rules[1].delay_dist == "lognormal"
+    seq = [("fetch", "n0"), ("write", "n1")] * 40
+    assert [a.decide(op, p) for op, p in seq] == [
+        b.decide(op, p) for op, p in seq
+    ]
+
+
+def test_faultrule_jitter_spreads_and_stays_nonnegative():
+    plan = FaultPlan([FaultRule(delay=0.05, jitter=0.05)], seed=7)
+    delays = [plan.decide("fetch", "n0")[1] for _ in range(50)]
+    assert min(delays) >= 0.0
+    assert len(set(delays)) > 10  # jitter actually varies the draws
+    assert all(d <= 0.1 + 1e-9 for d in delays)  # uniform: delay + jitter cap
+
+    # lognormal: median near delay, right tail can exceed delay + jitter
+    ln = FaultPlan(
+        [FaultRule(delay=0.05, jitter=0.05, delay_dist="lognormal")], seed=7
+    )
+    draws = sorted(ln.decide("fetch", "n0")[1] for _ in range(200))
+    assert draws[0] > 0.0  # lognormal never hits zero
+    med = draws[len(draws) // 2]
+    assert 0.02 < med < 0.12
+    assert draws[-1] > 0.1  # the heavy tail fixed sleeps don't have
+
+
+def test_faultrule_no_jitter_is_fixed_delay():
+    plan = FaultPlan([FaultRule(delay=0.03)], seed=1)
+    assert {plan.decide("fetch", "n0")[1] for _ in range(10)} == {0.03}
+
+
+# --- latency estimator + hedge budget (net/resilience) ---
+
+
+def test_latency_estimator_p95_and_rank():
+    from m3_tpu.net.resilience import LatencyEstimator
+
+    est = LatencyEstimator(window=32, min_samples=8)
+    assert est.p95("n0", "fetch") is None  # unmeasured: no made-up threshold
+    for i in range(7):
+        est.record("n0", "fetch", 0.01)
+    assert est.p95("n0", "fetch") is None  # still below min_samples
+    est.record("n0", "fetch", 0.01)
+    assert est.p95("n0", "fetch") == pytest.approx(0.01)
+    # a regime change decays in as old samples leave the window
+    for _ in range(32):
+        est.record("n0", "fetch", 0.5)
+    assert est.p95("n0", "fetch") == pytest.approx(0.5)
+
+    for t, peer in ((0.02, "n1"), (0.3, "n2")):
+        for _ in range(8):
+            est.record(peer, "fetch", t)
+    # fastest first; the unmeasured peer sorts last
+    assert est.rank(["n2", "n3", "n1"], "fetch") == ["n1", "n2", "n3"]
+
+
+def test_hedge_budget_bounds_extra_load():
+    from m3_tpu.net.resilience import HedgeBudget
+
+    b = HedgeBudget(max_tokens=8.0, token_ratio=0.05)
+    spent = 0
+    while b.try_spend():
+        spent += 1
+    assert spent == 4  # refuses at half the bucket
+    before = _counter_total("session_hedge_budget_exhausted_total")
+    assert not b.try_spend()
+    assert _counter_total("session_hedge_budget_exhausted_total") > before
+    # 5% deposit per served request: ~20 successes buy one more hedge
+    for _ in range(20):
+        b.on_success()
+    assert b.try_spend()
+
+
+# --- hedged replica requests (client/session) ---
+
+
+def _warm_session(cluster, **knobs):
+    s = cluster.session()
+    for k, v in knobs.items():
+        setattr(s, k, v)
+    return s
+
+
+def test_hedged_fetch_beats_straggler_grace(tmp_path):
+    """One replica with a seeded injected delay LONGER than the
+    straggler grace: with hedging on, the fan-out issues a backup to a
+    fast replica once the straggler exceeds its own p95 and the read
+    completes well under the grace wait — with the hedge counted won."""
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    s = _warm_session(cluster, straggler_grace=2.0, hedge_min_delay=0.05)
+    sid = s.write_tagged(((b"__name__", b"hedge_t"),), T0, 5.0)
+
+    # warm the per-(peer, op) p95 estimates with clean reads
+    for _ in range(10):
+        assert [dp.value for dp in s.fetch(sid, T0 - 1, T0 + HOUR)] == [5.0]
+
+    # a per-REQUEST tail (like real stragglers), not a dead host: the
+    # first in-flight request stalls 1s, the hedged backup goes through
+    # clean — first-response-wins must let the backup answer the merge
+    slow = cluster.nodes["node1"]
+    orig = slow.fetch_blocks
+    stalls = [1]
+
+    def stall_once(*a, **k):
+        if stalls and stalls.pop():
+            time.sleep(1.0)
+        return orig(*a, **k)
+
+    slow.fetch_blocks = stall_once
+    issued0 = _counter_total("session_hedges_issued_total")
+    won0 = _counter_total("session_hedges_won_total")
+    t0 = time.perf_counter()
+    vals = [dp.value for dp in s.fetch(sid, T0 - 1, T0 + HOUR)]
+    elapsed = time.perf_counter() - t0
+    assert vals == [5.0]
+    assert elapsed < 0.9, elapsed  # neither the 1s nap nor the 2s grace
+    assert _counter_total("session_hedges_issued_total") > issued0
+    assert _counter_total("session_hedges_won_total") > won0
+    s.close()
+
+
+def test_hedge_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("M3_TPU_HEDGE", "0")
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    s = _warm_session(cluster, straggler_grace=0.3, hedge_min_delay=0.01)
+    sid = s.write_tagged(((b"__name__", b"hedge_off"),), T0, 2.0)
+    for _ in range(10):
+        s.fetch(sid, T0 - 1, T0 + HOUR)
+    slow = cluster.nodes["node1"]
+    orig = slow.fetch_blocks
+    slow.fetch_blocks = lambda *a, **k: (time.sleep(1.0), orig(*a, **k))[1]
+    before = _counter_total("session_hedges_issued_total")
+    vals = [dp.value for dp in s.fetch(sid, T0 - 1, T0 + HOUR)]
+    assert vals == [2.0]
+    assert _counter_total("session_hedges_issued_total") == before
+    s.close()
+
+
+def test_hedge_never_fires_for_non_idempotent_ops(tmp_path):
+    """Writes must never hedge: a hedged write could double-apply. The
+    hedger is only constructed for ops in wire.IDEMPOTENT_OPS."""
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    s = _warm_session(cluster, straggler_grace=0.5, hedge_min_delay=0.0)
+    # warm write-path latency samples so a threshold WOULD exist
+    for i in range(10):
+        s.write_tagged(((b"__name__", b"widem"), (b"i", b"%d" % i)), T0, 1.0)
+    slow = cluster.nodes["node1"]
+    orig = slow.write_tagged_batch
+    slow.write_tagged_batch = lambda *a, **k: (time.sleep(0.4), orig(*a, **k))[1]
+    before = _counter_total("session_hedges_issued_total")
+    s.write_tagged(((b"__name__", b"widem"), (b"i", b"zz")), T0, 1.0)
+    assert _counter_total("session_hedges_issued_total") == before
+    s.close()
+
+
+def test_hedge_winner_abandoned_twin_not_an_error(tmp_path):
+    """First-response-wins: when the hedge twin answers first, the
+    abandoned primary must not surface as a replica error (and vice
+    versa) — repeated hedged reads stay error-free and bit-exact."""
+    cluster = LocalCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                           base_dir=str(tmp_path))
+    s = _warm_session(cluster, straggler_grace=2.0, hedge_min_delay=0.02)
+    sids = [
+        s.write_tagged(((b"__name__", b"htwin"), (b"i", b"%d" % i)), T0,
+                       float(i))
+        for i in range(6)
+    ]
+    for sid in sids:  # warm estimates
+        s.fetch(sid, T0 - 1, T0 + HOUR)
+    plan = FaultPlan([FaultRule(op="fetch_blocks", peer="node2",
+                                delay=0.3, jitter=0.1)], seed=11)
+    wrap_nodes(cluster.nodes, plan)
+    for i, sid in enumerate(sids):
+        vals = [dp.value for dp in s.fetch(sid, T0 - 1, T0 + HOUR)]
+        assert vals == [float(i)]
+    res = s.fetch_tagged(term(b"__name__", b"htwin"), T0 - 1, T0 + HOUR)
+    assert res.exhaustive
+    assert {row[0]: [dp.value for dp in row[2]] for row in res} == {
+        sid: [float(i)] for i, sid in enumerate(sids)
+    }
+    s.close()
+
+
+@pytest.mark.slow
+def test_property_hedging_retries_unstrict_proc_cluster(tmp_path):
+    """Satellite property over a REAL 3-process cluster: hedging +
+    ``op_retries`` + UNSTRICT_MAJORITY under a seeded delay+drop
+    FaultPlan on one node never double-merges one replica's response,
+    never surfaces a hedge loser (or a dropped/retried leg) as an
+    error, and stays value-exact against the unhedged baseline. Writes
+    are NOT faulted (the rule is op-scoped to fetch_tagged), so all
+    three replicas hold every series and any responding subset must
+    merge to the identical answer."""
+    from m3_tpu.testing.faults import env_with_plan
+    from m3_tpu.testing.proc_cluster import ProcCluster
+
+    plan = FaultPlan(
+        [FaultRule(op="fetch_tagged", drop=0.15, delay=0.2,
+                   delay_prob=0.4, jitter=0.12, delay_dist="lognormal")],
+        seed=23,
+    )
+    cluster = ProcCluster(num_nodes=3, num_shards=4, replica_factor=3,
+                          base_dir=str(tmp_path),
+                          node_env={"node1": env_with_plan(plan)})
+    try:
+        hedged = cluster.session(
+            read_cl=ConsistencyLevel.UNSTRICT_MAJORITY
+        )
+        hedged.hedge_enabled = True
+        hedged.op_retries = 2
+        hedged.straggler_grace = 0.4
+        hedged.hedge_min_delay = 0.02
+        expect = {}
+        for i in range(8):
+            tags = ((b"__name__", b"prop_h"), (b"i", b"%d" % i))
+            sid = hedged.write_tagged(tags, T0, float(i))
+            hedged.write(sid, T0 + NANOS, float(i) + 0.5)
+            expect[sid] = [float(i), float(i) + 0.5]
+        q = term(b"__name__", b"prop_h")
+
+        def read_map(s):
+            res = s.fetch_tagged(q, T0 - 1, T0 + HOUR)
+            rows = {}
+            for sid, _tags, dps in res:
+                ts = [dp.timestamp for dp in dps]
+                # no double-merge: timestamps unique and sorted, one
+                # value per written point
+                assert ts == sorted(set(ts)), ts
+                rows[sid] = [dp.value for dp in dps]
+            return rows
+
+        unhedged = cluster.session(
+            read_cl=ConsistencyLevel.UNSTRICT_MAJORITY
+        )
+        unhedged.hedge_enabled = False
+        unhedged.op_retries = 2
+        unhedged.straggler_grace = 0.4
+        assert read_map(unhedged) == expect  # unhedged baseline
+        issued0 = _counter_total("session_hedges_issued_total")
+        won0 = _counter_total("session_hedges_won_total")
+        wasted0 = _counter_total("session_hedges_wasted_total")
+        for _ in range(24):  # warms p95 estimates, then hedges engage
+            assert read_map(hedged) == expect
+        issued = _counter_total("session_hedges_issued_total") - issued0
+        won = _counter_total("session_hedges_won_total") - won0
+        wasted = _counter_total("session_hedges_wasted_total") - wasted0
+        # accounting invariant: every issued hedge settles exactly once
+        # (won or wasted) — a double-settle would double-merge, a
+        # missing settle would leak a leg
+        assert won + wasted == issued
+        unhedged.close()
+        hedged.close()
+    finally:
+        cluster.close()

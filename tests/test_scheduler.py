@@ -1,0 +1,300 @@
+"""Cost-aware admission scheduler (query/scheduler.py): fast path,
+priority ordering, queue-full eviction, deadline sheds, the DAGOR
+overload gate, and the engine integration (QueryStats stamping + cost
+memo feedback + typed QueryShedError surfacing)."""
+
+import threading
+import time
+
+import pytest
+
+from m3_tpu.query.scheduler import (
+    SHED_DEADLINE,
+    SHED_OVERLOAD,
+    SHED_QUEUE_FULL,
+    CostMemo,
+    QueryScheduler,
+    QueryShedError,
+    tenant_pressure,
+)
+from m3_tpu.query.tenants import LEDGER, tenant_context
+from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+NANOS = 1_000_000_000
+T0 = 1_700_000_000 * NANOS
+
+
+def _counter_total(name: str, **label_filter) -> float:
+    fam = METRICS.collect().get(f"m3tpu_{name}")
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for child in fam["children"]:
+        if all(child["labels"].get(k) == v for k, v in label_filter.items()):
+            total += child["value"]
+    return total
+
+
+def _join(threads, timeout=5.0):
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "admission thread wedged"
+
+
+# --- fast path + scoring ---
+
+
+def test_fast_path_admit_release():
+    s = QueryScheduler(max_inflight=2, max_queue=4)
+    s.admit("up", 10)
+    s.admit("up", 10)
+    snap = s.snapshot()
+    assert snap["inflight"] == 2 and snap["queued"] == []
+    s.release()
+    s.release()
+    assert s.snapshot()["inflight"] == 0
+
+
+def test_score_terms():
+    s = QueryScheduler()
+    # cost term is bounded in [0, 1); aging is linearly negative
+    assert 0.0 <= s.score("never_seen_tenant_xyz", 1.0) < 1.0
+    assert s.score("never_seen_tenant_xyz", 1e12) < 1.0
+    assert s.score("never_seen_tenant_xyz", 1.0, age=10.0) < 0.0
+    # a tenant that keeps tripping limits dominates every other term
+    LEDGER.charge("sched_score_bad", limit_rejections=50)
+    assert tenant_pressure("sched_score_bad") > 0.9
+    assert s.score("sched_score_bad", 1.0) > s.score(
+        "never_seen_tenant_xyz", 1e12
+    )
+
+
+def test_cost_memo_lru_and_feedback():
+    m = CostMemo(capacity=2)
+    assert m.series_estimate("q1") == 1  # optimistic default
+    m.observe("q1", 40)
+    m.observe("q2", 7)
+    assert m.estimate("q1", 100) == 100.0 * 40
+    m.observe("q3", 3)  # q2 is LRU (q1 was touched by estimate)
+    assert m.series_estimate("q2") == 1
+    assert m.series_estimate("q1") == 40 and m.series_estimate("q3") == 3
+    m.observe("q1", 0)  # non-positive observations are ignored
+    assert m.series_estimate("q1") == 40
+
+
+# --- queueing + priority ---
+
+
+def test_release_admits_lowest_score_first():
+    s = QueryScheduler(max_inflight=1, max_queue=8, max_queue_wait=5.0)
+    s.admit("up", 1)  # occupy the only slot
+    LEDGER.charge("sched_prio_bad", limit_rejections=30)
+    LEDGER.charge("sched_prio_good", queries=30)
+    order = []
+
+    def enter(tenant):
+        with tenant_context(tenant):
+            s.admit("up", 1)
+        order.append(tenant)
+
+    threads = [
+        threading.Thread(target=enter, args=(t,), daemon=True)
+        for t in ("sched_prio_bad", "sched_prio_good")
+    ]
+    threads[0].start()
+    # make sure the bad tenant is queued FIRST so ordering is by score,
+    # not arrival
+    deadline = time.monotonic() + 5.0
+    while not s.snapshot()["queued"] and time.monotonic() < deadline:
+        time.sleep(0.005)
+    threads[1].start()
+    while len(s.snapshot()["queued"]) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    s.release()  # frees one slot -> good admitted despite arriving later
+    while not order and time.monotonic() < deadline:
+        time.sleep(0.005)
+    s.release()
+    _join(threads)
+    assert order == ["sched_prio_good", "sched_prio_bad"]
+    s.release()
+
+
+def test_queue_full_evicts_worst_scoring_entry():
+    # watermark > 1 disables the overload fast gate so this test hits the
+    # queue-full eviction path specifically
+    s = QueryScheduler(
+        max_inflight=1, max_queue=1, max_queue_wait=5.0,
+        overload_watermark=2.0,
+    )
+    s.admit("up", 1)
+    LEDGER.charge("sched_evict_bad", limit_rejections=30)
+    admitted = []
+
+    def innocent():
+        with tenant_context("sched_evict_good"):
+            s.admit("up", 1)
+        admitted.append(True)
+
+    t = threading.Thread(target=innocent, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not s.snapshot()["queued"] and time.monotonic() < deadline:
+        time.sleep(0.005)
+    before = _counter_total(
+        "query_shed_total", tenant="sched_evict_bad", reason=SHED_QUEUE_FULL
+    )
+    with tenant_context("sched_evict_bad"):
+        with pytest.raises(QueryShedError) as ei:
+            s.admit("up", 1)  # queue is full; worst score (us) is evicted
+    assert ei.value.reason == SHED_QUEUE_FULL
+    assert ei.value.tenant == "sched_evict_bad"
+    assert (
+        _counter_total(
+            "query_shed_total",
+            tenant="sched_evict_bad",
+            reason=SHED_QUEUE_FULL,
+        )
+        > before
+    )
+    # the innocent entry survived the eviction and gets the next slot
+    s.release()
+    _join([t])
+    assert admitted
+    s.release()
+
+
+def test_deadline_shed_stamps_record():
+    from m3_tpu.query.stats import QueryStats
+
+    s = QueryScheduler(max_inflight=1, max_queue=4, max_queue_wait=0.05)
+    s.admit("up", 1)
+    rec = QueryStats(query="up")
+    t0 = time.monotonic()
+    with tenant_context("sched_deadline_t"):
+        with pytest.raises(QueryShedError) as ei:
+            s.admit("up", 1, record=rec)
+    assert ei.value.reason == SHED_DEADLINE
+    assert 0.03 < time.monotonic() - t0 < 2.0
+    assert rec.queue_state == "shed"
+    assert s.snapshot()["queued"] == []  # shed entries leave the queue
+    s.release()
+
+
+def test_overload_gate_fast_fails_pressured_tenant_only():
+    s = QueryScheduler(
+        max_inflight=1, max_queue=4, overload_watermark=0.5,
+        max_queue_wait=5.0,
+    )
+    s.admit("up", 1)
+    LEDGER.charge("sched_gate_bad", limit_rejections=50)
+    threads = []
+    for i in range(2):  # fill the queue past the 0.5 * 4 watermark
+        t = threading.Thread(
+            target=lambda: s.admit("up", 1), daemon=True
+        )
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + 5.0
+    while len(s.snapshot()["queued"]) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    with tenant_context("sched_gate_bad"):
+        with pytest.raises(QueryShedError) as ei:
+            s.admit("up", 1)
+    assert ei.value.reason == SHED_OVERLOAD
+    assert time.monotonic() - t0 < 1.0  # fast-fail, no queue wait
+    # an innocent tenant at the same depth queues instead of shedding
+    ok = []
+
+    def innocent():
+        with tenant_context("sched_gate_good"):
+            s.admit("up", 1)
+        ok.append(True)
+
+    t = threading.Thread(target=innocent, daemon=True)
+    t.start()
+    while len(s.snapshot()["queued"]) < 3 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(s.snapshot()["queued"]) == 3  # queued, not shed
+    for _ in range(3):
+        s.release()
+    _join(threads + [t])
+    assert ok
+    for _ in range(3):
+        s.release()
+
+
+def test_ledger_charges_sheds():
+    s = QueryScheduler(max_inflight=1, max_queue=4, max_queue_wait=0.02)
+    s.admit("up", 1)
+    base = (LEDGER.window_totals("sched_ledger_t") or {}).get("sheds", 0.0)
+    with tenant_context("sched_ledger_t"):
+        with pytest.raises(QueryShedError):
+            s.admit("up", 1)
+    assert LEDGER.window_totals("sched_ledger_t")["sheds"] == base + 1
+    s.release()
+
+
+# --- engine integration ---
+
+
+def _mini_engine(tmp_path, scheduler):
+    from m3_tpu.block.core import make_tags
+    from m3_tpu.query.engine import Engine
+    from m3_tpu.query.m3_storage import M3Storage
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    db = Database(str(tmp_path), num_shards=2, commitlog_enabled=False)
+    db.create_namespace("default", NamespaceOptions())
+    for i in range(4):
+        tags = make_tags({"__name__": "sched_gauge", "i": str(i)})
+        for j in range(10):
+            db.write_tagged(
+                "default", tags, T0 + j * 10 * NANOS, float(i + j)
+            )
+    return db, Engine(M3Storage(db, "default"), scheduler=scheduler)
+
+
+def test_engine_admits_observes_and_stamps(tmp_path):
+    from m3_tpu.query import stats
+
+    s = QueryScheduler(max_inflight=4)
+    db, engine = _mini_engine(tmp_path, s)
+    try:
+        res = engine.query_range("sched_gauge", T0, T0 + 90 * NANOS, 10 * NANOS)
+        assert len(res.metas) == 4
+        rec = next(
+            r
+            for r in reversed(stats.RING.dump())
+            if r["query"] == "sched_gauge"
+        )
+        assert rec["queueState"] == "running"
+        assert isinstance(rec["priority"], float)
+        # the observed series count feeds the cost memo: the next
+        # admission prices this query at its real cardinality
+        assert s.costs.series_estimate("sched_gauge") == 4
+        assert s.snapshot()["inflight"] == 0  # released in the finally
+    finally:
+        db.close()
+
+
+def test_engine_shed_surfaces_typed_error(tmp_path):
+    s = QueryScheduler(max_inflight=1, max_queue=4, max_queue_wait=0.05)
+    db, engine = _mini_engine(tmp_path, s)
+    try:
+        s.admit("elsewhere", 1)  # saturate the only slot
+        with tenant_context("sched_engine_t"):
+            with pytest.raises(QueryShedError) as ei:
+                engine.query_range(
+                    "sched_gauge", T0, T0 + 90 * NANOS, 10 * NANOS
+                )
+        assert ei.value.reason == SHED_DEADLINE
+        assert ei.value.tenant == "sched_engine_t"
+        # the shed query never took (or leaked) a slot
+        assert s.snapshot()["inflight"] == 1
+        s.release()
+        # the engine still works after a shed
+        res = engine.query_range("sched_gauge", T0, T0 + 90 * NANOS, 10 * NANOS)
+        assert len(res.metas) == 4
+    finally:
+        db.close()

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""CI guard for the concurrent-query scheduler (PR 14): hedged replica
+requests, cost-aware admission/shedding, and result fidelity.
+
+Boots a REAL 3-dbnode RF=3 process cluster with ONE replica
+fault-injected to straggle (seeded jittered lognormal delay on its
+``fetch_tagged`` data plane — a latency tail, not a dead host), plus
+three coordinators sharing it:
+
+- U: hedging force-disabled (``M3_TPU_HEDGE=0``) — the baseline probe;
+- H: hedging on, no admission scheduler — the tail-latency comparison;
+- S: hedging on + ``--sched-max-inflight`` + per-tenant limits — the
+  overload/shed phase.
+
+Asserts the scheduler contract end-to-end:
+
+- hedges actually fire on H (``m3tpu_session_hedges_won_total`` > 0)
+  within the hedge budget (issued ≤ ~5% of replica requests + burst);
+- hedged read p99 measurably below the unhedged baseline p99 under the
+  same straggler plan, zero client-visible errors on both;
+- under sustained overload through S, the over-limit tenant absorbs ALL
+  sheds (typed 503s, zero hard errors anywhere) while the free tenant
+  is never shed and its p99 stays within 1.5x of its unloaded baseline;
+- query results are bit-identical across the hedged and unhedged
+  coordinators (same stored data, same JSON payload);
+- Prometheus exposition validates on every process (3 dbnodes + 3
+  coordinators).
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_scheduler.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+LIMITS_YML = """\
+tenants:
+  capped:
+    max_datapoints: 25
+  free: {}
+  probe: {}
+"""
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _metric_total(exposition: str, name: str, must_contain: str = "") -> float:
+    total = 0.0
+    for line in exposition.splitlines():
+        if line.startswith(name) and must_contain in line:
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return total
+
+
+def _loadgen(coordinator: str, tenants: str, rate: float, duration: float,
+             read_fraction: float, series: int = 30, workers: int = 6,
+             offset: int = 0) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-m", "m3_tpu.services.loadgen",
+         "--coordinator", coordinator, "--tenants", tenants,
+         "--rate", str(rate), "--duration", str(duration),
+         "--read-fraction", str(read_fraction), "--series", str(series),
+         "--series-offset", str(offset), "--workers", str(workers)],
+        capture_output=True, text=True, timeout=180,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"loadgen failed: {out.stderr[-400:]!r}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from tools.check_metrics import validate_exposition
+
+    from m3_tpu.testing.faults import FaultPlan, FaultRule, env_with_plan
+    from m3_tpu.testing.proc_cluster import ProcCluster, _spawn_listening
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    # node1's read data plane straggles: ~3% of fetch_tagged calls draw a
+    # lognormal delay with 0.5s median — far past the default 10ms hedge
+    # floor and past straggler_grace (0.25s), so an unhedged read that
+    # hits it pays the full grace wait while a hedged one gets a backup
+    # twin. 3% keeps node1's p95 estimate CLEAN (the trigger stays
+    # sharp), and writes are untouched (rule is op-scoped).
+    plan = FaultPlan(
+        [FaultRule(op="fetch_tagged", delay=0.5, delay_prob=0.10,
+                   jitter=0.2, delay_dist="lognormal")],
+        seed=41,
+    )
+
+    base_dir = tempfile.mkdtemp(prefix="m3tpu-check-sched-")
+    limits_path = os.path.join(base_dir, "tenant-limits.yml")
+    with open(limits_path, "w") as f:
+        f.write(LIMITS_YML)
+
+    cluster = None
+    coords: list = []
+    try:
+        cluster = ProcCluster(
+            num_nodes=3, num_shards=4, replica_factor=3,
+            base_dir=base_dir,
+            node_env={"node1": env_with_plan(plan)},
+        )
+
+        def spawn_coord(tag: str, extra=(), env_extra=None):
+            proc, host, port = _spawn_listening(
+                [sys.executable, "-m", "m3_tpu.services.coordinator",
+                 "--port", "0", "--kv-endpoint", cluster.kv_endpoint,
+                 "--cluster", "--heartbeat-timeout", "2.0",
+                 "--instance-id", f"coord-{tag}", *extra],
+                f"coordinator-{tag}", env_extra=env_extra,
+            )
+            coords.append(proc)
+            return f"{host}:{port}"
+
+        unhedged = spawn_coord("u", env_extra={"M3_TPU_HEDGE": "0"})
+        hedged = spawn_coord("h")
+        sched = spawn_coord(
+            "s",
+            extra=("--tenant-limits", limits_path,
+                   "--sched-max-inflight", "1",
+                   "--sched-max-queue", "8",
+                   "--sched-max-wait", "1.0"),
+        )
+
+        # --- phase 1: straggler tail, unhedged vs hedged -------------
+        # unmeasured warmups first: the first reads through each
+        # coordinator pay one-time JIT/plan-compile costs that would
+        # otherwise land in whichever probe runs first; the measured
+        # probes then run LIGHT (this is a shared-core CI box — a
+        # saturating rate would put queueing delay, not the straggler,
+        # at p99 on both sides)
+        _loadgen(unhedged, "probe:1", rate=10, duration=3, read_fraction=0.8,
+                 series=10, workers=2)
+        _loadgen(hedged, "probe:1", rate=10, duration=3, read_fraction=0.8,
+                 series=10, workers=2)
+        stats_u = _loadgen(unhedged, "probe:1", rate=15, duration=10,
+                           read_fraction=0.8, series=10, workers=3)
+        stats_h = _loadgen(hedged, "probe:1", rate=15, duration=10,
+                           read_fraction=0.8, series=10, workers=3)
+        pu = stats_u["tenants"]["probe"]
+        ph = stats_h["tenants"]["probe"]
+        check(pu["errors"] == 0 and ph["errors"] == 0,
+              f"zero client-visible errors under the straggler plan "
+              f"(unhedged={pu['errors']}, hedged={ph['errors']})")
+        check(ph["p99_ms"] < 0.6 * pu["p99_ms"],
+              f"hedged p99 < 0.6x unhedged p99 "
+              f"({ph['p99_ms']}ms vs {pu['p99_ms']}ms)")
+
+        with urllib.request.urlopen(
+            f"http://{hedged}/metrics", timeout=30
+        ) as r:
+            h_expo = r.read().decode()
+        won = _metric_total(h_expo, "m3tpu_session_hedges_won_total")
+        issued = _metric_total(h_expo, "m3tpu_session_hedges_issued_total")
+        check(won > 0, f"hedges fired and won on the hedged coordinator "
+              f"(won={won}, issued={issued})")
+        # budget: <= token_ratio (5%) of replica responses + the burst
+        # bucket (8 tokens)
+        replica_reqs = 3 * max(1, stats_h["reads"])
+        check(issued <= 0.05 * replica_reqs + 8,
+              f"hedge volume within the 5% budget "
+              f"(issued={issued}, replica requests={replica_reqs})")
+        with urllib.request.urlopen(
+            f"http://{unhedged}/metrics", timeout=30
+        ) as r:
+            u_expo = r.read().decode()
+        check(_metric_total(u_expo, "m3tpu_session_hedges_issued_total") == 0,
+              "M3_TPU_HEDGE=0 probe issued zero hedges")
+
+        # --- phase 2: bit-identical results, hedged vs unhedged ------
+        # both coordinators read the SAME stored cluster data over a
+        # fixed past window; the hedged path (backup legs, loser
+        # suppression) must not change a single byte of the answer
+        now = time.time()
+        q = ("/api/v1/query_range?query="
+             "%7B__name__%3D~%22load_probe_.*%22%7D"
+             f"&start={now - 120}&end={now}&step=5")
+        identical = True
+        for _ in range(6):
+            du = _get_json(f"http://{unhedged}{q}")
+            dh = _get_json(f"http://{hedged}{q}")
+            if not (du.get("status") == dh.get("status") == "success"):
+                identical = False
+                break
+            if json.dumps(du["data"], sort_keys=True) != json.dumps(
+                dh["data"], sort_keys=True
+            ):
+                identical = False
+                break
+        check(identical,
+              "query results bit-identical across hedged/unhedged "
+              "coordinators (6 repeated reads)")
+
+        # --- phase 3: overload shedding lands on the over-limit tenant
+        # free-tenant unloaded baseline through S (scheduler on, no
+        # contention)
+        base_free = _loadgen(sched, "free:1", rate=30, duration=4,
+                             read_fraction=0.7, offset=100)
+        free_base_p99 = base_free["tenants"]["free"]["p99_ms"]
+        # build the capped tenant's pressure: its reads trip
+        # max_datapoints (422s -> ledger limit_rejections), which is the
+        # dominant term of its shed score
+        pre = _loadgen(sched, "capped:1", rate=80, duration=4,
+                       read_fraction=0.8, offset=200)
+        check(pre["tenants"]["capped"]["rejected"] > 0,
+              f"capped tenant tripped its cost limit "
+              f"(rejected={pre['tenants']['capped']['rejected']})")
+        # sustained overload: ~2x what --sched-max-inflight 1 serves,
+        # dominated by the misbehaving tenant
+        over = _loadgen(sched, "capped:3,free:1", rate=250, duration=8,
+                        read_fraction=0.7, workers=10, offset=200)
+        capped = over["tenants"]["capped"]
+        free = over["tenants"]["free"]
+        check(capped["shed"] > 0,
+              f"overload sheds fired (capped shed={capped['shed']})")
+        check(free["shed"] == 0,
+              f"the capped tenant absorbed ALL sheds "
+              f"(free shed={free['shed']}, capped shed={capped['shed']})")
+        check(capped["errors"] == 0 and free["errors"] == 0,
+              f"sheds are typed 503s, never hard errors "
+              f"(capped={capped['errors']}, free={free['errors']})")
+        check(free["p99_ms"] <= 1.5 * free_base_p99 + 5.0,
+              f"free tenant p99 within 1.5x of unloaded baseline "
+              f"({free['p99_ms']}ms vs {free_base_p99}ms base)")
+        with urllib.request.urlopen(
+            f"http://{sched}/metrics", timeout=30
+        ) as r:
+            s_expo = r.read().decode()
+        check(_metric_total(s_expo, "m3tpu_query_shed_total",
+                            'tenant="capped"') > 0,
+              "m3tpu_query_shed_total attributes sheds to the capped tenant")
+        check(_metric_total(s_expo, "m3tpu_query_shed_total",
+                            'tenant="free"') == 0,
+              "m3tpu_query_shed_total clean for the free tenant")
+
+        # --- phase 4: exposition validates on every process ----------
+        for tag, expo in (("coord-u", u_expo), ("coord-h", h_expo),
+                          ("coord-s", s_expo)):
+            errs = validate_exposition(expo)
+            check(not errs, f"{tag} exposition validates ({errs[:2]})")
+        for nid, pn in sorted(cluster.nodes.items()):
+            try:
+                expo = pn.client.metrics()
+                errs = validate_exposition(expo)
+                check(not errs, f"{nid} exposition validates ({errs[:2]})")
+            except Exception as exc:
+                check(False, f"{nid} exposition scraped over RPC ({exc})")
+        # the straggler node really injected delays
+        check(_metric_total(cluster.nodes["node1"].client.metrics(),
+                            "m3tpu_faults_injected_total") > 0,
+              "node1 reports injected delay faults")
+    finally:
+        for proc in coords:
+            proc.kill()
+            proc.wait(timeout=10)
+        if cluster is not None:
+            cluster.close()
+        import shutil
+
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    if failures:
+        print(f"\nFAIL: {len(failures)} scheduler contract violation(s)")
+        return 1
+    print("\nscheduler contract holds: hedging cuts the tail, sheds are "
+          "typed and targeted, results stay bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

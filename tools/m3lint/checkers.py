@@ -396,11 +396,15 @@ class MetricNameDiscipline(Checker):
     # "shard": configured shard ids (bounded by --num-shards), hard-capped
     # by resident/heat.ShardHeat (M3_TPU_SHARD_HEAT_CAP, overflow
     # collapsed loudly) — the per-shard heat signal rebalancing keys off.
+    # "reason": the shed/rejection cause vocabulary — a hand-enumerated
+    # constant set per emitting module (query/scheduler.py's SHED_*
+    # trio), never derived from request data; paired with "tenant" it is
+    # what lets dashboards split "who got shed" from "why".
     # Deliberately ABSENT: "frame"/"stack" — profile stacks are
     # unbounded runtime data and live in the profiling table
     # (m3_tpu/profiling/), never in metric labels.
     LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
-                  "ns", "group", "tenant", "scope", "shard"}
+                  "ns", "group", "tenant", "scope", "shard", "reason"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
