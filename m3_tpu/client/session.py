@@ -236,6 +236,14 @@ class _DaemonPool:
         self._inflight = 0  # submitted, not yet finished
 
     def submit(self, fn, *args) -> Future:
+        # the submitter's ambient deadline (coordinator HTTP timeout →
+        # resilience.deadline_scope) is a thread-local, so it must be
+        # captured here and re-established inside the worker — otherwise
+        # every fanned-out replica call would budget as if the caller
+        # were willing to wait forever
+        from ..net.resilience import current_deadline
+
+        deadline = current_deadline()
         fut: Future = Future()
         with self._lock:
             # invariant: threads >= min(max, inflight) — every
@@ -248,21 +256,24 @@ class _DaemonPool:
                 threading.Thread(
                     target=self._run, daemon=True, name="session-fanout"
                 ).start()
-        self._q.put((fut, fn, args))
+        self._q.put((fut, fn, args, deadline))
         return fut
 
     def _run(self) -> None:
+        from ..net.resilience import deadline_scope
+
         while True:
             item = self._q.get()
             if item is None:  # close() sentinel
                 with self._lock:
                     self._threads -= 1
                 return
-            fut, fn, args = item
+            fut, fn, args, deadline = item
             try:
                 if fut.set_running_or_notify_cancel():
                     try:
-                        fut.set_result(fn(*args))
+                        with deadline_scope(deadline):
+                            fut.set_result(fn(*args))
                     except BaseException as exc:
                         fut.set_exception(exc)
             finally:

@@ -125,8 +125,19 @@ class RpcClient:
         only (transport failures and typed retryable rejections); every
         attempt is gated by the peer's circuit breaker and bounded by one
         shared per-call deadline that also rides the wire."""
+        budget = _timeout if _timeout is not None else self.timeout
+        # ambient-deadline propagation (resilience.deadline_scope): a call
+        # made under a caller-supplied deadline (coordinator HTTP timeout)
+        # never budgets past what the caller will wait for — the tightened
+        # budget also rides the wire as the _deadline frame, so the server
+        # refuses work the client has already abandoned.
+        from .resilience import remaining_time
+
+        ambient = remaining_time()
+        if ambient is not None:
+            budget = min(budget, max(ambient, 0.0))
         # m3lint: disable=M3L004 -- the wire _deadline frame is wall-clock by protocol (must mean the same instant in another process)
-        deadline = time.time() + (_timeout if _timeout is not None else self.timeout)
+        deadline = time.time() + budget
         retryable = _retry and op in wire.IDEMPOTENT_OPS
         attempt = 0
         prev_backoff = 0.0
@@ -329,8 +340,33 @@ class RemoteNode(RpcClient):
         )
         return {bytes(k): {bytes(v) for v in vs} for k, vs in out}
 
-    def stream_shard(self, ns, shard):
-        return wire.series_from_wire(self._call("stream_shard", ns=ns, shard=shard))
+    def stream_shard(self, ns, shard, exclude_blocks=None):
+        """Decoded peer stream of one shard; ``exclude_blocks`` skips
+        sealed blocks the caller already imported via migration (their
+        buffered overlays still stream — only fileset content dedupes)."""
+        args = {"ns": ns, "shard": shard}
+        if exclude_blocks:
+            args["exclude"] = sorted(exclude_blocks)
+        return wire.series_from_wire(self._call("stream_shard", **args))
+
+    def migrate_manifest(self, ns, shard) -> list:
+        """Sealed-fileset inventory of a shard on this peer (the
+        migration source's streamable file roles + byte sizes)."""
+        return self._call("migrate_manifest", ns=ns, shard=shard)
+
+    def migrate_fetch(
+        self, ns, shard, block_start, volume, suffix, offset, max_bytes,
+        _timeout=None,
+    ) -> dict:
+        """One resumable byte-range read of one fileset file role on this
+        peer — deadline-bounded per chunk (``_timeout``) and transparently
+        retried under the idempotent-op budget, so a partial transfer
+        resumes at the byte offset rather than restarting the file."""
+        return self._call(
+            "migrate_fetch", _timeout=_timeout, ns=ns, shard=shard,
+            block_start=block_start, volume=volume, suffix=suffix,
+            offset=offset, max_bytes=max_bytes,
+        )
 
     def block_metadata(self, ns, shard):
         return self._call("block_metadata", ns=ns, shard=shard)

@@ -345,7 +345,38 @@ class NodeService:
         return [[k, sorted(vs)] for k, vs in agg.items()]
 
     def op_stream_shard(self, req):
-        return wire.series_to_wire(self.db.stream_shard(req["ns"], req["shard"]))
+        return wire.series_to_wire(
+            self.db.stream_shard(
+                req["ns"], req["shard"],
+                exclude_blocks=req.get("exclude") or (),
+            )
+        )
+
+    # -- shard-handoff migration source (warm residency streaming) --
+
+    def op_migrate_manifest(self, req):
+        """Streamable sealed-fileset inventory for one shard: per complete
+        fileset, byte sizes of every file role (compressed data pages,
+        packed side planes, index/bloom/summaries, digest) a receiver
+        fetches before cutover."""
+        from ..storage.fs import migration_manifest
+
+        return migration_manifest(self.db.base, req["ns"], req["shard"])
+
+    def op_migrate_fetch(self, req):
+        """One resumable byte-range read of one fileset file role
+        ({"data": bytes, "eof": bool}). Immutable source files make
+        re-reads duplicate-safe; a fileset retention raced away surfaces
+        as the error the receiver's fallback handles."""
+        from ..storage.fs import FilesetID, read_fileset_chunk
+
+        fid = FilesetID(
+            req["ns"], req["shard"], req["block_start"], req["volume"]
+        )
+        data, eof = read_fileset_chunk(
+            self.db.base, fid, req["suffix"], req["offset"], req["max_bytes"]
+        )
+        return {"data": data, "eof": eof}
 
     # -- repair endpoints (storage/repair.go metadata + block fetch) --
 

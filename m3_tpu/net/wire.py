@@ -215,6 +215,12 @@ IDEMPOTENT_OPS = frozenset(
         "health", "fetch", "fetch_blocks", "fetch_tagged", "query_ids",
         "aggregate_query", "stream_shard", "block_metadata",
         "stream_series_blocks", "scan_totals", "query_range", "owned_shards",
+        # shard-handoff migration reads: the manifest lists immutable
+        # sealed filesets, and a fetch is a byte-range read of one
+        # fileset file — re-reading the same range is duplicate-safe, so
+        # transfers survive transport failures via the normal budgeted
+        # retry machinery
+        "migrate_manifest", "migrate_fetch",
         # debug / observability ('profile' reads the process's folded
         # stack table — sampling continues regardless, duplicate-safe)
         "metrics", "traces", "cache_stats", "resident_stats", "index_stats",

@@ -157,8 +157,15 @@ class Engine:
                 # cost-aware admission: may block briefly, may shed with
                 # a typed QueryShedError (coordinator → HTTP 503); only
                 # top-level queries admit — nested evaluation rides the
-                # outer query's slot
-                self.scheduler.admit(query, steps, record=qs)
+                # outer query's slot. The queue wait is bounded by the
+                # caller's propagated deadline when one is ambient
+                # (coordinator timeout param/header), else by the
+                # scheduler's own max_queue_wait.
+                from ..net.resilience import current_deadline
+
+                self.scheduler.admit(
+                    query, steps, record=qs, deadline=current_deadline()
+                )
                 admitted = True
             parent = self.global_enforcer
             if self.tenant_enforcers is not None:

@@ -256,6 +256,7 @@ class ResidentPool:
         self.inplace_admissions = 0
         self.copy_admissions = 0
         self.side_pack_overflows = 0
+        self.rebalance_evictions = 0
         reg = registry or METRICS
         self._m_admissions = reg.counter(
             "resident_admissions_total", "blocks admitted to the resident pool"
@@ -288,6 +289,11 @@ class ResidentPool:
             "resident_copy_admissions_total",
             "admissions that fell back to the functional copy because a "
             "scan lease was active",
+        )
+        self._m_rebalance_evictions = reg.counter(
+            "resident_rebalance_evictions_total",
+            "entries evicted by the heat-driven budget rebalance after a "
+            "topology change (over-share shards shed LRU-oldest first)",
         )
         self._m_side_overflow = reg.counter(
             "resident_side_pack_overflows_total",
@@ -956,6 +962,28 @@ class ResidentPool:
             self._drop_complete_locked(namespace, shard_id, block_start, below_volume)
             return self._drop_locked(keys)
 
+    def drop_shard(self, namespace: str | None, shard_id: int) -> int:
+        """Drop every entry of one shard — the SOURCE side of a shard
+        handoff: once the placement stops assigning the shard here its
+        residency is dead weight starving the shards this node still
+        owns. ``namespace=None`` matches all namespaces."""
+        with self._lock:
+            self._drop_pending_locked(
+                lambda k: k.shard_id == shard_id
+                and (namespace is None or k.namespace == namespace)
+            )
+            keys = {
+                k
+                for k in self._od
+                if k.shard_id == shard_id
+                and (namespace is None or k.namespace == namespace)
+            }
+            for k in keys:
+                self._drop_complete_locked(
+                    k.namespace, k.shard_id, k.block_start, None
+                )
+            return self._drop_locked(keys)
+
     def clear(self) -> int:
         with self._lock:
             self._drop_pending_locked(lambda k: True)
@@ -974,6 +1002,73 @@ class ResidentPool:
             self._m_invalidations.inc(n)
             self._publish_locked()
             return n
+
+    def shard_usage(self) -> dict[tuple[str, int], int]:
+        """Resident bytes per (namespace, shard) across published entries
+        — the heat-driven rebalancer's occupancy input."""
+        with self._lock:
+            usage: dict[tuple[str, int], int] = {}
+            for key, entry in self._od.items():
+                k = (key.namespace, key.shard_id)
+                usage[k] = usage.get(k, 0) + entry.nbytes
+            return usage
+
+    def rebalance(self, heat: dict, slack: float = 0.10) -> int:
+        """Heat-driven budget redistribution after a topology change:
+        shards holding MORE than their heat-weighted share of the byte
+        budget shed LRU-oldest entries first, freeing pages for gained
+        hot shards' warm streaming and read-through re-admission.
+
+        ``heat`` is ShardHeat.dump() shape ({shard_id_str: {"hits", ...}});
+        a shard's weight is hits+misses (demand observed at the router),
+        floored at 1 so an unmeasured shard keeps a sliver instead of
+        being wiped. ``slack`` avoids churn at the boundary. Nothing is
+        admitted here — admission stays flush/demand-driven; this only
+        makes room where the heat says it is owed. Returns entries
+        evicted (counted in ``resident_rebalance_evictions_total``)."""
+        with self._lock:
+            usage: dict[tuple[str, int], int] = {}
+            for key, entry in self._od.items():
+                k = (key.namespace, key.shard_id)
+                usage[k] = usage.get(k, 0) + entry.nbytes
+            if len(usage) <= 1:
+                return 0  # one shard resident: nothing to redistribute
+            weights = {}
+            for k in usage:
+                h = heat.get(str(k[1])) or {}
+                weights[k] = max(
+                    float(h.get("hits", 0)) + float(h.get("misses", 0)), 1.0
+                )
+            total_w = sum(weights.values())
+            budget = float(self.options.max_bytes)
+            victims: list = []
+            for k, used in usage.items():
+                target = budget * (weights[k] / total_w) * (1.0 + slack)
+                over = float(used) - target
+                if over <= 0:
+                    continue
+                for key, entry in self._od.items():  # LRU order: oldest first
+                    if (key.namespace, key.shard_id) != k:
+                        continue
+                    victims.append(key)
+                    over -= entry.nbytes
+                    if over <= 0:
+                        break
+            for key in victims:
+                entry = self._od.pop(key, None)
+                if entry is None:
+                    continue
+                self._unindex_locked(key, entry)
+                self._free.extend(entry.pages)
+                self._free_side.extend(entry.side_pages)
+                self._resident_bytes -= entry.nbytes
+                self.evictions += 1
+                self._m_evictions.inc()
+                self.rebalance_evictions += 1
+                self._m_rebalance_evictions.inc()
+            if victims:
+                self._publish_locked()
+            return len(victims)
 
     def _reset_locked(self) -> None:
         """Last-resort recovery for a failed DONATED scatter: the old
@@ -1107,6 +1202,7 @@ class ResidentPool:
                 "inplace_admissions": self.inplace_admissions,
                 "copy_admissions": self.copy_admissions,
                 "side_pack_overflows": self.side_pack_overflows,
+                "rebalance_evictions": self.rebalance_evictions,
                 "epoch": self.epoch,
                 "shard_heat": self.heat.dump(),
             }

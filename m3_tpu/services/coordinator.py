@@ -645,6 +645,28 @@ class _Handler(BaseHTTPRequestHandler):
             self.headers.get("M3-Tenant") or q.get("tenant", [None])[0]
         )
 
+    def _deadline_scope(self, q: dict):
+        """Client deadline propagation: the ``timeout=`` query param (or
+        ``M3-Timeout`` header) in duration syntax (``500``, ``2.5``,
+        ``30s``, ``1m``) becomes the request thread's ambient MONOTONIC
+        deadline — QueryScheduler.admit bounds its queue wait by it
+        (shed reason ``deadline``) and outbound RPC calls tighten their
+        wall-clock budget and ``_deadline`` frame to it, so nobody works
+        for a caller that already gave up. Unparseable or absent →
+        no-op scope (only ``--sched-max-wait`` bounds the wait)."""
+        from ..net.resilience import deadline_scope
+
+        raw = self.headers.get("M3-Timeout") or q.get("timeout", [None])[0]
+        if not raw:
+            return deadline_scope(None)
+        try:
+            timeout_s = _parse_step(raw)
+        except ValueError:
+            return deadline_scope(None)
+        import time as _time
+
+        return deadline_scope(_time.monotonic() + timeout_s)
+
     def _debug_dump(self) -> bytes:
         """x/debug/debug.go zip dump: thread stacks, metrics, namespaces,
         placement, recent traces."""
@@ -762,7 +784,7 @@ class _Handler(BaseHTTPRequestHandler):
 
             tenant = self._tenant(q)
             span.set_tag("tenant", tenant)
-            with tenant_context(tenant), span:
+            with tenant_context(tenant), self._deadline_scope(q), span:
                 if url.path == "/health":
                     self._json({"ok": True})
                 elif url.path == "/metrics":
@@ -984,10 +1006,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             from ..query.tenants import tenant_context
 
-            tenant = self._tenant(parse_qs(url.query))
+            q = parse_qs(url.query)
+            tenant = self._tenant(q)
             span = TRACER.span("http.post", path=url.path)
             span.set_tag("tenant", tenant)
-            with tenant_context(tenant), span:
+            with tenant_context(tenant), self._deadline_scope(q), span:
                 if url.path in (
                     "/api/v1/graphite/render",
                     "/render",

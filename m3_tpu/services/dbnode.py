@@ -103,6 +103,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--heartbeat-timeout", type=float, default=10.0)
     p.add_argument(
+        "--no-migration",
+        action="store_true",
+        help="disable warm residency migration on shard handoff (gained "
+        "shards then rebuild purely from the decoded peers stream)",
+    )
+    p.add_argument(
+        "--migration-chunk-bytes",
+        type=int,
+        default=1 << 20,
+        help="byte-range size of one resumable migrate_fetch chunk",
+    )
+    p.add_argument(
+        "--migration-chunk-timeout",
+        type=float,
+        default=5.0,
+        help="per-chunk deadline of migration fetches; a dead source "
+        "costs at most this long before the next replica resumes",
+    )
+    p.add_argument(
         "--max-inflight",
         type=int,
         default=0,
@@ -344,7 +363,10 @@ def main(argv=None) -> int:
 
         threading.Thread(target=hb_loop, daemon=True, name="heartbeat").start()
         cluster_db = state["cluster_db"] = ClusterDatabase(
-            db, args.node_id, PlacementService(kv), node_service=service
+            db, args.node_id, PlacementService(kv), node_service=service,
+            migration_enabled=not args.no_migration,
+            migration_chunk_bytes=args.migration_chunk_bytes,
+            migration_chunk_timeout=args.migration_chunk_timeout,
         )
         cluster_db.start()
 

@@ -48,6 +48,9 @@ class ClusterDatabase:
         peer_factory=_default_peer_factory,
         on_bootstrapped=None,
         retry_secs: float = 2.0,
+        migration_enabled: bool = True,
+        migration_chunk_bytes: int = 1 << 20,
+        migration_chunk_timeout: float = 5.0,
     ) -> None:
         self.db = db
         self.node_id = node_id
@@ -56,8 +59,12 @@ class ClusterDatabase:
         self.peer_factory = peer_factory
         self.on_bootstrapped = on_bootstrapped
         self.retry_secs = retry_secs
+        self.migration_enabled = migration_enabled
+        self.migration_chunk_bytes = migration_chunk_bytes
+        self.migration_chunk_timeout = migration_chunk_timeout
         self._lock = threading.Lock()
         self._bootstrapping: set[int] = set()
+        self._assigned: set[int] | None = None  # None until first placement
         self._stopped = threading.Event()
         self._unsub = None
 
@@ -78,6 +85,14 @@ class ClusterDatabase:
         shards = set(inst.shards) if inst else set()
         if self.node_service is not None:
             self.node_service.assigned_shards = shards
+        with self._lock:
+            lost = self._assigned - shards if self._assigned is not None else set()
+            self._assigned = shards
+        if lost:
+            # source side of a handoff: the receiver marked our shard's
+            # replacement AVAILABLE and the placement dropped it here —
+            # free its residency so the surviving shards get the budget
+            self._on_shards_lost(sorted(lost))
         if inst is None:
             return
         with self._lock:
@@ -119,14 +134,24 @@ class ClusterDatabase:
         gained_ids = [s for s, _ in gained]
         preferred = {s: a.source_instance for s, a in gained}
 
+        # warm residency migration BEFORE the bootstrap chain runs: pull
+        # sealed blocks' raw fileset bytes (compressed pages + packed side
+        # planes) from the handoff sources so the resident pool and index
+        # are warm before the shards flip AVAILABLE. Returns the migrated
+        # block starts per (ns, shard); the decoded peers stream below
+        # excludes them so sealed content never re-enters the write path
+        # (re-buffering would force the streamed scan path post-cutover).
+        migrated = self._migrate_gained(p, gained)
+
         def peers_source(ns_name: str, shard: int):
+            excl = sorted(migrated.get((ns_name, shard), ()))
             for src in self._stream_sources(p, shard, preferred.get(shard)):
                 try:
                     peer = self.peer_factory(src.endpoint)
                 except Exception:
                     continue
                 try:
-                    return peer.stream_shard(ns_name, shard)
+                    return peer.stream_shard(ns_name, shard, exclude_blocks=excl)
                 except Exception:
                     continue  # dead/unreachable peer: try the next replica
                 finally:
@@ -163,6 +188,10 @@ class ClusterDatabase:
         if done:
             self._mark_available(done)
             METRICS.counter("peers_bootstrap_shards_total").inc(len(done))
+            # topology changed and the gained shards are serving: re-split
+            # the resident byte budget by observed demand so cold incumbent
+            # shards shed pages the migrated hot shards are owed
+            self._rebalance_pool()
             if self.on_bootstrapped is not None:
                 self.on_bootstrapped(done)
         if failed and not self._stopped.is_set():
@@ -184,6 +213,211 @@ class ClusterDatabase:
                 target=_retry, daemon=True,
                 name=f"peers-bootstrap-retry-{self.node_id}",
             ).start()
+
+    # -- warm residency migration (sealed fileset bytes move ahead of cutover) --
+
+    def _migrate_gained(self, p: Placement, gained) -> dict:
+        """Stream sealed filesets' raw bytes from the handoff sources for
+        every gained shard, hottest shard first, committing + admitting
+        each fileset as it lands so the resident pool and device index
+        warm BEFORE the shard flips AVAILABLE.
+
+        Returns {(ns_name, shard): {block_start, ...}} of blocks whose
+        fileset content was committed locally — the decoded peers stream
+        excludes exactly these. A shard whose every source fails mid-way
+        falls back to the decoded fileset-driven rebuild for whatever was
+        not yet committed (counted, never wedging INITIALIZING: committed
+        filesets stay excluded, everything else streams normally)."""
+        migrated: dict[tuple[str, int], set[int]] = {}
+        if not self.migration_enabled:
+            return migrated
+        preferred = {s: a.source_instance for s, a in gained}
+        peers: dict[str, object] = {}
+
+        def _peer(endpoint: str):
+            peer = peers.get(endpoint)
+            if peer is None:
+                peer = peers[endpoint] = self.peer_factory(endpoint)
+            return peer
+
+        # one residency-heat snapshot per distinct handoff source: order
+        # the gained shards hottest-first so a budget cut or mid-handoff
+        # death leaves warm what queries actually touch
+        heat: dict[int, float] = {}
+        for src_id in {preferred.get(s) for s, _ in gained}:
+            inst = p.instances.get(src_id) if src_id else None
+            if inst is None or not inst.endpoint:
+                continue
+            try:
+                dump = _peer(inst.endpoint).resident_stats().get("shard_heat", {})
+            except Exception:
+                continue  # heat ordering is a hint; cold order still works
+            for sid_str, h in dump.items():
+                try:
+                    sid = int(sid_str)
+                except (TypeError, ValueError):
+                    continue
+                heat[sid] = (
+                    heat.get(sid, 0.0)
+                    + float(h.get("hits", 0))
+                    + float(h.get("misses", 0))
+                )
+
+        with self.db.lock:
+            ns_names = list(self.db.namespaces)
+        order = sorted(
+            (s for s, _ in gained), key=lambda s: heat.get(s, 0.0), reverse=True
+        )
+        try:
+            for shard in order:
+                sources = self._stream_sources(p, shard, preferred.get(shard))
+                for ns_name in ns_names:
+                    try:
+                        n = self._migrate_shard(
+                            ns_name, shard, sources, _peer, migrated
+                        )
+                    except Exception:
+                        # all sources died mid-stream for this shard: the
+                        # decoded rebuild covers the uncommitted remainder
+                        METRICS.counter(
+                            "migration_stream_failures_total",
+                            "shard migrations that fell back to the decoded "
+                            "fileset-driven rebuild",
+                        ).inc()
+                        continue
+                    if n:
+                        METRICS.counter(
+                            "migration_shards_warm_total",
+                            "(ns, shard) handoffs whose sealed filesets were "
+                            "fully warm before cutover",
+                        ).inc()
+        finally:
+            for peer in peers.values():
+                try:
+                    peer.close()
+                except Exception:
+                    # m3lint: disable=M3L007 -- best-effort close of migration peers; transfer already finished or failed
+                    pass
+        return migrated
+
+    def _migrate_shard(self, ns_name, shard, sources, _peer, migrated) -> int:
+        """Migrate one (ns, shard)'s sealed filesets. Sources are tried in
+        placement order (preferred handoff source first); a source dying
+        mid-file costs at most one chunk — the next source resumes at the
+        local byte offset. Raises only when every source failed before the
+        manifest drained (committed filesets stay in ``migrated``)."""
+        from . import fs as _fs
+
+        warmed = 0
+        last_err = None
+        for src in sources:
+            try:
+                peer = _peer(src.endpoint)
+                manifest = peer.migrate_manifest(ns_name, shard)
+            except Exception as e:
+                last_err = e
+                continue
+            # newest blocks first: budget pushback in the pool keeps what
+            # is admitted first, and the newest sealed blocks are hottest
+            manifest.sort(
+                key=lambda m: (m["blockStart"], m["volume"]), reverse=True
+            )
+            try:
+                for entry in manifest:
+                    fid = _fs.FilesetID(
+                        ns_name, shard, int(entry["blockStart"]),
+                        int(entry["volume"]),
+                    )
+                    if not _fs.fileset_complete(self.db.base, fid):
+                        self._fetch_fileset(peer, src.id, fid, entry["files"])
+                        _fs.commit_imported_fileset(self.db.base, fid)
+                    self.db.admit_imported_fileset(ns_name, shard, fid)
+                    migrated.setdefault((ns_name, shard), set()).add(
+                        fid.block_start
+                    )
+                    warmed += 1
+                    METRICS.counter(
+                        "migration_filesets_total",
+                        "sealed filesets committed + admitted via migration",
+                    ).inc()
+                return warmed
+            except Exception as e:
+                last_err = e
+                continue
+        if last_err is not None:
+            raise last_err
+        return warmed  # no reachable source held sealed data for this shard
+
+    def _fetch_fileset(self, peer, peer_id: str, fid, files: dict) -> None:
+        """Chunked resumable fetch of every streamable file role of one
+        fileset: resume offset = local partial size, each chunk
+        deadline-bounded and transparently retried under the idempotent-op
+        budget. The checkpoint is never fetched — commit writes it locally
+        LAST, so a partial import stays invisible to queries."""
+        from . import fs as _fs
+
+        base = self.db.base
+        for suffix in _fs.MIGRATION_SUFFIXES:
+            total = int(files.get(suffix, 0))
+            offset = _fs.migration_file_size(base, fid, suffix)
+            if offset == 0 and total == 0:
+                # role exists but is empty: create it so commit can verify
+                _fs.append_fileset_chunk(base, fid, suffix, 0, b"")
+            while offset < total:
+                resp = peer.migrate_fetch(
+                    fid.namespace, fid.shard, fid.block_start, fid.volume,
+                    suffix, offset, self.migration_chunk_bytes,
+                    _timeout=self.migration_chunk_timeout,
+                )
+                data = resp["data"]
+                if data:
+                    _fs.append_fileset_chunk(base, fid, suffix, offset, data)
+                    offset += len(data)
+                    METRICS.counter(
+                        "migration_streamed_bytes_total",
+                        "raw fileset bytes pulled during shard handoff",
+                        labels={"peer": peer_id},
+                    ).inc(len(data))
+                if resp.get("eof"):
+                    # source file shorter than the manifest said: commit's
+                    # digest verification decides whether that matters
+                    break
+                if not data:
+                    raise OSError(
+                        f"migration stalled: empty non-eof chunk for "
+                        f"{fid} {suffix} @ {offset}"
+                    )
+
+    def _rebalance_pool(self) -> None:
+        pool = getattr(self.db, "resident_pool", None)
+        if pool is None or not getattr(pool, "enabled", False):
+            return
+        try:
+            pool.rebalance(pool.heat.dump())
+        except Exception:
+            # m3lint: disable=M3L007 -- budget redistribution is advisory; a failure must not take down placement handling
+            pass
+
+    def _on_shards_lost(self, shards: list[int]) -> None:
+        """Source-side cleanup after a handoff completes: the receiver is
+        AVAILABLE and the placement no longer assigns these shards here.
+        Reads are already gated by assigned_shards; drop the dead
+        residency and re-split the budget across surviving shards."""
+        pool = getattr(self.db, "resident_pool", None)
+        if pool is None or not getattr(pool, "enabled", False):
+            return
+        dropped = 0
+        for shard in shards:
+            try:
+                dropped += pool.drop_shard(None, shard)
+            except Exception:
+                continue  # best-effort cleanup; entries age out via LRU anyway
+        if dropped:
+            METRICS.counter(
+                "migration_source_dropped_total",
+                "resident entries dropped on the source after handoff",
+            ).inc(dropped)
+        self._rebalance_pool()
 
     def _mark_available(self, shards: list[int]) -> None:
         from ..cluster.placement import mark_shards_available

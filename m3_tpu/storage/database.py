@@ -103,6 +103,12 @@ class Shard:
         self.lock = threading.RLock()
         self.series: dict[bytes, SeriesBuffer] = {}
         self._flushed_blocks: set[int] = set()
+        # block_start -> live bucket count across ALL series buffers: the
+        # O(distinct buffered blocks) summary behind has_buffered_overlap.
+        # Buckets exist only while they hold points (created on first
+        # write, removed whole by flush/tick eviction), so a nonzero
+        # count is exactly "some series has buffered data in this block".
+        self._buffered_blocks: dict[int, int] = {}
         self._filesets: list[FilesetID] | None = None  # listdir cache
         self.fileset_epoch = 0  # bumps whenever the fileset set changes
         # block_start -> reader, LRU-bounded (wired_list.go:77 role: a cap on
@@ -160,9 +166,21 @@ class Shard:
             if buf is None:
                 buf = SeriesBuffer(sid, self.opts.block_size_nanos)
                 self.series[sid] = buf
-            buf.write(t_nanos, value, unit)
             bs = (t_nanos // self.opts.block_size_nanos) * self.opts.block_size_nanos
+            if bs not in buf.buckets:
+                self._buffered_blocks[bs] = self._buffered_blocks.get(bs, 0) + 1
+            buf.write(t_nanos, value, unit)
             self.invalidator.on_write(self.namespace, self.id, sid, bs)
+
+    def _buffered_dec(self, block_start: int, n: int = 1) -> None:
+        """Retire ``n`` evicted buckets from the buffered-block summary."""
+        left = self._buffered_blocks.get(block_start)
+        if left is None:
+            return
+        if left <= n:
+            del self._buffered_blocks[block_start]
+        else:
+            self._buffered_blocks[block_start] = left - n
 
     def read(
         self, sid: bytes, start: int, end: int, populate_cache: bool = True
@@ -302,6 +320,27 @@ class Shard:
         with self.lock:
             return self._segments_locked(sid, start, end)
 
+    def read_excluding(self, sid: bytes, exclude_blocks: set[int]) -> list[Datapoint]:
+        """Full-range lifecycle read SKIPPING the given sealed blocks'
+        fileset content; buffered overlays (including ones inside excluded
+        blocks — cold writes not yet flushed there) still return. The
+        peer-stream dedupe surface for migration: the receiver already
+        holds those blocks' filesets byte-identically."""
+        from ..codec.iterator import MultiReaderIterator
+
+        with self.lock:
+            segments: list[bytes] = []
+            for fid in self.filesets():
+                if fid.block_start in exclude_blocks:
+                    continue
+                stream = self._reader_locked(fid).stream(sid)
+                if stream:
+                    segments.append(stream)
+            buf = self.series.get(sid)
+            if buf is not None:
+                segments.extend(buf.streams(0, 2**62))
+        return [dp for dp in MultiReaderIterator(segments)]
+
     # --- resident-scan routing surface (m3_tpu/resident/) ---
 
     def scan_block_keys(self, sid: bytes, start: int, end: int):
@@ -327,11 +366,15 @@ class Shard:
         — the shard-level buffer-overlay gate the device query planner
         checks per execution (a fused plan reads sealed residency only,
         so one buffered point in range degrades the whole query to the
-        staged path, which applies the per-series overlay rule). Cost is
-        O(series with live buffers); zero for sealed-only workloads."""
+        staged path, which applies the per-series overlay rule). Served
+        from the maintained block-start summary: O(distinct buffered
+        blocks) regardless of how many series are ingesting, so a
+        heavily ingesting shard answering historical queries pays a few
+        integer compares, not a walk of every live buffer."""
+        bsz = self.opts.block_size_nanos
         with self.lock:
             return any(
-                buf.has_points(start, end) for buf in self.series.values()
+                bs + bsz > start and bs < end for bs in self._buffered_blocks
             )
 
     def scan_segments(self, sid: bytes, start: int, end: int) -> list[tuple]:
@@ -398,7 +441,8 @@ class Shard:
         # previously-flushed blocks stay buffered for cold_flush
         for buf in self.series.values():
             for fid in flushed:
-                buf.evict_block(fid.block_start)
+                if buf.evict_block(fid.block_start):
+                    self._buffered_dec(fid.block_start)
         # drop buffers the flush emptied (tick would anyway): keeps the
         # sealed-only fast path O(1) for has_buffered_overlap instead of
         # walking thousands of empty buckets per query
@@ -450,7 +494,8 @@ class Shard:
             write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
             flushed.append(fid)
             for sid in updates:
-                self.series[sid].evict_block(bs)
+                if self.series[sid].evict_block(bs):
+                    self._buffered_dec(bs)
         if flushed:
             self._invalidate_filesets()
             # a cold flush writes a NEW volume per block: every cached
@@ -535,7 +580,8 @@ class Shard:
         expire_before = now_nanos - self.opts.retention_nanos
         for sid in list(self.series):
             buf = self.series[sid]
-            buf.evict_before(expire_before)
+            for bs in buf.evict_before(expire_before):
+                self._buffered_dec(bs)
             if not buf.buckets:
                 del self.series[sid]
         bsz = self.opts.block_size_nanos
@@ -827,6 +873,8 @@ class Database:
                         bucket = buf.buckets.get(bs)
                         if bucket is None:
                             bucket = buf.buckets[bs] = BufferBucket(block_start=bs)
+                            buffered = sh._buffered_blocks
+                            buffered[bs] = buffered.get(bs, 0) + 1
                         bucket.times.append(t)
                         bucket.values.append(v)
                         bucket.units.append(unit_s)
@@ -1069,16 +1117,25 @@ class Database:
             }
         return out
 
-    def stream_shard(self, ns: str, shard_id: int) -> list:
+    def stream_shard(self, ns: str, shard_id: int, exclude_blocks=()) -> list:
         """Peer streaming (FetchBootstrapBlocksFromPeers / repair source):
         every (sid, tags, datapoints) owned by one shard; tags come from the
-        reverse index when available."""
+        reverse index when available. ``exclude_blocks`` skips sealed
+        blocks whose fileset content the receiver already imported via
+        migration — their data would otherwise re-enter the receiver's
+        write path, re-buffer, and wreck the warm-before-cutover contract
+        (a buffered overlay forces the streamed scan path). Buffered
+        overlays in excluded blocks still stream: they are NOT in the
+        migrated fileset."""
+        excl = set(exclude_blocks)
         with self.lock:
             namespace = self.namespaces[ns]
             sh = namespace.shards[shard_id]
             with sh.lock:
                 sids = set(sh.series)
                 for fid in sh.filesets():
+                    if fid.block_start in excl:
+                        continue
                     sids.update(sh.reader(fid).series_ids)
             docs: dict[bytes, tuple] = {}
             if namespace.index is not None and sids:
@@ -1093,10 +1150,39 @@ class Database:
             for sid in sorted(sids):
                 # a peer-streaming sweep reads every series once — don't
                 # let it evict the hot query working set
-                dps = sh.read(sid, 0, 2**62, populate_cache=False)
+                if excl:
+                    dps = sh.read_excluding(sid, excl)
+                else:
+                    dps = sh.read(sid, 0, 2**62, populate_cache=False)
                 if dps:
                     out.append((sid, docs.get(sid, ()), dps))
             return out
+
+    def admit_imported_fileset(self, ns: str, shard_id: int, fid: FilesetID) -> int:
+        """Post-commit bookkeeping for a migration-imported sealed
+        fileset: mark the block flushed, bump the shard's fileset epoch
+        (cached query plans revalidate their block set), invalidate any
+        superseded decoded/pool entries of lower volumes (on_flush — the
+        receiver may have served this block from an older fileset before
+        the handoff), re-index the imported series, then warm the
+        resident pool by re-admitting the fileset's compressed pages +
+        packed side planes. The pool's three-phase publish means a query
+        NEVER observes a partially-admitted block — it streams from the
+        (already committed) fileset until the group completes. Returns
+        admitted lanes (0 when the budget pushed back; the fileset still
+        serves streamed reads)."""
+        namespace = self.namespaces[ns]
+        sh = namespace.shards[shard_id]
+        with sh.lock:
+            sh._flushed_blocks.add(fid.block_start)
+            sh._invalidate_filesets()
+        sh.invalidator.on_flush(ns, shard_id, [fid])
+        try:
+            for sid in read_index_ids(self.base, fid):
+                self._reindex(namespace, sid, fid.block_start)
+        except FileNotFoundError:
+            return 0  # retention raced the import away
+        return sh.readmit_fileset(fid)
 
     def flush(self, ns: str, flush_before_nanos: int) -> list[FilesetID]:
         with TRACER.span("db.flush", namespace=ns):

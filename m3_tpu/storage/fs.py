@@ -281,6 +281,120 @@ def read_index_ids(base: str, fid: FilesetID) -> list[bytes]:
     return out
 
 
+# --- live-migration raw-file surface (shard handoff / warm residency) ---
+#
+# On shard handoff the source streams sealed filesets FILE-BY-FILE,
+# byte-for-byte: the data file IS the compressed pages and the side file
+# IS the packed side planes the receiver's resident pool admits, so no
+# decode/re-encode happens on either side and the imported fileset is
+# bit-identical to the source's (digest-verified). The checkpoint is
+# NEVER streamed: the receiver commits it locally LAST, so a
+# partially-fetched fileset stays invisible to list_filesets
+# (fileset_complete gates on the checkpoint) and a resumed transfer picks
+# up at the local partial file size — resumability, atomicity, and
+# integrity all fall out of the persistence format's own commit protocol.
+
+MIGRATION_SUFFIXES = SUFFIXES[:-1]  # everything but the checkpoint
+
+
+def migration_manifest(base: str, namespace: str, shard: int) -> list[dict]:
+    """Streamable fileset inventory for one shard: per complete fileset,
+    the byte size of every file role a receiver must fetch. A fileset
+    raced away by retention mid-listing is simply omitted (the receiver's
+    fallback covers anything it misses)."""
+    out = []
+    for fid in list_filesets(base, namespace, shard):
+        files: dict[str, int] = {}
+        ok = True
+        for suffix in MIGRATION_SUFFIXES:
+            try:
+                files[suffix] = os.path.getsize(_path(base, fid, suffix))
+            except OSError:
+                ok = False
+                break
+        if ok:
+            out.append(
+                {"blockStart": fid.block_start, "volume": fid.volume,
+                 "files": files}
+            )
+    return out
+
+
+def read_fileset_chunk(
+    base: str, fid: FilesetID, suffix: str, offset: int, max_bytes: int
+) -> tuple[bytes, bool]:
+    """(payload, eof): one byte-range read of one fileset file role — the
+    resumable unit of migration streaming. Raises FileNotFoundError when
+    retention deleted the fileset mid-stream (the receiver falls back)."""
+    if suffix not in MIGRATION_SUFFIXES:
+        raise ValueError(f"not a streamable fileset file role: {suffix!r}")
+    path = _path(base, fid, suffix)
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        f.seek(int(offset))
+        data = f.read(int(max_bytes))
+        return data, f.tell() >= size
+
+
+def migration_file_size(base: str, fid: FilesetID, suffix: str) -> int:
+    """Local partial size of one file role being imported — the resume
+    offset after a receiver restart or a retried transfer (0 = nothing
+    fetched yet)."""
+    try:
+        return os.path.getsize(_path(base, fid, suffix))
+    except OSError:
+        return 0
+
+
+def append_fileset_chunk(
+    base: str, fid: FilesetID, suffix: str, offset: int, data: bytes
+) -> None:
+    """Append one fetched chunk. The offset must equal the local partial
+    size (append-only resume); a mismatch means this importer lost a race
+    with another and must re-sync from migration_file_size."""
+    os.makedirs(_dir(base, fid), exist_ok=True)
+    with open(_path(base, fid, suffix), "ab") as f:
+        if f.tell() != int(offset):
+            raise ValueError(
+                f"resume offset {offset} != local size {f.tell()} for "
+                f"{fid} {suffix}"
+            )
+        f.write(data)
+
+
+def commit_imported_fileset(base: str, fid: FilesetID) -> None:
+    """Commit a fully-fetched fileset: verify every imported file against
+    the fetched digest, fsync them, then write the checkpoint LAST —
+    exactly write_fileset's crash-ordering, so an imported fileset is
+    indistinguishable from a locally flushed one. On digest mismatch the
+    partial files are deleted (the retried import starts clean) and
+    ValueError propagates so the caller counts the failure."""
+    with open(_path(base, fid, "digest"), "rb") as f:
+        digest_payload = f.read()
+    digests = json.loads(digest_payload.decode())
+    try:
+        for suffix in MIGRATION_SUFFIXES[:-1]:  # digest itself verified by checkpoint
+            with open(_path(base, fid, suffix), "rb") as f:
+                payload = f.read()
+            if zlib.adler32(payload) != digests.get(suffix):
+                raise ValueError(
+                    f"imported {suffix} digest mismatch for {fid}"
+                )
+    except (FileNotFoundError, ValueError):
+        delete_fileset(base, fid)
+        raise
+    for suffix in MIGRATION_SUFFIXES:
+        fd = os.open(_path(base, fid, suffix), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    with open(_path(base, fid, "checkpoint"), "wb") as f:
+        f.write(struct.pack("<I", zlib.adler32(digest_payload)))
+        f.flush()
+        os.fsync(f.fileno())
+
+
 class FilesetReader:
     """The mmap seeker (read.go + seek.go): id lookup via bloom filter →
     summaries binary search → bounded index scan → mmap'd data slice.

@@ -166,9 +166,15 @@ class SeriesBuffer:
             if bs + self.block_size <= flush_before_nanos
         }
 
-    def evict_before(self, t_nanos: int) -> None:
-        for bs in [b for b in self.buckets if b + self.block_size <= t_nanos]:
+    def evict_before(self, t_nanos: int) -> list[int]:
+        """Drop buckets entirely before the cutoff; returns the removed
+        block starts so the shard's buffered-block summary can decrement
+        exactly what disappeared."""
+        removed = [b for b in self.buckets if b + self.block_size <= t_nanos]
+        for bs in removed:
             del self.buckets[bs]
+        return removed
 
-    def evict_block(self, block_start: int) -> None:
-        self.buckets.pop(block_start, None)
+    def evict_block(self, block_start: int) -> bool:
+        """Drop one bucket; True iff it existed (summary bookkeeping)."""
+        return self.buckets.pop(block_start, None) is not None

@@ -295,3 +295,54 @@ def test_cost_limit_enforced_under_concurrency():
         stop.set()
         t.join(timeout=10)
         srv.shutdown()
+
+
+def test_client_timeout_sheds_with_deadline_reason():
+    """End-to-end client deadline propagation: `timeout=` (or M3-Timeout)
+    becomes the request thread's ambient deadline; with the only
+    admission slot taken, the queued query must shed with reason
+    `deadline` as a 503 well before the default queue wait."""
+    import urllib.error
+
+    from m3_tpu.query.scheduler import QueryScheduler
+
+    sched = QueryScheduler(max_inflight=1, max_queue=8, max_queue_wait=30.0)
+    coord = Coordinator(scheduler=sched)
+    srv, port = serve(coord)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        w = prompb.WriteRequest()
+        ts = w.timeseries.add()
+        ts.labels.add(name="__name__", value="dl")
+        for i in range(10):
+            ts.samples.add(value=float(i), timestamp=(T0 + i * 10) * 1000)
+        assert (
+            post(f"{base}/api/v1/prom/remote/write", compress(w.SerializeToString())).status
+            == 200
+        )
+        sched.admit("elsewhere", 1)  # saturate the only slot
+        t0 = __import__("time").monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get_json(
+                f"{base}/api/v1/query?query=dl&time={T0 + 90}&timeout=0.2"
+            )
+        elapsed = __import__("time").monotonic() - t0
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "1"
+        body = json.loads(ei.value.read())
+        assert body["errorType"] == "shed" and body["reason"] == "deadline"
+        assert elapsed < 10.0  # the 0.2s client deadline bounded the wait,
+        # not the 30s scheduler default
+        # the header spelling propagates identically
+        req = urllib.request.Request(
+            f"{base}/api/v1/query?query=dl&time={T0 + 90}",
+            headers={"M3-Timeout": "0.15"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            urllib.request.urlopen(req)
+        assert json.loads(ei2.value.read())["reason"] == "deadline"
+        sched.release()  # slot frees: the same query now succeeds
+        out = get_json(f"{base}/api/v1/query?query=dl&time={T0 + 90}&timeout=30s")
+        assert out["status"] == "success" and out["data"]["result"]
+    finally:
+        srv.shutdown()

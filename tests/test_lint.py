@@ -349,6 +349,36 @@ def test_double_prefix_and_bad_label_key_fire():
     assert codes(findings) == {"M3L005"} and len(findings) == 2
 
 
+def test_migration_label_key_outside_allowlist_fires():
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        METRICS.counter(
+            "migration_streamed_bytes_total",
+            "bytes pulled during handoff",
+            labels={"source_node": "node-a"},
+        ).inc(4096)
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+
+
+def test_migration_peer_label_key_quiet():
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        METRICS.counter(
+            "migration_streamed_bytes_total",
+            "bytes pulled during handoff",
+            labels={"peer": "node-a"},
+        ).inc(4096)
+        """
+    )
+    assert findings == []
+
+
 def test_colon_recorded_name_fires_outside_ruler():
     src = """
     from pkg.instrument import DEFAULT as METRICS

@@ -126,6 +126,17 @@ QUERIES = [
 ]
 
 
+def test_fused_vs_staged_bitexact_smoke(plan_db):
+    # one shape that composes most of the plan surface (prefix regexp +
+    # negated conjunction + temporal fn); the full per-shape sweep below
+    # is @slow — each shape pays its own fused+staged compile, and the
+    # seven together were the single largest line item in tier-1
+    _seed(plan_db)
+    eng = Engine(M3Storage(plan_db, "ns"))
+    _assert_bitexact(eng, 'avg_over_time(pm{job=~"app.*",s!="003"}[2m])', SPAN)
+
+
+@pytest.mark.slow
 def test_fused_vs_staged_bitexact_across_shapes(plan_db):
     _seed(plan_db)
     eng = Engine(M3Storage(plan_db, "ns"))

@@ -372,6 +372,7 @@ def test_sharded_resident_scan_matches_single_device():
     assert float(single.total_max) == float(sharded.total_max)
 
 
+@pytest.mark.slow  # per-class sweep; the mixed-lane property above stays tier-1
 def test_scan_totals_bit_exact_per_lane_class_property():
     """Seeded per-class property sweep: the resident-chunked scan must be
     bit-exact vs the streamed twin for EVERY lane class the classifier

@@ -1,0 +1,451 @@
+#!/usr/bin/env python
+"""CI guard for elastic placement: warm residency migration and
+zero-downtime resharding under chaos (storage/cluster_db.py).
+
+Boots a REAL 3-node RF=3 multi-process cluster with seeded fault plans —
+10% request drops + lognormal delay tails on node0/node1 and a full
+data-plane partition of node2 — seeds + seals a block of data, then runs
+the operator sequence add → rebalance → drain while loadgen-role
+read+write traffic flows the whole time:
+
+- ADD: a spare joins the placement (placement CAS, shards INITIALIZING
+  with handoff sources). The new owner must pull the sealed filesets'
+  raw bytes over migrate_manifest/migrate_fetch BEFORE flipping
+  AVAILABLE — its own exposition shows the m3tpu_migration_* family, and
+  its FIRST post-cutover scan of a migrated shard must run resident
+  (`resident-chunked` routing, zero upload/streamed bytes, zero new
+  admissions). One handoff source is the partitioned node: the receiver
+  must fail over to an AVAILABLE replica without counting a failure.
+- SOURCE SIDE: a donor that lost shards drops their residency
+  (m3tpu_migration_source_dropped_total) and re-splits its budget.
+- DRAIN: the oldest node leaves the placement; its shards redistribute
+  and every receiver reaches AVAILABLE; the process is then terminated.
+- Throughout: ZERO client-visible errors (MAJORITY writes,
+  UNSTRICT_MAJORITY reads — the reference's production read default) and
+  every read of the sealed series is BIT-IDENTICAL to what was written.
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_migration.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+
+NANOS = 1_000_000_000
+HOUR = 3600 * NANOS
+T0 = 1_600_000_000 * NANOS
+T_LIVE = T0 + 10 * HOUR
+N_SERIES = 32
+N_POINTS = 12
+SEALED_SPAN = (T0 - 1, T0 + 2 * HOUR)
+
+
+def _tags(i: int):
+    return ((b"__name__", b"sealed_gauge"), (b"i", b"%04d" % i))
+
+
+def _expected(i: int):
+    return [float(i * 100 + k) for k in range(N_POINTS)]
+
+
+def _scrape(expo: str, family: str) -> float:
+    """Sum every sample of one family in a Prometheus text exposition."""
+    total, seen = 0.0, False
+    for line in expo.splitlines():
+        m = re.match(rf"^{re.escape(family)}(?:{{[^}}]*}})? ([0-9.eE+-]+)$", line)
+        if m:
+            total += float(m.group(1))
+            seen = True
+    return total if seen else -1.0
+
+
+def _close_session(s) -> None:
+    s.close()
+    for n in s.nodes.values():
+        n.close()
+
+
+def _session_for(p):
+    """A chaos-grade session over the given placement: per-node retry
+    budgets for the droppy hosts, a breaker so the partitioned one ejects,
+    and session-level upsert retry rounds on top. Writes gate at strict
+    MAJORITY; reads run UNSTRICT_MAJORITY (the reference's production
+    read default) — during a handoff the INITIALIZING replica is excluded
+    from reads, so a moving shard has only rf-1 readable copies and a
+    strict majority is arithmetically unreachable while one of them is
+    partitioned; unstrict degrades to the replicas that DID respond, and
+    the gate still requires the degraded answers to be BIT-IDENTICAL."""
+    from m3_tpu.client.session import Session
+    from m3_tpu.cluster.topology import ConsistencyLevel, TopologyMap
+    from m3_tpu.net.client import RemoteNode
+    from m3_tpu.net.resilience import CircuitBreaker, RetryPolicy
+
+    nodes = {}
+    for i, (nid, inst) in enumerate(sorted(p.instances.items())):
+        if not inst.endpoint:
+            continue
+        host, port = inst.endpoint.rsplit(":", 1)
+        nodes[nid] = RemoteNode(
+            host, int(port), node_id=nid, timeout=5.0,
+            retry_policy=RetryPolicy(max_retries=3, seed=i),
+            breaker=CircuitBreaker(
+                peer=nid, failure_threshold=20, recovery_timeout=5.0
+            ),
+        )
+    s = Session(
+        topology=TopologyMap(p), nodes=nodes,
+        write_consistency=ConsistencyLevel.MAJORITY,
+        read_consistency=ConsistencyLevel.UNSTRICT_MAJORITY,
+    )
+    s.op_retries = 6
+    s.op_retry_backoff = 0.01
+    return s
+
+
+class _Traffic(threading.Thread):
+    """Loadgen-role client: sustained tagged writes into a live block plus
+    rotating reads of the sealed series, rebuilding
+    its session whenever the placement moves (a real client's topology
+    watch; keyed on the KV version — Placement.version is not serialized).
+    Errors and value mismatches are collected, never swallowed — the
+    gate's zero-downtime criterion."""
+
+    def __init__(self, placement_svc) -> None:
+        super().__init__(daemon=True, name="loadgen-traffic")
+        self.placement_svc = placement_svc
+        self.errors: list[str] = []
+        self.mismatches: list[str] = []
+        self.writes = 0
+        self.reads = 0
+        self._halt = threading.Event()
+        self._session = None
+        self._pver = None
+
+    def _refresh(self):
+        try:
+            p, kv_version = self.placement_svc.get_versioned()
+        except Exception:
+            return self._session  # KV blip: keep the session we have
+        if p is None:
+            return self._session
+        if self._session is None or kv_version != self._pver:
+            old = self._session
+            self._session = _session_for(p)
+            self._pver = kv_version
+            if old is not None:
+                try:
+                    _close_session(old)
+                except Exception:
+                    # m3lint: disable=M3L007 -- best-effort close of the superseded session's sockets
+                    pass
+        return self._session
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=30)
+        if self._session is not None:
+            self._session.close()
+            for n in self._session.nodes.values():
+                n.close()
+
+    def run(self) -> None:
+        i = 0
+        while not self._halt.is_set():
+            s = self._refresh()
+            if s is None:
+                time.sleep(0.1)
+                continue
+            tags = ((b"__name__", b"live_gauge"), (b"w", b"%05d" % (i % 64)))
+            try:
+                s.write_tagged(tags, T_LIVE + i * NANOS, float(i))
+                self.writes += 1
+            except Exception as exc:
+                self.errors.append(f"write {i}: {type(exc).__name__}: {exc}")
+            if i % 4 == 0:
+                k = (i // 4) % N_SERIES
+                try:
+                    from m3_tpu.rules.rules import encode_tags_id
+
+                    sid = encode_tags_id(_tags(k))
+                    vals = [dp.value for dp in s.fetch(sid, *SEALED_SPAN)]
+                    if vals != _expected(k):
+                        self.mismatches.append(
+                            f"series {k}: {vals} != {_expected(k)}"
+                        )
+                    self.reads += 1
+                except Exception as exc:
+                    self.errors.append(
+                        f"read {k}: {type(exc).__name__}: {exc}"
+                    )
+            i += 1
+            time.sleep(0.02)
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from m3_tpu.cluster.placement import (
+        ShardState,
+        add_instance,
+        remove_instance,
+    )
+    from m3_tpu.testing.faults import FaultPlan, FaultRule, env_with_plan
+    from m3_tpu.testing.proc_cluster import ProcCluster
+
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    def cas(svc, mutate) -> None:
+        while True:
+            p, version = svc.get_versioned()
+            mutate(p)
+            try:
+                svc.check_and_set(p, version)
+                return
+            except ValueError:
+                continue  # placement moved under us: re-read and re-apply
+
+    def wait_placement(svc, cond, what: str, timeout: float = 90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            p = svc.get()
+            if p is not None and cond(p):
+                return p
+            time.sleep(0.1)
+        raise TimeoutError(f"placement wait timed out: {what}")
+
+    # node0/node1: 10% request drops + a lognormal latency tail (median
+    # 5 ms, sigma 2 — the heavy right tail real stragglers have); node2:
+    # full data-plane partition (mgmt ops exempt so the fixture converges,
+    # exactly as a switch partition leaves the mgmt net alone)
+    noisy = FaultPlan(
+        [FaultRule(drop=0.10, delay=0.005, delay_prob=0.3, jitter=0.01,
+                   delay_dist="lognormal")],
+        seed=17,
+    )
+    cut = FaultPlan(
+        [FaultRule(partition=True)], seed=17, exempt_ops=("owned_shards",)
+    )
+
+    base = tempfile.mkdtemp(prefix="m3tpu-check-migration-")
+    cluster = None
+    traffic = None
+    try:
+        cluster = ProcCluster(
+            num_nodes=3, num_shards=4, replica_factor=3,
+            base_dir=base,
+            extra_args=[
+                "--resident-bytes", str(8 << 20),
+                "--index-device-bytes", str(16 << 20),
+            ],
+            node_env={
+                "node0": env_with_plan(noisy),
+                "node1": env_with_plan(noisy),
+                "node2": env_with_plan(cut),
+            },
+        )
+        svc = cluster.placement_svc
+
+        # ---- seed + seal: one block of data every later phase must keep
+        # serving bit-identically ----
+        # the traffic thread gets its OWN control-plane connection so its
+        # placement polls never interleave frames with the main thread's
+        from m3_tpu.cluster.kv_service import RemoteKVStore
+        from m3_tpu.cluster.placement import PlacementService
+
+        traffic_kv = RemoteKVStore.connect(cluster.kv_endpoint)
+        traffic = _Traffic(PlacementService(traffic_kv))
+        seed_session = _session_for(svc.get())
+        werrs = 0
+        for i in range(N_SERIES):
+            for k, v in enumerate(_expected(i)):
+                try:
+                    seed_session.write_tagged(_tags(i), T0 + k * 60 * NANOS, v)
+                except Exception as exc:
+                    werrs += 1
+                    print(f"  seed write {i}.{k} failed: {exc}")
+        check(werrs == 0, f"all {N_SERIES * N_POINTS} seed writes succeeded under chaos")
+        _close_session(seed_session)
+
+        for nid in ("node0", "node1"):  # node2 is partitioned: stays unsealed
+            client = cluster.nodes[nid].client
+            for attempt in range(10):
+                try:
+                    client.flush("default", T0 + 6 * HOUR)
+                    break
+                except Exception:
+                    if attempt == 9:
+                        raise
+                    time.sleep(0.2)  # injected drop: flush is safe to re-ask
+
+        n3_before = {}  # survivors' migration counters before any handoff
+        for nid in ("node0", "node1"):
+            n3_before[nid] = _scrape(
+                cluster.nodes[nid].client.metrics(),
+                "m3tpu_migration_source_dropped_total",
+            )
+
+        traffic.start()
+        time.sleep(1.0)  # a little steady-state traffic before the churn
+
+        # ---- ADD: spare joins, placement rebalances onto it ----
+        spare = cluster.spawn_spare("node3")
+        ep = spare.endpoint
+
+        def _add(p):
+            add_instance(p, "node3")
+            p.instances["node3"].endpoint = ep
+
+        cas(svc, _add)
+        p = wait_placement(
+            svc,
+            lambda p: "node3" in p.instances
+            and p.instances["node3"].shards
+            and all(
+                a.state == ShardState.AVAILABLE
+                for a in p.instances["node3"].shards.values()
+            ),
+            "node3 shards AVAILABLE",
+        )
+        gained = sorted(p.instances["node3"].shards)
+        check(len(gained) >= 2, f"add rebalanced {len(gained)} shards onto node3")
+        cluster.wait_for_shards()
+
+        # ---- warm-before-cutover on the new owner ----
+        expo = spare.client.metrics()
+        filesets = _scrape(expo, "m3tpu_migration_filesets_total")
+        streamed = _scrape(expo, "m3tpu_migration_streamed_bytes_total")
+        warm = _scrape(expo, "m3tpu_migration_shards_warm_total")
+        fails = _scrape(expo, "m3tpu_migration_stream_failures_total")
+        check(filesets >= len(gained),
+              f"new owner committed sealed filesets via migration ({filesets})")
+        check(streamed > 0,
+              f"m3tpu_migration_streamed_bytes_total in exposition ({streamed})")
+        check(warm >= 1,
+              f"m3tpu_migration_shards_warm_total in exposition ({warm})")
+        # one handoff source is the partitioned node: the receiver must
+        # have failed over to an AVAILABLE replica, not counted a failure
+        check(fails <= 0,
+              f"no stream failures despite a partitioned handoff source ({fails})")
+
+        rs_before = spare.client.resident_stats()
+        first = spare.client.scan_totals(
+            "default", [["__name__", "=", "sealed_gauge"]], *SEALED_SPAN,
+            explain=True,
+        )
+        rs_after = spare.client.resident_stats()
+        routing = first.get("routing") or []
+        check(first.get("path") == "resident" and first.get("count", 0) > 0,
+              f"FIRST post-cutover scan ran resident "
+              f"(path={first.get('path')}, count={first.get('count')})")
+        check(
+            len(routing) > 0
+            and all(
+                r["path"] == "resident" and r["reason"] == "resident-chunked"
+                for r in routing
+            ),
+            "every routed (series, block) served by the resident-chunked decoder",
+        )
+        check(
+            rs_after.get("upload_bytes") == rs_before.get("upload_bytes")
+            and rs_after.get("streamed_bytes", 0) == rs_before.get("streamed_bytes", 0)
+            and rs_after.get("admissions") == rs_before.get("admissions"),
+            "first post-cutover scan uploaded/streamed ZERO warm bytes "
+            "(pool was warm before the shard flipped AVAILABLE)",
+        )
+
+        # ---- source side: a donor that lost shards drops their residency ----
+        dropped = any(
+            _scrape(
+                cluster.nodes[nid].client.metrics(),
+                "m3tpu_migration_source_dropped_total",
+            )
+            > max(n3_before[nid], 0.0)
+            for nid in ("node0", "node1")
+        )
+        check(dropped, "a handoff donor dropped the lost shards' residency "
+                       "(m3tpu_migration_source_dropped_total grew)")
+
+        # ---- DRAIN: node0 leaves the placement; receivers must reach
+        # AVAILABLE with node0 still up, then the process goes away ----
+        cas(svc, lambda p: remove_instance(p, "node0"))
+        wait_placement(
+            svc,
+            lambda p: "node0" not in p.instances
+            and all(
+                a.state == ShardState.AVAILABLE
+                for inst in p.instances.values()
+                for a in inst.shards.values()
+            ),
+            "drain receivers AVAILABLE",
+        )
+        check(True, "drain: every redistributed shard reached AVAILABLE")
+        cluster.wait_for_shards()
+        cluster.nodes["node0"].terminate()
+        time.sleep(2.0)  # post-drain traffic against the shrunken cluster
+
+        traffic.stop()
+        for e in traffic.errors[:10]:
+            print("  " + e)
+        for m in traffic.mismatches[:10]:
+            print("  " + m)
+        check(
+            traffic.writes > 50 and traffic.reads > 10,
+            f"loadgen traffic actually flowed "
+            f"({traffic.writes} writes, {traffic.reads} reads)",
+        )
+        check(
+            not traffic.errors,
+            f"zero client-visible errors across add+drain "
+            f"({len(traffic.errors)} errors)",
+        )
+        check(
+            not traffic.mismatches,
+            f"every chaos-phase read of the sealed block was bit-identical "
+            f"({len(traffic.mismatches)} mismatches)",
+        )
+
+        # final quorum read with a FRESH post-drain session: the shrunken
+        # cluster still serves the sealed block bit-identically
+        fsess = _session_for(svc.get())
+        from m3_tpu.rules.rules import encode_tags_id
+
+        bad = 0
+        for i in range(N_SERIES):
+            vals = [dp.value for dp in fsess.fetch(encode_tags_id(_tags(i)), *SEALED_SPAN)]
+            if vals != _expected(i):
+                bad += 1
+                print(f"  final read {i}: {vals}")
+        check(bad == 0, "post-drain MAJORITY reads bit-identical for every series")
+        _close_session(fsess)
+        traffic_kv.close()
+    finally:
+        if traffic is not None and traffic.ident is not None:
+            traffic.stop()
+        if cluster is not None:
+            cluster.close()
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+
+    if failures:
+        print(f"\n{len(failures)} migration contract violation(s)")
+        return 1
+    print("\nelastic placement contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

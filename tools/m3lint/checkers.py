@@ -400,6 +400,11 @@ class MetricNameDiscipline(Checker):
     # constant set per emitting module (query/scheduler.py's SHED_*
     # trio), never derived from request data; paired with "tenant" it is
     # what lets dashboards split "who got shed" from "why".
+    # "peer": placement instance ids — bounded by the operator-built
+    # placement (node count), never derived from request data. The
+    # migration family (storage/cluster_db.py
+    # migration_streamed_bytes_total{peer}) keys on it so a handoff's
+    # byte flow is attributable to the source that served it.
     # Deliberately ABSENT: "frame"/"stack" — profile stacks are
     # unbounded runtime data and live in the profiling table
     # (m3_tpu/profiling/), never in metric labels.
