@@ -660,6 +660,141 @@ def bench_pipeline(n_series=None, on_tpu=False):
     )
 
 
+def bench_ingest(on_tpu):
+    """Device ingest suite (BENCH_r06 — the write-path twin of bench.py's
+    read headline). Three records:
+
+    1. ``ingest_device_write_plane`` (headline): sustained writes/s into
+       the per-shard (series_lane, slot) column planes, device syncs
+       riding along at the default IngestOptions.sync_batch cadence —
+       the client-visible write plane, the apples-to-apples twin of
+       PROFILE.md's 291k writes/s/core host BufferBucket ceiling (both
+       exclude seal-time encode, which is lazy on both paths).
+    2. ``ingest_encode_seal_kernel``: the seal-time chunk-parallel
+       m3tsz encode (ops/encode.py) in datapoints/s.
+    3. ``ingest_born_resident_seal``: end-to-end Database write->flush
+       through device ingest — proves zero upload bytes on the device
+       admissions while reporting the full-path rate.
+    """
+    import tempfile
+
+    from m3_tpu.ingest import IngestOptions
+    from m3_tpu.ingest.buffer import ColumnWriteBuffer
+    from m3_tpu.ops import encode as dev_encode
+    from m3_tpu.utils.instrument import Registry
+
+    HOST_CEILING = 291_000.0  # writes/s/core, PROFILE.md round 5
+    rng = np.random.default_rng(21)
+
+    # --- 1) write plane: sustained append+sync ---
+    B = 16384
+    lanes = 8192 if on_tpu else 2048
+    iters = 120 if on_tpu else 60
+    opts = IngestOptions(lanes=lanes, slots=1024, sync_batch=B)
+    buf = ColumnWriteBuffer(opts, 2 * 3600 * NANOS, registry=Registry("bi_"))
+    sids = [b"s%05d" % (i % lanes) for i in range(B)]
+    vals = (np.arange(B, dtype=np.float64) % 97) / 4.0
+    units = np.ones(B, np.int8)
+    base = (np.arange(B) // lanes).astype(np.int64)
+    per = B // lanes
+    buf.append_batch(sids, T0 + base * NANOS, vals, units)
+    buf.sync()  # jit compile + plane residency settle
+    t0 = time.perf_counter()
+    n = 0
+    for k in range(iters):
+        ts = T0 + (base + per * (k + 1)) * NANOS
+        buf.append_batch(sids, ts, vals, units)
+        n += B
+    dt = time.perf_counter() - t0
+    assert buf.spills == dict.fromkeys(buf.spills, 0), buf.spills
+    plane_rec = _rec(
+        "ingest_device_write_plane",
+        n / dt,
+        "writes/s",
+        vs_host_ceiling=round(n / dt / HOST_CEILING, 2),
+        batch=B,
+        lanes=lanes,
+        device_syncs=buf.device_syncs,
+        device_sync_bytes=buf.device_sync_bytes,
+    )
+
+    # --- 2) seal-time batched encode kernel ---
+    M, N = (4096, 720) if on_tpu else (512, 720)
+    enc_lanes = []
+    for m in range(M):
+        t = T0 + np.cumsum(rng.integers(1, 30, N)).astype(np.int64) * NANOS
+        v = (
+            rng.integers(-5000, 5000, N).astype(np.float64)
+            if m % 2
+            else rng.normal(0, 10, N)
+        )
+        enc_lanes.append((t, v))
+    kinds = [
+        dev_encode.classify_lane(t, v, np.ones(N, np.int8)).kind
+        for t, v in enc_lanes
+    ]
+    dev_encode.encode_lanes(enc_lanes, kinds, k=32)  # compile warm
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = dev_encode.encode_lanes(enc_lanes, kinds, k=32)
+    dt_enc = (time.perf_counter() - t0) / reps
+    enc_rec = _rec(
+        "ingest_encode_seal_kernel",
+        M * N / dt_enc,
+        "datapoints/s",
+        lanes=M,
+        points=N,
+        bytes_per_datapoint=round(float(res.nbytes.sum()) / (M * N), 3),
+    )
+
+    # --- 3) end-to-end born-resident seal ---
+    from m3_tpu.resident.pool import ResidentOptions
+    from m3_tpu.storage.database import Database, NamespaceOptions
+
+    bsz = 2 * 3600 * NANOS
+    S, P = (4096, 128) if on_tpu else (512, 64)
+    db = Database(
+        tempfile.mkdtemp(prefix="m3tpu-bench-ingest-"),
+        num_shards=4,
+        commitlog_enabled=False,
+        resident_options=ResidentOptions(enabled=True, max_bytes=256 << 20),
+        ingest_options=IngestOptions(),
+    )
+    db.create_namespace("bench", NamespaceOptions(block_size_nanos=bsz))
+    db.bootstrapped = True
+    entries = []
+    for s in range(S):
+        sid = b"ser%05d" % s
+        for p in range(P):
+            entries.append((sid, bsz + (p * 20 + s % 17) * NANOS, float(s % 100)))
+    t0 = time.perf_counter()
+    db.write_batch("bench", entries)
+    dt_w = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    db.flush("bench", 2 * bsz)
+    dt_f = time.perf_counter() - t0
+    st = db.resident_pool.stats()
+    db.close()
+    npts = S * P
+    seal_rec = _rec(
+        "ingest_born_resident_seal",
+        npts / (dt_w + dt_f),
+        "writes/s",
+        series=S,
+        points=P,
+        write_s=round(dt_w, 3),
+        seal_s=round(dt_f, 3),
+        device_admissions=st["device_admissions"],
+        admissions=st["admissions"],
+        upload_bytes=st["upload_bytes"],
+        side_stage_bytes=st["ingest_side_stage_bytes"],
+    )
+    assert st["upload_bytes"] == 0, st
+    assert st["device_admissions"] == st["admissions"] > 0, st
+    return [plane_rec, enc_rec, seal_rec]
+
+
 def bench_compression(n_series=2000, n_points=720):
     """bytes/datapoint on a PRODUCTION-LIKE trace, next to the reference's
     1.45 bytes/dp production claim (docs/m3db/architecture/engine.md:11).
@@ -885,7 +1020,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--configs",
-        default="1,2,3,4,5,mixed,scan,index,compression,tenants,pipeline",
+        default="1,2,3,4,5,mixed,scan,index,compression,tenants,pipeline,ingest",
     )
     ap.add_argument("--series", type=int, default=0, help="override config-2 series")
     ap.add_argument("--out", default="PERF_r05.json")
@@ -929,6 +1064,10 @@ def main() -> None:
         records.append(bench_hedging())
     if "pipeline" in want:
         records.append(bench_pipeline(on_tpu=on_tpu))
+    ingest_records = None
+    if "ingest" in want:
+        ingest_records = bench_ingest(on_tpu)
+        records.extend(ingest_records)
 
     # merge into an existing results file: re-running a subset of configs
     # replaces those records and keeps the rest
@@ -950,6 +1089,19 @@ def main() -> None:
             f,
             indent=1,
         )
+    if ingest_records is not None:
+        # BENCH_r06: the ingest round's headline (write-plane writes/s
+        # vs the PROFILE.md 291k/s/core host ceiling) + its satellites
+        with open("BENCH_r06.json", "w") as f:
+            json.dump(
+                {
+                    "platform": jax.devices()[0].device_kind,
+                    "parsed": ingest_records[0],
+                    "records": ingest_records,
+                },
+                f,
+                indent=1,
+            )
 
 
 if __name__ == "__main__":

@@ -16,7 +16,7 @@ dict work, exactly the role the reference's entry.go hashmap plays.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable
 
 import numpy as np
@@ -48,14 +48,55 @@ class AggregatedMetric:
         return self.id + b"." + self.agg_type.type_string.encode()
 
 
-@dataclass
 class _PolicyBuffer:
-    """Pending raw values for one storage policy within one shard."""
+    """Pending raw values for one storage policy within one shard.
 
-    ids: list[int] = field(default_factory=list)
-    times: list[int] = field(default_factory=list)
-    values: list[float] = field(default_factory=list)
-    types: list[int] = field(default_factory=list)
+    Growable numpy COLUMNS (amortized-doubling appends), not Python
+    lists: ingest appends whole value batches with one slice store, and
+    a drain hands the segment kernels contiguous array views with zero
+    list→array conversion on the flush path — the aggregation tier's
+    equivalent of the ingest column planes."""
+
+    __slots__ = ("ids", "times", "values", "types", "n")
+
+    def __init__(self, cap: int = 256) -> None:
+        self.ids = np.empty(cap, np.int32)
+        self.times = np.empty(cap, np.int64)
+        self.values = np.empty(cap, np.float32)
+        self.types = np.empty(cap, np.int32)
+        self.n = 0
+
+    def _grow(self, need: int) -> None:
+        cap = len(self.ids)
+        if self.n + need <= cap:
+            return
+        new_cap = max(cap * 2, self.n + need)
+        for name in ("ids", "times", "values", "types"):
+            a = getattr(self, name)
+            b = np.empty(new_cap, a.dtype)
+            b[: self.n] = a[: self.n]
+            setattr(self, name, b)
+
+    def extend(self, idx: int, time_nanos: int, values, mtype: int) -> None:
+        k = len(values)
+        self._grow(k)
+        n = self.n
+        self.ids[n : n + k] = idx
+        self.times[n : n + k] = time_nanos
+        self.values[n : n + k] = values
+        self.types[n : n + k] = mtype
+        self.n = n + k
+
+    def compact(self, keep: np.ndarray) -> None:
+        """Retain only ``keep``-masked rows (the unflushed tail), in
+        place — fancy-index RHS copies before the slice store."""
+        nk = int(keep.sum())
+        n = self.n
+        self.ids[:nk] = self.ids[:n][keep]
+        self.times[:nk] = self.times[:n][keep]
+        self.values[:nk] = self.values[:n][keep]
+        self.types[:nk] = self.types[:n][keep]
+        self.n = nk
 
 
 class _Shard:
@@ -114,7 +155,7 @@ class _Shard:
         return False
 
     def has_pending(self) -> bool:
-        return any(buf.ids for buf in self.buffers.values())
+        return any(buf.n for buf in self.buffers.values())
 
     def expire_entries(self, before_nanos: int) -> int:
         """Drop interned ids idle since ``before_nanos`` (entry TTL,
@@ -158,12 +199,10 @@ class _Shard:
         if not self.admit(idx, len(values), time_nanos, rate_limit):
             return
         for policy in policies:
-            buf = self.buffers.setdefault(policy, _PolicyBuffer())
-            for v in values:
-                buf.ids.append(idx)
-                buf.times.append(time_nanos)
-                buf.values.append(float(v))
-                buf.types.append(int(mtype))
+            buf = self.buffers.get(policy)
+            if buf is None:
+                buf = self.buffers[policy] = _PolicyBuffer()
+            buf.extend(idx, time_nanos, values, int(mtype))
 
 
 class Aggregator:
@@ -267,6 +306,20 @@ class Aggregator:
                 policies or self.default_policies, aggregations,
                 rate_limit=self.value_rate_limit,
             )
+
+    def add_timed_batch(self, rows) -> None:
+        """Batched AddTimed: ``rows`` is ``[(mid, mtype, time_nanos,
+        value, policies, aggregations)]``. One lock acquisition for the
+        whole batch — the handler-thread half of the column-buffer
+        design (per-row locking capped ingest the same way the per-point
+        write path did on the dbnode side)."""
+        with self._lock:
+            for mid, mtype, time_nanos, value, policies, aggregations in rows:
+                self.shards[self.shard_for(mid)].add(
+                    mid, mtype, time_nanos, [value],
+                    policies or self.default_policies, aggregations,
+                    rate_limit=self.value_rate_limit,
+                )
 
     # AddForwarded: multi-stage rollup input — same buffer path, the pipeline
     # stage lives in rules (forwarded_writer.go equivalence).
@@ -382,7 +435,7 @@ class Aggregator:
     def _drain(self, leader, up_to_nanos, leader_times, flushed_boundaries, out):
         for shard in self.shards:
             for policy, buf in shard.buffers.items():
-                if not buf.ids:
+                if not buf.n:
                     continue
                 res = policy.resolution.window_nanos
                 pkey = str(policy)
@@ -394,20 +447,17 @@ class Aggregator:
                     # durably flushed; everything else stays buffered so a
                     # takeover can flush it
                     boundary = prev_bound
-                times = np.asarray(buf.times, np.int64)
+                times = buf.times[: buf.n]
                 flushable = times < boundary
                 if not flushable.any():
                     continue
-                keep = ~flushable
-                ids = np.asarray(buf.ids, np.int32)[flushable]
-                vals = np.asarray(buf.values, np.float32)[flushable]
+                # fancy indexing copies, so the drained columns survive
+                # the in-place compaction below
+                ids = buf.ids[: buf.n][flushable]
+                vals = buf.values[: buf.n][flushable]
                 ts = times[flushable]
-                types = np.asarray(buf.types, np.int32)[flushable]
-                # retain unflushed tail
-                buf.ids = list(np.asarray(buf.ids, np.int32)[keep])
-                buf.times = list(times[keep])
-                buf.values = list(np.asarray(buf.values, np.float32)[keep])
-                buf.types = list(np.asarray(buf.types, np.int32)[keep])
+                types = buf.types[: buf.n][flushable]
+                buf.compact(~flushable)  # retain unflushed tail
                 if leader:
                     # windows the previous leader already emitted (per the
                     # shared flush times) are discarded, not re-emitted

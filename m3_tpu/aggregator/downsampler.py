@@ -33,6 +33,41 @@ class Downsampler:
     # rollup pipelines keyed by flushed metric identity
     _pipelines: dict[bytes, tuple] = field(default_factory=dict)
     _carry: dict[tuple, tuple] = field(default_factory=dict)
+    # active-snapshot cache: ``RuleSet.active_at`` builds a FRESH
+    # ActiveRuleSet (losing its per-ID match cache) every call, which
+    # re-ran every rule filter on every write. Keyed by the set of
+    # active rule indices, so a cutover lands on its exact boundary
+    # while every write inside a snapshot reuses the cached matcher —
+    # and with it the per-ID forward_match results.
+    _active_cache: dict[tuple, ActiveRuleSet] = field(default_factory=dict)
+    # tags -> encoded metric id (encode_tags per write dominated after
+    # the matcher cache; ids are immutable per tag set)
+    _id_cache: dict[Tags, bytes] = field(default_factory=dict)
+
+    def _active_for(self, time_nanos: int) -> ActiveRuleSet:
+        key = (
+            self.ruleset.version,
+            tuple(
+                i
+                for i, r in enumerate(self.ruleset.mapping_rules)
+                if r.cutover_nanos <= time_nanos
+            ),
+            tuple(
+                i
+                for i, r in enumerate(self.ruleset.rollup_rules)
+                if r.cutover_nanos <= time_nanos
+            ),
+        )
+        active = self._active_cache.get(key)
+        if active is None:
+            active = self._active_cache[key] = self.ruleset.active_at(time_nanos)
+        return active
+
+    def _id_for(self, tags: Tags) -> bytes:
+        mid = self._id_cache.get(tags)
+        if mid is None:
+            mid = self._id_cache[tags] = encode_tags_id(tags)
+        return mid
 
     def write(
         self,
@@ -43,27 +78,46 @@ class Downsampler:
     ) -> bool:
         """Returns False when a drop policy matched (metric not persisted
         unaggregated — ingest/write.go shouldWrite)."""
-        active: ActiveRuleSet = self.ruleset.active_at(time_nanos)
-        m = active.forward_match(tags)
-        mid = encode_tags_id(tags)
+        return self.write_batch([(tags, time_nanos, value, mtype)])[0]
 
-        policies = m.policies or self.auto_mapping_policies
-        if policies:
-            self.aggregator.add_timed(
-                mid, mtype, time_nanos, value, policies=policies, aggregations=m.aggregations or None
-            )
-        for rtags, target in m.rollups:
-            rid = encode_tags_id(rtags)
-            self._pipelines[rid] = target.pipeline
-            self.aggregator.add_timed(
-                rid,
-                MetricType.GAUGE if mtype == MetricType.GAUGE else MetricType.COUNTER,
-                time_nanos,
-                value,
-                policies=target.policies or policies or self.aggregator.default_policies,
-                aggregations=target.aggregations or None,
-            )
-        return not m.drop
+    def write_batch(self, rows) -> list[bool]:
+        """Batched ingest: rule evaluation runs once per distinct tag set
+        (cached matcher + cached encoded ids), and the aggregator takes
+        its lock ONCE for the whole batch instead of per metric. ``rows``
+        is ``[(tags, time_nanos, value, mtype)]``; returns the per-row
+        keep mask (False = a drop policy matched)."""
+        keep: list[bool] = []
+        adds: list[tuple] = []
+        for tags, time_nanos, value, mtype in rows:
+            m = self._active_for(time_nanos).forward_match(tags)
+            mid = self._id_for(tags)
+            policies = m.policies or self.auto_mapping_policies
+            if policies:
+                adds.append(
+                    (mid, mtype, time_nanos, value, policies,
+                     m.aggregations or None)
+                )
+            for rtags, target in m.rollups:
+                rid = self._id_for(rtags)
+                self._pipelines[rid] = target.pipeline
+                adds.append(
+                    (
+                        rid,
+                        MetricType.GAUGE
+                        if mtype == MetricType.GAUGE
+                        else MetricType.COUNTER,
+                        time_nanos,
+                        value,
+                        target.policies
+                        or policies
+                        or self.aggregator.default_policies,
+                        target.aggregations or None,
+                    )
+                )
+            keep.append(not m.drop)
+        if adds:
+            self.aggregator.add_timed_batch(adds)
+        return keep
 
     def flush(self, up_to_nanos: int) -> list[AggregatedMetric]:
         flushed = self.aggregator.flush(up_to_nanos)

@@ -1,0 +1,14 @@
+"""Device-side ingest: the write-path twin of the resident read pool.
+
+``ColumnWriteBuffer`` (buffer.py) accumulates write batches into
+per-shard ``(series_lane, slot)`` timestamp/value planes — ring-buffered
+per block window, mirrored to device with the resident pool's
+donation/epoch discipline — so seal hands CLEAN lanes straight to the
+batched m3tsz encode kernel (ops/encode.py) and blocks are born
+resident (resident/pool.admit_block_device) without a host encode or an
+admission upload.
+"""
+
+from .buffer import ColumnWriteBuffer, IngestOptions, SealLane
+
+__all__ = ["ColumnWriteBuffer", "IngestOptions", "SealLane"]

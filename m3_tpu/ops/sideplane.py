@@ -117,6 +117,73 @@ def pack_side_rows(snaps: list, block_start: int) -> np.ndarray | None:
     return rows
 
 
+def pack_side_rows_vec(
+    off,
+    prev_time,
+    prev_delta,
+    time_unit,
+    prev_float_bits,
+    prev_xor,
+    int_val,
+    sig,
+    mult,
+    is_float,
+    fast,
+    fast_float,
+    block_start: int,
+) -> np.ndarray | None:
+    """Vectorized :func:`pack_side_rows`: per-chunk field ARRAYS (one
+    element per chunk, 64-bit fields as uint64) -> uint32[n_chunks,
+    SIDE_WORDS], or None when any chunk overflows the packed ranges —
+    bit-identical to the dict packer for every row it accepts. This is
+    the device-encode seal path's packer (ops/encode.py): the encode
+    kernel hands back columnar snapshot state, so packing stays one
+    round of numpy ops instead of a per-chunk dict walk."""
+    off = np.asarray(off, np.uint64)
+    pt = np.asarray(prev_time, np.uint64)
+    pd = np.asarray(prev_delta, np.uint64)
+    tu = np.asarray(time_unit, np.uint64)
+    sig = np.asarray(sig, np.uint64)
+    mult = np.asarray(mult, np.uint64)
+    pfb = np.asarray(prev_float_bits, np.uint64)
+    pxr = np.asarray(prev_xor, np.uint64)
+    iv = np.asarray(int_val, np.uint64)
+    if (
+        (off >= 1 << OFF_BITS).any()
+        or (tu >= 1 << TU_BITS).any()
+        or (sig >= 1 << SIG_BITS).any()
+        or (mult >= 1 << MULT_BITS).any()
+        or (pd >= 1 << PD_BITS).any()
+    ):
+        return None
+    ptz = pt == 0
+    # uint64 wraparound turns a prev_time below block_start into a huge
+    # rel, caught by the same range check as the dict packer's rel < 0
+    rel = np.where(ptz, np.uint64(0), pt - np.uint64(int(block_start) & _M64))
+    if (rel >= 1 << RT_BITS).any():
+        return None
+    flags = np.where(np.asarray(fast, bool), np.uint64(1), np.uint64(0)) | np.where(
+        np.asarray(fast_float, bool), np.uint64(2), np.uint64(0)
+    )
+    w8 = (off << np.uint64(11)) | (tu << np.uint64(8)) | (sig << np.uint64(2)) | flags
+    w9 = (
+        ((rel >> np.uint64(32)) << np.uint64(20))
+        | ((pd >> np.uint64(32)) << np.uint64(7))
+        | (np.where(ptz, np.uint64(1), np.uint64(0)) << np.uint64(6))
+        | (mult << np.uint64(1))
+        | np.where(np.asarray(is_float, bool), np.uint64(1), np.uint64(0))
+    )
+    rows = np.empty((off.shape[0], SIDE_WORDS), np.uint32)
+    s32 = np.uint64(32)
+    m32 = np.uint64(_M32)
+    for j, col in enumerate(
+        (pfb >> s32, pfb & m32, pxr >> s32, pxr & m32, iv >> s32, iv & m32,
+         rel & m32, pd & m32, w8, w9)
+    ):
+        rows[:, j] = col.astype(np.uint32)
+    return rows
+
+
 def unpack_side_rows(rows: np.ndarray, block_start: int) -> list[dict]:
     """Host inverse of :func:`pack_side_rows` (the fileset side-file v3
     read path): packed rows -> snapshot dicts, bit-exact for every row

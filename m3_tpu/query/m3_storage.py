@@ -676,10 +676,33 @@ class FanoutStorage:
     """Retention/resolution-aware fanout (fanout/storage.go:48 +
     cluster_resolver): pick the namespace(s) whose attributes fit the query
     range, fetch, and dedupe exact-id overlaps preferring the
-    finer-resolution source."""
+    finer-resolution source.
+
+    The fan-in is concurrent and HEDGED ("The Tail at Scale", the same
+    discipline as the client session's replica fan-outs): each resolved
+    namespace fetches on its own daemon worker, and when a source has
+    been in flight longer than its own per-(source, op) p95 a single
+    budget-gated backup twin is issued — first leg per source wins, the
+    loser is abandoned, and a loser's late error never surfaces. Local
+    single-namespace queries stay inline (there is no independent
+    replica behind an in-process storage worth paying a thread for).
+    Counters ride the existing ``m3tpu_session_hedges_*`` family under
+    ``op="fanout_fetch"``."""
 
     namespaces: list  # list[ClusterNamespace]
     clock: object = None  # () -> nanos; injectable for tests
+    hedge_enabled: bool = True
+    # floor under the p95 straggler trigger (seconds): ordinary jitter
+    # must not burn hedge budget on sources answering in microseconds
+    hedge_min_delay: float = 0.010
+    _OP = "fanout_fetch"
+
+    def __post_init__(self) -> None:
+        from ..net.resilience import HedgeBudget, LatencyEstimator
+
+        self.latency = LatencyEstimator()
+        self.hedge_budget = HedgeBudget()
+        self._pool = None
 
     def _now(self) -> int:
         if self.clock is not None:
@@ -691,13 +714,140 @@ class FanoutStorage:
     def resolve(self, start_nanos: int) -> list[ClusterNamespace]:
         return resolve_cluster_namespaces(self.namespaces, self._now(), start_nanos)
 
+    def _ns_key(self, ns: ClusterNamespace) -> str:
+        """Stable latency-estimator identity for one source: remote
+        coordinators by URL, local storages by position + resolution."""
+        url = getattr(ns.storage, "base_url", None)
+        if url:
+            return str(url)
+        try:
+            pos = self.namespaces.index(ns)
+        except ValueError:
+            pos = -1
+        return f"local/{pos}/{ns.resolution_nanos}"
+
     def fetch(self, matchers, start_nanos, end_nanos):
+        resolved = self.resolve(start_nanos)
+        if len(resolved) == 1 and getattr(
+            resolved[0].storage, "base_url", None
+        ) is None:
+            results = {
+                0: resolved[0].storage.fetch(matchers, start_nanos, end_nanos)
+            }
+        else:
+            results = self._hedged_fetch(
+                resolved, matchers, start_nanos, end_nanos
+            )
         seen: dict = {}
         order = []
-        for ns in self.resolve(start_nanos):
-            for tags, times, vals in ns.storage.fetch(matchers, start_nanos, end_nanos):
+        for i in range(len(resolved)):
+            for tags, times, vals in results[i]:
                 if tags in seen:
                     continue
                 seen[tags] = (tags, times, vals)
                 order.append(tags)
         return [seen[t] for t in order]
+
+    def _hedged_fetch(self, resolved, matchers, start_nanos, end_nanos):
+        import time
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as futures_wait
+
+        from ..client.session import _DaemonPool, _session_hedges
+
+        if self._pool is None:
+            self._pool = _DaemonPool(max_workers=8)
+        pool = self._pool
+        n = len(resolved)
+        keys = [self._ns_key(ns) for ns in resolved]
+        futs: dict = {}  # Future -> source index
+        hedge_futs: set = set()  # backup legs
+        legs = [1] * n
+        attempted = [False] * n
+        unresolved: set[int] = set()  # issued hedges with no outcome yet
+        results: dict[int, list] = {}
+        errors: dict[int, BaseException] = {}
+        now = time.monotonic()
+        started = [now] * n
+        for i, ns in enumerate(resolved):
+            futs[pool.submit(ns.storage.fetch, matchers, start_nanos, end_nanos)] = i
+        pending = set(futs)
+        while pending and (len(results) + len(errors)) < n:
+            # wake exactly when the earliest unhedged source crosses its
+            # straggler threshold (or on the first completion)
+            now = time.monotonic()
+            fire = None
+            if self.hedge_enabled:
+                for i in range(n):
+                    if attempted[i] or i in results or i in errors:
+                        continue
+                    p95 = self.latency.p95(keys[i], self._OP)
+                    if p95 is None:
+                        continue
+                    at = started[i] + max(p95, self.hedge_min_delay)
+                    if fire is None or at < fire:
+                        fire = at
+            timeout = None if fire is None else max(fire - now, 0.0)
+            done, pending = futures_wait(
+                pending, timeout=timeout, return_when=FIRST_COMPLETED
+            )
+            for fut in done:
+                i = futs[fut]
+                is_hedge = fut in hedge_futs
+                exc = fut.exception()
+                if exc is None:
+                    if i in results:
+                        continue  # loser twin: never double-merged
+                    results[i] = fut.result()
+                    self.latency.record(
+                        keys[i], self._OP, time.monotonic() - started[i]
+                    )
+                    self.hedge_budget.on_success()
+                    if i in unresolved:
+                        unresolved.discard(i)
+                        _session_hedges(
+                            "won" if is_hedge else "wasted", self._OP
+                        ).inc()
+                else:
+                    legs[i] -= 1
+                    if is_hedge and i in unresolved:
+                        unresolved.discard(i)
+                        _session_hedges("wasted", self._OP).inc()
+                    # a leg's error surfaces only when the source has no
+                    # other live leg and no delivered result
+                    if i not in results and legs[i] <= 0:
+                        errors[i] = exc
+            if not pending or (len(results) + len(errors)) >= n:
+                break
+            if not self.hedge_enabled:
+                continue
+            # at most ONE budget-gated backup per wake, to the straggler
+            now = time.monotonic()
+            for i in range(n):
+                if attempted[i] or i in results or i in errors:
+                    continue
+                p95 = self.latency.p95(keys[i], self._OP)
+                if p95 is None:
+                    continue
+                if now - started[i] <= max(p95, self.hedge_min_delay):
+                    continue
+                attempted[i] = True
+                if not self.hedge_budget.try_spend():
+                    break
+                fut = pool.submit(
+                    resolved[i].storage.fetch, matchers, start_nanos, end_nanos
+                )
+                futs[fut] = i
+                hedge_futs.add(fut)
+                pending.add(fut)
+                legs[i] += 1
+                unresolved.add(i)
+                _session_hedges("issued", self._OP).inc()
+                break
+        # fan-in over: hedges with no outcome (both legs abandoned or
+        # still in flight) were pure extra load
+        for _ in range(len(unresolved)):
+            _session_hedges("wasted", self._OP).inc()
+        if errors:
+            raise errors[min(errors)]
+        return results

@@ -257,6 +257,8 @@ class ResidentPool:
         self.copy_admissions = 0
         self.side_pack_overflows = 0
         self.rebalance_evictions = 0
+        self.device_admissions = 0
+        self.ingest_side_stage_bytes = 0
         reg = registry or METRICS
         self._m_admissions = reg.counter(
             "resident_admissions_total", "blocks admitted to the resident pool"
@@ -300,6 +302,18 @@ class ResidentPool:
             "lanes admitted WITHOUT side planes because a chunk snapshot "
             "overflowed the packed 10-word layout (the lane decodes "
             "streamed; pathological block span or sample gap)",
+        )
+        self._m_device_admissions = reg.counter(
+            "ingest_device_admissions_total",
+            "born-resident admissions: lanes whose pages were encoded on "
+            "device and scattered device->device (zero stream-byte upload "
+            "— resident_upload_bytes_total does not move for these)",
+        )
+        self._m_side_stage = reg.counter(
+            "ingest_side_stage_bytes_total",
+            "packed side-plane row bytes staged host->device at "
+            "born-resident admission (O(40B/chunk) metadata; the DATA "
+            "pages never cross PCIe)",
         )
         self._g_bytes = reg.gauge("resident_pool_bytes", "compressed bytes resident")
         self._g_pages = reg.gauge("resident_pool_pages", "pages in use (excl. zero page)")
@@ -622,6 +636,303 @@ class ResidentPool:
                     self._m_rejections.inc(rejected_span + rejected_budget)
                 self._publish_locked()
         return AdmitResult(admitted, rejected_span, rejected_budget, complete)
+
+    def admit_block_device(
+        self,
+        namespace: str,
+        shard_id: int,
+        block_start: int,
+        volume: int,
+        words,
+        items: list,
+        chunk_k: int = CHUNK_K,
+        host_items: list | None = None,
+    ) -> AdmitResult:
+        """Born-resident admission: seal pages that are ALREADY on device.
+
+        ``words`` is the encode kernel's ``uint32[M, W]`` output
+        (ops/encode.py) with W a multiple of ``page_words``; ``items`` is
+        ``[(series_id, lane_row, nbytes, n_chunks, max_span_bits,
+        packed_side_rows | None)]``. The data pages move device->device
+        (a gather out of the encode buffer into the pool scatter) — the
+        hot path uploads ZERO stream bytes, which is the whole point:
+        ``resident_upload_bytes_total`` does not move. The packed side
+        rows are O(40B/chunk) host metadata and stage under
+        ``ingest_side_stage_bytes_total`` instead, so the zero-upload
+        contract stays assertable while side staging stays visible.
+
+        ``host_items`` carries the block's HOST-FALLBACK lanes
+        (annotated/mixed/overflow — ``(sid, stream, num_points)`` like
+        :meth:`admit_block`'s items): they ride the SAME three-phase
+        batch so the group's completeness marker is computed over the
+        union, never set by a partial subset. Their bytes stage
+        host->device and count under ``resident_upload_bytes_total`` as
+        usual — only device-encoded lanes are free.
+
+        Same three phases and the same donation/epoch fence discipline
+        as :meth:`admit_block`."""
+        if not self.enabled:
+            return AdmitResult(0, 0, 0, False)
+        o = self.options
+        if o.namespaces and namespace not in o.namespaces:
+            return AdmitResult(0, 0, 0, False)
+        page_bytes = o.page_bytes
+        pw = o.page_words
+        spc = o.side_page_chunks
+        W = int(words.shape[1]) if items else pw
+        if W % pw != 0:
+            raise ResidentPoolError(
+                f"device encode width {W} not a multiple of page_words {pw} "
+                "(encode with round_words_to=pool.options.page_words)"
+            )
+        lane_pages = W // pw
+        # plan rows: (key, src, nbytes, n_pages, n_side, rows, n_chunks,
+        # max_span) — src is an int lane row (device) or bytes (host)
+        plan: list[tuple] = []
+        rejected_span = 0
+        side_overflows = 0
+        for sid, lane_row, nbytes, n_chunks, max_span, rows in items:
+            if not nbytes:
+                continue
+            n_pages = -(-int(nbytes) // page_bytes)
+            if n_pages > o.max_lane_pages or n_pages > lane_pages:
+                rejected_span += 1
+                continue
+            if rows is None and n_chunks:
+                # a chunk overflowed the packed layout: lane admits
+                # without side planes and decodes streamed (counted)
+                side_overflows += 1
+                n_chunks = 0
+            key = BlockKey(namespace, shard_id, bytes(sid), block_start, volume)
+            plan.append(
+                (key, int(lane_row), int(nbytes), n_pages,
+                 -(-int(n_chunks) // spc) if n_chunks else 0,
+                 rows if n_chunks else None, int(n_chunks), int(max_span))
+            )
+        for sid, stream, _num_points in host_items or []:
+            if not stream:
+                continue
+            n_pages = -(-len(stream) // page_bytes)
+            if n_pages > o.max_lane_pages:
+                rejected_span += 1
+                continue
+            snaps = self._prescan([stream], chunk_k)[0]
+            rows = side_rows_from_snaps(snaps, block_start) if snaps else None
+            if snaps and rows is None:
+                side_overflows += 1
+                snaps = []
+            n_chunks = len(snaps)
+            max_span = max((p["span"] for p in snaps), default=0)
+            key = BlockKey(namespace, shard_id, bytes(sid), block_start, volume)
+            plan.append(
+                (key, bytes(stream), len(stream), n_pages,
+                 -(-n_chunks // spc) if n_chunks else 0,
+                 rows, n_chunks, max_span)
+            )
+        if side_overflows:
+            self.side_pack_overflows += side_overflows
+            self._m_side_overflow.inc(side_overflows)
+        rejected_budget = 0
+        admitted = 0
+        batch_entries: list[tuple] = []
+        with self._upload_lock:
+            with self._lock:
+                for key, src, nbytes, n_pages, n_side, rows, n_chunks, max_span in plan:
+                    alloc = self._alloc_locked(n_pages, n_side)
+                    if alloc is None:
+                        rejected_budget += 1
+                        continue
+                    pages, side_pages = alloc
+                    old = self._od.pop(key, None)
+                    if old is not None:
+                        self._unindex_locked(key, old)
+                        self._free.extend(old.pages)
+                        self._free_side.extend(old.side_pages)
+                        self._resident_bytes -= old.nbytes
+                    entry = ResidentEntry(
+                        pages=tuple(pages),
+                        num_bits=nbytes * 8,
+                        nbytes=nbytes,
+                        side_pages=tuple(side_pages),
+                        n_chunks=n_chunks,
+                        chunk_k=chunk_k if n_chunks else 0,
+                        max_span_bits=max_span,
+                    )
+                    self._pending[key] = entry
+                    admitted += 1
+                    batch_entries.append((key, entry, src, rows))
+            src_rows: list[int] = []
+            dst_pages: list[int] = []
+            host_rows: list[np.ndarray] = []
+            host_idx: list[int] = []
+            side_rows_staged: list[np.ndarray] = []
+            side_idx: list[int] = []
+            staged_keys: set = set()
+            with self._lock:
+                generation = self._generation
+            try:
+                if batch_entries:
+                    with self._lock:
+                        survivors_snapshot = [
+                            tup
+                            for tup in batch_entries
+                            if self._pending.get(tup[0]) is tup[1]
+                        ]
+                    for key, entry, src, rows in survivors_snapshot:
+                        staged_keys.add(key)
+                        if isinstance(src, int):
+                            for j, p in enumerate(entry.pages):
+                                src_rows.append(src * lane_pages + j)
+                                dst_pages.append(p)
+                        else:
+                            for j, p in enumerate(entry.pages):
+                                row = np.zeros(pw, np.uint32)
+                                chunk = src[j * page_bytes : (j + 1) * page_bytes]
+                                padded = chunk + b"\x00" * (-len(chunk) % 4)
+                                row[: len(padded) // 4] = np.frombuffer(
+                                    padded, ">u4"
+                                ).astype(np.uint32)
+                                host_rows.append(row)
+                                host_idx.append(p)
+                        if rows is not None and len(rows):
+                            for j, sp in enumerate(entry.side_pages):
+                                page = np.zeros((spc, N_SIDE_PLANES), np.uint32)
+                                seg = rows[j * spc : (j + 1) * spc]
+                                page[: len(seg)] = seg
+                                side_rows_staged.append(page)
+                                side_idx.append(sp)
+                    if src_rows or host_rows or side_rows_staged:
+                        self._upload_device(
+                            words, src_rows, dst_pages, host_rows, host_idx,
+                            side_rows_staged, side_idx,
+                        )
+            except BaseException:
+                with self._lock:
+                    if self._generation == generation:
+                        for key, entry, _row, _rows in batch_entries:
+                            if self._pending.get(key) is entry:
+                                del self._pending[key]
+                            self._free.extend(entry.pages)
+                            self._free_side.extend(entry.side_pages)
+                        self._publish_locked()
+                raise
+            with self._lock:
+                survivors = 0
+                dev_survivors = 0
+                for key, entry, src, _rows in batch_entries:
+                    present = self._pending.get(key) is entry
+                    if present:
+                        del self._pending[key]
+                    if present and key in staged_keys:
+                        survivors += 1
+                        if isinstance(src, int):
+                            dev_survivors += 1
+                        self._od[key] = entry
+                        self._index_locked(key)
+                        self._resident_bytes += entry.nbytes
+                    else:
+                        self._free.extend(entry.pages)
+                        self._free_side.extend(entry.side_pages)
+                complete = (
+                    admitted > 0
+                    and rejected_span == 0
+                    and rejected_budget == 0
+                    and survivors == len(plan)
+                )
+                if complete:
+                    self._complete.add((namespace, shard_id, block_start, volume))
+                if rejected_span:
+                    self._span_incomplete.add(
+                        (namespace, shard_id, block_start, volume)
+                    )
+                self.admissions += admitted
+                self.device_admissions += dev_survivors
+                self.rejections += rejected_span + rejected_budget
+                self._m_admissions.inc(admitted)
+                self._m_device_admissions.inc(dev_survivors)
+                if rejected_span + rejected_budget:
+                    self._m_rejections.inc(rejected_span + rejected_budget)
+                self._publish_locked()
+        return AdmitResult(admitted, rejected_span, rejected_budget, complete)
+
+    def _upload_device(
+        self, words_src, src_rows: list, dst_pages: list, host_rows: list,
+        host_idx: list, side_rows: list, side_idx: list
+    ):
+        """Device->device data publication + (tiny) side-plane staging —
+        the born-resident half of :meth:`_upload`, same donation fence
+        and epoch discipline, but the device-encoded pages never cross
+        PCIe and ``upload_bytes`` does not move for them. Host-fallback
+        rows of the same batch (``host_rows``) concatenate into the same
+        scatter and DO count under ``upload_bytes``."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            words = self._ensure_words()
+            side = self._ensure_side()
+            donate = self._leases == 0
+            if donate:
+                self._donating = True
+        try:
+            new_words = new_side = None
+            if src_rows or host_rows:
+                pw = self.options.page_words
+                parts = []
+                if src_rows:
+                    parts.append(
+                        words_src.reshape(-1, pw)[np.asarray(src_rows, np.int32)]
+                    )
+                if host_rows:
+                    staged_host = np.stack(host_rows)
+                    self.upload_bytes += staged_host.nbytes
+                    self._m_upload.inc(staged_host.nbytes)
+                    parts.append(jax.device_put(staged_host))
+                n = len(src_rows) + len(host_rows)
+                n_pad = 1 << max(n - 1, 0).bit_length()
+                if n_pad > n:
+                    # padding rows re-write zeros into the reserved zero
+                    # page, exactly like the host staging path
+                    parts.append(jnp.zeros((n_pad - n, pw), jnp.uint32))
+                gathered = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                indices = np.zeros(n_pad, np.int32)
+                indices[: len(src_rows)] = np.asarray(dst_pages, np.int32)
+                indices[len(src_rows) : n] = np.asarray(host_idx, np.int32)
+                new_words = _scatter(
+                    words, jax.device_put(indices), gathered, donate
+                )
+            if side_rows:
+                staged, indices = self._stage(
+                    side_rows, side_idx,
+                    (self.options.side_page_chunks, N_SIDE_PLANES),
+                )
+                self.ingest_side_stage_bytes += staged.nbytes
+                self._m_side_stage.inc(staged.nbytes)
+                new_side = _scatter(side, jax.device_put(indices),
+                                    jax.device_put(staged), donate)
+        except BaseException:
+            with self._lock:
+                if donate:
+                    self._reset_locked()
+                    self._donating = False
+                    self._fence.notify_all()
+            raise
+        with self._lock:
+            if new_words is not None:
+                self._words = new_words
+            if new_side is not None:
+                self._side = new_side
+            if new_words is not None or new_side is not None:
+                self.epoch += 1
+            if donate:
+                self._donating = False
+                self._fence.notify_all()
+        if donate:
+            self.inplace_admissions += 1
+            self._m_inplace.inc()
+        else:
+            self.copy_admissions += 1
+            self._m_copy.inc()
 
     @staticmethod
     def _prescan(streams: list, chunk_k: int) -> list:
@@ -1203,6 +1514,8 @@ class ResidentPool:
                 "copy_admissions": self.copy_admissions,
                 "side_pack_overflows": self.side_pack_overflows,
                 "rebalance_evictions": self.rebalance_evictions,
+                "device_admissions": self.device_admissions,
+                "ingest_side_stage_bytes": self.ingest_side_stage_bytes,
                 "epoch": self.epoch,
                 "shard_heat": self.heat.dump(),
             }

@@ -43,6 +43,16 @@ def build_parser() -> argparse.ArgumentParser:
         "at-least-once acks instead of direct dbnode writes",
     )
     p.add_argument(
+        "--msg-max-unacked",
+        type=int,
+        default=4096,
+        help="m3msg backpressure watermark (0 = unbounded): when more "
+        "than this many produced messages still await consumer acks, a "
+        "flush first attempts one redelivery sweep and then PARKS the "
+        "whole batch in the aggregator's pending queue for the next "
+        "pass instead of growing the unacked queue without bound",
+    )
+    p.add_argument(
         "--kv-endpoint",
         default="",
         help="control-plane KV for replicated HA: leased leader election "
@@ -111,8 +121,21 @@ def main(argv=None) -> int:
         )
 
     flushed_count = [0]
+    backpressure_parks = [0]
 
     def handler(metrics):
+        if producer is not None and args.msg_max_unacked > 0:
+            # backpressure BEFORE any produce, so the park is atomic for
+            # the batch: Aggregator.flush re-queues it in _pending_emit
+            # (or a follower mirror re-emits it) — nothing is half-sent
+            if producer.num_unacked > args.msg_max_unacked:
+                producer.retry_unacked()
+                if producer.num_unacked > args.msg_max_unacked:
+                    backpressure_parks[0] += 1
+                    raise RuntimeError(
+                        f"m3msg backpressure: {producer.num_unacked} "
+                        f"unacked > --msg-max-unacked={args.msg_max_unacked}"
+                    )
         flushed_count[0] += len(metrics)
         if producer is not None:
             by_shard: dict[int, list] = {}

@@ -318,17 +318,23 @@ class Coordinator:
         end-to-end (client host queues → one write_tagged_batch RPC per
         host) when the backing db supports it."""
         count = 0
-        batch = []
+        rows = []
         for ts in req.timeseries:
             tags = make_tags([(l.name, l.value) for l in ts.labels])
             for s in ts.samples:
-                t_nanos = s.timestamp * MS
-                keep = True
-                if self.downsampler is not None:
-                    keep = self.downsampler.write(tags, t_nanos, s.value, MetricType.GAUGE)
-                if keep:
-                    batch.append((tags, t_nanos, s.value, 1))
+                rows.append((tags, s.timestamp * MS, s.value, MetricType.GAUGE))
                 count += 1
+        # mapping/rollup rules evaluate over the whole batch (cached
+        # matcher, one aggregator lock) instead of per sample
+        if self.downsampler is not None and rows:
+            keeps = self.downsampler.write_batch(rows)
+        else:
+            keeps = [True] * len(rows)
+        batch = [
+            (tags, t_nanos, v, 1)
+            for (tags, t_nanos, v, _), keep in zip(rows, keeps)
+            if keep
+        ]
         if batch:
             if hasattr(self.db, "write_tagged_batch"):
                 errs = self.db.write_tagged_batch(self.namespace, batch)
@@ -549,14 +555,16 @@ class Coordinator:
         from .influx import parse_body
 
         points = parse_body(body, precision=precision)
+        rows = []
         for name, tags, t_nanos, value in points:
             # __name__ must win over any same-named line tag
             tag_pairs = make_tags({**tags, "__name__": name})
-            keep = True
-            if self.downsampler is not None:
-                keep = self.downsampler.write(
-                    tag_pairs, t_nanos, value, MetricType.GAUGE
-                )
+            rows.append((tag_pairs, t_nanos, value, MetricType.GAUGE))
+        if self.downsampler is not None and rows:
+            keeps = self.downsampler.write_batch(rows)
+        else:
+            keeps = [True] * len(rows)
+        for (tag_pairs, t_nanos, value, _), keep in zip(rows, keeps):
             if keep:
                 self.db.write_tagged(self.namespace, tag_pairs, t_nanos, value)
         from ..query.tenants import charge_writes

@@ -73,6 +73,33 @@ def build_parser() -> argparse.ArgumentParser:
         "stats on the index_stats debug op",
     )
     p.add_argument(
+        "--device-ingest",
+        action="store_true",
+        help="device-side ingest (m3_tpu/ingest/): write batches mirror "
+        "into per-shard (series_lane, slot) column planes; sealed blocks "
+        "device-encode through the batched m3tsz kernel (m3_tpu/ops/"
+        "encode.py) and admit born-resident with zero admission upload",
+    )
+    p.add_argument(
+        "--ingest-lanes",
+        type=int,
+        default=1024,
+        help="series lanes per ingest window plane (--device-ingest)",
+    )
+    p.add_argument(
+        "--ingest-slots",
+        type=int,
+        default=1024,
+        help="datapoint slots per ingest lane (--device-ingest)",
+    )
+    p.add_argument(
+        "--ingest-sync-batch",
+        type=int,
+        default=8192,
+        help="staged rows per shard that trigger a batched column-plane "
+        "sync to device (--device-ingest)",
+    )
+    p.add_argument(
         "--selfmon-interval",
         type=float,
         default=0.0,
@@ -184,6 +211,7 @@ def main(argv=None) -> int:
 
     from ..cache import CacheOptions
     from ..index.device import IndexDeviceOptions
+    from ..ingest import IngestOptions
     from ..resident import ResidentOptions
 
     db = Database(
@@ -200,6 +228,12 @@ def main(argv=None) -> int:
         index_device_options=IndexDeviceOptions(
             enabled=args.index_device_bytes > 0,
             max_bytes=max(args.index_device_bytes, 0),
+        ),
+        ingest_options=(
+            IngestOptions(lanes=args.ingest_lanes, slots=args.ingest_slots,
+                          sync_batch=args.ingest_sync_batch)
+            if args.device_ingest
+            else None
         ),
     )
     opts = NamespaceOptions(

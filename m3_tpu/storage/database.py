@@ -32,6 +32,28 @@ from ..utils.xtime import Unit
 _M_DECODED_BYTES = METRICS.counter(
     "decoded_bytes_total", "compressed stream bytes decoded into arrays"
 )
+# a cold-flush volume bump makes every lower volume of the block
+# unservable (the reader cache checks volume; caches/pool invalidate on
+# the flush notification), so they are deleted eagerly instead of
+# lingering on disk until retention expiry
+_M_SUPERSEDED_DELETED = METRICS.counter(
+    "db_superseded_volumes_deleted_total",
+    "superseded fileset volumes deleted eagerly at cold-flush volume bump",
+)
+_M_ENCODE_LANES = METRICS.counter(
+    "encode_device_lanes_total",
+    "lanes sealed through the batched device m3tsz encode kernel",
+)
+_M_ENCODE_FALLBACK = METRICS.counter(
+    "encode_host_fallback_lanes_total",
+    "sealing lanes the kernel cannot take (annotated values, sub-second "
+    "timestamps, mixed int/float, delta overflows) — encoded by the host "
+    "codec, riding the same fileset and admission batch",
+)
+_M_ENCODE_BYTES = METRICS.counter(
+    "encode_device_bytes_total",
+    "compressed stream bytes produced by the device encode kernel",
+)
 from .commitlog import CommitLog, CommitLogEntry
 from .fs import (
     CHUNK_K,
@@ -83,11 +105,23 @@ class Shard:
         cache: BlockCache | None = None,
         invalidator: CacheInvalidator | None = None,
         pool: ResidentPool | None = None,
+        ingest_options=None,
     ) -> None:
         self.id = shard_id
         self.namespace = ns
         self.opts = opts
         self.base = base
+        # device column write buffer (m3_tpu/ingest/): write batches
+        # accumulate into (series_lane, slot) planes, sealed blocks
+        # device-encode (ops/encode.py) and are born resident — opt-in
+        # via Database(ingest_options=...) / dbnode --device-ingest
+        self.ingest = None
+        if ingest_options is not None and ingest_options.enabled:
+            from ..ingest import ColumnWriteBuffer
+
+            self.ingest = ColumnWriteBuffer(
+                ingest_options, opts.block_size_nanos
+            )
         # decoded-block cache (m3_tpu/cache/): sealed fileset blocks decode
         # once; the invalidator hooks write/flush/tick so nothing stale or
         # superseded stays resident
@@ -170,6 +204,8 @@ class Shard:
             if bs not in buf.buckets:
                 self._buffered_blocks[bs] = self._buffered_blocks.get(bs, 0) + 1
             buf.write(t_nanos, value, unit)
+            if self.ingest is not None:
+                self.ingest.append(sid, t_nanos, value, int(unit))
             self.invalidator.on_write(self.namespace, self.id, sid, bs)
 
     def _buffered_dec(self, block_start: int, n: int = 1) -> None:
@@ -415,25 +451,141 @@ class Shard:
             return out
 
     def warm_flush(self, flush_before_nanos: int) -> list[FilesetID]:
-        """shard.go:2146 — write filesets for complete blocks, then evict."""
+        """shard.go:2146 — write filesets for complete blocks, then evict.
+
+        With device ingest on, sealed blocks encode through the batched
+        m3tsz kernel (ops/encode.py) and are BORN resident: the fileset
+        persists from the device-encoded bytes and admission gathers the
+        pages device->device (pool.admit_block_device) instead of
+        re-reading and re-uploading the fileset."""
         with self.lock:
-            flushed = self._warm_flush_locked(flush_before_nanos)
-            payload = self._collect_admission_locked(flushed)
+            flushed, device_payload = self._warm_flush_locked(flush_before_nanos)
+            device_blocks = {(p[0], p[1]) for p in device_payload}
+            payload = self._collect_admission_locked(
+                [
+                    f
+                    for f in flushed
+                    if (f.block_start, f.volume) not in device_blocks
+                ]
+            )
         self._admit_payload(payload)
+        self._admit_device_payload(device_payload)
         return flushed
 
-    def _warm_flush_locked(self, flush_before_nanos: int) -> list[FilesetID]:
-        blocks: dict[int, dict[bytes, bytes]] = {}
+    def _seal_encode_locked(self, bs: int, buckets: list):
+        """Device-encode one sealing block: ``buckets`` is
+        ``[(sid, BufferBucket)]``. Returns ``(series_streams,
+        fileset_side_rows, device_payload | None)`` where device_payload
+        is ``(block_start, volume_placeholder, words, dev_items,
+        host_items, chunk_k)`` admission input — volume is patched by
+        the caller. Ineligible lanes (annotated values, sub-second
+        timestamps, mixed int/float, overflows) fall back to the host
+        codec and ride the SAME admission batch as host items."""
+        from ..ops import encode as dev
+
+        if self.ingest is not None:
+            # release the sealed window's frame + clean/dirty accounting
+            # (the columns themselves are read off the canonical merged
+            # buckets; a clean lane's merge is a no-op)
+            self.ingest.seal_window(bs)
+        series: dict[bytes, bytes] = {}
+        side_rows: dict[bytes, object] = {}
+        host_items: list[tuple] = []
+        eligible: list[tuple] = []
+        for sid, bucket in buckets:
+            t, v, u = bucket.merged_points()
+            kind = dev.classify_lane(t, v, u).kind
+            if kind == dev.KIND_NONE:
+                stream = bucket.merged_stream()
+                if stream:
+                    series[sid] = stream
+                    host_items.append((sid, stream, len(t)))
+            else:
+                eligible.append((sid, t, v, kind))
+        _M_ENCODE_FALLBACK.inc(len(host_items))
+        if not eligible:
+            return series, side_rows, None
+        pw = (
+            self.pool.options.page_words
+            if self.pool is not None and self.pool.enabled
+            else 1
+        )
+        lanes = [(c[1], c[2]) for c in eligible]
+        res = dev.encode_lanes(
+            lanes, [c[3] for c in eligible], k=CHUNK_K, round_words_to=pw
+        )
+        rows = dev.side_rows_for(res, lanes, bs)
+        streams = res.streams()
+        _M_ENCODE_LANES.inc(len(eligible))
+        _M_ENCODE_BYTES.inc(int(res.nbytes.sum()))
+        dev_items = []
+        for m, (sid, t, v, kind) in enumerate(eligible):
+            series[sid] = streams[m]
+            side_rows[sid] = rows[m]
+            dev_items.append(
+                (
+                    sid,
+                    m,
+                    int(res.nbytes[m]),
+                    int(res.n_chunks[m]),
+                    dev.lane_max_span(res, m),
+                    rows[m],
+                )
+            )
+        return series, side_rows, (bs, 0, res.words, dev_items, host_items, CHUNK_K)
+
+    def _admit_device_payload(self, payload: list) -> int:
+        """Stage-2 admission of device-encoded seals (outside the shard
+        lock, like :meth:`_admit_payload`): pages gather device->device,
+        zero stream-byte upload; host-fallback lanes of the same block
+        ride the same batch and pay the normal upload."""
+        if self.pool is None or not self.pool.enabled:
+            return 0
+        admitted = 0
+        for block_start, volume, words, items, host_items, chunk_k in payload:
+            res = self.pool.admit_block_device(
+                self.namespace, self.id, block_start, volume, words, items,
+                chunk_k=chunk_k, host_items=host_items,
+            )
+            admitted += res.admitted
+        return admitted
+
+    def _warm_flush_locked(self, flush_before_nanos: int):
+        blocks: dict[int, list] = {}
         for sid, buf in self.series.items():
-            for bs, stream in buf.streams_before(flush_before_nanos).items():
-                if stream and bs not in self._flushed_blocks:
-                    blocks.setdefault(bs, {})[sid] = stream
+            for bs, bucket in buf.buckets.items():
+                if (
+                    bs + buf.block_size <= flush_before_nanos
+                    and bucket.times
+                    and bs not in self._flushed_blocks
+                ):
+                    blocks.setdefault(bs, []).append((sid, bucket))
         flushed = []
-        for bs, series in sorted(blocks.items()):
+        device_payload = []
+        for bs, buckets in sorted(blocks.items()):
+            if self.ingest is not None:
+                series, side_rows, dev_payload = self._seal_encode_locked(
+                    bs, buckets
+                )
+            else:
+                series = {
+                    sid: stream
+                    for sid, bucket in buckets
+                    for stream in [bucket.merged_stream()]
+                    if stream
+                }
+                side_rows, dev_payload = {}, None
+            if not series:
+                continue
             fid = FilesetID(self.namespace, self.id, bs, volume=0)
-            write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
+            write_fileset(
+                self.base, fid, series, self.opts.block_size_nanos, CHUNK_K,
+                side_rows=side_rows or None,
+            )
             self._flushed_blocks.add(bs)
             flushed.append(fid)
+            if dev_payload is not None:
+                device_payload.append(dev_payload)
         if flushed:
             self._invalidate_filesets()
             self.invalidator.on_flush(self.namespace, self.id, flushed)
@@ -448,7 +600,7 @@ class Shard:
         # walking thousands of empty buckets per query
         for sid in [s for s, buf in self.series.items() if not buf.buckets]:
             del self.series[sid]
-        return flushed
+        return flushed, device_payload
 
     def cold_flush(self, flush_before_nanos: int) -> list[FilesetID]:
         """shard.go:2212 + persist/fs/merger.go — out-of-order writes into
@@ -493,6 +645,15 @@ class Shard:
             fid = FilesetID(self.namespace, self.id, bs, volume=vol)
             write_fileset(self.base, fid, series, self.opts.block_size_nanos, CHUNK_K)
             flushed.append(fid)
+            # eager superseded-volume cleanup: every lower volume of this
+            # block can never serve a read again (the reader cache checks
+            # volume; caches/pool invalidate on the flush notification
+            # below), so delete it NOW instead of letting it linger on
+            # disk until retention expiry
+            for old in list_fileset_volumes(self.base, self.namespace, self.id):
+                if old.block_start == bs and old.volume < vol:
+                    delete_fileset(self.base, old)
+                    _M_SUPERSEDED_DELETED.inc()
             for sid in updates:
                 if self.series[sid].evict_block(bs):
                     self._buffered_dec(bs)
@@ -584,6 +745,10 @@ class Shard:
                 self._buffered_dec(bs)
             if not buf.buckets:
                 del self.series[sid]
+        if self.ingest is not None:
+            for bs in self.ingest.open_windows():
+                if bs + self.opts.block_size_nanos <= expire_before:
+                    self.ingest.drop_window(bs)
         bsz = self.opts.block_size_nanos
         expired = [
             fid
@@ -612,12 +777,16 @@ class Namespace:
         invalidator: CacheInvalidator | None = None,
         pool: ResidentPool | None = None,
         index_store=None,
+        ingest_options=None,
     ) -> None:
         self.name = name
         self.opts = opts
         self.num_shards = num_shards
         self.shards = [
-            Shard(i, name, opts, base, cache=cache, invalidator=invalidator, pool=pool)
+            Shard(
+                i, name, opts, base, cache=cache, invalidator=invalidator,
+                pool=pool, ingest_options=ingest_options,
+            )
             for i in range(num_shards)
         ]
         self.index = None
@@ -644,6 +813,7 @@ class Database:
         cache_options: CacheOptions | None = None,
         resident_options: ResidentOptions | None = None,
         index_device_options=None,
+        ingest_options=None,
     ) -> None:
         self.base = base_dir
         self.num_shards = num_shards
@@ -684,6 +854,10 @@ class Database:
             from ..index.device import DeviceIndexStore
 
             self.index_device_store = DeviceIndexStore(self.index_device_options)
+        # device-side ingest (m3_tpu/ingest/): write batches mirror into
+        # per-shard column planes so seal device-encodes and admits
+        # born-resident. Off by default — opt-in via dbnode --device-ingest.
+        self.ingest_options = ingest_options
         self.cache_invalidator = CacheInvalidator(self.block_cache, self.resident_pool)
         self._commitlogs: dict[str, CommitLog] = {}
         self.bootstrapped = False
@@ -725,6 +899,7 @@ class Database:
                 invalidator=self.cache_invalidator,
                 pool=self.resident_pool,
                 index_store=self.index_device_store,
+                ingest_options=self.ingest_options,
             )
             self.namespaces[name] = ns
             if self.commitlog_enabled:
@@ -884,6 +1059,17 @@ class Database:
                         bucket._stream_cache = None
                         bucket._arrays_cache = None
                         applied.append(CommitLogEntry(sid, t, v))
+                    if sh.ingest is not None and items:
+                        # mirror the batch into the device column planes
+                        # (one vectorized append per shard, not per point);
+                        # spilled rows just lose the device-seal shortcut —
+                        # the bucket append above stays the source of truth
+                        sh.ingest.append_batch(
+                            [e[0] for e in items],
+                            [e[1] for e in items],
+                            [e[2] for e in items],
+                            [unit_s] * len(items),
+                        )
             self._writes_counter(ns).inc(len(applied))
         finally:
             if touched:

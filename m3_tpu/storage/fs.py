@@ -109,8 +109,16 @@ def write_fileset(
     series: dict[bytes, bytes],
     block_size_nanos: int,
     chunk_k: int = CHUNK_K,
+    side_rows: dict | None = None,
 ) -> None:
-    """Write all fileset files, checkpoint LAST (write.go ordering)."""
+    """Write all fileset files, checkpoint LAST (write.go ordering).
+
+    ``side_rows`` optionally maps sid -> packed uint32[n_chunks, 10]
+    side rows ALREADY computed (the device encode path emits them at
+    seal, ops/encode.side_rows_for) — those sids skip the host prescan
+    entirely; absent sids prescan as before. The rows are bit-identical
+    to the prescan's packing, so the persisted side file is the same
+    bytes either way."""
     from .. import native
 
     os.makedirs(_dir(base, fid), exist_ok=True)
@@ -122,21 +130,41 @@ def write_fileset(
     offset = 0
     index_off = 0
     summaries: list[bytes] = []
-    if native.available():
-        all_snaps = native.prescan_batch([series[sid] for sid in ids], k=chunk_k)
-    else:
-        from ..ops.chunked import snapshot_stream
+    side_rows = {k: v for k, v in (side_rows or {}).items() if v is not None}
+    need = [i for i, sid in enumerate(ids) if sid not in side_rows]
+    all_snaps: list = [None] * len(ids)
+    if need:
+        if native.available():
+            scanned = native.prescan_batch(
+                [series[ids[i]] for i in need], k=chunk_k
+            )
+        else:
+            from ..ops.chunked import snapshot_stream
 
-        all_snaps = [snapshot_stream(series[sid], chunk_k) for sid in ids]
+            scanned = [snapshot_stream(series[ids[i]], chunk_k) for i in need]
+        for i, snaps in zip(need, scanned):
+            all_snaps[i] = snaps
     from ..ops.sideplane import pack_side_rows
 
     # side-file version for THIS fileset: v3 packed rows when every
     # chunk's state fits the packed ranges, else the v2 struct for the
     # whole file (records are fixed-width; the version is per file)
     side_version = SIDE_VERSION
-    packed_all = [pack_side_rows(snaps, fid.block_start) for snaps in all_snaps]
+    packed_all = [
+        side_rows[sid]
+        if sid in side_rows
+        else pack_side_rows(all_snaps[i], fid.block_start)
+        for i, sid in enumerate(ids)
+    ]
     if any(p is None for p in packed_all):
         side_version = 2
+        from ..ops.sideplane import unpack_side_rows
+
+        for i, sid in enumerate(ids):
+            if all_snaps[i] is None:
+                # v2 needs snapshot dicts; the packed->dict unpack is
+                # bit-exact for every row the packer accepted
+                all_snaps[i] = unpack_side_rows(packed_all[i], fid.block_start)
 
     def _side_bytes(i: int) -> bytes:
         if side_version >= 3:
@@ -162,10 +190,12 @@ def write_fileset(
 
     for i, sid in enumerate(ids):
         stream = series[sid]
-        snaps = all_snaps[i]
+        n_chunks = (
+            len(packed_all[i]) if all_snaps[i] is None else len(all_snaps[i])
+        )
         side_bytes = _side_bytes(i)
         index_entries.append(
-            struct.pack("<IIQI", len(sid), len(stream), offset, len(snaps)) + sid
+            struct.pack("<IIQI", len(sid), len(stream), offset, n_chunks) + sid
         )
         data_parts.append(stream)
         side_parts.append(side_bytes)

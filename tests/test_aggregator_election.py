@@ -57,7 +57,7 @@ def test_leader_emits_follower_mirrors():
     assert a.is_leader and not b.is_leader
     assert len(out_a) > 0 and out_b == []
     # follower buffers for the flushed window were pruned
-    assert all(not buf.ids for sh in b.shards for buf in sh.buffers.values())
+    assert all(buf.n == 0 for sh in b.shards for buf in sh.buffers.values())
 
 
 def test_leader_death_follower_takeover_exactly_once():

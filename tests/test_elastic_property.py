@@ -44,12 +44,20 @@ class _RepairPeer:
 
 def _no_superseded_volumes(db):
     """Every cache/pool entry's volume is the block's LATEST fileset
-    volume — the cold-flush bump invalidated everything below it."""
-    latest: dict[tuple[int, int], int] = {}
+    volume — the cold-flush bump invalidated everything below it — and
+    the DISK holds exactly one volume per block: the bump deletes
+    superseded filesets eagerly instead of leaving them for retention."""
+    on_disk: dict[tuple[int, int], list[int]] = {}
     for shard in db.namespaces["ns"].shards:
-        for fid in fs.list_filesets(db.base, "ns", shard.id):
-            k = (shard.id, fid.block_start)
-            latest[k] = max(latest.get(k, -1), fid.volume)
+        for fid in fs.list_fileset_volumes(db.base, "ns", shard.id):
+            on_disk.setdefault((shard.id, fid.block_start), []).append(fid.volume)
+    latest: dict[tuple[int, int], int] = {}
+    for k, vols in on_disk.items():
+        latest[k] = max(vols)
+        assert len(vols) == 1, (
+            f"disk holds superseded volumes {sorted(vols)} for "
+            f"shard={k[0]} bs={k[1]} (eager cleanup should leave one)"
+        )
     for name, od in (
         ("pool", db.resident_pool._od),
         ("cache", db.block_cache._od),

@@ -458,6 +458,59 @@ def test_frame_label_key_fires():
     assert codes(findings) == {"M3L005"}
 
 
+def test_ingest_spill_reason_label_quiet():
+    # the device-ingest family (ingest/buffer.py): spill causes are the
+    # hand-enumerated window/lanes/slots vocabulary under the allowlisted
+    # "reason" key; the unlabeled counters are the sync/seal/admission
+    # totals the check_ingest gate scrapes
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def spill(reason):
+            METRICS.counter(
+                "ingest_spilled_total", "rows the planes could not take",
+                labels={"reason": reason},
+            )
+            METRICS.counter("ingest_device_syncs_total", "plane scatters")
+            METRICS.counter("ingest_device_admissions_total", "born resident")
+        """
+    )
+    assert findings == []
+
+
+def test_ingest_per_series_label_key_fires():
+    # series ids are unbounded user data — a per-sid ingest counter would
+    # be one exposition series per written series; lanes are addressed by
+    # the bounded "shard" key or not at all
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def spill(sid):
+            METRICS.counter(
+                "ingest_lane_overflow_total", "per-series lane overflow",
+                labels={"sid": sid},
+            )
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+
+
+def test_encode_kernel_prefixed_name_fires():
+    # the encode family keeps the registry-prefix rule: minting
+    # "m3tpu_encode_*" literals would expose m3tpu_m3tpu_encode_*
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        METRICS.counter("m3tpu_encode_lanes_total", "device-encoded lanes")
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+    assert "m3tpu_" in findings[0].message
+
+
 def test_uncapped_tenant_like_label_key_fires():
     # near-miss keys stay banned: an uncapped identity key ("tenant_id",
     # "user") would be unbounded exposition cardinality

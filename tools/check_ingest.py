@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""CI guard for device-side ingest: born-resident seals over a REAL
+multi-process cluster under sustained write load (ingest/buffer.py +
+ops/encode.py + resident/pool.py + services/aggregator.py).
+
+Boots TWO single-node clusters from the same write stream — one with
+``--device-ingest`` (column write buffer + batched m3tsz encode at seal),
+one host-encoded baseline — and holds the device path to the host codec's
+contract end to end:
+
+- SEAL: flushing the device cluster admits every sealed block straight
+  from the encode kernel's output pages — ``m3tpu_resident_upload_bytes_total``
+  stays EXACTLY ZERO while ``m3tpu_ingest_device_admissions_total`` counts
+  every admission (device_admissions == admissions), and nothing spilled
+  out of the column planes along the way.
+- BIT-IDENTITY: every read of a device-encoded block is bit-identical to
+  the host baseline (float64 payloads compared exactly), and the sealed
+  filesets on disk are byte-for-byte the files the host codec writes —
+  the encode kernel is an exact inverse of the chunked decoder, not an
+  approximation of it.
+- AGGREGATION HA: two aggregator processes with mirrored input flush
+  against the leased leader election; SIGKILL the leader MID-WINDOW while
+  datapoints for that window are still arriving. The follower's takeover
+  must emit the interrupted window exactly once with all its datapoints —
+  no double-emitted and no dropped aggregates.
+- Throughout: a sustained writer keeps batches flowing into both clusters
+  (live block, device-eligible lanes) with zero client-visible errors,
+  and the final flush of everything it wrote still uploads zero bytes.
+
+Exit code 0 = contract holds, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/check_ingest.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+NANOS = 1_000_000_000
+HOUR = 3600 * NANOS
+BSZ_SECS = 2 * 3600
+BSZ = BSZ_SECS * NANOS
+T0 = 1_600_000_000 * NANOS
+BS0 = (T0 // BSZ) * BSZ  # the sealed block every check revolves around
+N_SERIES = 48
+N_POINTS = 200  # 9600 rows: crosses the 8192-row sync batch at least once
+WINDOW = 10 * NANOS  # aggregation policy resolution (10s:2d)
+
+_FAILED: list[str] = []
+
+
+def check(ok: bool, what: str) -> bool:
+    print(("PASS" if ok else "FAIL") + f"  {what}", flush=True)
+    if not ok:
+        _FAILED.append(what)
+    return ok
+
+
+def _scrape(expo: str, family: str) -> float:
+    """Sum every sample of one family in a Prometheus text exposition."""
+    total, seen = 0.0, False
+    for line in expo.splitlines():
+        m = re.match(rf"^{re.escape(family)}(?:{{[^}}]*}})? ([0-9.eE+-]+)$", line)
+        if m:
+            total += float(m.group(1))
+            seen = True
+    return total if seen else -1.0
+
+
+def _tags(i: int):
+    return ((b"__name__", b"ingest_gauge"), (b"i", b"%04d" % i))
+
+
+def _points(i: int):
+    """Device-eligible lanes: second-aligned times, 2/3 int-valued and
+    1/3 full-precision float values (both encode on device; a float64
+    survives the binary RPC framing exactly)."""
+    pts = []
+    for k in range(N_POINTS):
+        t = T0 + k * 20 * NANOS
+        if i % 3 == 2:
+            v = float(i) + k * 0.1234567891 + 1e-9  # FLOAT lanes
+        else:
+            v = float(i * 100 + k)  # INT lanes
+        pts.append((t, v))
+    return pts
+
+
+def _write_phase_a(node, unit) -> None:
+    # interleave series within each batch — the column buffer's grouped
+    # scatter, not a per-series fast path, takes these
+    pts = {i: _points(i) for i in range(N_SERIES)}
+    entries = []
+    for k in range(N_POINTS):
+        for i in range(N_SERIES):
+            t, v = pts[i][k]
+            entries.append((_tags(i), t, v, unit))
+    B = 256
+    for off in range(0, len(entries), B):
+        node.client.write_tagged_batch("default", entries[off : off + B])
+
+
+class _Writer(threading.Thread):
+    """Sustained load: identical device-eligible batches into both
+    clusters for the whole aggregator phase. Strictly increasing
+    second-aligned timestamps per series keep every lane clean."""
+
+    def __init__(self, nodes, unit, base_t):
+        super().__init__(daemon=True)
+        self.nodes, self.unit, self.base_t = nodes, unit, base_t
+        self.stop = threading.Event()
+        self.errors: list[str] = []
+        self.rounds = 0
+
+    def run(self):
+        from m3_tpu.rules.rules import encode_tags_id  # noqa: F401 (warm import)
+
+        while not self.stop.is_set() and self.rounds < 600:
+            r = self.rounds
+            entries = [
+                (
+                    ((b"__name__", b"live_gauge"), (b"i", b"%02d" % i)),
+                    self.base_t + r * NANOS,
+                    float(i * 1000 + r),
+                    self.unit,
+                )
+                for i in range(16)
+            ]
+            for node in self.nodes:
+                try:
+                    node.client.write_tagged_batch("default", entries)
+                except Exception as e:  # pragma: no cover - failure path
+                    self.errors.append(f"round {r}: {e!r}")
+                    return
+            self.rounds += 1
+            time.sleep(0.05)
+
+
+def _read_all(node, tags_fn, n, lo, hi):
+    from m3_tpu.rules.rules import encode_tags_id
+
+    out = {}
+    for i in range(n):
+        sid = encode_tags_id(tags_fn(i))
+        out[i] = [(dp.timestamp, dp.value) for dp in
+                  node.client.read("default", sid, lo, hi)]
+    return out
+
+
+def _fileset_bytes(base: str, node_id: str, block_start: int) -> dict[str, bytes]:
+    """Every fileset file of one block, keyed by path relative to the
+    node's data root — the byte-identity comparison surface."""
+    root = os.path.join(base, node_id, "data")
+    out = {}
+    prefix = f"fileset-{block_start}-"
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.startswith(prefix):
+                p = os.path.join(dirpath, f)
+                with open(p, "rb") as fh:
+                    out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from m3_tpu.aggregator.server import AggregatorClient
+    from m3_tpu.metrics.encoding import UnaggregatedMessage
+    from m3_tpu.metrics.types import MetricType, Untimed
+    from m3_tpu.rules.rules import encode_tags_id
+    from m3_tpu.utils.xtime import Unit
+    from m3_tpu.testing.proc_cluster import ProcCluster, _spawn_listening
+
+    unit = int(Unit.SECOND)
+    base_dev = tempfile.mkdtemp(prefix="m3tpu-check-ingest-dev-")
+    base_host = tempfile.mkdtemp(prefix="m3tpu-check-ingest-host-")
+    dev = host = writer = None
+    aggs = []
+    try:
+        common = dict(num_nodes=1, num_shards=4, replica_factor=1,
+                      block_size_secs=BSZ_SECS)
+        dev = ProcCluster(
+            base_dir=base_dev,
+            extra_args=[
+                "--device-ingest",
+                "--ingest-lanes", "256",
+                "--ingest-slots", "1024",
+                "--ingest-sync-batch", "1024",
+                "--resident-bytes", str(64 << 20),
+            ],
+            **common,
+        )
+        host = ProcCluster(
+            base_dir=base_host,
+            extra_args=["--resident-bytes", str(64 << 20)],
+            **common,
+        )
+        nd = next(iter(dev.nodes.values()))
+        nh = next(iter(host.nodes.values()))
+
+        # ---- phase A: identical write stream, seal, zero-upload ----
+        for node in (nd, nh):
+            _write_phase_a(node, unit)
+        for node in (nd, nh):
+            node.client.flush("default", BS0 + 2 * BSZ)
+
+        ed, eh = nd.client.metrics(), nh.client.metrics()
+        sd, sh = nd.client.resident_stats(), nh.client.resident_stats()
+        check(_scrape(ed, "m3tpu_ingest_appends_total") >= N_SERIES * N_POINTS,
+              "device node: column buffer took every row")
+        check(_scrape(ed, "m3tpu_ingest_spilled_total") == 0.0,
+              "device node: zero spills out of the column planes")
+        check(_scrape(ed, "m3tpu_ingest_device_syncs_total") > 0,
+              "device node: batched plane syncs ran")
+        check(_scrape(ed, "m3tpu_encode_device_lanes_total") >= N_SERIES,
+              "device node: every lane went through the encode kernel")
+        check(_scrape(ed, "m3tpu_encode_host_fallback_lanes_total") == 0.0,
+              "device node: no host-codec fallback lanes in this stream")
+        check(_scrape(ed, "m3tpu_ingest_device_admissions_total") > 0,
+              "device node: sealed blocks admitted from device encode")
+        check(_scrape(ed, "m3tpu_resident_upload_bytes_total") == 0.0,
+              "device node: ZERO admission upload bytes (born resident)")
+        check(sd["device_admissions"] == sd["admissions"] > 0,
+              "device node: every admission took the device path")
+        check(sd["ingest_side_stage_bytes"] > 0,
+              "device node: packed side planes staged for the v3 side file")
+        check(_scrape(eh, "m3tpu_ingest_device_admissions_total") <= 0.0,
+              "host baseline: no device admissions")
+        check(_scrape(eh, "m3tpu_resident_upload_bytes_total") > 0,
+              "host baseline: admissions paid the PCIe upload")
+
+        # ---- phase A: reads + on-disk filesets bit-identical ----
+        lo, hi = T0 - 1, T0 + BSZ
+        rd = _read_all(nd, _tags, N_SERIES, lo, hi)
+        rh = _read_all(nh, _tags, N_SERIES, lo, hi)
+        expected = {i: _points(i) for i in range(N_SERIES)}
+        check(rd == expected, "device reads match the written payload exactly")
+        check(rd == rh, "device reads bit-identical to host-encoded baseline")
+        fd = _fileset_bytes(base_dev, nd.node_id, BS0)
+        fh = _fileset_bytes(base_host, nh.node_id, BS0)
+        check(len(fd) > 0 and sorted(fd) == sorted(fh),
+              "sealed block wrote the same fileset files on both nodes")
+        diff = [p for p in fd if fd[p] != fh.get(p)]
+        check(not diff,
+              "device-encoded filesets byte-identical to host codec "
+              f"(diff: {diff[:4]})")
+
+        # ---- phase B: sustained writes + aggregator leader kill ----
+        live_base = (time.time_ns() // BSZ) * BSZ + 100 * NANOS
+        writer = _Writer([nd, nh], unit, live_base)
+        writer.start()
+
+        for iid in ("aggA", "aggB"):
+            proc, ahost, aport = _spawn_listening(
+                [
+                    sys.executable, "-m", "m3_tpu.services.aggregator",
+                    "--port", "0", "--policy", "10s:2d",
+                    "--flush-interval-secs", "0.4",
+                    "--forward", nh.endpoint,
+                    "--kv-endpoint", host.kv_endpoint,
+                    "--instance-id", iid,
+                    "--election-lease-secs", "2.0",
+                ],
+                f"aggregator-{iid}",
+            )
+            aggs.append((proc, AggregatorClient([(ahost, aport)])))
+
+        mid = encode_tags_id(((b"__name__", b"ha_metric"),))
+        sid = mid + b".last"  # gauge default aggregation suffix
+
+        def send(t, v, only=None):
+            for _, client in (aggs if only is None else [aggs[only]]):
+                client.send(UnaggregatedMessage(
+                    Untimed(MetricType.GAUGE, mid, gauge_value=v), t, timed=True
+                ))
+
+        t0 = time.time_ns() - 90 * NANOS
+        for i in range(6):  # closed windows: takeover must NOT re-emit these
+            send(t0 + i * WINDOW, float(i))
+
+        def fetch():
+            dps = nh.client.read("default", sid, t0 - NANOS,
+                                 time.time_ns() + 120 * NANOS)
+            return sorted(dp.value for dp in dps), [dp.timestamp for dp in dps]
+
+        deadline = time.monotonic() + 25
+        pts, ts = fetch()
+        while time.monotonic() < deadline and len(pts) < 6:
+            time.sleep(0.3)
+            pts, ts = fetch()
+        check(pts == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0],
+              f"leader emitted the closed windows exactly once ({pts})")
+
+        # kill the leader MID-WINDOW: datapoints for the current window are
+        # in flight on both replicas, more arrive after the kill — the
+        # follower must emit that window once, with ALL of them
+        now = time.time_ns()
+        wstart = (now // WINDOW) * WINDOW
+        if now - wstart > 6 * NANOS:  # too close to the window end: use next
+            time.sleep((wstart + WINDOW - now) / 1e9 + 0.2)
+            wstart += WINDOW
+        send(wstart + 1 * NANOS, 700.0)
+        send(wstart + 2 * NANOS, 710.0)
+        aggs[0][0].kill()
+        aggs[0][0].wait(timeout=10)
+        send(wstart + 3 * NANOS, 777.0, only=1)  # arrives after the kill
+
+        deadline = time.monotonic() + 40  # lease (2s) + window close + slack
+        pts, ts = fetch()
+        while time.monotonic() < deadline and len(pts) < 7:
+            time.sleep(0.3)
+            pts, ts = fetch()
+        check(pts == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 777.0],
+              f"follower emitted the interrupted window once, complete ({pts})")
+        check(len(ts) == len(set(ts)) == 7,
+              "one aggregate per window timestamp (no doubles)")
+        time.sleep(1.5)  # two flush passes of settle: no late re-emission
+        pts2, ts2 = fetch()
+        check(pts2 == pts and ts2 == ts,
+              "takeover settled: no double-emitted window after the kill")
+
+        # ---- phase C: the sustained load seals device-side too ----
+        writer.stop.set()
+        writer.join(timeout=30)
+        check(not writer.errors and writer.rounds > 10,
+              f"sustained writer: {writer.rounds} rounds, zero client errors "
+              f"({writer.errors[:2]})")
+        for node in (nd, nh):
+            node.client.flush("default", live_base + 3 * BSZ)
+        sd2 = nd.client.resident_stats()
+        check(sd2["upload_bytes"] == 0,
+              "device node: upload bytes STILL zero after sealing live load")
+        check(sd2["device_admissions"] == sd2["admissions"] > sd["admissions"],
+              "device node: live block sealed through the device path too")
+        live_tags = lambda i: ((b"__name__", b"live_gauge"), (b"i", b"%02d" % i))
+        ld = _read_all(nd, live_tags, 16, live_base - 1, live_base + BSZ)
+        lh = _read_all(nh, live_tags, 16, live_base - 1, live_base + BSZ)
+        check(ld == lh and sum(len(v) for v in ld.values()) == 16 * writer.rounds,
+              "sustained series bit-identical across device/host clusters")
+        return 0 if not _FAILED else 1
+    finally:
+        if writer is not None:
+            writer.stop.set()
+        for proc, client in aggs:
+            client.close()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        for cl in (dev, host):
+            if cl is not None:
+                cl.close()
+        shutil.rmtree(base_dev, ignore_errors=True)
+        shutil.rmtree(base_host, ignore_errors=True)
+        if _FAILED:
+            print(f"\n{len(_FAILED)} check(s) FAILED:", flush=True)
+            for f in _FAILED:
+                print(f"  - {f}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
