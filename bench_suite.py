@@ -1014,6 +1014,48 @@ def bench_index_device(series_counts, tmpdir="/tmp/m3tpu-index-device-bench"):
     )
 
 
+def bench_soak():
+    """Composed production-soak SLO gate (tools/check_soak.py): a seeded
+    multi-process RF=3 cluster + cluster-mode coordinator + aggregator HA
+    pair under overlapping acts (diurnal load, write storm, tenant flood,
+    node add+drain, aggregator leader SIGKILL, backfill burst, seeded
+    stragglers), with the SLO engine as the verdict. The headline is the
+    availability error budget still standing after ~90s of that. NOT in
+    the default config set — it spawns a fleet and owns the box while it
+    runs; invoke it deliberately (``--configs soak``, the CI gate)."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "check_soak.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, script, "--json"],
+        capture_output=True, text=True, timeout=900,
+    )
+    summary = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            summary = json.loads(line)
+    assert summary is not None, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert proc.returncode == 0 and not summary.get("failures"), summary
+    return _rec(
+        "soak_slo_gate",
+        summary["availability_budget_remaining"],
+        "availability budget remaining",
+        elapsed_secs=summary["elapsed_secs"],
+        total_ops=summary["total_ops"],
+        client_errors=summary["client_errors"],
+        sheds=summary["sheds"],
+        availability_sli=summary["availability_sli"],
+        latency_sli=summary["latency_sli"],
+        durability_probes=summary["durability_probes"],
+        freshness_probes=summary["freshness_probes"],
+        rollup_windows=summary["rollup_windows"],
+    )
+
+
 def main() -> None:
     import jax
 
@@ -1068,6 +1110,10 @@ def main() -> None:
     if "ingest" in want:
         ingest_records = bench_ingest(on_tpu)
         records.extend(ingest_records)
+    soak_record = None
+    if "soak" in want:
+        soak_record = bench_soak()
+        records.append(soak_record)
 
     # merge into an existing results file: re-running a subset of configs
     # replaces those records and keeps the rest
@@ -1089,6 +1135,19 @@ def main() -> None:
             f,
             indent=1,
         )
+    if soak_record is not None:
+        # BENCH_r07: the SLO round's headline — the error budget the
+        # fleet kept through the composed soak, with the act mix's vitals
+        with open("BENCH_r07.json", "w") as f:
+            json.dump(
+                {
+                    "platform": jax.devices()[0].device_kind,
+                    "parsed": soak_record,
+                    "records": [soak_record],
+                },
+                f,
+                indent=1,
+            )
     if ingest_records is not None:
         # BENCH_r06: the ingest round's headline (write-plane writes/s
         # vs the PROFILE.md 291k/s/core host ceiling) + its satellites

@@ -34,7 +34,7 @@ from ..utils.instrument import DEFAULT as METRICS
 
 # buckets matched to query latencies (sub-ms cached instant queries up to
 # multi-second cold range scans)
-_QUERY_BUCKETS = (
+QUERY_DURATION_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 )
@@ -147,6 +147,9 @@ class QueryStats:
         if self.record_routing:
             out["routing"] = list(self.routing)
             out["routingDropped"] = self.routing_dropped
+        objectives = slo_objectives_for(self.tenant)
+        if objectives is not None:
+            out["sloObjectives"] = objectives
         return out
 
 
@@ -176,6 +179,32 @@ def add_routing(series_id, block_start, path: str, reason: str = "") -> None:
             "reason": reason,
         }
     )
+
+
+# SLO-objective join seam: the SLO engine (m3_tpu/slo/engine.py)
+# installs a callable ``(tenant) -> [objective names]`` so debug query
+# rows (/debug/slow_queries, /debug/active_queries) can say which SLOs a
+# query counts against. A settable seam, not an import — the query layer
+# must not depend on the SLO package.
+_SLO_RESOLVER = None
+
+
+def set_slo_resolver(fn) -> None:
+    global _SLO_RESOLVER
+    _SLO_RESOLVER = fn
+
+
+def slo_objectives_for(tenant: str) -> list | None:
+    """Objective names the tenant's queries count against, or None when
+    no SLO engine is running (debug rows omit the field entirely then —
+    absent means 'no SLO plane', [] means 'none apply')."""
+    resolver = _SLO_RESOLVER
+    if resolver is None:
+        return None
+    try:
+        return list(resolver(tenant))
+    except Exception:
+        return None
 
 
 _local = threading.local()
@@ -219,18 +248,39 @@ def finish(st: QueryStats, duration_secs: float, error: str | None = None) -> No
     METRICS.counter("query_total", "completed queries").inc()
     if error is not None:
         METRICS.counter("query_errors_total", "failed queries").inc()
+    # availability SLI events (m3_tpu/slo): served-vs-failed per tenant.
+    # Sheds are counted (with reason) by the scheduler; 422 cost
+    # rejections are the CALLER's query being over budget, not the
+    # service being down — they count in neither class.
+    if st.queue_state != "shed" and st.limit_exceeded is None:
+        from . import tenants as _tenants
+
+        tenant = st.tenant or _tenants.DEFAULT_TENANT
+        if error is None:
+            METRICS.counter(
+                "query_completed_total",
+                "queries served successfully (availability SLI good events)",
+                labels={"tenant": tenant},
+            ).inc()
+        else:
+            METRICS.counter(
+                "query_failed_total",
+                "queries that failed serving (availability SLI bad events; "
+                "sheds counted separately in query_shed_total)",
+                labels={"tenant": tenant},
+            ).inc()
     # the trace id rides as an exemplar: a slow query_duration_seconds
     # bucket links to its stitched tree (/debug/traces) and its
     # /debug/slow_queries record via the shared id
     METRICS.histogram(
-        "query_duration_seconds", "query wall time", buckets=_QUERY_BUCKETS
+        "query_duration_seconds", "query wall time", buckets=QUERY_DURATION_BUCKETS
     ).observe(duration_secs, trace_id=st.trace_id, tenant=st.tenant or None)
     for stage, secs in st.stages.items():
         METRICS.histogram(
             "query_stage_duration_seconds",
             "per-stage query wall time",
             labels={"stage": stage},
-            buckets=_QUERY_BUCKETS,
+            buckets=QUERY_DURATION_BUCKETS,
         ).observe(secs, trace_id=st.trace_id)
     METRICS.counter("query_series_scanned_total").inc(st.series_scanned)
     METRICS.counter("query_datapoints_scanned_total").inc(st.datapoints_scanned)
@@ -392,8 +442,9 @@ class ActiveQueryRegistry:
             records = list(self._live.values())
             overflows = self._overflows
         now = time.time_ns()
-        rows = [
-            {
+        rows = []
+        for st in records:
+            row = {
                 "query": st.query,
                 "namespace": st.namespace,
                 "tenant": st.tenant,
@@ -404,8 +455,10 @@ class ActiveQueryRegistry:
                 "startUnixNanos": st.start_unix_nanos,
                 "elapsedSecs": max(now - st.start_unix_nanos, 0) / 1e9,
             }
-            for st in records
-        ]
+            objectives = slo_objectives_for(st.tenant)
+            if objectives is not None:
+                row["sloObjectives"] = objectives
+            rows.append(row)
         rows.sort(key=lambda r: -r["elapsedSecs"])
         return {"queries": rows, "overflows": overflows}
 

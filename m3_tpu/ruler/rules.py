@@ -162,6 +162,13 @@ def group_from_dict(d: dict, default_namespace: str = "default") -> RuleGroup:
     interval = parse_duration(d.get("interval", 30))
     if interval <= 0:
         raise ValueError(f"group {name!r}: interval must be positive")
+    # recording rules write derived series back into m3tsz second-unit
+    # storage: a sub-second eval interval collapses consecutive recorded
+    # samples onto one stored timestamp and flattens every rate() built
+    # on them — reject at load, not at the thousandth silent flat eval
+    from ..utils.schedule import check_telemetry_interval
+
+    check_telemetry_interval(interval, f"rule group {name!r}")
     return RuleGroup(
         name=str(name),
         interval_secs=interval,

@@ -108,8 +108,13 @@ class SelfMonCollector:
     ) -> None:
         import time as _time
 
+        from ..utils.schedule import check_telemetry_interval
+
         self.sink = sink
-        self.interval = float(interval)
+        # sub-second scrape intervals are rejected loudly: the scraped
+        # counters land in m3tsz second-unit storage, where sub-second
+        # samples collapse and flatten every rate() over the telemetry
+        self.interval = check_telemetry_interval(interval, "self-scrape")
         self.instance = instance
         self.component = component
         self.registry = registry if registry is not None else METRICS

@@ -98,6 +98,9 @@ class Coordinator:
         self._engines_lock = threading.Lock()
         self.selfmon = None  # SelfMonCollector when start_selfmon() ran
         self.ruler = None  # ruler.Ruler when start_ruler() ran
+        self.slo = None  # slo.SLOEngine when start_slo() ran
+        self._ruler_groups = []  # file-sourced groups (start_ruler keeps
+        # them so start_slo can re-publish file + generated together)
         self._selfmon_ns_ready = False
         # fleet-profile peer source (m3_tpu/profiling/): a zero-arg
         # callable yielding {instance_id: node} of `profile`-op-capable
@@ -186,10 +189,61 @@ class Coordinator:
         if rules_path:
             from ..ruler import load_rules_file
 
-            groups = load_rules_file(rules_path, self.namespace)
-            self.ruler.publish(groups_to_spec(groups))
+            self._ruler_groups = load_rules_file(rules_path, self.namespace)
+            self.ruler.publish(groups_to_spec(self._ruler_groups))
         self.ruler.start()
         return self.ruler
+
+    # --- SLO engine (m3_tpu/slo/): error budgets over the ruler's output ---
+
+    def start_slo(
+        self,
+        slo_path: str,
+        webhooks=(),
+        instance: str = "coordinator0",
+        jitter: bool = True,
+    ):
+        """Start the fleet SLO engine from an ``--slo-config`` spec file:
+        the objectives compile into one generated ``slo`` rule group
+        (ratio recordings + multi-window burn-rate alerts) published
+        through the ruler alongside any file-sourced groups, and the
+        engine's status/probe loops feed ``m3tpu_slo_*`` metrics plus the
+        ``/api/v1/slo`` + ``/debug/slo`` surfaces.
+
+        Requires a running self-scrape (the compiled rules read the
+        fleet's own stored telemetry in ``_m3tpu``); starts the ruler if
+        none is running yet."""
+        from ..ruler import groups_to_spec
+        from ..slo import SLO_GROUP, SLOEngine, load_slo_file
+
+        if self.selfmon is None:
+            raise RuntimeError(
+                "the SLO engine consumes the fleet's own stored telemetry: "
+                "start the self-scrape (--selfmon-interval) before "
+                "--slo-config, or the compiled SLI rules evaluate over an "
+                "empty _m3tpu namespace forever"
+            )
+        spec = load_slo_file(slo_path)
+        if self.ruler is None:
+            self.start_ruler(webhooks=webhooks, instance=instance, jitter=jitter)
+        if any(g.name == SLO_GROUP for g in self._ruler_groups):
+            raise ValueError(
+                f"rule group name {SLO_GROUP!r} is reserved for the "
+                "generated SLO group (--slo-config); rename the file group"
+            )
+        self.slo = SLOEngine(
+            spec,
+            engine_for=self.engine_for,
+            db=self.db,
+            ruler=self.ruler,
+            namespace=self.namespace,
+            instance=instance,
+        )
+        self.ruler.publish(
+            groups_to_spec(list(self._ruler_groups) + self.slo.rule_groups())
+        )
+        self.slo.start()
+        return self.slo
 
     # --- continuous profiling (m3_tpu/profiling/) ---
 
@@ -743,20 +797,29 @@ class _Handler(BaseHTTPRequestHandler):
                         indent=1,
                     ),
                 )
-            with c.db.lock:
-                namespaces = list(c.db.namespaces.items())
+            if c.slo is not None:
+                z.writestr(
+                    "slo.json", json.dumps(c.slo.debug_dict(), indent=1)
+                )
             ns_info = {}
-            for name, ns in namespaces:
-                counts = []
-                for s in ns.shards:
-                    with s.lock:
-                        counts.append(len(s.series))
-                ns_info[name] = {
-                    "blockSizeNanos": ns.opts.block_size_nanos,
-                    "retentionNanos": ns.opts.retention_nanos,
-                    "numShards": len(ns.shards),
-                    "numSeries": sum(counts),
-                }
+            if hasattr(c.db, "lock"):
+                with c.db.lock:
+                    namespaces = list(c.db.namespaces.items())
+                for name, ns in namespaces:
+                    counts = []
+                    for s in ns.shards:
+                        with s.lock:
+                            counts.append(len(s.series))
+                    ns_info[name] = {
+                        "blockSizeNanos": ns.opts.block_size_nanos,
+                        "retentionNanos": ns.opts.retention_nanos,
+                        "numShards": len(ns.shards),
+                        "numSeries": sum(counts),
+                    }
+            else:
+                # cluster mode (SessionDatabase): the shards live on the
+                # dbnodes — dump the known namespace names only
+                ns_info = {name: {} for name in sorted(c.db.namespaces)}
             z.writestr("namespaces.json", json.dumps(ns_info, indent=1))
             p = c.placement_svc.get()
             z.writestr("placement.json", json.dumps(p.to_dict() if p else {}, indent=1))
@@ -780,7 +843,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "/debug/slow_queries", "/debug/dump",
                     "/debug/exemplars", "/debug/active_queries",
                     "/debug/tenants", "/debug/pprof/profile",
-                    "/debug/pprof/fleet",
+                    "/debug/pprof/fleet", "/api/v1/slo", "/debug/slo",
                 )
                 else TRACER.span("http.get", path=url.path)
             )
@@ -798,9 +861,23 @@ class _Handler(BaseHTTPRequestHandler):
                 elif url.path == "/metrics":
                     from ..utils.instrument import DEFAULT as METRICS
 
-                    self._send(
-                        200, METRICS.expose().encode(), ctype="text/plain; version=0.0.4"
-                    )
+                    # content negotiation (openmetrics_spec): a scraper
+                    # advertising openmetrics-text gets the 1.0 exposition
+                    # (counter _total naming, exemplars on bucket lines,
+                    # # EOF); everyone else keeps the 0.0.4 text format
+                    accept = self.headers.get("Accept", "")
+                    if "application/openmetrics-text" in accept:
+                        self._send(
+                            200,
+                            METRICS.expose_openmetrics().encode(),
+                            ctype="application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8",
+                        )
+                    else:
+                        self._send(
+                            200, METRICS.expose().encode(),
+                            ctype="text/plain; version=0.0.4",
+                        )
                 elif url.path == "/api/v1/query_range":
                     self._json(
                         c.query_range(
@@ -892,6 +969,25 @@ class _Handler(BaseHTTPRequestHandler):
                         self._json({"error": "not found"}, 404)
                     else:
                         self._json(ruleset_to_dict(rs))
+                elif url.path == "/api/v1/slo":
+                    # live SLO status: per-objective budget remaining +
+                    # burn rates joined to the firing burn alerts
+                    self._json(
+                        {
+                            "status": "success",
+                            "data": (
+                                c.slo.status_dict() if c.slo is not None
+                                else {"objectives": []}
+                            ),
+                        }
+                    )
+                elif url.path == "/debug/slo":
+                    # status + the spec + the generated rule plane: the
+                    # operator's alert → objective → rules walk
+                    self._json(
+                        c.slo.debug_dict() if c.slo is not None
+                        else {"objectives": [], "spec": None}
+                    )
                 elif url.path == "/debug/traces":
                     limit = int(q.get("limit", ["256"])[0])
                     self._json({"spans": TRACER.dump(limit=limit)})
@@ -1317,6 +1413,15 @@ def main(argv=None) -> int:
         "transitions POST the Alertmanager webhook payload with "
         "retries under the resilience plane's budget",
     )
+    p.add_argument(
+        "--slo-config",
+        default="",
+        help="path to a YAML/JSON SLO spec (m3_tpu/slo/spec.py schema): "
+        "compiles the objectives into recording + multi-window burn-rate "
+        "alerting rules over _m3tpu, runs freshness/durability probes, "
+        "and serves /api/v1/slo + /debug/slo; requires "
+        "--selfmon-interval, starts the ruler if --ruler-rules is absent",
+    )
     args = p.parse_args(argv)
 
     cfg = load_config(CoordinatorConfig, args.config) if args.config else CoordinatorConfig()
@@ -1410,6 +1515,19 @@ def main(argv=None) -> int:
             instance=args.instance_id,
         )
 
+    if args.slo_config:
+        if args.selfmon_interval <= 0:
+            p.error(
+                "--slo-config requires --selfmon-interval: the compiled "
+                "SLI rules evaluate over the fleet's own stored telemetry "
+                "in _m3tpu, which only the self-scrape populates"
+            )
+        coord.start_slo(
+            args.slo_config,
+            webhooks=list(args.ruler_webhook),
+            instance=args.instance_id,
+        )
+
     detector = None
     if args.failure_detector:
         if kv is None:
@@ -1449,6 +1567,8 @@ def main(argv=None) -> int:
             msg_server.stop()
         if profiler is not None:
             profiler.stop()
+        if coord.slo is not None:
+            coord.slo.stop()
         if coord.selfmon is not None:
             coord.selfmon.stop()
         if coord.ruler is not None:

@@ -1,0 +1,48 @@
+"""Fleet SLO engine: declarative SLIs, error budgets, burn-rate alerts.
+
+The pipeline (ISSUE 17 / ROADMAP item 5):
+
+- spec.py    — ``--slo-config`` model: objectives over four SLI kinds
+               (availability, latency, freshness, durability), validated
+               loudly at load;
+- compile.py — objectives → one ruler RuleGroup over ``_m3tpu``:
+               colon-form ratio recordings per window plus multi-window
+               multi-burn-rate alerts (fast 5m/1h page, slow 6h/3d
+               ticket) and a budget-exhaustion alert;
+- budget.py  — the pure error-budget arithmetic both the engine and the
+               compiled alert expressions derive from;
+- engine.py  — the runtime: budget/burn gauges and edge-triggered
+               violation counts read back from rule-derived storage,
+               freshness/durability probes, and the live status surface
+               (``/api/v1/slo``, ``/debug/slo``) joined to firing alerts.
+"""
+
+from .budget import budget_remaining, burn_rate, error_budget, exhaustion_secs
+from .compile import SLO_GROUP, compile_groups, compile_objective, record_name
+from .engine import SLOEngine
+from .spec import (
+    Objective,
+    SLOSpec,
+    load_slo_file,
+    objective_from_dict,
+    spec_from_dict,
+    window_name,
+)
+
+__all__ = [
+    "SLOEngine",
+    "SLOSpec",
+    "Objective",
+    "SLO_GROUP",
+    "budget_remaining",
+    "burn_rate",
+    "compile_groups",
+    "compile_objective",
+    "error_budget",
+    "exhaustion_secs",
+    "load_slo_file",
+    "objective_from_dict",
+    "record_name",
+    "spec_from_dict",
+    "window_name",
+]

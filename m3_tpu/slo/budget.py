@@ -1,0 +1,56 @@
+"""Error-budget arithmetic: the pure math under the SLO engine.
+
+Definitions (Google SRE workbook, ch. 5 "Alerting on SLOs"):
+
+- error budget  = ``1 - objective`` — the fraction of events allowed to
+  be bad over the budget window;
+- burn rate     = ``(1 - sli) / (1 - objective)`` — how many multiples
+  of the steady-state budget spend the current bad-event fraction
+  represents (burn 1.0 spends exactly the budget over the window,
+  burn 14.4 over a 1h window spends ~2% of a 30d budget in that hour);
+- budget remaining = ``1 - (1 - sli_over_window) / (1 - objective)`` —
+  what is left of the window's budget given the window's observed SLI.
+
+Everything here is a pure function of (sli, objective) so the golden
+tests in tests/test_slo.py pin the arithmetic exactly; the engine and
+the compiled alert expressions both derive from these definitions, and
+the multi-window gate (fast fires only when the SHORT and the LONG
+window both burn) is what keeps a brief blip from paging.
+"""
+
+from __future__ import annotations
+
+
+def error_budget(objective: float) -> float:
+    """The allowed bad fraction: ``1 - objective``."""
+    if not 0.0 < objective < 1.0:
+        raise ValueError(f"objective must be in (0, 1), got {objective!r}")
+    return 1.0 - objective
+
+
+def burn_rate(sli: float, objective: float) -> float:
+    """Budget-spend multiple for an observed SLI over some window.
+
+    1.0 = spending exactly the budget; >1 = on track to exhaust it
+    before the window ends. An SLI above the objective burns < 1 (and
+    a perfect SLI burns 0 — never negative: over-delivery does not
+    refill the budget)."""
+    return max(0.0, (1.0 - sli)) / error_budget(objective)
+
+
+def budget_remaining(sli: float, objective: float) -> float:
+    """Fraction of the window's error budget left, given the window's
+    SLI. 1.0 = untouched, 0.0 = exhausted; clamped at 0 below (an SLI
+    past exhaustion reports 0, not a negative balance — the violation
+    counter carries "how often", the gauge carries "how much left")."""
+    return max(0.0, 1.0 - burn_rate(sli, objective))
+
+
+def exhaustion_secs(sli: float, objective: float, window_secs: float):
+    """Seconds until the window's budget is gone at the current burn
+    rate, or ``None`` when the current burn never exhausts it (burn
+    <= 1). The operator-facing "time to act" number."""
+    rate = burn_rate(sli, objective)
+    if rate <= 1.0:
+        return None
+    return float(window_secs) / rate

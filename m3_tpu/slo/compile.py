@@ -1,0 +1,192 @@
+"""Compile SLO objectives into ruler rule groups over ``_m3tpu``.
+
+Each objective becomes:
+
+- one **ratio recording rule per window** the spec needs (both burn
+  tiers' short+long windows plus the budget window), named in the
+  enforced colon form ``slo:<name>:ratio_rate<window>`` and labeled
+  ``objective=<name>`` — these are the ONLY series the budget engine
+  and the alert expressions read, so the whole SLO plane keys off
+  rule-derived storage, not live process state;
+- one **multi-window burn-rate alert per tier**: the page fires only
+  when the short AND the long fast window both burn past the fast
+  threshold (the AND is literal PromQL ``and`` over the two recorded
+  ratios — the short window gives reaction time, the long window keeps
+  a blip from paging, and the long window draining below threshold is
+  what resolves the alert: hysteresis for free);
+- one **budget-exhaustion alert** over the budget window.
+
+Ratio SLI expressions by kind:
+
+- availability: completed / (completed + shed + failed) over the
+  coordinator's ``m3tpu_query_{completed,shed,failed}_total`` counters
+  (shed-typed 503s and 5xx-style failures are the unavailability; 422
+  cost rejections are the caller's query being too expensive, not the
+  service being down, so they count in neither class).
+  ``or``-union keeps a side with no samples from erasing the ratio
+  (classic empty-vector-join failure), while a fully idle window stays
+  no-data rather than a fake 100%.
+- latency: the ``le=<threshold>`` bucket fraction of
+  ``m3tpu_query_duration_seconds`` — p99-under-threshold style.
+- freshness / durability: good/total over the SLO engine's own probe
+  counters (``m3tpu_slo_probe_*``), which ride the same selfmon scrape
+  as every other counter — one uniform ratio pipeline for passive and
+  active SLIs.
+"""
+
+from __future__ import annotations
+
+from ..ruler.rules import AlertRule, RecordingRule, RuleGroup
+from ..selfmon.convert import format_le
+from ..selfmon.guard import RESERVED_NS
+from .budget import error_budget
+from .spec import Objective, SLOSpec, window_name
+
+# the generated group's reserved name: merged rule files must not collide
+SLO_GROUP = "slo"
+
+
+def record_name(obj_name: str, window_secs: float) -> str:
+    return f"slo:{obj_name}:ratio_rate{window_name(window_secs)}"
+
+
+def _avail_expr(window: str, per_tenant: bool) -> str:
+    # non-5xx fraction of NON-SHED traffic: a deliberate load-shed (503
+    # from the admission scheduler, counted in m3tpu_query_shed_total) is
+    # capacity policy doing its job, not unavailability — it must not
+    # burn the error budget. Only served-and-failed queries are bad.
+    good = f"rate(m3tpu_query_completed_total[{window}])"
+    fail = f"rate(m3tpu_query_failed_total[{window}])"
+    if per_tenant:
+        g = f"sum by (tenant) ({good})"
+        f_ = f"sum by (tenant) ({fail})"
+        # or-union each side with the other's zeroed labels: a tenant
+        # with completions but no failures (or the reverse) must not
+        # drop out of the inner join the + performs
+        num = f"({g} or {f_} * 0)"
+        bad = f"({f_} or {g} * 0)"
+        # trailing `or`: a tenant whose window saw NO traffic at all
+        # (0/0 — both counters flat, rates zero) delivers its objective.
+        # Without it the division drops the row, the recording stops
+        # emitting, and the tenant's LAST ratio (possibly a burning 0)
+        # gets resurrected by instant-query lookback for minutes after
+        # recovery — burn stays pinned, pages never resolve by value,
+        # and the budget cannot drain
+        return f"{num} / ({num} + {bad}) or ({num} * 0 + 1)"
+    g = f"(sum({good}) or vector(0))"
+    b = f"(sum({fail}) or vector(0))"
+    return f"{g} / ({g} + {b}) or vector(1)"
+
+
+def _latency_expr(window: str, threshold: float) -> str:
+    # clamp_max: numerator and denominator ride separately-scraped
+    # series, so a _count sample missing a window (scrape skew under
+    # churn) would push the raw ratio past 1
+    le = format_le(threshold)
+    return (
+        "clamp_max("
+        f'sum(rate(m3tpu_query_duration_seconds_bucket{{le="{le}"}}[{window}]))'
+        f" / sum(rate(m3tpu_query_duration_seconds_count[{window}])), 1)"
+    )
+
+
+def _probe_expr(window: str, name: str) -> str:
+    sel = f'{{objective="{name}"}}'
+    return (
+        "clamp_max("
+        f"sum(rate(m3tpu_slo_probe_good_total{sel}[{window}]))"
+        f" / sum(rate(m3tpu_slo_probe_total{sel}[{window}])), 1)"
+    )
+
+
+def ratio_expr(obj: Objective, window_secs: float) -> str:
+    w = window_name(window_secs)
+    if obj.sli == "availability":
+        return _avail_expr(w, obj.per_tenant)
+    if obj.sli == "latency":
+        return _latency_expr(w, obj.threshold)
+    return _probe_expr(w, obj.name)
+
+
+def _burn_cond(obj: Objective, window_secs: float, threshold: float) -> str:
+    """``burn_rate(window) > threshold`` over the RECORDED ratio — the
+    budget.burn_rate definition inlined as PromQL."""
+    budget = error_budget(obj.objective)
+    return (
+        f"(1 - {record_name(obj.name, window_secs)}) / {budget:.10g}"
+        f" > {threshold:.10g}"
+    )
+
+
+def compile_objective(obj: Objective, spec: SLOSpec) -> list:
+    rules = [
+        RecordingRule(
+            record=record_name(obj.name, w),
+            expr=ratio_expr(obj, w),
+            labels={"objective": obj.name},
+        )
+        for w in spec.windows_for(obj)
+    ]
+    for short, long_, threshold, severity in spec.burn_windows():
+        alert = "SLOFastBurn" if severity == "page" else "SLOSlowBurn"
+        rules.append(
+            AlertRule(
+                alert=f"{alert}_{obj.name}",
+                # the multi-window AND gate: both the reactive short
+                # window and the smoothing long window must burn
+                expr=(
+                    f"({_burn_cond(obj, short, threshold)})"
+                    f" and ({_burn_cond(obj, long_, threshold)})"
+                ),
+                for_secs=0.0,
+                labels={
+                    "objective": obj.name,
+                    "severity": severity,
+                    "window": f"{window_name(short)}/{window_name(long_)}",
+                    "service": obj.service,
+                },
+                annotations={
+                    "summary": (
+                        f"{obj.name}: burning {{{{ $value }}}}x the error "
+                        f"budget over {window_name(short)} and {window_name(long_)}"
+                    ),
+                },
+            )
+        )
+    rules.append(
+        AlertRule(
+            alert=f"SLOBudgetExhausted_{obj.name}",
+            expr=_burn_cond(obj, obj.window_secs, 1.0),
+            for_secs=0.0,
+            labels={
+                "objective": obj.name,
+                "severity": "page",
+                "window": window_name(obj.window_secs),
+                "service": obj.service,
+            },
+            annotations={
+                "summary": (
+                    f"{obj.name}: error budget for the "
+                    f"{window_name(obj.window_secs)} window is exhausted "
+                    "(burn {{ $value }}x)"
+                ),
+            },
+        )
+    )
+    return rules
+
+
+def compile_groups(spec: SLOSpec) -> list:
+    """The whole spec as ONE rule group (recordings evaluate before the
+    alerts that read them — group rules run in file order)."""
+    rules: list = []
+    for obj in spec.objectives:
+        rules.extend(compile_objective(obj, spec))
+    return [
+        RuleGroup(
+            name=SLO_GROUP,
+            interval_secs=spec.eval_interval,
+            namespace=RESERVED_NS,
+            rules=tuple(rules),
+        )
+    ]

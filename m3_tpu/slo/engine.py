@@ -1,0 +1,429 @@
+"""The SLO engine: error-budget accounting over rule-derived storage.
+
+The engine owns no SLI math of its own at runtime — the ratio series
+are recorded by the compiled rule group (compile.py) through the ruler,
+into ``_m3tpu``, and the engine's status loop just reads them back
+(``engine_for("_m3tpu")``, the same per-namespace engine cache every
+query takes) and applies budget.py's arithmetic:
+
+- ``m3tpu_slo_budget_remaining_ratio{objective[,tenant]}`` gauge,
+- ``m3tpu_slo_burn_rate{objective,window}`` gauge,
+- ``m3tpu_slo_violations_total{objective}`` counter (edge-triggered on
+  budget exhaustion, not level-sampled — one violation per incident),
+
+and a ``status_dict()`` surface (``/api/v1/slo``, ``/debug/slo``,
+``slo.json`` in the debug dump) that joins each objective's live budget
+numbers to the burn-rate alerts currently pending/firing for it.
+
+Active SLIs (freshness, durability) are measured by probes that act on
+the data plane like a client would:
+
+- freshness: write a canary datapoint whose VALUE is its write time,
+  read it back, and score the observed ingest->readable lag against the
+  objective's threshold;
+- durability: write a seeded golden series once, then re-read the whole
+  range every probe tick and require bit-identical values (the same
+  spot-check the migration/ingest gates assert cross-process).
+
+Probe outcomes are plain registry counters (``m3tpu_slo_probe_*``);
+the selfmon scrape stores them and the compiled ratio rules consume
+them — active and passive SLIs ride ONE pipeline.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..query import stats as query_stats
+from ..selfmon.guard import RESERVED_NS
+from ..utils.instrument import DEFAULT as METRICS
+from ..utils.schedule import FixedRateTicker
+from .budget import budget_remaining, burn_rate, error_budget, exhaustion_secs
+from .compile import compile_groups, record_name
+from .spec import PROBE_SLIS, SLOSpec, window_name
+
+NANOS = 1_000_000_000
+
+# durability golden series shape: written once at start, re-read whole
+# every probe tick. Seeded full-precision values — the claim is
+# bit-identity, so the payload must exercise real mantissas.
+_GOLDEN_POINTS = 16
+_GOLDEN_SPACING_SECS = 2
+_GOLDEN_AGE_SECS = 600
+
+
+class SLOEngine:
+    """Budget accounting + probes + the live status surface."""
+
+    def __init__(
+        self,
+        spec: SLOSpec,
+        engine_for,
+        db,
+        ruler=None,
+        namespace: str = "default",
+        instance: str = "coordinator0",
+        clock=None,
+        seed: int = 17,
+    ) -> None:
+        self.spec = spec
+        self.engine_for = engine_for
+        self.db = db
+        self.ruler = ruler
+        self.namespace = namespace
+        self.instance = instance
+        self.clock = clock or time.time_ns
+        self.seed = seed
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        # per-objective last-computed status rows (objective name -> row)
+        self._status: dict[str, dict] = {
+            o.name: {"name": o.name, "sliRatio": None, "budgetRemaining": None}
+            for o in spec.objectives
+        }
+        self._last_tick_nanos = 0
+        self._exhausted: set = set()  # edge-trigger memory for violations
+        self._probe_counts: dict[str, list] = {
+            o.name: [0, 0] for o in spec.objectives if o.sli in PROBE_SLIS
+        }
+        self._freshness_first_write: float | None = None
+        self._golden: list | None = None  # [(time_nanos, value)] written
+        self._probe_seq = 0
+        self._m_violations = {
+            o.name: METRICS.counter(
+                "slo_violations_total",
+                "error-budget exhaustions (edge-triggered per incident)",
+                labels={"objective": o.name},
+            )
+            for o in spec.objectives
+        }
+
+    # -- generated rules --
+
+    def rule_groups(self) -> list:
+        return compile_groups(self.spec)
+
+    # -- lifecycle --
+
+    def start(self) -> "SLOEngine":
+        if self._threads:
+            return self
+        self._stop.clear()
+        self._seed_golden()
+        query_stats.set_slo_resolver(self._objectives_for_tenant)
+        for name, target, interval in (
+            ("slo-status", self._status_loop, self.spec.eval_interval),
+            ("slo-probe", self._probe_loop, self.spec.probe_interval),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        query_stats.set_slo_resolver(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+    def _status_loop(self) -> None:
+        ticker = FixedRateTicker(
+            self.spec.eval_interval,
+            phase_key=f"slo-status/{self.instance}",
+            stop=self._stop,
+        )
+        while True:
+            stopped, _ = ticker.wait_next()
+            if stopped:
+                return
+            self.tick_status(self.clock())
+
+    def _probe_loop(self) -> None:
+        ticker = FixedRateTicker(
+            self.spec.probe_interval,
+            phase_key=f"slo-probe/{self.instance}",
+            stop=self._stop,
+        )
+        while True:
+            stopped, _ = ticker.wait_next()
+            if stopped:
+                return
+            self.tick_probes(self.clock())
+
+    # -- satellite: tenant -> objectives join for the query debug rows --
+
+    def _objectives_for_tenant(self, tenant: str) -> list:
+        """Objectives a tenant's queries count against: the query-path
+        SLIs (availability/latency). Probe SLIs measure the engine's own
+        canaries, so no client query counts against them."""
+        return [
+            o.name for o in self.spec.objectives if o.sli not in PROBE_SLIS
+        ]
+
+    # -- budget accounting (one status pass; the testable seam) --
+
+    def _instant_rows(self, name: str, now_nanos: int) -> list:
+        """[(labels, value)] for a recorded series at ``now_nanos`` —
+        the ruler's own Result->rows projection, shared."""
+        from ..ruler.ruler import GroupRunner
+
+        engine = self.engine_for(RESERVED_NS)
+        return GroupRunner._rows(engine.query_instant(name, now_nanos))
+
+    def tick_status(self, now_nanos: int) -> dict:
+        """Recompute every objective's budget numbers from the recorded
+        ratio series. Never raises; a failed read keeps the previous
+        numbers and marks the row stale (the status surface must stay up
+        exactly when the fleet is in trouble)."""
+        for obj in self.spec.objectives:
+            row: dict = {
+                "name": obj.name,
+                "sli": obj.sli,
+                "service": obj.service,
+                "objective": obj.objective,
+                "budgetWindow": window_name(obj.window_secs),
+                "errorBudget": error_budget(obj.objective),
+            }
+            try:
+                burns: dict = {}
+                for w in self.spec.windows_for(obj):
+                    rows = self._instant_rows(record_name(obj.name, w), now_nanos)
+                    agg = self._aggregate(rows)
+                    if agg is not None:
+                        burns[window_name(w)] = burn_rate(agg, obj.objective)
+                    if w == obj.window_secs:
+                        self._apply_budget(obj, row, rows, agg)
+                row["burnRates"] = burns
+                for wname, b in burns.items():
+                    METRICS.gauge(
+                        "slo_burn_rate",
+                        "error-budget spend multiple per rate window",
+                        labels={"objective": obj.name, "window": wname},
+                    ).set(b)
+                row["stale"] = False
+            except Exception as exc:
+                prev = self._status.get(obj.name, {})
+                row.update(
+                    {
+                        k: prev.get(k)
+                        for k in ("sliRatio", "budgetRemaining", "burnRates",
+                                  "perTenant", "exhaustionSecs")
+                        if k in prev
+                    }
+                )
+                row["stale"] = True
+                row["lastError"] = f"{type(exc).__name__}: {exc}"
+            if obj.name in self._probe_counts:
+                good, total = self._probe_counts[obj.name]
+                row["probes"] = {"good": good, "total": total}
+            row["violations"] = self._m_violations[obj.name].value
+            with self._lock:
+                self._status[obj.name] = row
+        with self._lock:
+            self._last_tick_nanos = now_nanos
+        return self.status_dict()
+
+    @staticmethod
+    def _aggregate(rows: list):
+        """One scalar SLI out of an instant vector: the worst series
+        (budget math must not let a healthy tenant average away a
+        burning one)."""
+        vals = [v for _, v in rows if v == v]
+        return min(vals) if vals else None
+
+    def _apply_budget(self, obj, row: dict, rows: list, agg) -> None:
+        row["sliRatio"] = agg
+        if agg is None:
+            row["budgetRemaining"] = None
+            return
+        remaining = budget_remaining(agg, obj.objective)
+        row["budgetRemaining"] = remaining
+        row["exhaustionSecs"] = exhaustion_secs(agg, obj.objective, obj.window_secs)
+        METRICS.gauge(
+            "slo_budget_remaining_ratio",
+            "fraction of the window's error budget left",
+            labels={"objective": obj.name},
+        ).set(remaining)
+        if obj.per_tenant:
+            per_tenant = {}
+            for labels, v in rows:
+                tenant = labels.get("tenant", "")
+                if not tenant:
+                    continue
+                t_remaining = budget_remaining(v, obj.objective)
+                per_tenant[tenant] = {
+                    "sliRatio": v,
+                    "budgetRemaining": t_remaining,
+                    "burnRate": burn_rate(v, obj.objective),
+                }
+                METRICS.gauge(
+                    "slo_budget_remaining_ratio",
+                    "fraction of the window's error budget left",
+                    labels={"objective": obj.name, "tenant": tenant},
+                ).set(t_remaining)
+            row["perTenant"] = per_tenant
+        # edge-triggered violation accounting: one tick per incident
+        if remaining <= 0.0:
+            if obj.name not in self._exhausted:
+                self._exhausted.add(obj.name)
+                self._m_violations[obj.name].inc()
+        else:
+            self._exhausted.discard(obj.name)
+
+    # -- probes --
+
+    def _write_canary(self, tags: dict, points: list) -> int:
+        """Data-plane canary write (NOT the selfmon guard context: the
+        probe must take the same path a client write takes). Returns the
+        error count."""
+        from ..block.core import make_tags
+
+        entries = [(make_tags(tags), t, v, 1) for t, v in points]
+        errs = self.db.write_tagged_batch(self.namespace, entries)
+        return sum(1 for e in errs if e)
+
+    def _seed_golden(self) -> None:
+        if self._golden is not None:
+            return
+        rng = random.Random(self.seed)
+        t0 = self.clock() - _GOLDEN_AGE_SECS * NANOS
+        self._golden = [
+            (t0 + i * _GOLDEN_SPACING_SECS * NANOS, rng.random())
+            for i in range(_GOLDEN_POINTS)
+        ]
+        try:
+            self._write_canary(
+                {"__name__": "slo_canary_durability", "instance": self.instance},
+                self._golden,
+            )
+        except Exception:
+            # m3lint: disable=M3L007 -- an unseeded golden set fails every durability probe loudly (total grows, good does not), which IS the signal
+            pass
+
+    def _count_probe(self, obj, ok: bool) -> None:
+        labels = {"objective": obj.name, "kind": obj.sli}
+        METRICS.counter(
+            "slo_probe_total", "slo probe attempts", labels=labels
+        ).inc()
+        counts = self._probe_counts[obj.name]
+        counts[1] += 1
+        if ok:
+            METRICS.counter(
+                "slo_probe_good_total", "slo probes within objective",
+                labels=labels,
+            ).inc()
+            counts[0] += 1
+
+    def tick_probes(self, now_nanos: int) -> None:
+        """One probe pass for every active-SLI objective. Never raises;
+        a probe that errors scores bad — an unreadable canary IS the
+        outage being measured."""
+        for obj in self.spec.objectives:
+            if obj.sli == "freshness":
+                self._probe_freshness(obj, now_nanos)
+            elif obj.sli == "durability":
+                self._probe_durability(obj, now_nanos)
+
+    def _probe_freshness(self, obj, now_nanos: int) -> None:
+        self._probe_seq += 1
+        wrote = False
+        try:
+            errs = self._write_canary(
+                {"__name__": "slo_canary_freshness", "instance": self.instance},
+                [(now_nanos, now_nanos / 1e9)],
+            )
+            wrote = errs == 0
+        except Exception:
+            wrote = False
+        if self._freshness_first_write is None and wrote:
+            self._freshness_first_write = now_nanos / 1e9
+        try:
+            rows = self._data_rows(
+                f'slo_canary_freshness{{instance="{self.instance}"}}', now_nanos
+            )
+            latest = max((v for _, v in rows), default=None)
+        except Exception:
+            latest = None
+        if latest is None:
+            # nothing readable: only bad once a canary has been out
+            # longer than the lag bound (startup grace)
+            first = self._freshness_first_write
+            if first is None or now_nanos / 1e9 - first <= obj.threshold:
+                return
+            self._count_probe(obj, False)
+            return
+        lag = now_nanos / 1e9 - latest
+        self._count_probe(obj, wrote and lag <= obj.threshold)
+
+    def _probe_durability(self, obj, now_nanos: int) -> None:
+        golden = self._golden or []
+        if not golden:
+            self._count_probe(obj, False)
+            return
+        try:
+            import numpy as np
+
+            engine = self.engine_for(self.namespace)
+            start = golden[0][0]
+            step = _GOLDEN_SPACING_SECS * NANOS
+            result = engine.query_range(
+                f'slo_canary_durability{{instance="{self.instance}"}}',
+                start, golden[-1][0], step,
+            )
+            vals = np.asarray(result.values)
+            ok = (
+                len(result.metas) == 1
+                and vals.shape == (1, len(golden))
+                # bit-identical: exact float equality, no tolerance
+                and all(
+                    float(vals[0, i]) == v for i, (_, v) in enumerate(golden)
+                )
+            )
+        except Exception:
+            ok = False
+        self._count_probe(obj, ok)
+
+    def _data_rows(self, query: str, now_nanos: int) -> list:
+        from ..ruler.ruler import GroupRunner
+
+        engine = self.engine_for(self.namespace)
+        return GroupRunner._rows(engine.query_instant(query, now_nanos))
+
+    # -- status surface --
+
+    def _alerts_for(self, name: str) -> list:
+        if self.ruler is None:
+            return []
+        return [
+            a
+            for a in self.ruler.alerts_dict().get("alerts", [])
+            if a.get("labels", {}).get("objective") == name
+        ]
+
+    def status_dict(self) -> dict:
+        """Live objective status joined to the firing/pending burn-rate
+        alerts (what /api/v1/slo serves)."""
+        with self._lock:
+            rows = [dict(self._status[o.name]) for o in self.spec.objectives]
+            last = self._last_tick_nanos
+        for row in rows:
+            row["alerts"] = self._alerts_for(row["name"])
+        return {
+            "instance": self.instance,
+            "lastTickUnixNanos": last,
+            "evalIntervalSecs": self.spec.eval_interval,
+            "probeIntervalSecs": self.spec.probe_interval,
+            "objectives": rows,
+        }
+
+    def debug_dict(self) -> dict:
+        """The /debug/slo payload: status plus the compiled rule plane
+        (what the operator walks alert -> objective -> rules with)."""
+        out = self.status_dict()
+        out["spec"] = self.spec.to_dict()
+        out["generatedRules"] = [g.to_dict() for g in self.rule_groups()]
+        return out

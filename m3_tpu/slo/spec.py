@@ -1,0 +1,270 @@
+"""Declarative SLO specs: the config the fleet is operated against.
+
+A spec file (YAML or JSON, ``--slo-config`` on the coordinator) names
+objectives over the fleet's own stored telemetry (``_m3tpu``):
+
+.. code-block:: yaml
+
+    eval_interval: 15s        # rule eval + status cadence (>= 1s)
+    probe_interval: 15s       # freshness/durability probe cadence
+    windows:
+      fast: [5m, 1h]          # page: short AND long window both burn
+      slow: [6h, 3d]          # ticket: sustained slow burn
+    burn_thresholds:
+      fast: 14.4              # Google SRE workbook defaults
+      slow: 6.0
+    slos:
+      - name: query_availability
+        sli: availability     # non-5xx fraction of non-shed queries
+        objective: 0.999
+        window: 1h            # error-budget window
+        per_tenant: true      # also record/alert per tenant
+      - name: query_latency
+        sli: latency          # fraction of queries under threshold
+        objective: 0.99
+        threshold: 0.25       # seconds; must be a duration bucket bound
+        window: 1h
+      - name: write_freshness
+        sli: freshness        # probe: ingest -> readable lag bound
+        objective: 0.99
+        threshold: 5.0        # max acceptable lag seconds
+        window: 1h
+      - name: read_durability
+        sli: durability       # probe: bit-identical spot-check reads
+        objective: 0.9999
+        window: 1h
+
+Validation happens at load, loudly (the same posture as the ruler's
+rule files): a sub-second interval is rejected against the m3tsz
+second-unit floor (utils/schedule.check_telemetry_interval), a latency
+threshold that is not an actual ``m3tpu_query_duration_seconds`` bucket
+bound is rejected (the compiled SLI would silently select an empty
+bucket series), and objective names must be snake_case slugs because
+they become recording-rule name segments (``slo:<name>:ratio_rate5m``)
+and ``objective`` label values.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..query.stats import QUERY_DURATION_BUCKETS
+from ..ruler.rules import parse_duration
+from ..utils.schedule import check_telemetry_interval
+
+SLI_KINDS = ("availability", "latency", "freshness", "durability")
+# probe-driven SLIs measure the system by acting on it; ratio SLIs are
+# compiled purely from telemetry the fleet already stores about itself
+PROBE_SLIS = ("freshness", "durability")
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# window suffix grammar for recording-rule names: "5m" -> "rate5m".
+# Round multiples render with their natural unit; anything else renders
+# in whole seconds ("90s") — every form matches the colon-name segment
+# regex because it is appended to "ratio_rate".
+_UNITS = ((86400, "d"), (3600, "h"), (60, "m"))
+
+
+def window_name(secs: float) -> str:
+    """Seconds -> the compact duration token used in rule names and
+    status keys (300 -> "5m", 3600 -> "1h", 90 -> "90s")."""
+    s = int(secs)
+    if s != secs or s <= 0:
+        raise ValueError(f"window must be a positive whole-second count, got {secs!r}")
+    for unit, tok in _UNITS:
+        if s % unit == 0:
+            return f"{s // unit}{tok}"
+    return f"{s}s"
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One SLO: an SLI kind, a target, and an error-budget window."""
+
+    name: str
+    sli: str
+    objective: float
+    window_secs: float
+    threshold: float | None = None  # latency: seconds; freshness: max lag
+    per_tenant: bool = False
+    service: str = "coordinator"
+
+    def to_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "sli": self.sli,
+            "objective": self.objective,
+            "window": window_name(self.window_secs),
+            "service": self.service,
+        }
+        if self.threshold is not None:
+            out["threshold"] = self.threshold
+        if self.per_tenant:
+            out["perTenant"] = True
+        return out
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """The validated spec: objectives + burn windows + cadences."""
+
+    objectives: tuple = ()
+    fast_windows: tuple = (300.0, 3600.0)
+    slow_windows: tuple = (21600.0, 259200.0)
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+    eval_interval: float = 15.0
+    probe_interval: float = 15.0
+
+    def burn_windows(self) -> tuple:
+        """((short, long, threshold, severity) per alert tier)."""
+        return (
+            (self.fast_windows[0], self.fast_windows[1], self.fast_burn, "page"),
+            (self.slow_windows[0], self.slow_windows[1], self.slow_burn, "ticket"),
+        )
+
+    def windows_for(self, obj: Objective) -> list:
+        """Every distinct window the objective needs a ratio recording
+        for: both burn tiers plus the budget window, ascending."""
+        ws = {
+            self.fast_windows[0], self.fast_windows[1],
+            self.slow_windows[0], self.slow_windows[1],
+            obj.window_secs,
+        }
+        return sorted(ws)
+
+    def to_dict(self) -> dict:
+        return {
+            "slos": [o.to_dict() for o in self.objectives],
+            "windows": {
+                "fast": [window_name(w) for w in self.fast_windows],
+                "slow": [window_name(w) for w in self.slow_windows],
+            },
+            "burn_thresholds": {"fast": self.fast_burn, "slow": self.slow_burn},
+            "eval_interval": self.eval_interval,
+            "probe_interval": self.probe_interval,
+        }
+
+
+def _window_pair(raw, default: tuple, what: str) -> tuple:
+    if raw is None:
+        return default
+    if not isinstance(raw, (list, tuple)) or len(raw) != 2:
+        raise ValueError(f"{what} windows must be a [short, long] pair, got {raw!r}")
+    short, long_ = (parse_duration(v) for v in raw)
+    if not 0 < short < long_:
+        raise ValueError(
+            f"{what} windows must satisfy 0 < short < long, got {raw!r}"
+        )
+    for w in (short, long_):
+        check_telemetry_interval(w, f"{what} burn window")
+        window_name(w)  # must render as a rule-name token
+    return (short, long_)
+
+
+def objective_from_dict(d: dict) -> Objective:
+    if not isinstance(d, dict):
+        raise ValueError(f"slo entry must be a mapping, got {type(d).__name__}")
+    name = str(d.get("name", ""))
+    if not _NAME_RE.match(name):
+        raise ValueError(
+            f"slo name {name!r} must be a snake_case slug "
+            "([a-z][a-z0-9_]*): it becomes a recording-rule name segment "
+            "and an objective label value"
+        )
+    sli = str(d.get("sli", ""))
+    if sli not in SLI_KINDS:
+        raise ValueError(f"slo {name!r}: unknown sli {sli!r} (one of {SLI_KINDS})")
+    objective = float(d.get("objective", 0))
+    if not 0.0 < objective < 1.0:
+        raise ValueError(
+            f"slo {name!r}: objective must be in (0, 1), got {objective!r}"
+        )
+    window = parse_duration(d.get("window", "1h"))
+    check_telemetry_interval(window, f"slo {name!r} budget window")
+    window_name(window)
+    threshold = d.get("threshold")
+    if sli == "latency":
+        if threshold is None:
+            raise ValueError(f"slo {name!r}: latency slis need a threshold")
+        threshold = float(threshold)
+        if threshold not in QUERY_DURATION_BUCKETS:
+            raise ValueError(
+                f"slo {name!r}: latency threshold {threshold!r}s is not a "
+                "m3tpu_query_duration_seconds bucket bound "
+                f"{QUERY_DURATION_BUCKETS} — the compiled SLI selects the "
+                "le=<threshold> bucket series, so an off-bucket threshold "
+                "would silently measure nothing"
+            )
+    elif sli == "freshness":
+        threshold = float(threshold if threshold is not None else 5.0)
+        if threshold <= 0:
+            raise ValueError(f"slo {name!r}: freshness threshold must be positive")
+    elif threshold is not None:
+        raise ValueError(f"slo {name!r}: {sli} slis take no threshold")
+    per_tenant = bool(d.get("per_tenant", False))
+    if per_tenant and sli != "availability":
+        # only the availability events (completed/failed counters) carry a
+        # tenant label in storage; a per-tenant latency/probe SLI would
+        # compile to an expression over series that do not exist
+        raise ValueError(f"slo {name!r}: per_tenant applies to availability slis only")
+    return Objective(
+        name=name,
+        sli=sli,
+        objective=objective,
+        window_secs=window,
+        threshold=threshold,
+        per_tenant=per_tenant,
+        service=str(d.get("service", "coordinator")),
+    )
+
+
+def spec_from_dict(spec: dict) -> SLOSpec:
+    if not isinstance(spec, dict):
+        raise ValueError("slo spec must be a mapping with an 'slos' list")
+    objectives = tuple(objective_from_dict(o) for o in spec.get("slos", ()))
+    if not objectives:
+        raise ValueError("slo spec names no objectives")
+    seen: set = set()
+    for o in objectives:
+        if o.name in seen:
+            raise ValueError(f"duplicate slo name {o.name!r}")
+        seen.add(o.name)
+    windows = spec.get("windows") or {}
+    fast = _window_pair(windows.get("fast"), (300.0, 3600.0), "fast")
+    slow = _window_pair(windows.get("slow"), (21600.0, 259200.0), "slow")
+    thresholds = spec.get("burn_thresholds") or {}
+    fast_burn = float(thresholds.get("fast", 14.4))
+    slow_burn = float(thresholds.get("slow", 6.0))
+    for label, v in (("fast", fast_burn), ("slow", slow_burn)):
+        if v <= 1.0:
+            raise ValueError(
+                f"{label} burn threshold must exceed 1 (burn 1.0 is the "
+                f"steady-state budget spend), got {v!r}"
+            )
+    eval_interval = parse_duration(spec.get("eval_interval", 15))
+    probe_interval = parse_duration(spec.get("probe_interval", 15))
+    for what, iv in (("eval", eval_interval), ("probe", probe_interval)):
+        if iv <= 0:
+            raise ValueError(f"slo {what} interval must be positive")
+        check_telemetry_interval(iv, f"slo {what}")
+    return SLOSpec(
+        objectives=objectives,
+        fast_windows=fast,
+        slow_windows=slow,
+        fast_burn=fast_burn,
+        slow_burn=slow_burn,
+        eval_interval=eval_interval,
+        probe_interval=probe_interval,
+    )
+
+
+def load_slo_file(path: str) -> SLOSpec:
+    """Load + validate an SLO config (YAML, or JSON as its subset)."""
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        raw = yaml.safe_load(f.read()) or {}
+    return spec_from_dict(raw)

@@ -31,6 +31,19 @@ def _fmt_labels(labels: tuple) -> str:
     return "{" + inner + "}"
 
 
+def _fmt_exemplar(ex: tuple) -> str:
+    """OpenMetrics exemplar suffix for a bucket sample:
+    `` # {trace_id="...",tenant="..."} value timestamp`` — the trace-ID
+    link the 0.0.4 format can only serve out-of-band via
+    /debug/exemplars. ``ex`` is Histogram.exemplars' tuple form
+    (value, trace_id, unix_nanos, tenant)."""
+    v, trace_id, unix_nanos, tenant = ex
+    labels = [("trace_id", trace_id)]
+    if tenant is not None:
+        labels.append(("tenant", tenant))
+    return f" # {_fmt_labels(tuple(labels))} {v} {unix_nanos / 1e9:.9f}"
+
+
 class Counter:
     def __init__(self) -> None:
         self._v = 0.0
@@ -201,6 +214,66 @@ class Registry:
                 "kind": kind, "help": help_, "children": rows
             }
         return out
+
+    def expose_openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition (``/metrics`` content
+        negotiation: ``Accept: application/openmetrics-text``).
+
+        Differences from :meth:`expose` the spec mandates:
+
+        - a counter FAMILY is named without the ``_total`` suffix in its
+          HELP/TYPE lines while its sample keeps it (``# TYPE x counter``
+          + ``x_total 1``) — our counter families are all registered with
+          the suffix, so it is stripped for the metadata lines;
+        - histogram bucket samples carry their exemplars inline
+          (``... # {trace_id="..."} value timestamp``) — the trace-ID
+          exemplars the 0.0.4 format can only serve via /debug/exemplars;
+        - the exposition ends with the mandatory ``# EOF`` terminator
+          (its absence is how a consumer detects a truncated scrape).
+        """
+        lines = []
+        with self._lock:
+            fams = {
+                n: (f.kind, f.help, dict(f.children))
+                for n, f in sorted(self._fams.items())
+            }
+        for name, (kind, help_, children) in fams.items():
+            full = f"{self.prefix}{name}"
+            fam = full
+            if kind == "counter" and fam.endswith("_total"):
+                fam = fam[: -len("_total")]
+            if help_:
+                lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {kind}")
+            for labels, m in sorted(children.items()):
+                ls = _fmt_labels(labels)
+                if kind == "counter":
+                    lines.append(f"{fam}_total{ls} {m.value}")
+                elif kind == "gauge":
+                    lines.append(f"{fam}{ls} {m.value}")
+                else:
+                    counts, h_sum, h_total = m.snapshot()
+                    with m._lock:
+                        exemplars = dict(m.exemplars)
+                    acc = 0
+                    for i, (b, c) in enumerate(zip(m.buckets, counts)):
+                        acc += c
+                        lb = tuple(list(labels) + [("le", repr(float(b)))])
+                        line = f"{fam}_bucket{_fmt_labels(lb)} {acc}"
+                        ex = exemplars.get(i)
+                        if ex is not None:
+                            line += _fmt_exemplar(ex)
+                        lines.append(line)
+                    lb = tuple(list(labels) + [("le", "+Inf")])
+                    line = f"{fam}_bucket{_fmt_labels(lb)} {h_total}"
+                    ex = exemplars.get(len(m.buckets))
+                    if ex is not None:
+                        line += _fmt_exemplar(ex)
+                    lines.append(line)
+                    lines.append(f"{fam}_sum{ls} {h_sum}")
+                    lines.append(f"{fam}_count{ls} {h_total}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
     def expose(self) -> str:
         """Prometheus text exposition format."""

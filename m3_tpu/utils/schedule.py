@@ -27,6 +27,36 @@ import time
 
 from .hash import murmur3_32
 
+# The floor for any periodic loop that STORES series about the fleet
+# (self-scrape collector, ruler group evaluation, SLO status/probes).
+# Stored timestamps ride the m3tsz SECOND-unit delta encoding, so two
+# samples of one series closer than 1s collapse onto the same stored
+# timestamp — the series stays queryable but every rate()/increase()
+# over it flattens, which silently falsifies exactly the derived
+# signals (error rates, burn rates) these loops exist to produce.
+# Config loaders reject sub-second intervals LOUDLY against this
+# constant instead of degrading; loops that never store series
+# (health probes, failure detectors) are exempt.
+MIN_TELEMETRY_INTERVAL_SECS = 1.0
+
+
+def check_telemetry_interval(interval: float, what: str) -> float:
+    """Validate a stored-telemetry loop interval at config load.
+
+    Returns the interval; raises ``ValueError`` naming the caller's
+    config knob when ``interval`` is positive but under the m3tsz
+    second-unit floor (see :data:`MIN_TELEMETRY_INTERVAL_SECS`)."""
+    iv = float(interval)
+    if 0 < iv < MIN_TELEMETRY_INTERVAL_SECS:
+        raise ValueError(
+            f"{what} interval {iv!r}s is below the "
+            f"{MIN_TELEMETRY_INTERVAL_SECS:g}s floor: stored timestamps "
+            "ride m3tsz SECOND-unit deltas, so sub-second samples "
+            "collapse onto one stored timestamp and flatten every "
+            "rate() derived from this telemetry"
+        )
+    return iv
+
 
 def phase_fraction(key: str) -> float:
     """Deterministic jitter fraction in [0, 1) for a scheduling key.

@@ -427,6 +427,44 @@ def test_tenant_and_scope_label_keys_quiet():
     assert findings == []
 
 
+def test_slo_objective_and_window_label_keys_quiet():
+    # SLO attribution labels: "objective" values are the operator's
+    # --slo-config names (spec.py rejects duplicates and non-slugs) and
+    # "window" values are the spec's fixed window tokens — allowlisted
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def burn(objective):
+            METRICS.gauge(
+                "slo_budget_remaining_ratio",
+                labels={"objective": objective},
+            )
+            METRICS.gauge(
+                "slo_burn_rate",
+                labels={"objective": objective, "window": "5m/1h"},
+            )
+        """
+    )
+    assert findings == []
+
+
+def test_slo_alertname_label_key_fires():
+    # alertname is derived per-rule and belongs in the alert payload,
+    # not a metric label — it stays outside the allowlist
+    findings = lint(
+        """
+        from pkg.instrument import DEFAULT as METRICS
+
+        def fired(alertname):
+            METRICS.counter(
+                "slo_violations_total", labels={"alertname": alertname}
+            )
+        """
+    )
+    assert codes(findings) == {"M3L005"}
+
+
 def test_shard_label_key_quiet():
     # per-shard heat attribution (resident/heat.py): "shard" values are
     # configured shard ids, hard-capped by ShardHeat — allowlisted

@@ -498,7 +498,7 @@ def test_ruler_restart_after_stop(db):
 
     write(db, "default", "m", _time.time_ns(), 10, job="a")
     ruler = make_ruler(db, spec=one_group_spec(
-        [{"alert": "High", "expr": "m > 5", "for": 0}], interval="0.05s"
+        [{"alert": "High", "expr": "m > 5", "for": 0}], interval="1s"
     ))
     ruler.start()
     deadline = _time.monotonic() + 10
@@ -677,7 +677,7 @@ def test_group_runner_thread_evaluates(db):
 
     write(db, "default", "m", _time.time_ns(), 10, job="a")
     ruler = make_ruler(db, spec=one_group_spec(
-        [{"alert": "High", "expr": "m > 5", "for": 0}], interval="0.05s"
+        [{"alert": "High", "expr": "m > 5", "for": 0}], interval="1s"
     ))
     ruler.start()
     try:

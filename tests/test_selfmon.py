@@ -456,13 +456,13 @@ def test_e2e_self_scrape(tmp_path):
             [sys.executable, "-m", "m3_tpu.services.dbnode",
              "--base-dir", str(tmp_path / "dbnode"),
              "--shards", "0,1", "--num-shards", "2",
-             "--no-mediator", "--selfmon-interval", "0.3"],
+             "--no-mediator", "--selfmon-interval", "1"],
             "dbnode",
         )
         coordinator, ch, cport = _spawn_listening(
             [sys.executable, "-m", "m3_tpu.services.coordinator",
              "--base-dir", str(tmp_path / "coord"),
-             "--selfmon-interval", "0.3",
+             "--selfmon-interval", "1",
              "--selfmon-peer", f"{dh}:{dport}"],
             "coordinator",
         )

@@ -1,0 +1,709 @@
+"""SLO engine tests: golden error-budget arithmetic, spec validation
+(including the m3tsz 1s-interval-floor regressions), compiled rule
+shape, the multi-window AND gate + resolve hysteresis at the ruler's
+alert state machine, budget gauges and edge-triggered violations from
+the status pass, freshness/durability probes, the selfmon→ruler→SLO
+readback loop, the query-stats SLO-objective join, and the coordinator
+HTTP surfaces (/api/v1/slo, /debug/slo, OpenMetrics negotiation)."""
+
+import io
+import json
+import time
+import urllib.request
+import zipfile
+
+import pytest
+
+from m3_tpu.block.core import make_tags
+from m3_tpu.query import stats as query_stats
+from m3_tpu.ruler import Ruler, groups_from_spec, groups_to_spec
+from m3_tpu.selfmon import RESERVED_NS, DatabaseSink, SelfMonCollector, ruler_writer
+from m3_tpu.services.coordinator import Coordinator, serve
+from m3_tpu.slo import (
+    SLO_GROUP,
+    Objective,
+    SLOEngine,
+    budget_remaining,
+    burn_rate,
+    compile_groups,
+    error_budget,
+    exhaustion_secs,
+    load_slo_file,
+    record_name,
+    spec_from_dict,
+    window_name,
+)
+from m3_tpu.storage.database import Database, NamespaceOptions
+from m3_tpu.utils.instrument import DEFAULT as METRICS
+from m3_tpu.utils.instrument import Registry
+from m3_tpu.utils.schedule import check_telemetry_interval
+
+NANOS = 1_000_000_000
+T0 = 1_600_000_000 * NANOS
+
+
+@pytest.fixture()
+def db(tmp_path):
+    db = Database(str(tmp_path), num_shards=2)
+    db.create_namespace("default", NamespaceOptions())
+    db.create_namespace(RESERVED_NS, NamespaceOptions())
+    db.bootstrap()
+    yield db
+    db.close()
+
+
+def spec_dict(name="slo_t", sli="availability", objective=0.99, **over):
+    obj = {"name": name, "sli": sli, "objective": objective, "window": "1h"}
+    obj.update(over.pop("obj", {}))
+    d = {"slos": [obj], "eval_interval": "15s", "probe_interval": "15s"}
+    d.update(over)
+    return d
+
+
+def write_ratio(db, name, obj_name, window_secs, t_nanos, value, **labels):
+    """Seed one recorded ratio sample the way the ruler stores it."""
+    with ruler_writer():
+        db.write_tagged(
+            RESERVED_NS,
+            make_tags(
+                {
+                    "__name__": record_name(obj_name, window_secs),
+                    "objective": obj_name,
+                    **labels,
+                }
+            ),
+            t_nanos,
+            float(value),
+        )
+    assert name == RESERVED_NS  # the recorded plane lives in _m3tpu only
+
+
+def make_engine(db, spec, ruler=None, clock=None, **kw):
+    coord = Coordinator(db=db)
+    return SLOEngine(
+        spec,
+        engine_for=coord.engine_for,
+        db=db,
+        ruler=ruler,
+        namespace="default",
+        clock=clock,
+        **kw,
+    )
+
+
+# --- budget arithmetic goldens ---
+
+
+def test_error_budget_goldens():
+    assert error_budget(0.99) == pytest.approx(0.01)
+    assert error_budget(0.999) == pytest.approx(0.001)
+    for bad in (0.0, 1.0, -0.5, 1.5):
+        with pytest.raises(ValueError):
+            error_budget(bad)
+
+
+def test_burn_rate_goldens():
+    # SRE-workbook anchor: 99.9% objective, 0.1% budget
+    assert burn_rate(1.0, 0.999) == 0.0
+    assert burn_rate(0.999, 0.999) == pytest.approx(1.0)
+    # fast-page threshold case: SLI 98.56% at a 99.9% objective = 14.4x
+    assert burn_rate(0.9856, 0.999) == pytest.approx(14.4)
+    assert burn_rate(0.0, 0.999) == pytest.approx(1000.0)
+    # over-delivery never burns negative
+    assert burn_rate(1.5, 0.999) == 0.0
+
+
+def test_budget_remaining_goldens():
+    assert budget_remaining(1.0, 0.99) == 1.0
+    assert budget_remaining(0.995, 0.99) == pytest.approx(0.5)
+    assert budget_remaining(0.99, 0.99) == pytest.approx(0.0)
+    # past exhaustion clamps at zero, not negative balance
+    assert budget_remaining(0.5, 0.99) == 0.0
+
+
+def test_exhaustion_secs():
+    assert exhaustion_secs(1.0, 0.99, 3600) is None  # burn 0: never
+    assert exhaustion_secs(0.99, 0.99, 3600) is None  # burn 1.0: exactly lasts
+    assert exhaustion_secs(0.98, 0.99, 3600) == pytest.approx(1800.0)  # burn 2
+
+
+def test_window_name():
+    assert window_name(300) == "5m"
+    assert window_name(3600) == "1h"
+    assert window_name(21600) == "6h"
+    assert window_name(259200) == "3d"
+    assert window_name(90) == "90s"
+    for bad in (0, -60, 0.5, 90.5):
+        with pytest.raises(ValueError):
+            window_name(bad)
+
+
+# --- spec validation: loud at load ---
+
+
+def test_spec_validation_loud():
+    with pytest.raises(ValueError, match="snake_case"):
+        spec_from_dict(spec_dict(name="Bad-Name"))
+    with pytest.raises(ValueError, match="unknown sli"):
+        spec_from_dict(spec_dict(sli="uptime"))
+    with pytest.raises(ValueError, match="objective must be in"):
+        spec_from_dict(spec_dict(objective=1.0))
+    with pytest.raises(ValueError, match="no objectives"):
+        spec_from_dict({"slos": []})
+    with pytest.raises(ValueError, match="duplicate slo name"):
+        spec_from_dict({"slos": [
+            spec_dict()["slos"][0], spec_dict()["slos"][0],
+        ]})
+    with pytest.raises(ValueError, match="per_tenant applies"):
+        spec_from_dict(spec_dict(sli="latency", obj={
+            "threshold": 0.25, "per_tenant": True,
+        }))
+    with pytest.raises(ValueError, match="burn threshold must exceed 1"):
+        spec_from_dict(spec_dict(burn_thresholds={"fast": 0.5}))
+    with pytest.raises(ValueError, match="short < long"):
+        spec_from_dict(spec_dict(windows={"fast": ["1h", "5m"]}))
+    with pytest.raises(ValueError, match="take no threshold"):
+        spec_from_dict(spec_dict(sli="durability", obj={"threshold": 1.0}))
+
+
+def test_latency_threshold_must_be_a_duration_bucket():
+    ok = spec_from_dict(spec_dict(sli="latency", obj={"threshold": 0.25}))
+    assert ok.objectives[0].threshold == 0.25
+    with pytest.raises(ValueError, match="bucket bound"):
+        spec_from_dict(spec_dict(sli="latency", obj={"threshold": 0.3}))
+
+
+def test_interval_floor_regressions(db):
+    """The m3tsz second-unit gotcha (PR 7): every stored-telemetry loop
+    rejects sub-second cadences loudly at config load."""
+    assert check_telemetry_interval(1.0, "x") == 1.0
+    assert check_telemetry_interval(0.0, "x") == 0.0  # 0 = disabled
+    with pytest.raises(ValueError, match="m3tsz SECOND-unit"):
+        check_telemetry_interval(0.5, "x")
+    # rule groups: group_from_dict is the loader seam
+    with pytest.raises(ValueError, match="m3tsz SECOND-unit"):
+        groups_from_spec({"groups": [
+            {"name": "g", "interval": "50ms", "rules": []},
+        ]})
+    # self-scrape collector
+    with pytest.raises(ValueError, match="m3tsz SECOND-unit"):
+        SelfMonCollector(DatabaseSink(db), interval=0.3)
+    # SLO spec cadences
+    with pytest.raises(ValueError, match="m3tsz SECOND-unit"):
+        spec_from_dict(spec_dict(eval_interval="500ms"))
+    with pytest.raises(ValueError, match="m3tsz SECOND-unit"):
+        spec_from_dict(spec_dict(probe_interval=0.25))
+
+
+def test_load_slo_file(tmp_path):
+    p = tmp_path / "slo.yml"
+    p.write_text(
+        "eval_interval: 15s\n"
+        "slos:\n"
+        "  - name: query_availability\n"
+        "    sli: availability\n"
+        "    objective: 0.999\n"
+        "    window: 1h\n"
+        "    per_tenant: true\n"
+    )
+    spec = load_slo_file(str(p))
+    assert spec.objectives[0].per_tenant
+    assert spec.fast_windows == (300.0, 3600.0)  # workbook defaults
+
+
+# --- compiled rule plane ---
+
+
+def full_spec():
+    return spec_from_dict({
+        "eval_interval": "15s",
+        "slos": [
+            {"name": "avail", "sli": "availability", "objective": 0.999,
+             "window": "1h", "per_tenant": True},
+            {"name": "lat", "sli": "latency", "objective": 0.99,
+             "threshold": 0.25, "window": "1h"},
+            {"name": "fresh", "sli": "freshness", "objective": 0.99,
+             "threshold": 5.0, "window": "1h"},
+            {"name": "dura", "sli": "durability", "objective": 0.9999,
+             "window": "1h"},
+        ],
+    })
+
+
+def test_compile_shape_and_roundtrip():
+    groups = compile_groups(full_spec())
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.name == SLO_GROUP and g.namespace == RESERVED_NS
+    # per objective: 4 window recordings + fast/slow burn + exhaustion
+    assert len(g.rules) == 4 * 7
+    # every expression must survive the ruler's load-time PromQL parse
+    rt = groups_from_spec(groups_to_spec(groups))
+    assert len(rt[0].rules) == len(g.rules)
+    names = [r.record for r in g.rules if hasattr(r, "record")]
+    assert record_name("avail", 300) == "slo:avail:ratio_rate5m"
+    assert "slo:avail:ratio_rate5m" in names
+    assert "slo:lat:ratio_rate3d" in names
+    # recordings and alerts both carry the objective join label
+    for r in g.rules:
+        assert r.labels["objective"] in ("avail", "lat", "fresh", "dura")
+
+
+def test_compile_multi_window_and_gate():
+    g = compile_groups(full_spec())[0]
+    fast = next(r for r in g.rules
+                if getattr(r, "alert", "") == "SLOFastBurn_avail")
+    # the page gates on the SHORT and the LONG fast window together
+    assert " and " in fast.expr
+    assert "slo:avail:ratio_rate5m" in fast.expr
+    assert "slo:avail:ratio_rate1h" in fast.expr
+    assert "> 14.4" in fast.expr
+    assert fast.labels["severity"] == "page"
+    assert fast.labels["window"] == "5m/1h"
+    slow = next(r for r in g.rules
+                if getattr(r, "alert", "") == "SLOSlowBurn_avail")
+    assert "slo:avail:ratio_rate6h" in slow.expr
+    assert "slo:avail:ratio_rate3d" in slow.expr
+    assert slow.labels["severity"] == "ticket"
+    exh = next(r for r in g.rules
+               if getattr(r, "alert", "") == "SLOBudgetExhausted_avail")
+    assert "slo:avail:ratio_rate1h" in exh.expr and "> 1" in exh.expr
+
+
+def test_reserved_group_name_rejected_in_rule_files(db, tmp_path):
+    coord = Coordinator(db=db)
+    coord.start_selfmon(3600, instance="c0")
+    rules = tmp_path / "rules.yml"
+    rules.write_text(
+        'groups:\n  - name: slo\n    interval: 30s\n    rules: []\n'
+    )
+    slo = tmp_path / "slo.yml"
+    slo.write_text(
+        "slos:\n  - {name: a, sli: availability, objective: 0.99, window: 1h}\n"
+    )
+    coord.start_ruler(rules_path=str(rules), jitter=False)
+    try:
+        with pytest.raises(ValueError, match="reserved"):
+            coord.start_slo(str(slo))
+    finally:
+        coord.ruler.stop()
+        coord.selfmon.stop()
+
+
+def test_start_slo_requires_selfmon(db, tmp_path):
+    slo = tmp_path / "slo.yml"
+    slo.write_text(
+        "slos:\n  - {name: a, sli: availability, objective: 0.99, window: 1h}\n"
+    )
+    with pytest.raises(RuntimeError, match="self-scrape"):
+        Coordinator(db=db).start_slo(str(slo))
+
+
+# --- burn alerts at the ruler: AND gate + hysteresis ---
+
+
+def alerts_only(gspec):
+    """Drop the ratio-recording rules: these tests seed the `slo:*`
+    ratios by hand, and the recordings' rate()-over-raw evaluation is by
+    far the most expensive thing eval_once would otherwise do."""
+    for g in gspec["groups"]:
+        g["rules"] = [r for r in g["rules"] if r.get("alert")]
+    return gspec
+
+
+def seeded_burn_ruler(db, name):
+    spec = spec_from_dict(spec_dict(name=name, objective=0.99))
+    coord = Coordinator(db=db)
+    ruler = Ruler(engine_for=coord.engine_for, db=db, jitter=False)
+    ruler.publish(alerts_only(groups_to_spec(compile_groups(spec))))
+    return ruler.runners()[0]
+
+
+def seed_windows(db, name, t, r5m, r1h, r6h=0.999, r3d=0.999):
+    write_ratio(db, RESERVED_NS, name, 300, t, r5m)
+    write_ratio(db, RESERVED_NS, name, 3600, t, r1h)
+    write_ratio(db, RESERVED_NS, name, 21600, t, r6h)
+    write_ratio(db, RESERVED_NS, name, 259200, t, r3d)
+
+
+def test_fast_burn_requires_both_windows(db):
+    """objective 0.99 → budget 0.01 → page iff ratio < 1 − 14.4·0.01 =
+    0.856 in the 5m AND the 1h window."""
+    runner = seeded_burn_ruler(db, "gate")
+    # short window burning, long window healthy: a blip must NOT page
+    seed_windows(db, "gate", T0, r5m=0.5, r1h=0.99)
+    events = runner.eval_once(T0)
+    assert [e for e in events if "FastBurn" in e["labels"]["alertname"]] == []
+    # both windows burning: the page fires
+    seed_windows(db, "gate", T0 + 60 * NANOS, r5m=0.5, r1h=0.5)
+    events = runner.eval_once(T0 + 60 * NANOS)
+    fast = [e for e in events if "FastBurn" in e["labels"]["alertname"]]
+    assert [e["status"] for e in fast] == ["firing"]
+    assert fast[0]["labels"]["objective"] == "gate"
+    assert fast[0]["labels"]["severity"] == "page"
+
+
+def test_fast_burn_resolve_hysteresis(db):
+    """The LONG window draining below threshold is what resolves the
+    page — the short window still being noisy must not flap it back."""
+    runner = seeded_burn_ruler(db, "hyst")
+    seed_windows(db, "hyst", T0, r5m=0.5, r1h=0.5)
+    events = runner.eval_once(T0)
+    assert any("FastBurn" in e["labels"]["alertname"] for e in events)
+    # long window drains; short stays bad → resolved (hysteresis)
+    seed_windows(db, "hyst", T0 + 60 * NANOS, r5m=0.5, r1h=0.99)
+    events = runner.eval_once(T0 + 60 * NANOS)
+    fast = [e for e in events if "FastBurn" in e["labels"]["alertname"]]
+    assert [e["status"] for e in fast] == ["resolved"]
+    # steady: no flapping on the next tick
+    assert runner.eval_once(T0 + 120 * NANOS) == []
+
+
+def test_slow_burn_ticket_tier(db):
+    """ticket iff burn > 6 in the 6h AND 3d windows: ratio < 0.94."""
+    runner = seeded_burn_ruler(db, "tick")
+    seed_windows(db, "tick", T0, r5m=0.999, r1h=0.999, r6h=0.9, r3d=0.9)
+    events = runner.eval_once(T0)
+    slow = [e for e in events if "SlowBurn" in e["labels"]["alertname"]]
+    assert [e["status"] for e in slow] == ["firing"]
+    assert slow[0]["labels"]["severity"] == "ticket"
+
+
+def test_idle_tenant_records_ratio_one_not_nothing(db):
+    """A tenant whose window saw no traffic (counters flat → both rates
+    zero → 0/0) must RECORD ratio 1, not drop out of the recording:
+    a dropped row leaves the tenant's last ratio (possibly a burning 0)
+    to be resurrected by instant-query lookback for minutes after an
+    outage ends — burn stays pinned, the page never resolves by value,
+    and the budget cannot drain."""
+    spec = spec_from_dict(spec_dict(
+        name="idle", objective=0.99,
+        obj={"per_tenant": True, "window": "1m"},
+        windows={"fast": ["30s", "1m"], "slow": ["30s", "1m"]},
+    ))
+    g = compile_groups(spec)[0]
+    rec30 = next(r for r in g.rules
+                 if getattr(r, "record", "") == "slo:idle:ratio_rate30s")
+    # the compiled expr must carry the trailing fallback arm
+    assert " or (" in rec30.expr and rec30.expr.endswith("* 0 + 1)")
+    # victim: failed counter exists but is FLAT across the window (the
+    # post-outage shape); web: completions flow normally
+    with ruler_writer():
+        for t, failed, done in ((T0, 40.0, 100.0),
+                                (T0 + 15 * NANOS, 40.0, 160.0)):
+            db.write_tagged(
+                RESERVED_NS,
+                make_tags({"__name__": "m3tpu_query_failed_total",
+                           "tenant": "victim"}), t, failed)
+            db.write_tagged(
+                RESERVED_NS,
+                make_tags({"__name__": "m3tpu_query_completed_total",
+                           "tenant": "web"}), t, done)
+    coord = Coordinator(db=db)
+    ruler = Ruler(engine_for=coord.engine_for, db=db, jitter=False)
+    ruler.publish(groups_to_spec([g]))
+    ruler.runners()[0].eval_once(T0 + 15 * NANOS)
+    r = coord.engine_for(RESERVED_NS).query_instant(
+        'slo:idle:ratio_rate30s', T0 + 16 * NANOS)
+    by_tenant = {dict(m.tags).get(b"tenant", b"").decode(): float(r.values[i][-1])
+                 for i, m in enumerate(r.metas)}
+    assert by_tenant["victim"] == 1.0  # the or-fallback, not absence
+    assert by_tenant["web"] == 1.0  # the normal division, untouched
+
+
+# --- the status pass: gauges, violations, alerts join ---
+
+
+def test_tick_status_budget_and_edge_triggered_violations(db):
+    spec = spec_from_dict(spec_dict(name="edge", objective=0.99))
+    eng = make_engine(db, spec, clock=lambda: T0)
+    base = eng._m_violations["edge"].value
+    # healthy: sli 0.995 → burn 0.5 → half the budget left
+    seed_windows(db, "edge", T0, r5m=0.999, r1h=0.995)
+    status = eng.tick_status(T0)
+    row = status["objectives"][0]
+    assert row["sliRatio"] == pytest.approx(0.995)
+    assert row["budgetRemaining"] == pytest.approx(0.5)
+    assert row["burnRates"]["1h"] == pytest.approx(0.5)
+    assert row["burnRates"]["5m"] == pytest.approx(0.1)
+    assert row["exhaustionSecs"] is None
+    assert not row["stale"]
+    g = METRICS.gauge(
+        "slo_budget_remaining_ratio", labels={"objective": "edge"}
+    )
+    assert g.value == pytest.approx(0.5)
+    assert eng._m_violations["edge"].value == base
+    # exhausted: one violation, edge-triggered — a second tick in the
+    # same incident must not count again
+    seed_windows(db, "edge", T0 + 60 * NANOS, r5m=0.5, r1h=0.95)
+    eng.tick_status(T0 + 60 * NANOS)
+    assert eng._m_violations["edge"].value == base + 1
+    eng.tick_status(T0 + 60 * NANOS)
+    assert eng._m_violations["edge"].value == base + 1
+    # recover, then exhaust again: a NEW incident counts
+    seed_windows(db, "edge", T0 + 120 * NANOS, r5m=1.0, r1h=1.0)
+    eng.tick_status(T0 + 120 * NANOS)
+    seed_windows(db, "edge", T0 + 180 * NANOS, r5m=0.5, r1h=0.9)
+    eng.tick_status(T0 + 180 * NANOS)
+    assert eng._m_violations["edge"].value == base + 2
+
+
+def test_tick_status_per_tenant_worst_series_aggregate(db):
+    spec = spec_from_dict(
+        spec_dict(name="pt", objective=0.99, obj={"per_tenant": True})
+    )
+    eng = make_engine(db, spec, clock=lambda: T0)
+    for w in (300, 3600, 21600, 259200):
+        write_ratio(db, RESERVED_NS, "pt", w, T0, 1.0, tenant="good")
+        write_ratio(db, RESERVED_NS, "pt", w, T0, 0.995, tenant="bad")
+    row = eng.tick_status(T0)["objectives"][0]
+    # the scalar SLI is the WORST tenant, not the mean — a healthy
+    # tenant must not average away a burning one
+    assert row["sliRatio"] == pytest.approx(0.995)
+    per = row["perTenant"]
+    assert per["good"]["budgetRemaining"] == pytest.approx(1.0)
+    assert per["bad"]["budgetRemaining"] == pytest.approx(0.5)
+    assert METRICS.gauge(
+        "slo_budget_remaining_ratio",
+        labels={"objective": "pt", "tenant": "bad"},
+    ).value == pytest.approx(0.5)
+
+
+def test_tick_status_stale_on_query_failure(db):
+    spec = spec_from_dict(spec_dict(name="stale_t"))
+    eng = make_engine(db, spec, clock=lambda: T0)
+    seed_windows(db, "stale_t", T0, r5m=1.0, r1h=0.995)
+    assert eng.tick_status(T0)["objectives"][0]["budgetRemaining"] == (
+        pytest.approx(0.5)
+    )
+
+    def broken_engine_for(ns):
+        raise ConnectionError("query plane down")
+
+    eng.engine_for = broken_engine_for
+    row = eng.tick_status(T0 + 60 * NANOS)["objectives"][0]
+    # the status surface must stay up exactly when the fleet is hurting:
+    # last-known numbers kept, row marked stale with the error
+    assert row["stale"] and "ConnectionError" in row["lastError"]
+    assert row["budgetRemaining"] == pytest.approx(0.5)
+
+
+def test_status_joins_firing_alerts(db):
+    spec = spec_from_dict(spec_dict(name="join", objective=0.99))
+    coord = Coordinator(db=db)
+    ruler = Ruler(engine_for=coord.engine_for, db=db, jitter=False)
+    ruler.publish(alerts_only(groups_to_spec(compile_groups(spec))))
+    eng = SLOEngine(spec, engine_for=coord.engine_for, db=db, ruler=ruler,
+                    namespace="default", clock=lambda: T0)
+    seed_windows(db, "join", T0, r5m=0.5, r1h=0.5)
+    ruler.runners()[0].eval_once(T0)
+    eng.tick_status(T0)
+    row = eng.status_dict()["objectives"][0]
+    names = {a["labels"]["alertname"] for a in row["alerts"]}
+    assert "SLOFastBurn_join" in names
+    assert all(a["labels"]["objective"] == "join" for a in row["alerts"])
+
+
+# --- probes ---
+
+
+def test_freshness_and_durability_probes_good(db):
+    now = time.time_ns()
+    spec = spec_from_dict({"slos": [
+        {"name": "fr", "sli": "freshness", "objective": 0.99,
+         "threshold": 5.0, "window": "1h"},
+        {"name": "du", "sli": "durability", "objective": 0.9999,
+         "window": "1h"},
+    ]})
+    eng = make_engine(db, spec, clock=lambda: now)
+    eng._seed_golden()
+    eng.tick_probes(now)
+    assert eng._probe_counts["fr"] == [1, 1]
+    assert eng._probe_counts["du"] == [1, 1]
+    # probe outcomes ride plain registry counters → the selfmon scrape
+    assert METRICS.counter(
+        "slo_probe_good_total", labels={"objective": "du", "kind": "durability"}
+    ).value >= 1
+
+
+def test_durability_probe_detects_non_identical_read(db):
+    now = time.time_ns()
+    spec = spec_from_dict({"slos": [
+        {"name": "du2", "sli": "durability", "objective": 0.9999,
+         "window": "1h"},
+    ]})
+    eng = make_engine(db, spec, clock=lambda: now)
+    eng._seed_golden()
+    eng.tick_probes(now)
+    assert eng._probe_counts["du2"] == [1, 1]
+    # the stored bits no longer match the expectation → probe bad: the
+    # bit-identical contract admits no tolerance
+    t, v = eng._golden[3]
+    eng._golden[3] = (t, v + 1e-12)
+    eng.tick_probes(now)
+    assert eng._probe_counts["du2"] == [1, 2]
+
+
+def test_freshness_probe_scores_lag_against_threshold(db):
+    now = time.time_ns()
+    spec = spec_from_dict({"slos": [
+        {"name": "fr2", "sli": "freshness", "objective": 0.99,
+         "threshold": 5.0, "window": "1h"},
+    ]})
+    eng = make_engine(db, spec, clock=lambda: now)
+    eng.tick_probes(now)
+    assert eng._probe_counts["fr2"] == [1, 1]
+    # ingest wedges: the probe's write fails, the readback sees only
+    # the 30s-old canary → lag over the 5s bound → bad
+    eng._write_canary = lambda *a, **kw: 1
+    eng.tick_probes(now + 30 * NANOS)
+    assert eng._probe_counts["fr2"] == [1, 2]
+
+
+# --- the closed loop: selfmon → ruler → SLO readback ---
+
+
+def test_selfmon_ruler_slo_readback(db):
+    """Counters scraped into _m3tpu → compiled ratio rule records → the
+    status pass reads the budget back: the full pipeline, clock-driven,
+    no threads."""
+    reg = Registry(prefix="m3tpu_")
+    completed = reg.counter(
+        "query_completed_total", "c", labels={"tenant": "t1"}
+    )
+    completed.inc(100)
+    coll = SelfMonCollector(
+        DatabaseSink(db), interval=15.0, instance="c0",
+        component="coordinator", registry=reg, clock=lambda: clk[0],
+    )
+    clk = [T0]
+    coll.scrape_once()
+    completed.inc(60)
+    clk[0] = T0 + 15 * NANOS
+    coll.scrape_once()
+
+    # two distinct short windows only: every extra window compiles three
+    # more rate() programs, and this test is about the loop closing, not
+    # the window mix (the burn tiers above cover that)
+    spec = spec_from_dict(spec_dict(
+        name="loop", objective=0.999, obj={"window": "1m"},
+        windows={"fast": ["30s", "1m"], "slow": ["30s", "1m"]},
+    ))
+    coord = Coordinator(db=db)
+    ruler = Ruler(engine_for=coord.engine_for, db=db, jitter=False)
+    ruler.publish(groups_to_spec(compile_groups(spec)))
+    ruler.runners()[0].eval_once(T0 + 15 * NANOS)
+
+    eng = SLOEngine(spec, engine_for=coord.engine_for, db=db, ruler=ruler,
+                    namespace="default", clock=lambda: clk[0])
+    row = eng.tick_status(T0 + 15 * NANOS)["objectives"][0]
+    # completions flowed, nothing shed/failed → SLI 1.0, budget intact
+    assert row["sliRatio"] == pytest.approx(1.0)
+    assert row["budgetRemaining"] == pytest.approx(1.0)
+    assert not row["stale"]
+
+
+# --- query-stats join (satellite: debug rows name their objectives) ---
+
+
+def test_engine_registers_query_stats_resolver(db):
+    assert query_stats.slo_objectives_for("t") is None
+    spec = spec_from_dict({
+        "eval_interval": 3600, "probe_interval": 3600,
+        "slos": [
+            {"name": "res_av", "sli": "availability", "objective": 0.99,
+             "window": "1h"},
+            {"name": "res_du", "sli": "durability", "objective": 0.999,
+             "window": "1h"},
+        ],
+    })
+    eng = make_engine(db, spec)
+    eng.start()
+    try:
+        # query-path SLIs join; probe SLIs measure canaries, not clients
+        assert query_stats.slo_objectives_for("any") == ["res_av"]
+        st = query_stats.QueryStats(query="up", tenant="t1")
+        assert st.to_dict()["sloObjectives"] == ["res_av"]
+    finally:
+        eng.stop()
+    assert query_stats.slo_objectives_for("t") is None
+    st = query_stats.QueryStats(query="up")
+    assert "sloObjectives" not in st.to_dict()
+
+
+# --- coordinator HTTP surfaces ---
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req) as r:
+        return r.headers, r.read()
+
+
+def test_coordinator_slo_http_surfaces(db, tmp_path):
+    slo = tmp_path / "slo.yml"
+    slo.write_text(
+        "eval_interval: 3600\n"
+        "probe_interval: 3600\n"
+        "slos:\n"
+        "  - {name: http_av, sli: availability, objective: 0.99, window: 1h}\n"
+    )
+    coord = Coordinator(db=db)
+    coord.start_selfmon(3600, instance="c0")
+    coord.start_slo(str(slo), instance="c0", jitter=False)
+    srv, port = serve(coord, 0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        _, body = _get(f"{base}/api/v1/slo")
+        data = json.loads(body)["data"]
+        assert [o["name"] for o in data["objectives"]] == ["http_av"]
+        _, body = _get(f"{base}/debug/slo")
+        dbg = json.loads(body)
+        assert dbg["spec"]["slos"][0]["name"] == "http_av"
+        assert dbg["generatedRules"][0]["name"] == SLO_GROUP
+        # the generated group reached the ruler
+        _, body = _get(f"{base}/api/v1/rules")
+        assert any(g["name"] == SLO_GROUP
+                   for g in json.loads(body)["data"]["groups"])
+        # slo.json rides the debug dump
+        _, body = _get(f"{base}/debug/dump")
+        with zipfile.ZipFile(io.BytesIO(body)) as z:
+            assert "slo.json" in z.namelist()
+            assert json.loads(z.read("slo.json"))["spec"] is not None
+        # OpenMetrics content negotiation on /metrics
+        headers, body = _get(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        assert "openmetrics-text" in headers["Content-Type"]
+        text = body.decode()
+        assert text.rstrip().endswith("# EOF")
+        assert "# TYPE m3tpu_query_shed counter" in text or "_total" in text
+        headers, body = _get(f"{base}/metrics")
+        assert "0.0.4" in headers["Content-Type"]
+        assert "# EOF" not in body.decode()
+    finally:
+        coord.slo.stop()
+        coord.ruler.stop()
+        coord.selfmon.stop()
+        srv.shutdown()
+
+
+def test_openmetrics_exposition_grammar():
+    reg = Registry(prefix="m3tpu_")
+    reg.counter("om_events_total", "events", labels={"kind": "a"}).inc(2)
+    reg.gauge("om_level", "level").set(1.5)
+    h = reg.histogram("om_lat_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05, trace_id="feed", tenant="t9")
+    om = reg.expose_openmetrics()
+    lines = om.splitlines()
+    assert lines[-1] == "# EOF"
+    # counter family metadata drops _total; the sample keeps it
+    assert "# TYPE m3tpu_om_events counter" in lines
+    assert 'm3tpu_om_events_total{kind="a"} 2.0' in lines
+    # exemplar inline on the bucket that holds the traced observation
+    ex = next(l for l in lines if l.startswith('m3tpu_om_lat_seconds_bucket'))
+    assert '# {trace_id="feed",tenant="t9"} 0.05' in ex
+    # the 0.0.4 exposition is unchanged: no exemplars, no EOF
+    txt = reg.expose()
+    assert "# EOF" not in txt and "trace_id" not in txt

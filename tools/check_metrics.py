@@ -7,7 +7,11 @@ CI guard for the fleet-wide /metrics surface: boots a real dbnode process
 (scraped over HTTP ``/metrics``), pushes a little traffic through both so
 the interesting families exist, then validates every exposition line —
 sample-line grammar, label quoting/escaping, histogram bucket monotonicity,
-and TYPE/HELP comment shape. Exit code 0 = clean, 1 = malformed lines.
+and TYPE/HELP comment shape. The coordinator is scraped twice: once as
+Prometheus 0.0.4 text and once with ``Accept: application/openmetrics-text``,
+which must negotiate to OpenMetrics 1.0 (counter metadata without the
+``_total`` suffix, exemplars only on histogram buckets, terminating
+``# EOF``). Exit code 0 = clean, 1 = malformed lines.
 
     JAX_PLATFORMS=cpu python tools/check_metrics.py
 """
@@ -111,6 +115,77 @@ def validate_exposition(text: str) -> list[str]:
     return errors
 
 
+_EXEMPLAR_RE = re.compile(
+    r" # \{(.*)\} "
+    r"(-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\.[0-9]+))"
+    r"(?: [0-9]+(?:\.[0-9]+)?)?$"
+)
+
+
+def validate_openmetrics(text: str) -> list[str]:
+    """All format violations in an OpenMetrics 1.0 text payload."""
+    errors: list[str] = []
+    lines = text.split("\n")
+    while lines and lines[-1] == "":
+        lines.pop()
+    if not lines or lines[-1] != "# EOF":
+        errors.append("payload must end with '# EOF'")
+    types: dict[str, str] = {}
+    for lineno, line in enumerate(lines, 1):
+        if line == "# EOF":
+            if lineno != len(lines):
+                errors.append(f"line {lineno}: '# EOF' before end of payload")
+            continue
+        if not line:
+            errors.append(f"line {lineno}: blank line in OpenMetrics payload")
+            continue
+        if line.startswith("#"):
+            m = _COMMENT_RE.match(line)
+            if m is None:
+                errors.append(f"line {lineno}: malformed comment: {line!r}")
+            elif m.group(1) == "TYPE":
+                if m.group(3) not in _TYPES:
+                    errors.append(f"line {lineno}: unknown TYPE {m.group(3)!r}")
+                elif m.group(3) == "counter" and m.group(2).endswith("_total"):
+                    errors.append(
+                        f"line {lineno}: counter family metadata keeps "
+                        f"_total (OpenMetrics names the family bare): {line!r}"
+                    )
+                types[m.group(2)] = m.group(3)
+            continue
+        body, exemplar = line, None
+        if " # " in line:
+            exemplar = _EXEMPLAR_RE.search(line)
+            if exemplar is None:
+                errors.append(f"line {lineno}: malformed exemplar: {line!r}")
+                continue
+            body = line[: exemplar.start()]
+        m = _SAMPLE_RE.match(body)
+        if m is None:
+            errors.append(f"line {lineno}: malformed sample: {line!r}")
+            continue
+        name, rawlabels = m.group(1), m.group(2)
+        labels = _parse_labels(rawlabels) if rawlabels else {}
+        if labels is None:
+            errors.append(f"line {lineno}: malformed labels: {line!r}")
+            continue
+        if exemplar is not None:
+            if not name.endswith("_bucket"):
+                errors.append(
+                    f"line {lineno}: exemplar on a non-bucket sample: {line!r}"
+                )
+            if _parse_labels(exemplar.group(1)) is None:
+                errors.append(
+                    f"line {lineno}: malformed exemplar labels: {line!r}"
+                )
+        if types.get(name) == "counter":
+            errors.append(
+                f"line {lineno}: counter sample must carry the _total "
+                f"suffix: {line!r}"
+            )
+    return errors
+
+
 def _spawn(argv: list[str], marker: str = "LISTENING") -> tuple:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -191,11 +266,42 @@ def main() -> int:
             ):
                 if family not in coord_text:
                     failures.append(f"coordinator: missing {family} family")
+            # OpenMetrics negotiation: the same surface under Accept must
+            # produce a valid 1.0 payload
+            req = urllib.request.Request(
+                f"{cbase}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                om_ctype = resp.headers.get("Content-Type", "")
+                om_text = resp.read().decode()
+            if "application/openmetrics-text" not in om_ctype:
+                failures.append(
+                    f"coordinator: Accept negotiation ignored "
+                    f"(Content-Type {om_ctype!r})"
+                )
+            for err in validate_openmetrics(om_text):
+                failures.append(f"coordinator-om: {err}")
+            if "# TYPE m3tpu_db_writes counter" not in om_text:
+                failures.append(
+                    "coordinator-om: counter family metadata should be bare "
+                    "(m3tpu_db_writes, not m3tpu_db_writes_total)"
+                )
+
             # the escape probe must validate ON THE WIRE (local registry —
-            # validates _fmt_labels escaping end to end)
+            # validates _fmt_labels escaping end to end) in BOTH formats,
+            # with an exemplar-bearing histogram in the mix
+            METRICS.histogram(
+                "checkmetrics_om_seconds", buckets=(0.1, 1.0)
+            ).observe(0.05, trace_id="feedface", tenant="probe")
             local_text = METRICS.expose()
             for err in validate_exposition(local_text):
                 failures.append(f"local-registry: {err}")
+            local_om = METRICS.expose_openmetrics()
+            for err in validate_openmetrics(local_om):
+                failures.append(f"local-registry-om: {err}")
+            if 'trace_id="feedface"' not in local_om:
+                failures.append("local-registry-om: exemplar missing")
             slow = json.loads(
                 urllib.request.urlopen(f"{cbase}/debug/slow_queries").read()
             )

@@ -42,7 +42,7 @@ N_POINTS = 64
 T0 = 1_600_000_000 * NANOS
 STEP = 10 * NANOS
 PROFILE_HZ = "97"  # fast sampling so a short gate still sees hot frames
-SCRAPE_INTERVAL = 0.5
+SCRAPE_INTERVAL = 1.0
 
 
 def _get(url: str) -> bytes:

@@ -28,7 +28,7 @@ import tempfile
 import time
 import urllib.request
 
-SCRAPE_INTERVAL = 0.5
+SCRAPE_INTERVAL = 1.0
 
 
 def _get_json(url: str):

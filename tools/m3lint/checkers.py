@@ -405,11 +405,20 @@ class MetricNameDiscipline(Checker):
     # migration family (storage/cluster_db.py
     # migration_streamed_bytes_total{peer}) keys on it so a handoff's
     # byte flow is attributable to the source that served it.
+    # "objective": SLO objective names — bounded by the operator's
+    # --slo-config spec (spec.py rejects duplicates and non-slug names),
+    # never derived from request data; the m3tpu_slo_* family and the
+    # probe counters key on it so budget/burn series join 1:1 to the
+    # compiled slo:<name>:ratio_rate<w> recordings.
+    # "window": the spec's burn/budget window tokens ("5m", "1h",
+    # "5m/1h") — a handful of values fixed at config load; paired with
+    # "objective" it is what lets a dashboard overlay fast vs slow burn.
     # Deliberately ABSENT: "frame"/"stack" — profile stacks are
     # unbounded runtime data and live in the profiling table
     # (m3_tpu/profiling/), never in metric labels.
     LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
-                  "ns", "group", "tenant", "scope", "shard", "reason"}
+                  "ns", "group", "tenant", "scope", "shard", "reason",
+                  "objective", "window"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
