@@ -399,6 +399,22 @@ class RemoteNode(RpcClient):
         """Seal buffered blocks before the cutoff (operator/CI surface)."""
         return self._call("flush", ns=ns, flush_before=flush_before)
 
+    def snapshot(self, ns) -> dict:
+        """Capture un-flushed buffers to a snapshot file (operator/CI
+        surface; bounds commit-log replay)."""
+        return self._call("snapshot", ns=ns)
+
+    def scrub(self, ns=None) -> dict:
+        """One digest-verify pass over sealed filesets; corrupt/torn
+        volumes quarantine. {"scanned","quarantined","bytes"}."""
+        return self._call("scrub", ns=ns)
+
+    def repair(self, ns, peers, shards=None) -> dict:
+        """Checksum-diff ``shards`` (all when None) against peer
+        endpoint strings and merge differing blocks (operator/CI
+        surface; the repair daemon runs the same path on a cadence)."""
+        return self._call("repair", ns=ns, peers=list(peers), shards=shards)
+
     def scan_totals(self, ns, matchers, start, end, explain: bool = False) -> dict:
         """Raw-sample scan-and-aggregate; ``matchers``:
         [[name, op, value], ...] (see NodeService.op_scan_totals).

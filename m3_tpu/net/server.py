@@ -454,6 +454,44 @@ class NodeService:
         flushed = self.db.flush(req["ns"], req["flush_before"])
         return [[f.namespace, f.shard, f.block_start, f.volume] for f in flushed]
 
+    def op_snapshot(self, req):
+        """Operator/CI snapshot: capture un-flushed buffers so commit-log
+        replay is bounded (the mediator snapshots on its own cadence;
+        tools/check_crash.py drives it explicitly to reach the
+        snapshot:pre-cleanup crash point deterministically)."""
+        return {"records": self.db.snapshot(req["ns"])}
+
+    def op_scrub(self, req):
+        """Operator/CI scrub: one digest-verify pass over sealed filesets
+        (the background Scrubber daemon runs the same verification on its
+        own paced cadence). Corrupt/torn volumes quarantine — duplicate-
+        safe: a re-run re-verifies what's left."""
+        return self.db.scrub(req.get("ns"))
+
+    def op_repair(self, req):
+        """Operator/CI repair: checksum-diff the given shards against peer
+        endpoints and merge only differing blocks (storage/repair.py).
+        Duplicate-safe — a converged shard streams nothing on re-run."""
+        from ..storage.repair import repair_database
+        from .client import RemoteNode
+
+        peers = [RemoteNode.connect(ep) for ep in req["peers"]]
+        try:
+            res = repair_database(
+                self.db, req["ns"], peers, shard_ids=req.get("shards")
+            )
+        finally:
+            for peer in peers:
+                peer.close()
+        return {
+            "shards_repaired": res.shards_repaired,
+            "blocks_compared": res.blocks_compared,
+            "blocks_streamed": res.blocks_streamed,
+            "points_merged": res.points_merged,
+            "points_skipped_cold": res.points_skipped_cold,
+            "peer_errors": res.peer_errors,
+        }
+
     def op_scan_totals(self, req):
         """Raw-sample scan-and-aggregate over matched series (block
         granularity): routed to the decode-from-HBM path when every

@@ -226,7 +226,8 @@ IDEMPOTENT_OPS = frozenset(
         "metrics", "traces", "cache_stats", "resident_stats", "index_stats",
         "lg_poll", "profile",
         # operator ops that re-apply to the same state
-        "flush", "assign_shards", "resident_clear",
+        "flush", "assign_shards", "resident_clear", "scrub", "repair",
+        "snapshot",
         # raft protocol (duplicate-safe by design)
         "raft_vote", "raft_append", "raft_snapshot", "raft_status",
         # KV reads (mutations ride RemoteKVStore's own failover contract);
@@ -240,8 +241,13 @@ IDEMPOTENT_OPS = frozenset(
 # REFUSED the request (deadline already expired, load shed, injected fault)
 # without touching state. Raised as net.resilience.UnavailableError
 # server-side; RetryableError is the raft KV service's pre-existing
-# no-leader-yet rejection.
-RETRYABLE_ETYPES = frozenset({"UnavailableError", "RetryableError"})
+# no-leader-yet rejection. DiskFullError (storage/faults.py) is the
+# commit-log ENOSPC shed: the write was rejected before any WAL append, so
+# the client may retry it elsewhere (or later, once space frees) — the SLO
+# plane sees it as unavailability, not data loss.
+RETRYABLE_ETYPES = frozenset(
+    {"UnavailableError", "RetryableError", "DiskFullError"}
+)
 
 
 def inject_trace(req: dict, ctx: dict | None) -> dict:

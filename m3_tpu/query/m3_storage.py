@@ -199,9 +199,11 @@ class M3Storage:
                     or fid.block_start >= end_nanos
                 ):
                     continue
-                reader = shard.reader(FilesetID(
+                reader = shard.reader_or_none(FilesetID(
                     self.namespace, shard_id, fid.block_start, fid.volume
                 ))
+                if reader is None:
+                    continue  # retention race or quarantined mid-query
                 for sid in sids:
                     stream = reader.stream(sid)
                     if stream:
@@ -566,12 +568,12 @@ class M3Storage:
 
                     key = _keys[i]
                     shard = ns.shards[key.shard_id]
-                    reader = shard.reader(
+                    reader = shard.reader_or_none(
                         FilesetID(
                             key.namespace, key.shard_id, key.block_start, key.volume
                         )
                     )
-                    return reader.stream(key.series_id) or b""
+                    return (reader.stream(key.series_id) or b"") if reader else b""
 
         if aggs is None:
             pool = getattr(self.db, "resident_pool", None)

@@ -100,6 +100,32 @@ def build_parser() -> argparse.ArgumentParser:
         "sync to device (--device-ingest)",
     )
     p.add_argument(
+        "--commitlog-sync",
+        choices=["every", "interval", "none"],
+        default="interval",
+        help="commit-log durability mode (storage.database."
+        "COMMITLOG_SYNC_MODES): 'every' fsyncs before acking each write "
+        "(zero acked loss on a hard kill), 'interval' acks from the OS "
+        "buffer and fsyncs on a cadence (default; loss bounded by the "
+        "flush interval), 'none' leaves syncing to segment rotation "
+        "(loss bounded by the open segment)",
+    )
+    p.add_argument(
+        "--scrub-interval",
+        type=float,
+        default=0.0,
+        help="background fileset scrub cadence in seconds (0 disables): "
+        "digest-verifies sealed volumes and quarantines corruption "
+        "(storage/repair.py Scrubber); counts ride "
+        "m3tpu_storage_corruption_total",
+    )
+    p.add_argument(
+        "--scrub-bytes-per-sec",
+        type=int,
+        default=32 * 1024 * 1024,
+        help="scrub read-rate bound in bytes/sec (0 = unpaced)",
+    )
+    p.add_argument(
         "--selfmon-interval",
         type=float,
         default=0.0,
@@ -235,6 +261,7 @@ def main(argv=None) -> int:
             if args.device_ingest
             else None
         ),
+        commitlog_sync=args.commitlog_sync,
     )
     opts = NamespaceOptions(
         retention_nanos=args.retention_secs * NANOS,
@@ -318,6 +345,18 @@ def main(argv=None) -> int:
     if not args.no_mediator:
         mediator = Mediator(db, MediatorOptions())
         mediator.start()
+
+    scrubber = None
+    if args.scrub_interval > 0:
+        from ..storage.repair import Scrubber
+
+        scrubber = Scrubber(
+            db,
+            interval=args.scrub_interval,
+            bytes_per_sec=args.scrub_bytes_per_sec,
+            phase_key=args.node_id,
+        )
+        scrubber.start()
 
     shards = {int(s) for s in args.shards.split(",") if s.strip()}
     service = NodeService(db, node_id=args.node_id, assigned_shards=shards)
@@ -453,6 +492,8 @@ def main(argv=None) -> int:
             kv_raft.stop()
         if kv_server is not None:
             kv_server.stop()
+        if scrubber is not None:
+            scrubber.stop()
         if mediator is not None:
             mediator.stop()
         db.close()

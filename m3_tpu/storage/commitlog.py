@@ -17,6 +17,7 @@ the wrong series.
 
 from __future__ import annotations
 
+import errno
 import os
 import queue
 import re
@@ -26,11 +27,38 @@ import time
 import zlib
 from dataclasses import dataclass
 
+from ..utils.instrument import DEFAULT as METRICS
 from ..utils.xtime import Unit
+from .faults import DISK, DiskFullError, crash_point
 
 _MAGIC = 0x6D33574C  # "m3WL"
 _HDR = struct.Struct("<IHI")  # crc32 of (series_id + payload), id len, payload len
 _SEG_RE = re.compile(r"^commitlog-(\d+)\.wal$")
+
+_ENOSPC_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
+
+# disk-full degrade surface: one process-wide gauge (any commit log
+# degraded), one event counter. Per-log state lives on the instance; the
+# registry aggregates here so the SLO plane sees capacity pressure.
+_DISK_FULL_GAUGE = METRICS.gauge(
+    "storage_disk_full",
+    "1 while any commit log is in disk-full degraded mode",
+)
+_DISK_FULL_EVENTS = METRICS.counter(
+    "storage_disk_full_events_total",
+    "commit log disk-full degrade events",
+)
+_degraded_dirs: set = set()
+_degraded_lock = threading.Lock()
+
+
+def _mark_degraded(dir_path: str, on: bool) -> None:
+    with _degraded_lock:
+        if on:
+            _degraded_dirs.add(dir_path)
+        else:
+            _degraded_dirs.discard(dir_path)
+        _DISK_FULL_GAUGE.set(1.0 if _degraded_dirs else 0.0)
 
 
 @dataclass
@@ -82,11 +110,17 @@ class CommitLog:
         flush_interval: float = 1.0,
         write_behind: bool = True,
         queue_size: int = 65536,
+        degraded_retry_interval: float = 0.05,
     ) -> None:
         self.dir = dir_path
         self.flush_every = flush_every
         self.flush_interval = flush_interval
         self.write_behind = write_behind
+        self.degraded_retry_interval = degraded_retry_interval
+        # set to the triggering OSError while the log is parked in
+        # disk-full degraded mode; cleared when a retry succeeds
+        self._degraded: BaseException | None = None
+        self._parked: list = []  # dequeued cmds being retried while degraded
         # the writer thread owns the file; this lock only guards the
         # synchronous mode and open/close edges
         self._wlock = threading.RLock()
@@ -115,11 +149,12 @@ class CommitLog:
             self._writer.start()
 
     def _open_segment(self, seq: int):
-        f = open(_seg_path(self.dir, seq), "ab")
+        path = _seg_path(self.dir, seq)
+        f = DISK.open(path, "ab")
+        self._fpath = path
         if f.tell() == 0:
-            f.write(struct.pack("<I", _MAGIC))
-            f.flush()
-            os.fsync(f.fileno())
+            DISK.write(f, path, struct.pack("<I", _MAGIC))
+            DISK.fsync(f, path)
         return f
 
     # --- caller-facing surface ---
@@ -127,6 +162,28 @@ class CommitLog:
     def _check_failed(self) -> None:
         if self._failed is not None:
             raise RuntimeError("commit log writer failed") from self._failed
+
+    @property
+    def disk_full(self) -> bool:
+        """True while the log is parked in disk-full degraded mode: new
+        writes are shed with the typed retryable :class:`DiskFullError`
+        instead of being acked into a WAL that cannot land them."""
+        return self._degraded is not None
+
+    def _check_disk_full(self) -> None:
+        if self._degraded is not None:
+            raise DiskFullError(f"commit log disk full: {self.dir}")
+
+    def _enter_degraded(self, exc: OSError) -> None:
+        if self._degraded is None:
+            _DISK_FULL_EVENTS.inc()
+            _mark_degraded(self.dir, True)
+        self._degraded = exc
+
+    def _clear_degraded(self) -> None:
+        if self._degraded is not None:
+            self._degraded = None
+            _mark_degraded(self.dir, False)
 
     def _enqueue(self, cmd) -> bool:
         """Enqueue unless closed. Returns False when the log is closed."""
@@ -138,6 +195,7 @@ class CommitLog:
 
     def write(self, entry: CommitLogEntry) -> None:
         if self.write_behind:
+            self._check_disk_full()  # shed instead of acking into a parked WAL
             if not self._enqueue(("entry", entry)):  # blocks when full
                 self._check_failed()
                 raise ValueError("commit log is closed")
@@ -145,12 +203,17 @@ class CommitLog:
             with self._wlock:
                 if self._closed:
                     raise ValueError("commit log is closed")
-                self._append(entry)
-                if self._pending >= self.flush_every:
-                    self._fsync()
+                try:
+                    self._append(entry)
+                    if self._pending >= self.flush_every:
+                        self._fsync()
+                except OSError as exc:
+                    self._map_sync_oserror(exc)
+                self._clear_degraded()
 
     def write_batch(self, entries: list[CommitLogEntry]) -> None:
         if self.write_behind:
+            self._check_disk_full()
             # ONE queue command for the whole batch: per-entry queue puts
             # were ~6µs each and dominated batched ingest
             if not self._enqueue(("batch", entries)):
@@ -160,22 +223,44 @@ class CommitLog:
             with self._wlock:
                 if self._closed:
                     raise ValueError("commit log is closed")
-                for e in entries:
-                    self._append(e)
-                self._fsync()
+                try:
+                    for e in entries:
+                        self._append(e)
+                    self._fsync()
+                except OSError as exc:
+                    self._map_sync_oserror(exc)
+                self._clear_degraded()
+
+    def _map_sync_oserror(self, exc: OSError) -> None:
+        """Sync-mode failure mapping: ENOSPC degrades to the typed
+        retryable DiskFullError (a duplicate re-append after the caller's
+        retry is benign — replay dedupes (sid, t) last-wins); anything
+        else propagates as the hard failure it is."""
+        if exc.errno in _ENOSPC_ERRNOS:
+            self._enter_degraded(exc)
+            raise DiskFullError(f"commit log disk full: {self.dir}") from exc
+        raise exc
 
     def flush(self) -> None:
         """Durability barrier: everything enqueued before this call is on
-        disk when it returns. No-op after close (close fsyncs)."""
+        disk when it returns. No-op after close (close fsyncs). While
+        disk-full degraded the barrier cannot be met — fail typed-retryable
+        rather than blocking until space frees."""
         if self.write_behind:
+            self._check_disk_full()
             ev = threading.Event()
             if self._enqueue(("flush", ev)):
                 ev.wait()
             self._check_failed()
+            self._check_disk_full()
         else:
             with self._wlock:
                 if not self._closed:
-                    self._fsync()
+                    try:
+                        self._fsync()
+                    except OSError as exc:
+                        self._map_sync_oserror(exc)
+                    self._clear_degraded()
 
     def rotate(self) -> int:
         """RotateLogs (:370): seal the active segment, open the next.
@@ -254,6 +339,12 @@ class CommitLog:
 
             release(self._inflight)
             self._inflight = None
+            # commands dequeued into the degraded-retry park must release
+            # too — they are no longer in the queue, so the drain below
+            # would miss their waiters
+            for cmd in self._parked:
+                release(cmd)
+            self._parked = []
             try:
                 while True:
                     release(self._q.get_nowait())
@@ -272,35 +363,108 @@ class CommitLog:
             try:
                 cmd = self._q.get(timeout=timeout)
             except queue.Empty:
-                self._fsync()  # interval elapsed with records pending
-                last_fsync = time.monotonic()
-                continue
+                cmd = ("fsync",)  # interval elapsed with records pending
             self._inflight = cmd
-            kind = cmd[0]
-            if kind == "entry":
-                self._append(cmd[1])
-                if self._pending >= self.flush_every:
-                    self._fsync()
-                    last_fsync = time.monotonic()
-            elif kind == "batch":
-                for e in cmd[1]:
-                    self._append(e)
-                if self._pending >= self.flush_every:
-                    self._fsync()
-                    last_fsync = time.monotonic()
-            elif kind == "flush":
-                self._fsync()
-                last_fsync = time.monotonic()
-                cmd[1].set()
-            elif kind == "rotate":
-                cmd[2].append(self._rotate_now())
-                last_fsync = time.monotonic()
-                cmd[1].set()
-            elif kind == "close":
-                self._fsync()
-                self._f.close()
-                cmd[1].set()
+            try:
+                done = self._process_cmd(cmd)
+            except OSError as exc:
+                if exc.errno not in _ENOSPC_ERRNOS:
+                    raise
+                done = self._degraded_drain(cmd, exc)
+            last_fsync = time.monotonic()
+            if done:
                 return
+
+    def _process_cmd(self, cmd) -> bool:
+        """Serve one writer command; True means the log just closed.
+        Shared between the healthy loop and the degraded-retry loop —
+        re-serving a command whose first attempt partially appended is
+        safe because replay dedupes (sid, t) last-wins at bootstrap."""
+        kind = cmd[0]
+        if kind == "fsync":
+            self._fsync()
+        elif kind == "entry":
+            self._append(cmd[1])
+            if self._pending >= self.flush_every:
+                self._fsync()
+        elif kind == "batch":
+            for e in cmd[1]:
+                self._append(e)
+            if self._pending >= self.flush_every:
+                self._fsync()
+        elif kind == "flush":
+            self._fsync()
+            cmd[1].set()
+        elif kind == "rotate":
+            cmd[2].append(self._rotate_now())
+            cmd[1].set()
+        elif kind == "close":
+            self._fsync()
+            self._f.close()
+            cmd[1].set()
+            return True
+        return False
+
+    def _degraded_drain(self, first_cmd, exc: OSError) -> bool:
+        """Disk full: park instead of dying. New writes shed typed-
+        retryable (see ``write``); everything already accepted — the
+        failed command plus whatever queued behind it — retries in FIFO
+        order until space frees, so no acked record is dropped and no
+        ordering inverts. A close while still full force-closes (the
+        caller is tearing the process down; spinning against a dead-full
+        disk would hang shutdown forever). Returns True when the log
+        closed during the drain."""
+        self._enter_degraded(exc)
+        self._parked = [first_cmd] if first_cmd[0] != "fsync" else []
+        while True:
+            try:
+                while True:
+                    self._parked.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            try:
+                while self._parked:
+                    done = self._process_cmd(self._parked[0])
+                    self._parked.pop(0)
+                    if done:
+                        self._clear_degraded()
+                        return True
+                self._fsync()  # park entered with unsynced appends pending
+                self._clear_degraded()
+                return False
+            except OSError as retry_exc:
+                if retry_exc.errno not in _ENOSPC_ERRNOS:
+                    raise
+                self._enter_degraded(retry_exc)
+                if any(c[0] == "close" for c in self._parked):
+                    self._force_close_degraded()
+                    return True
+                time.sleep(self.degraded_retry_interval)
+
+    def _force_close_degraded(self) -> None:
+        """Close against a still-full disk: neutralize the file object
+        (python-buffered bytes must not flush at GC time into a reused
+        fd — see _crash) and release every parked waiter. Records parked
+        but never landed are lost, the same bound as a process kill here;
+        the on-disk WAL stays a clean torn tail that replay tolerates."""
+        with self._qlock:
+            self._closed = True
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            try:
+                os.dup2(devnull, self._f.fileno())
+            finally:
+                os.close(devnull)
+            self._f.close()
+        except (OSError, ValueError):
+            pass  # fd neutralization is best-effort; waiters still release
+        for cmd in self._parked:
+            if cmd[0] in ("flush", "close"):
+                cmd[1].set()
+            elif cmd[0] == "rotate":
+                cmd[2].append(self.active_seq)
+                cmd[1].set()
+        self._parked = []
 
     # --- file ops (writer thread in write-behind mode; else under _wlock) ---
 
@@ -317,13 +481,12 @@ class CommitLog:
         )
         crc = zlib.crc32(entry.series_id + payload)
         rec = _HDR.pack(crc, len(entry.series_id), len(payload)) + entry.series_id + payload
-        self._f.write(rec)
+        DISK.write(self._f, self._fpath, rec)
         self._pending += 1
         self._active_entries += 1
 
     def _fsync(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        DISK.fsync(self._f, self._fpath)
         self._pending = 0
 
     def _rotate_now(self) -> int:
@@ -332,6 +495,9 @@ class CommitLog:
             return sealed
         self._fsync()
         self._f.close()
+        # the sealed segment is durable and closed; the next one does not
+        # exist yet — the exact torn state a rotation-time kill leaves
+        crash_point("commitlog:mid-rotation")
         self.active_seq += 1
         self._f = self._open_segment(self.active_seq)
         self._pending = 0

@@ -55,16 +55,34 @@ _M_ENCODE_BYTES = METRICS.counter(
     "compressed stream bytes produced by the device encode kernel",
 )
 from .commitlog import CommitLog, CommitLogEntry
+from .faults import DiskFullError
 from .fs import (
     CHUNK_K,
+    CorruptFilesetError,
     FilesetID,
     FilesetReader,
     delete_fileset,
+    fileset_complete,
     list_fileset_volumes,
     list_filesets,
+    quarantine_fileset,
     read_index_ids,
+    verify_fileset,
     write_fileset,
 )
+
+# --commitlog-sync mapping onto the CommitLog knobs: the acked-write loss
+# bound per mode on a hard process kill (pinned by
+# tests/test_storage_faults.py::test_commitlog_sync_loss_bounds):
+#   every    acked => appended AND fsynced; zero acked-write loss
+#   interval write-behind; loss bounded by flush_every/flush_interval
+#   none     fsync only at explicit barriers (flush/rotate/close); loss
+#            bounded by the OS+python buffers — fastest, replay gaps OK
+COMMITLOG_SYNC_MODES: dict[str, dict] = {
+    "every": {"write_behind": False, "flush_every": 1},
+    "interval": {},
+    "none": {"write_behind": True, "flush_every": 1 << 30, "flush_interval": 1e9},
+}
 from .series import NANOS, SeriesBuffer
 from .snapshot import read_latest_snapshot, remove_snapshots, write_snapshot
 
@@ -175,13 +193,83 @@ class Shard:
         if cached is not None and cached.fid.volume == fid.volume:
             self._readers.move_to_end(fid.block_start)
             return cached
-        reader = FilesetReader(self.base, fid)
+        try:
+            reader = FilesetReader(self.base, fid)
+        except CorruptFilesetError as exc:
+            # verify-on-first-read tripped: the volume rotted on disk
+            # after commit. Quarantine it and report the fileset missing —
+            # every caller already survives a retention race deleting a
+            # fileset mid-read, and subsequent listings exclude it, so the
+            # shard degrades to peers/repair instead of erroring reads.
+            self._quarantine_locked(fid, exc.problems)
+            raise FileNotFoundError(f"fileset {fid} quarantined") from exc
         self.reader_materializations += 1
         self._readers[fid.block_start] = reader
         self._readers.move_to_end(fid.block_start)
         while len(self._readers) > self.max_cached_readers:
             self._readers.popitem(last=False)
         return reader
+
+    def _reader_or_none_locked(self, fid: FilesetID) -> FilesetReader | None:
+        """Reader, or None when the fileset vanished (retention race) or
+        was just quarantined — the graceful-read spelling call sites use
+        so corruption never surfaces as a client-visible error."""
+        try:
+            return self._reader_locked(fid)
+        except FileNotFoundError:
+            return None
+
+    def reader_or_none(self, fid: FilesetID) -> FilesetReader | None:
+        with self.lock:
+            return self._reader_or_none_locked(fid)
+
+    def _quarantine_locked(self, fid: FilesetID, problems: list) -> None:
+        """Rename a corrupt volume aside and invalidate everything that
+        could still serve its bytes: the reader LRU entry, the fileset
+        listing cache + epoch (device query plans revalidate), the decoded
+        cache and resident pool for the block. If no complete volume
+        remains for the block it is no longer 'flushed', so bootstrap's
+        peers source / the repair plane re-replicate it."""
+        quarantine_fileset(self.base, fid, problems)
+        self._readers.pop(fid.block_start, None)
+        self._invalidate_filesets()
+        remaining = [
+            f
+            for f in list_fileset_volumes(self.base, self.namespace, self.id)
+            if f.block_start == fid.block_start
+        ]
+        if not remaining:
+            self._flushed_blocks.discard(fid.block_start)
+        self.invalidator.on_tick_expire(
+            self.namespace, self.id, {fid.block_start}
+        )
+
+    def scrub(self) -> dict:
+        """One verify pass over this shard's sealed filesets: every
+        complete volume is digest-verified; mismatches quarantine. Returns
+        {"scanned", "quarantined", "bytes"} for the scrubber's pacing."""
+        from .fs import fileset_bytes
+
+        scanned = quarantined = scrubbed_bytes = 0
+        for fid in list_fileset_volumes(self.base, self.namespace, self.id):
+            scrubbed_bytes += fileset_bytes(self.base, fid)
+            problems = verify_fileset(self.base, fid)
+            scanned += 1
+            if problems:
+                with self.lock:
+                    # retention/supersede deletes run under the shard lock;
+                    # re-verify under it so a fileset deleted mid-verify
+                    # doesn't count as corruption
+                    if fileset_complete(self.base, fid):
+                        problems = verify_fileset(self.base, fid)
+                        if problems:
+                            self._quarantine_locked(fid, problems)
+                            quarantined += 1
+        return {
+            "scanned": scanned,
+            "quarantined": quarantined,
+            "bytes": scrubbed_bytes,
+        }
 
     def check_write(self, t_nanos: int) -> None:
         """Raise if a write at ``t_nanos`` would be rejected (shard.go:
@@ -275,7 +363,8 @@ class Shard:
             key = BlockKey(self.namespace, self.id, sid, fid.block_start, fid.volume)
 
             def _decode(fid=fid):
-                stream = self._reader_locked(fid).stream(sid)
+                reader = self._reader_or_none_locked(fid)
+                stream = reader.stream(sid) if reader is not None else None
                 _M_DECODED_BYTES.inc(len(stream) if stream else 0)
                 arrs = decode_stream_arrays(stream or b"")
                 return None if arrs is None else DecodedBlock(*arrs)
@@ -344,7 +433,8 @@ class Shard:
         for fid in self.filesets():
             if fid.block_start + self.opts.block_size_nanos <= start or fid.block_start >= end:
                 continue
-            stream = self.reader(fid).stream(sid)
+            reader = self._reader_or_none_locked(fid)
+            stream = reader.stream(sid) if reader is not None else None
             if stream:
                 segments.append(stream)
         buf = self.series.get(sid)
@@ -369,7 +459,8 @@ class Shard:
             for fid in self.filesets():
                 if fid.block_start in exclude_blocks:
                     continue
-                stream = self._reader_locked(fid).stream(sid)
+                reader = self._reader_or_none_locked(fid)
+                stream = reader.stream(sid) if reader is not None else None
                 if stream:
                     segments.append(stream)
             buf = self.series.get(sid)
@@ -430,7 +521,9 @@ class Shard:
             for fid in self.filesets():
                 if fid.block_start + bsz <= start or fid.block_start >= end:
                     continue
-                reader = self._reader_locked(fid)
+                reader = self._reader_or_none_locked(fid)
+                if reader is None:
+                    continue
                 entry = reader._lookup(sid) if reader.bloom.test(sid) else None
                 if entry is None:
                     continue
@@ -623,8 +716,8 @@ class Shard:
         for bs, updates in sorted(cold.items()):
             prev = next((f for f in self.filesets() if f.block_start == bs), None)
             series: dict[bytes, bytes] = {}
-            if prev is not None:
-                reader = self.reader(prev)
+            reader = self._reader_or_none_locked(prev) if prev is not None else None
+            if reader is not None:
                 for other in reader.series_ids:
                     series[other] = reader.stream(other) or b""
             from ..codec.m3tsz import Encoder
@@ -814,11 +907,18 @@ class Database:
         resident_options: ResidentOptions | None = None,
         index_device_options=None,
         ingest_options=None,
+        commitlog_sync: str = "interval",
     ) -> None:
         self.base = base_dir
         self.num_shards = num_shards
         self.namespaces: dict[str, Namespace] = {}
         self.commitlog_enabled = commitlog_enabled
+        if commitlog_sync not in COMMITLOG_SYNC_MODES:
+            raise ValueError(
+                f"commitlog_sync must be one of {sorted(COMMITLOG_SYNC_MODES)}, "
+                f"got {commitlog_sync!r}"
+            )
+        self.commitlog_sync = commitlog_sync
         # decoded-block cache, shared across namespaces/shards (one byte
         # budget per node, like the reference's process-wide wired list)
         self.cache_options = cache_options or CacheOptions()
@@ -903,7 +1003,10 @@ class Database:
             )
             self.namespaces[name] = ns
             if self.commitlog_enabled:
-                self._commitlogs[name] = CommitLog(self._commitlog_dir(name))
+                self._commitlogs[name] = CommitLog(
+                    self._commitlog_dir(name),
+                    **COMMITLOG_SYNC_MODES[self.commitlog_sync],
+                )
             return ns
 
     def _commitlog_dir(self, ns: str) -> str:
@@ -947,6 +1050,12 @@ class Database:
         check_write(ns)
         namespace = self.namespaces[ns]
         shard = namespace.shard_for(sid)
+        cl = self._commitlogs.get(ns)
+        if cl is not None and cl.disk_full:
+            # shed before buffering: an accepted point the WAL cannot land
+            # would be unreplayable after a crash. Typed retryable — the
+            # client backs off and the write succeeds once space frees.
+            raise DiskFullError(f"commit log disk full: {ns}")
         with shard.lock:
             with self._limit_lock:
                 is_new = self._check_new_series(shard, sid)
@@ -985,6 +1094,9 @@ class Database:
         check_write(ns)
         namespace = self.namespaces[ns]
         cl = self._commitlogs.get(ns)
+        if cl is not None and cl.disk_full:
+            # shed the whole batch before buffering (see write())
+            raise DiskFullError(f"commit log disk full: {ns}")
         limit_on = self._new_series_limit > 0
         unit_s = int(Unit.SECOND)
         # shard routing for the whole batch in ONE native murmur3 call
@@ -1322,7 +1434,9 @@ class Database:
                 for fid in sh.filesets():
                     if fid.block_start in excl:
                         continue
-                    sids.update(sh.reader(fid).series_ids)
+                    reader = sh._reader_or_none_locked(fid)
+                    if reader is not None:
+                        sids.update(reader.series_ids)
             docs: dict[bytes, tuple] = {}
             if namespace.index is not None and sids:
                 with namespace.index.lock:
@@ -1445,6 +1559,23 @@ class Database:
                     cl.remove_inactive()
                 return total
 
+    def scrub(self, ns: str | None = None) -> dict:
+        """One verify pass over sealed filesets (op_scrub lands here; the
+        background Scrubber daemon does its own per-volume walk so it can
+        pace to a byte budget): every complete volume
+        is digest-verified; mismatched/torn volumes quarantine with full
+        cache/pool/index invalidation and the shard falls back to the
+        peer/repair machinery. Returns {"scanned","quarantined","bytes"}."""
+        totals = {"scanned": 0, "quarantined": 0, "bytes": 0}
+        names = [ns] if ns is not None else list(self.namespaces)
+        for name in names:
+            namespace = self.namespaces[name]
+            for shard in namespace.shards:
+                r = shard.scrub()
+                for k in totals:
+                    totals[k] += r[k]
+        return totals
+
     def tick(self, now_nanos: int) -> None:
         """storage/mediator.go tick: expire buffers, filesets, and index
         blocks past retention (including their persisted segment files)."""
@@ -1502,6 +1633,7 @@ class Database:
                 "commitlog_entries": 0,
                 "filesets": 0,
                 "snapshot_records": 0,
+                "quarantined": 0,
                 "sources": {},
             }
             for name, ns in list(self.namespaces.items()):
@@ -1612,7 +1744,8 @@ class Database:
                 return False
             pk = (sh.id, bs, sid)
             if pk not in pts:
-                stream = sh.reader(fid).stream(sid)
+                reader = sh.reader_or_none(fid)
+                stream = reader.stream(sid) if reader is not None else None
                 pts[pk] = (
                     {dp.timestamp: dp.value for dp in decode(stream)}
                     if stream
@@ -1640,7 +1773,27 @@ class Database:
                 if ns.index is not None:
                     persisted = ns.index.load_persisted(self.base, ns_name)
                 for shard in shards:
-                    fids = shard.filesets()
+                    # bootstrap-open verification: digest-check every
+                    # discovered volume BEFORE trusting it as provenance.
+                    # A corrupt winner quarantines and the re-listing may
+                    # surface an older complete volume; blocks left with
+                    # no clean volume stay unfulfilled here and fall
+                    # through the chain to peers.
+                    with shard.lock:
+                        while True:
+                            fids = shard.filesets()
+                            bad = next(
+                                (
+                                    (fid, problems)
+                                    for fid in fids
+                                    if (problems := verify_fileset(self.base, fid))
+                                ),
+                                None,
+                            )
+                            if bad is None:
+                                break
+                            shard._quarantine_locked(bad[0], bad[1])
+                            result["quarantined"] += 1
                     result["filesets"] += len(fids)
                     for fid in fids:
                         shard._flushed_blocks.add(fid.block_start)

@@ -15,6 +15,7 @@ prescan.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import struct
@@ -22,6 +23,9 @@ import zlib
 from dataclasses import dataclass
 
 import numpy as np
+
+from ..utils.instrument import DEFAULT as METRICS
+from .faults import DISK, DiskFullError, crash_point
 
 from typing import TYPE_CHECKING
 
@@ -57,6 +61,21 @@ SIDE_VERSION = 3
 SIDE_REC_V3 = 40  # SIDE_WORDS * 4
 
 SUFFIXES = ("info", "index", "summaries", "bloomfilter", "data", "side", "digest", "checkpoint")
+
+#: subdirectory (next to ``data/``) where corrupt fileset volumes are
+#: renamed aside for post-mortem inspection instead of deleted
+QUARANTINE_DIR = "quarantine"
+
+
+class CorruptFilesetError(RuntimeError):
+    """A checkpoint-complete fileset failed digest verification — torn or
+    bit-rotted on disk after commit. Carries the per-file evidence so the
+    quarantine path can count ``storage_corruption_total{file,reason}``."""
+
+    def __init__(self, fid: "FilesetID", problems: list[tuple[str, str]]) -> None:
+        super().__init__(f"corrupt fileset {fid}: {problems}")
+        self.fid = fid
+        self.problems = problems  # [(file_role, reason)]
 
 
 def _bloom_bits(n: int) -> int:
@@ -229,22 +248,30 @@ def write_fileset(
         "side": b"".join(side_parts),
     }
     digests = {}
-    for suffix, payload in files.items():
-        with open(_path(base, fid, suffix), "wb") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        digests[suffix] = zlib.adler32(payload)
-    digest_payload = json.dumps(digests).encode()
-    with open(_path(base, fid, "digest"), "wb") as f:
-        f.write(digest_payload)
-        f.flush()
-        os.fsync(f.fileno())
-    # checkpoint carries the digest-of-digests and commits the fileset
-    with open(_path(base, fid, "checkpoint"), "wb") as f:
-        f.write(struct.pack("<I", zlib.adler32(digest_payload)))
-        f.flush()
-        os.fsync(f.fileno())
+    try:
+        for suffix, payload in files.items():
+            DISK.write_durable(_path(base, fid, suffix), payload)
+            digests[suffix] = zlib.adler32(payload)
+            if suffix == "data":
+                crash_point("fileset:data-written")
+        digest_payload = json.dumps(digests).encode()
+        DISK.write_durable(_path(base, fid, "digest"), digest_payload)
+        crash_point("fileset:pre-checkpoint")
+        # checkpoint carries the digest-of-digests and commits the fileset
+        DISK.write_durable(
+            _path(base, fid, "checkpoint"),
+            struct.pack("<I", zlib.adler32(digest_payload)),
+        )
+    except OSError as exc:
+        # the checkpoint never landed, so the partial set was invisible —
+        # remove it so the retried flush starts clean; disk-full degrades
+        # to the typed retryable rejection instead of a crash
+        delete_fileset(base, fid)
+        if isinstance(exc, DiskFullError):
+            raise
+        if exc.errno in (errno.ENOSPC, errno.EDQUOT):
+            raise DiskFullError(f"disk full writing fileset {fid}") from exc
+        raise
 
 
 def fileset_complete(base: str, fid: FilesetID) -> bool:
@@ -266,6 +293,111 @@ def delete_fileset(base: str, fid: FilesetID) -> None:
             os.remove(_path(base, fid, suffix))
         except FileNotFoundError:
             pass
+
+
+# --- verify + quarantine (scrub plane) ---
+
+_CORRUPTION_CHILDREN: dict = {}
+_QUARANTINE_GAUGE = METRICS.gauge(
+    "storage_quarantined_volumes",
+    "fileset volumes quarantined since process start",
+)
+_quarantined_total = 0
+
+
+def _count_corruption(file_role: str, reason: str) -> None:
+    child = _CORRUPTION_CHILDREN.get((file_role, reason))
+    if child is None:
+        child = METRICS.counter(
+            "storage_corruption_total",
+            "corrupt fileset files detected by verify/scrub",
+            labels={"file": file_role, "reason": reason},
+        )
+        _CORRUPTION_CHILDREN[(file_role, reason)] = child
+    child.inc()
+
+
+def _read_role(base: str, fid: FilesetID, suffix: str) -> bytes:
+    path = _path(base, fid, suffix)
+    with DISK.open(path, "rb") as f:
+        return DISK.read(f, path)
+
+
+def verify_fileset(base: str, fid: FilesetID) -> list[tuple[str, str]]:
+    """Digest-verify every file of a fileset against its digest file and
+    the digest file against its checkpoint. Returns [] when clean, else
+    (file_role, reason) evidence pairs with reason in {"missing", "torn",
+    "digest-mismatch"}. Reads are full sequential file reads — callers
+    cache the verdict (reader LRU / scrub cursor), never per query."""
+    try:
+        cp = _read_role(base, fid, "checkpoint")
+    except OSError:
+        return [("checkpoint", "missing")]
+    if len(cp) != 4:
+        return [("checkpoint", "torn")]
+    try:
+        digest_payload = _read_role(base, fid, "digest")
+    except OSError:
+        return [("digest", "missing")]
+    (want,) = struct.unpack("<I", cp)
+    if zlib.adler32(digest_payload) != want:
+        return [("digest", "digest-mismatch")]
+    digests = json.loads(digest_payload.decode())
+    problems: list[tuple[str, str]] = []
+    for suffix in SUFFIXES[:-2]:
+        try:
+            payload = _read_role(base, fid, suffix)
+        except OSError:
+            problems.append((suffix, "missing"))
+            continue
+        if zlib.adler32(payload) != digests.get(suffix):
+            problems.append((suffix, "digest-mismatch"))
+    return problems
+
+
+def fileset_bytes(base: str, fid: FilesetID) -> int:
+    """Total on-disk bytes of a fileset (the scrubber's rate-limit unit)."""
+    total = 0
+    for suffix in SUFFIXES:
+        try:
+            total += os.path.getsize(_path(base, fid, suffix))
+        except OSError:
+            continue
+    return total
+
+
+def quarantine_fileset(
+    base: str, fid: FilesetID, problems: list[tuple[str, str]] | None = None
+) -> str:
+    """Rename a corrupt fileset aside into ``base/quarantine/<ns>/<shard>/``,
+    checkpoint FIRST — the instant it moves, the volume stops being
+    'complete' to every lister, so a crash mid-quarantine leaves an
+    incomplete (ignored) fileset, never a half-visible one. Counts
+    ``storage_corruption_total{file,reason}`` per evidence pair and bumps
+    the quarantine gauge. Returns the quarantine directory."""
+    global _quarantined_total
+    qdir = os.path.join(base, QUARANTINE_DIR, fid.namespace, str(fid.shard))
+    os.makedirs(qdir, exist_ok=True)
+    for suffix in ("checkpoint", "digest") + SUFFIXES[:-2]:
+        src = _path(base, fid, suffix)
+        try:
+            os.replace(src, os.path.join(qdir, os.path.basename(src)))
+        except FileNotFoundError:
+            pass
+    for file_role, reason in problems or [("checkpoint", "unknown")]:
+        _count_corruption(file_role, reason)
+    _quarantined_total += 1
+    _QUARANTINE_GAUGE.set(_quarantined_total)
+    return qdir
+
+
+def list_quarantined(base: str, namespace: str, shard: int) -> list[str]:
+    """File names currently sitting in one shard's quarantine directory."""
+    d = os.path.join(base, QUARANTINE_DIR, namespace, str(shard))
+    try:
+        return sorted(os.listdir(d))
+    except FileNotFoundError:
+        return []
 
 
 def list_fileset_volumes(base: str, namespace: str, shard: int) -> list[FilesetID]:
@@ -383,13 +515,14 @@ def append_fileset_chunk(
     size (append-only resume); a mismatch means this importer lost a race
     with another and must re-sync from migration_file_size."""
     os.makedirs(_dir(base, fid), exist_ok=True)
-    with open(_path(base, fid, suffix), "ab") as f:
+    path = _path(base, fid, suffix)
+    with DISK.open(path, "ab") as f:
         if f.tell() != int(offset):
             raise ValueError(
                 f"resume offset {offset} != local size {f.tell()} for "
                 f"{fid} {suffix}"
             )
-        f.write(data)
+        DISK.write(f, path, data)
 
 
 def commit_imported_fileset(base: str, fid: FilesetID) -> None:
@@ -414,15 +547,11 @@ def commit_imported_fileset(base: str, fid: FilesetID) -> None:
         delete_fileset(base, fid)
         raise
     for suffix in MIGRATION_SUFFIXES:
-        fd = os.open(_path(base, fid, suffix), os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-    with open(_path(base, fid, "checkpoint"), "wb") as f:
-        f.write(struct.pack("<I", zlib.adler32(digest_payload)))
-        f.flush()
-        os.fsync(f.fileno())
+        DISK.fsync_path(_path(base, fid, suffix))
+    DISK.write_durable(
+        _path(base, fid, "checkpoint"),
+        struct.pack("<I", zlib.adler32(digest_payload)),
+    )
 
 
 class FilesetReader:
@@ -435,9 +564,16 @@ class FilesetReader:
     same way, seek.go:63). Full-index parses happen lazily and only for
     whole-fileset consumers (series_ids, shard streaming)."""
 
-    def __init__(self, base: str, fid: FilesetID) -> None:
+    def __init__(self, base: str, fid: FilesetID, verify: bool = True) -> None:
         if not fileset_complete(base, fid):
             raise FileNotFoundError(f"incomplete fileset {fid}")
+        if verify:
+            # verify-on-first-read: one full digest pass when the reader
+            # materializes (readers are LRU-cached by the shard, so this
+            # is per serving volume, never per query)
+            problems = verify_fileset(base, fid)
+            if problems:
+                raise CorruptFilesetError(fid, problems)
         self.fid = fid
         self.info = json.loads(self._read(base, "info"))
         self.bloom = BloomFilter(
@@ -477,13 +613,14 @@ class FilesetReader:
                 self._summary_offs.append(index_off)
 
     def _read(self, base: str, suffix: str) -> bytes:
-        with open(_path(base, self.fid, suffix), "rb") as f:
-            return f.read()
+        path = _path(base, self.fid, suffix)
+        with DISK.open(path, "rb") as f:
+            return DISK.read(f, path)
 
     def _mmap(self, base: str, suffix: str):
         import mmap as _mmap_mod
 
-        with open(_path(base, self.fid, suffix), "rb") as f:
+        with DISK.open(_path(base, self.fid, suffix), "rb") as f:
             size = os.fstat(f.fileno()).st_size
             if size == 0:
                 return memoryview(b"")

@@ -17,6 +17,7 @@ import re
 import struct
 
 from ..utils.blob import read_checked_blob, write_atomic_checked_blob
+from .faults import crash_point
 
 _MAGIC = 0x6D335350  # "m3SP" (v3: records the fileset volume at snapshot)
 _REC = struct.Struct("<IqIi")  # id len, block_start, stream len, volume
@@ -63,6 +64,10 @@ def write_snapshot(
         _MAGIC,
         b"".join(parts),
     )
+    # the new snapshot is durable; the superseded ones still exist — a
+    # kill here must leave a readable newest snapshot (read_latest walks
+    # newest-first, so the stale survivors are inert)
+    crash_point("snapshot:pre-cleanup")
     for _, path in existing:
         os.remove(path)
     return seq

@@ -31,6 +31,18 @@ from ..net.faults import (  # noqa: F401  (re-exported surface)
     FaultRule,
     plan_from_env,
 )
+from ..storage.faults import (  # noqa: F401  (re-exported surface)
+    CRASH_POINT_ENV,
+    CRASH_POINTS,
+    DISK_FAULT_PLAN_ENV,
+    DiskFaultError,
+    DiskFaultPlan,
+    DiskFaultRule,
+    DiskFullError,
+)
+from ..storage.faults import (
+    plan_from_env as disk_plan_from_env,  # noqa: F401
+)
 
 __all__ = [
     "FAULT_PLAN_ENV",
@@ -41,6 +53,17 @@ __all__ = [
     "env_with_plan",
     "plan_from_env",
     "wrap_nodes",
+    # disk fault-injection surface (storage/faults.py)
+    "CRASH_POINT_ENV",
+    "CRASH_POINTS",
+    "DISK_FAULT_PLAN_ENV",
+    "DiskFaultError",
+    "DiskFaultPlan",
+    "DiskFaultRule",
+    "DiskFullError",
+    "disk_plan_from_env",
+    "env_with_crash_point",
+    "env_with_disk_plan",
 ]
 
 
@@ -92,3 +115,25 @@ def full_env_with_plan(plan: FaultPlan) -> dict:
     """A COMPLETE environ (os.environ + the plan) for subprocess spawns
     that replace the environment rather than overlaying it."""
     return env_with_plan(plan, base=dict(os.environ))
+
+
+def env_with_disk_plan(plan: DiskFaultPlan, base: dict | None = None) -> dict:
+    """Env-var overlay installing a DISK fault plan (storage/faults.py) in
+    a spawned server process (proc_cluster ``node_env`` seam)."""
+    env = dict(base or {})
+    env[DISK_FAULT_PLAN_ENV] = plan.to_json()
+    return env
+
+
+def env_with_crash_point(*sites: str, base: dict | None = None) -> dict:
+    """Env-var overlay arming deterministic crash points (the process
+    hard-exits with CRASH_EXIT_CODE the first time it passes any of the
+    named sites — see storage.faults.CRASH_POINTS)."""
+    for site in sites:
+        if site not in CRASH_POINTS:
+            raise ValueError(
+                f"unknown crash point {site!r}; known: {CRASH_POINTS}"
+            )
+    env = dict(base or {})
+    env[CRASH_POINT_ENV] = ",".join(sites)
+    return env

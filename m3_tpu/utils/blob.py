@@ -18,17 +18,15 @@ _U32 = struct.Struct("<I")
 
 
 def write_atomic_checked_blob(path: str, magic: int, body: bytes) -> None:
+    # lazy import: the storage fault seam (storage/faults.py) owns the
+    # write-temp -> fsync -> rename primitive so injected disk faults
+    # reach blob writers too; utils must not import storage at load time
+    from ..storage.faults import DISK
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     head = _U32.pack(magic)
     blob = head + body + _U32.pack(zlib.crc32(head + body))
-    tmp = os.path.join(
-        os.path.dirname(path), f".{os.path.basename(path)}.tmp"
-    )
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    DISK.write_durable(path, blob)
 
 
 def read_checked_blob(path: str, magic: int) -> bytes | None:

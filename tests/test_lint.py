@@ -633,6 +633,50 @@ def test_counted_or_narrow_swallow_quiet():
     assert findings == []
 
 
+# --- M3L008 durable-write-discipline ---
+
+
+def test_bare_open_and_post_checkpoint_write_fire():
+    src = """
+    import os
+
+    def persist(base, payload, DISK):
+        with open(os.path.join(base, "info.db"), "wb") as f:
+            f.write(payload)
+
+    def commit(base, digest_payload, data, DISK):
+        DISK.write_durable(os.path.join(base, "checkpoint.db"),
+                           digest_payload)
+        DISK.write_durable(os.path.join(base, "data.db"), data)
+    """
+    findings = lint(src, rel="m3_tpu/storage/newstore.py")
+    assert codes(findings) == {"M3L008"} and len(findings) == 2
+    # same code outside storage/ (and in the seam itself) is not flagged
+    assert lint(src, rel="m3_tpu/ops/newstore.py") == []
+    assert lint(src, rel="m3_tpu/storage/faults.py") == []
+
+
+def test_seamed_checkpoint_last_quiet():
+    findings = lint(
+        """
+        import os
+
+        def commit(base, files, digest_payload, DISK):
+            for suffix, payload in files.items():
+                DISK.write_durable(os.path.join(base, suffix + ".db"),
+                                   payload)
+            DISK.write_durable(os.path.join(base, "checkpoint.db"),
+                               digest_payload)
+
+        def read(path):
+            with open(path, "rb") as f:
+                return f.read()
+        """,
+        rel="m3_tpu/storage/newstore.py",
+    )
+    assert findings == []
+
+
 # --- the fixed codebase stays quiet + the gate runs inside tier-1 ---
 
 

@@ -92,6 +92,22 @@ def test_series_ids_full_parse(fileset):
     assert r.full_index_parses == 1
 
 
+def _refresh_digests(base, fid):
+    # keep verify-on-open honest after rewriting a fileset file in place
+    import zlib
+
+    dpath = _path(base, fid, "digest")
+    digests = json.loads(open(dpath, "rb").read())
+    for suffix in digests:
+        with open(_path(base, fid, suffix), "rb") as f:
+            digests[suffix] = zlib.adler32(f.read())
+    payload = json.dumps(digests).encode()
+    with open(dpath, "wb") as f:
+        f.write(payload)
+    with open(_path(base, fid, "checkpoint"), "wb") as f:
+        f.write(struct.pack("<I", zlib.adler32(payload)))
+
+
 def test_legacy_fileset_without_summary_offsets(fileset, tmp_path):
     # filesets written before the seek format (no summariesIndexOffsets
     # marker) fall back to the full index parse
@@ -102,6 +118,7 @@ def test_legacy_fileset_without_summary_offsets(fileset, tmp_path):
     legacy.pop("summariesIndexOffsets")
     with open(info_path, "wb") as f:
         f.write(json.dumps(legacy).encode())
+    _refresh_digests(base, fid)
     try:
         r = FilesetReader(base, fid)
         sid = b"series-00123"
@@ -110,6 +127,7 @@ def test_legacy_fileset_without_summary_offsets(fileset, tmp_path):
     finally:
         with open(info_path, "wb") as f:
             f.write(json.dumps(info).encode())
+        _refresh_digests(base, fid)
 
 
 def test_reader_cache_lru_bound(tmp_path):

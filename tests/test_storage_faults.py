@@ -1,0 +1,559 @@
+"""Storage fault injection + crash-anywhere recovery (storage/faults.py).
+
+Fast tier: seeded-plan determinism, torn-tail WAL replay under injected
+torn writes, bit-flip caught by scrub/verify with full invalidation,
+ENOSPC graceful degradation + recovery in both commitlog modes, the
+acked-write loss bound of each --commitlog-sync mode, crash-point arming,
+and the PR 16 device-ingest WAL-coverage regression. Heavy multi-process
+cluster variants (SIGKILL at armed crash points, planted corruption +
+peer repair) are @slow; tools/check_crash.py is the composed gate.
+"""
+
+import glob
+import os
+import shutil
+import time
+
+import pytest
+
+from m3_tpu.storage import faults
+from m3_tpu.storage.commitlog import CommitLog, CommitLogEntry
+from m3_tpu.storage.database import (
+    COMMITLOG_SYNC_MODES,
+    Database,
+    NamespaceOptions,
+)
+from m3_tpu.storage.faults import (
+    CRASH_POINT_ENV,
+    DiskFaultPlan,
+    DiskFaultRule,
+    DiskFullError,
+    classify_path,
+    install_plan,
+)
+from m3_tpu.utils.xtime import Unit
+
+NANOS = 1_000_000_000
+HOUR = 3600 * NANOS
+BSZ = 2 * HOUR
+T0 = 1_600_000_000 * NANOS
+
+
+@pytest.fixture(autouse=True)
+def _clean_seam():
+    """No injected plan may leak into another test (the seam is a process
+    global, exactly like the disk it stands in for)."""
+    yield
+    install_plan(None)
+
+
+def _mkdb(path, **kwargs):
+    db = Database(str(path), num_shards=2, **kwargs)
+    db.create_namespace(
+        "t",
+        NamespaceOptions(
+            retention_nanos=48 * HOUR, block_size_nanos=BSZ
+        ),
+    )
+    db.bootstrapped = True
+    return db
+
+
+# --- seeded plan core ---
+
+
+def test_plan_determinism_and_json_roundtrip():
+    def seq(plan, n=64):
+        return [plan.decide("write", "data", 100) for _ in range(n)]
+
+    rules = [
+        DiskFaultRule(op="write", path_class="data", torn=0.3, bitflip=0.2),
+        DiskFaultRule(eio=0.1),
+    ]
+    a = seq(DiskFaultPlan(rules_copy(rules), seed=42))
+    b = seq(DiskFaultPlan(rules_copy(rules), seed=42))
+    assert a == b and any(action != "pass" for action, _ in a)
+    # a different seed draws a different schedule
+    assert seq(DiskFaultPlan(rules_copy(rules), seed=43)) != a
+    # JSON roundtrip: same schedule, runtime hit counts stripped
+    plan = DiskFaultPlan(rules_copy(rules), seed=42)
+    plan.rules[0].hits = 7
+    clone = DiskFaultPlan.from_json(plan.to_json())
+    assert clone.seed == 42 and clone.rules[0].hits == 0
+    assert clone.rules[0].torn == 0.3 and clone.rules[1].eio == 0.1
+    assert seq(clone) == a
+
+
+def rules_copy(rules):
+    return [DiskFaultRule(**{**r.__dict__, "hits": 0}) for r in rules]
+
+
+def test_rule_max_hits_bounds_injection():
+    plan = DiskFaultPlan([DiskFaultRule(eio=1.0, max_hits=2)], seed=1)
+    actions = [plan.decide("write", "data")[0] for _ in range(5)]
+    assert actions == ["eio", "eio", "pass", "pass", "pass"]
+
+
+def test_classify_path():
+    assert classify_path("/x/data/fileset-0-1-data.db") == "data"
+    assert classify_path("/x/data/fileset-0-1-checkpoint.db") == "checkpoint"
+    # the durable-write temp spelling classifies as its final name
+    assert classify_path("/x/.fileset-0-1-checkpoint.db.tmp") == "checkpoint"
+    assert classify_path("/x/commitlogs/t/commitlog-3.wal") == "commitlog"
+    assert classify_path("/x/snapshots/t/0/snapshot-1.db") == "snapshot"
+    assert classify_path("/x/whatever.bin") == "other"
+
+
+# --- torn writes: the WAL replay contract ---
+
+
+def test_torn_commitlog_write_replays_clean_prefix(tmp_path):
+    cl = CommitLog(str(tmp_path / "wal"), write_behind=False)
+    for i in range(3):
+        cl.write(CommitLogEntry(b"s", T0 + i * NANOS, float(i), Unit.SECOND))
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="commitlog",
+                           torn=1.0, max_hits=1)],
+            seed=9,
+        )
+    )
+    with pytest.raises(OSError):
+        cl.write(CommitLogEntry(b"s", T0 + 3 * NANOS, 3.0, Unit.SECOND))
+    install_plan(None)
+    # the torn final record is on disk; replay stops cleanly before it
+    entries = CommitLog.replay(str(tmp_path / "wal"))
+    assert [e.value for e in entries] == [0.0, 1.0, 2.0]
+
+
+# --- bit flips: verify-on-read and the scrubber ---
+
+
+def _corruption_count():
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    fam = METRICS.collect().get("m3tpu_storage_corruption_total")
+    return sum(c["value"] for c in fam["children"]) if fam else 0.0
+
+
+def test_injected_bitflip_detected_by_scrub_with_invalidation(tmp_path):
+    db = _mkdb(tmp_path, commitlog_enabled=False)
+    for i in range(40):
+        db.write("t", b"s%d" % (i % 4), T0 + i * NANOS, float(i))
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="data",
+                           bitflip=1.0, max_hits=1)],
+            seed=5,
+        )
+    )
+    db.flush("t", T0 + 10 * BSZ)  # the data file lands silently corrupted
+    install_plan(None)
+
+    calls = []
+    for ns in db.namespaces.values():
+        for sh in ns.shards:
+            orig = sh.invalidator
+
+            class _Rec:
+                def __init__(self, inner):
+                    self._inner = inner
+
+                def __getattr__(self, name):
+                    fn = getattr(self._inner, name)
+
+                    def wrap(*a, **k):
+                        calls.append((name, a))
+                        return fn(*a, **k)
+
+                    return wrap
+
+            sh.invalidator = _Rec(orig)
+
+    before = _corruption_count()
+    res = db.scrub()
+    assert res["quarantined"] == 1 and res["scanned"] >= 1
+    assert _corruption_count() > before
+    # the quarantined block's caches/pool/index were expired
+    assert any(name == "on_tick_expire" for name, _ in calls)
+    # the volume moved aside; reads degrade (no error), listings exclude it
+    quarantined = glob.glob(
+        os.path.join(str(tmp_path), "quarantine", "**", "*-data.db"),
+        recursive=True,
+    )
+    assert len(quarantined) == 1
+    assert db.read("t", b"s0", T0, T0 + BSZ) == []
+    # a second pass finds nothing left to quarantine
+    assert db.scrub()["quarantined"] == 0
+    db.close()
+
+
+def test_on_disk_corruption_caught_at_first_read(tmp_path):
+    """Verify-on-first-read: corruption planted AFTER a clean flush trips
+    when the reader materializes, not per-query."""
+    db = _mkdb(tmp_path, commitlog_enabled=False)
+    for i in range(30):
+        db.write("t", b"r%d" % (i % 3), T0 + i * NANOS, float(i))
+    db.flush("t", T0 + 10 * BSZ)
+    data = glob.glob(
+        os.path.join(str(tmp_path), "**", "*-data.db"), recursive=True
+    )
+    assert data
+    with open(data[0], "r+b") as f:
+        f.seek(6)
+        byte = f.read(1)
+        f.seek(6)
+        f.write(bytes([byte[0] ^ 0x10]))
+    before = _corruption_count()
+    # graceful: the read returns empty instead of raising, volume quarantines
+    assert db.read("t", b"r0", T0, T0 + BSZ) == []
+    assert _corruption_count() > before
+    assert glob.glob(
+        os.path.join(str(tmp_path), "quarantine", "**", "*-data.db"),
+        recursive=True,
+    )
+    db.close()
+
+
+# --- ENOSPC graceful degradation ---
+
+
+def test_enospc_sync_mode_degrades_and_recovers(tmp_path):
+    cl = CommitLog(str(tmp_path / "wal"), write_behind=False)
+    cl.write(CommitLogEntry(b"s", T0, 1.0, Unit.SECOND))
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="commitlog", enospc=1.0)],
+            seed=3,
+        )
+    )
+    with pytest.raises(DiskFullError):
+        cl.write(CommitLogEntry(b"s", T0 + NANOS, 2.0, Unit.SECOND))
+    assert cl.disk_full
+    install_plan(None)  # space freed
+    cl.write(CommitLogEntry(b"s", T0 + 2 * NANOS, 3.0, Unit.SECOND))
+    assert not cl.disk_full
+    cl.close()
+    # the shed write never acked and never landed; everything acked did
+    assert [e.value for e in CommitLog.replay(str(tmp_path / "wal"))] == [1.0, 3.0]
+
+
+def test_enospc_write_behind_parks_then_drains(tmp_path):
+    cl = CommitLog(
+        str(tmp_path / "wal"), write_behind=True, flush_every=1,
+        degraded_retry_interval=0.01,
+    )
+    cl.write(CommitLogEntry(b"s", T0, 1.0, Unit.SECOND))
+    cl.flush()
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="commitlog", enospc=1.0)],
+            seed=3,
+        )
+    )
+    cl.write(CommitLogEntry(b"s", T0 + NANOS, 2.0, Unit.SECOND))  # acked, parks
+    deadline = time.monotonic() + 10
+    while not cl.disk_full and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert cl.disk_full
+    # while parked: new writes and barriers shed typed-retryable, no crash
+    with pytest.raises(DiskFullError):
+        cl.write(CommitLogEntry(b"s", T0 + 2 * NANOS, 9.0, Unit.SECOND))
+    with pytest.raises(DiskFullError):
+        cl.flush()
+    install_plan(None)  # space freed: the parked record drains on its own
+    deadline = time.monotonic() + 10
+    while cl.disk_full and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert not cl.disk_full
+    cl.write(CommitLogEntry(b"s", T0 + 3 * NANOS, 3.0, Unit.SECOND))
+    cl.flush()
+    cl.close()
+    # every ACKED write recovered, in order; the shed one never landed
+    assert [e.value for e in CommitLog.replay(str(tmp_path / "wal"))] == [
+        1.0, 2.0, 3.0,
+    ]
+
+
+def test_enospc_flush_persist_degrades_then_retries(tmp_path):
+    db = _mkdb(tmp_path, commitlog_enabled=False)
+    for i in range(20):
+        db.write("t", b"s%d" % (i % 2), T0 + i * NANOS, float(i))
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="data",
+                           enospc=1.0, max_hits=1)],
+            seed=11,
+        )
+    )
+    with pytest.raises(DiskFullError):
+        db.flush("t", T0 + 10 * BSZ)
+    install_plan(None)
+    # nothing half-written survived, buffers intact: the retry flushes all
+    assert db.flush("t", T0 + 10 * BSZ)
+    assert len(db.read("t", b"s0", T0, T0 + BSZ)) == 10
+    assert db.scrub()["quarantined"] == 0
+    db.close()
+
+
+def test_database_write_sheds_while_wal_disk_full(tmp_path):
+    db = _mkdb(tmp_path)
+    db.write("t", b"s", T0, 1.0)
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="commitlog", enospc=1.0)],
+            seed=2,
+        )
+    )
+    db.write("t", b"s", T0 + NANOS, 2.0)  # acked; parks the WAL writer
+    cl = db._commitlogs["t"]
+    deadline = time.monotonic() + 10
+    while not cl.disk_full and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert cl.disk_full
+    with pytest.raises(DiskFullError):
+        db.write("t", b"s", T0 + 2 * NANOS, 3.0)
+    with pytest.raises(DiskFullError):
+        db.write_batch("t", [(b"s", T0 + 3 * NANOS, 4.0)])
+    install_plan(None)
+    deadline = time.monotonic() + 10
+    while cl.disk_full and time.monotonic() < deadline:
+        time.sleep(0.005)
+    db.write("t", b"s", T0 + 4 * NANOS, 5.0)  # writes resume, no restart
+    db.flush_wals()
+    assert [dp.value for dp in db.read("t", b"s", T0, T0 + BSZ)] == [
+        1.0, 2.0, 5.0,
+    ]
+    db.close()
+
+
+def test_disk_full_error_is_wire_retryable():
+    from m3_tpu.net.wire import RETRYABLE_ETYPES
+
+    assert type(DiskFullError("x")).__name__ in RETRYABLE_ETYPES
+
+
+# --- --commitlog-sync acked-write loss bounds ---
+
+
+@pytest.mark.parametrize("mode", ["every", "interval", "none"])
+def test_commitlog_sync_loss_bounds(tmp_path, mode):
+    """The bound pinned per mode: writes BEFORE the last durability
+    barrier always survive a hard kill; writes after it survive iff the
+    mode syncs them ('every' syncs per write; 'interval' is bounded by
+    the flush cadence; 'none' only at rotation/explicit barriers)."""
+    cl = CommitLog(str(tmp_path / "wal"), **COMMITLOG_SYNC_MODES[mode])
+    for i in range(4):
+        cl.write(CommitLogEntry(b"s", T0 + i * NANOS, float(i), Unit.SECOND))
+    cl.flush()  # explicit durability barrier: 0..3 are now on disk
+    for i in range(4, 7):
+        cl.write(CommitLogEntry(b"s", T0 + i * NANOS, float(i), Unit.SECOND))
+    if mode == "interval":
+        # give the write-behind writer a chance to dequeue (NOT to fsync:
+        # the flush interval is 1s and we kill well before it)
+        time.sleep(0.05)
+    cl._crash()  # SIGKILL stand-in: queue + python file buffer die
+    got = [e.value for e in CommitLog.replay(str(tmp_path / "wal"))]
+    assert got[:4] == [0.0, 1.0, 2.0, 3.0]  # pre-barrier: never lost
+    if mode == "every":
+        assert got == [float(i) for i in range(7)]  # zero acked loss
+    elif mode == "none":
+        assert got == [0.0, 1.0, 2.0, 3.0]  # post-barrier all lost
+    else:
+        assert 4 <= len(got) <= 7  # bounded by the flush interval
+
+
+# --- crash points ---
+
+
+def test_crash_point_arming(monkeypatch):
+    calls = []
+    monkeypatch.setattr(faults, "_exit", lambda code: calls.append(code))
+    monkeypatch.delenv(CRASH_POINT_ENV, raising=False)
+    faults.crash_point("fileset:pre-checkpoint")
+    assert calls == []  # unarmed: free
+    monkeypatch.setenv(
+        CRASH_POINT_ENV, "fileset:pre-checkpoint, commitlog:mid-rotation"
+    )
+    faults.crash_point("snapshot:pre-cleanup")
+    assert calls == []  # armed, but a different site
+    faults.crash_point("fileset:pre-checkpoint")
+    faults.crash_point("commitlog:mid-rotation")
+    assert calls == [faults.CRASH_EXIT_CODE] * 2
+
+
+class _FakeCrash(BaseException):
+    """Stands in for os._exit: nothing may catch it on the way out."""
+
+
+def test_crash_at_pre_checkpoint_leaves_incomplete_volume(tmp_path, monkeypatch):
+    """Killed between digest and checkpoint, the volume is torn exactly as
+    the protocol promises: data+digest durable, checkpoint absent — so the
+    volume is invisible to listings and a fresh bootstrap."""
+    from m3_tpu.storage.fs import list_filesets
+
+    def _boom(code):
+        raise _FakeCrash(code)
+
+    monkeypatch.setattr(faults, "_exit", _boom)
+    monkeypatch.setenv(CRASH_POINT_ENV, "fileset:pre-checkpoint")
+    db = _mkdb(tmp_path, commitlog_enabled=False)
+    for i in range(10):
+        db.write("t", b"s", T0 + i * NANOS, float(i))
+    with pytest.raises(_FakeCrash):
+        db.flush("t", T0 + 10 * BSZ)
+    monkeypatch.delenv(CRASH_POINT_ENV)
+    files = glob.glob(os.path.join(str(tmp_path), "**", "fileset-*.db"),
+                      recursive=True)
+    roles = {os.path.basename(p).rsplit("-", 1)[1] for p in files}
+    assert "data.db" in roles and "digest.db" in roles
+    assert "checkpoint.db" not in roles
+    fids = list_filesets(str(tmp_path), "t", 0) + list_filesets(
+        str(tmp_path), "t", 1
+    )
+    assert fids == []  # incomplete volume: invisible to listings
+    db.close()
+    # a fresh bootstrap on the torn dir comes up clean (no half volume)
+    db2 = Database(str(tmp_path), num_shards=2)
+    db2.create_namespace(
+        "t", NamespaceOptions(retention_nanos=48 * HOUR, block_size_nanos=BSZ)
+    )
+    db2.bootstrap()
+    assert db2.read("t", b"s", T0, T0 + BSZ) == []
+    db2.close()
+
+
+# --- PR 16 regression: device-ingest writes are WAL-covered ---
+
+
+def test_device_ingest_writes_survive_hard_kill(tmp_path):
+    """Every acked write through the device-ingest path (spill lanes AND
+    dirty-tail rows included) must replay from the WAL bit-identically
+    after a hard kill: Database.bootstrap() on a copy of the data dir."""
+    from m3_tpu.ingest import IngestOptions
+
+    db = _mkdb(
+        tmp_path / "live",
+        ingest_options=IngestOptions(lanes=4, slots=8, sync_batch=4),
+    )
+    entries = []
+    for s in range(12):  # 12 series > 4 lanes: forces spill lanes
+        sid = f"series-{s}".encode()
+        for i in range(12):  # 12 points > 8 slots: forces dirty tails
+            entries.append((sid, T0 + (i * 7 + s) * NANOS, float(s * 100 + i)))
+    db.write_batch("t", entries[: len(entries) // 2])
+    for sid, t, v in entries[len(entries) // 2 :]:
+        db.write("t", sid, t, v)
+    db.flush_wals()  # durability barrier: every write above is acked
+    expected = {
+        f"series-{s}".encode(): db.read(
+            "t", f"series-{s}".encode(), T0, T0 + BSZ
+        )
+        for s in range(12)
+    }
+    assert all(len(v) == 12 for v in expected.values())
+    for cl in db._commitlogs.values():
+        cl._crash()  # hard kill: buffers, queues, device planes all die
+    shutil.copytree(str(tmp_path / "live"), str(tmp_path / "copy"))
+
+    db2 = Database(str(tmp_path / "copy"), num_shards=2)
+    db2.create_namespace(
+        "t", NamespaceOptions(retention_nanos=48 * HOUR, block_size_nanos=BSZ)
+    )
+    db2.bootstrap()
+    for sid, want in expected.items():
+        assert db2.read("t", sid, T0, T0 + BSZ) == want, sid
+    db2.close()
+
+
+# --- heavy multi-process variants (tools/check_crash.py is the full gate) ---
+
+
+@pytest.mark.slow
+def test_cluster_node_dies_at_crash_point_and_recovers(tmp_path):
+    """Arm a crash point on one replica, drive it there via a flush RPC,
+    watch it die with CRASH_EXIT_CODE, restart it on the same data dir and
+    assert every replication-acked write reads back."""
+    from m3_tpu.index.query import term as term_q
+    from m3_tpu.testing.faults import env_with_crash_point
+    from m3_tpu.testing.proc_cluster import ProcCluster
+
+    cluster = ProcCluster(
+        num_nodes=3, num_shards=4, replica_factor=3, base_dir=str(tmp_path),
+        extra_args=["--commitlog-sync", "every"],
+    )
+    try:
+        session = cluster.session()
+        for i in range(8):
+            session.write_tagged(
+                ((b"host", f"h{i}".encode()), (b"name", b"reqs")),
+                T0 + NANOS, float(i),
+            )
+        cluster.node_env["node2"] = env_with_crash_point("fileset:data-written")
+        cluster.restart("node2")
+        session = cluster.session()
+        for i in range(8, 12):
+            session.write_tagged(
+                ((b"host", f"h{i}".encode()), (b"name", b"reqs")),
+                T0 + NANOS, float(i),
+            )
+        with pytest.raises(Exception):
+            cluster.nodes["node2"].client.flush("default", T0 + 24 * HOUR)
+        cluster.nodes["node2"].proc.wait(timeout=20)
+        assert cluster.nodes["node2"].proc.returncode == faults.CRASH_EXIT_CODE
+        cluster.node_env.pop("node2")
+        cluster.restart("node2")
+        res = cluster.nodes["node2"].client.fetch_tagged(
+            "default", term_q(b"name", b"reqs"), T0, T0 + HOUR
+        )
+        assert sum(len(d) for _, _, d in res) == 12
+    finally:
+        cluster.close()
+
+
+@pytest.mark.slow
+def test_cluster_planted_corruption_quarantines_and_peer_repairs(tmp_path):
+    """Plant a bit flip in one replica's sealed data file: scrub must
+    quarantine the volume (visible in its exposition), repair must
+    re-converge it from peers, and clients never see an error."""
+    from m3_tpu.index.query import term as term_q
+    from m3_tpu.testing.proc_cluster import ProcCluster
+
+    cluster = ProcCluster(
+        num_nodes=3, num_shards=4, replica_factor=3, base_dir=str(tmp_path),
+        extra_args=["--commitlog-sync", "every"],
+    )
+    try:
+        session = cluster.session()
+        for i in range(8):
+            session.write_tagged(
+                ((b"host", f"h{i}".encode()), (b"name", b"cpu")),
+                T0 + NANOS, float(i),
+            )
+        node2 = cluster.nodes["node2"].client
+        assert node2.flush("default", T0 + 24 * HOUR)
+        data = glob.glob(
+            os.path.join(str(tmp_path), "node2", "**", "*-data.db"),
+            recursive=True,
+        )
+        assert data
+        with open(data[0], "r+b") as f:
+            f.seek(8)
+            b = f.read(1)
+            f.seek(8)
+            f.write(bytes([b[0] ^ 1]))
+        res = node2.scrub()
+        assert res["quarantined"] >= 1
+        expo = node2.metrics()
+        assert "m3tpu_storage_corruption_total" in expo
+        peers = [
+            cluster.nodes[n].endpoint for n in ("node0", "node1")
+        ]
+        rep = node2.repair("default", peers)
+        assert rep["points_merged"] > 0 and not rep["peer_errors"]
+        got = node2.fetch_tagged(
+            "default", term_q(b"name", b"cpu"), T0, T0 + HOUR
+        )
+        assert sum(len(d) for _, _, d in got) == 8
+    finally:
+        cluster.close()

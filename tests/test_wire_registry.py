@@ -53,9 +53,12 @@ def test_untraced_ops_are_idempotent_reads(model):
 
 
 def test_retryable_etypes_are_defined_exception_classes(model):
+    from m3_tpu.storage import faults as storage_faults
+
     for name in wire.RETRYABLE_ETYPES:
         assert name in model.classes, f"{name} not defined anywhere"
-        cls = getattr(resilience, name, None) or getattr(raft, name, None)
+        cls = (getattr(resilience, name, None) or getattr(raft, name, None)
+               or getattr(storage_faults, name, None))
         assert cls is not None and issubclass(cls, Exception), name
 
 

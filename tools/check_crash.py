@@ -1,0 +1,327 @@
+#!/usr/bin/env python3
+"""check_crash: the crash-anywhere recovery gate.
+
+Boots a seeded RF=3 proc cluster (--commitlog-sync every) and proves the
+storage plane survives the two failure modes the fault seams exist for:
+
+1. THE KILLED NODE — for every armed crash point (fileset:data-written,
+   fileset:pre-checkpoint, commitlog:mid-rotation, snapshot:pre-cleanup)
+   one replica is restarted with the point armed, driven across it by the
+   operator RPC that crosses the site (flush / snapshot) while a live
+   MAJORITY writer runs, and must die hard (os._exit) AT the site. While
+   it is down, MAJORITY writes keep acking and an UNSTRICT_MAJORITY read
+   serves every acked write bit-identically off the surviving replicas.
+   After a restart on the same data dir, a MAJORITY read is bit-identical
+   to the acked corpus: zero loss of replication-acked data.
+
+2. THE BAD DISK — after sealing filesets everywhere, a bit-flipped data
+   file and a torn checkpoint are planted on the victim. Scrub must
+   quarantine the corrupt volume (m3tpu_storage_corruption_total > 0 in
+   its exposition), the torn-checkpoint volume must drop out of the
+   served set (a fileset exists iff its checkpoint is valid), degraded
+   reads must stay clean off the peers, and peer repair must re-converge
+   the victim until its direct reads are bit-identical to the control
+   replicas.
+
+Every process must serve a parseable exposition at the end.
+
+Usage:  python tools/check_crash.py [--json]
+Exit 0 on PASS, 1 on any FAIL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+NANOS = 1_000_000_000
+HOUR = 3600 * NANOS
+BLOCK = 2 * HOUR  # ProcCluster default block size
+T0 = 1_600_000_000 * NANOS
+NS = "default"
+VICTIM = "node2"
+
+
+class LiveWriter(threading.Thread):
+    """Background MAJORITY writer: any write that returns without raising
+    is replication-acked and may not be lost by anything this gate does
+    to a single replica."""
+
+    def __init__(self, session, tags, t_base: int) -> None:
+        super().__init__(name="live-writer", daemon=True)
+        self.session = session
+        self.tags = tags
+        self.t_base = t_base
+        self.acked: list[tuple[int, float]] = []
+        self.errors: list[str] = []
+        self.lock = threading.Lock()
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        i = 0
+        while not self._halt.is_set():
+            t = self.t_base + i * NANOS
+            v = float(i) + 0.2718281828  # non-round: == is a bit check
+            try:
+                self.session.write_tagged(self.tags, t, v)
+            except Exception as e:  # noqa: BLE001 - reported by the verdict
+                self.errors.append(f"write[{i}]: {e!r}")
+            else:
+                with self.lock:
+                    self.acked.append((t, v))
+            i += 1
+            time.sleep(0.02)
+
+    def snapshot(self) -> list[tuple[int, float]]:
+        with self.lock:
+            return list(self.acked)
+
+    def stop(self) -> list[tuple[int, float]]:
+        self._halt.set()
+        self.join(timeout=30)
+        return self.snapshot()
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable summary line at the end")
+    args = ap.parse_args()
+
+    from m3_tpu.cluster.topology import ConsistencyLevel
+    from m3_tpu.index.query import term as term_q
+    from m3_tpu.storage import faults
+    from m3_tpu.testing.faults import env_with_crash_point
+    from m3_tpu.testing.proc_cluster import ProcCluster
+    from tools.check_metrics import _SAMPLE_RE
+
+    failures: list[str] = []
+    summary: dict = {}
+
+    def check(ok: bool, what: str) -> None:
+        print(("PASS " if ok else "FAIL ") + what, flush=True)
+        if not ok:
+            failures.append(what)
+
+    def exposition_errors(text: str) -> list[str]:
+        errs = []
+        for i, line in enumerate(text.splitlines(), 1):
+            if not line or line.startswith("#"):
+                continue
+            if _SAMPLE_RE.match(line) is None:
+                errs.append(f"line {i}: {line!r}")
+        return errs
+
+    def counter_total(expo: str, family: str) -> float:
+        total = 0.0
+        for line in expo.splitlines():
+            if line.startswith(family + "{") or line.startswith(family + " "):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    base_dir = tempfile.mkdtemp(prefix="m3tpu-check-crash-")
+    cluster = None
+    # (host_tag, timestamp) -> value: every replication-acked write of the
+    # whole gate; the convergence verdicts compare against this corpus
+    expected: dict[tuple[bytes, int], float] = {}
+
+    def fetched_points(rows) -> dict[tuple[bytes, int], float]:
+        out = {}
+        for _, tags, dps in rows:
+            host = dict((bytes(n), bytes(v)) for n, v in tags)[b"host"]
+            for dp in dps:
+                out[(host, dp.timestamp)] = dp.value
+        return out
+
+    try:
+        cluster = ProcCluster(
+            num_nodes=3, num_shards=4, replica_factor=3, base_dir=base_dir,
+            extra_args=["--commitlog-sync", "every"],
+        )
+        print(f"READY 3 dbnodes, 4 shards, rf=3, commitlog-sync=every "
+              f"({base_dir})", flush=True)
+
+        def trigger(client, site: str) -> None:
+            # the operator RPC whose storage path crosses the armed site
+            if site.startswith("snapshot:"):
+                client.snapshot(NS)
+            else:
+                client.flush(NS, T0 + 24 * HOUR)
+
+        # --- act 1: die AT every crash point, lose nothing acked ---
+        for phase, site in enumerate(faults.CRASH_POINTS):
+            host_tag = f"phase{phase}".encode()
+            tags = ((b"host", host_tag), (b"name", b"crashgate"))
+            t_base = T0 + phase * BLOCK  # one block per phase: flushing an
+            # earlier phase's block never collides with this phase's writes
+            session = cluster.session()
+            for i in range(6):
+                t, v = t_base + i * NANOS, phase * 1000 + i + 0.5772156649
+                session.write_tagged(tags, t, v)
+                expected[(host_tag, t)] = v
+
+            cluster.node_env[VICTIM] = env_with_crash_point(site)
+            cluster.restart(VICTIM)
+            wsession = cluster.session(
+                read_cl=ConsistencyLevel.UNSTRICT_MAJORITY)
+            writer = LiveWriter(wsession, tags, t_base + HOUR)
+            writer.start()
+            time.sleep(0.4)  # live acked traffic before the kill
+            pre_kill = writer.snapshot()
+
+            node = cluster.nodes[VICTIM]
+            died_in_call = False
+            try:
+                trigger(node.client, site)
+            except Exception:
+                died_in_call = True
+            check(died_in_call,
+                  f"{site}: the trigger RPC died mid-call (armed point fired)")
+            if died_in_call:
+                node.proc.wait(timeout=30)
+            check(node.proc.returncode == faults.CRASH_EXIT_CODE,
+                  f"{site}: {VICTIM} hard-exited AT the armed point "
+                  f"(exit {node.proc.returncode})")
+
+            time.sleep(0.5)  # live acked traffic with the replica dead
+            down_acked = writer.snapshot()
+            check(len(down_acked) > len(pre_kill),
+                  f"{site}: MAJORITY writes kept acking with the replica "
+                  f"dead (+{len(down_acked) - len(pre_kill)})")
+
+            got = {dp.timestamp: dp.value
+                   for _, _, dps in wsession.fetch_tagged(
+                       term_q(b"host", host_tag), t_base, t_base + BLOCK)
+                   for dp in dps}
+            missing = [(t, v) for t, v in down_acked if got.get(t) != v]
+            check(not missing,
+                  f"{site}: UNSTRICT_MAJORITY read served all "
+                  f"{len(down_acked)} acked writes bit-identically off the "
+                  f"survivors ({len(missing)} diverged)")
+
+            acked = writer.stop()
+            check(not writer.errors,
+                  f"{site}: zero client-visible write errors "
+                  f"({writer.errors[:3]})")
+            for t, v in acked:
+                expected[(host_tag, t)] = v
+
+            cluster.node_env.pop(VICTIM, None)
+            cluster.restart(VICTIM)
+            phase_want = {k: v for k, v in expected.items()
+                          if k[0] == host_tag}
+            got2 = fetched_points(cluster.session().fetch_tagged(
+                term_q(b"host", host_tag), t_base, t_base + BLOCK))
+            diff = [k for k, v in phase_want.items() if got2.get(k) != v]
+            check(not diff,
+                  f"{site}: post-restart MAJORITY read is bit-identical to "
+                  f"the acked corpus ({len(phase_want)} points, "
+                  f"{len(diff)} diverged)")
+        summary["crash_points"] = len(faults.CRASH_POINTS)
+        summary["acked_writes"] = len(expected)
+
+        # --- act 2: the bad disk — scrub, quarantine, peer repair ---
+        print("ACT  seal filesets everywhere, plant corruption on "
+              + VICTIM, flush=True)
+        for nid in ("node0", "node1", VICTIM):
+            cluster.nodes[nid].client.flush(NS, T0 + 24 * HOUR)
+        data = sorted(glob.glob(
+            os.path.join(base_dir, VICTIM, "**", "*-data.db"),
+            recursive=True))
+        check(bool(data), f"sealed data files exist on {VICTIM} "
+              f"({len(data)} volumes)")
+        with open(data[0], "r+b") as f:
+            f.seek(8)
+            b = f.read(1)
+            f.seek(8)
+            f.write(bytes([b[0] ^ 0x10]))
+        prefix = data[0][: -len("data.db")]
+        cps = [p for p in sorted(glob.glob(
+            os.path.join(base_dir, VICTIM, "**", "*-checkpoint.db"),
+            recursive=True)) if not p.startswith(prefix)]
+        check(bool(cps),
+              "a second sealed fileset exists for the torn checkpoint")
+        if cps:
+            with open(cps[0], "r+b") as f:
+                f.truncate(3)
+
+        node2 = cluster.nodes[VICTIM].client
+        res = node2.scrub()
+        check(res["quarantined"] >= 1,
+              f"scrub quarantined the bit-flipped volume ({res})")
+        qfiles = glob.glob(
+            os.path.join(base_dir, VICTIM, "quarantine", "**", "*-data.db"),
+            recursive=True)
+        check(bool(qfiles),
+              f"the corrupt volume moved to the quarantine dir "
+              f"({len(qfiles)} files)")
+        expo = node2.metrics()
+        corr = counter_total(expo, "m3tpu_storage_corruption_total")
+        check(corr > 0,
+              f"m3tpu_storage_corruption_total > 0 on the victim ({corr})")
+        check("m3tpu_storage_quarantined_volumes" in expo,
+              "the quarantine gauge rides the victim's exposition")
+        summary["quarantined"] = res["quarantined"]
+        summary["corruption_total"] = corr
+
+        # degraded reads stay clean while the victim has holes
+        rsession = cluster.session(
+            read_cl=ConsistencyLevel.UNSTRICT_MAJORITY)
+        got = fetched_points(rsession.fetch_tagged(
+            term_q(b"name", b"crashgate"), T0, T0 + 24 * HOUR))
+        diff = [k for k, v in expected.items() if got.get(k) != v]
+        check(not diff,
+              f"pre-repair UNSTRICT_MAJORITY reads serve the full acked "
+              f"corpus off the peers ({len(expected)} points, "
+              f"{len(diff)} diverged)")
+
+        peers = [cluster.nodes[n].endpoint for n in ("node0", "node1")]
+        rep = node2.repair(NS, peers)
+        check(rep["points_merged"] > 0,
+              f"peer repair re-streamed the lost volumes ({rep})")
+        check(not rep["peer_errors"],
+              f"peer repair saw no peer errors ({rep.get('peer_errors')})")
+        summary["points_merged"] = rep["points_merged"]
+
+        # convergence: every replica now serves the acked corpus
+        # bit-identically from a DIRECT (single-node) read
+        for nid in ("node0", "node1", VICTIM):
+            gotn = fetched_points(cluster.nodes[nid].client.fetch_tagged(
+                NS, term_q(b"name", b"crashgate"), T0, T0 + 24 * HOUR))
+            diff = [k for k, v in expected.items() if gotn.get(k) != v]
+            check(not diff,
+                  f"{nid} direct read is bit-identical to the control "
+                  f"corpus ({len(expected)} points, {len(diff)} diverged)")
+
+        # every process still serves a parseable exposition
+        for nid in ("node0", "node1", VICTIM):
+            text = cluster.nodes[nid].client.metrics()
+            errs = exposition_errors(text)
+            check(not errs and "m3tpu_" in text,
+                  f"{nid} serves a parseable exposition ({errs[:2]})")
+    finally:
+        if cluster is not None:
+            cluster.close()
+
+    ok = not failures
+    summary["failures"] = failures
+    print(("OK check_crash: every crash point survived, the bad disk was "
+           "quarantined and repaired") if ok
+          else f"FAILED check_crash: {len(failures)} checks failed",
+          flush=True)
+    if args.json:
+        print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

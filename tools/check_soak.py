@@ -368,6 +368,21 @@ def main() -> int:
             finally:
                 reader.close()
 
+        def act_node_crash():
+            # a dbnode SIGKILL + rejoin mid-diurnal (node2: node1 carries
+            # the straggler plan and node0 is drained later): RF=3
+            # MAJORITY rides through the dead replica, the restart
+            # bootstraps from its WAL/filesets, and the SLO plane is the
+            # verdict — zero hard client errors and intact budgets below
+            node = cluster.nodes["node2"]
+            node.proc.kill()
+            node.proc.wait(timeout=10)
+            print("ACT  dbnode node2 SIGKILLed", flush=True)
+            time.sleep(4.0)  # several eval ticks with the replica dead
+            cluster.restart("node2")
+            owned = cluster.nodes["node2"].client.owned_shards(cache_secs=0.0)
+            return {"rejoined_shards": len(owned)}
+
         acts = [
             Act("diurnal", 0.0, act_diurnal),
             Act("storm", 5.0, act_storm),
@@ -375,6 +390,7 @@ def main() -> int:
             Act("outage", 2.0, act_outage),
             Act("backfill", 2.0, act_backfill),
             Act("agg-traffic", 0.0, act_agg_traffic),
+            Act("node-crash", 6.0, act_node_crash),
         ]
         for a in acts:
             a.start()
@@ -529,6 +545,14 @@ def main() -> int:
         )
         check(bool(rec.get("data", {}).get("result")),
               "slo:fleet_availability:ratio_rate45s recorded in _m3tpu")
+
+        # the node-crash act: the SIGKILLed replica rejoined, serves its
+        # shards again, and (checked above) no act saw a hard client
+        # error while it was down — the fleet absorbed the dead node
+        crash_act = next(a for a in acts if a.act_name == "node-crash")
+        check((crash_act.result or {}).get("rejoined_shards", 0) >= 1,
+              f"SIGKILLed dbnode rejoined and serves its shards "
+              f"({crash_act.result})")
 
         # the outage act: every injected request was a served-and-failed
         # 400 (never shed — parse precedes admission), the fast-burn

@@ -23,6 +23,12 @@
   never wedge interpreter exit — the PR 4 fan-out rule).
 - M3L007 swallowed-exception — no bare `except:`; an
   `except Exception:` body that is only `pass` must count or log.
+- M3L008 durable-write-discipline — storage/ code never opens a file
+  for writing with bare ``open()`` (all durable bytes go through the
+  storage.faults DiskIO seam: write-temp → fsync → rename, and fault
+  injection reaches them), and within a function the checkpoint file is
+  written LAST (the checkpoint commits the volume; anything written
+  after it is outside the atomic-commit protocol).
 """
 
 from __future__ import annotations
@@ -416,9 +422,12 @@ class MetricNameDiscipline(Checker):
     # Deliberately ABSENT: "frame"/"stack" — profile stacks are
     # unbounded runtime data and live in the profiling table
     # (m3_tpu/profiling/), never in metric labels.
+    # "file": fileset file roles ("data", "digest", "checkpoint", ...) —
+    # bounded by fs.SUFFIXES; the m3tpu_storage_corruption_total family
+    # keys on it so a scrub alert names WHICH file of a volume rotted.
     LABEL_KEYS = {"component", "op", "peer", "to", "kernel", "kind", "stage",
                   "ns", "group", "tenant", "scope", "shard", "reason",
-                  "objective", "window"}
+                  "objective", "window", "file"}
 
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
@@ -579,3 +588,90 @@ class SwallowedException(Checker):
         if isinstance(type_node, ast.Tuple):
             return any(self._is_broad(e) for e in type_node.elts)
         return _terminal_name(type_node) in self.BROAD
+
+
+# ---------------------------------------------------------------- M3L008
+
+
+@register
+class DurableWriteDiscipline(Checker):
+    code = "M3L008"
+    name = "durable-write-discipline"
+
+    SCOPED_DIRS = ("m3_tpu/storage/",)
+    # the seam itself is the one place allowed to touch files directly
+    EXCLUDED = ("m3_tpu/storage/faults.py",)
+    # the shared write-temp → fsync → rename primitives (storage/faults
+    # DiskIO.write_durable; utils/blob wraps it with framing)
+    DURABLE_CALLS = {"write_durable", "write_atomic_checked_blob"}
+    WRITE_MODES = frozenset("wax+")
+
+    def check_file(self, ctx: FileContext):
+        if not ctx.rel.startswith(self.SCOPED_DIRS):
+            return
+        if ctx.rel in self.EXCLUDED:
+            return
+        yield from self._check_bare_open(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_checkpoint_order(ctx, node)
+
+    def _check_bare_open(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # builtin open() only — os.open(devnull) and DISK.open are
+            # Attribute calls and stay out of scope
+            if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+                continue
+            mode = next(
+                (kw.value for kw in node.keywords if kw.arg == "mode"),
+                node.args[1] if len(node.args) > 1 else None,
+            )
+            if mode is None:
+                continue  # default "r"
+            if (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and not (set(mode.value) & self.WRITE_MODES)
+            ):
+                continue  # read-only literal mode
+            yield self.finding(
+                ctx,
+                node.lineno,
+                "bare open() for writing in storage/ — durable bytes go "
+                "through the storage.faults DiskIO seam (DISK.open / "
+                "DISK.write_durable: write-temp → fsync → rename, fault "
+                "injection included)",
+            )
+
+    def _check_checkpoint_order(self, ctx, fn):
+        writes = []  # (lineno, is_checkpoint)
+        for node in _walk_skip_defs(fn.body):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_name(node.func) not in self.DURABLE_CALLS:
+                continue
+            is_ckpt = any(
+                isinstance(sub, ast.Constant)
+                and isinstance(sub.value, str)
+                and "checkpoint" in sub.value
+                for arg in node.args + [kw.value for kw in node.keywords]
+                for sub in ast.walk(arg)
+            )
+            writes.append((node.lineno, is_ckpt))
+        writes.sort()
+        ckpt_line = next((ln for ln, c in writes if c), None)
+        if ckpt_line is None:
+            return
+        for ln, is_ckpt in writes:
+            if ln > ckpt_line and not is_ckpt:
+                yield self.finding(
+                    ctx,
+                    ln,
+                    "durable write after the checkpoint write in the same "
+                    "function — the checkpoint commits the volume and must "
+                    "be written LAST (fs.py atomic-commit protocol; a crash "
+                    "between checkpoint and this write leaves a 'complete' "
+                    "volume missing data)",
+                )
