@@ -112,6 +112,7 @@ def bitmap_to_docids(words: np.ndarray) -> np.ndarray:
     """Packed uint32 doc bitmap -> ascending int32 doc ids (host side).
     Bit j of word w is doc ``32*w + j``; on a little-endian host the
     byte view + little bit order reads exactly that sequence."""
+    # m3lint: disable=M3L010 -- input bitmap is already host-side (Planner._execute reads back once before calling this); host unpackbits is the point of this helper
     words = np.ascontiguousarray(np.asarray(words, np.uint32))
     bits = np.unpackbits(words.view(np.uint8), bitorder="little")
     return np.flatnonzero(bits).astype(np.int32)

@@ -137,17 +137,19 @@ def streamed_scan_totals(segments: list, k: int = CHUNK_K):
     return _slice_series(aggs, s)
 
 
+_SERIES_FIELDS = (
+    "series_sum", "series_count", "series_min", "series_max",
+    "series_last", "series_err",
+)
+
+
 def _slice_series(aggs, s: int):
-    return aggs._replace(
-        series_sum=np.asarray(aggs.series_sum)[:s],
-        series_count=np.asarray(aggs.series_count)[:s],
-        series_min=np.asarray(aggs.series_min)[:s],
-        series_max=np.asarray(aggs.series_max)[:s],
-        series_last=np.asarray(aggs.series_last)[:s],
-        series_err=(
-            np.asarray(aggs.series_err)[:s] if aggs.series_err is not None else None
-        ),
-    )
+    out = {}
+    for name in _SERIES_FIELDS:
+        v = getattr(aggs, name)
+        # m3lint: disable=M3L010 -- sanctioned end-of-scan host finalize: the one device->host copy after the fused dispatch (device_out=True is the zero-copy pipelining escape)
+        out[name] = np.asarray(v)[:s] if v is not None else None
+    return aggs._replace(**out)
 
 
 def resident_fetch_arrays(pool, keys: list):

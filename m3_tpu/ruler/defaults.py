@@ -1,0 +1,132 @@
+"""Built-in default rule groups: fleet invariants every deployment wants.
+
+The storage durability group watches
+``m3tpu_storage_corruption_total`` — the counter every corruption
+detection path feeds (verify-on-read, the background scrubber, repair) —
+through the same selfmon -> ruler path as user rules: the counter is
+self-scraped into ``_m3tpu`` storage, the recordings below derive
+colon-form burn-rate series from it, and the alerts read ONLY the
+recordings. The target rate for corruption is zero, so "burn" is any
+positive rate; the multi-window AND still buys the usual shape — the
+short window gives reaction time and resolves the alert once detection
+stops, the long window keeps the slow tier open while the incident is
+triaged.
+
+Groups are compiled from plain dicts through
+:func:`~m3_tpu.ruler.rules.groups_from_spec`, so they get exactly the
+load-time validation (PromQL parse, colon-name enforcement, interval
+floor) a rules file would. :func:`default_rule_spec` exposes the dict
+form for tooling; :func:`default_groups` the validated RuleGroups the
+coordinator merges in (file groups win on name collision —
+``--no-default-rules`` opts out entirely).
+"""
+
+from __future__ import annotations
+
+from ..selfmon.guard import RESERVED_NS
+
+#: reserved like SLO_GROUP: a rules file must not redefine it silently —
+#: the coordinator skips the default when a file group takes the name
+DURABILITY_GROUP = "storage_durability_default"
+
+# (window token, recorded name) pairs — fast tier (5m/1h) pages, slow
+# tier (6h/3d) tickets, mirroring slo.spec's default burn windows
+_WINDOWS = ("5m", "1h", "6h", "3d")
+
+
+def corruption_record_name(window: str) -> str:
+    return f"storage:corruption:rate{window}"
+
+
+def _corruption_expr(window: str) -> str:
+    # or vector(0): a fleet with zero corruption must still record 0 —
+    # the alert conditions below read the recording, and a no-data
+    # recording would leave lookback resurrecting the last sample
+    return (
+        f"sum(rate(m3tpu_storage_corruption_total[{window}])) or vector(0)"
+    )
+
+
+def _burn_alert(name: str, short: str, long_: str, severity: str) -> dict:
+    return {
+        "alert": name,
+        # multi-window AND over the recordings: corruption's error budget
+        # is zero, so any positive detection rate is over-budget burn
+        "expr": (
+            f"({corruption_record_name(short)} > 0)"
+            f" and ({corruption_record_name(long_)} > 0)"
+        ),
+        "for": 0,
+        "labels": {
+            "objective": "storage_durability",
+            "severity": severity,
+            "window": f"{short}/{long_}",
+            "service": "dbnode",
+        },
+        "annotations": {
+            "summary": (
+                "storage corruption detected: "
+                f"{{{{ $value }}}} corrupt files/sec over {short} "
+                f"(sustained over {long_})"
+            ),
+        },
+    }
+
+
+def default_rule_spec(interval_secs: float = 30.0) -> dict:
+    """The default groups as a rules-file-shaped dict (the
+    ``groups_from_spec`` input schema, so it round-trips through the KV
+    ruleset mirror like any file-sourced group)."""
+    rules = [
+        {
+            "record": corruption_record_name(w),
+            "expr": _corruption_expr(w),
+            "labels": {"objective": "storage_durability"},
+        }
+        for w in _WINDOWS
+    ]
+    rules.append(
+        _burn_alert("StorageDurabilityFastBurn", "5m", "1h", "page")
+    )
+    rules.append(
+        _burn_alert("StorageDurabilitySlowBurn", "6h", "3d", "ticket")
+    )
+    return {
+        "groups": [
+            {
+                "name": DURABILITY_GROUP,
+                "interval": interval_secs,
+                "namespace": RESERVED_NS,
+                "rules": rules,
+            }
+        ]
+    }
+
+
+def default_groups(interval_secs: float = 30.0) -> list:
+    """The validated default RuleGroups (same loader as rule files)."""
+    from .rules import groups_from_spec
+
+    return groups_from_spec(default_rule_spec(interval_secs), RESERVED_NS)
+
+
+def default_durability_slo_spec() -> dict:
+    """A matching SLO-spec fragment (``slo.spec.spec_from_dict`` schema):
+    the probe-driven durability objective whose compiled rules complement
+    the passive corruption-counter group above — spot-check reads prove
+    bytes come back bit-identical, the counter group catches what the
+    scrubber finds between probes. Merge into an ``--slo-config`` file or
+    compile standalone."""
+    return {
+        "slos": [
+            {
+                "name": "storage_durability",
+                "sli": "durability",
+                "objective": 0.9999,
+                "window": "1h",
+                "service": "dbnode",
+            }
+        ],
+        "eval_interval": 30,
+        "probe_interval": 30,
+    }

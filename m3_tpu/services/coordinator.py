@@ -165,6 +165,7 @@ class Coordinator:
         webhooks=(),
         instance: str = "coordinator0",
         jitter: bool = True,
+        default_rules: bool = True,
     ):
         """Start the rule engine: groups from ``rules_path`` (YAML/JSON)
         are validated, mirrored into the shared KV ruleset (all
@@ -173,7 +174,13 @@ class Coordinator:
         per-namespace engine cache the HTTP query surface uses — so
         ``namespace: _m3tpu`` rules watch the fleet's own stored
         telemetry. ``webhooks``: notifier URLs (each gets the resilience
-        plane's retry policy); a log notifier is always attached."""
+        plane's retry policy); a log notifier is always attached.
+
+        ``default_rules`` merges in the built-in groups
+        (ruler/defaults.py — the storage durability burn-rate group over
+        ``m3tpu_storage_corruption_total``); a file group reusing a
+        default group's name wins, so a deployment can override the
+        defaults rule-for-rule or drop them with ``--no-default-rules``."""
         from ..ruler import Ruler, WebhookNotifier, groups_to_spec
 
         self.ruler = Ruler(
@@ -186,10 +193,20 @@ class Coordinator:
             ensure_namespace=lambda ns: self._ensure_selfmon_namespace(),
             jitter=jitter,
         )
+        groups = []
         if rules_path:
             from ..ruler import load_rules_file
 
-            self._ruler_groups = load_rules_file(rules_path, self.namespace)
+            groups = load_rules_file(rules_path, self.namespace)
+        if default_rules:
+            from ..ruler.defaults import default_groups
+
+            named = {g.name for g in groups}
+            groups = groups + [
+                g for g in default_groups() if g.name not in named
+            ]
+        if groups:
+            self._ruler_groups = groups
             self.ruler.publish(groups_to_spec(self._ruler_groups))
         self.ruler.start()
         return self.ruler
@@ -1414,6 +1431,14 @@ def main(argv=None) -> int:
         "retries under the resilience plane's budget",
     )
     p.add_argument(
+        "--no-default-rules",
+        action="store_true",
+        help="skip the built-in default rule groups (ruler/defaults.py: "
+        "the storage durability burn-rate group over "
+        "m3tpu_storage_corruption_total); a rules file reusing a default "
+        "group's name also overrides it without this flag",
+    )
+    p.add_argument(
         "--slo-config",
         default="",
         help="path to a YAML/JSON SLO spec (m3_tpu/slo/spec.py schema): "
@@ -1513,6 +1538,7 @@ def main(argv=None) -> int:
             rules_path=args.ruler_rules,
             webhooks=list(args.ruler_webhook),
             instance=args.instance_id,
+            default_rules=not args.no_default_rules,
         )
 
     if args.slo_config:

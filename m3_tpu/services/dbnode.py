@@ -126,6 +126,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="scrub read-rate bound in bytes/sec (0 = unpaced)",
     )
     p.add_argument(
+        "--scrub-iops",
+        type=int,
+        default=0,
+        help="scrub file-open rate bound in opens/sec (0 = unpaced); "
+        "paces alongside --scrub-bytes-per-sec — whichever budget is "
+        "further behind wins, so many tiny filesets can't dodge pacing",
+    )
+    p.add_argument(
+        "--quarantine-retention-secs",
+        type=float,
+        default=0.0,
+        help="prune quarantined fileset volumes older than this many "
+        "seconds at the end of each scrub pass (0 = keep forever); "
+        "prunes count m3tpu_storage_quarantine_pruned_total and drop "
+        "the quarantine gauge",
+    )
+    p.add_argument(
         "--selfmon-interval",
         type=float,
         default=0.0,
@@ -354,6 +371,8 @@ def main(argv=None) -> int:
             db,
             interval=args.scrub_interval,
             bytes_per_sec=args.scrub_bytes_per_sec,
+            iops=args.scrub_iops,
+            quarantine_retention_secs=args.quarantine_retention_secs,
             phase_key=args.node_id,
         )
         scrubber.start()

@@ -19,6 +19,7 @@ import errno
 import json
 import os
 import struct
+import time
 import zlib
 from dataclasses import dataclass
 
@@ -398,6 +399,59 @@ def list_quarantined(base: str, namespace: str, shard: int) -> list[str]:
         return sorted(os.listdir(d))
     except FileNotFoundError:
         return []
+
+
+_M_QUARANTINE_PRUNED = METRICS.counter(
+    "storage_quarantine_pruned_total",
+    "quarantined fileset volumes removed by retention GC",
+)
+
+
+def prune_quarantine(
+    base: str, retention_secs: float, now: float | None = None
+) -> int:
+    """Retention GC for ``base/quarantine/``: delete quarantined fileset
+    volumes whose NEWEST file is older than ``retention_secs`` (mtime is
+    stamped by the quarantine rename, so age = time since quarantine).
+    Whole volumes prune atomically — a volume with any fresh file is kept
+    intact so post-mortem evidence is never half-deleted. Decrements the
+    quarantine gauge and counts
+    ``storage_quarantine_pruned_total`` per volume. Returns the number of
+    volumes pruned; ``retention_secs <= 0`` means keep forever."""
+    global _quarantined_total
+    if retention_secs <= 0:
+        return 0
+    # m3lint: disable=M3L004 -- quarantine age is judged against file mtimes, which are wall-clock stamps; monotonic time has no relation to st_mtime
+    cutoff = (time.time() if now is None else now) - float(retention_secs)
+    pruned = 0
+    for dirpath, _dirnames, filenames in os.walk(
+        os.path.join(base, QUARANTINE_DIR)
+    ):
+        volumes: dict[tuple[str, str], list[str]] = {}
+        for name in filenames:
+            parts = name.split("-")
+            if len(parts) != 4 or parts[0] != "fileset":
+                continue
+            volumes.setdefault((parts[1], parts[2]), []).append(name)
+        for _vol, names in sorted(volumes.items()):
+            paths = [os.path.join(dirpath, n) for n in names]
+            try:
+                newest = max(os.path.getmtime(p) for p in paths)
+            except OSError:
+                continue  # pruned by a concurrent pass
+            if newest > cutoff:
+                continue
+            for p in paths:
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
+            pruned += 1
+    if pruned:
+        _M_QUARANTINE_PRUNED.inc(pruned)
+        _quarantined_total = max(0, _quarantined_total - pruned)
+        _QUARANTINE_GAUGE.set(_quarantined_total)
+    return pruned
 
 
 def list_fileset_volumes(base: str, namespace: str, shard: int) -> list[FilesetID]:

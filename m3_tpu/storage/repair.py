@@ -222,16 +222,24 @@ class Scrubber:
     unlucky query. Any mismatch quarantines the volume through the
     shard's invalidation seam and the repair plane re-replicates.
 
-    ``bytes_per_sec`` paces the pass: after each fileset the loop sleeps
-    until the pass's cumulative read rate falls back under budget (0 =
-    unpaced — tools and tests). ``run_once`` is the deterministic
-    synchronous entry point the daemon loop and tests share."""
+    ``bytes_per_sec`` and ``iops`` pace the pass: after each fileset the
+    loop sleeps until the pass's cumulative read rate AND file-open rate
+    both fall back under budget — whichever budget is further behind wins
+    (0 = that dimension unpaced — tools and tests). Verifying one fileset
+    opens every file role once, so opens are modeled as len(SUFFIXES) per
+    fileset. ``quarantine_retention_secs`` > 0 additionally runs
+    quarantine retention GC (fs.prune_quarantine) at the end of each
+    pass, bounding post-mortem disk held by quarantined volumes to one
+    retention window. ``run_once`` is the deterministic synchronous entry
+    point the daemon loop and tests share."""
 
     def __init__(
         self,
         db,
         interval: float = 300.0,
         bytes_per_sec: int = 32 << 20,
+        iops: int = 0,
+        quarantine_retention_secs: float = 0.0,
         phase_key: str = "scrubber",
         clock=time.monotonic,
         sleep=time.sleep,
@@ -239,6 +247,8 @@ class Scrubber:
         self.db = db
         self.interval = float(interval)
         self.bytes_per_sec = int(bytes_per_sec)
+        self.iops = int(iops)
+        self.quarantine_retention_secs = float(quarantine_retention_secs)
         self.phase_key = phase_key
         self._clock = clock
         self._sleep = sleep
@@ -250,7 +260,7 @@ class Scrubber:
     def run_once(self) -> dict:
         from . import fs as fsm
 
-        totals = {"scanned": 0, "quarantined": 0, "bytes": 0}
+        totals = {"scanned": 0, "quarantined": 0, "bytes": 0, "opens": 0, "pruned": 0}
         start = self._clock()
         for name in list(self.db.namespaces):
             namespace = self.db.namespaces.get(name)
@@ -275,12 +285,21 @@ class Scrubber:
                                 if problems:
                                     shard._quarantine_locked(fid, problems)
                                     totals["quarantined"] += 1
+                    totals["opens"] += len(fsm.SUFFIXES)
+                    elapsed = self._clock() - start
+                    ahead = 0.0
                     if self.bytes_per_sec > 0:
-                        ahead = totals["bytes"] / self.bytes_per_sec - (
-                            self._clock() - start
+                        ahead = totals["bytes"] / self.bytes_per_sec - elapsed
+                    if self.iops > 0:
+                        ahead = max(
+                            ahead, totals["opens"] / self.iops - elapsed
                         )
-                        if ahead > 0:
-                            self._sleep(ahead)
+                    if ahead > 0:
+                        self._sleep(ahead)
+        if self.quarantine_retention_secs > 0:
+            totals["pruned"] = fsm.prune_quarantine(
+                self.db.base, self.quarantine_retention_secs
+            )
         self.passes += 1
         self.quarantined += totals["quarantined"]
         _M_SCRUB_PASSES.inc()

@@ -708,3 +708,566 @@ def test_cli_json_smoke():
     payload = json.loads(out.stdout)
     assert payload["ok"] and payload["findings"] == []
     assert payload["files_scanned"] > 100
+
+
+# --- pass-1 project model (call graph, locks, jit surfaces) ---
+
+
+def build_model(files):
+    from tools.m3lint import FileContext
+    from tools.m3lint.model import ProjectModel
+
+    return ProjectModel(
+        [
+            FileContext(rel, textwrap.dedent(src))
+            for rel, src in files.items()
+        ]
+    )
+
+
+def test_model_wire_edge_resolution():
+    model = build_model({
+        "m3_tpu/net/client.py": """
+            class RpcClient:
+                def _call(self, op, **kw):
+                    pass
+
+                def sync(self):
+                    return self._call("sync")
+            """,
+        "m3_tpu/services/node.py": """
+            class NodeService:
+                def handle(self, req):
+                    pass
+
+                def op_sync(self, req):
+                    return 1
+            """,
+    })
+    fi = model.functions["m3_tpu/net/client.py::RpcClient.sync"]
+    call = next(c for c in fi.calls if c.wire_op == "sync")
+    targets = model.resolve(fi, call)
+    assert [t.qualname for t in targets] == [
+        "m3_tpu/services/node.py::NodeService.op_sync"
+    ]
+
+
+def test_model_method_resolution_through_bases():
+    model = build_model({
+        "m3_tpu/a.py": """
+            class Base:
+                def helper(self):
+                    pass
+
+            class Child(Base):
+                def go(self):
+                    self.helper()
+            """,
+    })
+    fi = model.functions["m3_tpu/a.py::Child.go"]
+    call = next(c for c in fi.calls if c.name == "helper")
+    assert [t.display for t in model.resolve(fi, call)] == ["Base.helper"]
+
+
+def test_model_generic_method_names_never_resolve_by_uniqueness():
+    # `f.write(...)` must not resolve to the one class defining write()
+    model = build_model({
+        "m3_tpu/a.py": """
+            class Sink:
+                def write(self, b):
+                    pass
+
+            def save(f):
+                f.write(b"x")
+            """,
+    })
+    fi = model.functions["m3_tpu/a.py::save"]
+    call = next(c for c in fi.calls if c.name == "write")
+    assert model.resolve(fi, call) == []
+
+
+def test_model_lock_summaries():
+    model = build_model({
+        "m3_tpu/p.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def admit(self, other):
+                    with self._lock:
+                        other.enter()
+            """,
+    })
+    fi = model.functions["m3_tpu/p.py::Pool.admit"]
+    assert [a.lock for a in fi.acquires] == ["Pool._lock"]
+    assert model.lock_kinds["Pool._lock"] == "Lock"
+    call = next(c for c in fi.calls if c.name == "enter")
+    # the call site knows which locks are held around it
+    assert [lock for lock, _line in call.locks_held] == ["Pool._lock"]
+
+
+def test_model_jit_surfaces():
+    model = build_model({
+        "m3_tpu/k.py": """
+            import functools
+
+            import jax
+
+            _MEMO = None
+
+            @functools.partial(
+                jax.jit, static_argnums=(1,), donate_argnums=(0,)
+            )
+            def fused(buf, n):
+                return buf
+
+            def get():
+                global _MEMO
+                if _MEMO is None:
+                    _MEMO = jax.jit(lambda x: x)
+                return _MEMO
+
+            def factory():
+                return jax.jit(lambda x: x)
+            """,
+    })
+    dec = next(s for s in model.jit_surfaces if s.kind == "decorated")
+    assert dec.name == "fused"
+    assert dec.static_argnums == (1,)
+    assert dec.donate_argnums == (0,)
+    memo = next(
+        s for s in model.jit_surfaces if s.kind == "call" and s.memoized
+    )
+    assert memo.in_function == "get"
+    ret = next(
+        s for s in model.jit_surfaces if s.kind == "call" and s.returned
+    )
+    assert ret.in_function == "factory"
+
+
+# --- M3L009 static-lock-order ---
+
+
+def test_static_lock_order_fires_on_ab_ba():
+    # the exact AB/BA shape tests/test_lockcheck.py witnesses at runtime,
+    # found here without executing anything
+    findings = lint(
+        """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def ab():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def ba():
+            with b_lock:
+                with a_lock:
+                    pass
+        """
+    )
+    assert codes(findings) == {"M3L009"} and len(findings) == 1
+    msg = findings[0].message
+    # BOTH witness chains are in the finding
+    assert "ab (" in msg and "ba (" in msg
+    assert "deadlock" in msg
+
+
+def test_static_lock_order_quiet_on_consistent_order():
+    findings = lint(
+        """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def one():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def two():
+            with a_lock:
+                with b_lock:
+                    pass
+        """
+    )
+    assert findings == []
+
+
+def test_static_lock_order_fires_across_call_chain():
+    # the inversion only exists through resolved call edges: A.outer
+    # holds A._lock and calls into B.enter (taking B._lock) while
+    # B.reverse holds B._lock and calls back into A.outer
+    findings = lint(
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self, other):
+                with self._lock:
+                    other.enter()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def enter(self):
+                with self._lock:
+                    pass
+
+            def reverse(self, a):
+                with self._lock:
+                    a.outer(self)
+        """
+    )
+    assert "M3L009" in codes(findings)
+    assert any("A._lock" in f.message and "B._lock" in f.message
+               for f in findings if f.code == "M3L009")
+
+
+# --- M3L010 host-sync-on-hot-path ---
+
+
+HOT_SYNC_SRC = """
+    import numpy as np
+
+    def resident_scan_totals(aggs):
+        return _finish(aggs)
+
+    def _finish(aggs):
+        return np.asarray(aggs)
+    """
+
+
+def test_host_sync_fires_with_reachability_chain():
+    findings = lint(HOT_SYNC_SRC, rel="m3_tpu/resident/scan.py")
+    assert codes(findings) == {"M3L010"} and len(findings) == 1
+    msg = findings[0].message
+    assert "np.asarray" in msg
+    # the finding names the chain from the hot entry to the sync site
+    assert "resident_scan_totals" in msg and "_finish" in msg
+
+
+def test_host_sync_quiet_off_hot_path():
+    # byte-identical code outside the hot-entry registry is fine
+    assert lint(HOT_SYNC_SRC, rel="m3_tpu/utils/export.py") == []
+
+
+def test_host_sync_quiet_on_host_literal_asarray():
+    findings = lint(
+        """
+        import numpy as np
+
+        def resident_scan_totals(ranges):
+            los = np.asarray([lo for lo, _ in ranges] or [0], np.int32)
+            return los
+        """,
+        rel="m3_tpu/resident/scan.py",
+    )
+    assert findings == []
+
+
+def test_host_sync_does_not_cross_wire_boundary():
+    # `_call("x")` edges are NOT followed: work past the RPC dispatch
+    # runs in the serving process, not on this caller's hot path
+    findings = lint(
+        """
+        def resident_scan_totals(client):
+            return client._call("scan_sync")
+        """,
+        rel="m3_tpu/resident/scan.py",
+        extra={
+            "m3_tpu/services/node.py": textwrap.dedent(
+                """
+                import jax
+
+                class NodeService:
+                    def handle(self, req):
+                        pass
+
+                    def op_scan_sync(self, req):
+                        jax.block_until_ready(req)
+                """
+            ),
+        },
+    )
+    assert "M3L010" not in codes(findings)
+
+
+# --- M3L011 jit-recompile-hazard ---
+
+
+def test_jit_in_request_body_fires():
+    findings = lint(
+        """
+        import jax
+
+        def handle(x):
+            fn = jax.jit(lambda v: v + 1)
+            return fn(x)
+        """
+    )
+    assert codes(findings) == {"M3L011"} and len(findings) == 1
+    assert "hoist" in findings[0].message
+
+
+def test_jit_global_memo_quiet():
+    findings = lint(
+        """
+        import jax
+
+        _J = None
+
+        def handle(x):
+            global _J
+            if _J is None:
+                _J = jax.jit(lambda v: v + 1)
+            return _J(x)
+        """
+    )
+    assert findings == []
+
+
+def test_jit_compile_factory_return_quiet():
+    # `return jax.jit(...)` is a factory: the CALLER owns memoization
+    # (kernels._get_jit build()s, parallel.scan make_sharded_*)
+    findings = lint(
+        """
+        import jax
+
+        def make_step(n):
+            def step(x):
+                return x * n
+            return jax.jit(step)
+        """
+    )
+    assert findings == []
+
+
+def test_jit_traced_branch_fires_and_static_quiet():
+    fired = lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 0:
+                return x
+            return x * 2
+        """
+    )
+    assert codes(fired) == {"M3L011"} and len(fired) == 1
+    assert "traced parameter `n`" in fired[0].message
+
+    quiet = lint(
+        """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 0:
+                return x
+            return x * 2
+        """
+    )
+    assert quiet == []
+
+
+def test_jit_shape_guards_quiet():
+    # x.ndim / len() / `is None` are static at trace time — not value
+    # branches
+    findings = lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                return x
+            if x.ndim > 1:
+                return x + mask
+            return x
+        """
+    )
+    assert findings == []
+
+
+def test_jit_mutated_closure_read_fires():
+    findings = lint(
+        """
+        import jax
+
+        SCALE = 2.0
+
+        @jax.jit
+        def apply(x):
+            return x * SCALE
+        """,
+        rel="m3_tpu/ops/knob.py",
+        extra={
+            "m3_tpu/query/tune.py": textwrap.dedent(
+                """
+                import m3_tpu.ops.knob as knob
+
+                def tune():
+                    knob.SCALE = 3.0
+                """
+            ),
+        },
+    )
+    assert "M3L011" in codes(findings)
+    hit = next(f for f in findings if f.code == "M3L011")
+    assert "SCALE" in hit.message and "old value" in hit.message
+
+
+# --- M3L012 donation-after-use ---
+
+
+DONATE_SRC = """
+    import jax
+
+    _STEP = jax.jit(lambda b, y: b + y, donate_argnums=(0,))
+
+    def step(buf, y):
+        out = _STEP(buf, y)
+        total = buf.sum()
+        return out, total
+    """
+
+
+def test_donation_after_use_fires():
+    findings = lint(DONATE_SRC)
+    assert codes(findings) == {"M3L012"} and len(findings) == 1
+    assert "`buf` was donated" in findings[0].message
+
+
+def test_donation_rebind_quiet():
+    findings = lint(
+        """
+        import jax
+
+        _STEP = jax.jit(lambda b, y: b + y, donate_argnums=(0,))
+
+        def step(buf, y):
+            buf = _STEP(buf, y)
+            return buf.sum()
+        """
+    )
+    assert findings == []
+
+
+def test_donation_at_return_quiet():
+    # dispatch inside `return` hands the buffer off; the other return is
+    # a disjoint control path, not a use-after-donation (the
+    # resident/pool.py _scatter donate/non-donate branch shape)
+    findings = lint(
+        """
+        import jax
+
+        _STEP = jax.jit(lambda b, y: b + y, donate_argnums=(0,))
+
+        def step(buf, y, donate):
+            if donate:
+                return _STEP(buf, y)
+            return _STEP(buf, y)
+        """
+    )
+    assert findings == []
+
+
+# --- differential mode + SARIF ---
+
+
+def test_changed_lines_and_differential_filter(tmp_path):
+    import subprocess as sp
+
+    from tools.m3lint import Finding, Result, changed_lines, filter_to_changed
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@example.invalid")
+    git("config", "user.name", "t")
+    pkg = repo / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("a = 1\nb = 2\nc = 3\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "base")
+    (pkg / "m.py").write_text("a = 1\nb = 99\nc = 3\nd = 4\n")
+
+    changed = changed_lines("HEAD", repo_root=str(repo))
+    assert changed == {"pkg/m.py": {2, 4}}
+
+    res = Result(findings=[
+        Finding("M3L010", "pkg/m.py", 2, "on a changed line"),
+        Finding("M3L010", "pkg/m.py", 3, "on an unchanged line"),
+        Finding("M3L010", "pkg/other.py", 2, "in an untouched file"),
+    ])
+    out = filter_to_changed(res, changed)
+    assert [(f.path, f.line) for f in out.findings] == [("pkg/m.py", 2)]
+    # parse errors always survive differential mode
+    res2 = Result(errors=["pkg/bad.py: boom"])
+    assert filter_to_changed(res2, changed).errors == ["pkg/bad.py: boom"]
+
+
+def test_sarif_matches_golden():
+    import os
+
+    from tools.m3lint import Finding, Result, sarif_from_result
+
+    res = Result(
+        findings=[
+            Finding(
+                "M3L010",
+                "m3_tpu/resident/scan.py",
+                42,
+                "np.asarray() (device->host copy) reachable from hot entry",
+                "host-sync-on-hot-path",
+            )
+        ],
+        files_scanned=1,
+    )
+    doc = sarif_from_result(res)
+    golden_path = os.path.join(
+        os.path.dirname(__file__), "data", "m3lint_golden.sarif"
+    )
+    with open(golden_path, encoding="utf-8") as f:
+        golden = json.load(f)
+    assert doc == golden, (
+        "SARIF output drifted from tests/data/m3lint_golden.sarif — if "
+        "the change is deliberate (new checker, schema fix), regenerate "
+        "the golden with json.dump(sarif_from_result(...))"
+    )
+
+
+def test_cli_sarif_and_changed_smoke():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.m3lint", "m3_tpu", "tools",
+         "--format", "sarif", "--changed", "HEAD"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"M3L009", "M3L010", "M3L011", "M3L012"} <= rule_ids
+    assert run["results"] == []

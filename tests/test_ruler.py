@@ -634,7 +634,9 @@ def test_http_rules_alerts_active_queries(db, tmp_path):
     p = tmp_path / "rules.json"
     p.write_text(json.dumps(rules))
     coord = Coordinator(db=db)
-    coord.start_ruler(rules_path=str(p), jitter=False)
+    # default_rules=False: this test asserts the exact group list the
+    # FILE contributes; defaults.py coverage lives in test_default_rules_*
+    coord.start_ruler(rules_path=str(p), jitter=False, default_rules=False)
     coord.ruler.runners()[0].eval_once(T0)
     srv, port = serve(coord)
     base = f"http://127.0.0.1:{port}"
@@ -687,3 +689,116 @@ def test_group_runner_thread_evaluates(db):
         assert ruler.log_notifier.sent, "alert never fired from the loop"
     finally:
         ruler.stop()
+
+
+# --- built-in default rules (ruler/defaults.py) ---
+
+
+def test_default_rules_readback_selfmon_to_ruler(db):
+    """The durability default closes the loop end to end: the corruption
+    counter scraped into _m3tpu -> the colon recordings derive burn
+    rates -> both burn-tier alerts fire off the recordings, same tick."""
+    from m3_tpu.selfmon import DatabaseSink, SelfMonCollector
+    from m3_tpu.ruler.defaults import DURABILITY_GROUP, default_groups
+
+    reg = Registry(prefix="m3tpu_")
+    corrupt = reg.counter(
+        "storage_corruption_total", "c",
+        labels={"file": "data", "reason": "digest-mismatch"},
+    )
+    corrupt.inc()
+    clk = [T0]
+    coll = SelfMonCollector(
+        DatabaseSink(db), interval=15.0, instance="n0",
+        component="dbnode", registry=reg, clock=lambda: clk[0],
+    )
+    coll.scrape_once()
+    corrupt.inc(3)
+    clk[0] = T0 + 60 * NANOS
+    coll.scrape_once()
+
+    groups = default_groups()
+    assert [g.name for g in groups] == [DURABILITY_GROUP]
+    assert all(g.namespace == RESERVED_NS for g in groups)
+    ruler = make_ruler(db, spec=groups_to_spec(groups))
+    events = ruler.runners()[0].eval_once(T0 + 60 * NANOS)
+
+    eng = ruler.engine_for(RESERVED_NS)
+    r = eng.query_instant("storage:corruption:rate5m", T0 + 61 * NANOS)
+    assert float(np.asarray(r.values)[0, -1]) > 0.0
+    firing = sorted(
+        e["labels"]["alertname"] for e in events if e["status"] == "firing"
+    )
+    assert firing == ["StorageDurabilityFastBurn", "StorageDurabilitySlowBurn"]
+    by_name = {e["labels"]["alertname"]: e for e in events}
+    assert by_name["StorageDurabilityFastBurn"]["labels"]["severity"] == "page"
+    assert by_name["StorageDurabilitySlowBurn"]["labels"]["severity"] == "ticket"
+
+
+def test_default_rules_quiet_without_corruption(db):
+    """Zero corruption: the recordings still emit (vector(0), so lookback
+    can't resurrect stale burn) and no alert fires."""
+    from m3_tpu.ruler.defaults import default_groups
+
+    ruler = make_ruler(db, spec=groups_to_spec(default_groups()))
+    events = ruler.runners()[0].eval_once(T0)
+    assert events == []
+    eng = ruler.engine_for(RESERVED_NS)
+    r = eng.query_instant("storage:corruption:rate5m", T0 + NANOS)
+    assert float(np.asarray(r.values)[0, -1]) == 0.0
+
+
+def test_default_rules_merge_and_file_override(db, tmp_path):
+    from m3_tpu.ruler.defaults import DURABILITY_GROUP
+
+    rules = one_group_spec(
+        [{"record": "job:m:last", "expr": "m"}], interval="30s"
+    )
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(rules))
+    coord = Coordinator(db=db)
+    coord.start_ruler(rules_path=str(p), jitter=False)
+    try:
+        assert [g.name for g in coord._ruler_groups] == [
+            "g", DURABILITY_GROUP
+        ]
+    finally:
+        coord.ruler.stop()
+
+    # a file group taking the default's name replaces it wholesale
+    override = one_group_spec(
+        [], name=DURABILITY_GROUP, namespace=RESERVED_NS, interval="30s"
+    )
+    p2 = tmp_path / "override.json"
+    p2.write_text(json.dumps(override))
+    coord2 = Coordinator(db=db)
+    coord2.start_ruler(rules_path=str(p2), jitter=False)
+    try:
+        assert [g.name for g in coord2._ruler_groups] == [DURABILITY_GROUP]
+        assert coord2._ruler_groups[0].rules == ()
+    finally:
+        coord2.ruler.stop()
+
+    # explicit opt-out: only the file's groups survive
+    coord3 = Coordinator(db=db)
+    coord3.start_ruler(rules_path=str(p), jitter=False, default_rules=False)
+    try:
+        assert [g.name for g in coord3._ruler_groups] == ["g"]
+    finally:
+        coord3.ruler.stop()
+
+
+def test_default_durability_slo_spec_compiles(db):
+    """The matching SLO fragment is spec_from_dict-valid and compiles to
+    the usual ratio recordings + burn alerts for the probe-driven SLI."""
+    from m3_tpu.ruler.defaults import default_durability_slo_spec
+    from m3_tpu.slo.compile import compile_groups
+    from m3_tpu.slo.spec import spec_from_dict
+
+    spec = spec_from_dict(default_durability_slo_spec())
+    assert [o.sli for o in spec.objectives] == ["durability"]
+    (group,) = compile_groups(spec)
+    records = [getattr(r, "record", "") for r in group.rules]
+    assert "slo:storage_durability:ratio_rate5m" in records
+    alerts = [getattr(r, "alert", "") for r in group.rules]
+    assert "SLOFastBurn_storage_durability" in alerts

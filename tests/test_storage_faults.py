@@ -557,3 +557,141 @@ def test_cluster_planted_corruption_quarantines_and_peer_repairs(tmp_path):
         assert sum(len(d) for _, _, d in got) == 8
     finally:
         cluster.close()
+
+
+# --- quarantine retention GC + scrub pacing (repair.py Scrubber) ---
+
+
+def _gauge_value():
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    fam = METRICS.collect().get("m3tpu_storage_quarantined_volumes")
+    return sum(c["value"] for c in fam["children"]) if fam else 0.0
+
+
+def _pruned_count():
+    from m3_tpu.utils.instrument import DEFAULT as METRICS
+
+    fam = METRICS.collect().get("m3tpu_storage_quarantine_pruned_total")
+    return sum(c["value"] for c in fam["children"]) if fam else 0.0
+
+
+def _quarantine_one_volume(tmp_path):
+    """Flush one fileset with a silently corrupted data file, scrub it
+    into quarantine, and return (db, quarantined file paths)."""
+    db = _mkdb(tmp_path, commitlog_enabled=False)
+    for i in range(40):
+        db.write("t", b"s%d" % (i % 4), T0 + i * NANOS, float(i))
+    install_plan(
+        DiskFaultPlan(
+            [DiskFaultRule(op="write", path_class="data",
+                           bitflip=1.0, max_hits=1)],
+            seed=5,
+        )
+    )
+    db.flush("t", T0 + 10 * BSZ)
+    install_plan(None)
+    assert db.scrub()["quarantined"] == 1
+    files = glob.glob(
+        os.path.join(str(tmp_path), "quarantine", "**", "*.db"),
+        recursive=True,
+    )
+    assert files  # the whole volume moved aside
+    return db, files
+
+
+def test_quarantine_retention_prunes_old_volumes(tmp_path):
+    from m3_tpu.storage import fs as fsm
+
+    db, files = _quarantine_one_volume(tmp_path)
+    gauge_before = _gauge_value()
+    pruned_before = _pruned_count()
+
+    # young volume + positive retention: kept (post-mortem window)
+    assert fsm.prune_quarantine(db.base, 3600.0) == 0
+    assert all(os.path.exists(p) for p in files)
+    # retention disabled: kept forever regardless of age
+    assert fsm.prune_quarantine(db.base, 0.0) == 0
+
+    # injected `now` ages the volume past retention: the WHOLE volume
+    # prunes atomically, the counter bumps, the gauge drops
+    assert fsm.prune_quarantine(db.base, 3600.0, now=time.time() + 7200) == 1
+    assert not any(os.path.exists(p) for p in files)
+    assert _pruned_count() == pruned_before + 1
+    assert _gauge_value() == gauge_before - 1
+    # idempotent: nothing left to prune
+    assert fsm.prune_quarantine(db.base, 3600.0, now=time.time() + 7200) == 0
+    db.close()
+
+
+def test_scrubber_runs_quarantine_retention(tmp_path):
+    from m3_tpu.storage.repair import Scrubber
+
+    db, files = _quarantine_one_volume(tmp_path)
+    # age the quarantined files on disk so the scrubber's wall-clock
+    # retention pass sees them as expired
+    old = time.time() - 1000
+    for p in files:
+        os.utime(p, (old, old))
+    scr = Scrubber(db, bytes_per_sec=0, quarantine_retention_secs=500.0)
+    totals = scr.run_once()
+    assert totals["pruned"] == 1
+    assert not any(os.path.exists(p) for p in files)
+
+    # retention off (the default): a pass leaves quarantine alone
+    db2, files2 = _quarantine_one_volume(tmp_path / "keep")
+    for p in files2:
+        os.utime(p, (old, old))
+    assert Scrubber(db2, bytes_per_sec=0).run_once()["pruned"] == 0
+    assert all(os.path.exists(p) for p in files2)
+    db.close()
+    db2.close()
+
+
+def test_scrubber_iops_pacing_with_injected_clock(tmp_path):
+    from m3_tpu.storage import fs as fsm
+    from m3_tpu.storage.repair import Scrubber
+
+    db = _mkdb(tmp_path, commitlog_enabled=False)
+    for i in range(40):
+        db.write("t", b"s%d" % (i % 4), T0 + i * NANOS, float(i))
+    db.flush("t", T0 + 10 * BSZ)
+
+    clk = [0.0]
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clk[0] += s
+
+    scr = Scrubber(
+        db, bytes_per_sec=0, iops=4, clock=lambda: clk[0], sleep=sleep
+    )
+    totals = scr.run_once()
+    assert totals["scanned"] >= 1
+    # opens are modeled as one per file role per fileset verified
+    assert totals["opens"] == totals["scanned"] * len(fsm.SUFFIXES)
+    # the pass slept the pace down to <= iops opens/sec: with a clock
+    # that only advances inside sleep, total sleep equals opens/iops
+    assert sleeps and all(s > 0 for s in sleeps)
+    assert sum(sleeps) == pytest.approx(totals["opens"] / 4)
+
+    # both budgets together: the further-behind one wins each step
+    clk[0] = 0.0
+    sleeps.clear()
+    scr = Scrubber(
+        db, bytes_per_sec=1, iops=4, clock=lambda: clk[0], sleep=sleep
+    )
+    totals = scr.run_once()
+    expect = max(totals["bytes"] / 1.0, totals["opens"] / 4.0)
+    assert sum(sleeps) == pytest.approx(expect)
+
+    # iops=0 (the default) leaves open-rate unpaced: byte budget only
+    clk[0] = 0.0
+    sleeps.clear()
+    scr = Scrubber(
+        db, bytes_per_sec=1 << 30, clock=lambda: clk[0], sleep=sleep
+    )
+    totals = scr.run_once()
+    assert sum(sleeps) == pytest.approx(totals["bytes"] / (1 << 30))
+    db.close()

@@ -16,8 +16,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SCAN_ROOTS = ("m3_tpu", "tools")
 
 
+# the interprocedural (pass-2) checkers the v2 gate must run: a refactor
+# that silently drops their registration would leave the tree "clean"
+# without the device-contract/deadlock analysis ever executing
+V2_CODES = ("M3L009", "M3L010", "M3L011", "M3L012")
+
+
 def main(argv=None) -> int:
-    from tools.m3lint import lint_paths
+    from tools.m3lint import CHECKERS, lint_paths
 
     res = lint_paths(list(SCAN_ROOTS))
     ok = True
@@ -32,6 +38,11 @@ def main(argv=None) -> int:
     for err in res.errors:
         print(f"  PARSE ERROR: {err}", flush=True)
     check(res.files_scanned > 100, f"scanned the whole tree ({res.files_scanned} files)")
+    registered = {cls.code for cls in CHECKERS}
+    check(
+        all(code in registered for code in V2_CODES),
+        f"v2 interprocedural checkers registered ({', '.join(V2_CODES)})",
+    )
     check(not res.errors, "every scanned file parses")
     check(
         not res.findings,

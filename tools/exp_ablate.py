@@ -42,6 +42,7 @@ def main():
     w4 = jax.device_put(packed.windows4)
     l4 = jax.device_put(packed.lanes4)
     tf = jax.device_put(packed.tile_flags)
+    # m3lint: disable=M3L011 -- benchmark harness: main() runs once per process; the jit is built once and timed over warm dispatches
     fn = jax.jit(
         functools.partial(
             chunked_scan_aggregate_packed,

@@ -359,6 +359,7 @@ def lint_contexts(contexts, baseline=None) -> Result:
 def lint_paths(paths, repo_root: str | None = None, baseline_path: str | None = None) -> Result:
     # import for side effect: checker registration
     from . import checkers as _checkers  # noqa: F401
+    from . import project_checkers as _project_checkers  # noqa: F401
 
     repo_root = repo_root or REPO_ROOT
     contexts, errors = load_files(paths, repo_root)
@@ -370,10 +371,107 @@ def lint_paths(paths, repo_root: str | None = None, baseline_path: str | None = 
     return res
 
 
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def sarif_from_result(res: Result) -> dict:
+    """Minimal SARIF 2.1.0 document for CI annotation surfaces (GitHub
+    code scanning et al.): one run, one rule per registered checker,
+    one result per non-suppressed finding."""
+    from . import checkers as _checkers  # noqa: F401
+    from . import project_checkers as _project_checkers  # noqa: F401
+
+    rules = {"M3L000": "suppression-rationale"}
+    for cls in CHECKERS:
+        rules[cls.code] = cls.name
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "m3lint",
+                        "rules": [
+                            {"id": code, "name": name}
+                            for code, name in sorted(rules.items())
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.code,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": max(f.line, 1)
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in res.findings
+                ],
+            }
+        ],
+    }
+
+
+_HUNK_RE = re.compile(r"@@ -\S+ \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(ref: str, repo_root: str | None = None) -> dict:
+    """{repo-relative path: set of line numbers} added/modified since
+    ``ref`` (``git diff -U0``) — the differential-mode input."""
+    import subprocess
+
+    out = subprocess.run(
+        ["git", "diff", "-U0", ref, "--", "*.py"],
+        cwd=repo_root or REPO_ROOT,
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    changed: dict = {}
+    cur = None
+    for line in out.splitlines():
+        if line.startswith("+++ "):
+            path = line[4:].strip()
+            cur = (
+                None
+                if path == "/dev/null"
+                else (path[2:] if path.startswith("b/") else path)
+            )
+        elif cur is not None and line.startswith("@@ "):
+            m = _HUNK_RE.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                if count:
+                    changed.setdefault(cur, set()).update(
+                        range(start, start + count)
+                    )
+    return changed
+
+
+def filter_to_changed(res: Result, changed: dict) -> Result:
+    """Differential mode: keep only findings landing on changed lines
+    (parse errors always survive — a broken file is never 'unchanged')."""
+    res.findings = [
+        f for f in res.findings if f.line in changed.get(f.path, ())
+    ]
+    return res
+
+
 def lint_source(source: str, rel: str = "synthetic/mod.py", extra: dict | None = None) -> list:
     """Lint one in-memory module (plus optional named companions) and
     return raw findings — the unit-test seam for individual checkers."""
     from . import checkers as _checkers  # noqa: F401
+    from . import project_checkers as _project_checkers  # noqa: F401
 
     contexts = [FileContext(rel, source)]
     for other_rel, other_src in (extra or {}).items():

@@ -1,7 +1,11 @@
-"""CLI: ``python -m tools.m3lint [paths...] [--format text|json]``.
+"""CLI: ``python -m tools.m3lint [paths...] [--format text|json|sarif]
+[--changed <git-ref>]``.
 
 Exits 0 when every finding is suppressed (inline with rationale) or
 baselined (tools/m3lint/baseline.json with reason); nonzero otherwise.
+``--changed <ref>`` enables differential mode: only findings landing on
+lines added/modified since ``ref`` count (the pre-merge CI shape —
+whole-tree cleanliness stays tools/check_lint.py's job).
 """
 
 from __future__ import annotations
@@ -10,8 +14,16 @@ import argparse
 import json
 import sys
 
-from . import CHECKERS, DEFAULT_BASELINE, lint_paths
+from . import (
+    CHECKERS,
+    DEFAULT_BASELINE,
+    changed_lines,
+    filter_to_changed,
+    lint_paths,
+    sarif_from_result,
+)
 from . import checkers as _checkers  # noqa: F401 — registers checkers
+from . import project_checkers as _pc  # noqa: F401 — registers checkers
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -22,7 +34,15 @@ def build_parser() -> argparse.ArgumentParser:
         default=["m3_tpu", "tools"],
         help="scan roots, relative to the repo root (default: m3_tpu tools)",
     )
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
+    p.add_argument(
+        "--changed",
+        metavar="GIT_REF",
+        help="differential mode: only report findings on lines "
+        "added/modified since GIT_REF (git diff -U0)",
+    )
     p.add_argument(
         "--baseline",
         default=DEFAULT_BASELINE,
@@ -53,8 +73,12 @@ def main(argv=None) -> int:
         args.paths or ["m3_tpu", "tools"],
         baseline_path="" if args.no_baseline else args.baseline,
     )
+    if args.changed:
+        res = filter_to_changed(res, changed_lines(args.changed))
     if args.format == "json":
         print(json.dumps(res.to_dict(), indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(sarif_from_result(res), indent=2))
     else:
         for f in res.findings:
             print(f.render())

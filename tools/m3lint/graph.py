@@ -1,0 +1,133 @@
+"""Pass-2 graph algorithms over the ProjectModel: the static lock graph
+(order edges + elementary cycles with witness call chains, the offline
+twin of m3_tpu/testing/lockcheck.py's runtime inversion detector) and
+hot-path reachability (BFS over resolved call edges from the declared
+hot-entry registry, stopping at the RPC boundary — work past a wire
+dispatch runs in another process and is not THIS path's host sync).
+"""
+
+from __future__ import annotations
+
+# a witness frame is (display, rel, line); a chain is a tuple of frames
+
+
+def call_edges(model):
+    """qualname -> [(CallSite, callee qualname)] resolved once."""
+    out = {}
+    for q, fi in model.functions.items():
+        edges = []
+        for call in fi.calls:
+            for tgt in model.resolve(fi, call):
+                edges.append((call, tgt.qualname))
+        out[q] = edges
+    return out
+
+
+def transitive_acquisitions(model, edges=None):
+    """qualname -> {lock: witness chain to its acquisition}, closed over
+    the call graph (bounded fixpoint; chains capped so pathological
+    recursion cannot run away)."""
+    edges = edges if edges is not None else call_edges(model)
+    acq = {}
+    for q, fi in model.functions.items():
+        d = {}
+        for a in fi.acquires:
+            d.setdefault(a.lock, ((fi.display, fi.rel, a.lineno),))
+        acq[q] = d
+    for _ in range(30):
+        changed = False
+        for q, fi in model.functions.items():
+            for call, tq in edges.get(q, ()):
+                for lock, chain in list(acq.get(tq, {}).items()):
+                    if lock not in acq[q] and len(chain) < 8:
+                        acq[q][lock] = (
+                            (fi.display, fi.rel, call.lineno),
+                        ) + chain
+                        changed = True
+        if not changed:
+            break
+    return acq
+
+
+def build_lock_graph(model):
+    """(held, acquired) -> witness chain: the statically derived
+    lock-order graph. An edge L->M exists when some function acquires M
+    (directly or through any resolvable call chain) while holding L.
+    Same-lock re-entry is not an order edge (RLock re-entry is legal;
+    self-deadlock is the runtime harness's department)."""
+    edges = call_edges(model)
+    trans = transitive_acquisitions(model, edges)
+    graph = {}
+    for q, fi in model.functions.items():
+        for a in fi.acquires:
+            for held_lock, held_line in a.held:
+                key = (held_lock, a.lock)
+                if held_lock != a.lock and key not in graph:
+                    graph[key] = (
+                        (fi.display, fi.rel, held_line),
+                        (fi.display, fi.rel, a.lineno),
+                    )
+        for call, tq in edges.get(q, ()):
+            if not call.locks_held:
+                continue
+            for lock, chain in trans.get(tq, {}).items():
+                for held_lock, held_line in call.locks_held:
+                    key = (held_lock, lock)
+                    if held_lock != lock and key not in graph:
+                        graph[key] = (
+                            (fi.display, fi.rel, held_line),
+                            (fi.display, fi.rel, call.lineno),
+                        ) + chain
+    return graph
+
+
+def lock_cycles(graph, max_len=5, max_cycles=20):
+    """Elementary cycles in the lock-order graph, each reported once
+    (canonical rotation starts at the lexicographically smallest lock)."""
+    adj = {}
+    for a, b in graph:
+        adj.setdefault(a, set()).add(b)
+    cycles = []
+
+    def dfs(start, cur, path):
+        if len(cycles) >= max_cycles:
+            return
+        for nxt in sorted(adj.get(cur, ())):
+            if nxt == start and len(path) >= 2:
+                cycles.append(tuple(path))
+            elif nxt > start and nxt not in path and len(path) < max_len:
+                dfs(start, nxt, path + [nxt])
+
+    for node in sorted(adj):
+        dfs(node, node, [node])
+    return cycles
+
+
+def hot_reachability(model, entries, max_depth=10):
+    """qualname -> chain of displays from the nearest hot entry. Wire
+    dispatch edges are NOT followed: past `_call` the work belongs to the
+    serving process, not the caller's device hot path."""
+    chains = {}
+    queue = []
+    for rel, display in entries:
+        q = f"{rel}::{display}"
+        if q in model.functions:
+            chains[q] = (display,)
+            queue.append(q)
+    while queue:
+        q = queue.pop(0)
+        fi = model.functions[q]
+        if len(chains[q]) >= max_depth:
+            continue
+        for call in fi.calls:
+            if call.wire_op is not None:
+                continue
+            for tgt in model.resolve(fi, call):
+                if tgt.qualname not in chains:
+                    chains[tgt.qualname] = chains[q] + (tgt.display,)
+                    queue.append(tgt.qualname)
+    return chains
+
+
+def render_chain(chain):
+    return " -> ".join(f"{d} ({rel}:{line})" for d, rel, line in chain)

@@ -1,15 +1,31 @@
-"""Cross-file project model for m3lint: the wire registries, every RPC
-dispatch table, every client-side literal op, and every exception class —
-the shared substrate for the wire-registry-consistency checker (M3L003)
-and for tests/test_wire_registry.py's generated sync assertions.
+"""Cross-file project model for m3lint — pass 1 of the two-pass analyzer.
 
-The model is AST-derived (never imports the code under analysis), so it
-works on broken trees and inside the lint gate without jax present.
+Originally this held only the wire registries, RPC dispatch tables,
+client-side literal ops and exception classes (the substrate for M3L003
+and tests/test_wire_registry.py). v2 grows it into a full project model:
+
+- a **call graph**: one :class:`FunctionInfo` per module-level function
+  and per method, with every call site, conservatively resolved
+  (``self.``-methods through base classes, bare names through imports,
+  module-alias calls, unique method names, and the wire dispatch edges —
+  ``client._call("x")`` resolves to every ``op_x`` handler);
+- a **lock summary** per function: which locks it acquires (identity
+  seeded from the same ``threading.Lock/RLock/Condition`` shapes the
+  runtime lockcheck harness patches) and which locks are held at every
+  call site;
+- a **jit-surface summary**: every ``@jax.jit`` / ``jax.jit(...)`` /
+  ``pallas_call`` site with its static/donate argnums and the name the
+  compiled callable is bound to.
+
+Pass 2 (tools/m3lint/project_checkers.py: M3L009–M3L012) consumes this
+model. The model is AST-derived (never imports the code under analysis),
+so it works on broken trees and inside the lint gate without jax present.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 
 # registry names read out of net/wire.py
@@ -39,11 +55,131 @@ def is_mutating_op(op: str) -> bool:
     return op in MUTATING_OP_EXACT or op.startswith(MUTATING_OP_PREFIXES)
 
 
+# ---------------------------------------------------------------- helpers
+# (shared with checkers.py — the terminal/receiver walkers and the lock
+# spelling are the one vocabulary both passes must agree on)
+
+
+def _terminal_name(node: ast.expr) -> str:
+    """The rightmost identifier of a Name/Attribute/Subscript chain."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _receiver_name(node: ast.expr) -> str:
+    """The leftmost identifier (``jax`` in ``jax.device_put``)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value if isinstance(node, ast.Attribute) else node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+_LOCK_NAME = re.compile(r"(lock|mutex)s?$|(^|_)(mu|cv|cond)$", re.IGNORECASE)
+
+
+def _is_lock_like(expr: ast.expr) -> bool:
+    return bool(_LOCK_NAME.search(_terminal_name(expr)))
+
+
+def _attr_path(node: ast.expr):
+    """``self._pool._lock`` -> ["self", "_pool", "_lock"]; None when the
+    chain is broken by a call/subscript (identity unknowable)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def module_name_for(rel: str) -> str:
+    """Repo-relative path -> dotted module name."""
+    p = rel[:-3] if rel.endswith(".py") else rel
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# --------------------------------------------------------- pass-1 records
+
+
+@dataclass
+class CallSite:
+    name: str  # terminal callee name
+    receiver: str  # leftmost name; "" for a bare Name call
+    lineno: int
+    node: ast.Call
+    locks_held: tuple = ()  # ((lock_id, acquired_line), ...)
+    wire_op: str | None = None  # literal `_call("<op>")` target
+
+
+@dataclass
+class LockAcq:
+    lock: str  # lock identity (e.g. "Pool._lock", "shard.lock")
+    lineno: int
+    held: tuple = ()  # locks already held when this one is taken
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "<rel>::<display>"
+    rel: str
+    name: str
+    cls: str | None
+    lineno: int
+    node: object
+    display: str  # "Class.method" or "func"
+    calls: list = field(default_factory=list)
+    acquires: list = field(default_factory=list)
+    cached: bool = False  # @lru_cache/@cache factory
+    global_names: frozenset = frozenset()
+
+
+@dataclass
+class JitSurface:
+    rel: str
+    lineno: int
+    kind: str  # "decorated" | "call" | "pallas"
+    name: str = ""  # bound name (call form) or def name (decorated)
+    fn_name: str = ""  # wrapped callable's terminal name (call form)
+    static_argnums: tuple = ()
+    static_argnames: tuple = ()
+    donate_argnums: tuple = ()
+    in_function: str = ""  # enclosing function display, "" at module level
+    memoized: bool = False  # assigned to a `global` memo or self attr
+    enclosing_cached: bool = False  # enclosing def is an lru_cache factory
+    returned: bool = False  # `return jax.jit(...)` — a compile factory
+
+
 @dataclass
 class RegistrySet:
     ops: frozenset
     line: int = 0  # line of the assignment in net/wire.py
     entry_lines: dict = field(default_factory=dict)  # op -> line
+
+
+# method names too generic to resolve by project-wide uniqueness: they
+# are routinely called on stdlib/file/socket objects, so a lone project
+# class defining one must not capture every such call in the tree
+_GENERIC_METHOD_NAMES = frozenset(
+    {
+        "write", "read", "get", "put", "set", "close", "open", "flush",
+        "send", "recv", "append", "add", "update", "pop", "join", "start",
+        "stop", "run", "acquire", "release", "wait", "notify", "clear",
+        "copy", "items", "keys", "values", "encode", "decode", "handle",
+        "next", "reset", "step", "result", "submit", "connect", "commit",
+    }
+)
 
 
 class ProjectModel:
@@ -63,8 +199,29 @@ class ProjectModel:
         # every class name defined anywhere in the scan roots (for
         # RETRYABLE_ETYPES resolution)
         self.classes: dict = {}
+        # -- pass-1 call-graph state --
+        self.functions: dict = {}  # qualname -> FunctionInfo
+        self.funcs_by_rel: dict = {}  # rel -> {name: qualname} (module level)
+        self.class_methods: dict = {}  # (rel, cls) -> {name: qualname}
+        self.class_bases: dict = {}  # (rel, cls) -> (base names)
+        self.methods_by_name: dict = {}  # name -> [qualname]
+        self.modules: dict = {}  # dotted module name -> rel
+        self.imports: dict = {}  # rel -> {alias: dotted module}
+        self.from_imports: dict = {}  # rel -> {name: (module, orig name)}
+        self.wire_handlers: dict = {}  # op -> [qualname of op_ method]
+        self.lock_kinds: dict = {}  # lock identity -> Lock|RLock|Condition
+        self.jit_surfaces: list = []
+        # (module, attr) -> [(rel, line)]: cross-module attribute writes
+        # (`mod.NAME = ...` through an import alias) — the runtime
+        # mutations a traced closure would never see
+        self.module_attr_mutations: dict = {}
+        self._fn_by_node: dict = {}  # id(def node) -> FunctionInfo
+        for ctx in self.contexts:
+            self.modules[module_name_for(ctx.rel)] = ctx.rel
         for ctx in self.contexts:
             self._scan(ctx)
+        for ctx in self.contexts:
+            self._scan_jit_surfaces(ctx)
 
     # -- scanning --
 
@@ -72,6 +229,7 @@ class ProjectModel:
         if ctx.rel.endswith("net/wire.py"):
             self.wire_rel = ctx.rel
             self._scan_wire(ctx)
+        self._scan_imports(ctx)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
                 self.classes.setdefault(node.name, (ctx.rel, node.lineno))
@@ -83,6 +241,9 @@ class ProjectModel:
                     self.client_calls.setdefault(op, []).append(
                         (ctx.rel, node.lineno)
                     )
+            elif isinstance(node, ast.Assign):
+                self._scan_module_attr_mutation(ctx, node)
+        self._scan_defs(ctx)
 
     def _scan_wire(self, ctx) -> None:
         for node in ast.walk(ctx.tree):
@@ -122,6 +283,9 @@ class ProjectModel:
                 self.dispatched.setdefault(item.name[3:], []).append(
                     (ctx.rel, item.lineno)
                 )
+                self.wire_handlers.setdefault(item.name[3:], []).append(
+                    f"{ctx.rel}::{cls.name}.{item.name}"
+                )
             # string-compare dispatch (`if op == "health": ...`) used by
             # DebugService / the middleware's universal `metrics` op
             for node in ast.walk(item):
@@ -154,6 +318,310 @@ class ProjectModel:
             return node.args[0].value
         return None
 
+    # -- pass 1: imports --
+
+    def _scan_imports(self, ctx) -> None:
+        alias_map: dict = {}
+        from_map: dict = {}
+        mod = module_name_for(ctx.rel)
+        pkg_parts = mod.split(".")
+        if not ctx.rel.endswith("__init__.py"):
+            pkg_parts = pkg_parts[:-1]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        alias_map[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        alias_map.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    anchor = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    local = a.asname or a.name
+                    full = f"{base}.{a.name}" if base else a.name
+                    if full in self.modules:
+                        alias_map[local] = full
+                    else:
+                        from_map[local] = (base, a.name)
+        self.imports[ctx.rel] = alias_map
+        self.from_imports[ctx.rel] = from_map
+
+    def _scan_module_attr_mutation(self, ctx, node: ast.Assign) -> None:
+        for target in node.targets:
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+            ):
+                continue
+            mod = self.imports.get(ctx.rel, {}).get(target.value.id)
+            if mod and mod in self.modules:
+                self.module_attr_mutations.setdefault(
+                    (mod, target.attr), []
+                ).append((ctx.rel, node.lineno))
+
+    # -- pass 1: functions, locks, calls --
+
+    def _scan_defs(self, ctx) -> None:
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(ctx, stmt, cls=None)
+            elif isinstance(stmt, ast.ClassDef):
+                bases = tuple(
+                    _terminal_name(b) for b in stmt.bases if _terminal_name(b)
+                )
+                self.class_bases[(ctx.rel, stmt.name)] = bases
+                for item in stmt.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._add_function(ctx, item, cls=stmt.name)
+                    elif isinstance(item, ast.Assign):
+                        self._scan_lock_kind(ctx, item, cls=stmt.name)
+            elif isinstance(stmt, ast.Assign):
+                self._scan_lock_kind(ctx, stmt, cls=None)
+
+    def _add_function(self, ctx, fn, cls) -> None:
+        display = f"{cls}.{fn.name}" if cls else fn.name
+        qualname = f"{ctx.rel}::{display}"
+        fi = FunctionInfo(
+            qualname=qualname,
+            rel=ctx.rel,
+            name=fn.name,
+            cls=cls,
+            lineno=fn.lineno,
+            node=fn,
+            display=display,
+            cached=any(
+                _terminal_name(d.func if isinstance(d, ast.Call) else d)
+                in ("lru_cache", "cache")
+                for d in fn.decorator_list
+            ),
+            global_names=frozenset(
+                n
+                for g in ast.walk(fn)
+                if isinstance(g, ast.Global)
+                for n in g.names
+            ),
+        )
+        self.functions[qualname] = fi
+        self._fn_by_node[id(fn)] = fi
+        if cls is None:
+            self.funcs_by_rel.setdefault(ctx.rel, {})[fn.name] = qualname
+        else:
+            self.class_methods.setdefault((ctx.rel, cls), {})[
+                fn.name
+            ] = qualname
+            self.methods_by_name.setdefault(fn.name, []).append(qualname)
+        if fn.name == "__init__" and cls is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    self._scan_lock_kind(ctx, node, cls=cls)
+        for stmt in fn.body:
+            self._visit(fi, stmt, ())
+
+    _LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore")
+
+    def _scan_lock_kind(self, ctx, node: ast.Assign, cls) -> None:
+        if not (
+            isinstance(node.value, ast.Call)
+            and _terminal_name(node.value.func) in self._LOCK_CTORS
+        ):
+            return
+        kind = _terminal_name(node.value.func)
+        for target in node.targets:
+            lid = self._lock_id(target, ctx.rel, cls)
+            if lid is not None:
+                self.lock_kinds.setdefault(lid, kind)
+
+    @staticmethod
+    def _lock_id(expr, rel, cls):
+        """Stable identity for a lock expression: ``self.X`` in class C
+        is ``C.X`` (one identity per class attribute, however the
+        instance is reached); ``recv.X`` keeps the receiver spelling;
+        a bare module-global name is qualified by its file."""
+        parts = _attr_path(expr)
+        if not parts or not _LOCK_NAME.search(parts[-1]):
+            return None
+        if parts[0] == "self" and cls:
+            return ".".join([cls] + parts[1:])
+        if len(parts) == 1:
+            return f"{rel}::{parts[0]}"
+        return ".".join(parts[-2:])
+
+    def _visit(self, fi, node, held) -> None:
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            return  # nested defs do not RUN here
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            cur = list(held)
+            for item in node.items:
+                self._visit(fi, item.context_expr, tuple(cur))
+                lid = self._lock_id(item.context_expr, fi.rel, fi.cls)
+                if lid is not None and lid not in [l for l, _ in cur]:
+                    fi.acquires.append(
+                        LockAcq(lid, item.context_expr.lineno, tuple(cur))
+                    )
+                    cur.append((lid, item.context_expr.lineno))
+            for stmt in node.body:
+                self._visit(fi, stmt, tuple(cur))
+            return
+        if isinstance(node, ast.Call):
+            receiver = (
+                "" if isinstance(node.func, ast.Name)
+                else _receiver_name(node.func)
+            )
+            fi.calls.append(
+                CallSite(
+                    name=_terminal_name(node.func),
+                    receiver=receiver,
+                    lineno=node.lineno,
+                    node=node,
+                    locks_held=held,
+                    wire_op=self._literal_call_op(node),
+                )
+            )
+        for child in ast.iter_child_nodes(node):
+            self._visit(fi, child, held)
+
+    # -- pass 1: jit surfaces --
+
+    def _scan_jit_surfaces(self, ctx) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not _is_jit_decorator(dec):
+                        continue
+                    nums, names, donate = _jit_params(dec)
+                    self.jit_surfaces.append(
+                        JitSurface(
+                            rel=ctx.rel,
+                            lineno=node.lineno,
+                            kind="decorated",
+                            name=node.name,
+                            fn_name=node.name,
+                            static_argnums=nums,
+                            static_argnames=names,
+                            donate_argnums=donate,
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                t = _terminal_name(node.func)
+                if t == "pallas_call":
+                    self.jit_surfaces.append(
+                        JitSurface(ctx.rel, node.lineno, kind="pallas")
+                    )
+                elif t == "jit":
+                    self._add_call_surface(ctx, node)
+
+    def _add_call_surface(self, ctx, node: ast.Call) -> None:
+        nums, names, donate = _jit_params(node)
+        surface = JitSurface(
+            rel=ctx.rel,
+            lineno=node.lineno,
+            kind="call",
+            fn_name=_terminal_name(node.args[0]) if node.args else "",
+            static_argnums=nums,
+            static_argnames=names,
+            donate_argnums=donate,
+        )
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Assign) and parent.value is node:
+            tgt = parent.targets[0]
+            if isinstance(tgt, ast.Name):
+                surface.name = tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                surface.name = tgt.attr
+                if _receiver_name(tgt) == "self":
+                    surface.memoized = True  # per-instance construction
+        cur = parent
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            if isinstance(cur, ast.Return):
+                surface.returned = True
+            cur = ctx.parents.get(cur)
+        if cur is not None:
+            fi = self._fn_by_node.get(id(cur))
+            surface.in_function = fi.display if fi else cur.name
+            if fi is not None:
+                surface.enclosing_cached = fi.cached
+                if surface.name and surface.name in fi.global_names:
+                    surface.memoized = True  # the lazy module-memo pattern
+        self.jit_surfaces.append(surface)
+
+    # -- pass 2: conservative call resolution --
+
+    def resolve(self, fi: FunctionInfo, call: CallSite):
+        """Resolve a call site to FunctionInfos. Deliberately
+        conservative: an unresolvable call returns [] (no edge) rather
+        than guessing — interprocedural checkers must not invent paths."""
+        if call.wire_op is not None:
+            return [
+                self.functions[q]
+                for q in self.wire_handlers.get(call.wire_op, ())
+                if q in self.functions
+            ]
+        if call.receiver == "self":
+            if fi.cls is None:
+                return []
+            q = self._method_in_class(fi.rel, fi.cls, call.name)
+            return [self.functions[q]] if q else []
+        if call.receiver == "":
+            q = self.funcs_by_rel.get(fi.rel, {}).get(call.name)
+            if q:
+                return [self.functions[q]]
+            tgt = self.from_imports.get(fi.rel, {}).get(call.name)
+            if tgt:
+                mod, orig = tgt
+                rel = self.modules.get(mod)
+                if rel:
+                    q = self.funcs_by_rel.get(rel, {}).get(orig)
+                    if q:
+                        return [self.functions[q]]
+            return []
+        mod = self.imports.get(fi.rel, {}).get(call.receiver)
+        if mod:
+            rel = self.modules.get(mod)
+            if rel:
+                q = self.funcs_by_rel.get(rel, {}).get(call.name)
+                return [self.functions[q]] if q else []
+            return []
+        if call.receiver in self.classes:
+            crel, _ = self.classes[call.receiver]
+            q = self._method_in_class(crel, call.receiver, call.name)
+            if q:
+                return [self.functions[q]]
+        # last resort: a method name defined by exactly ONE class in the
+        # whole project (and not a generic stdlib-ish name) is unambiguous
+        if call.name in _GENERIC_METHOD_NAMES:
+            return []
+        qs = self.methods_by_name.get(call.name, ())
+        if len(qs) == 1:
+            return [self.functions[qs[0]]]
+        return []
+
+    def _method_in_class(self, rel, cls, name, _seen=None):
+        _seen = _seen or set()
+        if (rel, cls) in _seen:
+            return None
+        _seen.add((rel, cls))
+        q = self.class_methods.get((rel, cls), {}).get(name)
+        if q:
+            return q
+        for base in self.class_bases.get((rel, cls), ()):
+            if base in self.classes:
+                brel, _ = self.classes[base]
+                q = self._method_in_class(brel, base, name, _seen)
+                if q:
+                    return q
+        return None
+
     # -- convenience views --
 
     def registry(self, name: str) -> RegistrySet:
@@ -162,6 +630,65 @@ class ProjectModel:
     @property
     def has_wire(self) -> bool:
         return bool(self.registries)
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    return _terminal_name(node) == "jit"
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    # @jax.jit / @jit
+    if _is_jit_expr(dec):
+        return True
+    # @functools.partial(jax.jit, ...) / @partial(jit, ...)
+    if (
+        isinstance(dec, ast.Call)
+        and _terminal_name(dec.func) == "partial"
+        and dec.args
+        and _is_jit_expr(dec.args[0])
+    ):
+        return True
+    return False
+
+
+def _jit_params(node):
+    """(static_argnums, static_argnames, donate_argnums) from a jit call
+    or a ``partial(jax.jit, ...)`` decorator; empty tuples otherwise."""
+    if not isinstance(node, ast.Call):
+        return (), (), ()
+    nums, names, donate = (), (), ()
+    for kw in node.keywords:
+        if kw.arg == "static_argnums":
+            nums = _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_tuple(kw.value)
+        elif kw.arg == "donate_argnums":
+            donate = _int_tuple(kw.value)
+    return nums, names, donate
+
+
+def _int_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _str_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
 
 
 def _frozenset_literal(node: ast.expr):

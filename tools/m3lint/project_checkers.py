@@ -1,0 +1,413 @@
+"""Pass-2 interprocedural checkers over the project model. Codes:
+
+- M3L009 static-lock-order — elementary cycles in the statically derived
+  lock graph: two call paths acquiring the same pair of locks in
+  opposite orders deadlock under concurrency. This is the offline twin
+  of the runtime lockcheck harness (m3_tpu/testing/lockcheck.py), which
+  needs a lucky interleaving to witness the same AB/BA inversion; here
+  the cycle is found without executing anything, with BOTH witness call
+  chains in the finding.
+- M3L010 host-sync-on-hot-path — `block_until_ready`, `np.asarray`,
+  `.item()`, `float()/bool()` on device values, and `device_put`
+  reachable from the declared hot-entry registry. The paper's value
+  proposition is ONE warm XLA dispatch with zero host transfer on the
+  scan/aggregate path; any host sync on it is either a bug or a
+  sanctioned boundary that must carry an inline suppression rationale.
+- M3L011 jit-recompile-hazard — jax.jit constructed inside a per-call
+  function body (recompiles or re-hashes every request; memoize it), a
+  @jit function reading a module global that OTHER modules reassign
+  through an import alias (the trace captured the old value), and a
+  Python `if`/`while` branching directly on a traced parameter (shape
+  derivation must use static argnums; value branches don't trace).
+- M3L012 donation-after-use — a name passed at a `donate_argnums`
+  position and read again on a later line without reassignment: the
+  dispatch invalidated that buffer (the exact bug class PR 11's
+  pool-reset fix hand-patched at runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Checker, register
+from .graph import (
+    build_lock_graph,
+    hot_reachability,
+    lock_cycles,
+    render_chain,
+)
+from .model import _receiver_name, _terminal_name
+
+# ---------------------------------------------------------------- M3L009
+
+
+@register
+class StaticLockOrder(Checker):
+    code = "M3L009"
+    name = "static-lock-order"
+
+    def check_project(self, model):
+        graph = build_lock_graph(model)
+        for cycle in lock_cycles(graph):
+            pairs = [
+                (cycle[i], cycle[(i + 1) % len(cycle)])
+                for i in range(len(cycle))
+            ]
+            witnesses = [
+                f"[{a} -> {b}: {render_chain(graph[(a, b)])}]"
+                for a, b in pairs
+            ]
+            # anchor the finding where the first edge closes: the line
+            # that acquires the second lock while the first is held
+            first = graph[pairs[0]]
+            rel, line = first[-1][1], first[-1][2]
+            order = " -> ".join(cycle + (cycle[0],))
+            yield self.finding(
+                rel,
+                line,
+                f"static lock-order cycle {order}: "
+                + "; ".join(witnesses)
+                + " — opposite acquisition orders deadlock under "
+                "concurrency (the AB/BA shape lockcheck only catches at "
+                "runtime); impose one global order or drop a lock",
+            )
+
+
+# ---------------------------------------------------------------- M3L010
+
+
+@register
+class HostSyncOnHotPath(Checker):
+    code = "M3L010"
+    name = "host-sync-on-hot-path"
+
+    # The declared hot-entry registry: the paths PAPER.md promises stay
+    # one warm device dispatch. Grown here (with a cardinality-style
+    # argument in CONTRIBUTING.md) as new hot surfaces are added.
+    HOT_ENTRIES = (
+        ("m3_tpu/resident/scan.py", "resident_scan_totals"),
+        ("m3_tpu/parallel/scan.py", "chunked_scan_aggregate_packed"),
+        ("m3_tpu/query/plan.py", "Planner.run"),
+        ("m3_tpu/ingest/buffer.py", "ColumnWriteBuffer.sync"),
+    )
+
+    def check_project(self, model):
+        chains = hot_reachability(model, self.HOT_ENTRIES)
+        for qualname, chain in sorted(chains.items()):
+            fi = model.functions[qualname]
+            path = " -> ".join(chain)
+            for line, desc in self._sync_ops(fi):
+                yield self.finding(
+                    fi.rel,
+                    line,
+                    f"{desc} reachable from hot entry ({path}) — the "
+                    "scan/aggregate path must stay one device dispatch "
+                    "with zero host transfer; hoist the sync off the hot "
+                    "path or suppress at a sanctioned boundary with a "
+                    "rationale",
+                )
+
+    def _sync_ops(self, fi):
+        device_names = self._device_derived(fi)
+        for call in fi.calls:
+            node = call.node
+            if call.name == "block_until_ready":
+                yield node.lineno, "block_until_ready()"
+            elif call.name == "device_put":
+                yield node.lineno, "jax.device_put()"
+            elif (
+                call.name == "asarray"
+                and call.receiver in ("np", "numpy")
+                and not (node.args and self._host_literal(node.args[0]))
+            ):
+                yield node.lineno, "np.asarray() (device->host copy)"
+            elif (
+                call.name == "item"
+                and isinstance(node.func, ast.Attribute)
+                and not node.args
+            ):
+                yield node.lineno, ".item() (host scalar readback)"
+            elif (
+                call.receiver == ""
+                and call.name in ("float", "bool", "int")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in device_names
+            ):
+                yield (
+                    node.lineno,
+                    f"{call.name}() on device value "
+                    f"`{node.args[0].id}` (host scalar readback)",
+                )
+
+    @staticmethod
+    def _host_literal(node):
+        """np.asarray over a Python list/tuple/comprehension builds a
+        host array from host data — shaping, not a device sync."""
+        if isinstance(node, ast.BoolOp):
+            return all(
+                HostSyncOnHotPath._host_literal(v) for v in node.values
+            )
+        return isinstance(
+            node,
+            (ast.List, ast.ListComp, ast.Tuple, ast.GeneratorExp,
+             ast.Dict, ast.Constant),
+        )
+
+    @staticmethod
+    def _device_derived(fi):
+        """Names assigned from jnp/jax/lax calls inside this function —
+        the intra-function dataflow feeding float()/bool() checks."""
+        names = set()
+        for node in ast.walk(fi.node):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            if _receiver_name(node.value.func) not in ("jnp", "jax", "lax"):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+
+# ---------------------------------------------------------------- M3L011
+
+
+@register
+class JitRecompileHazard(Checker):
+    code = "M3L011"
+    name = "jit-recompile-hazard"
+
+    def check_project(self, model):
+        yield from self._jit_in_body(model)
+        yield from self._mutated_closure_reads(model)
+        yield from self._traced_branches(model)
+
+    def _jit_in_body(self, model):
+        for s in model.jit_surfaces:
+            if s.kind != "call" or not s.in_function:
+                continue
+            if s.memoized or s.enclosing_cached:
+                continue
+            if s.returned:
+                continue  # a factory RETURNING the compiled callable —
+                # the caller owns memoization (kernels._get_jit, the
+                # make_sharded_* builders)
+            if s.in_function.endswith("__init__"):
+                continue  # once per instance, not per call
+            yield self.finding(
+                s.rel,
+                s.lineno,
+                f"jax.jit constructed inside {s.in_function}() on every "
+                "call — each construction re-traces/re-hashes the "
+                "signature; hoist it to module level, memoize through a "
+                "`global` slot, or wrap the factory in functools.lru_cache",
+            )
+
+    def _mutated_closure_reads(self, model):
+        from .model import module_name_for
+
+        for s in model.jit_surfaces:
+            if s.kind != "decorated":
+                continue
+            fn = self._find_def(model, s)
+            if fn is None:
+                continue
+            mod = module_name_for(s.rel)
+            local = _local_names(fn)
+            seen = set()
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    continue
+                key = (mod, node.id)
+                if node.id in local or node.id in seen:
+                    continue
+                sites = model.module_attr_mutations.get(key)
+                if not sites:
+                    continue
+                seen.add(node.id)
+                wrel, wline = sites[0]
+                yield self.finding(
+                    s.rel,
+                    node.lineno,
+                    f"@jit function {s.name}() reads module global "
+                    f"`{node.id}` which {wrel}:{wline} reassigns through "
+                    "an import alias — the trace captured the old value "
+                    "and will silently serve it forever; pass it as an "
+                    "argument or mark it static",
+                )
+
+    def _traced_branches(self, model):
+        for s in model.jit_surfaces:
+            if s.kind != "decorated":
+                continue
+            fn = self._find_def(model, s)
+            if fn is None:
+                continue
+            params = [a.arg for a in fn.args.args]
+            static = set(s.static_argnames)
+            for i in s.static_argnums:
+                if 0 <= i < len(params):
+                    static.add(params[i])
+            traced = {p for p in params if p not in static and p != "self"}
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                for name in _bare_value_names(node.test):
+                    if name in traced:
+                        yield self.finding(
+                            s.rel,
+                            node.lineno,
+                            f"Python {type(node).__name__.lower()} "
+                            f"branches on traced parameter `{name}` "
+                            f"inside @jit {s.name}() — value branches "
+                            "don't trace (TracerBoolConversionError) and "
+                            "shape derivation belongs in static argnums; "
+                            "use jnp.where / lax.cond or mark the "
+                            "argument static",
+                        )
+                        break
+
+    @staticmethod
+    def _find_def(model, surface):
+        for ctx in model.contexts:
+            if ctx.rel != surface.rel:
+                continue
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == surface.name
+                    and node.lineno == surface.lineno
+                ):
+                    return node
+        return None
+
+
+def _local_names(fn):
+    names = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    if fn.args.vararg:
+        names.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+    return names
+
+
+def _bare_value_names(test):
+    """Name loads in a branch test that reach the boolean through only
+    Compare/BoolOp/UnaryOp/BinOp — i.e. the VALUE is branched on.
+    `x.shape`/`x.ndim`/`len(x)`/`x is None` are static at trace time and
+    excluded (their Name sits under an Attribute/Call/`is` compare)."""
+    parents = {}
+    for node in ast.walk(test):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(test):
+        if not (
+            isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+        ):
+            continue
+        ok = True
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in cur.ops
+            ):
+                ok = False
+                break
+            if not isinstance(
+                cur, (ast.Compare, ast.BoolOp, ast.UnaryOp, ast.BinOp)
+            ):
+                ok = False
+                break
+            cur = parents.get(cur)
+        if ok:
+            yield node.id
+
+
+# ---------------------------------------------------------------- M3L012
+
+
+@register
+class DonationAfterUse(Checker):
+    code = "M3L012"
+    name = "donation-after-use"
+
+    def check_project(self, model):
+        for s in model.jit_surfaces:
+            if not s.donate_argnums or not s.name:
+                continue
+            for fi in model.functions.values():
+                if fi.rel != s.rel:
+                    continue
+                for call in fi.calls:
+                    if call.name != s.name:
+                        continue
+                    yield from self._check_call(fi, call, s)
+
+    def _check_call(self, fi, call, surface):
+        # `return JIT(x, ...)` hands the buffer off with the dispatch —
+        # lines after it are other control-flow paths, not uses
+        for node in ast.walk(fi.node):
+            if (
+                isinstance(node, ast.Return)
+                and node.value is not None
+                and any(n is call.node for n in ast.walk(node.value))
+            ):
+                return
+        for pos in surface.donate_argnums:
+            if pos >= len(call.node.args):
+                continue
+            arg = call.node.args[pos]
+            if not isinstance(arg, ast.Name):
+                continue
+            use = self._use_after(fi, call, arg.id)
+            if use is not None:
+                yield self.finding(
+                    fi.rel,
+                    use,
+                    f"`{arg.id}` was donated to {surface.name} "
+                    f"(donate_argnums position {pos}, line "
+                    f"{call.lineno}) and is read again here — donation "
+                    "hands the buffer to XLA and the old reference is "
+                    "invalid; rebind the name to the dispatch result or "
+                    "drop donation",
+                )
+
+    @staticmethod
+    def _use_after(fi, call, name):
+        """First Load of `name` after the dispatch line with no
+        intervening rebind (linear document-order approximation; the
+        rebind-at-dispatch `x = jit(x)` pattern clears it)."""
+        inside = {id(n) for n in ast.walk(call.node)}
+        stores = sorted(
+            n.lineno
+            for n in ast.walk(fi.node)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Store)
+            and n.id == name
+        )
+        loads = sorted(
+            n.lineno
+            for n in ast.walk(fi.node)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id == name
+            and id(n) not in inside
+            and n.lineno > call.lineno
+        )
+        for use in loads:
+            if any(call.lineno <= s < use for s in stores):
+                return None  # rebound before this use — donation-safe
+            return use
+        return None
